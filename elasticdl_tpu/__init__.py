@@ -1,0 +1,18 @@
+"""elasticdl_tpu — a TPU-native elastic deep-learning framework.
+
+A ground-up rebuild of the capabilities of ElasticDL (Kubernetes-native elastic
+training with dynamic data sharding, fault tolerance, parameter-server-class
+sparse embeddings, and a train/evaluate/predict CLI over a model zoo) designed
+idiomatically for TPUs:
+
+* the compute plane is a single jit-compiled JAX train step sharded over a
+  ``jax.sharding.Mesh`` (XLA collectives over ICI replace the reference's
+  gRPC parameter-server push/pull data plane),
+* sparse embedding tables live sharded across device HBM and are updated with
+  static-shape gather/scatter (the reference keeps them in PS pod RAM),
+* the control plane (master task queue, dynamic data sharding, elasticity)
+  remains a small Python + gRPC service, as in the reference
+  (``/root/reference/elasticdl/python/master``).
+"""
+
+from elasticdl_tpu.version import __version__  # noqa: F401

@@ -1,0 +1,97 @@
+"""Framework-wide constants.
+
+Parity with the reference's ``elasticdl/python/common/constants.py`` plus the
+TPU-specific knobs this framework adds (mesh axis names, record format magic).
+"""
+
+
+class Mode(object):
+    """Job modes (reference: common/constants.py `Mode`)."""
+
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+
+
+class TaskExecCounterKey(object):
+    FAIL_COUNT = "fail_count"
+
+
+class GRPC(object):
+    """Control-plane gRPC caps (reference: common/constants.py `GRPC`,
+    go/pkg/ps/server.go:31-34 — 256 MB caps). The data plane in this framework
+    never rides gRPC, so these only bound control messages (eval outputs,
+    checkpoint metadata)."""
+
+    MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
+    MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+
+class WorkerEnv(object):
+    MASTER_ADDR = "EDL_TPU_MASTER_ADDR"
+    WORKER_ID = "EDL_TPU_WORKER_ID"
+    WORKER_NUM = "EDL_TPU_WORKER_NUM"
+
+
+class JobType(object):
+    TRAINING_ONLY = "training_only"
+    TRAINING_WITH_EVALUATION = "training_with_evaluation"
+    EVALUATION_ONLY = "evaluation_only"
+    PREDICTION_ONLY = "prediction_only"
+
+
+class DistributionStrategy(object):
+    """Distribution strategies.
+
+    The reference supports LOCAL / PARAMETER_SERVER / ALLREDUCE
+    (elasticdl_client/common/constants.py). On TPU the parameter-server data
+    plane is subsumed by sharded-HBM embeddings + XLA collectives, so
+    PARAMETER_SERVER is accepted as an alias for MESH (sharded embedding +
+    allreduce dense) to keep CLI parity.
+    """
+
+    LOCAL = "Local"
+    ALLREDUCE = "AllreduceStrategy"
+    PARAMETER_SERVER = "ParameterServerStrategy"
+    MESH = "MeshStrategy"
+
+
+class MeshAxis(object):
+    """Canonical mesh axis names, in order.
+
+    dp    data parallel (batch)
+    fsdp  fully-sharded data parallel (params/opt-state sharding over dp axis)
+    ep    expert / embedding-shard axis (sparse tables are sharded over it)
+    tp    tensor parallel
+    sp    sequence / context parallel (ring attention)
+    """
+
+    DP = "dp"
+    FSDP = "fsdp"
+    EP = "ep"
+    TP = "tp"
+    SP = "sp"
+    ALL = (DP, FSDP, EP, TP, SP)
+
+
+# Max retries for a dispatched task before the job fails
+# (reference: master/task_dispatcher.py:27 `_MAX_TASK_RETRIES = 3`).
+MAX_TASK_RETRIES = 3
+
+# Max retries of a single minibatch on the worker
+# (reference: worker/worker.py:62 `# The default maximum number of a minibatch retry ... 64`).
+MAX_MINIBATCH_RETRY_NUM = 64
+
+# Default number of records per dispatched task
+# (reference: elasticdl_client/common/args.py `--records_per_task` default).
+DEFAULT_RECORDS_PER_TASK = 64
+
+
+class ReaderType(object):
+    RECORDIO = "RecordIO"
+    CSV = "CSV"
+    TEXT = "Text"
+
+
+class SaveModelConfig(object):
+    SAVED_MODEL_PATH = "saved_model_path"

@@ -1,0 +1,66 @@
+"""Dtype registry: numpy <-> wire ids <-> JAX dtypes.
+
+Replaces the reference's TF-centric dtype maps
+(elasticdl/python/common/dtypes.py). Wire ids are stable small ints used by
+the tensor serialization (`tensor_utils.py`) and the native record codec.
+"""
+
+import numpy as np
+
+# Stable wire ids. Never renumber — checkpoints and the control-plane protocol
+# depend on them.
+_WIRE = [
+    (1, np.dtype(np.float16)),
+    (2, np.dtype(np.float32)),
+    (3, np.dtype(np.float64)),
+    (4, np.dtype(np.int8)),
+    (5, np.dtype(np.int16)),
+    (6, np.dtype(np.int32)),
+    (7, np.dtype(np.int64)),
+    (8, np.dtype(np.uint8)),
+    (9, np.dtype(np.uint16)),
+    (10, np.dtype(np.uint32)),
+    (11, np.dtype(np.uint64)),
+    (12, np.dtype(np.bool_)),
+    # bfloat16 — the TPU-native default compute dtype. numpy has no builtin
+    # bfloat16; ml_dtypes (a JAX dependency) provides it.
+    (13, None),  # placeholder, filled below
+    (14, np.dtype(object)),  # python bytes / str records
+]
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BFLOAT16 = None
+
+NP_DTYPE_TO_WIRE = {}
+WIRE_TO_NP_DTYPE = {}
+for wire_id, dt in _WIRE:
+    if wire_id == 13:
+        dt = _BFLOAT16
+    if dt is None:
+        continue
+    NP_DTYPE_TO_WIRE[dt] = wire_id
+    WIRE_TO_NP_DTYPE[wire_id] = dt
+
+
+def dtype_to_wire(dtype):
+    dtype = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    try:
+        return NP_DTYPE_TO_WIRE[dtype]
+    except KeyError:
+        raise ValueError("Unsupported dtype for serialization: %r" % (dtype,))
+
+
+def wire_to_dtype(wire_id):
+    try:
+        return WIRE_TO_NP_DTYPE[wire_id]
+    except KeyError:
+        raise ValueError("Unknown wire dtype id: %r" % (wire_id,))
+
+
+def is_numerical_dtype(dtype):
+    dtype = np.dtype(dtype)
+    return dtype.kind in "fiub"

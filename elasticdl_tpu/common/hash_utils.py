@@ -1,0 +1,45 @@
+"""Deterministic partitioning helpers.
+
+Parity with the reference's elasticdl/python/common/hash_utils.py:17-63:
+dense variables are placed by sha256-of-name mod N, embedding rows by id mod N.
+In this framework the same functions partition embedding rows across the mesh's
+`ep` axis shards and place host-spilled tables.
+"""
+
+import hashlib
+
+import numpy as np
+
+
+def string_to_id(name, bucket_num):
+    """sha256(name) mod bucket_num (reference hash_utils.py:17-22)."""
+    if bucket_num <= 0:
+        raise ValueError("bucket_num must be positive, got %d" % bucket_num)
+    digest = hashlib.sha256(name.encode("utf-8")).hexdigest()
+    return int(digest, 16) % bucket_num
+
+
+def int_to_id(value, bucket_num):
+    """value mod bucket_num (reference hash_utils.py:25-27)."""
+    if bucket_num <= 0:
+        raise ValueError("bucket_num must be positive, got %d" % bucket_num)
+    return int(value) % bucket_num
+
+
+def scatter_ids(ids, bucket_num):
+    """Partition an int array of ids into per-bucket index lists.
+
+    Returns (bucket_ids, bucket_positions): for each bucket b,
+    ``bucket_ids[b]`` holds the ids routed to b (id % bucket_num == b) and
+    ``bucket_positions[b]`` their positions in the input array, so results can
+    be scattered back (reference hash_utils.py `scatter_embedding_vector`
+    behavior, vectorized).
+    """
+    ids = np.asarray(ids)
+    buckets = ids % bucket_num
+    bucket_ids, bucket_positions = [], []
+    for b in range(bucket_num):
+        mask = buckets == b
+        bucket_ids.append(ids[mask])
+        bucket_positions.append(np.nonzero(mask)[0])
+    return bucket_ids, bucket_positions

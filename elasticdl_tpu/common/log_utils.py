@@ -1,0 +1,30 @@
+"""Structured logger factory (reference: elasticdl/python/common/log_utils.py)."""
+
+import logging
+import sys
+
+_DEFAULT_FMT = (
+    "[%(asctime)s] [%(levelname)s] "
+    "[%(filename)s:%(lineno)d:%(funcName)s] %(message)s"
+)
+
+_default_level = logging.INFO
+
+
+def set_default_level(level):
+    global _default_level
+    _default_level = level
+
+
+def get_logger(name, level=None, fmt=_DEFAULT_FMT):
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(handler)
+        logger.propagate = False
+    logger.setLevel(level if level is not None else _default_level)
+    return logger
+
+
+default_logger = get_logger("elasticdl_tpu")

@@ -1,0 +1,168 @@
+"""Model-zoo module loading and spec discovery by convention.
+
+Parity with the reference's elasticdl/python/common/model_utils.py:139-198: a
+model definition is a Python module that exports, by name,
+
+    custom_model()    -> a flax.linen.Module (reference: a Keras model)
+    loss              -> loss(labels, predictions) scalar
+    optimizer         -> optimizer(**kwargs) returning an optax transform
+    dataset_fn        -> dataset_fn(dataset, mode, metadata) -> dataset
+    eval_metrics_fn   -> dict {metric_name: fn(labels, predictions)}
+
+plus optionally `callbacks()`, `custom_data_reader`,
+`prediction_outputs_processor`, and `feature_shapes()` (TPU addition: static
+shapes so the train step compiles once).
+"""
+
+import importlib
+import importlib.util
+import os
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+def load_module(module_file):
+    spec = importlib.util.spec_from_file_location(module_file, module_file)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def get_module_file_path(model_zoo, spec_key):
+    """'<pkg>.<module>.<name>' -> '<model_zoo>/<pkg>/<module>.py'
+    (reference model_utils.py `get_module_file_path`)."""
+    return os.path.join(model_zoo, *spec_key.split(".")[:-1]) + ".py"
+
+
+def get_dict_from_params_str(params_str):
+    """Parse 'k1=v1; k2=v2' model/reader params with Python literal values
+    (reference: common/model_utils.py:79-94)."""
+    if not params_str:
+        return {}
+    out = {}
+    for kv in params_str.split(";"):
+        kv = kv.strip()
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        k, v = k.strip(), v.strip()
+        try:
+            out[k] = eval(v, {"__builtins__": {}}, {})
+        except Exception:
+            out[k] = v
+    return out
+
+
+def _get_spec_value(spec_key, model_zoo, default_module, required=False):
+    """Resolve a spec item either from the model-def module (bare name) or a
+    separate module path 'a.b.name' under model_zoo
+    (reference model_utils.py:113-137)."""
+    if spec_key is None:
+        return None
+    if "." in spec_key:
+        module_file = get_module_file_path(model_zoo, spec_key)
+        module = load_module(module_file).__dict__
+        name = spec_key.split(".")[-1]
+    else:
+        module = default_module
+        name = spec_key
+    value = module.get(name, None)
+    if required and value is None:
+        raise ValueError(
+            "Missing required spec key %s in the module" % spec_key
+        )
+    return value
+
+
+class ModelSpec(object):
+    """Resolved model-zoo spec (reference get_model_spec returns a tuple;
+    a named object is kinder to callers)."""
+
+    def __init__(
+        self,
+        model_fn,
+        dataset_fn,
+        loss,
+        optimizer,
+        eval_metrics_fn,
+        prediction_outputs_processor=None,
+        custom_data_reader=None,
+        callbacks_fn=None,
+        feature_shapes=None,
+        module=None,
+    ):
+        self.model_fn = model_fn
+        self.dataset_fn = dataset_fn
+        self.loss = loss
+        self.optimizer = optimizer
+        self.eval_metrics_fn = eval_metrics_fn
+        self.prediction_outputs_processor = prediction_outputs_processor
+        self.custom_data_reader = custom_data_reader
+        self.callbacks_fn = callbacks_fn
+        self.feature_shapes = feature_shapes
+        self.module = module
+
+    def create_model(self, model_params_str=""):
+        kwargs = get_dict_from_params_str(model_params_str)
+        return self.model_fn(**kwargs)
+
+
+def get_model_spec(
+    model_zoo,
+    model_def,
+    dataset_fn="dataset_fn",
+    loss="loss",
+    optimizer="optimizer",
+    eval_metrics_fn="eval_metrics_fn",
+    prediction_outputs_processor="PredictionOutputsProcessor",
+    custom_data_reader="custom_data_reader",
+    callbacks="callbacks",
+):
+    """Load the model-def module and resolve all spec items by convention
+    (reference model_utils.py:139-198)."""
+    module_file = get_module_file_path(model_zoo, model_def)
+    module = load_module(module_file).__dict__
+    model_name = model_def.split(".")[-1]
+    model_fn = module.get(model_name, None)
+    if model_fn is None:
+        raise ValueError(
+            "Cannot find the model function %s in %s"
+            % (model_name, module_file)
+        )
+    pop = module.get(prediction_outputs_processor, None) if isinstance(
+        prediction_outputs_processor, str
+    ) else prediction_outputs_processor
+    return ModelSpec(
+        model_fn=model_fn,
+        dataset_fn=_get_spec_value(dataset_fn, model_zoo, module, required=True),
+        loss=_get_spec_value(loss, model_zoo, module, required=True),
+        optimizer=_get_spec_value(optimizer, model_zoo, module, required=True),
+        eval_metrics_fn=_get_spec_value(
+            eval_metrics_fn, model_zoo, module, required=True
+        ),
+        prediction_outputs_processor=pop,
+        custom_data_reader=_get_spec_value(
+            custom_data_reader, model_zoo, module
+        ),
+        callbacks_fn=module.get(callbacks, None),
+        feature_shapes=module.get("feature_shapes", None),
+        module=module,
+    )
+
+
+def load_model_spec_from_module(module):
+    """Build a ModelSpec from an already-imported module object (used by
+    tests and the local executor)."""
+    d = module.__dict__
+    return ModelSpec(
+        model_fn=d["custom_model"],
+        dataset_fn=d["dataset_fn"],
+        loss=d["loss"],
+        optimizer=d["optimizer"],
+        eval_metrics_fn=d["eval_metrics_fn"],
+        prediction_outputs_processor=d.get("PredictionOutputsProcessor"),
+        custom_data_reader=d.get("custom_data_reader"),
+        callbacks_fn=d.get("callbacks"),
+        feature_shapes=d.get("feature_shapes"),
+        module=module,
+    )

@@ -1,0 +1,99 @@
+"""ndarray / pytree (de)serialization for the control plane and checkpoints.
+
+Replaces the reference's TF `TensorProto`-based codec
+(elasticdl/python/common/tensor_utils.py:29-114) with a self-contained binary
+layout (no TF dependency):
+
+    Tensor   := name | wire_dtype | ndim | dims[] | raw bytes (C-order)
+    IndexedSlices := ids tensor + values tensor
+
+Also provides `deduplicate_indexed_slices` / `merge_indexed_slices`, which the
+reference uses to combine sparse embedding gradients before the PS scatter
+(tensor_utils.py:84-114); here they feed the sharded-HBM embedding update.
+"""
+
+import struct
+
+import numpy as np
+
+from elasticdl_tpu.common.dtypes import dtype_to_wire, wire_to_dtype
+
+_HEADER = struct.Struct("<HBB")  # name_len, wire_dtype, ndim
+_DIM = struct.Struct("<q")
+
+
+def serialize_ndarray(array, name=""):
+    """Serialize one ndarray (with optional name) to bytes."""
+    array = np.ascontiguousarray(array)
+    name_b = name.encode("utf-8")
+    if len(name_b) > 0xFFFF:
+        raise ValueError("tensor name too long")
+    parts = [_HEADER.pack(len(name_b), dtype_to_wire(array.dtype), array.ndim)]
+    parts.append(name_b)
+    for d in array.shape:
+        parts.append(_DIM.pack(d))
+    parts.append(array.tobytes())
+    return b"".join(parts)
+
+
+def deserialize_ndarray(buf, offset=0):
+    """Inverse of serialize_ndarray. Returns (name, array, next_offset)."""
+    name_len, wire, ndim = _HEADER.unpack_from(buf, offset)
+    offset += _HEADER.size
+    name = bytes(buf[offset : offset + name_len]).decode("utf-8")
+    offset += name_len
+    shape = []
+    for _ in range(ndim):
+        (d,) = _DIM.unpack_from(buf, offset)
+        shape.append(d)
+        offset += _DIM.size
+    dtype = wire_to_dtype(wire)
+    count = int(np.prod(shape)) if shape else 1
+    nbytes = count * dtype.itemsize
+    array = np.frombuffer(buf, dtype=dtype, count=count, offset=offset).reshape(
+        shape
+    )
+    offset += nbytes
+    return name, array, offset
+
+
+def serialize_ndarray_dict(d):
+    """Serialize {name: ndarray} to bytes (order-stable by name)."""
+    parts = [struct.pack("<I", len(d))]
+    for name in sorted(d):
+        parts.append(serialize_ndarray(np.asarray(d[name]), name))
+    return b"".join(parts)
+
+
+def deserialize_ndarray_dict(buf):
+    (n,) = struct.unpack_from("<I", buf, 0)
+    offset = 4
+    out = {}
+    for _ in range(n):
+        name, arr, offset = deserialize_ndarray(buf, offset)
+        out[name] = arr
+    return out
+
+
+def deduplicate_indexed_slices(values, indices):
+    """Sum-combine rows with duplicate indices.
+
+    Reference: common/tensor_utils.py `deduplicate_indexed_slices` (via
+    tf.math.segment_sum). Pure numpy: returns (sum_combined_values,
+    unique_indices) where sum_combined_values[i] is the sum of all rows of
+    `values` whose index == unique_indices[i].
+    """
+    values = np.asarray(values)
+    indices = np.asarray(indices)
+    unique_ids, inverse = np.unique(indices, return_inverse=True)
+    summed = np.zeros((unique_ids.shape[0],) + values.shape[1:], values.dtype)
+    np.add.at(summed, inverse, values)
+    return summed, unique_ids
+
+
+def merge_indexed_slices(*slices_list):
+    """Concatenate (values, ids) pairs (reference tensor_utils.py
+    `merge_indexed_slices`); combine with deduplicate_indexed_slices."""
+    values = np.concatenate([np.asarray(v) for v, _ in slices_list], axis=0)
+    ids = np.concatenate([np.asarray(i) for _, i in slices_list], axis=0)
+    return values, ids

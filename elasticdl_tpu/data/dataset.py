@@ -1,0 +1,195 @@
+"""A minimal host-side dataset pipeline (tf.data replacement).
+
+The reference feeds workers with ``tf.data.Dataset.from_generator`` over the
+task stream and lets the model zoo's ``dataset_fn`` map/shuffle/batch it
+(worker/task_data_service.py:163-203, model zoo dataset_fn convention). TPU
+input pipelines are host-side numpy anyway (device work happens inside jit),
+so this module provides the small composable subset the model zoo needs:
+
+    Dataset.from_generator(gen_fn)
+      .map(fn) .shuffle(buffer_size) .batch(n, drop_remainder) .prefetch(n)
+
+Batching stacks dict-of-ndarray (or tuple) elements into leading-batch-dim
+numpy arrays, ready for ``jax.device_put`` with a batch sharding.
+"""
+
+import collections
+import queue
+import random
+import threading
+
+import numpy as np
+
+
+class Dataset(object):
+    def __init__(self, source_fn):
+        # source_fn: () -> iterator of elements
+        self._source_fn = source_fn
+
+    @staticmethod
+    def from_generator(gen_fn):
+        return Dataset(gen_fn)
+
+    @staticmethod
+    def from_list(items):
+        return Dataset(lambda: iter(list(items)))
+
+    def map(self, fn):
+        src = self._source_fn
+
+        def gen():
+            for x in src():
+                yield fn(x)
+
+        return Dataset(gen)
+
+    def filter(self, pred):
+        src = self._source_fn
+
+        def gen():
+            for x in src():
+                if pred(x):
+                    yield x
+
+        return Dataset(gen)
+
+    def shuffle(self, buffer_size, seed=None):
+        src = self._source_fn
+
+        def gen():
+            rng = random.Random(seed)
+            buf = []
+            for x in src():
+                buf.append(x)
+                if len(buf) >= buffer_size:
+                    i = rng.randrange(len(buf))
+                    buf[i], buf[-1] = buf[-1], buf[i]
+                    yield buf.pop()
+            rng.shuffle(buf)
+            for x in buf:
+                yield x
+
+        return Dataset(gen)
+
+    def repeat(self, count=None):
+        src = self._source_fn
+
+        def gen():
+            n = 0
+            while count is None or n < count:
+                emitted = False
+                for x in src():
+                    emitted = True
+                    yield x
+                n += 1
+                if not emitted:
+                    return
+
+        return Dataset(gen)
+
+    def take(self, count):
+        src = self._source_fn
+
+        def gen():
+            it = src()
+            for _ in range(count):
+                try:
+                    yield next(it)
+                except StopIteration:
+                    return
+
+        return Dataset(gen)
+
+    def batch(self, batch_size, drop_remainder=False):
+        src = self._source_fn
+
+        def gen():
+            buf = []
+            for x in src():
+                buf.append(x)
+                if len(buf) == batch_size:
+                    yield _stack(buf)
+                    buf = []
+            if buf and not drop_remainder:
+                yield _stack(buf)
+
+        return Dataset(gen)
+
+    def prefetch(self, buffer_size=1):
+        src = self._source_fn
+
+        def gen():
+            q = queue.Queue(maxsize=max(1, buffer_size))
+            _SENTINEL = object()
+            err = []
+
+            def producer():
+                try:
+                    for x in src():
+                        q.put(x)
+                except BaseException as e:  # propagate into consumer
+                    err.append(e)
+                finally:
+                    q.put(_SENTINEL)
+
+            t = threading.Thread(target=producer, daemon=True)
+            t.start()
+            while True:
+                x = q.get()
+                if x is _SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield x
+
+        return Dataset(gen)
+
+    def __iter__(self):
+        return self._source_fn()
+
+
+def _stack(elements):
+    """Stack a list of homogeneous elements (dicts, tuples, or arrays) into a
+    batched element with a leading batch axis."""
+    first = elements[0]
+    if isinstance(first, dict):
+        return collections.OrderedDict(
+            (k, _stack([e[k] for e in elements])) for k in first
+        )
+    if isinstance(first, tuple):
+        return tuple(
+            _stack([e[i] for e in elements]) for i in range(len(first))
+        )
+    arrs = [np.asarray(e) for e in elements]
+    return np.stack(arrs, axis=0)
+
+
+def pad_batch(batch, batch_size):
+    """Pad the leading axis of every array in `batch` to `batch_size` by
+    repeating the last element; returns (padded_batch, true_count).
+
+    XLA-compiled steps need static shapes; the final partial batch of a task
+    is padded up and the loss/metric masked by true_count.
+    """
+    def leading(x):
+        return np.asarray(x).shape[0]
+
+    def pad(x):
+        x = np.asarray(x)
+        n = x.shape[0]
+        if n == batch_size:
+            return x
+        reps = np.repeat(x[-1:], batch_size - n, axis=0)
+        return np.concatenate([x, reps], axis=0)
+
+    if isinstance(batch, dict):
+        n = leading(next(iter(batch.values())))
+        return {k: pad(v) for k, v in batch.items()}, n
+    if isinstance(batch, tuple):
+        n = leading(batch[0] if not isinstance(batch[0], dict) else next(iter(batch[0].values())))
+        return tuple(
+            {k: pad(v) for k, v in b.items()} if isinstance(b, dict) else pad(b)
+            for b in batch
+        ), n
+    n = leading(batch)
+    return pad(batch), n

@@ -1,0 +1,23 @@
+"""Example codec: {feature_name: ndarray} <-> record payload bytes.
+
+The reference serializes training examples as TF `tf.train.Example` protos
+inside RecordIO (e.g. model_zoo/mnist_functional_api/mnist_functional_api.py
+`prepare_data_for_a_single_file`). This framework is TF-free: an example is a
+dict of named ndarrays serialized with the same binary tensor layout as the
+control plane (common/tensor_utils.py).
+"""
+
+from elasticdl_tpu.common.tensor_utils import (
+    deserialize_ndarray_dict,
+    serialize_ndarray_dict,
+)
+
+
+def encode_example(features):
+    """features: {name: ndarray-like} -> bytes."""
+    return serialize_ndarray_dict(features)
+
+
+def decode_example(payload):
+    """bytes -> {name: ndarray}."""
+    return deserialize_ndarray_dict(payload)

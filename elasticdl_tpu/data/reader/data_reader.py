@@ -1,0 +1,52 @@
+"""AbstractDataReader: the pluggable data-source interface.
+
+Parity with the reference's elasticdl/python/data/reader/data_reader.py:65-106:
+a reader exposes ``read_records(task)`` (a generator over the task's record
+range) and ``create_shards()`` (the {shard_name: (start, num_records)} map the
+master shards into tasks). Tasks — not ranks — are the unit of work, which is
+what makes the worker count elastic.
+"""
+
+from abc import ABC, abstractmethod
+
+
+class Metadata(object):
+    """Dataset metadata: column names/dtypes for table-like sources
+    (reference data_reader.py `Metadata`)."""
+
+    def __init__(self, column_names, column_dtypes=None):
+        self.column_names = column_names
+        self.column_dtypes = column_dtypes
+
+
+class AbstractDataReader(ABC):
+    def __init__(self, **kwargs):
+        pass
+
+    @abstractmethod
+    def read_records(self, task):
+        """Yield raw records (bytes or parsed rows) for `task`'s
+        [start, end) range of its shard."""
+
+    @abstractmethod
+    def create_shards(self):
+        """Return {shard_name: (start_index, num_records)}."""
+
+    @property
+    def records_output_types(self):
+        """Kept for API parity; TPU pipeline is dtype-agnostic until
+        dataset_fn parses records."""
+        return None
+
+    @property
+    def metadata(self):
+        return Metadata(column_names=None)
+
+
+def check_required_kwargs(required_args, kwargs):
+    missing = [k for k in required_args if k not in kwargs]
+    if missing:
+        raise ValueError(
+            "The following required arguments are missing: %s"
+            % ", ".join(missing)
+        )

@@ -1,0 +1,30 @@
+"""Reader factory (reference data/reader/data_reader_factory.py:23-73).
+
+Resolution order: explicit `reader_type` param > custom reader from the model
+zoo > extension sniffing (.csv -> CSV, else TRec/RecordIO).
+"""
+
+import os
+
+from elasticdl_tpu.common.constants import ReaderType
+from elasticdl_tpu.data.reader.csv_reader import CSVDataReader
+from elasticdl_tpu.data.reader.recordio_reader import RecordIODataReader
+
+
+def create_data_reader(data_origin, records_per_task=None, **kwargs):
+    reader_type = kwargs.pop("reader_type", None)
+    kwargs.setdefault("data_dir", data_origin)
+    if records_per_task is not None:
+        kwargs.setdefault("records_per_task", records_per_task)
+
+    if reader_type is None:
+        if data_origin and os.path.isdir(data_origin):
+            names = os.listdir(data_origin)
+            if names and all(n.endswith(".csv") for n in names):
+                return CSVDataReader(**kwargs)
+        return RecordIODataReader(**kwargs)
+    if reader_type == ReaderType.CSV:
+        return CSVDataReader(**kwargs)
+    if reader_type == ReaderType.RECORDIO:
+        return RecordIODataReader(**kwargs)
+    raise ValueError("Unknown reader_type %s" % reader_type)

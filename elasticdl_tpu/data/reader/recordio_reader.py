@@ -1,0 +1,54 @@
+"""TRec (RecordIO-equivalent) reader.
+
+Parity with reference data/reader/recordio_reader.py:27-62: shards are one
+file each, named by path, with (0, record_count); read_records scans
+[task.start, task.end) of task.shard_name. Uses the native C++ scanner when
+built, else the pure-Python codec.
+"""
+
+import os
+
+from elasticdl_tpu.data.reader.data_reader import (
+    AbstractDataReader,
+    check_required_kwargs,
+)
+
+
+def _scan(path, start, count):
+    try:
+        from elasticdl_tpu.native import recordio_native
+
+        if recordio_native.available():
+            return recordio_native.scan(path, start, count)
+    except Exception:
+        pass
+    from elasticdl_tpu.data import record_format
+
+    return iter(record_format.Scanner(path, start, count))
+
+
+class RecordIODataReader(AbstractDataReader):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        check_required_kwargs(["data_dir"], kwargs)
+        self._kwargs = kwargs
+
+    def read_records(self, task):
+        for record in _scan(
+            task.shard_name, task.start, task.end - task.start
+        ):
+            yield record
+
+    def create_shards(self):
+        from elasticdl_tpu.data.record_format import get_record_count
+
+        data_dir = self._kwargs["data_dir"]
+        if not data_dir:
+            return {}
+        shards = {}
+        for fname in sorted(os.listdir(data_dir)):
+            path = os.path.join(data_dir, fname)
+            if not os.path.isfile(path):
+                continue
+            shards[path] = (0, get_record_count(path))
+        return shards

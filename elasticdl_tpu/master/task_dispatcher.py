@@ -1,0 +1,307 @@
+"""Dynamic data sharding: the master-owned task queue.
+
+Behavioral parity with the reference's master/task_dispatcher.py:27-392 —
+tasks are record ranges (shard_name, start, end) of ``records_per_task``
+records; workers pull tasks, so the worker count is elastic by construction:
+
+* per-epoch TRAINING task creation with shuffle; EVALUATION / PREDICTION /
+  TRAIN_END_CALLBACK task types,
+* todo / doing bookkeeping keyed by task_id with per-task start timestamps
+  (feeds the straggler watchdog),
+* failed tasks are re-queued at most ``MAX_TASK_RETRIES`` (=3) times,
+* epoch rollover happens lazily inside ``get`` when the todo list drains,
+* a deferred TRAIN_END_CALLBACK task (one shard of data) is appended after
+  all training tasks finish so the worker can run train-end callbacks
+  (SavedModel export) with real data,
+* ``recover_tasks(worker_id)`` re-queues everything a dead worker was doing.
+
+TF-free: callbacks are the framework's own (elasticdl_tpu/api/callbacks.py);
+`stop_training` lives on the dispatcher itself and is toggled by
+MaxStepsStopping-style callbacks.
+"""
+
+import random
+import threading
+import time
+
+from elasticdl_tpu.common.constants import (
+    MAX_TASK_RETRIES,
+    TaskExecCounterKey,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class TaskType(object):
+    """Task types (reference: proto enum elasticdl.proto TaskType)."""
+
+    TRAINING = "TRAINING"
+    EVALUATION = "EVALUATION"
+    PREDICTION = "PREDICTION"
+    WAIT = "WAIT"
+    TRAIN_END_CALLBACK = "TRAIN_END_CALLBACK"
+
+
+class Task(object):
+    """A record-range work item (reference _Task)."""
+
+    __slots__ = ("shard_name", "start", "end", "type", "model_version",
+                 "extended_config")
+
+    def __init__(self, shard_name, start, end, type, model_version=-1,
+                 **kwargs):
+        self.shard_name = shard_name
+        self.start = start
+        self.end = end
+        self.type = type
+        self.model_version = model_version
+        self.extended_config = kwargs
+
+    def _info(self):
+        return (
+            self.shard_name, self.start, self.end, self.type,
+            self.model_version,
+        )
+
+    def __repr__(self):
+        return "Task(%s[%d:%d], %s, v%d)" % self._info()
+
+
+class JobCounter(object):
+    def __init__(self, total_records=0, failed_records=0):
+        self.total_records = total_records
+        self.failed_records = failed_records
+
+
+class TaskDispatcher(object):
+    def __init__(
+        self,
+        training_shards,
+        evaluation_shards,
+        prediction_shards,
+        records_per_task,
+        num_epochs,
+        callbacks_list=None,
+    ):
+        self._lock = threading.Lock()
+        self._num_epochs = num_epochs
+        self._epoch = 0
+        self._training_shards = training_shards
+        self._evaluation_shards = evaluation_shards
+        self._prediction_shards = prediction_shards
+        self._records_per_task = records_per_task
+        self._callbacks_list = callbacks_list
+        self.stop_training = False
+
+        self._todo = []
+        self._doing = {}  # task_id -> (worker_id, task, start_time)
+        self._task_id = 0
+        self._eval_todo = []
+        self._evaluation_service = None
+        self._tasks_done_deferred_callbacks = []
+        self._job_counters = {}
+        self._task_retry_count = {}
+
+        if self._training_shards:
+            logger.info("Starting epoch %d", self._epoch)
+            self.create_tasks(TaskType.TRAINING)
+        elif self._evaluation_shards:
+            self.create_tasks(TaskType.EVALUATION)
+        elif self._prediction_shards:
+            self.create_tasks(TaskType.PREDICTION)
+
+    def reset_job_counters(self, task_type):
+        self._job_counters[task_type] = JobCounter()
+
+    def create_tasks(self, task_type, model_version=-1):
+        logger.info(
+            "Creating a new set of %s tasks for model version %d",
+            task_type.lower(),
+            model_version,
+        )
+        self.reset_job_counters(task_type)
+        if task_type == TaskType.TRAINING:
+            shards = self._training_shards
+        elif task_type == TaskType.EVALUATION:
+            shards = self._evaluation_shards
+        else:
+            shards = self._prediction_shards
+        tasks = []
+        counter = self._job_counters[task_type]
+        for shard_name, (start_ind, num_records) in shards.items():
+            max_ind = start_ind + num_records
+            counter.total_records += num_records
+            for task_start in range(start_ind, max_ind,
+                                    self._records_per_task):
+                tasks.append(
+                    Task(
+                        shard_name=shard_name,
+                        start=task_start,
+                        end=min(task_start + self._records_per_task, max_ind),
+                        type=task_type,
+                        model_version=model_version,
+                    )
+                )
+        if task_type == TaskType.TRAINING:
+            random.shuffle(tasks)
+            self._todo.extend(tasks)
+        elif task_type == TaskType.EVALUATION:
+            self._eval_todo.extend(tasks)
+        else:
+            self._todo.extend(tasks)
+        logger.info("%d tasks created with total of %d records.",
+                    len(tasks), counter.total_records)
+
+    def get_eval_task(self, worker_id):
+        with self._lock:
+            if not self._eval_todo:
+                return -1, None
+            self._task_id += 1
+            task = self._eval_todo.pop()
+            self._doing[self._task_id] = (worker_id, task, time.time())
+            return self._task_id, task
+
+    def _create_train_end_callback_task(self):
+        """Append one TRAIN_END_CALLBACK task carrying the first shard's
+        first task-range of data (reference :219-250)."""
+        if not self._training_shards:
+            return
+        self.reset_job_counters(TaskType.TRAIN_END_CALLBACK)
+        shard_name, (start_ind, num_records) = next(
+            iter(self._training_shards.items())
+        )
+        self._todo.append(
+            Task(
+                shard_name=shard_name,
+                start=start_ind,
+                end=start_ind + min(self._records_per_task, num_records),
+                type=TaskType.TRAIN_END_CALLBACK,
+            )
+        )
+
+    def add_deferred_callback_create_train_end_task(self):
+        self._tasks_done_deferred_callbacks.append(
+            self._create_train_end_callback_task
+        )
+
+    def invoke_deferred_callback(self):
+        with self._lock:
+            if not self._tasks_done_deferred_callbacks:
+                return False
+            callback = self._tasks_done_deferred_callbacks.pop()
+            callback()
+            return True
+
+    def get(self, worker_id):
+        """Pop the next (task_id, task); starts a new epoch lazily when the
+        todo list drains (reference :272-297)."""
+        with self._lock:
+            if (
+                not self._todo
+                and not self.stop_training
+                and self._epoch < self._num_epochs - 1
+            ):
+                self._epoch += 1
+                self.create_tasks(TaskType.TRAINING)
+                logger.info("Starting epoch %d", self._epoch)
+
+            if not self._todo:
+                return -1, None
+
+            self._task_id += 1
+            task = self._todo.pop()
+            self._doing[self._task_id] = (worker_id, task, time.time())
+            return self._task_id, task
+
+    def report(self, task_id, success, exec_counters=None):
+        """Mark a doing task finished or failed; failed tasks re-queue unless
+        they exceeded MAX_TASK_RETRIES (reference :299-348).
+
+        Returns (elapsed_time, task, worker_id)."""
+        evaluation_task_completed = False
+        with self._lock:
+            worker_id, task, start_time = self._doing.pop(
+                task_id, (-1, None, -1)
+            )
+            if task and exec_counters:
+                self._job_counters[task.type].failed_records += (
+                    exec_counters.get(TaskExecCounterKey.FAIL_COUNT, 0)
+                )
+            if not task:
+                logger.warning("Unknown task_id: %d", task_id)
+            elif not success:
+                logger.warning("Task %d of %s failed", task_id, task.type)
+                if not self.check_exceed_max_task_retries(task):
+                    if task.type in (
+                        TaskType.TRAINING,
+                        TaskType.TRAIN_END_CALLBACK,
+                    ):
+                        self._todo.append(task)
+                    else:
+                        self._eval_todo.append(task)
+            elif (
+                task.type == TaskType.EVALUATION
+                and self._evaluation_service is not None
+            ):
+                evaluation_task_completed = True
+            else:
+                self._call_on_task_end(task)
+                logger.info(
+                    "Task:%d completed, %d remaining tasks",
+                    task_id,
+                    len(self._todo) + len(self._doing),
+                )
+            if evaluation_task_completed:
+                self._evaluation_service.complete_task()
+
+            if success:
+                self._task_retry_count.pop(task, None)
+                if self.stop_training:
+                    self._todo = []
+
+        return (time.time() - start_time), task, worker_id
+
+    def check_exceed_max_task_retries(self, task):
+        self._task_retry_count.setdefault(task, 1)
+        self._task_retry_count[task] += 1
+        if self._task_retry_count[task] > MAX_TASK_RETRIES:
+            logger.error(
+                "A %s task failed with %d retries", task.type,
+                MAX_TASK_RETRIES,
+            )
+            return True
+        return False
+
+    def finished(self):
+        return not self._todo and not self._eval_todo and not self._doing
+
+    def recover_tasks(self, worker_id):
+        """Re-queue all doing tasks of a dead worker (reference :365-377)."""
+        with self._lock:
+            ids = [
+                tid
+                for tid, (wid, _, _) in self._doing.items()
+                if wid == worker_id
+            ]
+        for tid in ids:
+            self.report(tid, False)
+
+    def set_evaluation_service(self, evaluation_service):
+        with self._lock:
+            self._evaluation_service = evaluation_service
+            if self._evaluation_shards and not self._training_shards:
+                evaluation_service.init_eval_only_job(len(self._eval_todo))
+
+    def _call_on_task_end(self, task):
+        if self._callbacks_list:
+            for callback in self._callbacks_list.callbacks:
+                if hasattr(callback, "on_task_end"):
+                    callback.on_task_end(task)
+
+    # introspection helpers for the servicer / watchdog
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def doing_tasks(self):
+        with self._lock:
+            return dict(self._doing)

@@ -1,0 +1,85 @@
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import hash_utils
+from elasticdl_tpu.common.model_utils import get_dict_from_params_str
+from elasticdl_tpu.common.tensor_utils import (
+    deduplicate_indexed_slices,
+    deserialize_ndarray,
+    deserialize_ndarray_dict,
+    merge_indexed_slices,
+    serialize_ndarray,
+    serialize_ndarray_dict,
+)
+
+
+def test_string_to_id_stable_and_bounded():
+    ids = [hash_utils.string_to_id("dense/kernel:0", 4) for _ in range(3)]
+    assert len(set(ids)) == 1
+    assert 0 <= ids[0] < 4
+    assert hash_utils.string_to_id("a", 1) == 0
+
+
+def test_int_to_id():
+    assert hash_utils.int_to_id(10, 3) == 1
+    assert hash_utils.int_to_id(2, 3) == 2
+
+
+def test_scatter_ids():
+    ids = np.array([0, 3, 4, 7, 9, 1])
+    bucket_ids, bucket_pos = hash_utils.scatter_ids(ids, 3)
+    assert [list(b) for b in bucket_ids] == [[0, 3, 9], [4, 7, 1], []]
+    # positions map back
+    for b in range(3):
+        np.testing.assert_array_equal(ids[bucket_pos[b]], bucket_ids[b])
+
+
+def test_tensor_roundtrip():
+    arr = np.random.rand(3, 4, 5).astype(np.float32)
+    name, out, off = deserialize_ndarray(serialize_ndarray(arr, "w"))
+    assert name == "w"
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_tensor_dict_roundtrip():
+    d = {
+        "a": np.arange(6, dtype=np.int64).reshape(2, 3),
+        "b": np.array(1.5, dtype=np.float64),
+    }
+    out = deserialize_ndarray_dict(serialize_ndarray_dict(d))
+    assert set(out) == {"a", "b"}
+    np.testing.assert_array_equal(out["a"], d["a"])
+    np.testing.assert_array_equal(out["b"], d["b"])
+
+
+def test_bfloat16_roundtrip():
+    import ml_dtypes
+
+    arr = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    _, out, _ = deserialize_ndarray(serialize_ndarray(arr))
+    assert out.dtype == arr.dtype
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_deduplicate_indexed_slices():
+    values = np.array([[1.0, 2.0], [3.0, 4.0], [10.0, 20.0]])
+    indices = np.array([5, 2, 5])
+    summed, ids = deduplicate_indexed_slices(values, indices)
+    np.testing.assert_array_equal(ids, [2, 5])
+    np.testing.assert_allclose(summed, [[3.0, 4.0], [11.0, 22.0]])
+
+
+def test_merge_indexed_slices():
+    v, i = merge_indexed_slices(
+        (np.ones((2, 3)), np.array([0, 1])),
+        (np.full((1, 3), 2.0), np.array([1])),
+    )
+    assert v.shape == (3, 3)
+    np.testing.assert_array_equal(i, [0, 1, 1])
+
+
+def test_params_str_parsing():
+    d = get_dict_from_params_str("lr=0.1; hidden=[10, 20]; name='x'; flag=True")
+    assert d == {"lr": 0.1, "hidden": [10, 20], "name": "x", "flag": True}
+    assert get_dict_from_params_str("") == {}
+    assert get_dict_from_params_str(None) == {}

@@ -1,0 +1,72 @@
+import numpy as np
+
+from elasticdl_tpu.data.dataset import Dataset, pad_batch
+
+
+def test_map_batch():
+    ds = Dataset.from_list(range(10)).map(lambda x: x * 2).batch(4)
+    batches = list(ds)
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[0], [0, 2, 4, 6])
+    np.testing.assert_array_equal(batches[2], [16, 18])
+
+
+def test_batch_drop_remainder():
+    ds = Dataset.from_list(range(10)).batch(4, drop_remainder=True)
+    assert len(list(ds)) == 2
+
+
+def test_batch_dicts():
+    items = [{"x": np.ones(3) * i, "y": np.array([i])} for i in range(4)]
+    (b,) = list(Dataset.from_list(items).batch(4))
+    assert b["x"].shape == (4, 3)
+    assert b["y"].shape == (4, 1)
+
+
+def test_batch_tuples():
+    items = [({"x": np.float32(i)}, np.int32(i)) for i in range(6)]
+    batches = list(Dataset.from_list(items).batch(3))
+    feats, labels = batches[0]
+    assert feats["x"].shape == (3,)
+    assert labels.shape == (3,)
+
+
+def test_shuffle_is_permutation():
+    out = list(Dataset.from_list(range(100)).shuffle(16, seed=0))
+    assert sorted(out) == list(range(100))
+    assert out != list(range(100))
+
+
+def test_prefetch_preserves_order_and_errors():
+    ds = Dataset.from_list(range(50)).prefetch(4)
+    assert list(ds) == list(range(50))
+
+    def bad_gen():
+        yield 1
+        raise ValueError("boom")
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        list(Dataset.from_generator(bad_gen).prefetch(2))
+
+
+def test_repeat_take():
+    assert list(Dataset.from_list([1, 2]).repeat(3)) == [1, 2] * 3
+    assert list(Dataset.from_list(range(10)).take(3)) == [0, 1, 2]
+
+
+def test_pad_batch_dict():
+    batch = {"x": np.arange(6).reshape(3, 2), "y": np.arange(3)}
+    padded, n = pad_batch(batch, 5)
+    assert n == 3
+    assert padded["x"].shape == (5, 2)
+    np.testing.assert_array_equal(padded["x"][3], padded["x"][2])
+
+
+def test_pad_batch_tuple():
+    batch = ({"x": np.zeros((2, 4))}, np.zeros(2))
+    (feats, labels), n = pad_batch(batch, 8)
+    assert n == 2
+    assert feats["x"].shape == (8, 4)
+    assert labels.shape == (8,)

@@ -1,0 +1,56 @@
+import os
+
+import numpy as np
+
+from elasticdl_tpu.data import recordio_gen
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.data.reader.csv_reader import CSVDataReader
+from elasticdl_tpu.data.reader.data_reader_factory import create_data_reader
+from elasticdl_tpu.data.reader.recordio_reader import RecordIODataReader
+from elasticdl_tpu.master.task_dispatcher import Task, TaskType
+
+
+def _task(shard, start, end):
+    return Task(shard, start, end, TaskType.TRAINING)
+
+
+def test_recordio_reader_shards_and_records(tmp_path):
+    data_dir = str(tmp_path / "mnist")
+    recordio_gen.gen_mnist_like(data_dir, num_files=3, records_per_file=17)
+    reader = RecordIODataReader(data_dir=data_dir)
+    shards = reader.create_shards()
+    assert len(shards) == 3
+    assert all(v == (0, 17) for v in shards.values())
+    shard = next(iter(shards))
+    records = list(reader.read_records(_task(shard, 5, 12)))
+    assert len(records) == 7
+    ex = decode_example(records[0])
+    assert ex["image"].shape == (28, 28)
+    assert ex["label"].dtype == np.int32
+
+
+def test_csv_reader(tmp_path):
+    path = tmp_path / "d.csv"
+    path.write_text("a,b,c\n" + "\n".join("%d,%d,%d" % (i, i, i) for i in range(20)) + "\n")
+    reader = CSVDataReader(data_dir=str(tmp_path))
+    shards = reader.create_shards()
+    assert shards[str(path)] == (0, 20)
+    rows = list(reader.read_records(_task(str(path), 3, 6)))
+    assert rows == [["3", "3", "3"], ["4", "4", "4"], ["5", "5", "5"]]
+    assert reader.metadata.column_names == ["a", "b", "c"]
+
+
+def test_factory_sniffs(tmp_path):
+    csv_dir = tmp_path / "csvs"
+    csv_dir.mkdir()
+    (csv_dir / "x.csv").write_text("a\n1\n")
+    assert isinstance(create_data_reader(str(csv_dir)), CSVDataReader)
+
+    rec_dir = str(tmp_path / "recs")
+    recordio_gen.gen_mnist_like(rec_dir, num_files=1, records_per_file=2)
+    assert isinstance(create_data_reader(rec_dir), RecordIODataReader)
+
+    assert isinstance(
+        create_data_reader(str(csv_dir), reader_type="RecordIO"),
+        RecordIODataReader,
+    )

@@ -1,0 +1,56 @@
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data import record_format
+from elasticdl_tpu.data.example_codec import decode_example, encode_example
+
+
+def test_write_and_scan_all(tmp_path):
+    path = str(tmp_path / "a.trec")
+    payloads = [b"rec-%d" % i for i in range(100)]
+    record_format.write_records(path, payloads)
+    assert record_format.get_record_count(path) == 100
+    got = list(record_format.Scanner(path))
+    assert got == payloads
+
+
+def test_scan_range(tmp_path):
+    path = str(tmp_path / "a.trec")
+    record_format.write_records(path, [b"%d" % i for i in range(50)])
+    got = list(record_format.Scanner(path, start=10, count=5))
+    assert got == [b"10", b"11", b"12", b"13", b"14"]
+    # range past EOF clamps
+    got = list(record_format.Scanner(path, start=48, count=10))
+    assert got == [b"48", b"49"]
+
+
+def test_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "a.trec")
+    record_format.write_records(path, [b"x" * 100])
+    data = bytearray(open(path, "rb").read())
+    data[20] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(IOError):
+        list(record_format.Scanner(path))
+
+
+def test_empty_file(tmp_path):
+    path = str(tmp_path / "e.trec")
+    record_format.write_records(path, [])
+    assert record_format.get_record_count(path) == 0
+    assert list(record_format.Scanner(path)) == []
+
+
+def test_example_codec_roundtrip(tmp_path):
+    ex = {
+        "image": np.random.rand(28, 28).astype(np.float32),
+        "label": np.array([3], dtype=np.int32),
+        "ids": np.arange(7, dtype=np.int64),
+    }
+    out = decode_example(encode_example(ex))
+    assert set(out) == set(ex)
+    for k in ex:
+        np.testing.assert_array_equal(out[k], ex[k])
+        assert out[k].dtype == ex[k].dtype
