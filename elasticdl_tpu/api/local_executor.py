@@ -1,0 +1,166 @@
+"""LocalExecutor: single-process train/eval/predict over a model-zoo spec.
+
+Parity with the reference's elasticdl/python/elasticdl/local_executor.py
+(debug path without master/PS pods) — but TPU-native: it drives the same
+in-process TaskDispatcher the master uses (tasks stay the unit of work, so
+local and distributed runs share semantics) and the same jit-compiled Trainer
+(so "local" already means "all local TPU chips via the mesh").
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.data.dataset import Dataset, pad_batch
+from elasticdl_tpu.data.reader.data_reader_factory import create_data_reader
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher, TaskType
+from elasticdl_tpu.training.metrics import MetricsAggregator
+from elasticdl_tpu.training.trainer import Trainer
+
+
+class LocalExecutor(object):
+    def __init__(
+        self,
+        model_spec,
+        training_data=None,
+        validation_data=None,
+        prediction_data=None,
+        minibatch_size=32,
+        num_epochs=1,
+        records_per_task=256,
+        evaluation_steps=0,
+        mesh=None,
+        model_params="",
+        data_reader_params=None,
+        seed=0,
+        max_steps=None,
+    ):
+        self.spec = model_spec
+        self.minibatch_size = minibatch_size
+        self.num_epochs = num_epochs
+        self.records_per_task = records_per_task
+        self.evaluation_steps = evaluation_steps
+        self.max_steps = max_steps
+        self._reader_params = data_reader_params or {}
+        self.training_data = training_data
+        self.validation_data = validation_data
+        self.prediction_data = prediction_data
+        self.trainer = Trainer(
+            model_spec, mesh=mesh, model_params=model_params, seed=seed
+        )
+        self.state = None
+        self.losses = []
+
+    def _reader(self, data_origin):
+        return create_data_reader(
+            data_origin, self.records_per_task, **dict(self._reader_params)
+        )
+
+    def _make_dispatcher(self):
+        def shards_of(data):
+            return self._reader(data).create_shards() if data else {}
+
+        return TaskDispatcher(
+            shards_of(self.training_data),
+            shards_of(self.validation_data),
+            shards_of(self.prediction_data),
+            self.records_per_task,
+            self.num_epochs,
+        )
+
+    def _task_dataset(self, reader, task, mode):
+        ds = Dataset.from_generator(lambda: reader.read_records(task))
+        ds = self.spec.dataset_fn(ds, mode, reader.metadata)
+        return ds.batch(self.minibatch_size)
+
+    def _ensure_state(self, batch):
+        if self.state is None:
+            padded, _ = pad_batch(batch, self.minibatch_size)
+            self.state = self.trainer.init_state(padded)
+
+    def run(self):
+        if self.training_data:
+            return self.train()
+        if self.validation_data:
+            return self.evaluate()
+        if self.prediction_data:
+            return self.predict()
+        raise ValueError("No data configured")
+
+    def train(self):
+        dispatcher = self._make_dispatcher()
+        reader = self._reader(self.training_data)
+        eval_reader = (
+            self._reader(self.validation_data)
+            if self.validation_data
+            else None
+        )
+        stop = False
+        while not stop:
+            task_id, task = dispatcher.get("local")
+            if task is None:
+                break
+            for batch in self._task_dataset(reader, task, Mode.TRAINING):
+                padded, n = pad_batch(batch, self.minibatch_size)
+                self._ensure_state(padded)
+                self.state, loss = self.trainer.train_step(
+                    self.state, padded, n
+                )
+                self.losses.append(float(loss))
+                step = int(self.state.step)
+                if (
+                    self.evaluation_steps
+                    and eval_reader
+                    and step % self.evaluation_steps == 0
+                ):
+                    metrics = self._evaluate_with_reader(eval_reader)
+                    logger.info("Eval at step %d: %s", step, metrics)
+                if self.max_steps and step >= self.max_steps:
+                    dispatcher.stop_training = True
+                    stop = True
+                    break
+            dispatcher.report(task_id, True)
+        final_metrics = (
+            self._evaluate_with_reader(eval_reader) if eval_reader else {}
+        )
+        if final_metrics:
+            logger.info("Final eval: %s", final_metrics)
+        return self.state, final_metrics
+
+    def _evaluate_with_reader(self, reader):
+        agg = MetricsAggregator(self.spec.eval_metrics_fn())
+        for shard_name, (start, n) in reader.create_shards().items():
+            from elasticdl_tpu.master.task_dispatcher import Task
+
+            task = Task(shard_name, start, start + n, TaskType.EVALUATION)
+            for batch in self._task_dataset(reader, task, Mode.EVALUATION):
+                padded, n_true = pad_batch(batch, self.minibatch_size)
+                self._ensure_state(padded)
+                outputs, labels = self.trainer.evaluate_batch(
+                    self.state, padded, n_true
+                )
+                agg.update(labels, outputs)
+        return agg.result()
+
+    def evaluate(self):
+        reader = self._reader(self.validation_data)
+        return self._evaluate_with_reader(reader)
+
+    def predict(self):
+        reader = self._reader(self.prediction_data)
+        outputs = []
+        for shard_name, (start, n) in reader.create_shards().items():
+            from elasticdl_tpu.master.task_dispatcher import Task
+
+            task = Task(shard_name, start, start + n, TaskType.PREDICTION)
+            for batch in self._task_dataset(reader, task, Mode.PREDICTION):
+                padded, n_true = pad_batch(batch, self.minibatch_size)
+                self._ensure_state(padded)
+                preds, _ = self.trainer.evaluate_batch(
+                    self.state, padded, n_true
+                )
+                outputs.append(preds)
+        result = np.concatenate(outputs, axis=0) if outputs else np.array([])
+        if self.spec.prediction_outputs_processor is not None:
+            self.spec.prediction_outputs_processor(result)
+        return result

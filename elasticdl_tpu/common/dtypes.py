@@ -25,7 +25,8 @@ _WIRE = [
     # bfloat16 — the TPU-native default compute dtype. numpy has no builtin
     # bfloat16; ml_dtypes (a JAX dependency) provides it.
     (13, None),  # placeholder, filled below
-    (14, np.dtype(object)),  # python bytes / str records
+    # wire id 14 is reserved for variable-length bytes; object arrays are
+    # rejected (np.frombuffer cannot reconstruct them)
 ]
 
 try:  # ml_dtypes ships with jax

@@ -121,26 +121,45 @@ class Dataset(object):
         def gen():
             q = queue.Queue(maxsize=max(1, buffer_size))
             _SENTINEL = object()
+            stop = threading.Event()
             err = []
 
             def producer():
                 try:
                     for x in src():
-                        q.put(x)
+                        # bounded put that notices consumer abandonment, so
+                        # a dropped iterator can't leak a blocked thread and
+                        # its open file handles
+                        while not stop.is_set():
+                            try:
+                                q.put(x, timeout=0.1)
+                                break
+                            except queue.Full:
+                                continue
+                        if stop.is_set():
+                            return
                 except BaseException as e:  # propagate into consumer
                     err.append(e)
                 finally:
-                    q.put(_SENTINEL)
+                    while not stop.is_set():
+                        try:
+                            q.put(_SENTINEL, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
 
             t = threading.Thread(target=producer, daemon=True)
             t.start()
-            while True:
-                x = q.get()
-                if x is _SENTINEL:
-                    if err:
-                        raise err[0]
-                    return
-                yield x
+            try:
+                while True:
+                    x = q.get()
+                    if x is _SENTINEL:
+                        if err:
+                            raise err[0]
+                        return
+                    yield x
+            finally:
+                stop.set()
 
         return Dataset(gen)
 

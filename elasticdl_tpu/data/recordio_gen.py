@@ -13,101 +13,67 @@ from elasticdl_tpu.data.example_codec import encode_example
 from elasticdl_tpu.data.record_format import RecordWriter
 
 
-def gen_mnist_like(data_dir, num_files=2, records_per_file=128, seed=0):
-    """28x28 float images in [0,1) + int32 labels in [0,10)."""
+def _generate(data_dir, prefix, make_example, num_files, records_per_file,
+              seed):
     rng = np.random.RandomState(seed)
     os.makedirs(data_dir, exist_ok=True)
     paths = []
     for i in range(num_files):
-        path = os.path.join(data_dir, "mnist-%04d.trec" % i)
+        path = os.path.join(data_dir, "%s-%04d.trec" % (prefix, i))
         with RecordWriter(path) as w:
             for _ in range(records_per_file):
-                w.write(
-                    encode_example(
-                        {
-                            "image": rng.rand(28, 28).astype(np.float32),
-                            "label": np.array(
-                                [rng.randint(10)], dtype=np.int32
-                            ),
-                        }
-                    )
-                )
+                w.write(encode_example(make_example(rng)))
         paths.append(path)
     return paths
+
+
+def gen_mnist_like(data_dir, num_files=2, records_per_file=128, seed=0):
+    """28x28 float images in [0,1) + int32 labels in [0,10)."""
+    def example(rng):
+        return {
+            "image": rng.rand(28, 28).astype(np.float32),
+            "label": np.array([rng.randint(10)], dtype=np.int32),
+        }
+
+    return _generate(data_dir, "mnist", example, num_files,
+                     records_per_file, seed)
 
 
 def gen_cifar10_like(data_dir, num_files=2, records_per_file=128, seed=0):
-    rng = np.random.RandomState(seed)
-    os.makedirs(data_dir, exist_ok=True)
-    paths = []
-    for i in range(num_files):
-        path = os.path.join(data_dir, "cifar10-%04d.trec" % i)
-        with RecordWriter(path) as w:
-            for _ in range(records_per_file):
-                w.write(
-                    encode_example(
-                        {
-                            "image": rng.rand(32, 32, 3).astype(np.float32),
-                            "label": np.array(
-                                [rng.randint(10)], dtype=np.int32
-                            ),
-                        }
-                    )
-                )
-        paths.append(path)
-    return paths
+    def example(rng):
+        return {
+            "image": rng.rand(32, 32, 3).astype(np.float32),
+            "label": np.array([rng.randint(10)], dtype=np.int32),
+        }
+
+    return _generate(data_dir, "cifar10", example, num_files,
+                     records_per_file, seed)
 
 
-def gen_frappe_like(
-    data_dir, num_files=2, records_per_file=128, feature_dim=10,
-    input_dim=5383, seed=0
-):
+def gen_frappe_like(data_dir, num_files=2, records_per_file=128,
+                    feature_dim=10, input_dim=5383, seed=0):
     """Sparse-id recommendation records (frappe schema: fixed-length id list +
     binary label), used by the DeepFM configs."""
-    rng = np.random.RandomState(seed)
-    os.makedirs(data_dir, exist_ok=True)
-    paths = []
-    for i in range(num_files):
-        path = os.path.join(data_dir, "frappe-%04d.trec" % i)
-        with RecordWriter(path) as w:
-            for _ in range(records_per_file):
-                w.write(
-                    encode_example(
-                        {
-                            "feature": rng.randint(
-                                input_dim, size=feature_dim
-                            ).astype(np.int64),
-                            "label": np.array(
-                                [rng.randint(2)], dtype=np.int32
-                            ),
-                        }
-                    )
-                )
-        paths.append(path)
-    return paths
+    def example(rng):
+        return {
+            "feature": rng.randint(input_dim, size=feature_dim).astype(
+                np.int64
+            ),
+            "label": np.array([rng.randint(2)], dtype=np.int32),
+        }
+
+    return _generate(data_dir, "frappe", example, num_files,
+                     records_per_file, seed)
 
 
 def gen_census_like(data_dir, num_files=2, records_per_file=128, seed=0):
     """Tabular wide&deep records: a few dense floats + categorical ids."""
-    rng = np.random.RandomState(seed)
-    os.makedirs(data_dir, exist_ok=True)
-    paths = []
-    for i in range(num_files):
-        path = os.path.join(data_dir, "census-%04d.trec" % i)
-        with RecordWriter(path) as w:
-            for _ in range(records_per_file):
-                w.write(
-                    encode_example(
-                        {
-                            "dense": rng.rand(5).astype(np.float32),
-                            "category": rng.randint(
-                                1000, size=8
-                            ).astype(np.int64),
-                            "label": np.array(
-                                [rng.randint(2)], dtype=np.int32
-                            ),
-                        }
-                    )
-                )
-        paths.append(path)
-    return paths
+    def example(rng):
+        return {
+            "dense": rng.rand(5).astype(np.float32),
+            "category": rng.randint(1000, size=8).astype(np.int64),
+            "label": np.array([rng.randint(2)], dtype=np.int32),
+        }
+
+    return _generate(data_dir, "census", example, num_files,
+                     records_per_file, seed)

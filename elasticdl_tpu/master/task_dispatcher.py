@@ -231,13 +231,14 @@ class TaskDispatcher(object):
             elif not success:
                 logger.warning("Task %d of %s failed", task_id, task.type)
                 if not self.check_exceed_max_task_retries(task):
-                    if task.type in (
-                        TaskType.TRAINING,
-                        TaskType.TRAIN_END_CALLBACK,
-                    ):
-                        self._todo.append(task)
-                    else:
+                    # Deviation from the reference (:320-327): it re-queues
+                    # failed PREDICTION tasks into the eval queue, which
+                    # prediction jobs never drain — a job hang. Here every
+                    # non-eval task returns to the main todo queue.
+                    if task.type == TaskType.EVALUATION:
                         self._eval_todo.append(task)
+                    else:
+                        self._todo.append(task)
             elif (
                 task.type == TaskType.EVALUATION
                 and self._evaluation_service is not None

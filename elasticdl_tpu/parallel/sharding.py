@@ -1,0 +1,84 @@
+"""Parameter/optimizer-state sharding rules.
+
+The reference places whole dense variables on PS pods by name hash
+(hash_utils.string_to_id — SURVEY.md §2.5). On TPU, dense parameters are
+either replicated (pure DP) or sharded over the ``fsdp`` axis (ZeRO-style),
+and the optimizer state follows the parameter sharding — XLA then inserts the
+all-gathers/reduce-scatters that the reference did with explicit pull/push
+RPCs.
+
+Two mechanisms compose:
+1. explicit logical annotations via ``flax.linen.with_partitioning`` in model
+   code (used by the TP/SP model families), surfaced here through
+   ``nn.get_partition_spec``;
+2. an automatic rule for unannotated params: shard the largest axis that
+   divides by the fsdp size, else replicate.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.common.constants import MeshAxis
+
+
+def _auto_pspec(shape, fsdp_size, min_size_to_shard=2**14):
+    """Shard the largest divisible axis over fsdp; tiny params replicate."""
+    if fsdp_size <= 1 or not shape:
+        return P()
+    if int(np.prod(shape)) < min_size_to_shard:
+        return P()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % fsdp_size == 0:
+            spec = [None] * len(shape)
+            spec[i] = MeshAxis.FSDP
+            return P(*spec)
+    return P()
+
+
+def infer_params_pspec(params, mesh, annotations=None):
+    """Return a pytree of PartitionSpecs matching `params`.
+
+    `annotations` (optional) is a matching pytree of PartitionSpecs from
+    nn.get_partition_spec; entries that are non-trivial win over the
+    automatic rule.
+    """
+    fsdp = mesh.shape[MeshAxis.FSDP]
+
+    def rule(leaf, ann=None):
+        if ann is not None and tuple(ann) != ():
+            return ann
+        return _auto_pspec(np.shape(leaf), fsdp)
+
+    if annotations is None:
+        return jax.tree.map(rule, params)
+    return jax.tree.map(rule, params, annotations)
+
+
+def params_sharding(params, mesh, annotations=None):
+    pspecs = infer_params_pspec(params, mesh, annotations)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+
+
+def infer_state_pspec(state_shapes, mesh):
+    """PartitionSpecs for a whole TrainState from its eval_shape pytree.
+
+    Applies the automatic fsdp rule uniformly: optimizer moments (mu/nu)
+    share their param's shape, so they land on the same spec — the
+    co-sharding the reference gets by keeping slot tables next to embedding
+    shards on the same PS pod (ps/parameters.py create_slot_params).
+    """
+    fsdp = mesh.shape[MeshAxis.FSDP]
+    return jax.tree.map(
+        lambda leaf: _auto_pspec(tuple(getattr(leaf, "shape", ())), fsdp),
+        state_shapes,
+    )
+
+
+def pspec_to_sharding(pspecs, mesh):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,105 @@
+"""Evaluation metric aggregation.
+
+The reference ships raw model outputs+labels from workers to the master,
+which updates Keras metric objects in ≤300-row chunks
+(common/evaluation_utils.py, master/evaluation_service.py:55-62). Here the
+same dataflow exists (workers report outputs+labels; the eval service owns
+aggregation), with two metric kinds:
+
+* per-sample callables ``fn(labels, predictions) -> array`` (the zoo
+  convention, e.g. accuracy) — aggregated as a running weighted mean;
+* stateful metric objects with ``update(labels, predictions)`` / ``result()``
+  (for metrics needing global state, e.g. AUC).
+"""
+
+import numpy as np
+
+
+class StreamingMetric(object):
+    """Base for stateful metrics (subclass with update/result)."""
+
+    def update(self, labels, predictions):
+        raise NotImplementedError
+
+    def result(self):
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+
+class AUC(StreamingMetric):
+    """Binary AUC via a fixed-bin score histogram (XLA/EVAL-friendly,
+    memory-bounded like the reference's chunked Keras AUC updates)."""
+
+    def __init__(self, num_thresholds=200):
+        self._bins = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self._bins, np.int64)
+        self._neg = np.zeros(self._bins, np.int64)
+
+    def update(self, labels, predictions):
+        labels = np.asarray(labels).reshape(-1)
+        scores = np.asarray(predictions).reshape(-1)
+        # squash logits into [0, 1) bin space
+        probs = 1.0 / (1.0 + np.exp(-scores.astype(np.float64)))
+        idx = np.clip((probs * self._bins).astype(int), 0, self._bins - 1)
+        np.add.at(self._pos, idx[labels > 0], 1)
+        np.add.at(self._neg, idx[labels <= 0], 1)
+
+    def result(self):
+        # trapezoid over ROC from histogram tails
+        pos_c = np.cumsum(self._pos[::-1])
+        neg_c = np.cumsum(self._neg[::-1])
+        tp = pos_c / max(1, pos_c[-1])
+        fp = neg_c / max(1, neg_c[-1])
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz
+        return float(trapezoid(tp, fp))
+
+
+class MetricsAggregator(object):
+    def __init__(self, metrics_dict):
+        self._metrics = metrics_dict
+        self._sums = {k: 0.0 for k in metrics_dict}
+        self._counts = {k: 0 for k in metrics_dict}
+
+    def update(self, labels, predictions, chunk_size=4096):
+        """Feed one batch of raw (labels, outputs). Chunked so huge eval
+        reports stay memory-bounded."""
+        n = _leading(labels if labels is not None else predictions)
+        for lo in range(0, n, chunk_size):
+            hi = min(n, lo + chunk_size)
+            lab = _slice(labels, lo, hi)
+            pred = _slice(predictions, lo, hi)
+            for name, fn in self._metrics.items():
+                if isinstance(fn, StreamingMetric):
+                    fn.update(lab, pred)
+                else:
+                    vals = np.asarray(fn(lab, pred), np.float64).reshape(-1)
+                    self._sums[name] += float(vals.sum())
+                    self._counts[name] += vals.size
+
+    def result(self):
+        out = {}
+        for name, fn in self._metrics.items():
+            if isinstance(fn, StreamingMetric):
+                out[name] = fn.result()
+            else:
+                out[name] = self._sums[name] / max(1, self._counts[name])
+        return out
+
+
+def _leading(x):
+    if isinstance(x, dict):
+        return next(iter(x.values())).shape[0]
+    return np.asarray(x).shape[0]
+
+
+def _slice(x, lo, hi):
+    if x is None:
+        return None
+    if isinstance(x, dict):
+        return {k: np.asarray(v)[lo:hi] for k, v in x.items()}
+    return np.asarray(x)[lo:hi]

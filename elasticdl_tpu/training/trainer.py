@@ -1,0 +1,267 @@
+"""The jit-compiled compute plane: train / evaluate / predict steps.
+
+This replaces the reference worker's TF2-eager gradient path
+(worker/worker.py:730-870: forward, tape.gradient, report_gradient to PS) and
+the entire PS apply path (ps/servicer.py push_gradients →
+OptimizerWrapper.apply_gradients; Go server.go → optimizer.go → Eigen
+kernels). On TPU all of that is ONE compiled XLA program per step:
+
+    forward + backward + optax update, sharded over the mesh —
+    gradient reduction is not an RPC but the psum XLA inserts because the
+    batch is sharded over (dp, fsdp) while params are replicated/sharded.
+
+Design notes (TPU-first):
+* static shapes everywhere — partial batches are padded host-side
+  (data/dataset.pad_batch) and masked via each example's weight column;
+* state is donated (`donate_argnums`) so params/opt-state update in place
+  in HBM;
+* models come from the zoo convention (flax.linen Module whose __call__
+  takes a feature dict and `training` flag);
+* loss signature parity with the reference zoo: loss(labels, predictions),
+  with an optional 3rd `sample_weights` arg picked up by introspection.
+"""
+
+import inspect
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from flax.core import FrozenDict
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.parallel.sharding import (
+    infer_state_pspec,
+    pspec_to_sharding,
+)
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: any = struct.field(pytree_node=True)
+    opt_state: any = struct.field(pytree_node=True)
+    model_state: any = struct.field(pytree_node=True)  # batch_stats etc.
+    rng: jax.Array = struct.field(pytree_node=True)
+
+    @property
+    def version(self):
+        """Model version = step count (the reference's PS `version` that
+        workers/eval sync on is the number of applied updates)."""
+        return int(self.step)
+
+
+def _split_label(batch):
+    """Zoo datasets yield (features_dict, labels) for train/eval and bare
+    features for prediction (reference dataset_fn convention)."""
+    if isinstance(batch, tuple) and len(batch) == 2:
+        return batch[0], batch[1]
+    return batch, None
+
+
+class Trainer(object):
+    """Owns the model/optimizer from a ModelSpec and the compiled steps.
+
+    One Trainer per process; the same object backs the LocalExecutor
+    (reference elasticdl/local_executor.py) and the distributed Worker
+    (reference worker/worker.py).
+    """
+
+    def __init__(self, model_spec, mesh=None, model_params="", seed=0,
+                 compute_dtype=None):
+        self.spec = model_spec
+        self.model = model_spec.create_model(model_params)
+        self.tx = model_spec.optimizer()
+        self.mesh = mesh if mesh is not None else mesh_lib.local_mesh()
+        self.seed = seed
+        self.compute_dtype = compute_dtype
+        self._loss_takes_weights = (
+            len(inspect.signature(model_spec.loss).parameters) >= 3
+        )
+        if not self._loss_takes_weights:
+            logger.warning(
+                "loss() takes no sample_weights arg: padded rows of partial "
+                "final batches will enter the loss unmasked (add a 3rd "
+                "`sample_weights` parameter for exact partial-batch math)"
+            )
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._state_sharding = None
+
+    # ---------------------------------------------------------------- init
+
+    def init_state(self, example_batch):
+        """Initialize params/opt-state sharded over the mesh.
+
+        The reference initializes variables lazily on the worker's first
+        minibatch and pushes them to the PS (worker.py:664-701
+        `_run_model_call_before_training`); here the same "first batch
+        defines the variables" contract seeds a sharded jit init.
+        """
+        features, _ = _split_label(example_batch)
+        features = jax.tree.map(jnp.asarray, features)
+        root_rng = jax.random.PRNGKey(self.seed)
+        init_rng, state_rng = jax.random.split(root_rng)
+
+        def init_fn(rng, feats):
+            variables = self.model.init(
+                {"params": rng, "dropout": rng}, feats, training=False
+            )
+            variables = dict(variables)
+            params = variables.pop("params")
+            opt_state = self.tx.init(params)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=opt_state,
+                model_state=FrozenDict(variables),
+                rng=state_rng,
+            )
+
+        state_shapes = jax.eval_shape(init_fn, init_rng, features)
+        pspecs = infer_state_pspec(state_shapes, self.mesh)
+        self._state_sharding = pspec_to_sharding(pspecs, self.mesh)
+        with self.mesh:
+            state = jax.jit(
+                init_fn, out_shardings=self._state_sharding
+            )(init_rng, features)
+        n_params = sum(
+            int(np.prod(x.shape))
+            for x in jax.tree.leaves(state.params)
+        )
+        logger.info(
+            "Initialized model: %d parameters, mesh axes %s",
+            n_params, dict(self.mesh.shape),
+        )
+        return state
+
+    # --------------------------------------------------------------- steps
+
+    def _compute_loss(self, labels, predictions, weights):
+        if self._loss_takes_weights:
+            return self.spec.loss(labels, predictions, weights)
+        return self.spec.loss(labels, predictions)
+
+    def _build_train_step(self):
+        batch_sh = mesh_lib.batch_sharding(self.mesh)
+        repl = mesh_lib.replicated(self.mesh)
+
+        def train_step(state, features, labels, weights):
+            dropout_rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_fn(params):
+                variables = {"params": params, **state.model_state}
+                mutable = [k for k in state.model_state if k != "params"]
+                if mutable:
+                    preds, new_model_state = self.model.apply(
+                        variables,
+                        features,
+                        training=True,
+                        mutable=mutable,
+                        rngs={"dropout": dropout_rng},
+                    )
+                else:
+                    preds = self.model.apply(
+                        variables,
+                        features,
+                        training=True,
+                        rngs={"dropout": dropout_rng},
+                    )
+                    new_model_state = state.model_state
+                return (
+                    self._compute_loss(labels, preds, weights),
+                    new_model_state,
+                )
+
+            (loss_val, new_model_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            updates, new_opt_state = self.tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = jax.tree.map(
+                lambda p, u: (p + u).astype(p.dtype),
+                state.params,
+                updates,
+            )
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                model_state=FrozenDict(new_model_state),
+            )
+            return new_state, loss_val
+
+        return jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(self._state_sharding, batch_sh, batch_sh, batch_sh),
+            out_shardings=(self._state_sharding, repl),
+        )
+
+    def _build_eval_step(self):
+        batch_sh = mesh_lib.batch_sharding(self.mesh)
+        repl = mesh_lib.replicated(self.mesh)
+
+        def eval_step(state, features):
+            variables = {"params": state.params, **state.model_state}
+            preds = self.model.apply(variables, features, training=False)
+            return preds
+
+        return jax.jit(
+            eval_step,
+            in_shardings=(self._state_sharding, batch_sh),
+            out_shardings=repl,
+        )
+
+    # ---------------------------------------------------------------- API
+
+    def train_step(self, state, batch, true_count=None):
+        """One optimizer update. `batch` = (features, labels) numpy dicts
+        already padded to the static batch size; `true_count` masks padding.
+        Returns (new_state, float loss)."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        features, labels = _split_label(batch)
+        bsz = _leading_dim(features)
+        weights = _make_weights(bsz, true_count)
+        with self.mesh:
+            state, loss_val = self._train_step(
+                state, features, labels, weights
+            )
+        return state, loss_val
+
+    def forward(self, state, features):
+        """Inference forward pass (evaluation / prediction)."""
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        with self.mesh:
+            return self._eval_step(state, features)
+
+    def evaluate_batch(self, state, batch, true_count=None):
+        """Returns (outputs, labels) trimmed to true_count, for master-side
+        metric aggregation (reference worker.py report_evaluation_metrics)."""
+        features, labels = _split_label(batch)
+        preds = np.asarray(self.forward(state, features))
+        labels = np.asarray(labels) if labels is not None else None
+        if true_count is not None:
+            preds = preds[:true_count]
+            labels = labels[:true_count] if labels is not None else None
+        return preds, labels
+
+
+def _leading_dim(features):
+    if isinstance(features, dict):
+        return next(iter(features.values())).shape[0]
+    return features.shape[0]
+
+
+def _make_weights(batch_size, true_count):
+    if true_count is None or true_count >= batch_size:
+        return np.ones((batch_size,), np.float32)
+    w = np.zeros((batch_size,), np.float32)
+    w[:true_count] = 1.0
+    return w

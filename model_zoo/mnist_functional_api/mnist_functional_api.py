@@ -1,0 +1,73 @@
+"""MNIST CNN — flax port of the reference zoo module
+(model_zoo/mnist_functional_api/mnist_functional_api.py:21-103): same
+architecture (Conv32-Conv64-BN-MaxPool-Dropout-Dense10), same spec surface
+(custom_model/loss/optimizer/dataset_fn/eval_metrics_fn), TPU-idiomatic
+implementation (flax.linen + optax, records parsed from TRec examples)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+
+
+class MnistModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = features["image"]
+        x = x.reshape(x.shape[0], 28, 28, 1)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.BatchNorm(use_running_average=not training, momentum=0.99)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not training)(x)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(10)(x)
+
+
+def custom_model():
+    return MnistModel()
+
+
+def loss(labels, predictions, sample_weights=None):
+    labels = labels.reshape(-1)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels
+    )
+    if sample_weights is None:
+        return jnp.mean(ce)
+    return jnp.sum(ce * sample_weights) / jnp.maximum(
+        jnp.sum(sample_weights), 1.0
+    )
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {"image": ex["image"].astype(np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex["label"].astype(np.int32)[0]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=1) == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    return {"image": (28, 28)}

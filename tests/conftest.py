@@ -1,13 +1,24 @@
-"""Test env: force an 8-device virtual CPU platform BEFORE jax initializes,
-so multi-chip sharding tests run anywhere (the driver's dryrun does the same).
+"""Test env: force an 8-device virtual CPU platform BEFORE any XLA client
+initializes, so multi-chip sharding tests run anywhere (the driver's
+multichip dryrun uses the same mechanism).
+
+Note: the ambient TPU plugin may override JAX_PLATFORMS at `import jax`
+time, so we must also set the config knob after import — env vars alone are
+not enough in this environment.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+_platform = os.environ.get("EDL_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
