@@ -1,0 +1,177 @@
+"""Master orchestrator: builds the dispatcher, gRPC service, evaluation
+service, and (when instance management is configured) the worker fleet;
+runs the wait loop with the straggler watchdog.
+
+Parity with the reference's master/master.py:95-558, minus what the PS
+deletion removes (PS pod management, PS command lines). Instance management
+is pluggable (master/instance_manager.py): a local-process backend for
+single-host elastic tests and a gated Kubernetes backend for clusters.
+"""
+
+import threading
+import time
+from concurrent import futures
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher, TaskType
+from elasticdl_tpu.proto.service import (
+    add_master_servicer_to_server,
+    build_server,
+)
+
+
+class Master(object):
+    def __init__(
+        self,
+        model_spec,
+        training_data=None,
+        validation_data=None,
+        prediction_data=None,
+        minibatch_size=32,
+        records_per_task=256,
+        num_epochs=1,
+        evaluation_steps=0,
+        eval_start_delay_secs=0,
+        eval_throttle_secs=0,
+        port=0,
+        create_data_reader_fn=None,
+        instance_manager=None,
+        task_timeout_check_interval=30,
+        callbacks_list=None,
+        export_saved_model=False,
+    ):
+        from elasticdl_tpu.data.reader.data_reader_factory import (
+            create_data_reader,
+        )
+
+        self.spec = model_spec
+        self.minibatch_size = minibatch_size
+        create_fn = create_data_reader_fn or create_data_reader
+
+        def shards_of(data):
+            if not data:
+                return {}
+            return create_fn(data, records_per_task).create_shards()
+
+        self.task_d = TaskDispatcher(
+            shards_of(training_data),
+            shards_of(validation_data),
+            shards_of(prediction_data),
+            records_per_task,
+            num_epochs,
+            callbacks_list=callbacks_list,
+        )
+        if export_saved_model and training_data:
+            self.task_d.add_deferred_callback_create_train_end_task()
+
+        eval_only = bool(validation_data) and not training_data
+        self.evaluation_service = None
+        if validation_data:
+            self.evaluation_service = EvaluationService(
+                None,  # metrics writer wired by caller (tensorboard svc)
+                self.task_d,
+                eval_start_delay_secs,
+                eval_throttle_secs,
+                evaluation_steps,
+                eval_only,
+                model_spec.eval_metrics_fn,
+            )
+            self.task_d.set_evaluation_service(self.evaluation_service)
+
+        self.servicer = MasterServicer(
+            minibatch_size,
+            self.task_d,
+            evaluation_service=self.evaluation_service,
+            instance_manager=instance_manager,
+        )
+        self.instance_manager = instance_manager
+        self._port = port
+        self._server = None
+        self.port = None
+        self._task_timeout_check_interval = task_timeout_check_interval
+        self._watchdog_stopper = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def prepare(self):
+        """Start gRPC service + eval trigger + workers (reference
+        Master.prepare, master.py:202-233)."""
+        server = build_server(futures.ThreadPoolExecutor(max_workers=64))
+        add_master_servicer_to_server(self.servicer, server)
+        self.port = server.add_insecure_port("[::]:%d" % self._port)
+        server.start()
+        self._server = server
+        logger.info("Master gRPC server started on port %d", self.port)
+        if self.evaluation_service:
+            self.evaluation_service.start()
+        if self.instance_manager:
+            self.instance_manager.start_workers()
+        self._start_watchdog()
+
+    def run(self, poll_interval=1.0):
+        """Block until all tasks finish (reference Master.run,
+        master.py:235-260)."""
+        try:
+            while not self.task_d.finished():
+                if (
+                    self.instance_manager
+                    and self.instance_manager.all_workers_failed()
+                ):
+                    raise RuntimeError("All workers failed")
+                time.sleep(poll_interval)
+            # serve the deferred train-end callback task if any
+            while True:
+                if self.task_d.finished():
+                    if not self.task_d.invoke_deferred_callback():
+                        break
+                time.sleep(poll_interval)
+        finally:
+            self.stop()
+        return 0
+
+    def stop(self):
+        self._watchdog_stopper.set()
+        if self.evaluation_service:
+            self.evaluation_service.stop()
+        if self.instance_manager:
+            self.instance_manager.stop()
+        if self._server:
+            self._server.stop(grace=1.0)
+            self._server = None
+
+    # ------------------------------------------------------------ watchdog
+
+    def _start_watchdog(self):
+        t = threading.Thread(
+            target=self._check_timeout_tasks_loop, daemon=True
+        )
+        t.start()
+
+    def _check_timeout_tasks_loop(self):
+        """Straggler watchdog: a task running > 3x the average completion
+        time gets recovered and its worker removed (reference
+        master.py:536-558)."""
+        while not self._watchdog_stopper.wait(
+            self._task_timeout_check_interval
+        ):
+            self.check_timeout_tasks()
+
+    def check_timeout_tasks(self):
+        avg_time = self.servicer.get_average_task_complete_time()
+        now = time.time()
+        for task_id, (worker_id, task, start_time) in (
+            self.task_d.doing_tasks().items()
+        ):
+            if task.type not in (TaskType.TRAINING, TaskType.EVALUATION):
+                continue
+            if now - start_time > 3 * avg_time.get(task.type, 300.0):
+                logger.info(
+                    "Task %d timed out on worker %s; recovering",
+                    task_id, worker_id,
+                )
+                self.task_d.recover_tasks(worker_id)
+                if self.instance_manager:
+                    self.instance_manager.remove_worker(worker_id)

@@ -1,0 +1,143 @@
+"""Master gRPC servicer (reference: master/servicer.py:24-137).
+
+Implements the Master service over the hand-rolled binding
+(proto/service.py). The WAIT protocol is preserved: when the todo queue is
+empty but may refill (doing tasks could fail and re-queue, or a deferred
+train-end callback is pending), workers are told to wait instead of exiting.
+"""
+
+import statistics
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.master.task_dispatcher import TaskType
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.proto.convert import TASK_TYPE_TO_PB as _TASK_TYPE_TO_PB
+
+
+class MasterServicer(object):
+    def __init__(self, minibatch_size, task_d, evaluation_service=None,
+                 instance_manager=None):
+        self._task_d = task_d
+        self._lock = threading.Lock()
+        self._minibatch_size = minibatch_size
+        self._version = 0
+        self._evaluation_service = evaluation_service
+        self._instance_manager = instance_manager
+        self._task_complete_times = {
+            TaskType.TRAINING: [],
+            TaskType.EVALUATION: [],
+        }
+        self._worker_liveness_time = {}
+        self._workers = {}
+        self._cluster_version = 0
+        if evaluation_service:
+            evaluation_service.set_master_servicer(self)
+
+    def get_model_version(self):
+        return self._version
+
+    # ------------------------------------------------------------- RPCs
+
+    def get_task(self, request, _context=None):
+        res = pb.Task(type=pb.NONE)
+        res.model_version = self._version
+        res.minibatch_size = self._minibatch_size
+        if request.task_type == pb.EVALUATION:
+            task_id, task = self._task_d.get_eval_task(request.worker_id)
+        else:
+            task_id, task = self._task_d.get(request.worker_id)
+
+        if task:
+            res.task_id = task_id
+            res.shard_name = task.shard_name
+            res.start = task.start
+            res.end = task.end
+            res.type = _TASK_TYPE_TO_PB[task.type]
+            for k, v in task.extended_config.items():
+                res.extended_config[k] = str(v)
+            if task.type == TaskType.EVALUATION:
+                # eval tasks pin the model version they evaluate
+                res.model_version = task.model_version
+        elif (not self._task_d.finished()) or (
+            self._task_d.invoke_deferred_callback()
+        ):
+            res.type = pb.WAIT
+        with self._lock:
+            self._worker_liveness_time[request.worker_id] = time.time()
+        return res
+
+    def report_task_result(self, request, _context=None):
+        if request.err_message:
+            logger.warning(
+                "Worker reported error: %s", request.err_message
+            )
+            self._task_d.report(
+                request.task_id, False,
+                exec_counters=dict(request.exec_counters),
+            )
+        else:
+            complete_time, task, worker_id = self._task_d.report(
+                request.task_id, True,
+                exec_counters=dict(request.exec_counters),
+            )
+            if task:
+                with self._lock:
+                    self._worker_liveness_time[worker_id] = time.time()
+                    if task.type in self._task_complete_times:
+                        self._task_complete_times[task.type].append(
+                            complete_time
+                        )
+        return pb.Empty()
+
+    def report_evaluation_metrics(self, request, _context=None):
+        with self._lock:
+            self._worker_liveness_time[request.worker_id] = time.time()
+        if self._evaluation_service:
+            self._evaluation_service.report_evaluation_metrics(
+                request.model_outputs, request.labels
+            )
+        return pb.Empty()
+
+    def report_version(self, request, _context=None):
+        self._version = max(self._version, request.model_version)
+        if self._evaluation_service:
+            self._evaluation_service.add_evaluation_task_if_needed(
+                model_version=request.model_version
+            )
+        return pb.Empty()
+
+    def register_worker(self, request, _context=None):
+        with self._lock:
+            self._workers[request.worker_id] = {
+                "address": request.address,
+                "num_devices": request.num_devices,
+                "registered_at": time.time(),
+            }
+            self._cluster_version += 1
+            self._worker_liveness_time[request.worker_id] = time.time()
+        logger.info(
+            "Worker %d registered from %s (%d devices)",
+            request.worker_id, request.address, request.num_devices,
+        )
+        return pb.RegisterWorkerResponse(
+            cluster_version=self._cluster_version
+        )
+
+    # --------------------------------------------------- watchdog helpers
+
+    def get_average_task_complete_time(self):
+        """Per-type average, defaulting to 300 s until 20 samples exist
+        (fixes the reference's servicer.py:119-127, which compared the dict
+        length — always 2 — against 20 and so never left the default)."""
+        out = {}
+        for task_type, times in self._task_complete_times.items():
+            if len(times) < 20:
+                out[task_type] = 300.0
+            else:
+                out[task_type] = statistics.mean(times[-200:])
+        return out
+
+    def get_worker_liveness_time(self, worker_id):
+        return self._worker_liveness_time.get(worker_id)

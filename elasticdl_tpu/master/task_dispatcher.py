@@ -150,6 +150,7 @@ class TaskDispatcher(object):
             self._todo.extend(tasks)
         logger.info("%d tasks created with total of %d records.",
                     len(tasks), counter.total_records)
+        return len(tasks)
 
     def get_eval_task(self, worker_id):
         with self._lock:

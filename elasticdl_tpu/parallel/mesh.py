@@ -103,16 +103,8 @@ def batch_sharding(mesh):
     return NamedSharding(mesh, P((MeshAxis.DP, MeshAxis.FSDP)))
 
 
-def batch_pspec():
-    return P((MeshAxis.DP, MeshAxis.FSDP))
-
-
 def replicated(mesh):
     return NamedSharding(mesh, P())
-
-
-def data_parallel_size(mesh):
-    return mesh.shape[MeshAxis.DP] * mesh.shape[MeshAxis.FSDP]
 
 
 def local_mesh():

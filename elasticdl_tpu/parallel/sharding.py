@@ -37,30 +37,6 @@ def _auto_pspec(shape, fsdp_size, min_size_to_shard=2**14):
     return P()
 
 
-def infer_params_pspec(params, mesh, annotations=None):
-    """Return a pytree of PartitionSpecs matching `params`.
-
-    `annotations` (optional) is a matching pytree of PartitionSpecs from
-    nn.get_partition_spec; entries that are non-trivial win over the
-    automatic rule.
-    """
-    fsdp = mesh.shape[MeshAxis.FSDP]
-
-    def rule(leaf, ann=None):
-        if ann is not None and tuple(ann) != ():
-            return ann
-        return _auto_pspec(np.shape(leaf), fsdp)
-
-    if annotations is None:
-        return jax.tree.map(rule, params)
-    return jax.tree.map(rule, params, annotations)
-
-
-def params_sharding(params, mesh, annotations=None):
-    pspecs = infer_params_pspec(params, mesh, annotations)
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
-
-
 def infer_state_pspec(state_shapes, mesh):
     """PartitionSpecs for a whole TrainState from its eval_shape pytree.
 

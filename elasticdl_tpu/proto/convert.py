@@ -1,0 +1,21 @@
+"""Conversions between wire enums and the framework's TaskType strings."""
+
+from elasticdl_tpu.master.task_dispatcher import TaskType
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+TASK_TYPE_TO_PB = {
+    TaskType.TRAINING: pb.TRAINING,
+    TaskType.EVALUATION: pb.EVALUATION,
+    TaskType.PREDICTION: pb.PREDICTION,
+    TaskType.WAIT: pb.WAIT,
+    TaskType.TRAIN_END_CALLBACK: pb.TRAIN_END_CALLBACK,
+}
+PB_TO_TASK_TYPE = {v: k for k, v in TASK_TYPE_TO_PB.items()}
+
+
+def task_type_to_pb(task_type):
+    return TASK_TYPE_TO_PB[task_type]
+
+
+def task_type_from_pb(pb_type):
+    return PB_TO_TASK_TYPE.get(pb_type)

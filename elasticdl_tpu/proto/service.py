@@ -1,0 +1,85 @@
+"""Hand-rolled gRPC service binding for the Master service.
+
+The environment ships `protoc` without the grpc python plugin, so instead of
+generated `*_pb2_grpc.py` stubs this module declares the method table once
+and derives both the server-side generic handler and the client stub from it.
+Functionally equivalent to the reference's generated elasticdl_pb2_grpc
+(MasterServicer / MasterStub).
+"""
+
+import grpc
+
+from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+SERVICE_NAME = "elasticdl_tpu.Master"
+
+# method name -> (request class, response class)
+_METHODS = {
+    "get_task": (pb.GetTaskRequest, pb.Task),
+    "report_task_result": (pb.ReportTaskResultRequest, pb.Empty),
+    "report_evaluation_metrics": (
+        pb.ReportEvaluationMetricsRequest,
+        pb.Empty,
+    ),
+    "report_version": (pb.ReportVersionRequest, pb.Empty),
+    "register_worker": (
+        pb.RegisterWorkerRequest,
+        pb.RegisterWorkerResponse,
+    ),
+}
+
+
+def add_master_servicer_to_server(servicer, server):
+    handlers = {}
+    for name, (req_cls, resp_cls) in _METHODS.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class MasterStub(object):
+    def __init__(self, channel):
+        for name, (req_cls, resp_cls) in _METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    "/%s/%s" % (SERVICE_NAME, name),
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+def build_channel(addr):
+    """Insecure channel with the control-plane message caps (reference:
+    common/grpc_utils.py:19-30)."""
+    return grpc.insecure_channel(
+        addr,
+        options=[
+            ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+            (
+                "grpc.max_receive_message_length",
+                GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
+            ),
+        ],
+    )
+
+
+def build_server(thread_pool):
+    return grpc.server(
+        thread_pool,
+        options=[
+            ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+            (
+                "grpc.max_receive_message_length",
+                GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
+            ),
+        ],
+    )

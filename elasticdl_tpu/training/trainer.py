@@ -243,13 +243,20 @@ class Trainer(object):
 
     def evaluate_batch(self, state, batch, true_count=None):
         """Returns (outputs, labels) trimmed to true_count, for master-side
-        metric aggregation (reference worker.py report_evaluation_metrics)."""
+        metric aggregation (reference worker.py report_evaluation_metrics).
+        Outputs may be a dict for multi-output models."""
         features, labels = _split_label(batch)
-        preds = np.asarray(self.forward(state, features))
-        labels = np.asarray(labels) if labels is not None else None
-        if true_count is not None:
-            preds = preds[:true_count]
-            labels = labels[:true_count] if labels is not None else None
+        preds = self.forward(state, features)
+
+        def trim(x):
+            x = np.asarray(x)
+            return x[:true_count] if true_count is not None else x
+
+        if isinstance(preds, dict):
+            preds = {k: trim(v) for k, v in preds.items()}
+        else:
+            preds = trim(preds)
+        labels = trim(labels) if labels is not None else None
         return preds, labels
 
 

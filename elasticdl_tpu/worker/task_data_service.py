@@ -1,0 +1,137 @@
+"""Task data service: bridges the master task queue to the worker's input
+pipeline.
+
+Behavioral parity with the reference's worker/task_data_service.py:26-237:
+* a record generator that pulls tasks from the master forever, queues each
+  pending task, and streams its records (batches may span task boundaries),
+* ``report_record_done(count)`` pops pending tasks once enough records were
+  consumed and reports them to the master (with failed-record counters),
+* WAIT handling: when the master says WAIT the current dataset ends and
+  ``get_dataset`` yields a fresh one after a backoff, so the worker loop can
+  interleave evaluation tasks while training tasks are scarce,
+* TRAIN_END_CALLBACK tasks are intercepted and parked for the worker.
+
+TF-free: produces the framework's Dataset (data/dataset.py) over raw records.
+"""
+
+import threading
+import time
+from collections import deque
+
+from elasticdl_tpu.common.constants import TaskExecCounterKey
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.data.dataset import Dataset
+from elasticdl_tpu.data.reader.data_reader_factory import create_data_reader
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+class TaskDataService(object):
+    def __init__(
+        self,
+        worker,
+        data_origin=None,
+        data_reader_params=None,
+        custom_data_reader=None,
+        records_per_task=None,
+        wait_sleep_secs=2.0,
+    ):
+        self._worker = worker
+        self._lock = threading.Lock()
+        self._pending_dataset = True
+        self._pending_train_end_callback_task = None
+        self._wait_sleep_secs = wait_sleep_secs
+        create_fn = custom_data_reader or create_data_reader
+        self.data_reader = create_fn(
+            data_origin, records_per_task, **(data_reader_params or {})
+        )
+        self._failed_record_count = 0
+        self._reported_record_count = 0
+        self._current_task = None
+        self._pending_tasks = deque()
+
+    def _reset(self):
+        self._reported_record_count = 0
+        self._failed_record_count = 0
+        self._pending_tasks = deque()
+        self._current_task = None
+
+    def get_current_task(self):
+        return self._current_task
+
+    def _do_report_task(self, task, err_msg=""):
+        exec_counters = None
+        if self._failed_record_count:
+            exec_counters = {
+                TaskExecCounterKey.FAIL_COUNT: self._failed_record_count
+            }
+        self._worker.report_task_result(
+            task.task_id, err_msg, exec_counters=exec_counters
+        )
+
+    def report_record_done(self, count, err_msg=""):
+        """Account `count` consumed records against the pending task queue;
+        report and pop every task fully covered (reference :94-129)."""
+        self._reported_record_count += count
+        if err_msg:
+            self._failed_record_count += count
+        if not self._pending_tasks:
+            return False
+        task = self._pending_tasks[0]
+        if self._reported_record_count >= task.end - task.start:
+            with self._lock:
+                while self._pending_tasks and (
+                    self._reported_record_count
+                    >= self._pending_tasks[0].end
+                    - self._pending_tasks[0].start
+                ):
+                    task = self._pending_tasks[0]
+                    self._reported_record_count -= task.end - task.start
+                    self._pending_tasks.popleft()
+                    self._do_report_task(task, err_msg)
+                    self._failed_record_count = 0
+                if self._pending_tasks:
+                    self._current_task = self._pending_tasks[0]
+            return True
+        return False
+
+    def get_train_end_callback_task(self):
+        return self._pending_train_end_callback_task
+
+    def clear_train_end_callback_task(self):
+        self._pending_train_end_callback_task = None
+
+    def get_dataset(self):
+        """A fresh Dataset streaming records of dispatched tasks, or None
+        when the job has no more training work (reference :163-203)."""
+        if not self._pending_dataset:
+            return None
+        if self._pending_tasks:
+            logger.error(
+                "Cannot get a new dataset with pending tasks"
+            )
+            return None
+        self._reset()
+        self._pending_dataset = False
+        return Dataset.from_generator(self._gen)
+
+    def _gen(self):
+        while True:
+            task = self._worker.get_task()
+            if not task.shard_name:
+                if task.type == pb.WAIT:
+                    self._pending_dataset = True
+                    logger.info("No tasks for now, maybe more later")
+                    time.sleep(self._wait_sleep_secs)
+                else:
+                    logger.info("No more tasks, stopping")
+                break
+            with self._lock:
+                if task.type == pb.TRAIN_END_CALLBACK:
+                    self._pending_train_end_callback_task = task
+                    continue
+                self._pending_tasks.append(task)
+                if len(self._pending_tasks) == 1:
+                    self._current_task = task
+            for record in self.data_reader.read_records(task):
+                if record is not None:
+                    yield record
