@@ -95,7 +95,8 @@ class EvaluationService(object):
     ):
         self._metrics_writer = metrics_writer
         self._task_d = task_d
-        self._lock = threading.Lock()
+        # reentrant: complete_task -> try_to_create_new_job both lock
+        self._lock = threading.RLock()
         self._eval_job = None
         self.trigger = _EvaluationTrigger(
             self, start_delay_secs, throttle_secs
@@ -171,30 +172,29 @@ class EvaluationService(object):
             )
 
     def report_evaluation_metrics(self, model_outputs, labels):
-        if self._eval_job is None:
-            return False
         with self._lock:
+            if self._eval_job is None:
+                return False
             return self._eval_job.report_evaluation_metrics(
                 model_outputs, labels
             )
 
     def complete_task(self):
-        if self._eval_job is None:
-            return None
-        self._eval_job.complete_task()
-        if self._eval_job.finished():
+        with self._lock:
+            if self._eval_job is None:
+                return None
+            self._eval_job.complete_task()
+            if not self._eval_job.finished():
+                return None
             metrics = self._eval_job.get_evaluation_summary()
             version = self._eval_job.model_version
             self.completed_job_metrics.append((version, metrics))
-            if self._metrics_writer and metrics:
-                self._metrics_writer.write_dict_to_summary(
-                    metrics, version=version
-                )
-            logger.info(
-                "Evaluation metrics[v=%d]: %s", version, metrics
-            )
             if not self._eval_only:
                 self._eval_job = None
-                self.try_to_create_new_job()
-            return metrics
-        return None
+        if self._metrics_writer and metrics:
+            self._metrics_writer.write_dict_to_summary(
+                metrics, version=version
+            )
+        logger.info("Evaluation metrics[v=%d]: %s", version, metrics)
+        self.try_to_create_new_job()
+        return metrics

@@ -4,8 +4,10 @@ runs the wait loop with the straggler watchdog.
 
 Parity with the reference's master/master.py:95-558, minus what the PS
 deletion removes (PS pod management, PS command lines). Instance management
-is pluggable (master/instance_manager.py): a local-process backend for
-single-host elastic tests and a gated Kubernetes backend for clusters.
+is pluggable via the duck-typed `instance_manager` argument
+(start_workers / all_workers_failed / remove_worker / stop); backend
+implementations (local-process and gated Kubernetes) live in
+master/instance_manager.py once the elasticity milestone lands.
 """
 
 import threading

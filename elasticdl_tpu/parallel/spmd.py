@@ -1,0 +1,220 @@
+"""SPMD multi-host execution: lockstep elastic training over a global mesh.
+
+This is the TPU-native answer to the reference's PS data plane. In the
+reference, workers progress independently and exchange gradients with PS pods
+over gRPC (async or grads_to_wait sync — ps/servicer.py:120-227). On TPU,
+every host participates in ONE jit-compiled step over a global
+``jax.sharding.Mesh``; gradient aggregation is the psum XLA inserts for the
+batch-sharded loss. That imposes lockstep: all hosts must invoke the same
+compiled computation the same number of times.
+
+Lockstep + elastic task dispatch are reconciled here:
+
+* each host pulls record-range tasks from the master independently (dynamic
+  sharding preserved — the worker count can change between jobs, and task
+  re-queue covers host loss),
+* every round, hosts that have a local batch contribute it; hosts that are
+  starved contribute a ZERO-WEIGHT batch (the global weighted-mean loss
+  ignores them exactly — sum(ce*w)/sum(w) reductions are global),
+* the loop ends only when ALL hosts are done, agreed via a host-level
+  allgather of done-flags (jax.experimental.multihost_utils), so no host
+  abandons a collective.
+
+Single-process (1 host, N local devices) degenerates to device_put with the
+batch sharding — same code path the tests exercise on the 8-device CPU mesh.
+"""
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.parallel import mesh as mesh_lib
+
+
+def initialize_distributed(coordinator_addr=None, num_processes=None,
+                           process_id=None, platform=None):
+    """jax.distributed bootstrap (multi-host). On CPU test rigs, selects the
+    gloo collectives implementation. No-op when single-process args given."""
+    if num_processes is None or num_processes <= 1:
+        return
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_addr,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed initialized: process %d/%d, %d global devices",
+        process_id, num_processes, len(jax.devices()),
+    )
+
+
+class SPMDContext(object):
+    """Global-batch assembly + host-level agreement primitives."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._batch_sharding = mesh_lib.batch_sharding(mesh)
+        self.num_processes = jax.process_count()
+        self.process_index = jax.process_index()
+        self._row_cache = {}
+
+    @property
+    def is_multiprocess(self):
+        return self.num_processes > 1
+
+    def local_rows(self, global_batch_size):
+        """Cached local_row_positions for the batch sharding."""
+        rows = self._row_cache.get(global_batch_size)
+        if rows is None:
+            rows = local_row_positions(
+                self._batch_sharding, global_batch_size
+            )
+            self._row_cache[global_batch_size] = rows
+        return rows
+
+    def assemble(self, local_pytree):
+        """Host-local numpy (leading dim = per-host batch) -> global sharded
+        jax.Arrays (leading dim = per-host batch * num_processes)."""
+        if not self.is_multiprocess:
+            return jax.device_put(local_pytree, self._batch_sharding)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                self._batch_sharding, np.asarray(x)
+            ),
+            local_pytree,
+        )
+
+    def all_done(self, local_done):
+        """True iff every host reports done (host-level consensus)."""
+        if not self.is_multiprocess:
+            return bool(local_done)
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.array([1 if local_done else 0], np.int32)
+        )
+        return bool(np.asarray(flags).sum() == self.num_processes)
+
+    def broadcast_scalar(self, value, root=0):
+        if not self.is_multiprocess:
+            return value
+        from jax.experimental import multihost_utils
+
+        arr = multihost_utils.broadcast_one_to_all(
+            np.asarray(value), is_source=jax.process_index() == root
+        )
+        return np.asarray(arr)
+
+
+def local_row_positions(batch_sharding, global_batch_size):
+    """Global row indices owned by this host's devices, in the order
+    make_array_from_process_local_data consumed the host-local rows.
+
+    Used to slice a replicated global output back down to the rows this
+    host contributed (robust against device-mesh reordering on real ICI
+    topologies, where host rows need not be one contiguous block)."""
+    index_map = batch_sharding.devices_indices_map((global_batch_size,))
+    blocks = []
+    for dev in batch_sharding.addressable_devices:
+        sl = index_map[dev][0]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else global_batch_size
+        blocks.append(np.arange(start, stop))
+    blocks.sort(key=lambda a: a[0] if a.size else 0)
+    return np.concatenate(blocks) if blocks else np.arange(0)
+
+
+# Round modes, in priority order (lower wins the consensus):
+MODE_EVAL = 0     # at least one host holds an evaluation batch
+MODE_TRAIN = 1    # at least one host holds a training batch
+MODE_IDLE = 2     # nobody has data now, but the master said WAIT
+MODE_STOP = 3     # every host got "no more tasks"
+
+
+class ElasticSPMDLoop(object):
+    """The lockstep state machine reconciling SPMD collectives with elastic
+    task dispatch.
+
+    Every round, each host polls its local sources and proposes a mode;
+    the global mode is the MINIMUM over hosts (allgathered), i.e. highest
+    priority wins: EVAL > TRAIN > IDLE > STOP. Then EVERY host executes that
+    round's compiled program — with a zero-weight padding batch if it has no
+    real data — so no host ever abandons a collective. Eval-before-train
+    priority mirrors the reference worker, which gives evaluation a chance
+    before every training minibatch (worker.py:1041-1047).
+
+    poll_eval()  -> eval item or None
+    poll_train() -> ("item", batch) | ("wait",) | ("done",)
+    train_step(item_or_None), eval_step(item_or_None): must submit the same
+    compiled computation regardless of padding.
+    """
+
+    def __init__(self, ctx, poll_train=None, poll_eval=None,
+                 train_step=None, eval_step=None, idle_sleep_secs=0.2):
+        self.ctx = ctx
+        self.poll_train = poll_train
+        self.poll_eval = poll_eval
+        self.train_step = train_step
+        self.eval_step = eval_step
+        self.idle_sleep_secs = idle_sleep_secs
+
+    def _gather_mode(self, local_mode):
+        if not self.ctx.is_multiprocess:
+            return local_mode
+        from jax.experimental import multihost_utils
+
+        modes = multihost_utils.process_allgather(
+            np.array([local_mode], np.int32)
+        )
+        return int(np.asarray(modes).min())
+
+    def run(self):
+        import time
+
+        pending_train = None
+        pending_eval = None
+        train_done = self.poll_train is None
+        rounds = {MODE_TRAIN: 0, MODE_EVAL: 0}
+        while True:
+            if pending_eval is None and self.poll_eval is not None:
+                pending_eval = self.poll_eval()
+            if (
+                pending_train is None
+                and not train_done
+            ):
+                kind = self.poll_train()
+                if kind[0] == "item":
+                    pending_train = kind[1]
+                elif kind[0] == "done":
+                    train_done = True
+                # "wait": leave pending_train None this round
+
+            if pending_eval is not None:
+                local_mode = MODE_EVAL
+            elif pending_train is not None:
+                local_mode = MODE_TRAIN
+            elif not train_done:
+                local_mode = MODE_IDLE
+            else:
+                local_mode = MODE_STOP
+
+            mode = self._gather_mode(local_mode)
+            if mode == MODE_STOP:
+                break
+            if mode == MODE_IDLE:
+                time.sleep(self.idle_sleep_secs)
+                continue
+            if mode == MODE_EVAL:
+                item, pending_eval = pending_eval, None
+                if item is not None:
+                    rounds[MODE_EVAL] += 1
+                self.eval_step(item)
+            else:
+                item, pending_train = pending_train, None
+                if item is not None:
+                    rounds[MODE_TRAIN] += 1
+                self.train_step(item)
+        return rounds

@@ -223,23 +223,29 @@ class Trainer(object):
         """One optimizer update. `batch` = (features, labels) numpy dicts
         already padded to the static batch size; `true_count` masks padding.
         Returns (new_state, float loss)."""
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
         features, labels = _split_label(batch)
         bsz = _leading_dim(features)
         weights = _make_weights(bsz, true_count)
+        return self.train_step_assembled(state, features, labels, weights)
+
+    def train_step_assembled(self, state, features, labels, weights):
+        """Run the compiled step on already-prepared (possibly global
+        multi-host) arrays — the SPMD path (parallel/spmd.py)."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
         with self.mesh:
-            state, loss_val = self._train_step(
-                state, features, labels, weights
-            )
-        return state, loss_val
+            return self._train_step(state, features, labels, weights)
 
     def forward(self, state, features):
-        """Inference forward pass (evaluation / prediction)."""
+        """Inference forward pass (evaluation / prediction). Output is
+        replicated to every host."""
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         with self.mesh:
             return self._eval_step(state, features)
+
+    def make_weights(self, batch_size, true_count):
+        return _make_weights(batch_size, true_count)
 
     def evaluate_batch(self, state, batch, true_count=None):
         """Returns (outputs, labels) trimmed to true_count, for master-side

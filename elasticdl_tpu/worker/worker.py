@@ -74,6 +74,7 @@ class Worker(object):
         seed=0,
         callbacks=None,
         wait_sleep_secs=0.5,
+        spmd=False,
     ):
         """Connect either over gRPC (master_addr) or in-process
         (master_servicer — the test harness path, mirroring the reference's
@@ -107,6 +108,16 @@ class Worker(object):
         self._minibatch_retry_count = 0
         self._ever_connected = master_servicer is not None
         self.losses = []
+        self.spmd = spmd
+        self._spmd_ctx = None
+        self._template_batch = None
+        self._train_iter = None
+        self._eval_iter = None
+        self._eval_task_pb = None
+        if spmd:
+            from elasticdl_tpu.parallel.spmd import SPMDContext
+
+            self._spmd_ctx = SPMDContext(self.trainer.mesh)
 
     # ----------------------------------------------------------- RPC layer
 
@@ -265,15 +276,10 @@ class Worker(object):
         return executed
 
     def _process_eval_task(self, task_pb):
-        task = self._task_from_pb(task_pb)
-        reader = self._task_data_service.data_reader
-        from elasticdl_tpu.data.dataset import Dataset
-
-        ds = Dataset.from_generator(lambda: reader.read_records(task))
-        ds = self.spec.dataset_fn(ds, Mode.EVALUATION, reader.metadata)
+        ds = self._task_dataset(self._task_from_pb(task_pb), Mode.EVALUATION)
         err = ""
         try:
-            for batch in ds.batch(self.minibatch_size):
+            for batch in ds:
                 padded, n = pad_batch(batch, self.minibatch_size)
                 self._ensure_state(padded)
                 outputs, labels = self.trainer.evaluate_batch(
@@ -298,15 +304,12 @@ class Worker(object):
                     time.sleep(self._task_data_service._wait_sleep_secs)
                     continue
                 break
-            task = self._task_from_pb(task_pb)
-            reader = self._task_data_service.data_reader
-            from elasticdl_tpu.data.dataset import Dataset
-
-            ds = Dataset.from_generator(lambda: reader.read_records(task))
-            ds = self.spec.dataset_fn(ds, Mode.PREDICTION, reader.metadata)
+            ds = self._task_dataset(
+                self._task_from_pb(task_pb), Mode.PREDICTION
+            )
             err = ""
             try:
-                for batch in ds.batch(self.minibatch_size):
+                for batch in ds:
                     padded, n = pad_batch(batch, self.minibatch_size)
                     self._ensure_state(padded)
                     preds, _ = self.trainer.evaluate_batch(
@@ -342,16 +345,180 @@ class Worker(object):
         self._task_data_service.clear_train_end_callback_task()
         self.report_task_result(task_pb.task_id, err)
 
+    # ------------------------------------------------------ SPMD lockstep
+
+    def _poll_train(self):
+        """One tri-state train poll for the ElasticSPMDLoop:
+        ("item", (padded, n)) | ("wait",) | ("done",)."""
+        while True:
+            if self._train_iter is None:
+                dataset = self._task_data_service.get_dataset()
+                if dataset is None:
+                    return ("done",)
+                dataset = self.spec.dataset_fn(
+                    dataset,
+                    Mode.TRAINING,
+                    self._task_data_service.data_reader.metadata,
+                )
+                self._train_iter = iter(
+                    dataset.batch(self.minibatch_size).prefetch(1)
+                )
+            batch = next(self._train_iter, None)
+            if batch is not None:
+                return ("item", pad_batch(batch, self.minibatch_size))
+            self._train_iter = None
+            if self._task_data_service._pending_dataset:
+                return ("wait",)
+            # stream ended for good: loop once more; get_dataset -> None
+
+    def _poll_eval(self):
+        """Next eval batch, fetching new eval tasks as needed. Reports a
+        task's result when refilled past its last batch (the loop only
+        refills after the previous item's round executed)."""
+        while True:
+            if self._eval_iter is not None:
+                batch = next(self._eval_iter, None)
+                if batch is not None:
+                    return (
+                        pad_batch(batch, self.minibatch_size),
+                        self._eval_task_pb,
+                    )
+                self.report_task_result(self._eval_task_pb.task_id, "")
+                self._eval_iter = None
+                self._eval_task_pb = None
+            task_pb = self.get_task(pb.EVALUATION)
+            if not task_pb.shard_name:
+                return None
+            self._eval_iter = iter(
+                self._task_dataset(
+                    self._task_from_pb(task_pb), Mode.EVALUATION
+                )
+            )
+            self._eval_task_pb = task_pb
+
+    def _zero_weight_item(self):
+        """A template batch with weight 0 — keeps a starved host inside the
+        collective without contributing to the global weighted loss."""
+        if self._template_batch is None:
+            raise RuntimeError(
+                "host has no batch template: it never received any data, so "
+                "it cannot synthesize a padding batch for the collective"
+            )
+        return self._template_batch, 0
+
+    def _task_dataset(self, task, mode):
+        """Batched dataset over one task's records (shared by the eval /
+        predict paths)."""
+        reader = self._task_data_service.data_reader
+        from elasticdl_tpu.data.dataset import Dataset
+
+        ds = Dataset.from_generator(lambda: reader.read_records(task))
+        ds = self.spec.dataset_fn(ds, mode, reader.metadata)
+        return ds.batch(self.minibatch_size)
+
+    def _spmd_step(self, item):
+        from elasticdl_tpu.training.trainer import _split_label
+
+        if item is None:
+            item = self._zero_weight_item()
+        padded, n = item
+        features, labels = _split_label(padded)
+        weights = self.trainer.make_weights(self.minibatch_size, n)
+        gf, gl, gw = self._spmd_ctx.assemble((features, labels, weights))
+        self._ensure_state(padded)
+        self.state, loss = self.trainer.train_step_assembled(
+            self.state, gf, gl, gw
+        )
+        if n > 0:
+            self._template_batch = (features, labels)
+            self.losses.append(float(loss))
+            if self._spmd_ctx.process_index == 0:
+                self.report_version(int(self.state.step))
+            self._task_data_service.report_record_done(n, "")
+
+    def _run_spmd_job(self, with_train):
+        """Unified lockstep job loop: eval-priority mode consensus every
+        round (parallel/spmd.py ElasticSPMDLoop)."""
+        from elasticdl_tpu.parallel.spmd import ElasticSPMDLoop
+
+        with_eval = self.job_type in (
+            JobType.TRAINING_WITH_EVALUATION,
+            JobType.EVALUATION_ONLY,
+        )
+        loop = ElasticSPMDLoop(
+            self._spmd_ctx,
+            poll_train=self._poll_train if with_train else None,
+            poll_eval=self._poll_eval if with_eval else None,
+            train_step=self._spmd_step,
+            eval_step=self._spmd_eval_step,
+            idle_sleep_secs=min(0.2, self._task_data_service._wait_sleep_secs),
+        )
+        try:
+            loop.run()
+        except Exception as e:
+            # Report in-flight tasks as failed so the master requeues them
+            # promptly instead of waiting out the straggler watchdog, then
+            # re-raise: a failed step desyncs the lockstep, so the job-level
+            # answer is restart with a re-formed mesh (elastic recovery).
+            err = "spmd step failed: %s" % e
+            logger.error("%s\n%s", err, traceback.format_exc())
+            if self._eval_task_pb is not None:
+                self.report_task_result(self._eval_task_pb.task_id, err)
+                self._eval_task_pb = None
+            for task in list(
+                self._task_data_service._pending_tasks
+            ):
+                self.report_task_result(task.task_id, err)
+            raise
+        self._process_train_end_callback_task_if_needed()
+
+    def _spmd_eval_step(self, item):
+        from elasticdl_tpu.training.trainer import _split_label
+
+        if item is None:
+            padded, n = self._zero_weight_item()
+            task_pb = None
+        else:
+            (padded, n), task_pb = item
+        features, labels = _split_label(padded)
+        gf = self._spmd_ctx.assemble(features)
+        self._ensure_state(padded)
+        global_out = self.trainer.forward(self.state, gf)
+        if task_pb is None:
+            return
+        self._template_batch = (features, labels)
+        # slice the replicated global output back to this host's rows
+        global_bsz = self.minibatch_size * self._spmd_ctx.num_processes
+        rows = self._spmd_ctx.local_rows(global_bsz)
+
+        def to_local(x):
+            return np.asarray(x)[rows][:n]
+
+        if isinstance(global_out, dict):
+            outputs = {k: to_local(v) for k, v in global_out.items()}
+        else:
+            outputs = to_local(global_out)
+        self.report_evaluation_metrics(
+            outputs, np.asarray(labels)[:n], task_pb.model_version
+        )
+
+
     def run(self):
         self.register()
         if self.job_type in (
             JobType.TRAINING_ONLY,
             JobType.TRAINING_WITH_EVALUATION,
         ):
-            self._train_and_evaluate()
+            if self.spmd:
+                self._run_spmd_job(with_train=True)
+            else:
+                self._train_and_evaluate()
             return self.state
         if self.job_type == JobType.EVALUATION_ONLY:
-            self._evaluate_only()
+            if self.spmd:
+                self._run_spmd_job(with_train=False)
+            else:
+                self._evaluate_only()
             return self.state
         if self.job_type == JobType.PREDICTION_ONLY:
             return self._predict_only()

@@ -1,0 +1,54 @@
+"""Subprocess entry for multi-process SPMD tests (launched by
+test_spmd_multiprocess.py). Each process = one 'host' of the mesh, with its
+own gRPC connection to the master — the CPU-rig equivalent of a TPU pod
+slice host."""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+num_procs = int(sys.argv[2])
+master_port = sys.argv[3]
+coord_port = sys.argv[4]
+data_dir = sys.argv[5]
+local_devices = int(sys.argv[6])
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=%d" % local_devices
+)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elasticdl_tpu.parallel.spmd import initialize_distributed
+
+initialize_distributed(
+    coordinator_addr="localhost:%s" % coord_port,
+    num_processes=num_procs,
+    process_id=proc_id,
+    platform="cpu",
+)
+
+import jax
+
+from elasticdl_tpu.common.model_utils import load_model_spec_from_module
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.worker.worker import JobType, Worker
+from model_zoo.mnist_functional_api import mnist_functional_api as zoo
+
+mesh = mesh_lib.build_mesh({"dp": num_procs * local_devices})
+worker = Worker(
+    proc_id,
+    load_model_spec_from_module(zoo),
+    master_addr="localhost:%s" % master_port,
+    job_type=JobType.TRAINING_WITH_EVALUATION,
+    minibatch_size=8,
+    training_data=data_dir,
+    wait_sleep_secs=0.1,
+    mesh=mesh,
+    spmd=True,
+)
+state = worker.run()
+print(
+    "SPMD_PROC_DONE pid=%d steps=%d real_batches=%d"
+    % (proc_id, int(state.step) if state else -1, len(worker.losses)),
+    flush=True,
+)
