@@ -1,0 +1,103 @@
+"""Training callbacks.
+
+Parity with the reference's elasticdl/callbacks.py:25-154 (SavedModelExporter,
+MaxStepsStopping, LearningRateScheduler) without the Keras dependency:
+
+* ``SavedModelExporter`` runs at train end via the TRAIN_END_CALLBACK task the
+  dispatcher emits after the last training task (reference
+  task_dispatcher.py:219-254 → callbacks.py:39-67);
+* ``MaxStepsStopping`` counts completed training-task steps master-side and
+  flips the dispatcher's ``stop_training`` (reference callbacks.py:69-117,
+  on_task_end);
+* ``LearningRateScheduler`` modulates the learning rate as a function of the
+  model version (reference callbacks.py:119-154 sets
+  ``optimizer.learning_rate`` before every batch). TPU-native difference: the
+  schedule is compiled INTO the train step as an
+  ``optax.scale_by_schedule`` over ``state.step`` (== model version), so the
+  callback's fn maps version → **multiplier on the optimizer's base LR**
+  rather than overwriting an absolute LR; there is no per-batch host hook in
+  a jit loop.
+"""
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class Callback(object):
+    """Minimal callback interface. Hooks are discovered by name:
+    on_task_end(task), on_train_end(worker)."""
+
+
+class CallbackList(object):
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+
+class SavedModelExporter(Callback):
+    """Exports the trained model at train end (reference callbacks.py:39-67,
+    driven by the TRAIN_END_CALLBACK task)."""
+
+    def __init__(self, export_dir):
+        self.export_dir = export_dir
+
+    def on_train_end(self, worker):
+        from elasticdl_tpu.api.exporter import export_model
+
+        if worker.state is None:
+            logger.warning("No trained state to export")
+            return
+        path = export_model(
+            worker.trainer.model, worker.state, self.export_dir
+        )
+        logger.info("Exported trained model to %s", path)
+
+
+class MaxStepsStopping(Callback):
+    """Stops the job once `max_steps` optimizer updates have been dispatched
+    (reference callbacks.py:69-117: counts steps from completed task record
+    ranges — the master never sees individual batches)."""
+
+    def __init__(self, max_steps, minibatch_size=32):
+        self.max_steps = int(max_steps)
+        self.minibatch_size = int(minibatch_size)
+        self._completed_steps = 0
+        self._dispatcher = None
+
+    def set_task_dispatcher(self, dispatcher):
+        self._dispatcher = dispatcher
+
+    def on_task_end(self, task):
+        from elasticdl_tpu.master.task_dispatcher import TaskType
+
+        if task.type != TaskType.TRAINING:
+            return
+        records = task.end - task.start
+        self._completed_steps += (
+            records + self.minibatch_size - 1
+        ) // self.minibatch_size
+        if (
+            self._completed_steps >= self.max_steps
+            and self._dispatcher is not None
+            and not self._dispatcher.stop_training
+        ):
+            logger.info(
+                "MaxStepsStopping: %d steps completed (max %d); stopping",
+                self._completed_steps, self.max_steps,
+            )
+            self._dispatcher.stop_training = True
+
+
+class LearningRateScheduler(Callback):
+    """LR modulation by model version, compiled into the train step.
+
+    ``multiplier_fn(version) -> float`` scales the optimizer's base LR (the
+    reference's fn returned an absolute LR and overwrote
+    ``optimizer.learning_rate`` per batch — callbacks.py:119-154; under jit
+    the schedule must be a traced function of the step counter instead).
+    Consumed by Trainer at optimizer construction.
+    """
+
+    def __init__(self, multiplier_fn):
+        self.multiplier_fn = multiplier_fn

@@ -1,0 +1,100 @@
+"""Trained-model export / import.
+
+The reference exports a TF SavedModel at train end by rebuilding native Keras
+embedding layers and loading checkpoint weights (common/model_handler.py
+get_model_to_export, model_handler.py:247-289). The TPU-native artifact is a
+self-contained directory:
+
+    <dir>/params.msgpack    flax msgpack of {"params": ..., "model_state": ...}
+                            fully gathered (unsharded) — loadable anywhere
+    <dir>/meta.json         step/version + param count
+
+plus ``make_serving_fn`` to turn (model, restored variables) into a jitted
+inference callable — the serving-signature analogue.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+from flax import serialization
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+PARAMS_FILE = "params.msgpack"
+META_FILE = "meta.json"
+
+
+def _gather_full(tree):
+    """Device → host, gathering across processes when sharded."""
+
+    def leaf(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            x = multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(x)
+
+    return jax.tree.map(leaf, tree)
+
+
+def export_model(model, state, export_dir):
+    """Write the export artifact from a live TrainState. Returns the dir."""
+    os.makedirs(export_dir, exist_ok=True)
+    payload = {
+        "params": _gather_full(state.params),
+        "model_state": _gather_full(dict(state.model_state)),
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(export_dir, PARAMS_FILE), "wb") as f:
+            f.write(serialization.to_bytes(payload))
+        n_params = sum(
+            int(np.prod(x.shape))
+            for x in jax.tree.leaves(payload["params"])
+        )
+        with open(os.path.join(export_dir, META_FILE), "w") as f:
+            json.dump(
+                {
+                    "version": int(state.step),
+                    "num_params": n_params,
+                    "model_class": type(model).__name__,
+                },
+                f,
+            )
+    return export_dir
+
+
+def export_from_checkpoint(model, template_state, checkpoint_dir, export_dir):
+    """Export the LATEST valid checkpoint (the reference export path reads
+    the newest checkpoint, not live PS state — model_handler.py:247-273)."""
+    from elasticdl_tpu.checkpoint import restore_state_from_checkpoint
+
+    state, version = restore_state_from_checkpoint(
+        template_state, checkpoint_dir
+    )
+    logger.info("Exporting checkpoint version %d", version)
+    return export_model(model, state, export_dir)
+
+
+def load_exported(export_dir):
+    """Read back {"params": ..., "model_state": ...} plus meta dict."""
+    with open(os.path.join(export_dir, PARAMS_FILE), "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    meta = {}
+    meta_path = os.path.join(export_dir, META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    return payload, meta
+
+
+def make_serving_fn(model, payload):
+    """A jitted features → predictions callable over exported weights."""
+    variables = {"params": payload["params"], **payload.get("model_state", {})}
+
+    @jax.jit
+    def serve(features):
+        return model.apply(variables, features, training=False)
+
+    return serve

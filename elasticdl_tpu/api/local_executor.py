@@ -34,6 +34,10 @@ class LocalExecutor(object):
         data_reader_params=None,
         seed=0,
         max_steps=None,
+        checkpoint_dir=None,
+        checkpoint_steps=0,
+        keep_checkpoint_max=0,
+        checkpoint_dir_for_init=None,
     ):
         self.spec = model_spec
         self.minibatch_size = minibatch_size
@@ -50,6 +54,16 @@ class LocalExecutor(object):
         )
         self.state = None
         self.losses = []
+        self._checkpoint_dir_for_init = checkpoint_dir_for_init
+        self._checkpoint_saver = None
+        if checkpoint_dir and checkpoint_steps:
+            from elasticdl_tpu.checkpoint import CheckpointSaver
+
+            self._checkpoint_saver = CheckpointSaver(
+                checkpoint_dir,
+                checkpoint_steps=checkpoint_steps,
+                keep_max_version=keep_checkpoint_max,
+            )
 
     def _reader(self, data_origin):
         return create_data_reader(
@@ -77,6 +91,18 @@ class LocalExecutor(object):
         if self.state is None:
             padded, _ = pad_batch(batch, self.minibatch_size)
             self.state = self.trainer.init_state(padded)
+            if self._checkpoint_dir_for_init:
+                from elasticdl_tpu.checkpoint import (
+                    restore_state_from_checkpoint,
+                )
+
+                self.state, version = restore_state_from_checkpoint(
+                    self.state, self._checkpoint_dir_for_init
+                )
+                logger.info(
+                    "Restored model version %d from %s",
+                    version, self._checkpoint_dir_for_init,
+                )
 
     def run(self):
         if self.training_data:
@@ -107,6 +133,8 @@ class LocalExecutor(object):
                     self.state, padded, n
                 )
                 self.losses.append(float(loss))
+                if self._checkpoint_saver is not None:
+                    self._checkpoint_saver.maybe_save(self.state)
                 step = int(self.state.step)
                 if (
                     self.evaluation_steps

@@ -1,0 +1,7 @@
+from elasticdl_tpu.checkpoint.saver import (  # noqa: F401
+    CheckpointSaver,
+    flatten_state,
+    get_latest_checkpoint_version,
+    load_checkpoint,
+    restore_state_from_checkpoint,
+)

@@ -1,0 +1,305 @@
+"""Sharded, re-shardable checkpoints.
+
+Layout parity with the reference (common/save_utils.py:93-294 and
+go/pkg/ps/checkpoint.go:31-141):
+
+    <dir>/version-<V>/variables-<i>-of-<M>.ckpt
+
+* each shard file holds a subset of leaves, assigned by sha256(name) mod M
+  (the reference's dense-variable placement rule, hash_utils.string_to_id);
+* a version dir is valid iff it contains exactly M ``variables-*-of-M`` files
+  (reference save_utils.py `_get_valid_lastest_version_dir` semantics);
+* old versions are pruned keeping the newest ``keep_max`` (reference
+  `_delete_old_checkpoints`);
+* restore merges ALL shard files then re-places onto the target mesh, so a
+  checkpoint written with M shards restores onto any device count / mesh
+  shape (reference `restore_params_from_checkpoint` re-sharding,
+  save_utils.py:229-282 — there a hash re-partition, here a
+  ``jax.device_put`` with the new state's NamedSharding).
+
+TPU-native differences: the unit of state is the whole TrainState pytree
+(params + optimizer slots + batch stats + rng + step) rather than PS-resident
+variables, so resume restores the *optimizer* exactly, and shard files are
+written by hosts (process h writes shards h, h+P, ...) instead of PS pods.
+"""
+
+import json
+import os
+import re
+import shutil
+import tempfile
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.hash_utils import string_to_id
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.tensor_utils import (
+    deserialize_ndarray_dict,
+    serialize_ndarray_dict,
+)
+
+_SHARD_RE = re.compile(r"^variables-(\d+)-of-(\d+)\.ckpt$")
+_VERSION_RE = re.compile(r"^version-(\d+)$")
+
+
+def flatten_state(state):
+    """Flatten any pytree to {keystr: ndarray} with jax path strings as the
+    stable leaf names (e.g. ``.params['Dense_0']['kernel']``)."""
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    out = {}
+    for path, leaf in leaves:
+        out[jax.tree_util.keystr(path)] = _to_numpy(leaf)
+    return out
+
+
+def _to_numpy(leaf):
+    """Materialize a (possibly sharded, possibly multi-host) jax.Array on the
+    host. Non-fully-addressable arrays are all-gathered across processes."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    return np.asarray(leaf)
+
+
+def _unflatten_into(state, flat):
+    """Rebuild a pytree shaped like `state` from {keystr: ndarray}, keeping
+    each leaf's dtype and the target's sharding (device_put against the
+    existing leaf's sharding when present)."""
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    new_leaves = []
+    missing = []
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        if key not in flat:
+            missing.append(key)
+            new_leaves.append(leaf)
+            continue
+        arr = flat[key]
+        target_dtype = getattr(leaf, "dtype", None)
+        if target_dtype is not None and arr.dtype != target_dtype:
+            arr = arr.astype(target_dtype)
+        if isinstance(leaf, jax.Array):
+            arr = jax.device_put(arr, leaf.sharding)
+        new_leaves.append(arr)
+    if missing:
+        raise ValueError(
+            "Checkpoint is missing %d leaves, e.g. %s"
+            % (len(missing), missing[:3])
+        )
+    return treedef.unflatten(new_leaves)
+
+
+class CheckpointSaver(object):
+    """Writes and prunes versioned sharded checkpoints.
+
+    Args mirror the reference CheckpointSaver (save_utils.py:93-120):
+    checkpoint_dir, checkpoint_steps (save every N model versions; 0 =
+    disabled), keep_max_version (0 = keep all), num_shards (defaults to the
+    process count so every host writes one file).
+    """
+
+    def __init__(self, checkpoint_dir, checkpoint_steps=0,
+                 keep_max_version=0, num_shards=None):
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_steps = int(checkpoint_steps)
+        self.keep_max_version = int(keep_max_version)
+        self.num_shards = int(
+            num_shards if num_shards is not None else jax.process_count()
+        )
+        if self.num_shards <= 0:
+            raise ValueError("num_shards must be positive")
+        self._last_saved_version = -1
+
+    def is_enabled(self):
+        return bool(self.checkpoint_dir) and self.checkpoint_steps > 0
+
+    def maybe_save(self, state, version=None):
+        """Save iff `version` crosses a checkpoint_steps boundary (the
+        reference PS saves inside push_gradients every checkpoint_steps —
+        ps/servicer.py:255-270)."""
+        if not self.is_enabled():
+            return False
+        version = int(version if version is not None else state.step)
+        if version <= 0 or version % self.checkpoint_steps != 0:
+            return False
+        if version == self._last_saved_version:
+            return False
+        self.save(state, version)
+        return True
+
+    def save(self, state, version):
+        """Write version-<V> atomically (temp dir + rename), then prune."""
+        version = int(version)
+        flat = flatten_state(state)
+        final_dir = self._version_dir(version)
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+
+        proc, nproc = jax.process_index(), jax.process_count()
+        tmp_dir = None
+        try:
+            if nproc == 1:
+                # single-process: write to a temp dir, rename for atomicity
+                tmp_dir = tempfile.mkdtemp(
+                    prefix=".version-%d." % version, dir=self.checkpoint_dir
+                )
+                write_dir = tmp_dir
+            else:
+                # multi-host: every process writes its shards straight into
+                # the final dir (assumed shared storage). No atomic rename —
+                # a partially-written dir fails the M-files validity check,
+                # which is exactly the reference's protection too. Stale
+                # shard files from an earlier run with a DIFFERENT shard
+                # count would make load merge two runs' tensors, so each
+                # process clears foreign-count files it would orphan.
+                write_dir = final_dir
+                os.makedirs(write_dir, exist_ok=True)
+                for name in os.listdir(write_dir):
+                    m = _SHARD_RE.match(name)
+                    if m and int(m.group(2)) != self.num_shards:
+                        try:
+                            os.remove(os.path.join(write_dir, name))
+                        except OSError:
+                            pass
+            shards = self._partition(flat)
+            for i in range(proc, self.num_shards, nproc):
+                path = os.path.join(
+                    write_dir,
+                    "variables-%d-of-%d.ckpt" % (i, self.num_shards),
+                )
+                with open(path, "wb") as f:
+                    f.write(serialize_ndarray_dict(shards[i]))
+            if proc == 0:
+                meta = {
+                    "version": version,
+                    "num_shards": self.num_shards,
+                    "leaf_count": len(flat),
+                }
+                with open(os.path.join(write_dir, "meta.json"), "w") as f:
+                    json.dump(meta, f)
+                if tmp_dir is not None:
+                    if os.path.isdir(final_dir):
+                        shutil.rmtree(final_dir)
+                    os.rename(tmp_dir, final_dir)
+                    tmp_dir = None
+        finally:
+            if tmp_dir is not None and os.path.isdir(tmp_dir):
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+        self._last_saved_version = version
+        logger.info(
+            "Saved checkpoint version-%d (%d shards) to %s",
+            version, self.num_shards, self.checkpoint_dir,
+        )
+        if proc == 0:
+            self._prune()
+        return final_dir
+
+    # ------------------------------------------------------------ internals
+
+    def _version_dir(self, version):
+        return os.path.join(self.checkpoint_dir, "version-%d" % version)
+
+    def _partition(self, flat):
+        shards = [dict() for _ in range(self.num_shards)]
+        for name, arr in flat.items():
+            shards[string_to_id(name, self.num_shards)][name] = arr
+        return shards
+
+    def _prune(self):
+        if self.keep_max_version <= 0:
+            return
+        versions = _list_versions(self.checkpoint_dir)
+        for v in versions[: -self.keep_max_version]:
+            shutil.rmtree(self._version_dir(v), ignore_errors=True)
+            logger.info("Pruned checkpoint version-%d", v)
+
+
+def _list_versions(checkpoint_dir):
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return []
+    versions = []
+    for name in os.listdir(checkpoint_dir):
+        m = _VERSION_RE.match(name)
+        if m:
+            versions.append(int(m.group(1)))
+    return sorted(versions)
+
+
+def _complete_set_counts(path):
+    """Shard counts M for which all M ``variables-*-of-M.ckpt`` exist."""
+    if not os.path.isdir(path):
+        return []
+    counts = {}
+    for name in os.listdir(path):
+        m = _SHARD_RE.match(name)
+        if m:
+            counts.setdefault(int(m.group(2)), set()).add(int(m.group(1)))
+    return [
+        total for total, seen in counts.items()
+        if seen == set(range(total))
+    ]
+
+
+def _has_complete_set(path, total):
+    return total in _complete_set_counts(path)
+
+
+def _is_valid_version_dir(path):
+    """Valid iff it holds exactly M ``variables-*-of-M.ckpt`` files (the
+    reference's validity rule: file count equals the N in the filename)."""
+    return bool(_complete_set_counts(path))
+
+
+def get_latest_checkpoint_version(checkpoint_dir):
+    """Largest version whose dir is valid, or -1."""
+    for v in reversed(_list_versions(checkpoint_dir)):
+        if _is_valid_version_dir(
+            os.path.join(checkpoint_dir, "version-%d" % v)
+        ):
+            return v
+    return -1
+
+
+def load_checkpoint(checkpoint_dir, version=None):
+    """Merge all shard files of a version into one {keystr: ndarray}.
+
+    Shard count at load time is irrelevant — this is what makes checkpoints
+    re-shardable to any mesh (reference save_utils.py:229-282).
+    Returns (flat_dict, version).
+    """
+    if version is None:
+        version = get_latest_checkpoint_version(checkpoint_dir)
+    if version < 0:
+        raise FileNotFoundError(
+            "No valid checkpoint under %r" % checkpoint_dir
+        )
+    vdir = os.path.join(checkpoint_dir, "version-%d" % version)
+    if not _is_valid_version_dir(vdir):
+        raise FileNotFoundError("Invalid checkpoint dir %r" % vdir)
+    # restrict to one complete shard set: meta.json's count when present,
+    # else the largest complete set — never merge files across shard counts
+    want = None
+    meta_path = os.path.join(vdir, "meta.json")
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                want = int(json.load(f).get("num_shards"))
+        except (ValueError, TypeError, OSError):
+            want = None
+    if want is None or not _has_complete_set(vdir, want):
+        want = max(_complete_set_counts(vdir))
+    flat = {}
+    for name in sorted(os.listdir(vdir)):
+        m = _SHARD_RE.match(name)
+        if m and int(m.group(2)) == want:
+            with open(os.path.join(vdir, name), "rb") as f:
+                flat.update(deserialize_ndarray_dict(f.read()))
+    return flat, version
+
+
+def restore_state_from_checkpoint(state, checkpoint_dir, version=None):
+    """Rebuild a TrainState-shaped pytree from a checkpoint, re-sharded to
+    `state`'s own shardings. Returns (new_state, restored_version)."""
+    flat, version = load_checkpoint(checkpoint_dir, version)
+    return _unflatten_into(state, flat), version

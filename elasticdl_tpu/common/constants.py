@@ -82,6 +82,12 @@ MAX_TASK_RETRIES = 3
 # (reference: worker/worker.py:62 `# The default maximum number of a minibatch retry ... 64`).
 MAX_MINIBATCH_RETRY_NUM = 64
 
+# Embedding tables at least this big are sharded over (ep, fsdp); smaller
+# ones follow the plain auto rule (reference: the 2 MB cutoff below which an
+# embedding layer stays native instead of moving to the PS —
+# common/model_handler.py:98-102).
+EMBEDDING_PARTITION_THRESHOLD_BYTES = 2 * 1024 * 1024
+
 # Default number of records per dispatched task
 # (reference: elasticdl_client/common/args.py `--records_per_task` default).
 DEFAULT_RECORDS_PER_TASK = 64

@@ -1,0 +1,73 @@
+"""Strategy-dependent model handling.
+
+The reference's ModelHandler (common/model_handler.py:148-466) clones a Keras
+model, swapping native ``tf.keras.layers.Embedding`` for the PS-backed
+ElasticDL layer when a table exceeds 2 MB, and performs the inverse rewrite
+(plus checkpoint-weight restore) at export time.
+
+On TPU there is no separate "distributed layer" to swap in: the framework's
+``elasticdl_tpu.embedding.Embedding`` IS both — whether a table replicates or
+shards over the (ep, fsdp) mesh axes is a *sharding decision*, made by
+parallel/sharding.infer_state_pspec with the same 2 MB threshold
+(constants.EMBEDDING_PARTITION_THRESHOLD_BYTES). The handler therefore keeps
+the reference's API surface (get_model_handler / get_model_to_train /
+get_model_to_export) while its work reduces to: pass the model through, and
+gather + export weights (optionally from the latest checkpoint) at the end.
+"""
+
+from elasticdl_tpu.common.constants import DistributionStrategy
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class ModelHandler(object):
+    @classmethod
+    def get_model_handler(
+        cls, distribution_strategy=None, checkpoint_dir=None
+    ):
+        """Strategy → handler (reference model_handler.py:155-176).
+        PARAMETER_SERVER maps to the mesh handler: the PS data plane's TPU
+        equivalent is sharded-HBM embeddings + XLA collectives."""
+        if distribution_strategy in (
+            DistributionStrategy.PARAMETER_SERVER,
+            DistributionStrategy.MESH,
+            DistributionStrategy.ALLREDUCE,
+        ):
+            return MeshModelHandler(checkpoint_dir=checkpoint_dir)
+        return LocalModelHandler(checkpoint_dir=checkpoint_dir)
+
+    def __init__(self, checkpoint_dir=None):
+        self._checkpoint_dir = checkpoint_dir
+
+    def get_model_to_train(self, model):
+        """Identity: the framework's Embedding layer serves local AND
+        distributed execution; sharding is decided at init (see module
+        docstring). Kept for API parity with the reference's rewrite."""
+        return model
+
+    def get_model_to_export(self, model, state, export_dir):
+        """Gather weights (preferring the latest checkpoint when one exists,
+        as the reference does — model_handler.py:247-273) and write the
+        export artifact."""
+        from elasticdl_tpu.api import exporter
+        from elasticdl_tpu.checkpoint import get_latest_checkpoint_version
+
+        if (
+            self._checkpoint_dir
+            and get_latest_checkpoint_version(self._checkpoint_dir) >= 0
+        ):
+            logger.info(
+                "Exporting from checkpoint dir %s", self._checkpoint_dir
+            )
+            return exporter.export_from_checkpoint(
+                model, state, self._checkpoint_dir, export_dir
+            )
+        return exporter.export_model(model, state, export_dir)
+
+
+class LocalModelHandler(ModelHandler):
+    """Single-host strategy (reference model_handler.py:179-204)."""
+
+
+class MeshModelHandler(ModelHandler):
+    """Mesh (PS-equivalent) strategy (reference
+    ParameterServerModelHandler, model_handler.py:207-466)."""

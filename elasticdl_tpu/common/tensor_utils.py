@@ -24,13 +24,15 @@ _DIM = struct.Struct("<q")
 
 def serialize_ndarray(array, name=""):
     """Serialize one ndarray (with optional name) to bytes."""
+    array = np.asarray(array)
+    shape = array.shape  # before ascontiguousarray, which promotes 0-d to 1-d
     array = np.ascontiguousarray(array)
     name_b = name.encode("utf-8")
     if len(name_b) > 0xFFFF:
         raise ValueError("tensor name too long")
-    parts = [_HEADER.pack(len(name_b), dtype_to_wire(array.dtype), array.ndim)]
+    parts = [_HEADER.pack(len(name_b), dtype_to_wire(array.dtype), len(shape))]
     parts.append(name_b)
-    for d in array.shape:
+    for d in shape:
         parts.append(_DIM.pack(d))
     parts.append(array.tobytes())
     return b"".join(parts)
@@ -51,7 +53,7 @@ def deserialize_ndarray(buf, offset=0):
     count = int(np.prod(shape)) if shape else 1
     nbytes = count * dtype.itemsize
     array = np.frombuffer(buf, dtype=dtype, count=count, offset=offset).reshape(
-        shape
+        tuple(shape)
     )
     offset += nbytes
     return name, array, offset

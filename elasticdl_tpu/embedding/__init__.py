@@ -1,0 +1,8 @@
+from elasticdl_tpu.embedding.layer import (  # noqa: F401
+    EMBEDDING_PARAM_NAME,
+    Embedding,
+    safe_embedding_lookup,
+)
+from elasticdl_tpu.embedding.sparse_optim import (  # noqa: F401
+    make_row_sparse,
+)

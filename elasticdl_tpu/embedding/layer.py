@@ -1,0 +1,141 @@
+"""Distributed embedding layer: sharded-HBM tables on the `ep` mesh axis.
+
+The TPU-native replacement for the reference's PS-resident sparse embedding
+stack (elasticdl/layers/embedding.py:20-163 + embedding_delegate.py:26-310 +
+ps/embedding_table.py:23-136):
+
+* the table is ONE dense [vocab, dim] parameter whose rows are sharded over
+  the (`ep`, `fsdp`) mesh axes — the analogue of rows living `id % num_ps`
+  across PS pods (hash_utils.int_to_id). XLA inserts the all-to-all that the
+  reference's pull_embedding_vectors RPC fan-out did by hand;
+* lookups are gathers inside the jit-compiled step; gradients come back as
+  (dense) scatter-adds that the row-sparse optimizer wrapper
+  (embedding/sparse_optim.py) applies with reference OptimizerWrapper
+  semantics (untouched rows and their slots stay untouched);
+* ragged/sparse inputs are the padded-dense equivalent of tf.SparseTensor:
+  an int id matrix [batch, max_ids] where PADDING_ID (-1) marks absent
+  entries — static shapes, which is what keeps the step compiled once;
+* combiner sum/mean/sqrtn reproduces the reference `Embedding`'s
+  `_sparse_input_call` via safe_embedding_lookup (empty rows → zero vectors,
+  the safe_embedding_lookup_sparse re-impl of embedding_delegate.py:108-230).
+
+Lazy row init (ps/embedding_table.py `EmbeddingTable.get`) has no TPU
+analogue — XLA arrays are materialized whole — so tables are initialized at
+state-init time with the same initializer family; the observable semantics
+(initializer distribution, trained values) are preserved.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# Param name the sharding rules and the row-sparse optimizer key on.
+EMBEDDING_PARAM_NAME = "embedding_table"
+
+# Id value marking padding slots in ragged inputs (never a valid row).
+PADDING_ID = -1
+
+
+def get_initializer(name_or_fn):
+    """Map reference initializer names (keras strings) to jax initializers.
+    'uniform' is keras RandomUniform(-0.05, 0.05) — the one the reference's
+    Go PS hard-codes too (go/pkg/common/embedding_table.go:50-54)."""
+    if callable(name_or_fn):
+        return name_or_fn
+    name = (name_or_fn or "uniform").lower()
+    if name in ("uniform", "random_uniform"):
+        def _keras_uniform(key, shape, dtype=jnp.float32):
+            return jax.random.uniform(
+                key, shape, dtype, minval=-0.05, maxval=0.05
+            )
+
+        return _keras_uniform
+    if name in ("normal", "random_normal"):
+        return nn.initializers.normal(stddev=0.05)
+    if name in ("truncated_normal",):
+        return nn.initializers.truncated_normal(stddev=0.05)
+    if name in ("glorot_uniform", "xavier_uniform"):
+        return nn.initializers.glorot_uniform()
+    if name in ("zeros", "zero"):
+        return nn.initializers.zeros
+    if name in ("ones", "one"):
+        return nn.initializers.ones
+    raise ValueError("Unknown embeddings_initializer %r" % name_or_fn)
+
+
+def safe_embedding_lookup(table, ids, combiner="mean", weights=None):
+    """Combined lookup over padded ragged ids (PADDING_ID = absent).
+
+    Parity with the reference's safe_embedding_lookup_sparse re-impl
+    (embedding_delegate.py:108-230): rows with no ids yield zero vectors;
+    `weights`, when given, weight each id's vector before combining (and the
+    mean/sqrtn denominators use weight totals, as in TF).
+
+    table: [vocab, dim]; ids: int [batch, max_ids]; weights: float like ids.
+    Returns [batch, dim].
+    """
+    mask = (ids != PADDING_ID).astype(table.dtype)
+    if weights is not None:
+        w = jnp.asarray(weights, table.dtype) * mask
+    else:
+        w = mask
+    gathered = jnp.take(table, jnp.maximum(ids, 0), axis=0)  # [B, L, D]
+    summed = jnp.einsum("bl,bld->bd", w, gathered)
+    if combiner == "sum":
+        return summed
+    denom = jnp.sum(w, axis=1, keepdims=True)  # [B, 1]
+    if combiner == "mean":
+        pass
+    elif combiner == "sqrtn":
+        denom = jnp.sqrt(denom)
+    else:
+        raise ValueError("Unknown combiner %r" % combiner)
+    return summed / jnp.maximum(denom, 1e-12)
+
+
+class Embedding(nn.Module):
+    """Flax counterpart of `elasticdl.layers.Embedding`.
+
+    input_dim/output_dim/embeddings_initializer/combiner mirror the reference
+    layer's constructor (elasticdl/layers/embedding.py:40-66). Input forms:
+
+    * int ids [batch] or [batch, k] with ``combiner=None`` → embeddings with
+      a trailing dim axis appended (keras Embedding behavior);
+    * padded ragged ids [batch, max_ids] (PADDING_ID marks absent) with a
+      combiner → combined [batch, dim] (the SparseTensor path).
+    """
+
+    input_dim: int
+    output_dim: int
+    embeddings_initializer: str = "uniform"
+    combiner: str = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, weights=None):
+        table = self.param(
+            EMBEDDING_PARAM_NAME,
+            get_initializer(self.embeddings_initializer),
+            (self.input_dim, self.output_dim),
+            self.param_dtype,
+        )
+        ids = jnp.asarray(ids)
+        if self.combiner is None:
+            return jnp.take(table, jnp.maximum(ids, 0), axis=0)
+        if ids.ndim != 2:
+            raise ValueError(
+                "combiner=%r needs [batch, max_ids] padded ids, got shape %s"
+                % (self.combiner, ids.shape)
+            )
+        return safe_embedding_lookup(
+            table, ids, combiner=self.combiner, weights=weights
+        )
+
+
+def is_embedding_path(path):
+    """True if a pytree key path addresses an embedding table param."""
+    return any(
+        getattr(k, "key", None) == EMBEDDING_PARAM_NAME
+        or getattr(k, "name", None) == EMBEDDING_PARAM_NAME
+        for k in path
+    )

@@ -1,0 +1,114 @@
+"""Row-sparse optimizer semantics for embedding tables.
+
+The reference's OptimizerWrapper (ps/optimizer_wrapper.py:70-351) makes a
+stock optimizer update ONLY the embedding rows a minibatch touched, together
+with their slot values (Adam m/v etc.); untouched rows and slots don't move.
+A plain dense optax update over a [vocab, dim] table violates that: Adam
+moves every row each step (moment decay + bias correction), and so would
+weight decay.
+
+``make_row_sparse(tx)`` wraps ANY optax GradientTransformation with the same
+sparse contract, fully vectorized for XLA (no data-dependent shapes):
+
+* rows whose gradient is exactly zero (i.e. not gathered this step — gather
+  backward writes exact zeros elsewhere) keep their parameter value;
+* optimizer-state leaves that mirror an embedding param (mu/nu/trace/…)
+  keep their previous value on untouched rows;
+* scalar state (step counts) advances globally, matching the reference where
+  the wrapped Keras optimizer's `iterations` is global
+  (optimizer_wrapper.py applies through the stock optimizer).
+
+Identification is structural: a pytree leaf belongs to an embedding table iff
+its key path ends with the embedding param's path (optax state subtrees
+mirror the params tree), keyed on EMBEDDING_PARAM_NAME.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.embedding.layer import is_embedding_path
+
+
+def _keystr(path):
+    return jax.tree_util.keystr(path)
+
+
+def _embedding_suffixes(params):
+    """Key-path strings of embedding-table leaves within the params tree."""
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        if is_embedding_path(path):
+            out.append((_keystr(path), getattr(leaf, "shape", ())))
+    return out
+
+
+def _row_mask(grad):
+    """[vocab, 1, ...] bool: True where any element of the row is nonzero."""
+    axes = tuple(range(1, grad.ndim))
+    return jnp.any(grad != 0, axis=axes, keepdims=True)
+
+
+def make_row_sparse(tx):
+    """Wrap an optax transform with row-sparse embedding-table updates.
+
+    No-op (beyond a cheap path scan) for models without embedding tables.
+    """
+
+    def init(params):
+        return tx.init(params)
+
+    def update(grads, state, params=None):
+        suffixes = _embedding_suffixes(grads)
+        if not suffixes:
+            return tx.update(grads, state, params)
+
+        # row masks keyed by the embedding leaf's params-tree path string
+        masks = {}
+        shapes = dict(suffixes)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(grads)[0]:
+            ks = _keystr(path)
+            if ks in shapes:
+                masks[ks] = _row_mask(leaf)
+        # longest suffix first, so nested paths can't shadow each other
+        ordered = sorted(masks, key=len, reverse=True)
+
+        def mask_for(path, leaf):
+            ks = _keystr(path)
+            for suffix in ordered:
+                if ks.endswith(suffix) and (
+                    getattr(leaf, "ndim", 0) >= 1
+                    and leaf.shape[0] == shapes[suffix][0]
+                ):
+                    return masks[suffix]
+            return None
+
+        updates, new_state = tx.update(grads, state, params)
+
+        def mask_update(path, upd):
+            m = mask_for(path, upd)
+            if m is None:
+                return upd
+            return jnp.where(m, upd, jnp.zeros_like(upd))
+
+        updates = jax.tree_util.tree_map_with_path(mask_update, updates)
+
+        old_leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+        new_leaves = jax.tree_util.tree_flatten_with_path(new_state)[0]
+        merged = []
+        for (old_path, old_leaf), (new_path, new_leaf) in zip(
+            old_leaves, new_leaves
+        ):
+            m = mask_for(new_path, new_leaf)
+            if m is not None and getattr(old_leaf, "shape", None) == getattr(
+                new_leaf, "shape", None
+            ):
+                merged.append(jnp.where(m, new_leaf, old_leaf))
+            else:
+                merged.append(new_leaf)
+        new_state = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(new_state), merged
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)

@@ -68,6 +68,12 @@ class Master(object):
         )
         if export_saved_model and training_data:
             self.task_d.add_deferred_callback_create_train_end_task()
+        # wire master-side callbacks that act on the dispatcher
+        # (MaxStepsStopping flips its stop_training flag on_task_end)
+        if callbacks_list is not None:
+            for cb in callbacks_list.callbacks:
+                if hasattr(cb, "set_task_dispatcher"):
+                    cb.set_task_dispatcher(self.task_d)
 
         eval_only = bool(validation_data) and not training_data
         self.evaluation_service = None

@@ -1,0 +1,130 @@
+// Native TRec scanner: the C++ fast path for the framework's record format
+// (layout defined in elasticdl_tpu/data/record_format.py — keep in sync).
+//
+// The reference framework's hot native loop is its Go/C++ PS kernel stack
+// (reference: go/pkg/kernel/capi/kernel_api.cc); on TPU the optimizer math
+// lives inside XLA, so the native speedup that still matters host-side is
+// the data plane: this scanner feeds the input pipeline without Python
+// per-record overhead. Exposed as a C ABI consumed via ctypes
+// (elasticdl_tpu/native/recordio_native.py).
+//
+//   file  := MAGIC(8) VERSION(u32) record* footer
+//   record:= len(u64) crc32(u32) payload[len]
+//   footer:= offsets[count](u64 each) count(u64) FOOT_MAGIC(8)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr char kMagic[] = "TRECIO\x00\x01";
+constexpr char kFootMagic[] = "TRECEND\x00";
+constexpr size_t kMagicLen = 8;
+constexpr size_t kFootLen = 8;
+
+struct TrecFile {
+  FILE* f = nullptr;
+  std::vector<uint64_t> offsets;
+};
+
+bool ReadU64At(FILE* f, long pos, uint64_t* out) {
+  if (fseek(f, pos, SEEK_SET) != 0) return false;
+  unsigned char buf[8];
+  if (fread(buf, 1, 8, f) != 8) return false;
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; --i) v = (v << 8) | buf[i];  // little-endian
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Opens `path`, validates magic + footer, loads the offset index.
+// Returns an opaque handle or nullptr on failure.
+void* trec_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return nullptr; }
+  long size = ftell(f);
+  long tail = static_cast<long>(8 + kFootLen);
+  if (size < static_cast<long>(kMagicLen + 4) + tail) { fclose(f); return nullptr; }
+
+  char magic[kMagicLen];
+  if (fseek(f, 0, SEEK_SET) != 0 || fread(magic, 1, kMagicLen, f) != kMagicLen ||
+      memcmp(magic, kMagic, kMagicLen) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  char foot[kFootLen];
+  if (fseek(f, size - static_cast<long>(kFootLen), SEEK_SET) != 0 ||
+      fread(foot, 1, kFootLen, f) != kFootLen ||
+      memcmp(foot, kFootMagic, kFootLen) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  uint64_t count = 0;
+  if (!ReadU64At(f, size - tail, &count)) { fclose(f); return nullptr; }
+  long index_start = size - tail - static_cast<long>(count) * 8;
+  if (index_start < static_cast<long>(kMagicLen + 4)) { fclose(f); return nullptr; }
+
+  auto* tf = new TrecFile;
+  tf->f = f;
+  tf->offsets.resize(count);
+  for (uint64_t i = 0; i < count; ++i) {
+    if (!ReadU64At(f, index_start + static_cast<long>(i) * 8, &tf->offsets[i])) {
+      fclose(f);
+      delete tf;
+      return nullptr;
+    }
+  }
+  return tf;
+}
+
+long trec_count(void* handle) {
+  if (!handle) return -1;
+  return static_cast<long>(static_cast<TrecFile*>(handle)->offsets.size());
+}
+
+// Reads record `index` into a malloc'd buffer (*out). Returns payload length,
+// or -1 on error. Caller frees with trec_free_buf.
+long trec_read(void* handle, long index, char** out) {
+  if (!handle || !out) return -1;
+  auto* tf = static_cast<TrecFile*>(handle);
+  if (index < 0 || static_cast<size_t>(index) >= tf->offsets.size()) return -1;
+  if (fseek(tf->f, static_cast<long>(tf->offsets[index]), SEEK_SET) != 0) return -1;
+
+  unsigned char hdr[12];  // len(u64) crc32(u32), little-endian
+  if (fread(hdr, 1, 12, tf->f) != 12) return -1;
+  uint64_t len = 0;
+  for (int i = 7; i >= 0; --i) len = (len << 8) | hdr[i];
+  uint32_t crc = 0;
+  for (int i = 11; i >= 8; --i) crc = (crc << 8) | hdr[i];
+  if (len > (1ull << 33)) return -1;  // sanity cap, matches gRPC-era limits
+
+  char* buf = static_cast<char*>(malloc(len ? len : 1));
+  if (!buf) return -1;
+  if (len && fread(buf, 1, len, tf->f) != len) { free(buf); return -1; }
+  uint32_t actual = static_cast<uint32_t>(
+      crc32(0L, reinterpret_cast<const Bytef*>(buf), static_cast<uInt>(len)));
+  if (actual != crc) { free(buf); return -1; }
+  *out = buf;
+  return static_cast<long>(len);
+}
+
+void trec_free_buf(char* buf) { free(buf); }
+
+void trec_close(void* handle) {
+  if (!handle) return;
+  auto* tf = static_cast<TrecFile*>(handle);
+  if (tf->f) fclose(tf->f);
+  delete tf;
+}
+
+}  // extern "C"

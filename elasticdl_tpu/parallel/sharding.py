@@ -37,19 +37,60 @@ def _auto_pspec(shape, fsdp_size, min_size_to_shard=2**14):
     return P()
 
 
-def infer_state_pspec(state_shapes, mesh):
+def _embedding_pspec(shape, ep_size, fsdp_size, threshold_bytes, itemsize=4):
+    """Embedding tables shard rows over (ep, fsdp) — the analogue of rows
+    living `id % num_ps` across PS pods. Falls back to (ep,) then the auto
+    fsdp rule when the vocab doesn't divide.
+
+    Tables smaller than `threshold_bytes` use the plain auto rule instead —
+    the reference's 2 MB cutoff below which an embedding stays a native
+    (replicated) layer rather than moving to the PS
+    (common/model_handler.py:98-102)."""
+    if not shape:
+        return P()
+    if int(np.prod(shape)) * itemsize < threshold_bytes:
+        return _auto_pspec(shape, fsdp_size)
+    rest = (None,) * (len(shape) - 1)
+    if ep_size * fsdp_size > 1 and shape[0] % (ep_size * fsdp_size) == 0:
+        return P((MeshAxis.EP, MeshAxis.FSDP), *rest)
+    if ep_size > 1 and shape[0] % ep_size == 0:
+        return P(MeshAxis.EP, *rest)
+    return _auto_pspec(shape, fsdp_size)
+
+
+def infer_state_pspec(state_shapes, mesh, embedding_threshold_bytes=None):
     """PartitionSpecs for a whole TrainState from its eval_shape pytree.
 
-    Applies the automatic fsdp rule uniformly: optimizer moments (mu/nu)
-    share their param's shape, so they land on the same spec — the
-    co-sharding the reference gets by keeping slot tables next to embedding
-    shards on the same PS pod (ps/parameters.py create_slot_params).
+    Embedding-table leaves (key path containing EMBEDDING_PARAM_NAME) get
+    row sharding over (ep, fsdp); everything else the automatic fsdp rule.
+    Both apply uniformly across params AND optimizer state: optax moments
+    (mu/nu) mirror their param's path and shape, so they land on the same
+    spec — the co-sharding the reference gets by keeping slot tables next to
+    embedding shards on the same PS pod (ps/parameters.py
+    create_slot_params).
     """
-    fsdp = mesh.shape[MeshAxis.FSDP]
-    return jax.tree.map(
-        lambda leaf: _auto_pspec(tuple(getattr(leaf, "shape", ())), fsdp),
-        state_shapes,
+    from elasticdl_tpu.common.constants import (
+        EMBEDDING_PARTITION_THRESHOLD_BYTES,
     )
+    from elasticdl_tpu.embedding.layer import is_embedding_path
+
+    if embedding_threshold_bytes is None:
+        embedding_threshold_bytes = EMBEDDING_PARTITION_THRESHOLD_BYTES
+    fsdp = mesh.shape[MeshAxis.FSDP]
+    ep = mesh.shape[MeshAxis.EP]
+
+    def leaf_spec(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if is_embedding_path(path):
+            itemsize = getattr(
+                getattr(leaf, "dtype", None), "itemsize", 4
+            )
+            return _embedding_pspec(
+                shape, ep, fsdp, embedding_threshold_bytes, itemsize
+            )
+        return _auto_pspec(shape, fsdp)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, state_shapes)
 
 
 def pspec_to_sharding(pspecs, mesh):

@@ -1,0 +1,13 @@
+from elasticdl_tpu.preprocessing.layers import (  # noqa: F401
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    LogRound,
+    Normalizer,
+    RoundIdentity,
+    SparseEmbedding,
+    ToNumber,
+    ToRagged,
+    ToSparse,
+)

@@ -1,0 +1,44 @@
+"""Placeholder transform-parameter accessors.
+
+Parity with elasticdl_preprocessing/utils/analyzer_utils.py: in the reference
+these return placeholder values that a SQLFlow table-analysis pass substitutes
+at template-expansion time. This build computes them directly from a numpy
+column when given one, falling back to the same pass-through placeholders.
+"""
+
+import numpy as np
+
+
+def get_min(column=None, default=0.0):
+    return float(np.min(column)) if column is not None else default
+
+
+def get_max(column=None, default=1.0):
+    return float(np.max(column)) if column is not None else default
+
+
+def get_avg(column=None, default=0.0):
+    return float(np.mean(column)) if column is not None else default
+
+
+def get_stddev(column=None, default=1.0):
+    return float(np.std(column)) if column is not None else default
+
+
+def get_bucket_boundaries(column=None, num_buckets=10, default=None):
+    """Quantile boundaries (len = num_buckets - 1)."""
+    if column is None:
+        return default if default is not None else []
+    qs = np.linspace(0, 100, num_buckets + 1)[1:-1]
+    return np.percentile(np.asarray(column), qs).tolist()
+
+
+def get_vocabulary(column=None, default=None):
+    if column is None:
+        return default if default is not None else []
+    values = np.asarray(column).reshape(-1)
+    seen = {}
+    for v in values:
+        s = v.decode("utf-8") if isinstance(v, bytes) else str(v)
+        seen.setdefault(s, None)
+    return list(seen)

@@ -1,0 +1,77 @@
+"""Feature-column equivalents.
+
+The reference adds a ``ConcatenatedCategoricalColumn`` to TF's feature-column
+system (elasticdl_preprocessing/feature_column/feature_column.py) plus an
+``embedding_column`` backed by the distributed embedding delegate
+(elasticdl/feature_column/feature_column.py). Without TF's column machinery,
+this framework expresses the same two compositions functionally: a column is
+a callable ``features_dict -> ids/values array`` plus metadata, composable
+into model input pipelines.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.preprocessing.layers import ConcatenateWithOffset
+
+
+class CategoricalColumn(object):
+    """ids column: key into the features dict + its bucket count."""
+
+    def __init__(self, key, num_buckets, transform=None):
+        self.key = key
+        self.num_buckets = int(num_buckets)
+        self._transform = transform
+
+    def __call__(self, features):
+        v = features[self.key]
+        return self._transform(v) if self._transform else v
+
+
+def categorical_column_with_identity(key, num_buckets):
+    return CategoricalColumn(key, num_buckets)
+
+
+def concatenated_categorical_column(categorical_columns):
+    """Concatenate several categorical columns into ONE id space by shifting
+    each column's ids past the previous columns' bucket counts (reference
+    ConcatenatedCategoricalColumn: offsets = cumulative num_buckets)."""
+    offsets = np.cumsum(
+        [0] + [c.num_buckets for c in categorical_columns[:-1]]
+    ).tolist()
+    concat = ConcatenateWithOffset(offsets=offsets, axis=-1)
+    total = sum(c.num_buckets for c in categorical_columns)
+
+    def column(features):
+        parts = []
+        for c in categorical_columns:
+            ids = np.asarray(c(features))
+            if ids.ndim == 1:
+                ids = ids[:, None]
+            parts.append(ids)
+        return concat(parts)
+
+    column.num_buckets = total
+    column.keys = [c.key for c in categorical_columns]
+    return column
+
+
+def embedding_column(categorical_column, dimension, combiner="mean",
+                     initializer="uniform"):
+    """Pair a categorical column with an Embedding layer spec (reference
+    elasticdl/feature_column/feature_column.py embedding_column: lookup
+    delegated to the distributed table). Returns (column_fn, layer_factory):
+    apply column_fn in dataset_fn, instantiate the layer inside the model."""
+    from elasticdl_tpu.embedding.layer import Embedding
+
+    num_buckets = getattr(categorical_column, "num_buckets")
+
+    def layer_factory(name=None):
+        return Embedding(
+            input_dim=num_buckets,
+            output_dim=dimension,
+            combiner=combiner,
+            embeddings_initializer=initializer,
+            name=name,
+        )
+
+    return categorical_column, layer_factory

@@ -1,0 +1,323 @@
+"""Feature-preprocessing layers.
+
+Parity with ``elasticdl_preprocessing/layers/`` (11 layers predating TF's own
+preprocessing set). Design notes for the TPU build:
+
+* Numeric transforms (Normalizer, RoundIdentity, LogRound, Discretization,
+  ConcatenateWithOffset, Hashing-on-ints) are ``jnp``-traceable, so they can
+  run either host-side inside ``dataset_fn`` or inside the jit-compiled
+  model.
+* String transforms (IndexLookup, ToNumber, Hashing-on-strings) are
+  host-side numpy ops — strings never enter XLA. Use them in ``dataset_fn``.
+* TF's SparseTensor/RaggedTensor input forms map to this framework's padded
+  id matrices: PADDING_ID (-1) marks absent slots (see embedding/layer.py).
+  Transforms preserve padding slots; ToSparse/ToRagged convert between dense
+  and padded forms.
+* Hashing parity note: the reference hashes with TF's
+  ``strings.to_hash_bucket_fast`` (FarmHash64 — hashing.py). This build uses
+  md5 (stable, seedless, dependency-free); bucket DISTRIBUTION properties
+  match, exact bucket assignments differ from TF.
+"""
+
+import hashlib
+
+import numpy as np
+
+from elasticdl_tpu.embedding.layer import PADDING_ID
+
+
+def _is_jax(x):
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def _np_mod(x):
+    """numpy for host arrays, jax.numpy for traced/device arrays."""
+    if _is_jax(x):
+        import jax.numpy as jnp
+
+        return jnp
+    return np
+
+
+class _Layer(object):
+    """Callable-layer base (keras Layer stand-in)."""
+
+    def __call__(self, inputs):
+        return self.call(inputs)
+
+
+class Normalizer(_Layer):
+    """(x - subtractor) / divisor (reference normalizer.py)."""
+
+    def __init__(self, subtractor, divisor):
+        if divisor == 0:
+            raise ValueError("The divisor cannot be 0")
+        self.subtractor = subtractor
+        self.divisor = divisor
+
+    def call(self, inputs):
+        xp = _np_mod(inputs)
+        x = xp.asarray(inputs, dtype=xp.float32)
+        return (x - self.subtractor) / self.divisor
+
+
+class RoundIdentity(_Layer):
+    """round(x) as an integer id; out-of-[0, num_buckets) → default_value
+    (reference round_identity.py `_round_and_truncate`)."""
+
+    def __init__(self, num_buckets, default_value=0):
+        self.num_buckets = int(num_buckets)
+        self.default_value = int(default_value)
+
+    def call(self, inputs):
+        xp = _np_mod(inputs)
+        v = xp.round(xp.asarray(inputs, dtype=xp.float32)).astype(xp.int64)
+        bad = (v < 0) | (v >= self.num_buckets)
+        return xp.where(bad, xp.int64(self.default_value), v)
+
+
+class LogRound(_Layer):
+    """round(log_base(x)) as an integer id; out-of-[0, num_bins) →
+    default_value (reference log_round.py)."""
+
+    def __init__(self, num_bins, base=None, default_value=0):
+        self.num_bins = int(num_bins)
+        self.base = base
+        self.default_value = int(default_value)
+
+    def call(self, inputs):
+        xp = _np_mod(inputs)
+        x = xp.asarray(inputs, dtype=xp.float32)
+        v = xp.log(x)
+        if self.base is not None:
+            v = v / xp.log(xp.float32(self.base))
+        v = xp.round(v).astype(xp.int64)
+        bad = (v < 0) | (v >= self.num_bins)
+        return xp.where(bad, xp.int64(self.default_value), v)
+
+
+class Discretization(_Layer):
+    """Bucketize by boundaries: output = #boundaries <= x, so `bins=[0,1,2]`
+    yields buckets (-inf,0) [0,1) [1,2) [2,inf) (reference
+    discretization.py)."""
+
+    def __init__(self, bins):
+        self.bins = list(bins)
+
+    def num_bins(self):
+        return len(self.bins) + 1
+
+    def call(self, inputs):
+        if _is_jax(inputs):
+            import jax.numpy as jnp
+
+            x = jnp.asarray(inputs)
+            b = jnp.asarray(self.bins, dtype=x.dtype)
+            return jnp.searchsorted(b, x, side="right").astype(jnp.int64)
+        x = np.asarray(inputs)
+        return np.digitize(x, self.bins, right=False).astype(np.int64)
+
+
+class Hashing(_Layer):
+    """value → md5(str(value)) % num_bins (reference hashing.py uses
+    FarmHash64 via strings.to_hash_bucket_fast; see module docstring for the
+    divergence). Int inputs are stringified first, exactly like the
+    reference. Padding slots (PADDING_ID) pass through untouched."""
+
+    def __init__(self, num_bins):
+        if num_bins is None or num_bins <= 0:
+            raise ValueError(
+                "`num_bins` cannot be `None` or non-positive values."
+            )
+        self.num_bins = int(num_bins)
+
+    def _hash_one(self, v):
+        if isinstance(v, bytes):
+            s = v
+        else:
+            s = str(v).encode("utf-8")
+        return int.from_bytes(
+            hashlib.md5(s).digest()[:8], "little"
+        ) % self.num_bins
+
+    def call(self, inputs):
+        arr = np.asarray(inputs)
+        if arr.dtype.kind in ("i", "u"):
+            out = np.empty(arr.shape, np.int64)
+            flat_in, flat_out = arr.reshape(-1), out.reshape(-1)
+            for i, v in enumerate(flat_in):
+                flat_out[i] = (
+                    PADDING_ID if v == PADDING_ID else self._hash_one(int(v))
+                )
+            return out
+        out = np.empty(arr.shape, np.int64)
+        flat_in, flat_out = arr.reshape(-1), out.reshape(-1)
+        for i, v in enumerate(flat_in):
+            flat_out[i] = self._hash_one(v)
+        return out
+
+
+class IndexLookup(_Layer):
+    """String → zero-based index by vocabulary; OOV maps to
+    ``hash(v) % num_oov_tokens + len(vocab)`` (reference index_lookup.py:
+    with the default num_oov_tokens=1 every OOV value becomes len(vocab))."""
+
+    def __init__(self, vocabulary=None, num_oov_tokens=1):
+        if isinstance(vocabulary, str):
+            with open(vocabulary) as f:
+                vocabulary = [line.rstrip("\n") for line in f if line.strip()]
+        vocabulary = list(vocabulary or [])
+        if len(set(vocabulary)) != len(vocabulary):
+            raise ValueError(
+                "The vocabulary has repeated items: %s"
+                % [v for v in set(vocabulary) if vocabulary.count(v) > 1]
+            )
+        self.vocabulary = vocabulary
+        self.num_oov_tokens = int(num_oov_tokens)
+        self._table = {self._norm(v): i for i, v in enumerate(vocabulary)}
+        self._hash = Hashing(max(self.num_oov_tokens, 1))
+
+    @staticmethod
+    def _norm(v):
+        return v.decode("utf-8") if isinstance(v, bytes) else str(v)
+
+    def vocab_size(self):
+        return len(self.vocabulary) + self.num_oov_tokens
+
+    def call(self, inputs):
+        arr = np.asarray(inputs)
+        out = np.empty(arr.shape, np.int64)
+        flat_in, flat_out = arr.reshape(-1), out.reshape(-1)
+        n = len(self.vocabulary)
+        for i, v in enumerate(flat_in):
+            key = self._norm(v)
+            idx = self._table.get(key)
+            if idx is None:
+                if self.num_oov_tokens > 1:
+                    idx = n + self._hash._hash_one(key)
+                else:
+                    idx = n
+            flat_out[i] = idx
+        return out
+
+
+class ConcatenateWithOffset(_Layer):
+    """Add offsets[i] to each id tensor, then concatenate (reference
+    concatenate_with_offset.py). Padding slots keep PADDING_ID so combiner
+    lookups still ignore them."""
+
+    def __init__(self, offsets, axis=-1):
+        self.offsets = offsets
+        self.axis = axis
+
+    def call(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return inputs
+        if self.offsets is not None and len(self.offsets) != len(inputs):
+            raise ValueError(
+                "The offsets length is not equal to inputs length: "
+                "inputs %d, offsets %d" % (len(inputs), len(self.offsets))
+            )
+        xp = _np_mod(inputs[0])
+        shifted = []
+        for i, t in enumerate(inputs):
+            t = xp.asarray(t)
+            if self.offsets is not None:
+                off = self.offsets[i]
+                t = xp.where(t == PADDING_ID, t, t + off)
+            shifted.append(t)
+        return xp.concatenate(shifted, axis=self.axis)
+
+
+class ToNumber(_Layer):
+    """Parse strings to numbers; unparseable/empty → default_value
+    (reference to_number.py)."""
+
+    def __init__(self, out_type, default_value):
+        self.out_type = np.dtype(out_type)
+        self.default_value = default_value
+
+    def call(self, inputs):
+        arr = np.asarray(inputs)
+        if arr.dtype.kind in ("i", "u", "f"):
+            return arr.astype(self.out_type)
+        out = np.empty(arr.shape, self.out_type)
+        flat_in, flat_out = arr.reshape(-1), out.reshape(-1)
+        caster = float if self.out_type.kind == "f" else lambda s: int(
+            float(s)
+        )
+        for i, v in enumerate(flat_in):
+            s = v.decode("utf-8") if isinstance(v, bytes) else str(v)
+            try:
+                flat_out[i] = caster(s)
+            except (ValueError, TypeError):
+                flat_out[i] = self.default_value
+        return out
+
+
+class ToRagged(_Layer):
+    """Dense → ragged, dropping `ignore_value` entries (reference
+    to_ragged.py). Padded-dense equivalent: surviving values are compacted
+    left and the tail filled with PADDING_ID, so downstream combiner lookups
+    see the same id multiset per row."""
+
+    def __init__(self, ignore_value=-1):
+        self.ignore_value = ignore_value
+
+    def call(self, inputs):
+        arr = np.asarray(inputs)
+        if arr.ndim == 1:
+            arr = arr[:, None]
+        out = np.full(arr.shape, PADDING_ID, np.int64)
+        for r in range(arr.shape[0]):
+            keep = [
+                v for v in arr[r]
+                if not self._ignored(v)
+            ]
+            out[r, : len(keep)] = [int(v) for v in keep]
+        return out
+
+    def _ignored(self, v):
+        if isinstance(v, (bytes, str)):
+            s = v.decode("utf-8") if isinstance(v, bytes) else v
+            return s == str(self.ignore_value) or s == ""
+        return v == self.ignore_value
+
+
+class ToSparse(ToRagged):
+    """Dense → sparse keeping positions (reference to_sparse.py). In the
+    padded-dense representation positions are preserved: ignored entries
+    simply become PADDING_ID."""
+
+    def call(self, inputs):
+        arr = np.asarray(inputs)
+        if arr.dtype.kind in ("i", "u"):
+            return np.where(
+                arr == self.ignore_value, np.int64(PADDING_ID), arr
+            ).astype(np.int64)
+        out = np.empty(arr.shape, np.int64)
+        flat_in, flat_out = arr.reshape(-1), out.reshape(-1)
+        for i, v in enumerate(flat_in):
+            flat_out[i] = PADDING_ID if self._ignored(v) else int(
+                float(v.decode() if isinstance(v, bytes) else v)
+            )
+        return out
+
+
+def SparseEmbedding(
+    input_dim, output_dim, combiner="sum", embeddings_initializer="uniform"
+):
+    """Embedding over padded sparse ids with a combiner (reference
+    sparse_embedding.py: safe_embedding_lookup_sparse over a SparseTensor).
+    Returns the framework's Embedding module configured with the combiner —
+    the two layers share one implementation here by construction."""
+    from elasticdl_tpu.embedding.layer import Embedding
+
+    return Embedding(
+        input_dim=input_dim,
+        output_dim=output_dim,
+        combiner=combiner,
+        embeddings_initializer=embeddings_initializer,
+    )

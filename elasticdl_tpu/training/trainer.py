@@ -70,10 +70,21 @@ class Trainer(object):
     """
 
     def __init__(self, model_spec, mesh=None, model_params="", seed=0,
-                 compute_dtype=None):
+                 compute_dtype=None, callbacks=None,
+                 embedding_partition_threshold=None):
         self.spec = model_spec
         self.model = model_spec.create_model(model_params)
-        self.tx = model_spec.optimizer()
+        from elasticdl_tpu.embedding.sparse_optim import make_row_sparse
+
+        tx = model_spec.optimizer()
+        if callbacks is None and model_spec.callbacks_fn is not None:
+            callbacks = model_spec.callbacks_fn()
+        tx = _apply_lr_scheduler(tx, callbacks)
+        # Row-sparse embedding semantics (reference OptimizerWrapper:
+        # untouched rows and slots don't move). Identity for models
+        # without embedding tables.
+        self.tx = make_row_sparse(tx)
+        self.embedding_partition_threshold = embedding_partition_threshold
         self.mesh = mesh if mesh is not None else mesh_lib.local_mesh()
         self.seed = seed
         self.compute_dtype = compute_dtype
@@ -122,7 +133,12 @@ class Trainer(object):
             )
 
         state_shapes = jax.eval_shape(init_fn, init_rng, features)
-        pspecs = infer_state_pspec(state_shapes, self.mesh)
+        kwargs = {}
+        if self.embedding_partition_threshold is not None:
+            kwargs["embedding_threshold_bytes"] = (
+                self.embedding_partition_threshold
+            )
+        pspecs = infer_state_pspec(state_shapes, self.mesh, **kwargs)
         self._state_sharding = pspec_to_sharding(pspecs, self.mesh)
         with self.mesh:
             state = jax.jit(
@@ -264,6 +280,22 @@ class Trainer(object):
             preds = trim(preds)
         labels = trim(labels) if labels is not None else None
         return preds, labels
+
+
+def _apply_lr_scheduler(tx, callbacks):
+    """Chain an optax scale_by_schedule when a LearningRateScheduler
+    callback is present (api/callbacks.py: version → LR multiplier,
+    compiled into the step)."""
+    import optax
+
+    from elasticdl_tpu.api.callbacks import LearningRateScheduler
+
+    for cb in callbacks or []:
+        if isinstance(cb, LearningRateScheduler):
+            return optax.chain(
+                tx, optax.scale_by_schedule(cb.multiplier_fn)
+            )
+    return tx
 
 
 def _leading_dim(features):

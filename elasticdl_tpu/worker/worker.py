@@ -75,6 +75,8 @@ class Worker(object):
         callbacks=None,
         wait_sleep_secs=0.5,
         spmd=False,
+        checkpoint_saver=None,
+        checkpoint_dir_for_init=None,
     ):
         """Connect either over gRPC (master_addr) or in-process
         (master_servicer — the test harness path, mirroring the reference's
@@ -108,6 +110,11 @@ class Worker(object):
         self._minibatch_retry_count = 0
         self._ever_connected = master_servicer is not None
         self.losses = []
+        # The reference's PS owns checkpointing (ps/servicer.py:255-270);
+        # with the PS gone the worker that owns the jit state does, on the
+        # same every-checkpoint_steps cadence.
+        self._checkpoint_saver = checkpoint_saver
+        self._checkpoint_dir_for_init = checkpoint_dir_for_init
         self.spmd = spmd
         self._spmd_ctx = None
         self._template_batch = None
@@ -207,6 +214,28 @@ class Worker(object):
     def _ensure_state(self, batch):
         if self.state is None:
             self.state = self.trainer.init_state(batch)
+            if self._checkpoint_dir_for_init:
+                from elasticdl_tpu.checkpoint import (
+                    restore_state_from_checkpoint,
+                )
+
+                self.state, version = restore_state_from_checkpoint(
+                    self.state, self._checkpoint_dir_for_init
+                )
+                logger.info(
+                    "Restored model version %d from %s",
+                    version, self._checkpoint_dir_for_init,
+                )
+
+    def _maybe_checkpoint(self):
+        """Save on the checkpoint_steps cadence. Never raises: a transient
+        save failure must not fail (or retry) the already-applied step."""
+        if self._checkpoint_saver is None or self.state is None:
+            return
+        try:
+            self._checkpoint_saver.maybe_save(self.state)
+        except Exception:
+            logger.warning("checkpoint save failed", exc_info=True)
 
     def _process_minibatch(self, batch, true_count):
         """Train one minibatch with retry (reference :870-922: up to 64
@@ -220,7 +249,7 @@ class Worker(object):
                     self.state, batch, true_count
                 )
                 self.losses.append(float(loss))
-                return ""
+                break
             except (ValueError, TypeError):
                 # deterministic failures don't heal with retries
                 raise
@@ -230,7 +259,11 @@ class Worker(object):
                     "minibatch failed (attempt %d): %s", attempt + 1, err
                 )
                 self._minibatch_retry_count += 1
-        return err or "minibatch failed"
+        else:
+            return err or "minibatch failed"
+        # outside the retry region by design (see _maybe_checkpoint)
+        self._maybe_checkpoint()
+        return ""
 
     def _train_and_evaluate(self):
         evaluation_task_executed = False
@@ -429,6 +462,7 @@ class Worker(object):
         self.state, loss = self.trainer.train_step_assembled(
             self.state, gf, gl, gw
         )
+        self._maybe_checkpoint()
         if n > 0:
             self._template_batch = (features, labels)
             self.losses.append(float(loss))

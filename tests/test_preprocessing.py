@@ -1,0 +1,202 @@
+"""Preprocessing layers: behavior parity with the reference docstring
+examples (elasticdl_preprocessing/layers/*, tests/*)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding.layer import PADDING_ID
+from elasticdl_tpu.preprocessing import (
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    LogRound,
+    Normalizer,
+    RoundIdentity,
+    SparseEmbedding,
+    ToNumber,
+    ToRagged,
+    ToSparse,
+)
+from elasticdl_tpu.preprocessing import analyzer_utils, feature_column
+
+
+def test_normalizer():
+    layer = Normalizer(subtractor=1.0, divisor=2.0)
+    out = layer(np.asarray([[3.0], [5.0], [7.0]]))
+    np.testing.assert_allclose(np.asarray(out), [[1.0], [2.0], [3.0]])
+    with pytest.raises(ValueError):
+        Normalizer(subtractor=0.0, divisor=0.0)
+    # jnp path
+    out_j = layer(jnp.asarray([[3.0]]))
+    np.testing.assert_allclose(np.asarray(out_j), [[1.0]])
+
+
+def test_round_identity():
+    layer = RoundIdentity(num_buckets=10)
+    inp = np.asarray([[1.2], [1.6], [0.2], [3.1], [4.9]])
+    np.testing.assert_array_equal(
+        np.asarray(layer(inp)), [[1], [2], [0], [3], [5]]
+    )
+    # out-of-range → default_value
+    np.testing.assert_array_equal(
+        np.asarray(RoundIdentity(num_buckets=5)(np.asarray([[7.9], [-2.0]]))),
+        [[0], [0]],
+    )
+
+
+def test_log_round():
+    layer = LogRound(num_bins=16, base=2)
+    inp = np.asarray([[1.2], [1.6], [0.2], [3.1], [100]])
+    np.testing.assert_array_equal(
+        np.asarray(layer(inp)), [[0], [1], [0], [2], [7]]
+    )
+
+
+def test_discretization():
+    layer = Discretization(bins=[0.0, 1.0, 2.0])
+    assert layer.num_bins() == 4
+    inp = np.asarray([[-1.0], [0.0], [0.5], [1.5], [5.0]])
+    np.testing.assert_array_equal(
+        np.asarray(layer(inp)), [[0], [1], [1], [2], [3]]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(layer(jnp.asarray(inp))), [[0], [1], [1], [2], [3]]
+    )
+
+
+def test_hashing():
+    layer = Hashing(num_bins=3)
+    out = layer(np.asarray([["A"], ["B"], ["C"], ["D"], ["E"]]))
+    assert out.shape == (5, 1)
+    assert ((out >= 0) & (out < 3)).all()
+    # deterministic
+    np.testing.assert_array_equal(
+        out, layer(np.asarray([["A"], ["B"], ["C"], ["D"], ["E"]]))
+    )
+    # int inputs stringify like the reference; padding passes through
+    ints = layer(np.asarray([[7, PADDING_ID]]))
+    assert ints[0, 1] == PADDING_ID
+    assert 0 <= ints[0, 0] < 3
+    with pytest.raises(ValueError):
+        Hashing(num_bins=0)
+
+
+def test_index_lookup():
+    layer = IndexLookup(vocabulary=["A", "B", "C"])
+    out = layer(np.array([["A"], ["B"], ["C"], ["D"], ["E"]]))
+    np.testing.assert_array_equal(out, [[0], [1], [2], [3], [3]])
+    assert layer.vocab_size() == 4
+    # bytes input (TRec payloads decode to bytes)
+    np.testing.assert_array_equal(
+        layer(np.array([[b"B"]], dtype=object)), [[1]]
+    )
+    # multiple OOV buckets spread deterministically in [n, n+num_oov)
+    multi = IndexLookup(vocabulary=["A"], num_oov_tokens=4)
+    oov = multi(np.array([["X"], ["Y"], ["Z"]]))
+    assert ((oov >= 1) & (oov < 5)).all()
+    with pytest.raises(ValueError):
+        IndexLookup(vocabulary=["A", "A"])
+
+
+def test_index_lookup_from_file(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("A\nB\nC\n")
+    layer = IndexLookup(vocabulary=str(p))
+    np.testing.assert_array_equal(layer(np.array([["C"]])), [[2]])
+
+
+def test_concatenate_with_offset():
+    a1 = np.asarray([[1], [1], [1]])
+    a2 = np.asarray([[2], [2], [2]])
+    layer = ConcatenateWithOffset(offsets=[0, 10], axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(layer([a1, a2])), [[1, 12], [1, 12], [1, 12]]
+    )
+    # padding ids don't get shifted
+    b = np.asarray([[PADDING_ID], [2], [PADDING_ID]])
+    out = np.asarray(ConcatenateWithOffset(offsets=[0, 10], axis=1)([a1, b]))
+    np.testing.assert_array_equal(
+        out, [[1, PADDING_ID], [1, 12], [1, PADDING_ID]]
+    )
+    with pytest.raises(ValueError):
+        ConcatenateWithOffset(offsets=[0])([a1, a2])
+
+
+def test_to_number():
+    layer = ToNumber(np.float32, default_value=-1.0)
+    out = layer(np.array([["1.5"], ["oops"], [""]], dtype=object))
+    np.testing.assert_allclose(out, [[1.5], [-1.0], [-1.0]])
+    assert out.dtype == np.float32
+    out_i = ToNumber(np.int64, 0)(np.array([[b"7"]], dtype=object))
+    np.testing.assert_array_equal(out_i, [[7]])
+
+
+def test_to_ragged_and_to_sparse():
+    dense = np.asarray([[3, -1, 5], [-1, -1, -1], [2, 4, -1]])
+    ragged = ToRagged(ignore_value=-1)(dense)
+    np.testing.assert_array_equal(
+        ragged,
+        [[3, 5, PADDING_ID], [PADDING_ID] * 3, [2, 4, PADDING_ID]],
+    )
+    sparse = ToSparse(ignore_value=-1)(dense)
+    np.testing.assert_array_equal(
+        sparse,
+        [[3, PADDING_ID, 5], [PADDING_ID] * 3, [2, 4, PADDING_ID]],
+    )
+    # 0 as ignore_value (the reference SparseEmbedding dense-input trick)
+    z = ToSparse(ignore_value=0)(np.asarray([[3, 0], [0, 1]]))
+    np.testing.assert_array_equal(z, [[3, PADDING_ID], [PADDING_ID, 1]])
+
+
+def test_sparse_embedding_layer():
+    import jax
+
+    layer = SparseEmbedding(input_dim=10, output_dim=4, combiner="sum")
+    ids = jnp.asarray([[1, 3, PADDING_ID]])
+    params = layer.init(jax.random.PRNGKey(0), ids)
+    out = layer.apply(params, ids)
+    table = np.asarray(params["params"]["embedding_table"])
+    np.testing.assert_allclose(
+        np.asarray(out)[0], table[1] + table[3], rtol=1e-6
+    )
+
+
+def test_concatenated_categorical_column():
+    c1 = feature_column.categorical_column_with_identity("a", num_buckets=10)
+    c2 = feature_column.categorical_column_with_identity("b", num_buckets=20)
+    col = feature_column.concatenated_categorical_column([c1, c2])
+    assert col.num_buckets == 30
+    features = {
+        "a": np.asarray([1, 2]),
+        "b": np.asarray([0, 5]),
+    }
+    out = col(features)
+    # second column's ids shifted by c1.num_buckets
+    np.testing.assert_array_equal(out, [[1, 10], [2, 15]])
+
+
+def test_embedding_column():
+    c = feature_column.categorical_column_with_identity("x", num_buckets=8)
+    col, layer_factory = feature_column.embedding_column(
+        c, dimension=3, combiner="mean"
+    )
+    layer = layer_factory()
+    assert layer.input_dim == 8 and layer.output_dim == 3
+
+
+def test_analyzer_utils():
+    col = np.asarray([1.0, 2.0, 3.0, 4.0])
+    assert analyzer_utils.get_min(col) == 1.0
+    assert analyzer_utils.get_max(col) == 4.0
+    assert analyzer_utils.get_avg(col) == 2.5
+    assert analyzer_utils.get_stddev(col) > 0
+    bounds = analyzer_utils.get_bucket_boundaries(col, num_buckets=2)
+    assert len(bounds) == 1
+    assert analyzer_utils.get_vocabulary(np.array(["b", "a", "b"])) == [
+        "b", "a",
+    ]
+    # placeholder fallbacks
+    assert analyzer_utils.get_min() == 0.0
+    assert analyzer_utils.get_bucket_boundaries() == []
