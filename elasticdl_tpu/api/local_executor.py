@@ -190,5 +190,9 @@ class LocalExecutor(object):
                 outputs.append(preds)
         result = np.concatenate(outputs, axis=0) if outputs else np.array([])
         if self.spec.prediction_outputs_processor is not None:
-            self.spec.prediction_outputs_processor(result)
+            from elasticdl_tpu.worker.prediction_outputs_processor import (
+                invoke_processor,
+            )
+
+            invoke_processor(self.spec.prediction_outputs_processor, result)
         return result

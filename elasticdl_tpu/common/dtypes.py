@@ -25,8 +25,8 @@ _WIRE = [
     # bfloat16 — the TPU-native default compute dtype. numpy has no builtin
     # bfloat16; ml_dtypes (a JAX dependency) provides it.
     (13, None),  # placeholder, filled below
-    # wire id 14 is reserved for variable-length bytes; object arrays are
-    # rejected (np.frombuffer cannot reconstruct them)
+    # wire id 14 is BYTES_WIRE_ID: fixed-length bytes ('S<n>'); object
+    # arrays are rejected (np.frombuffer cannot reconstruct them)
 ]
 
 try:  # ml_dtypes ships with jax
@@ -47,8 +47,15 @@ for wire_id, dt in _WIRE:
     WIRE_TO_NP_DTYPE[wire_id] = dt
 
 
+# wire id 14: fixed-length bytes (numpy 'S<n>'); the itemsize rides in the
+# serialized shape (tensor_utils appends it as a trailing pseudo-dim).
+BYTES_WIRE_ID = 14
+
+
 def dtype_to_wire(dtype):
     dtype = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    if dtype.kind == "S":
+        return BYTES_WIRE_ID
     try:
         return NP_DTYPE_TO_WIRE[dtype]
     except KeyError:

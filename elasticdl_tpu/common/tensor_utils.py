@@ -16,7 +16,11 @@ import struct
 
 import numpy as np
 
-from elasticdl_tpu.common.dtypes import dtype_to_wire, wire_to_dtype
+from elasticdl_tpu.common.dtypes import (
+    BYTES_WIRE_ID,
+    dtype_to_wire,
+    wire_to_dtype,
+)
 
 _HEADER = struct.Struct("<HBB")  # name_len, wire_dtype, ndim
 _DIM = struct.Struct("<q")
@@ -30,9 +34,17 @@ def serialize_ndarray(array, name=""):
     name_b = name.encode("utf-8")
     if len(name_b) > 0xFFFF:
         raise ValueError("tensor name too long")
-    parts = [_HEADER.pack(len(name_b), dtype_to_wire(array.dtype), len(shape))]
+    if array.dtype.kind == "U":  # unicode str arrays ride as utf-8 bytes
+        array = np.char.encode(array, "utf-8")
+    wire = dtype_to_wire(array.dtype)
+    dims = list(shape)
+    if wire == BYTES_WIRE_ID:
+        if array.dtype.itemsize == 0:  # all-empty strings -> 1-byte slots
+            array = array.astype("S1")
+        dims.append(array.dtype.itemsize)  # trailing pseudo-dim: byte width
+    parts = [_HEADER.pack(len(name_b), wire, len(dims))]
     parts.append(name_b)
-    for d in shape:
+    for d in dims:
         parts.append(_DIM.pack(d))
     parts.append(array.tobytes())
     return b"".join(parts)
@@ -49,7 +61,11 @@ def deserialize_ndarray(buf, offset=0):
         (d,) = _DIM.unpack_from(buf, offset)
         shape.append(d)
         offset += _DIM.size
-    dtype = wire_to_dtype(wire)
+    if wire == BYTES_WIRE_ID:
+        itemsize = max(1, shape.pop())  # trailing pseudo-dim: byte width
+        dtype = np.dtype("S%d" % itemsize)
+    else:
+        dtype = wire_to_dtype(wire)
     count = int(np.prod(shape)) if shape else 1
     nbytes = count * dtype.itemsize
     array = np.frombuffer(buf, dtype=dtype, count=count, offset=offset).reshape(

@@ -59,8 +59,59 @@ class AUC(StreamingMetric):
         return float(trapezoid(tp, fp))
 
 
+def flatten_metrics_dict(metrics_dict):
+    """Reference parity (common/evaluation_utils.py): ``eval_metrics_fn`` may
+    return the flat form {metric: fn} or, for dict-output models, the nested
+    form {output_name: {metric: fn}}. Flatten the nested form into
+    {"output_metric": fn'} where fn' selects predictions[output] (and
+    labels[output] when labels are also a dict)."""
+    flat = {}
+    for name, fn in metrics_dict.items():
+        if isinstance(fn, dict):
+            for metric_name, metric_fn in fn.items():
+                flat["%s_%s" % (name, metric_name)] = _bind_output(
+                    metric_fn, name
+                )
+        else:
+            flat[name] = fn
+    return flat
+
+
+def _bind_output(metric_fn, output_name):
+    if isinstance(metric_fn, StreamingMetric):
+
+        class _Bound(StreamingMetric):
+            def update(self, labels, predictions):
+                metric_fn.update(
+                    _pick(labels, output_name), _pick(predictions, output_name)
+                )
+
+            def result(self):
+                return metric_fn.result()
+
+            def reset(self):
+                metric_fn.reset()
+
+        return _Bound()
+    return lambda labels, predictions: metric_fn(
+        _pick(labels, output_name), _pick(predictions, output_name)
+    )
+
+
+def _pick(x, key):
+    if isinstance(x, dict):
+        if key not in x:
+            raise KeyError(
+                "eval_metrics_fn references output %r but the model "
+                "produced outputs %r" % (key, sorted(x))
+            )
+        return x[key]
+    return x
+
+
 class MetricsAggregator(object):
     def __init__(self, metrics_dict):
+        metrics_dict = flatten_metrics_dict(metrics_dict)
         self._metrics = metrics_dict
         self._sums = {k: 0.0 for k in metrics_dict}
         self._counts = {k: 0 for k in metrics_dict}

@@ -1,0 +1,44 @@
+"""User-extensible sink for prediction outputs.
+
+Reference: elasticdl/python/worker/prediction_outputs_processor.py:17-35 —
+model-zoo modules export a ``PredictionOutputsProcessor`` subclass (by name)
+whose ``process(predictions, worker_id)`` is invoked per prediction batch.
+"""
+
+from abc import ABC, abstractmethod
+
+
+class BasePredictionOutputsProcessor(ABC):
+    @abstractmethod
+    def process(self, predictions, worker_id):
+        """Process a batch of prediction outputs.
+
+        Args:
+            predictions: model outputs for one minibatch (ndarray or dict of
+                ndarrays for multi-output models).
+            worker_id: the integer id of the reporting worker.
+        """
+
+
+def resolve_processor(processor):
+    """Normalize the spec's processor (class, instance, or bare callable)
+    into a single ``fn(predictions, worker_id)``. Classes are instantiated
+    exactly once so stateful processors (the reference's ODPS table writer
+    pattern) keep cross-batch state."""
+    if processor is None:
+        return None
+    if isinstance(processor, type) and issubclass(
+        processor, BasePredictionOutputsProcessor
+    ):
+        processor = processor()
+    if isinstance(processor, BasePredictionOutputsProcessor):
+        return processor.process
+    return lambda predictions, worker_id: processor(predictions)
+
+
+def invoke_processor(processor, predictions, worker_id=0):
+    """One-shot convenience over resolve_processor (prefer resolving once
+    outside any per-batch loop)."""
+    fn = resolve_processor(processor)
+    if fn is not None:
+        fn(predictions, worker_id)

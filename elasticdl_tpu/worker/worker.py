@@ -327,6 +327,13 @@ class Worker(object):
         self.report_task_result(task_pb.task_id, err)
 
     def _predict_only(self):
+        from elasticdl_tpu.worker.prediction_outputs_processor import (
+            resolve_processor,
+        )
+
+        process_outputs = resolve_processor(
+            self.spec.prediction_outputs_processor
+        )
         results = []
         while True:
             task_pb = self.get_task()
@@ -349,8 +356,8 @@ class Worker(object):
                         self.state, padded, n
                     )
                     results.append(preds)
-                    if self.spec.prediction_outputs_processor:
-                        self.spec.prediction_outputs_processor(preds)
+                    if process_outputs is not None:
+                        process_outputs(preds, self.worker_id)
             except Exception as e:
                 err = "%s" % e
                 logger.error(

@@ -1,0 +1,70 @@
+"""Census feature transforms — rebuild of the reference
+model_zoo/census_dnn_model/census_feature_columns.py (numeric columns pass
+through; each categorical string column is hashed into 64 buckets and embedded
+at dim 16 via the framework embedding_column equivalent).
+
+TPU split: string hashing is a host-side transform (strings never enter XLA),
+so it runs in ``dataset_fn`` via preprocessing.Hashing; the embedding + concat
+half lives in the flax model (CensusFeatureLayer). Same bucket counts and
+dimensions as the reference."""
+
+import numpy as np
+from flax import linen as nn
+
+from elasticdl_tpu.preprocessing.layers import Hashing
+
+CATEGORICAL_FEATURE_KEYS = [
+    "workclass",
+    "education",
+    "marital-status",
+    "occupation",
+    "relationship",
+    "race",
+    "sex",
+    "native-country",
+]
+NUMERIC_FEATURE_KEYS = [
+    "age",
+    "capital-gain",
+    "capital-loss",
+    "hours-per-week",
+]
+LABEL_KEY = "label"
+
+HASH_BUCKET_SIZE = 64
+EMBEDDING_DIM = 16
+
+
+def transform_categoricals(example):
+    """Host-side: string categorical features -> hashed int ids."""
+    out = {}
+    for key in CATEGORICAL_FEATURE_KEYS:
+        out[key] = np.asarray(
+            Hashing(HASH_BUCKET_SIZE)(example[key]), dtype=np.int32
+        )
+    return out
+
+
+class CensusFeatureLayer(nn.Module):
+    """In-model half of the feature columns: embeds each hashed categorical
+    (64 buckets -> dim 16) and concatenates with the numeric features —
+    the DenseFeatures equivalent."""
+
+    @nn.compact
+    def __call__(self, features):
+        import jax.numpy as jnp
+
+        parts = [
+            jnp.reshape(
+                features[key].astype(jnp.float32), (-1, 1)
+            )
+            for key in NUMERIC_FEATURE_KEYS
+        ]
+        for key in CATEGORICAL_FEATURE_KEYS:
+            ids = features[key].astype(jnp.int32).reshape(-1)
+            emb = nn.Embed(
+                HASH_BUCKET_SIZE, EMBEDDING_DIM,
+                name="emb_%s" % key.replace("-", "_"),
+            )(ids)
+            parts.append(emb)
+        return jnp.concatenate(parts, axis=-1)

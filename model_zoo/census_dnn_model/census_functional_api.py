@@ -1,0 +1,75 @@
+"""Census-income DNN — rebuild of the reference
+model_zoo/census_dnn_model/census_functional_api.py:23-61 (DenseFeatures over
+numeric + hashed-embedded categoricals, Dense16-Dense16-Dense1-sigmoid, Adam,
+binary crossentropy)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from model_zoo.census_dnn_model.census_feature_columns import (
+    CATEGORICAL_FEATURE_KEYS,
+    LABEL_KEY,
+    NUMERIC_FEATURE_KEYS,
+    CensusFeatureLayer,
+    transform_categoricals,
+)
+
+
+class CensusDnnModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = CensusFeatureLayer()(features)
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.sigmoid(nn.Dense(1)(x))
+
+
+def custom_model():
+    return CensusDnnModel()
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1, 1).astype(jnp.float32)
+    p = jnp.clip(predictions, 1e-7, 1 - 1e-7)
+    return -jnp.mean(
+        labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p)
+    )
+
+
+def optimizer():
+    return optax.adam(1e-3)
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = transform_categoricals(ex)
+        for key in NUMERIC_FEATURE_KEYS:
+            features[key] = np.asarray(ex[key], dtype=np.float32).reshape(())
+        if mode == Mode.PREDICTION:
+            return features
+        return features, np.asarray(ex[LABEL_KEY], dtype=np.int32).reshape(())
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.round(np.asarray(predictions).reshape(-1)).astype(np.int32)
+            == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    shapes = {key: () for key in NUMERIC_FEATURE_KEYS}
+    shapes.update({key: () for key in CATEGORICAL_FEATURE_KEYS})
+    return shapes

@@ -1,0 +1,31 @@
+"""Census DNN, Sequential style — rebuild of the reference
+model_zoo/census_dnn_model/census_sequential.py (same MLP as the functional
+variant, built with nn.Sequential over the feature layer output)."""
+
+from flax import linen as nn
+
+from model_zoo.census_dnn_model.census_functional_api import (  # noqa: F401
+    dataset_fn,
+    eval_metrics_fn,
+    feature_shapes,
+    loss,
+    optimizer,
+)
+from model_zoo.census_dnn_model.census_feature_columns import (
+    CensusFeatureLayer,
+)
+
+
+class CensusSequentialModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = CensusFeatureLayer()(features)
+        mlp = nn.Sequential(
+            [nn.Dense(16), nn.relu, nn.Dense(16), nn.relu, nn.Dense(1),
+             nn.sigmoid]
+        )
+        return mlp(x)
+
+
+def custom_model():
+    return CensusSequentialModel()

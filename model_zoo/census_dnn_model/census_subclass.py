@@ -1,0 +1,34 @@
+"""Census DNN, subclass style — rebuild of the reference
+model_zoo/census_dnn_model/census_subclass.py (same MLP with explicit
+submodules via flax setup())."""
+
+from flax import linen as nn
+
+from model_zoo.census_dnn_model.census_functional_api import (  # noqa: F401
+    dataset_fn,
+    eval_metrics_fn,
+    feature_shapes,
+    loss,
+    optimizer,
+)
+from model_zoo.census_dnn_model.census_feature_columns import (
+    CensusFeatureLayer,
+)
+
+
+class CensusSubclassModel(nn.Module):
+    def setup(self):
+        self._features = CensusFeatureLayer()
+        self._dense1 = nn.Dense(16)
+        self._dense2 = nn.Dense(16)
+        self._head = nn.Dense(1)
+
+    def __call__(self, features, training=False):
+        x = self._features(features)
+        x = nn.relu(self._dense1(x))
+        x = nn.relu(self._dense2(x))
+        return nn.sigmoid(self._head(x))
+
+
+def custom_model():
+    return CensusSubclassModel()

@@ -1,0 +1,56 @@
+"""Host-side transform pipeline — rebuild of the reference's
+keras_process_layer.py (CategoryLookup/CategoryHash/NumericBucket) +
+wide_deep_functional_api.transform/transform_group: each feature maps to an
+int id, per-feature ids inside a group are offset so they share one id space,
+and a group becomes a [batch, n_features] id matrix.
+
+Runs host-side in ``dataset_fn`` (strings never enter XLA); the embedding
+towers consume the resulting static-shape id matrices."""
+
+import numpy as np
+
+from elasticdl_tpu.preprocessing.layers import (
+    Discretization,
+    Hashing,
+    IndexLookup,
+)
+from model_zoo.census_wide_deep_model.feature_info_util import (
+    TransformOp,
+    get_id_boundaries,
+)
+
+
+def get_transform_layer(feature_info):
+    """FeatureInfo -> host-side transform callable
+    (reference wide_deep_functional_api.get_transform_layer)."""
+    if feature_info.op_name == TransformOp.LOOKUP:
+        return IndexLookup(vocabulary=list(feature_info.param))
+    if feature_info.op_name == TransformOp.HASH:
+        return Hashing(num_bins=int(feature_info.param))
+    if feature_info.op_name == TransformOp.BUCKETIZE:
+        return Discretization(bins=list(feature_info.param))
+    raise ValueError("The op %r is not supported" % (feature_info.op_name,))
+
+
+def transform_group(example, feature_group):
+    """Transform one example's features of a group into an offset id vector
+    (reference transform_group: per-feature transform + AddIdOffset +
+    concatenate)."""
+    offsets = get_id_boundaries(feature_group)
+    ids = []
+    for offset, info in zip(offsets[:-1], feature_group):
+        value = example[info.name]
+        if info.op_name == TransformOp.BUCKETIZE:
+            value = np.asarray(value, np.float32)
+        out = np.asarray(get_transform_layer(info)(value)).reshape(-1)
+        ids.append(out.astype(np.int64) + offset)
+    return np.concatenate(ids)
+
+
+def transform(example, feature_groups):
+    """{group_name: offset id vector} for one example
+    (reference wide_deep_functional_api.transform)."""
+    return {
+        name: transform_group(example, group)
+        for name, group in feature_groups.items()
+    }

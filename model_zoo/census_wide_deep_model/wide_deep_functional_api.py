@@ -1,0 +1,107 @@
+"""Census Wide&Deep — rebuild of the reference
+model_zoo/census_wide_deep_model/wide_deep_functional_api.py:164-244:
+
+* features transformed per group into offset id matrices (host-side,
+  transform_layers.py),
+* wide tower: per-group Embedding(dim 1) summed over the group's features,
+* deep tower: per-group Embedding(dim 8) summed, Dense[16, 8, 4],
+* concat(wide, deep) -> reduce_sum -> logits; sigmoid -> probs,
+* dict outputs {"logits", "probs"}, nested eval metrics with AUC.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.training.metrics import AUC
+from model_zoo.census_wide_deep_model.feature_config import (
+    FEATURE_GROUPS,
+    LABEL_KEY,
+    MODEL_INPUTS,
+    get_id_group_dims,
+)
+from model_zoo.census_wide_deep_model.transform_layers import transform
+
+
+class WideDeepModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        id_group_dims = get_id_group_dims()
+
+        def embed_sum(group_name, dim, tower):
+            ids = features[group_name].astype(jnp.int32)  # [B, n_feat]
+            emb = nn.Embed(
+                id_group_dims[group_name], dim,
+                name="%s_%s_embedding" % (tower, group_name),
+            )(ids)
+            return jnp.sum(emb, axis=1)  # [B, dim]
+
+        wide_embeddings = [
+            embed_sum(g, 1, "wide") for g in MODEL_INPUTS["wide"]
+        ]
+        deep_embeddings = [
+            embed_sum(g, 8, "deep") for g in MODEL_INPUTS["deep"]
+        ]
+
+        wide = jnp.concatenate(wide_embeddings, axis=-1)
+
+        dnn = jnp.concatenate(deep_embeddings, axis=-1)
+        for units in (16, 8, 4):
+            dnn = nn.Dense(units)(dnn)
+
+        concat = jnp.concatenate([wide, dnn], axis=1)
+        logits = jnp.sum(concat, axis=1, keepdims=True)
+        probs = jnp.reshape(nn.sigmoid(logits), (-1,))
+        return {"logits": logits, "probs": probs}
+
+
+def custom_model():
+    return WideDeepModel()
+
+
+def loss(labels, predictions):
+    logits = predictions["logits"].reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+
+def optimizer(lr=0.001):
+    return optax.adam(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {
+            name: ids.astype(np.int64)
+            for name, ids in transform(ex, FEATURE_GROUPS).items()
+        }
+        if mode == Mode.PREDICTION:
+            return features
+        return features, np.asarray(ex[LABEL_KEY], np.int32).reshape(())
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "logits": {
+            "accuracy": lambda labels, predictions: (
+                (np.asarray(predictions).reshape(-1) > 0.0).astype(np.int32)
+                == np.asarray(labels).reshape(-1)
+            ).astype(np.float32)
+        },
+        "probs": {"auc": AUC()},
+    }
+
+
+def feature_shapes():
+    return {
+        name: (len(group),) for name, group in FEATURE_GROUPS.items()
+    }

@@ -1,0 +1,107 @@
+"""CIFAR-10 VGG-style CNN — rebuild of the reference zoo module
+model_zoo/cifar10_functional_api/cifar10_functional_api.py:19-176 (three
+conv-BN-relu pairs at 32/64/128 channels with maxpool+dropout between, then
+Dense10) as a compact flax module. Includes the reference's
+LearningRateScheduler callback (steps 5000/15000 -> 0.1/0.01/0.001,
+reference :132-141) and a PredictionOutputsProcessor."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.api.callbacks import LearningRateScheduler
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.worker.prediction_outputs_processor import (
+    BasePredictionOutputsProcessor,
+)
+
+
+class Cifar10Model(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = features["image"]
+        x = x.reshape(x.shape[0], 32, 32, 3)
+
+        def conv_bn_relu(x, ch):
+            x = nn.Conv(ch, (3, 3), padding="SAME")(x)
+            x = nn.BatchNorm(
+                use_running_average=not training, momentum=0.9, epsilon=1e-6
+            )(x)
+            return nn.relu(x)
+
+        for ch, rate in ((32, 0.2), (64, 0.3), (128, 0.4)):
+            x = conv_bn_relu(x, ch)
+            x = conv_bn_relu(x, ch)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = nn.Dropout(rate, deterministic=not training)(x)
+
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(10, name="output")(x)
+
+
+def custom_model():
+    return Cifar10Model()
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(predictions, labels)
+    )
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def callbacks():
+    # traced schedule (compiled into the train step): the reference's
+    # python-if absolute-LR schedule (cifar10_functional_api.py:132-141),
+    # expressed as multipliers of the base lr=0.1
+    def _schedule(model_version):
+        return jnp.where(
+            model_version < 5000, 1.0,
+            jnp.where(model_version < 15000, 0.1, 0.01),
+        )
+
+    return [LearningRateScheduler(_schedule)]
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {"image": ex["image"].astype(np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex["label"].astype(np.int32)[0]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=1) == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    return {"image": (32, 32, 3)}
+
+
+class PredictionOutputsProcessor(BasePredictionOutputsProcessor):
+    """Logs prediction batches (the reference writes them to a MaxCompute
+    table when ODPS is configured — cifar10_functional_api.py:178-202; here
+    the ODPS sink lives behind data/odps gating)."""
+
+    def process(self, predictions, worker_id):
+        logger.info(
+            "worker %d predictions: %s", worker_id, np.asarray(predictions)
+        )

@@ -1,0 +1,96 @@
+"""CIFAR-10 CNN, subclass style — rebuild of the reference zoo module
+model_zoo/cifar10_subclass/cifar10_subclass.py:18-200 (same stack as the
+functional variant: conv-BN-relu pairs at 32/64/128 with maxpool+dropout,
+Dense10), written with explicit flax `setup()` submodules."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+
+
+class _ConvBNRelu(nn.Module):
+    channels: int
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        x = nn.Conv(self.channels, (3, 3), padding="SAME")(x)
+        x = nn.BatchNorm(
+            use_running_average=not training, momentum=0.9, epsilon=1e-6
+        )(x)
+        return nn.relu(x)
+
+
+class CustomModel(nn.Module):
+    channel_last: bool = True
+
+    def setup(self):
+        self._block1a = _ConvBNRelu(32)
+        self._block1b = _ConvBNRelu(32)
+        self._drop1 = nn.Dropout(0.2)
+        self._block2a = _ConvBNRelu(64)
+        self._block2b = _ConvBNRelu(64)
+        self._drop2 = nn.Dropout(0.3)
+        self._block3a = _ConvBNRelu(128)
+        self._block3b = _ConvBNRelu(128)
+        self._drop3 = nn.Dropout(0.4)
+        self._dense = nn.Dense(10)
+
+    def __call__(self, features, training=False):
+        x = features["image"]
+        x = x.reshape(x.shape[0], 32, 32, 3)
+        for a, b, drop in (
+            (self._block1a, self._block1b, self._drop1),
+            (self._block2a, self._block2b, self._drop2),
+            (self._block3a, self._block3b, self._drop3),
+        ):
+            x = a(x, training)
+            x = b(x, training)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = drop(x, deterministic=not training)
+        x = x.reshape(x.shape[0], -1)
+        return self._dense(x)
+
+
+def custom_model():
+    return CustomModel()
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(predictions, labels)
+    )
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {"image": ex["image"].astype(np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex["label"].astype(np.int32)[0]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=1) == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    return {"image": (32, 32, 3)}

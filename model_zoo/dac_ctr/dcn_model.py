@@ -1,0 +1,42 @@
+"""Criteo DCN — rebuild of the reference model_zoo/dac_ctr/dcn_model.py
+(linear logits + parallel DNN[16,4] and 2-layer CrossNet over the deep
+input, Dense(1) over their concat, reduce_sum with linear -> logits)."""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from model_zoo.dac_ctr.utils import DNN, CrossNet, GroupEmbeddings
+
+
+class DCNCTR(nn.Module):
+    max_ids: dict
+    deep_embedding_dim: int = 8
+
+    @nn.compact
+    def __call__(self, dense_tensor, id_tensors, training=False):
+        linear_logits = GroupEmbeddings(self.max_ids, 1)(id_tensors)
+        deep_embeddings = GroupEmbeddings(
+            self.max_ids, self.deep_embedding_dim
+        )(id_tensors)
+
+        dnn_input = jnp.concatenate(deep_embeddings, axis=-1)
+        if dense_tensor is not None:
+            dnn_input = jnp.concatenate([dense_tensor, dnn_input], axis=-1)
+            linear_logits.append(nn.Dense(1, use_bias=False)(dense_tensor))
+
+        linear_logit = jnp.concatenate(linear_logits, axis=-1)
+
+        dnn_output = DNN((16, 4), "relu")(dnn_input)
+        cross_out = CrossNet(2)(dnn_input)
+        deep_cross_logit = nn.Dense(1, use_bias=False)(
+            jnp.concatenate([dnn_output, cross_out], axis=1)
+        )
+
+        concat = jnp.concatenate([linear_logit, deep_cross_logit], axis=1)
+        logits = jnp.sum(concat, axis=1, keepdims=True)
+        probs = jnp.reshape(nn.sigmoid(logits), (-1,))
+        return {"logits": logits, "probs": probs}
+
+
+def dcn_model(max_ids, deep_embedding_dim=8):
+    return DCNCTR(max_ids=max_ids, deep_embedding_dim=deep_embedding_dim)

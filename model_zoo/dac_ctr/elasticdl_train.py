@@ -1,0 +1,111 @@
+"""Criteo CTR training entry — rebuild of the reference
+model_zoo/dac_ctr/elasticdl_train.py (spec module: transform_feature over
+FEATURE_GROUPS feeding a selectable CTR model — the reference hardwires
+xdeepfm; here ``custom_model(ctr_model=...)`` selects
+wide_deep/deepfm/dcn/xdeepfm via --model_params, and
+``max_hashing_bucket_size`` scales the hash spaces for small runs)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.api.callbacks import MaxStepsStopping
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.training.metrics import AUC
+from model_zoo.dac_ctr.dcn_model import dcn_model
+from model_zoo.dac_ctr.deepfm_model import deepfm_model
+from model_zoo.dac_ctr.feature_config import (
+    FEATURE_GROUPS,
+    LABEL_KEY,
+    MAX_HASHING_BUCKET_SIZE,
+)
+from model_zoo.dac_ctr.feature_transform import (
+    group_max_ids,
+    transform_feature,
+)
+from model_zoo.dac_ctr.wide_deep_model import wide_deep_model
+from model_zoo.dac_ctr.xdeepfm_model import xdeepfm_model
+
+_MODELS = {
+    "wide_deep": wide_deep_model,
+    "deepfm": deepfm_model,
+    "dcn": dcn_model,
+    "xdeepfm": xdeepfm_model,
+}
+
+# module-level so dataset_fn (which has no model handle) matches the model's
+# id spaces; custom_model(max_hashing_bucket_size=...) updates it
+_max_bucket = [MAX_HASHING_BUCKET_SIZE]
+
+
+class _CTRWrapper(nn.Module):
+    """Adapts (features dict) -> (dense_tensor, id_tensors) call form."""
+
+    inner: nn.Module
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        dense = features["dense"].astype(jnp.float32)
+        id_tensors = {
+            k: v for k, v in features.items() if k.startswith("group_")
+        }
+        return self.inner(dense, id_tensors, training=training)
+
+
+def custom_model(ctr_model="xdeepfm",
+                 max_hashing_bucket_size=MAX_HASHING_BUCKET_SIZE):
+    _max_bucket[0] = int(max_hashing_bucket_size)
+    max_ids = group_max_ids(FEATURE_GROUPS, _max_bucket[0])
+    return _CTRWrapper(inner=_MODELS[ctr_model](max_ids))
+
+
+def loss(labels, predictions):
+    logits = predictions["logits"].reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+
+def optimizer(lr=0.001):
+    return optax.adam(lr)
+
+
+def callbacks():
+    return [MaxStepsStopping(max_steps=150000)]
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        dense, id_tensors = transform_feature(
+            ex, FEATURE_GROUPS, _max_bucket[0]
+        )
+        features = {"dense": dense}
+        features.update(id_tensors)
+        if mode == Mode.PREDICTION:
+            return features
+        return features, np.asarray(ex[LABEL_KEY], np.int32).reshape(())
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=10000, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "logits": {
+            "accuracy": lambda labels, predictions: (
+                (np.asarray(predictions).reshape(-1) > 0.5).astype(np.int32)
+                == np.asarray(labels).reshape(-1)
+            ).astype(np.float32)
+        },
+        "probs": {"auc": AUC()},
+    }
+
+
+def feature_shapes():
+    shapes = {"dense": (13,)}
+    shapes.update({"group_%d" % i: (1,) for i in range(len(FEATURE_GROUPS))})
+    return shapes

@@ -1,0 +1,106 @@
+"""Shared CTR building blocks — rebuild of the reference
+model_zoo/dac_ctr/utils.py (DNN, lookup_embedding_func) plus flax
+implementations of the interaction layers the reference imports from the
+external `deepctr` package (FM, CrossNet, CIN)."""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class DNN(nn.Module):
+    """Stack of Dense layers (reference utils.py DNN)."""
+
+    hidden_units: tuple
+    activation: str = None
+
+    @nn.compact
+    def __call__(self, x):
+        act = {"relu": nn.relu, None: lambda y: y}[self.activation]
+        for units in self.hidden_units:
+            x = act(nn.Dense(units)(x))
+        return x
+
+
+class GroupEmbeddings(nn.Module):
+    """Per-group embedding lookup + sum over the group's features
+    (reference utils.py lookup_embedding_func). Call with the dict of
+    [batch, n_feat] id tensors; returns a list of [batch, embedding_dim]
+    tensors, one per group, in group order."""
+
+    max_ids: dict
+    embedding_dim: int
+
+    @nn.compact
+    def __call__(self, id_tensors):
+        embeddings = []
+        for name in sorted(
+            id_tensors, key=lambda n: int(n.split("_")[-1])
+        ):
+            ids = id_tensors[name].astype(jnp.int32)
+            emb = nn.Embed(
+                self.max_ids[name], self.embedding_dim,
+                name="%s_dim%d_embedding" % (name, self.embedding_dim),
+            )(ids)
+            embeddings.append(jnp.sum(emb, axis=1))
+        return embeddings
+
+
+class FM(nn.Module):
+    """Factorization-machine pairwise term over stacked field embeddings
+    (deepctr.layers.interaction.FM equivalent): input [B, F, D] ->
+    0.5 * sum_d((sum_f e)^2 - sum_f e^2) -> [B, 1]."""
+
+    @nn.compact
+    def __call__(self, stacked):
+        sum_sq = jnp.square(jnp.sum(stacked, axis=1))
+        sq_sum = jnp.sum(jnp.square(stacked), axis=1)
+        return 0.5 * jnp.sum(sum_sq - sq_sum, axis=1, keepdims=True)
+
+
+class CrossNet(nn.Module):
+    """DCN cross network (deepctr CrossNet equivalent):
+    x_{l+1} = x_0 * (x_l . w_l) + b_l + x_l."""
+
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x0):
+        x = x0
+        dim = x0.shape[-1]
+        for layer in range(self.num_layers):
+            w = self.param(
+                "cross_w_%d" % layer, nn.initializers.normal(0.01), (dim,)
+            )
+            b = self.param(
+                "cross_b_%d" % layer, nn.initializers.zeros, (dim,)
+            )
+            xw = jnp.einsum("bd,d->b", x, w)[:, None]  # [B, 1]
+            x = x0 * xw + b + x
+        return x
+
+
+class CIN(nn.Module):
+    """Compressed interaction network (xDeepFM; deepctr CIN equivalent).
+    Input [B, F, D]; each layer compresses the outer product of the previous
+    feature maps with X^0 along the field axes; sum-pool over D at the end."""
+
+    layer_sizes: tuple = (128, 128)
+
+    @nn.compact
+    def __call__(self, x0):
+        batch, fields, dim = x0.shape
+        finals = []
+        xk = x0
+        for k, size in enumerate(self.layer_sizes):
+            hk = xk.shape[1]
+            # outer product along field axes: [B, hk, F, D]
+            z = jnp.einsum("bhd,bfd->bhfd", xk, x0)
+            z = z.reshape(batch, hk * fields, dim)
+            w = self.param(
+                "cin_w_%d" % k,
+                nn.initializers.normal(0.01),
+                (size, hk * fields),
+            )
+            xk = jnp.einsum("bmd,sm->bsd", z, w)  # [B, size, D]
+            finals.append(jnp.sum(xk, axis=2))  # sum pool over D
+        return jnp.concatenate(finals, axis=1)  # [B, sum(sizes)]

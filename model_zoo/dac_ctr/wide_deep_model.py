@@ -1,0 +1,44 @@
+"""Criteo Wide&Deep — rebuild of the reference
+model_zoo/dac_ctr/wide_deep_model.py (linear logits from dim-1 group
+embeddings + Dense(1) over the standardized dense tensor; deep tower
+DNN[16,4] over dense+flattened dim-8 embeddings; reduce_sum of
+[linear, dnn_logit] -> logits)."""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from model_zoo.dac_ctr.utils import DNN, GroupEmbeddings
+
+
+class WideDeepCTR(nn.Module):
+    max_ids: dict
+    deep_embedding_dim: int = 8
+    dnn_hidden_units: tuple = (16, 4)
+
+    @nn.compact
+    def __call__(self, dense_tensor, id_tensors, training=False):
+        linear_logits = GroupEmbeddings(self.max_ids, 1)(id_tensors)
+        deep_embeddings = GroupEmbeddings(
+            self.max_ids, self.deep_embedding_dim
+        )(id_tensors)
+
+        dnn_input = jnp.concatenate(deep_embeddings, axis=-1)
+        if dense_tensor is not None:
+            dnn_input = jnp.concatenate([dense_tensor, dnn_input], axis=-1)
+            linear_logits.append(
+                nn.Dense(1, use_bias=False)(dense_tensor)
+            )
+
+        linear_logit = jnp.concatenate(linear_logits, axis=-1)
+        dnn_output = DNN(self.dnn_hidden_units, "relu")(dnn_input)
+        dnn_logit = nn.Dense(1, use_bias=False)(dnn_output)
+
+        concat = jnp.concatenate([linear_logit, dnn_logit], axis=1)
+        logits = jnp.sum(concat, axis=1, keepdims=True)
+        probs = jnp.reshape(nn.sigmoid(logits), (-1,))
+        return {"logits": logits, "probs": probs}
+
+
+def wide_deep_model(max_ids, deep_embedding_dim=8):
+    return WideDeepCTR(max_ids=max_ids,
+                       deep_embedding_dim=deep_embedding_dim)

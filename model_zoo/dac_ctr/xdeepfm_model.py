@@ -1,0 +1,47 @@
+"""Criteo xDeepFM — rebuild of the reference
+model_zoo/dac_ctr/xdeepfm_model.py (linear logits + DNN[16,4] logit + CIN
+(layer sizes 128,128) over the stacked dim-8 embeddings with a Dense(1)
+head; reduce_sum -> logits). The CIN here is the plain (non-split-half)
+variant — same interaction order, simpler compression."""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from model_zoo.dac_ctr.utils import CIN, DNN, GroupEmbeddings
+
+
+class XDeepFMCTR(nn.Module):
+    max_ids: dict
+    deep_embedding_dim: int = 8
+
+    @nn.compact
+    def __call__(self, dense_tensor, id_tensors, training=False):
+        linear_logits = GroupEmbeddings(self.max_ids, 1)(id_tensors)
+        deep_embeddings = GroupEmbeddings(
+            self.max_ids, self.deep_embedding_dim
+        )(id_tensors)
+
+        dnn_input = jnp.concatenate(deep_embeddings, axis=-1)
+        if dense_tensor is not None:
+            dnn_input = jnp.concatenate([dense_tensor, dnn_input], axis=-1)
+            linear_logits.append(nn.Dense(1, use_bias=False)(dense_tensor))
+
+        linear_logit = jnp.concatenate(linear_logits, axis=-1)
+        dnn_logit = nn.Dense(1, use_bias=False)(
+            DNN((16, 4), "relu")(dnn_input)
+        )
+
+        parts = [linear_logit, dnn_logit]
+        if len(deep_embeddings) > 1:
+            stacked = jnp.stack(deep_embeddings, axis=1)  # [B, F, D]
+            exfm_out = CIN((128, 128))(stacked)
+            parts.append(nn.Dense(1)(exfm_out))
+
+        concat = jnp.concatenate(parts, axis=1)
+        logits = jnp.sum(concat, axis=1, keepdims=True)
+        probs = jnp.reshape(nn.sigmoid(logits), (-1,))
+        return {"logits": logits, "probs": probs}
+
+
+def xdeepfm_model(max_ids, deep_embedding_dim=8):
+    return XDeepFMCTR(max_ids=max_ids, deep_embedding_dim=deep_embedding_dim)

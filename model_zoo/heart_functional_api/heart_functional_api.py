@@ -1,0 +1,101 @@
+"""Heart-disease classifier — rebuild of the reference
+model_zoo/heart_functional_api/heart_functional_api.py:20-100:
+
+* numeric features trestbps/chol/thalach/oldpeak/slope/ca pass through,
+* `age` bucketized at [18,25,30,35,40,45,50,55,60,65] (one-hot indicator),
+* `thal` string hashed into 100 buckets and embedded at dim 8
+  (framework embedding_column equivalent),
+* Dense16-Dense16-Dense1 sigmoid head, SGD(1e-6), binary crossentropy.
+
+TPU split: the string hash + bucketize run host-side in dataset_fn; the
+embedding/one-hot + MLP are the jit-compiled model."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.preprocessing.layers import Discretization, Hashing
+
+NUMERIC_KEYS = ["trestbps", "chol", "thalach", "oldpeak", "slope", "ca"]
+AGE_BOUNDARIES = [18, 25, 30, 35, 40, 45, 50, 55, 60, 65]
+THAL_HASH_BUCKETS = 100
+THAL_EMBEDDING_DIM = 8
+
+
+class HeartModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        parts = [
+            features[k].astype(jnp.float32).reshape(-1, 1)
+            for k in NUMERIC_KEYS
+        ]
+        age_onehot = jnp.eye(len(AGE_BOUNDARIES) + 1)[
+            features["age_bucket"].astype(jnp.int32).reshape(-1)
+        ]
+        parts.append(age_onehot)
+        thal_emb = nn.Embed(
+            THAL_HASH_BUCKETS, THAL_EMBEDDING_DIM, name="thal_embedding"
+        )(features["thal_id"].astype(jnp.int32).reshape(-1))
+        parts.append(thal_emb)
+        x = jnp.concatenate(parts, axis=-1)
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.sigmoid(nn.Dense(1)(x))
+
+
+def custom_model():
+    return HeartModel()
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1).astype(jnp.float32)
+    p = jnp.clip(predictions.reshape(-1), 1e-7, 1 - 1e-7)
+    return -jnp.mean(
+        labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p)
+    )
+
+
+def optimizer(lr=1e-6):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    age_bucketize = Discretization(bins=AGE_BOUNDARIES)
+    thal_hash = Hashing(THAL_HASH_BUCKETS)
+
+    def _parse(record):
+        ex = decode_example(record)
+        features = {
+            k: np.asarray(ex[k], dtype=np.float32).reshape(())
+            for k in NUMERIC_KEYS
+        }
+        features["age_bucket"] = np.asarray(
+            age_bucketize(np.asarray(ex["age"], np.float32)), np.int32
+        ).reshape(())
+        features["thal_id"] = np.asarray(
+            thal_hash(ex["thal"]), np.int32
+        ).reshape(())
+        if mode == Mode.PREDICTION:
+            return features
+        return features, np.asarray(ex["target"], np.int32).reshape(())
+
+    return dataset.map(_parse)
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.round(np.asarray(predictions).reshape(-1)).astype(np.int32)
+            == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    shapes = {k: () for k in NUMERIC_KEYS}
+    shapes["age_bucket"] = ()
+    shapes["thal_id"] = ()
+    return shapes

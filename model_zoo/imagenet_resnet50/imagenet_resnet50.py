@@ -1,0 +1,72 @@
+"""ImageNet ResNet-50 zoo entry — rebuild of the reference
+model_zoo/imagenet_resnet50/imagenet_resnet50.py (ResNet-50 over 224x224x3
+images, 1000 classes). Shares the flax ResNet50 stack with resnet50_subclass;
+bfloat16 activations for MXU throughput on real ImageNet shapes."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from model_zoo.resnet50_subclass.resnet50_model import (
+    L2_WEIGHT_DECAY,
+    ResNet50,
+)
+
+
+class ImagenetModel(nn.Module):
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = features["image"].astype(jnp.bfloat16)
+        logits = ResNet50(num_classes=self.num_classes, name="resnet50")(
+            x, training
+        )
+        return logits.astype(jnp.float32)
+
+
+def custom_model():
+    return ImagenetModel()
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(predictions, labels)
+    )
+
+
+def optimizer(lr=0.1):
+    return optax.chain(
+        optax.add_decayed_weights(L2_WEIGHT_DECAY),
+        optax.sgd(lr, momentum=0.9),
+    )
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {"image": ex["image"].astype(np.float32) / 255.0}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex["label"].astype(np.int32)[0]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=1) == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    return {"image": (224, 224, 3)}

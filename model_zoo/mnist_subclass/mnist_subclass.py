@@ -1,0 +1,76 @@
+"""MNIST CNN, subclass style — rebuild of the reference zoo module
+model_zoo/mnist_subclass/mnist_subclass.py:18-100 (explicit-submodule Keras
+subclass Conv32-Conv64-BN-MaxPool-Dropout-Dense10) as a flax.linen module
+with `setup()` (the flax analogue of the Keras subclass style). Same spec
+surface: custom_model/loss/optimizer/dataset_fn/eval_metrics_fn."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+
+
+class CustomModel(nn.Module):
+    channel_last: bool = True
+
+    def setup(self):
+        self._conv1 = nn.Conv(32, (3, 3), padding="VALID")
+        self._conv2 = nn.Conv(64, (3, 3), padding="VALID")
+        self._batch_norm = nn.BatchNorm(momentum=0.99)
+        self._dropout = nn.Dropout(0.25)
+        self._dense = nn.Dense(10)
+
+    def __call__(self, features, training=False):
+        x = features["image"]
+        x = x.reshape(x.shape[0], 28, 28, 1)
+        x = nn.relu(self._conv1(x))
+        x = nn.relu(self._conv2(x))
+        x = self._batch_norm(x, use_running_average=not training)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = self._dropout(x, deterministic=not training)
+        x = x.reshape(x.shape[0], -1)
+        return self._dense(x)
+
+
+def custom_model():
+    return CustomModel()
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(predictions, labels)
+    )
+
+
+def optimizer(lr=0.01):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {"image": ex["image"].astype(np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex["label"].astype(np.int32)[0]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=1) == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    return {"image": (28, 28)}

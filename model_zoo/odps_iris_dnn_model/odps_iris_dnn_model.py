@@ -1,0 +1,59 @@
+"""Iris DNN over table rows — rebuild of the reference
+model_zoo/odps_iris_dnn_model/odps_iris_dnn_model.py:18-56 (flatten 4 floats
+-> Dense(3) softmax classifier; the reference reads MaxCompute/ODPS rows of
+strings, parsed to floats in dataset_fn). Here the debug path consumes CSV
+rows (lists of strings) from the CSV reader, matching the reference's
+string-row parsing."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+
+
+class IrisDnnModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = features["input"].reshape(features["input"].shape[0], -1)
+        return nn.Dense(3, name="output")(x)
+
+
+def custom_model():
+    return IrisDnnModel()
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1).astype(jnp.int32)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(predictions, labels)
+    )
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        # record: list/array of string fields (ODPS row / CSV row)
+        values = [float(v) for v in record]
+        features = {"input": np.asarray(values[0:-1], np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, np.asarray(values[-1], np.int32).reshape(())
+
+    return dataset.map(_parse)
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=1) == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    return {"input": (4,)}

@@ -1,0 +1,119 @@
+"""ResNet-50 building blocks in flax — rebuild of the reference's
+model_zoo/resnet50_subclass/resnet50_model.py (IdentityBlock / ConvBlock with
+BATCH_NORM_DECAY/EPSILON and L2 weight decay). TPU-idiomatic: NHWC layout so
+XLA tiles convs onto the MXU; L2 decay is applied in the optimizer
+(optax.add_decayed_weights) instead of per-layer kernel regularizers."""
+
+from flax import linen as nn
+
+L2_WEIGHT_DECAY = 1e-4
+BATCH_NORM_DECAY = 0.9
+BATCH_NORM_EPSILON = 1e-5
+
+
+class IdentityBlock(nn.Module):
+    """3-conv residual block whose shortcut is the identity
+    (reference resnet50_model.py IdentityBlock)."""
+
+    kernel_size: int
+    filters: tuple
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        f1, f2, f3 = self.filters
+
+        def bn(y):
+            return nn.BatchNorm(
+                use_running_average=not training,
+                momentum=BATCH_NORM_DECAY,
+                epsilon=BATCH_NORM_EPSILON,
+            )(y)
+
+        shortcut = x
+        y = nn.Conv(f1, (1, 1), use_bias=False)(x)
+        y = nn.relu(bn(y))
+        y = nn.Conv(
+            f2, (self.kernel_size, self.kernel_size), padding="SAME",
+            use_bias=False,
+        )(y)
+        y = nn.relu(bn(y))
+        y = nn.Conv(f3, (1, 1), use_bias=False)(y)
+        y = bn(y)
+        return nn.relu(y + shortcut)
+
+
+class ConvBlock(nn.Module):
+    """3-conv residual block with a strided conv shortcut
+    (reference resnet50_model.py ConvBlock)."""
+
+    kernel_size: int
+    filters: tuple
+    strides: tuple = (2, 2)
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        f1, f2, f3 = self.filters
+
+        def bn(y):
+            return nn.BatchNorm(
+                use_running_average=not training,
+                momentum=BATCH_NORM_DECAY,
+                epsilon=BATCH_NORM_EPSILON,
+            )(y)
+
+        y = nn.Conv(f1, (1, 1), strides=self.strides, use_bias=False)(x)
+        y = nn.relu(bn(y))
+        y = nn.Conv(
+            f2, (self.kernel_size, self.kernel_size), padding="SAME",
+            use_bias=False,
+        )(y)
+        y = nn.relu(bn(y))
+        y = nn.Conv(f3, (1, 1), use_bias=False)(y)
+        y = bn(y)
+        shortcut = nn.Conv(
+            f3, (1, 1), strides=self.strides, use_bias=False
+        )(x)
+        shortcut = bn(shortcut)
+        return nn.relu(y + shortcut)
+
+
+class ResNet50(nn.Module):
+    """Full ResNet-50 stack (reference resnet50_subclass.py CustomModel:
+    7x7/2 stem, maxpool, stages [3,4,6,3], global average pool, Dense)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, name="conv1",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not training,
+            momentum=BATCH_NORM_DECAY,
+            epsilon=BATCH_NORM_EPSILON,
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(
+            x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)]
+        )
+
+        x = ConvBlock(3, (64, 64, 256), strides=(1, 1))(x, training)
+        x = IdentityBlock(3, (64, 64, 256))(x, training)
+        x = IdentityBlock(3, (64, 64, 256))(x, training)
+
+        x = ConvBlock(3, (128, 128, 512))(x, training)
+        for _ in range(3):
+            x = IdentityBlock(3, (128, 128, 512))(x, training)
+
+        x = ConvBlock(3, (256, 256, 1024))(x, training)
+        for _ in range(5):
+            x = IdentityBlock(3, (256, 256, 1024))(x, training)
+
+        x = ConvBlock(3, (512, 512, 2048))(x, training)
+        x = IdentityBlock(3, (512, 512, 2048))(x, training)
+        x = IdentityBlock(3, (512, 512, 2048))(x, training)
+
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, name="fc1000")(x)

@@ -1,0 +1,73 @@
+"""ResNet-50 zoo entry — rebuild of the reference
+model_zoo/resnet50_subclass/resnet50_subclass.py (CustomModel over cifar-size
+images, num_classes=10, momentum SGD). L2 weight decay (reference: per-layer
+kernel regularizers, L2_WEIGHT_DECAY=1e-4) is folded into the optimizer as
+decoupled decay — the XLA-friendly equivalent."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from model_zoo.resnet50_subclass.resnet50_model import (
+    L2_WEIGHT_DECAY,
+    ResNet50,
+)
+
+
+from flax import linen as nn  # noqa: E402
+
+
+class CustomModel(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        return ResNet50(num_classes=self.num_classes, name="resnet50")(
+            features["image"], training
+        )
+
+
+def custom_model():
+    return CustomModel(num_classes=10)
+
+
+def loss(labels, predictions):
+    labels = labels.reshape(-1)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(predictions, labels)
+    )
+
+
+def optimizer(lr=0.02):
+    return optax.chain(
+        optax.add_decayed_weights(L2_WEIGHT_DECAY),
+        optax.sgd(lr, momentum=0.9),
+    )
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {"image": ex["image"].astype(np.float32) / 255.0}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex["label"].astype(np.int32)[0]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=1) == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    return {"image": (32, 32, 3)}

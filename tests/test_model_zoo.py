@@ -1,0 +1,151 @@
+"""End-to-end model-zoo coverage: every reference zoo family trains through
+the LocalExecutor on tiny synthetic data (mirrors the reference's
+example_test.py:94-174 in-process harness over mnist/cifar10/resnet50/
+deepfm/wide-deep/census/heart/iris/dac_ctr)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.api.local_executor import LocalExecutor
+from elasticdl_tpu.common.model_utils import get_model_spec
+from elasticdl_tpu.data import recordio_gen
+
+MODEL_ZOO = "model_zoo"
+
+
+def _run(spec_key, data_gen, tmp_path, minibatch=8, records=32,
+         model_params="", n_files=1, **gen_kwargs):
+    train_dir = str(tmp_path / "train")
+    val_dir = str(tmp_path / "val")
+    data_gen(train_dir, num_files=n_files, records_per_file=records,
+             **gen_kwargs)
+    data_gen(val_dir, num_files=1, records_per_file=records, seed=7,
+             **gen_kwargs)
+    spec = get_model_spec(MODEL_ZOO, spec_key)
+    executor = LocalExecutor(
+        spec,
+        training_data=train_dir,
+        validation_data=val_dir,
+        minibatch_size=minibatch,
+        num_epochs=1,
+        records_per_task=records,
+        model_params=model_params,
+    )
+    state, metrics = executor.run()
+    assert int(state.step) == (records * n_files) // minibatch
+    assert np.isfinite(executor.losses).all()
+    return metrics
+
+
+def test_mnist_subclass(tmp_path):
+    metrics = _run("mnist_subclass.mnist_subclass.custom_model",
+                   recordio_gen.gen_mnist_like, tmp_path)
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_cifar10_functional_api(tmp_path):
+    metrics = _run(
+        "cifar10_functional_api.cifar10_functional_api.custom_model",
+        recordio_gen.gen_cifar10_like, tmp_path)
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_cifar10_subclass(tmp_path):
+    metrics = _run("cifar10_subclass.cifar10_subclass.custom_model",
+                   recordio_gen.gen_cifar10_like, tmp_path)
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_deepfm_functional_api(tmp_path):
+    metrics = _run(
+        "deepfm_functional_api.deepfm_functional_api.custom_model",
+        recordio_gen.gen_frappe_like, tmp_path)
+    assert 0.0 <= metrics["logits_accuracy"] <= 1.0
+    assert 0.0 <= metrics["probs_auc"] <= 1.0
+
+
+def test_deepfm_edl_embedding(tmp_path):
+    metrics = _run(
+        "deepfm_edl_embedding.deepfm_edl_embedding.custom_model",
+        recordio_gen.gen_frappe_like, tmp_path)
+    assert 0.0 <= metrics["logits_accuracy"] <= 1.0
+
+
+@pytest.mark.parametrize("variant", [
+    "census_functional_api", "census_sequential", "census_subclass",
+])
+def test_census_dnn(tmp_path, variant):
+    metrics = _run("census_dnn_model.%s.custom_model" % variant,
+                   recordio_gen.gen_census_raw, tmp_path)
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_census_wide_deep(tmp_path):
+    metrics = _run(
+        "census_wide_deep_model.wide_deep_functional_api.custom_model",
+        recordio_gen.gen_census_raw, tmp_path)
+    assert 0.0 <= metrics["logits_accuracy"] <= 1.0
+    assert 0.0 <= metrics["probs_auc"] <= 1.0
+
+
+def test_heart_functional_api(tmp_path):
+    metrics = _run("heart_functional_api.heart_functional_api.custom_model",
+                   recordio_gen.gen_heart_like, tmp_path)
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_odps_iris_dnn_model(tmp_path):
+    train_dir = str(tmp_path / "train")
+    recordio_gen.gen_iris_csv(train_dir, num_files=1, rows_per_file=32)
+    spec = get_model_spec(
+        MODEL_ZOO, "odps_iris_dnn_model.odps_iris_dnn_model.custom_model"
+    )
+    executor = LocalExecutor(
+        spec, training_data=train_dir, minibatch_size=8,
+        num_epochs=1, records_per_task=32,
+    )
+    state, _ = executor.run()
+    assert int(state.step) == 4
+    assert np.isfinite(executor.losses).all()
+
+
+@pytest.mark.parametrize("ctr_model", [
+    "wide_deep", "deepfm", "dcn", "xdeepfm",
+])
+def test_dac_ctr(tmp_path, ctr_model):
+    metrics = _run(
+        "dac_ctr.elasticdl_train.custom_model",
+        recordio_gen.gen_criteo_like, tmp_path,
+        model_params=(
+            "ctr_model='%s'; max_hashing_bucket_size=997" % ctr_model
+        ),
+    )
+    assert 0.0 <= metrics["logits_accuracy"] <= 1.0
+    assert 0.0 <= metrics["probs_auc"] <= 1.0
+
+
+def test_resnet50_subclass(tmp_path):
+    metrics = _run("resnet50_subclass.resnet50_subclass.custom_model",
+                   recordio_gen.gen_cifar10_like, tmp_path,
+                   minibatch=4, records=8)
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_imagenet_resnet50_forward():
+    # full training at 224x224 is a TPU-scale job; on the CPU test rig we
+    # verify the model builds and produces 1000-way logits at a small size
+    import jax
+
+    from elasticdl_tpu.common.model_utils import get_model_spec as gms
+
+    spec = gms(MODEL_ZOO, "imagenet_resnet50.imagenet_resnet50.custom_model")
+    model = spec.model_fn()
+    feats = {"image": np.random.RandomState(0).rand(2, 64, 64, 3)
+             .astype(np.float32)}
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        feats, training=False,
+    )
+    out = model.apply(variables, feats, training=False)
+    assert out.shape == (2, 1000)
+    assert out.dtype == np.float32
