@@ -1,0 +1,28 @@
+"""TPU-native compute kernels (Pallas).
+
+The hot-op layer of the framework: where the reference backs its PS with
+C++ Eigen kernels driven from Go (go/pkg/kernel/capi/kernel_api.cc:6-96,
+go/pkg/kernel/kernel.go:14-199), this package provides the same optimizer
+kernel family as Pallas TPU kernels — dense whole-tensor updates and
+sparse row updates against an HBM-resident embedding table — plus the
+row-gather that replaces the reference's pull_embedding_vectors RPC.
+
+Every op has a pure-jnp reference path; Pallas kernels run compiled on TPU
+and in interpreter mode elsewhere (tests exercise both).
+"""
+
+from elasticdl_tpu.ops.dispatch import use_pallas  # noqa: F401
+from elasticdl_tpu.ops.embedding_ops import (  # noqa: F401
+    dedup_indexed_slices,
+    embedding_gather,
+    sparse_adagrad_update,
+    sparse_adam_update,
+    sparse_momentum_update,
+    sparse_sgd_update,
+)
+from elasticdl_tpu.ops.optimizer_kernels import (  # noqa: F401
+    adagrad_update,
+    adam_update,
+    momentum_update,
+    sgd_update,
+)

@@ -1,0 +1,26 @@
+"""Backend dispatch for Pallas kernels.
+
+Compiled Pallas requires a TPU; everywhere else (CPU tests, the virtual
+8-device mesh in tests/conftest.py) kernels run in Pallas interpreter mode
+so the exact same kernel code is what the tests verify.
+
+Env knobs:
+  ELASTICDL_TPU_DISABLE_PALLAS=1  force the pure-jnp reference paths
+  ELASTICDL_TPU_FORCE_INTERPRET=1 force interpreter mode even on TPU
+"""
+
+import os
+
+import jax
+
+
+def use_pallas():
+    """Whether call sites should route through the Pallas kernels at all."""
+    return os.environ.get("ELASTICDL_TPU_DISABLE_PALLAS", "") != "1"
+
+
+def interpret_mode():
+    """interpret= flag for pallas_call: compiled only on a real TPU."""
+    if os.environ.get("ELASTICDL_TPU_FORCE_INTERPRET", "") == "1":
+        return True
+    return jax.default_backend() != "tpu"

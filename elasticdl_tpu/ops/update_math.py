@@ -1,0 +1,56 @@
+"""The optimizer update rules themselves, as pure array functions.
+
+Single source of truth shared by the dense blocked kernels
+(optimizer_kernels.py), the sparse row kernels (embedding_ops.py), and the
+pure-jnp fallback paths — the same role kernel_api.cc plays for the
+reference's dense and sparse Go wrappers (go/pkg/kernel/kernel.go calls
+the one C function from both).
+
+Each function maps (param(s), slot(s), grad, hyperparams) → new values;
+inputs are arrays of any matching shape (a full tensor block or one row).
+"""
+
+import jax.numpy as jnp
+
+
+def sgd_math(p, g, lr):
+    return p - lr * g
+
+
+def momentum_math(p, v, g, lr, mu, nesterov):
+    """`nesterov` is a 0/1 float so the same code runs with traced
+    hyperparams inside kernels."""
+    v_new = mu * v + g
+    step = jnp.where(nesterov > 0, mu * v_new + g, v_new)
+    return p - lr * step, v_new
+
+
+def adam_math(p, m, v, g, alpha, b1, b2, eps):
+    """`alpha` is the bias-corrected step size
+    lr * sqrt(1 - b2^t) / (1 - b1^t), precomputed by adam_alpha()."""
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    p_new = p - alpha * m_new / (jnp.sqrt(v_new) + eps)
+    return p_new, m_new, v_new
+
+
+def adam_amsgrad_math(p, m, v, ms, g, alpha, b1, b2, eps):
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    ms_new = jnp.maximum(ms, v_new)
+    p_new = p - alpha * m_new / (jnp.sqrt(ms_new) + eps)
+    return p_new, m_new, v_new, ms_new
+
+
+def adam_alpha(lr, beta1, beta2, step):
+    """Bias-corrected Adam step size; `step` is the 1-based update count
+    and may be a traced array (Mosaic can't lower scalar powf, so this
+    runs outside the kernel)."""
+    t = jnp.asarray(step, jnp.float32)
+    return lr * jnp.sqrt(1.0 - beta2**t) / (1.0 - beta1**t)
+
+
+def adagrad_math(p, a, g, lr, eps):
+    a_new = a + g * g
+    p_new = p - lr * g / (jnp.sqrt(a_new) + eps)
+    return p_new, a_new
