@@ -1,0 +1,269 @@
+"""Attention ops: naive reference, blockwise (memory-efficient), and a
+Pallas flash-attention TPU kernel.
+
+The reference framework has no attention/sequence stack at all
+(SURVEY.md §5 "long-context: absent") — this is net-new TPU-first
+capability: the single-chip kernels here are the local compute of the
+ring/context-parallel attention in parallel/context_parallel.py, which
+shards the sequence axis over the `sp` mesh axis.
+
+Layout convention: [batch, heads, seq, head_dim].
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.ops.dispatch import interpret_mode, use_pallas
+
+_NEG_INF = -1e30
+NEG_INF = _NEG_INF  # masking constant shared with context_parallel
+
+
+def softmax_merge(o, l, m, s, v_blk):
+    """One online-softmax accumulation step: merge scores `s`
+    [b,h,q,k_blk] and values `v_blk` [b,h,k_blk,d] into the running
+    (output, denominator, rowmax) triple. Shared by blockwise_attention
+    and ring attention so the subtle numerics live once."""
+    m_new = jnp.maximum(m, s.max(-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(-1)
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+    return o_new, l_new, m_new
+
+
+def softmax_finalize(o, l):
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def naive_attention(q, k, v, causal=False, scale=None):
+    """Reference softmax(q k^T) v; O(L^2) memory. Test oracle and the
+    custom-vjp backward for the flash kernel."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        mask = (
+            jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        )
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512):
+    """Online-softmax attention via lax.scan over key blocks: O(L) memory,
+    differentiable, pure jnp (the fallback when the flash kernel can't
+    run). Matches naive_attention to float tolerance."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    block = min(block_size, lk)
+    if lk % block:
+        # pad keys; padded positions masked below via k_pos >= lk
+        pad = block - lk % block
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    n_blocks = k.shape[2] // block
+    k_blocks = k.reshape(b, h, n_blocks, block, d)
+    v_blocks = v.reshape(b, h, n_blocks, block, d)
+    q_scaled = q * scale
+    q_pos = jnp.arange(lq)
+
+    def step(carry, inputs):
+        o, l, m = carry
+        kb, vb, kb_idx = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, kb)
+        k_pos = kb_idx * block + jnp.arange(block)
+        valid = k_pos < lk
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (lq, block))
+        s = jnp.where(valid[None, None], s, _NEG_INF)
+        return softmax_merge(o, l, m, s, vb), None
+
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros((b, h, lq), q.dtype)
+    m0 = jnp.full((b, h, lq), _NEG_INF, q.dtype)
+    (o, l, m), _ = jax.lax.scan(
+        step,
+        (o0, l0, m0),
+        (
+            jnp.moveaxis(k_blocks, 2, 0),
+            jnp.moveaxis(v_blocks, 2, 0),
+            jnp.arange(n_blocks),
+        ),
+    )
+    return softmax_finalize(o, l)
+
+
+# --------------------------------------------------------- flash kernel
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, scale, causal, block_q, block_k, n_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip key blocks that lie entirely after this query block
+    run = (
+        qi * block_q + block_q - 1 >= ki * block_k if causal else True
+    )
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0] * scale
+        s = jax.lax.dot_general(
+            q, k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * corr + p.sum(-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        o_ref[0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, lq, d)
+    k3 = k.reshape(bh, lk, d)
+    v3 = v.reshape(bh, lk, d)
+    n_q = lq // block_q
+    n_k = lk // block_k
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        n_k=n_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d),
+                lambda i, j, t: (i, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda i, j, t: (i, t, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda i, j, t: (i, t, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d),
+            lambda i, j, t: (i, j, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret_mode() if interpret is None else interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, lq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    # flash backward = recompute: vjp of the O(L)-memory blockwise path
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Tiled online-softmax attention (Pallas). head_dim is zero-padded
+    to the 128-lane width (zeros don't change q·k or add output columns
+    that survive the final slice); falls back to blockwise_attention when
+    Pallas is disabled or the sequence doesn't tile into the blocks."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    tiles = (
+        lq % block_q == 0 and lk % block_k == 0
+        and block_q % 8 == 0 and block_k % 8 == 0
+    )
+    if not (use_pallas() and tiles):
+        if use_pallas():
+            logger.debug(
+                "flash_attention falling back to blockwise: seq (%d, %d) "
+                "does not tile into (%d, %d) blocks",
+                lq, lk, block_q, block_k,
+            )
+        return blockwise_attention(q, k, v, causal=causal, scale=scale)
+    if d % 128:
+        pad = 128 - d % 128
+        widths = ((0, 0), (0, 0), (0, 0), (0, pad))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    out = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out[..., :d]

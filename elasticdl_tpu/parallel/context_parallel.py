@@ -1,0 +1,96 @@
+"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+
+The reference has no long-context story (SURVEY.md §5: no ring attention,
+no sequence parallelism anywhere in the tree); this module is the
+TPU-native design the rebuild reserves the `sp` axis for: the sequence
+axis of q/k/v is sharded over `sp`, each device computes its query
+shard's attention against the key/value shard it currently holds, and
+key/value shards rotate around the ring with `jax.lax.ppermute` (ICI
+neighbor exchange) while partial softmax results merge online — the
+all-gather of the full sequence never materializes.
+
+Works inside `jit` via `shard_map`; differentiable (ppermute has a
+transpose rule), so the same code path trains.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.common.constants import MeshAxis
+from elasticdl_tpu.ops.attention import (
+    NEG_INF as _NEG_INF,
+    softmax_finalize,
+    softmax_merge,
+)
+
+
+def ring_attention_local(q, k, v, axis_name, causal=False, scale=None):
+    """Per-device body: q/k/v are the local sequence shards
+    [batch, heads, local_len, dim]. Call inside shard_map/pjit with a
+    named `axis_name` axis; returns the local output shard."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    q_scaled = q * scale
+    q_pos = my * lq + jnp.arange(lq)
+    perm = [((j + 1) % size, j) for j in range(size)]
+
+    def merge_shard(o, l, m, k_cur, v_cur, i):
+        # after i rotations device `my` holds the shard born on my+i
+        src = (my + i) % size
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, k_cur)
+        if causal:
+            k_pos = src * lk + jnp.arange(lk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        return softmax_merge(o, l, m, s, v_cur)
+
+    def step(carry, i):
+        o, l, m, k_cur, v_cur = carry
+        o, l, m = merge_shard(o, l, m, k_cur, v_cur, i)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, l, m, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros((b, h, lq), q.dtype)
+    m0 = jnp.full((b, h, lq), _NEG_INF, q.dtype)
+    # the last shard's rotation would be discarded — merge it outside the
+    # scan so each step pays exactly the ppermutes it uses
+    (o, l, m, k_last, v_last), _ = jax.lax.scan(
+        step, (o0, l0, m0, k, v), jnp.arange(size - 1)
+    )
+    o, l, m = merge_shard(o, l, m, k_last, v_last, size - 1)
+    return softmax_finalize(o, l)
+
+
+def ring_attention(q, k, v, mesh, causal=False, scale=None,
+                   seq_axis=MeshAxis.SP, batch_axes=(MeshAxis.DP,
+                                                     MeshAxis.FSDP)):
+    """Global-view ring attention: q/k/v are [batch, heads, seq, dim]
+    arrays (sharded or not); the sequence axis is laid out over
+    `seq_axis` and batch over `batch_axes`, and XLA inserts only the
+    ring ppermutes — no full-sequence gather.
+
+    With an sp=1 mesh this degenerates to one shard_map program == plain
+    attention.
+    """
+    spec = P(batch_axes, None, seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(
+            ring_attention_local,
+            axis_name=seq_axis,
+            causal=causal,
+            scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -110,3 +110,18 @@ def replicated(mesh):
 def local_mesh():
     """A 1-device mesh (single-chip / local-executor path)."""
     return build_mesh({MeshAxis.DP: 1}, devices=jax.devices()[:1])
+
+
+def current_mesh():
+    """The Mesh active via `with mesh:` (how model code — e.g. the
+    transformer's attention — discovers the sp axis at trace time inside
+    the Trainer's compiled step), or None outside any mesh context."""
+    try:
+        from jax._src.mesh import thread_resources
+    except ImportError:  # older jax
+        from jax.interpreters.pxla import thread_resources
+
+    mesh = thread_resources.env.physical_mesh
+    if mesh is None or mesh.empty:
+        return None
+    return mesh

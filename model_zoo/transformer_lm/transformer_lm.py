@@ -1,0 +1,135 @@
+"""Causal transformer language model — the long-context flagship.
+
+The reference zoo has no sequence model (its largest config is
+ResNet50); this family exercises the capabilities the TPU rebuild adds
+on top of reference parity: flash attention on one chip and ring
+attention over the `sp` mesh axis for sequences that don't fit a single
+device (parallel/context_parallel.py). Same zoo spec surface as every
+other family (custom_model/loss/optimizer/dataset_fn/eval_metrics_fn).
+
+Records are token sequences; the training pair is (tokens[:-1] →
+tokens[1:]) built in dataset_fn, so seq_len below is the model's input
+length and records carry seq_len + 1 tokens.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import MeshAxis, Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.ops.attention import flash_attention
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.parallel.context_parallel import ring_attention
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        b, l, e = x.shape
+        h, d = self.num_heads, self.head_dim
+        qkv = nn.Dense(3 * h * d, use_bias=False, name="qkv")(x)
+        qkv = qkv.reshape(b, l, 3, h, d).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
+        mesh = mesh_lib.current_mesh()
+        if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:
+            out = ring_attention(q, k, v, mesh, causal=True)
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
+        return nn.Dense(e, use_bias=False, name="proj")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        e = x.shape[-1]
+        y = nn.LayerNorm()(x)
+        x = x + CausalSelfAttention(self.num_heads, self.head_dim)(
+            y, training
+        )
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(self.mlp_ratio * e)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(e)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 256
+    seq_len: int = 128
+    embed_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        tokens = features["tokens"]  # int32 [b, seq_len]
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="wte")(tokens)
+        pos = nn.Embed(self.seq_len, self.embed_dim, name="wpe")(
+            jnp.arange(tokens.shape[1])[None, :]
+        )
+        x = x + pos
+        head_dim = self.embed_dim // self.num_heads
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, head_dim, name="block_%d" % i)(
+                x, training
+            )
+        x = nn.LayerNorm(name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, name="head")(x)
+
+
+def custom_model(**kwargs):
+    return TransformerLM(**kwargs)
+
+
+def loss(labels, predictions, sample_weights=None):
+    # labels [b, l] int, predictions [b, l, vocab]
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels
+    ).mean(axis=-1)
+    if sample_weights is None:
+        return jnp.mean(ce)
+    return jnp.sum(ce * sample_weights) / jnp.maximum(
+        jnp.sum(sample_weights), 1.0
+    )
+
+
+def optimizer(lr=3e-4):
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        ex = decode_example(record)
+        tokens = ex["tokens"].astype(np.int32)
+        features = {"tokens": tokens[:-1]}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, tokens[1:]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "token_accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=-1)
+            == np.asarray(labels)
+        ).astype(np.float32).reshape(len(labels), -1).mean(axis=1)
+    }
+
+
+def feature_shapes(seq_len=128):
+    return {"tokens": (seq_len,)}
