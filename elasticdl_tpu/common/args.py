@@ -1,0 +1,268 @@
+"""Declarative argparse flag system for master/worker processes and the
+client CLI.
+
+Parity with the reference's three-layer arg stack
+(elasticdl_client/common/args.py + elasticdl/python/common/args.py,
+~817 LoC): the same declarative adders, the same propagation model —
+parsed args are RE-SERIALIZED into child command lines
+(`build_arguments_from_parsed_result`, reference
+elasticdl_client/common/args.py:519-567, used by the master to build
+worker pod commands at master/master.py:398-496) — minus the PS flag
+groups (no parameter servers on TPU) plus the mesh/sharding flags the
+TPU runtime adds.
+"""
+
+import argparse
+
+# master-only flags that must not propagate into worker command lines
+MASTER_ONLY_ARGS = {
+    "port", "num_workers", "worker_image", "namespace",
+    "worker_pod_priority", "worker_resource_request",
+    "worker_resource_limit", "relaunch_on_worker_failure",
+    "disable_relaunch", "task_timeout_check_interval", "cluster_spec",
+    "image_pull_policy", "restart_policy", "volume", "need_tensorboard",
+    "tensorboard_log_dir", "export_saved_model",
+}
+
+
+def pos_int(arg):
+    res = int(arg)
+    if res <= 0:
+        raise ValueError("Positive integer argument required, got %s" % res)
+    return res
+
+
+def non_neg_int(arg):
+    res = int(arg)
+    if res < 0:
+        raise ValueError(
+            "Non-negative integer argument required, got %s" % res
+        )
+    return res
+
+
+def add_bool_param(parser, name, default, help):
+    parser.add_argument(
+        name,
+        nargs="?",
+        const=not default,
+        default=default,
+        type=lambda x: x.lower() in ["true", "yes", "t", "y"],
+        help=help,
+    )
+
+
+def add_common_params(parser):
+    """Flags shared by client, master and worker (reference
+    add_common_params, elasticdl_client/common/args.py)."""
+    parser.add_argument(
+        "--job_name", default="elasticdl-job", help="Job name"
+    )
+    parser.add_argument(
+        "--model_zoo", required=True,
+        help="Directory containing the model-zoo modules",
+    )
+    parser.add_argument(
+        "--model_def", required=True,
+        help="Dotted path to the model function inside the zoo, e.g. "
+             "mnist_functional_api.mnist_functional_api.custom_model",
+    )
+    parser.add_argument(
+        "--model_params", default="",
+        help="Model constructor kwargs, 'k1=v1; k2=v2'",
+    )
+    parser.add_argument("--minibatch_size", type=pos_int, default=32)
+    parser.add_argument("--num_epochs", type=pos_int, default=1)
+    parser.add_argument(
+        "--records_per_task", type=pos_int, default=256,
+        help="Records per dynamic-sharding task",
+    )
+    parser.add_argument("--training_data", default="")
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument("--prediction_data", default="")
+    parser.add_argument(
+        "--data_reader_params", default="",
+        help="Data reader kwargs, 'k1=v1; k2=v2'",
+    )
+    parser.add_argument("--evaluation_steps", type=non_neg_int, default=0)
+    parser.add_argument(
+        "--eval_start_delay_secs", type=non_neg_int, default=0
+    )
+    parser.add_argument("--eval_throttle_secs", type=non_neg_int, default=0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
+    parser.add_argument(
+        "--keep_checkpoint_max", type=non_neg_int, default=0
+    )
+    parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument(
+        "--output", default="",
+        help="Directory for the exported model at train end",
+    )
+    parser.add_argument(
+        "--mesh_spec", default="",
+        help="Device mesh axis sizes, e.g. 'dp=4,sp=2' (-1 fills)",
+    )
+    parser.add_argument(
+        "--distribution_strategy", default="Local",
+        choices=["Local", "AllreduceStrategy"],
+        help="Local = single process; AllreduceStrategy = SPMD lockstep "
+             "over jax.distributed (the reference's allreduce path)",
+    )
+    parser.add_argument("--log_level", default="INFO")
+    parser.add_argument("--seed", type=int, default=0)
+    add_bool_param(
+        parser, "--use_go_ps", False,
+        help="Accepted for reference CLI compatibility; ignored (there "
+             "is no parameter server on TPU)",
+    )
+
+
+def add_master_params(parser):
+    parser.add_argument("--port", type=non_neg_int, default=50001)
+    parser.add_argument("--num_workers", type=non_neg_int, default=0)
+    parser.add_argument(
+        "--worker_image", default="", help="Worker container image"
+    )
+    parser.add_argument(
+        "--namespace", default="default", help="Kubernetes namespace"
+    )
+    parser.add_argument(
+        "--worker_pod_priority", default="",
+        help="Priority class for worker pods; 'high=0.5' makes half the "
+             "workers high-priority (reference "
+             "k8s_instance_manager.py _parse_worker_pod_priority)",
+    )
+    parser.add_argument(
+        "--worker_resource_request",
+        default="cpu=1,memory=4096Mi",
+        help="Worker resource requests, 'cpu=N,memory=XMi,google.com/tpu=N'",
+    )
+    parser.add_argument("--worker_resource_limit", default="")
+    parser.add_argument(
+        "--relaunch_on_worker_failure", type=non_neg_int, default=3,
+        help="Max relaunches per worker pod",
+    )
+    add_bool_param(
+        parser, "--disable_relaunch", False,
+        help="Never relaunch failed workers",
+    )
+    parser.add_argument(
+        "--task_timeout_check_interval", type=pos_int, default=30
+    )
+    parser.add_argument(
+        "--cluster_spec", default="",
+        help="Python module customizing pod manifests before creation",
+    )
+    parser.add_argument(
+        "--image_pull_policy", default="Always",
+        choices=["Always", "IfNotPresent", "Never"],
+    )
+    parser.add_argument("--restart_policy", default="Never")
+    parser.add_argument(
+        "--volume", default="",
+        help="Host volume spec 'host_path=/a,mount_path=/b'",
+    )
+    add_bool_param(
+        parser, "--need_tensorboard", False,
+        help="Start a TensorBoard service on the master",
+    )
+    parser.add_argument("--tensorboard_log_dir", default="")
+    add_bool_param(
+        parser, "--export_saved_model", False,
+        help="Export the model at train end via the TRAIN_END_CALLBACK "
+             "task",
+    )
+
+
+def add_worker_params(parser):
+    parser.add_argument("--worker_id", type=non_neg_int, required=True)
+    parser.add_argument(
+        "--master_addr", required=True, help="host:port of the master"
+    )
+    parser.add_argument(
+        "--job_type", default="training_only",
+        choices=[
+            "training_only",
+            "training_with_evaluation",
+            "evaluation_only",
+            "prediction_only",
+        ],
+    )
+    parser.add_argument(
+        "--num_minibatches_per_task", type=pos_int, default=8
+    )
+    parser.add_argument(
+        "--coordinator_addr", default="",
+        help="jax.distributed coordinator (multi-host SPMD)",
+    )
+    parser.add_argument(
+        "--num_processes", type=non_neg_int, default=0,
+        help="jax.distributed world size (multi-host SPMD)",
+    )
+    parser.add_argument(
+        "--process_id", type=non_neg_int, default=0,
+        help="jax.distributed process index",
+    )
+
+
+def parse_master_args(args=None):
+    parser = argparse.ArgumentParser(description="ElasticDL-TPU master")
+    add_common_params(parser)
+    add_master_params(parser)
+    parsed, unknown = parser.parse_known_args(args=args)
+    if unknown:
+        import warnings
+
+        warnings.warn("Unknown master args: %s" % unknown)
+    return parsed
+
+
+def parse_worker_args(args=None):
+    parser = argparse.ArgumentParser(description="ElasticDL-TPU worker")
+    add_common_params(parser)
+    add_worker_params(parser)
+    parsed, unknown = parser.parse_known_args(args=args)
+    if unknown:
+        import warnings
+
+        warnings.warn("Unknown worker args: %s" % unknown)
+    return parsed
+
+
+def build_arguments_from_parsed_result(args, filter_args=None):
+    """Reconstruct the command-line list from a parsed namespace — how
+    flags propagate master → worker pods (reference
+    elasticdl_client/common/args.py:519-545)."""
+    items = vars(args).items()
+    if filter_args:
+        items = [(k, v) for k, v in items if k not in filter_args]
+    arguments = []
+    for key, value in sorted(items):
+        if value is None or value == "":
+            continue
+        if isinstance(value, bool):
+            value = "true" if value else "false"
+        arguments.extend(["--" + key, str(value)])
+    return arguments
+
+
+def wrap_args_with_string(arguments):
+    """Shell-quote an argument list into one string (reference
+    wrap_python_args_with_string, args.py:548-559)."""
+    import shlex
+
+    return " ".join(shlex.quote(a) for a in arguments)
+
+
+def parse_resource_spec(spec):
+    """'cpu=1,memory=4096Mi,google.com/tpu=8' → dict (reference
+    common/k8s_resource.py parse)."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = v.strip()
+    return out

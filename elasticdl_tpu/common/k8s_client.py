@@ -1,0 +1,301 @@
+"""Kubernetes client: pod/service lifecycle for the elastic master.
+
+Parity with the reference's master-side client (common/k8s_client.py:
+29-329): worker pod CRUD with owner references to the master pod, a
+watch-stream thread feeding pod events to a callback, per-replica
+services, master-pod labels as job status. TPU-native deltas: replicas
+are TPU-VM worker pods (resource key `google.com/tpu`), and there are no
+PS pods or FTLib gossip services to manage.
+
+The `kubernetes` package import is gated: construction takes an optional
+`core_api` (anything with the CoreV1Api surface), which is how unit
+tests drive the client without a cluster — the reference mocks the same
+boundary (k8s_client_test.py).
+
+Pod manifests are plain dicts (the k8s API accepts them verbatim), so
+nothing here needs the kubernetes model classes.
+"""
+
+import threading
+import traceback
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+ELASTICDL_APP_NAME = "elasticdl"
+ELASTICDL_JOB_KEY = "elasticdl-job-name"
+ELASTICDL_REPLICA_TYPE_KEY = "elasticdl-replica-type"
+ELASTICDL_REPLICA_INDEX_KEY = "elasticdl-replica-index"
+
+_SERVICE_PORT = {"worker": 3333, "master": 50001}
+
+
+def get_master_pod_name(job_name):
+    return "elasticdl-%s-master" % job_name
+
+
+class Client(object):
+    def __init__(
+        self,
+        *,
+        image_name,
+        namespace,
+        job_name,
+        event_callback=None,
+        cluster_spec="",
+        core_api=None,
+    ):
+        self.image_name = image_name
+        self.namespace = namespace
+        self.job_name = job_name
+        self._event_cb = event_callback
+        self._cluster_spec = cluster_spec
+        self._watch_thread = None
+        self._stopped = threading.Event()
+        if core_api is not None:
+            self.client = core_api
+        else:
+            self.client = self._load_core_api()
+        if self._event_cb:
+            self._watch_thread = threading.Thread(
+                target=self._watch, name="event_watcher", daemon=True
+            )
+            self._watch_thread.start()
+
+    @staticmethod
+    def _load_core_api():
+        try:
+            from kubernetes import client as k8s_client
+            from kubernetes import config
+        except ImportError as e:
+            raise RuntimeError(
+                "The kubernetes package is not installed; pass core_api= "
+                "or use the local instance manager"
+            ) from e
+        try:
+            config.load_incluster_config()
+        except Exception:
+            config.load_kube_config()
+        return k8s_client.CoreV1Api()
+
+    # ------------------------------------------------------------- watch
+
+    def _watch(self):
+        """Stream pod events for this job to the callback (reference
+        Client._watch, k8s_client.py:82-96)."""
+        from kubernetes import watch
+
+        label_selector = "%s=%s" % (ELASTICDL_JOB_KEY, self.job_name)
+        while not self._stopped.is_set():
+            try:
+                stream = watch.Watch().stream(
+                    self.client.list_namespaced_pod,
+                    self.namespace,
+                    label_selector=label_selector,
+                )
+                for event in stream:
+                    if self._stopped.is_set():
+                        break
+                    self._event_cb(event)
+            except Exception:
+                if not self._stopped.is_set():
+                    traceback.print_exc()
+                    # don't busy-spin when the API server is unreachable
+                    self._stopped.wait(3.0)
+
+    def stop(self):
+        self._stopped.set()
+
+    # -------------------------------------------------------------- names
+
+    def get_master_pod_name(self):
+        return get_master_pod_name(self.job_name)
+
+    def get_worker_pod_name(self, worker_id):
+        return "elasticdl-%s-worker-%s" % (self.job_name, worker_id)
+
+    def get_worker_service_name(self, worker_id):
+        return self.get_worker_pod_name(worker_id)
+
+    # ------------------------------------------------------------ get/del
+
+    def get_master_pod(self):
+        return self.get_pod(self.get_master_pod_name())
+
+    def get_pod(self, pod_name):
+        try:
+            return self.client.read_namespaced_pod(
+                namespace=self.namespace, name=pod_name
+            )
+        except Exception as e:
+            logger.warning("Exception in read_namespaced_pod: %s", e)
+            return None
+
+    def delete_pod(self, pod_name):
+        self.client.delete_namespaced_pod(
+            pod_name,
+            self.namespace,
+            body={"propagationPolicy": "Foreground"},
+        )
+
+    def delete_worker(self, worker_id):
+        self.delete_pod(self.get_worker_pod_name(worker_id))
+
+    # ------------------------------------------------------------- create
+
+    def _owner_reference(self):
+        """Owner ref to the master pod so worker pods are GC'd with it
+        (reference create_owner_reference, k8s_client.py)."""
+        master = self.get_master_pod()
+        if master is None:
+            return []
+        meta = (
+            master["metadata"]
+            if isinstance(master, dict)
+            else master.metadata
+        )
+        name = meta["name"] if isinstance(meta, dict) else meta.name
+        uid = meta["uid"] if isinstance(meta, dict) else meta.uid
+        return [
+            {
+                "apiVersion": "v1",
+                "blockOwnerDeletion": True,
+                "kind": "Pod",
+                "name": name,
+                "uid": uid,
+            }
+        ]
+
+    def _pod_manifest(
+        self,
+        *,
+        pod_name,
+        replica_type,
+        replica_index,
+        command,
+        args,
+        resource_requests,
+        resource_limits,
+        priority_class=None,
+        restart_policy="Never",
+        image_pull_policy="Always",
+        envs=None,
+        volume=None,
+        image_name=None,
+    ):
+        container = {
+            "name": pod_name,
+            "image": image_name or self.image_name,
+            "command": list(command or []),
+            "args": list(args or []),
+            "imagePullPolicy": image_pull_policy,
+            "resources": {
+                "requests": dict(resource_requests or {}),
+                "limits": dict(
+                    resource_limits or resource_requests or {}
+                ),
+            },
+            "env": [
+                {"name": k, "value": str(v)}
+                for k, v in (envs or {}).items()
+            ],
+        }
+        spec = {
+            "containers": [container],
+            "restartPolicy": restart_policy,
+        }
+        if priority_class:
+            spec["priorityClassName"] = priority_class
+        if volume:
+            spec["volumes"] = [
+                {
+                    "name": "elasticdl-volume",
+                    "hostPath": {"path": volume["host_path"]},
+                }
+            ]
+            container["volumeMounts"] = [
+                {
+                    "name": "elasticdl-volume",
+                    "mountPath": volume["mount_path"],
+                }
+            ]
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_name,
+                "labels": {
+                    "app": ELASTICDL_APP_NAME,
+                    ELASTICDL_JOB_KEY: self.job_name,
+                    ELASTICDL_REPLICA_TYPE_KEY: replica_type,
+                    ELASTICDL_REPLICA_INDEX_KEY: str(replica_index),
+                },
+                "ownerReferences": self._owner_reference(),
+            },
+            "spec": spec,
+        }
+
+    def create_worker_pod(self, worker_id, **kwargs):
+        manifest = self._pod_manifest(
+            pod_name=self.get_worker_pod_name(worker_id),
+            replica_type="worker",
+            replica_index=worker_id,
+            **kwargs,
+        )
+        if self._cluster_spec:
+            manifest = self._apply_cluster_spec(manifest)
+        return self.client.create_namespaced_pod(self.namespace, manifest)
+
+    def _apply_cluster_spec(self, manifest):
+        """Load the user cluster-spec module and let it patch the pod
+        manifest (reference cluster spec hook)."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "cluster_spec", self._cluster_spec
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        if hasattr(module, "with_pod"):
+            return module.with_pod(manifest)
+        return manifest
+
+    def create_worker_service(self, worker_id):
+        """Per-replica service so a relaunched worker keeps its address
+        (reference create_service, k8s_client.py; ports at :29-31)."""
+        name = self.get_worker_service_name(worker_id)
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "labels": {
+                    "app": ELASTICDL_APP_NAME,
+                    ELASTICDL_JOB_KEY: self.job_name,
+                },
+                "ownerReferences": self._owner_reference(),
+            },
+            "spec": {
+                "selector": {
+                    ELASTICDL_JOB_KEY: self.job_name,
+                    ELASTICDL_REPLICA_TYPE_KEY: "worker",
+                    ELASTICDL_REPLICA_INDEX_KEY: str(worker_id),
+                },
+                "ports": [
+                    {"port": _SERVICE_PORT["worker"], "protocol": "TCP"}
+                ],
+                "clusterIP": "None",
+            },
+        }
+        return self.client.create_namespaced_service(
+            self.namespace, manifest
+        )
+
+    # ------------------------------------------------------------- status
+
+    def update_master_label(self, status):
+        """Reflect job status as a master-pod label (reference: master
+        pod labels carry status for the CLI job monitor)."""
+        body = {"metadata": {"labels": {"status": status}}}
+        self.client.patch_namespaced_pod(
+            self.get_master_pod_name(), self.namespace, body
+        )

@@ -1,0 +1,147 @@
+"""Master process entrypoint (reference master/main.py:20-24 +
+Master._create_instance_manager, master.py:387-534): parse flags, build
+the Master with an instance manager, reconstruct worker command lines
+from the parsed args, serve until the job finishes."""
+
+import sys
+
+from elasticdl_tpu.common.args import (
+    MASTER_ONLY_ARGS,
+    build_arguments_from_parsed_result,
+    parse_master_args,
+    parse_resource_spec,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.model_utils import get_model_spec
+from elasticdl_tpu.master.master import Master
+
+def _infer_job_type(args):
+    if args.prediction_data and not args.training_data:
+        return "prediction_only"
+    if args.validation_data and not args.training_data:
+        return "evaluation_only"
+    if args.validation_data:
+        return "training_with_evaluation"
+    return "training_only"
+
+
+def build_worker_args(args, master_addr):
+    worker_args = build_arguments_from_parsed_result(
+        args, filter_args=MASTER_ONLY_ARGS
+    )
+    worker_args += [
+        "--master_addr", master_addr,
+        "--job_type", _infer_job_type(args),
+    ]
+    return worker_args
+
+
+def create_instance_manager(args, task_d, master_port):
+    """K8s pods when a worker image is configured, local subprocesses
+    otherwise (the no-cluster path)."""
+    if args.num_workers <= 0:
+        return None
+    if args.worker_image:
+        from elasticdl_tpu.common.k8s_client import (
+            Client,
+            get_master_pod_name,
+        )
+        from elasticdl_tpu.master.instance_manager import (
+            K8sInstanceManager,
+        )
+
+        # worker pods dial the master pod by its stable in-cluster name,
+        # never localhost (that would be the worker's own netns)
+        worker_args = build_worker_args(
+            args,
+            "%s:%d" % (get_master_pod_name(args.job_name), master_port),
+        )
+        manager_holder = {}
+
+        def event_cb(event):
+            manager = manager_holder.get("m")
+            if manager is not None:
+                manager.event_cb(event)
+
+        client = Client(
+            image_name=args.worker_image,
+            namespace=args.namespace,
+            job_name=args.job_name,
+            event_callback=event_cb,
+            cluster_spec=args.cluster_spec,
+        )
+        volume = None
+        if args.volume:
+            volume = parse_resource_spec(args.volume)
+        manager = K8sInstanceManager(
+            task_d,
+            num_workers=args.num_workers,
+            worker_command=["python", "-m", "elasticdl_tpu.worker.main"],
+            worker_args=worker_args,
+            k8s_client=client,
+            resource_request=parse_resource_spec(
+                args.worker_resource_request
+            ),
+            resource_limit=parse_resource_spec(args.worker_resource_limit),
+            pod_priority=args.worker_pod_priority,
+            restart_policy=args.restart_policy,
+            image_pull_policy=args.image_pull_policy,
+            volume=volume,
+            relaunch_on_worker_failure=args.relaunch_on_worker_failure,
+            disable_relaunch=args.disable_relaunch,
+        )
+        manager_holder["m"] = manager
+        return manager
+    from elasticdl_tpu.master.instance_manager import LocalInstanceManager
+
+    return LocalInstanceManager(
+        task_d,
+        num_workers=args.num_workers,
+        worker_args=build_worker_args(
+            args, "localhost:%d" % master_port
+        ),
+        relaunch_on_worker_failure=args.relaunch_on_worker_failure,
+        disable_relaunch=args.disable_relaunch,
+    )
+
+
+def main(argv=None):
+    args = parse_master_args(argv)
+    spec = get_model_spec(args.model_zoo, args.model_def)
+    callbacks_list = None
+    if spec.callbacks_fn is not None:
+        from elasticdl_tpu.api.callbacks import CallbackList
+
+        callbacks_list = CallbackList(spec.callbacks_fn())
+
+    master = Master(
+        spec,
+        training_data=args.training_data or None,
+        validation_data=args.validation_data or None,
+        prediction_data=args.prediction_data or None,
+        minibatch_size=args.minibatch_size,
+        records_per_task=args.records_per_task,
+        num_epochs=args.num_epochs,
+        evaluation_steps=args.evaluation_steps,
+        eval_start_delay_secs=args.eval_start_delay_secs,
+        eval_throttle_secs=args.eval_throttle_secs,
+        port=args.port,
+        task_timeout_check_interval=args.task_timeout_check_interval,
+        callbacks_list=callbacks_list,
+        export_saved_model=args.export_saved_model,
+    )
+    # gRPC port is bound in prepare(); the instance manager needs the
+    # final address, so wire it afterwards.
+    master.prepare()
+    instance_manager = create_instance_manager(
+        args, master.task_d, master.port
+    )
+    master.instance_manager = instance_manager
+    if instance_manager:
+        instance_manager.start_workers()
+    logger.info("Master ready on port %d", master.port)
+    return master.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

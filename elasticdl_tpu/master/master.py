@@ -93,7 +93,6 @@ class Master(object):
             minibatch_size,
             self.task_d,
             evaluation_service=self.evaluation_service,
-            instance_manager=instance_manager,
         )
         self.instance_manager = instance_manager
         self._port = port

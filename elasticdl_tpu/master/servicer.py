@@ -17,14 +17,12 @@ from elasticdl_tpu.proto.convert import TASK_TYPE_TO_PB as _TASK_TYPE_TO_PB
 
 
 class MasterServicer(object):
-    def __init__(self, minibatch_size, task_d, evaluation_service=None,
-                 instance_manager=None):
+    def __init__(self, minibatch_size, task_d, evaluation_service=None):
         self._task_d = task_d
         self._lock = threading.Lock()
         self._minibatch_size = minibatch_size
         self._version = 0
         self._evaluation_service = evaluation_service
-        self._instance_manager = instance_manager
         self._task_complete_times = {
             TaskType.TRAINING: [],
             TaskType.EVALUATION: [],

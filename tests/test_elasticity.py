@@ -1,0 +1,383 @@
+"""Elasticity core tests: k8s client manifests, instance-manager event
+machine (relaunch policy, preemption, task recovery), watchdog wiring,
+args round-trip — the same boundaries the reference mocks
+(k8s_client_test.py, k8s_instance_manager_test.py)."""
+
+import threading
+
+import pytest
+
+from elasticdl_tpu.common.args import (
+    MASTER_ONLY_ARGS,
+    build_arguments_from_parsed_result,
+    parse_master_args,
+    parse_resource_spec,
+    parse_worker_args,
+    wrap_args_with_string,
+)
+from elasticdl_tpu.common.k8s_client import Client
+from elasticdl_tpu.master.instance_manager import (
+    K8sInstanceManager,
+    parse_worker_pod_priority,
+)
+
+
+class FakeCoreApi(object):
+    """Records API calls; returns dict pods like the real API would."""
+
+    def __init__(self):
+        self.created_pods = []
+        self.deleted = []
+        self.services = []
+
+    def create_namespaced_pod(self, namespace, manifest):
+        self.created_pods.append((namespace, manifest))
+        return manifest
+
+    def delete_namespaced_pod(self, name, namespace, body=None):
+        self.deleted.append(name)
+
+    def read_namespaced_pod(self, namespace, name):
+        return {
+            "metadata": {"name": name, "uid": "uid-%s" % name},
+        }
+
+    def create_namespaced_service(self, namespace, manifest):
+        self.services.append((namespace, manifest))
+        return manifest
+
+    def patch_namespaced_pod(self, name, namespace, body):
+        return body
+
+
+class FakeTaskDispatcher(object):
+    def __init__(self):
+        self.recovered = []
+
+    def recover_tasks(self, worker_id):
+        self.recovered.append(worker_id)
+
+
+def _client(api=None):
+    return Client(
+        image_name="img:latest",
+        namespace="ns",
+        job_name="testjob",
+        core_api=api or FakeCoreApi(),
+    )
+
+
+def _manager(api=None, task_d=None, **kwargs):
+    api = api or FakeCoreApi()
+    task_d = task_d or FakeTaskDispatcher()
+    manager = K8sInstanceManager(
+        task_d,
+        num_workers=kwargs.pop("num_workers", 2),
+        worker_command=["python", "-m", "elasticdl_tpu.worker.main"],
+        worker_args=["--master_addr", "localhost:1234"],
+        k8s_client=_client(api),
+        resource_request={"cpu": "1", "memory": "4096Mi"},
+        **kwargs,
+    )
+    return manager, api, task_d
+
+
+def _event(worker_id, phase, evt_type="MODIFIED", exit_code=None,
+           reason=None):
+    pod = {
+        "metadata": {
+            "labels": {
+                "elasticdl-replica-type": "worker",
+                "elasticdl-replica-index": str(worker_id),
+            }
+        },
+        "status": {"phase": phase},
+    }
+    if exit_code is not None:
+        pod["status"]["containerStatuses"] = [
+            {"state": {"terminated": {"exitCode": exit_code,
+                                      "reason": reason}}}
+        ]
+    return {"type": evt_type, "object": pod}
+
+
+# ------------------------------------------------------------- k8s client
+
+
+def test_worker_pod_manifest():
+    api = FakeCoreApi()
+    client = _client(api)
+    client.create_worker_pod(
+        3,
+        command=["python"],
+        args=["--worker_id", "3"],
+        resource_requests={"cpu": "2", "google.com/tpu": "8"},
+        resource_limits=None,
+        priority_class="high",
+    )
+    ns, manifest = api.created_pods[0]
+    assert ns == "ns"
+    assert manifest["metadata"]["name"] == "elasticdl-testjob-worker-3"
+    labels = manifest["metadata"]["labels"]
+    assert labels["elasticdl-job-name"] == "testjob"
+    assert labels["elasticdl-replica-type"] == "worker"
+    assert labels["elasticdl-replica-index"] == "3"
+    # owner ref ties worker GC to the master pod
+    assert manifest["metadata"]["ownerReferences"][0]["name"] == (
+        "elasticdl-testjob-master"
+    )
+    container = manifest["spec"]["containers"][0]
+    assert container["resources"]["requests"]["google.com/tpu"] == "8"
+    # limits default to requests
+    assert container["resources"]["limits"]["cpu"] == "2"
+    assert manifest["spec"]["priorityClassName"] == "high"
+
+
+def test_delete_worker():
+    api = FakeCoreApi()
+    client = _client(api)
+    client.delete_worker(5)
+    assert api.deleted == ["elasticdl-testjob-worker-5"]
+
+
+def test_worker_service_manifest():
+    api = FakeCoreApi()
+    client = _client(api)
+    client.create_worker_service(1)
+    _, manifest = api.services[0]
+    sel = manifest["spec"]["selector"]
+    assert sel["elasticdl-replica-index"] == "1"
+    assert manifest["spec"]["ports"][0]["port"] == 3333
+
+
+# ------------------------------------------------------ instance manager
+
+
+def test_start_workers_launches_pods():
+    manager, api, _ = _manager()
+    manager.start_workers()
+    assert len(api.created_pods) == 2
+    args = api.created_pods[0][1]["spec"]["containers"][0]["args"]
+    assert args[-2:] == ["--worker_id", "0"]
+
+
+def test_failed_worker_recovers_tasks_and_relaunches():
+    manager, api, task_d = _manager()
+    manager.start_workers()
+    manager.event_cb(_event(0, "Failed", exit_code=1))
+    assert task_d.recovered == [0]
+    # relaunched with a NEW worker id (reference :369-378)
+    assert len(api.created_pods) == 3
+    args = api.created_pods[2][1]["spec"]["containers"][0]["args"]
+    assert args[-2:] == ["--worker_id", "2"]
+
+
+def test_relaunch_budget_exhausted():
+    manager, api, task_d = _manager(relaunch_on_worker_failure=2)
+    manager.start_workers()
+    current = 0
+    for round_ in range(2):
+        manager.event_cb(_event(current, "Failed", exit_code=1))
+        current = 2 + round_  # relaunched id
+    assert len(api.created_pods) == 4  # 2 initial + 2 relaunches
+    # third failure: budget burned, no relaunch
+    manager.event_cb(_event(current, "Failed", exit_code=1))
+    assert len(api.created_pods) == 4
+
+
+def test_preemption_exit_137_does_not_burn_retry():
+    manager, api, task_d = _manager(relaunch_on_worker_failure=1)
+    manager.start_workers()
+    # preempted twice (137, not OOM): always relaunched
+    manager.event_cb(_event(0, "Failed", exit_code=137))
+    manager.event_cb(_event(2, "Failed", exit_code=137))
+    assert len(api.created_pods) == 4
+    # a real failure burns the single retry...
+    manager.event_cb(_event(3, "Failed", exit_code=1))
+    assert len(api.created_pods) == 5
+    # ...and the next one is terminal
+    manager.event_cb(_event(4, "Failed", exit_code=1))
+    assert len(api.created_pods) == 5
+
+
+def test_oom_137_burns_retry():
+    manager, api, _ = _manager(relaunch_on_worker_failure=1)
+    manager.start_workers()
+    manager.event_cb(_event(0, "Failed", exit_code=137, reason="OOMKilled"))
+    assert len(api.created_pods) == 3
+    manager.event_cb(_event(2, "Failed", exit_code=137, reason="OOMKilled"))
+    assert len(api.created_pods) == 3  # budget exhausted
+
+
+def test_deleted_pod_relaunches():
+    manager, api, task_d = _manager()
+    manager.start_workers()
+    manager.event_cb(_event(1, "Running", evt_type="DELETED"))
+    assert task_d.recovered == [1]
+    assert len(api.created_pods) == 3
+
+
+def test_succeeded_worker_not_relaunched():
+    manager, api, _ = _manager()
+    manager.start_workers()
+    manager.event_cb(_event(0, "Succeeded"))
+    assert len(api.created_pods) == 2
+    assert manager.worker_phase(0) == "Succeeded"
+
+
+def test_all_workers_failed():
+    manager, _, _ = _manager(num_workers=2, disable_relaunch=True)
+    manager.start_workers()
+    assert not manager.all_workers_failed()
+    manager.event_cb(_event(0, "Failed", exit_code=1))
+    assert not manager.all_workers_failed()
+    manager.event_cb(_event(1, "Failed", exit_code=1))
+    assert manager.all_workers_failed()
+
+
+def test_disable_relaunch():
+    manager, api, _ = _manager(disable_relaunch=True)
+    manager.start_workers()
+    manager.event_cb(_event(0, "Failed", exit_code=137))
+    assert len(api.created_pods) == 2
+
+
+def test_remove_worker_deletes_pod():
+    manager, api, _ = _manager()
+    manager.start_workers()
+    manager.remove_worker(1)
+    assert api.deleted == ["elasticdl-testjob-worker-1"]
+
+
+def test_non_worker_events_ignored():
+    manager, api, task_d = _manager()
+    manager.start_workers()
+    event = {
+        "type": "MODIFIED",
+        "object": {
+            "metadata": {"labels": {"elasticdl-replica-type": "master"}},
+            "status": {"phase": "Failed"},
+        },
+    }
+    manager.event_cb(event)
+    assert task_d.recovered == []
+
+
+# --------------------------------------------------------------- priority
+
+
+def test_priority_fraction():
+    pri = parse_worker_pod_priority(4, "high=0.5")
+    assert pri == {0: "high", 1: "high", 2: None, 3: None}
+
+
+def test_priority_uniform_and_empty():
+    assert parse_worker_pod_priority(2, "low") == {0: "low", 1: "low"}
+    assert parse_worker_pod_priority(2, "") == {0: None, 1: None}
+
+
+# ------------------------------------------------------------------- args
+
+
+def test_args_roundtrip():
+    argv = [
+        "--model_zoo", "model_zoo",
+        "--model_def", "mnist_functional_api.mnist_functional_api."
+                       "custom_model",
+        "--training_data", "/data/train",
+        "--num_workers", "2",
+        "--minibatch_size", "64",
+        "--worker_pod_priority", "high=0.5",
+    ]
+    args = parse_master_args(argv)
+    rebuilt = build_arguments_from_parsed_result(
+        args, filter_args=MASTER_ONLY_ARGS
+    )
+    # a worker parses the rebuilt line (plus its own flags)
+    worker_args = parse_worker_args(
+        rebuilt + ["--worker_id", "0", "--master_addr", "h:1"]
+    )
+    assert worker_args.minibatch_size == 64
+    assert worker_args.training_data == "/data/train"
+    assert worker_args.model_zoo == "model_zoo"
+    assert "--num_workers" not in rebuilt
+
+
+def test_wrap_args_quotes():
+    assert wrap_args_with_string(["--a", "x y"]) == "--a 'x y'"
+
+
+def test_parse_resource_spec():
+    assert parse_resource_spec("cpu=1,memory=4096Mi,google.com/tpu=8") == {
+        "cpu": "1", "memory": "4096Mi", "google.com/tpu": "8",
+    }
+
+
+# --------------------------------------------------------------- watchdog
+
+
+def test_watchdog_removes_straggler():
+    """Master.check_timeout_tasks recovers 3x-average stragglers and
+    removes the worker (reference master.py:536-558)."""
+    import time
+
+    from elasticdl_tpu.common.model_utils import (
+        load_model_spec_from_module,
+    )
+    from elasticdl_tpu.master.master import Master
+    from model_zoo.mnist_functional_api import mnist_functional_api as zoo
+
+    class FakeManager(object):
+        def __init__(self):
+            self.removed = []
+
+        def start_workers(self):
+            pass
+
+        def all_workers_failed(self):
+            return False
+
+        def remove_worker(self, worker_id):
+            self.removed.append(worker_id)
+
+        def stop(self):
+            pass
+
+    class FakeReader(object):
+        def __init__(self, *a, **k):
+            pass
+
+        def create_shards(self):
+            return {"shard": (0, 512)}
+
+    spec = load_model_spec_from_module(zoo)
+    manager = FakeManager()
+    master = Master(
+        spec,
+        training_data="unused",
+        create_data_reader_fn=lambda *a, **k: FakeReader(),
+        instance_manager=manager,
+    )
+    # worker 7 takes a task; averages say tasks complete in ~0.01s
+    task_id, task = master.task_d.get(7)
+    assert task is not None
+    master.servicer._task_complete_times[task.type] = [0.01] * 25
+    # backdate the doing-task start time beyond 3x average
+    worker_id, t, start = master.task_d._doing[task_id]
+    master.task_d._doing[task_id] = (worker_id, t, start - 600.0)
+    master.check_timeout_tasks()
+    assert manager.removed == [7]
+    assert task_id not in master.task_d.doing_tasks()
+
+
+def test_stop_does_not_relaunch_killed_workers():
+    """stop() kills the fleet; the resulting exit/DELETED events must NOT
+    trigger relaunches (shutdown, not preemption)."""
+    manager, api, task_d = _manager()
+    manager.start_workers()
+    manager.stop()
+    # watch events for the deliberate deletions arrive after stop()
+    manager.event_cb(_event(0, "Running", evt_type="DELETED"))
+    manager.event_cb(_event(1, "Failed", exit_code=137))
+    assert len(api.created_pods) == 2  # no relaunches
+    assert task_d.recovered == []

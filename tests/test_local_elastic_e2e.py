@@ -1,0 +1,123 @@
+"""End-to-end local elasticity: a real master gRPC server + subprocess
+workers launched by LocalInstanceManager, with fault injection — the
+TPU-native analogue of the reference's PS-restart fault test
+(worker_ps_interaction_test.py:350-402) and the minikube job drills
+(scripts/travis/run_job.sh), run without a cluster."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from elasticdl_tpu.common.model_utils import load_model_spec_from_module
+from elasticdl_tpu.data import recordio_gen
+from elasticdl_tpu.master.instance_manager import LocalInstanceManager
+from elasticdl_tpu.master.master import Master
+
+
+def _spec():
+    from model_zoo.mnist_functional_api import mnist_functional_api as zoo
+
+    return load_model_spec_from_module(zoo)
+
+
+def _worker_args(train_dir):
+    return [
+        "--model_zoo", os.path.join(os.path.dirname(__file__), "..",
+                                    "model_zoo"),
+        "--model_def",
+        "mnist_functional_api.mnist_functional_api.custom_model",
+        "--training_data", train_dir,
+        "--minibatch_size", "16",
+        "--records_per_task", "24",
+        "--job_type", "training_only",
+    ]
+
+
+def _env():
+    env = dict(os.environ)
+    # subprocess workers run on CPU; keep jax quiet and single-device
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = (
+        os.path.join(os.path.dirname(__file__), "..")
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    return env
+
+
+@pytest.mark.integration
+def test_subprocess_workers_complete_job(tmp_path):
+    train_dir = str(tmp_path / "train")
+    recordio_gen.gen_mnist_like(train_dir, num_files=2,
+                                records_per_file=48)
+    master = Master(
+        _spec(),
+        training_data=train_dir,
+        minibatch_size=16,
+        records_per_task=24,
+        num_epochs=1,
+    )
+    master.prepare()
+    manager = LocalInstanceManager(
+        master.task_d,
+        num_workers=2,
+        worker_args=_worker_args(train_dir)
+        + ["--master_addr", "localhost:%d" % master.port],
+        env=_env(),
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    try:
+        deadline = time.time() + 300
+        while not master.task_d.finished() and time.time() < deadline:
+            time.sleep(0.5)
+        assert master.task_d.finished(), "job did not finish"
+    finally:
+        master.stop()
+
+
+@pytest.mark.integration
+def test_worker_killed_mid_job_is_relaunched_and_job_completes(tmp_path):
+    train_dir = str(tmp_path / "train")
+    recordio_gen.gen_mnist_like(train_dir, num_files=4,
+                                records_per_file=48)
+    master = Master(
+        _spec(),
+        training_data=train_dir,
+        minibatch_size=16,
+        records_per_task=24,
+        num_epochs=2,
+    )
+    master.prepare()
+    manager = LocalInstanceManager(
+        master.task_d,
+        num_workers=1,
+        worker_args=_worker_args(train_dir)
+        + ["--master_addr", "localhost:%d" % master.port],
+        env=_env(),
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    try:
+        # wait for the worker to start doing real work, then kill it
+        deadline = time.time() + 120
+        while not master.task_d.doing_tasks() and time.time() < deadline:
+            time.sleep(0.2)
+        assert master.task_d.doing_tasks(), "worker never took a task"
+        manager.remove_worker(0)  # SIGKILL -> exit -9 -> preemption path
+
+        deadline = time.time() + 300
+        while not master.task_d.finished() and time.time() < deadline:
+            if manager.all_workers_failed():
+                pytest.fail("all workers failed instead of relaunching")
+            time.sleep(0.5)
+        assert master.task_d.finished(), "job did not finish after kill"
+        # the kill triggered a relaunch with a new worker id
+        assert manager.worker_phase(0) in ("Failed", "Deleted")
+        assert manager.worker_phase(1) is not None
+    finally:
+        master.stop()
