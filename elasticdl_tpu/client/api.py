@@ -1,0 +1,185 @@
+"""Client API: zoo scaffolding, image build/push, job submission
+(reference elasticdl_client/api.py:31-234).
+
+Job submission rebuilds the master command line from the parsed args
+(the reference's `_submit_job`, api.py:179-234) and either creates the
+master pod through the k8s API or — with no `--image_name` — execs the
+master entrypoint in-process, which is the zero-infra path."""
+
+import os
+
+from elasticdl_tpu.common.args import build_arguments_from_parsed_result
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+# flags that belong to the client only, never to the master process
+_CLIENT_ONLY_ARGS = {
+    "command", "zoo_command", "func", "image_name", "detach",
+    "master_resource_request", "master_resource_limit",
+    "master_pod_priority",
+}
+
+_ZOO_TEMPLATE = '''\
+"""Model-zoo module template. Export by convention:
+custom_model / loss / optimizer / dataset_fn / eval_metrics_fn."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+
+class MyModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = features["x"]
+        return nn.Dense(1)(x)
+
+
+def custom_model():
+    return MyModel()
+
+
+def loss(labels, predictions, sample_weights=None):
+    err = (predictions.reshape(-1) - labels.reshape(-1)) ** 2
+    if sample_weights is None:
+        return jnp.mean(err)
+    return jnp.sum(err * sample_weights) / jnp.maximum(
+        jnp.sum(sample_weights), 1.0
+    )
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, metadata):
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "mse": lambda labels, predictions: (
+            (np.asarray(predictions).reshape(-1)
+             - np.asarray(labels).reshape(-1)) ** 2
+        )
+    }
+'''
+
+
+# ------------------------------------------------------------------ zoo
+
+
+def init_zoo(args, extra=None):
+    """Scaffold a model-zoo directory (reference api.init_zoo,
+    api.py:31-62): requirements + a template module + the Dockerfile
+    seed."""
+    path = args.path
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "requirements.txt"), "w") as f:
+        f.write("jax\nflax\noptax\n")
+    with open(os.path.join(path, "my_model.py"), "w") as f:
+        f.write(_ZOO_TEMPLATE)
+    from elasticdl_tpu.client.image_builder import write_dockerfile
+
+    write_dockerfile(
+        path,
+        base_image=args.base_image,
+        extra_pypi_index=args.extra_pypi_index,
+        cluster_spec=args.cluster_spec,
+    )
+    logger.info("Initialized model zoo at %s", path)
+    return 0
+
+
+def build_zoo(args, extra=None):
+    from elasticdl_tpu.client.image_builder import build_image
+
+    build_image(args.path, args.image)
+    return 0
+
+
+def push_zoo(args, extra=None):
+    from elasticdl_tpu.client.image_builder import push_image
+
+    push_image(args.image)
+    return 0
+
+
+# ------------------------------------------------------------------ jobs
+
+
+def train(args, extra=None):
+    return _submit_job(args, extra, job_mode="train")
+
+
+def evaluate(args, extra=None):
+    _require(args.validation_data, "--validation_data")
+    args.training_data = ""
+    return _submit_job(args, extra, job_mode="evaluate")
+
+
+def predict(args, extra=None):
+    _require(args.prediction_data, "--prediction_data")
+    args.training_data = ""
+    args.validation_data = ""
+    return _submit_job(args, extra, job_mode="predict")
+
+
+def _require(value, flag):
+    if not value:
+        raise ValueError("%s is required for this command" % flag)
+
+
+def build_master_args(args, extra=None):
+    """Master command-line from the parsed client args (reference
+    api._submit_job rebuilding `python -m ...master.main --…`)."""
+    master_args = build_arguments_from_parsed_result(
+        args, filter_args=_CLIENT_ONLY_ARGS
+    )
+    return master_args + list(extra or [])
+
+
+def _submit_job(args, extra, job_mode):
+    master_args = build_master_args(args, extra)
+    if not args.image_name:
+        # no-cluster path: run the master right here
+        from elasticdl_tpu.master.main import main as master_main
+
+        logger.info("Running local master (%s)", job_mode)
+        return master_main(master_args)
+    return _submit_master_pod(args, master_args)
+
+
+def _submit_master_pod(args, master_args, core_api=None):
+    """Create the master pod via the k8s API (reference
+    elasticdl_client/common/k8s_client.py create_master)."""
+    from elasticdl_tpu.common.args import parse_resource_spec
+    from elasticdl_tpu.common.k8s_client import Client
+
+    client = Client(
+        image_name=args.image_name,
+        namespace=args.namespace,
+        job_name=args.job_name,
+        core_api=core_api,
+    )
+    client.create_master_pod(
+        # plain "python": resolved inside the job image, never the
+        # client machine's interpreter path
+        command=["python", "-m", "elasticdl_tpu.master.main"],
+        args=master_args,
+        resource_requests=parse_resource_spec(
+            args.master_resource_request
+        ),
+        resource_limits=parse_resource_spec(args.master_resource_limit),
+        priority_class=args.master_pod_priority or None,
+        restart_policy=args.restart_policy,
+        image_pull_policy=args.image_pull_policy,
+    )
+    logger.info(
+        "Submitted master pod %s", client.get_master_pod_name()
+    )
+    if not args.detach:
+        from elasticdl_tpu.client.job_monitor import EdlJobMonitor
+
+        EdlJobMonitor(client).monitor_job_status()
+    return 0

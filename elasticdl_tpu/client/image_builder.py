@@ -1,0 +1,70 @@
+"""Docker image builder for model-zoo jobs (reference
+elasticdl/python/elasticdl/image_builder.py, 272 LoC): assemble a
+Dockerfile that layers the model zoo (and its requirements) onto a base
+image carrying the framework, then build/push via the docker CLI.
+
+The docker binary is the gate: everything here raises a clear error when
+it's absent, and `write_dockerfile` (pure file generation) is always
+available and unit-tested."""
+
+import os
+import shutil
+import subprocess
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+_DOCKERFILE = """\
+FROM {base_image}
+COPY . /model_zoo
+RUN pip install --no-cache-dir {pypi_flag} -r /model_zoo/requirements.txt
+ENV PYTHONPATH=/model_zoo:$PYTHONPATH
+{cluster_spec_line}
+"""
+
+
+def write_dockerfile(zoo_path, base_image="python:3.10",
+                     extra_pypi_index="", cluster_spec=""):
+    """Generate the zoo Dockerfile (reference
+    image_builder._generate_dockerfile)."""
+    pypi_flag = (
+        "--extra-index-url %s" % extra_pypi_index
+        if extra_pypi_index
+        else ""
+    )
+    cluster_spec_line = (
+        "COPY %s /cluster_spec/cluster_spec.py" % cluster_spec
+        if cluster_spec
+        else ""
+    )
+    content = _DOCKERFILE.format(
+        base_image=base_image,
+        pypi_flag=pypi_flag,
+        cluster_spec_line=cluster_spec_line,
+    )
+    dockerfile = os.path.join(zoo_path, "Dockerfile")
+    with open(dockerfile, "w") as f:
+        f.write(content)
+    return dockerfile
+
+
+def _docker(*cmd):
+    if shutil.which("docker") is None:
+        raise RuntimeError(
+            "docker is not installed; build the image on a machine with "
+            "docker or use the local (no-image) job path"
+        )
+    logger.info("Running: docker %s", " ".join(cmd))
+    subprocess.run(["docker", *cmd], check=True)
+
+
+def build_image(zoo_path, image):
+    """docker build the zoo directory (reference
+    build_and_push_docker_image's build step)."""
+    dockerfile = os.path.join(zoo_path, "Dockerfile")
+    if not os.path.exists(dockerfile):
+        write_dockerfile(zoo_path)
+    _docker("build", "-t", image, zoo_path)
+
+
+def push_image(image):
+    _docker("push", image)

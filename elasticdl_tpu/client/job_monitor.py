@@ -1,0 +1,101 @@
+"""Client-side job monitoring: poll pod phases, tail master logs
+(reference common/k8s_job_monitor.py: PodMonitor / EdlJobMonitor,
+213 LoC). Works against any object with the CoreV1Api read/log surface,
+so tests drive it with fakes."""
+
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+_FINISHED_PHASES = ("Succeeded", "Failed")
+
+
+def _phase(pod):
+    if pod is None:
+        return None
+    status = (
+        pod.get("status") if isinstance(pod, dict)
+        else getattr(pod, "status", None)
+    )
+    if status is None:
+        return None
+    return (
+        status.get("phase") if isinstance(status, dict)
+        else getattr(status, "phase", None)
+    )
+
+
+class PodMonitor(object):
+    """Poll one pod until it reaches a terminal phase (reference
+    PodMonitor.monitor_status)."""
+
+    def __init__(self, k8s_client, pod_name, poll_interval=5):
+        self._client = k8s_client
+        self._pod_name = pod_name
+        self._poll_interval = poll_interval
+
+    def monitor_status(self, timeout=None, max_not_found=3):
+        deadline = time.time() + timeout if timeout else None
+        last_phase = None
+        not_found = 0
+        while True:
+            pod = self._client.get_pod(self._pod_name)
+            phase = _phase(pod)
+            if pod is None:
+                not_found += 1
+                if not_found >= max_not_found:
+                    # evicted/deleted pod: terminal, don't poll forever
+                    logger.warning(
+                        "Pod %s not found; giving up", self._pod_name
+                    )
+                    return "NotFound"
+            else:
+                not_found = 0
+            if phase != last_phase:
+                logger.info("Pod %s phase: %s", self._pod_name, phase)
+                last_phase = phase
+            if phase in _FINISHED_PHASES:
+                return phase
+            if deadline and time.time() > deadline:
+                return phase
+            time.sleep(self._poll_interval)
+
+
+class EdlJobMonitor(object):
+    """Monitor a whole job: master phase + log tailing (reference
+    EdlJobMonitor.monitor_job_status)."""
+
+    def __init__(self, k8s_client, poll_interval=5):
+        self._client = k8s_client
+        self._poll_interval = poll_interval
+
+    def tail_master_log(self, since_seconds=None):
+        try:
+            return self._client.client.read_namespaced_pod_log(
+                self._client.get_master_pod_name(),
+                self._client.namespace,
+                **(
+                    {"since_seconds": since_seconds}
+                    if since_seconds
+                    else {}
+                ),
+            )
+        except Exception as e:
+            logger.warning("Cannot read master log: %s", e)
+            return None
+
+    def monitor_job_status(self, timeout=None):
+        phase = PodMonitor(
+            self._client,
+            self._client.get_master_pod_name(),
+            poll_interval=self._poll_interval,
+        ).monitor_status(timeout=timeout)
+        log = self.tail_master_log(since_seconds=60)
+        if log:
+            for line in log.splitlines()[-20:]:
+                logger.info("[master] %s", line)
+        if phase in ("Failed", "NotFound"):
+            raise RuntimeError(
+                "Job failed (master pod phase %s)" % phase
+            )
+        return phase

@@ -97,6 +97,7 @@ class ReaderType(object):
     RECORDIO = "RecordIO"
     CSV = "CSV"
     TEXT = "Text"
+    ODPS = "ODPS"
 
 
 class SaveModelConfig(object):

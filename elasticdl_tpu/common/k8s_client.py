@@ -290,6 +290,31 @@ class Client(object):
             self.namespace, manifest
         )
 
+    def create_master_pod(self, *, command, args, resource_requests,
+                          resource_limits=None, priority_class=None,
+                          restart_policy="Never",
+                          image_pull_policy="Always", envs=None,
+                          volume=None):
+        """Create the job-root master pod (reference client-side
+        create_master, elasticdl_client/common/k8s_client.py). The
+        master owns the job: no owner reference."""
+        manifest = self._pod_manifest(
+            pod_name=self.get_master_pod_name(),
+            replica_type="master",
+            replica_index=0,
+            command=command,
+            args=args,
+            resource_requests=resource_requests,
+            resource_limits=resource_limits,
+            priority_class=priority_class,
+            restart_policy=restart_policy,
+            image_pull_policy=image_pull_policy,
+            envs=envs,
+            volume=volume,
+        )
+        manifest["metadata"]["ownerReferences"] = []
+        return self.client.create_namespaced_pod(self.namespace, manifest)
+
     # ------------------------------------------------------------- status
 
     def update_master_label(self, status):

@@ -1,7 +1,9 @@
 """Reader factory (reference data/reader/data_reader_factory.py:23-73).
 
-Resolution order: explicit `reader_type` param > custom reader from the model
-zoo > extension sniffing (.csv -> CSV, else TRec/RecordIO).
+Resolution order: explicit `reader_type` param > ODPS env sniffing
+(MAXCOMPUTE_* credentials present and the origin is a table name, as in
+the reference's env-based choice) > extension sniffing (.csv -> CSV,
+else TRec/RecordIO).
 """
 
 import os
@@ -11,6 +13,40 @@ from elasticdl_tpu.data.reader.csv_reader import CSVDataReader
 from elasticdl_tpu.data.reader.recordio_reader import RecordIODataReader
 
 
+def _odps_env():
+    """MaxCompute credentials from the env (reference
+    data_reader_factory.py env sniffing + odps_io MaxComputeConfig)."""
+    ak = os.environ.get("MAXCOMPUTE_AK") or os.environ.get("ODPS_ACCESS_ID")
+    sk = os.environ.get("MAXCOMPUTE_SK") or os.environ.get(
+        "ODPS_ACCESS_KEY"
+    )
+    project = os.environ.get("MAXCOMPUTE_PROJECT") or os.environ.get(
+        "ODPS_PROJECT_NAME"
+    )
+    endpoint = os.environ.get("MAXCOMPUTE_ENDPOINT") or os.environ.get(
+        "ODPS_ENDPOINT"
+    )
+    if ak and sk and project:
+        return {
+            "access_id": ak,
+            "access_key": sk,
+            "project": project,
+            "endpoint": endpoint,
+        }
+    return None
+
+
+def _make_odps_reader(data_origin, kwargs):
+    from elasticdl_tpu.data.reader.odps_reader import ODPSDataReader
+
+    kwargs.pop("data_dir", None)
+    env = _odps_env() or {}
+    for k, v in env.items():
+        kwargs.setdefault(k, v)
+    kwargs.setdefault("table", data_origin)
+    return ODPSDataReader(**kwargs)
+
+
 def create_data_reader(data_origin, records_per_task=None, **kwargs):
     reader_type = kwargs.pop("reader_type", None)
     kwargs.setdefault("data_dir", data_origin)
@@ -18,6 +54,12 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
         kwargs.setdefault("records_per_task", records_per_task)
 
     if reader_type is None:
+        if (
+            _odps_env() is not None
+            and data_origin
+            and not os.path.exists(data_origin)
+        ):
+            return _make_odps_reader(data_origin, kwargs)
         if data_origin and os.path.isdir(data_origin):
             names = os.listdir(data_origin)
             if names and all(n.endswith(".csv") for n in names):
@@ -27,4 +69,6 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
         return CSVDataReader(**kwargs)
     if reader_type == ReaderType.RECORDIO:
         return RecordIODataReader(**kwargs)
+    if reader_type == ReaderType.ODPS:
+        return _make_odps_reader(data_origin, kwargs)
     raise ValueError("Unknown reader_type %s" % reader_type)

@@ -114,6 +114,16 @@ def main(argv=None):
 
         callbacks_list = CallbackList(spec.callbacks_fn())
 
+    tensorboard_service = None
+    if args.need_tensorboard:
+        from elasticdl_tpu.master.tensorboard_service import (
+            TensorboardService,
+        )
+
+        tensorboard_service = TensorboardService(
+            args.tensorboard_log_dir or "/tmp/elasticdl_tb"
+        )
+
     master = Master(
         spec,
         training_data=args.training_data or None,
@@ -129,6 +139,7 @@ def main(argv=None):
         task_timeout_check_interval=args.task_timeout_check_interval,
         callbacks_list=callbacks_list,
         export_saved_model=args.export_saved_model,
+        tensorboard_service=tensorboard_service,
     )
     # gRPC port is bound in prepare(); the instance manager needs the
     # final address, so wire it afterwards.

@@ -44,6 +44,7 @@ class Master(object):
         task_timeout_check_interval=30,
         callbacks_list=None,
         export_saved_model=False,
+        tensorboard_service=None,
     ):
         from elasticdl_tpu.data.reader.data_reader_factory import (
             create_data_reader,
@@ -76,10 +77,11 @@ class Master(object):
                     cb.set_task_dispatcher(self.task_d)
 
         eval_only = bool(validation_data) and not training_data
+        self.tensorboard_service = tensorboard_service
         self.evaluation_service = None
         if validation_data:
             self.evaluation_service = EvaluationService(
-                None,  # metrics writer wired by caller (tensorboard svc)
+                tensorboard_service,
                 self.task_d,
                 eval_start_delay_secs,
                 eval_throttle_secs,
@@ -114,6 +116,8 @@ class Master(object):
         logger.info("Master gRPC server started on port %d", self.port)
         if self.evaluation_service:
             self.evaluation_service.start()
+        if self.tensorboard_service:
+            self.tensorboard_service.start()
         if self.instance_manager:
             self.instance_manager.start_workers()
         self._start_watchdog()
@@ -143,6 +147,9 @@ class Master(object):
         self._watchdog_stopper.set()
         if self.evaluation_service:
             self.evaluation_service.stop()
+        # after the eval service: late metrics must not reopen the writer
+        if self.tensorboard_service:
+            self.tensorboard_service.stop()
         if self.instance_manager:
             self.instance_manager.stop()
         if self._server:

@@ -1,0 +1,76 @@
+"""TensorBoard service on the master (reference
+master/tensorboard_service.py:21-63): evaluation metrics become scalar
+summaries keyed by model version; a `tensorboard` subprocess serves them
+when the binary exists (gated — the TPU image may not ship it).
+
+Summaries are written with the dependency-free event writer
+(common/tb_events.py) instead of tf.summary."""
+
+import shutil
+import subprocess
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.tb_events import EventFileWriter
+
+
+class TensorboardService(object):
+    def __init__(self, tensorboard_log_dir, master_ip="", port=6006):
+        self._log_dir = tensorboard_log_dir
+        self._master_ip = master_ip
+        self._port = port
+        self._writer = None
+        self._tb_process = None
+
+    def _ensure_writer(self):
+        if self._writer is None:
+            self._writer = EventFileWriter(self._log_dir)
+        return self._writer
+
+    def write_dict_to_summary(self, dictionary, version):
+        """Scalar per metric at step=version (reference
+        write_dict_to_summary, tensorboard_service.py:41-49)."""
+        writer = self._ensure_writer()
+        for key, value in dictionary.items():
+            try:
+                writer.add_scalar(key, float(value), version)
+            except (TypeError, ValueError):
+                logger.warning(
+                    "Skipping non-scalar metric %s=%r", key, value
+                )
+
+    def start(self):
+        """Launch the tensorboard subprocess if it is installed
+        (reference start, :51-60)."""
+        if shutil.which("tensorboard") is None:
+            logger.warning(
+                "tensorboard binary not found; summaries are still "
+                "written to %s", self._log_dir,
+            )
+            return False
+        self._tb_process = subprocess.Popen(
+            [
+                "tensorboard",
+                "--logdir", self._log_dir,
+                "--port", str(self._port),
+                "--bind_all",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        logger.info("TensorBoard serving %s on :%d",
+                    self._log_dir, self._port)
+        return True
+
+    def is_active(self):
+        return (
+            self._tb_process is not None
+            and self._tb_process.poll() is None
+        )
+
+    def stop(self):
+        if self._writer:
+            self._writer.close()
+            self._writer = None
+        if self.is_active():
+            self._tb_process.terminate()
+            self._tb_process = None

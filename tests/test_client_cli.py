@@ -1,0 +1,164 @@
+"""Client CLI tests: parser surface, master-arg reconstruction, zoo
+scaffolding, master-pod submission (fake k8s), job monitor, and the
+no-cluster end-to-end `train` path (reference elasticdl_client/tests +
+scripts/client_test.sh in spirit)."""
+
+import os
+import sys
+
+import pytest
+
+from elasticdl_tpu.client import api
+from elasticdl_tpu.client.job_monitor import EdlJobMonitor, PodMonitor
+from elasticdl_tpu.client.main import build_argument_parser
+
+
+def _parse(argv):
+    return build_argument_parser().parse_known_args(argv)
+
+
+def test_parser_train():
+    args, extra = _parse([
+        "train",
+        "--model_zoo", "model_zoo",
+        "--model_def", "m.m.custom_model",
+        "--num_workers", "2",
+        "--image_name", "img:1",
+    ])
+    assert args.command == "train"
+    assert args.num_workers == 2
+    assert args.func is api.train
+
+
+def test_parser_zoo_init(tmp_path):
+    args, _ = _parse(["zoo", "init", "--path", str(tmp_path)])
+    assert args.zoo_command == "init"
+    assert args.func is api.init_zoo
+
+
+def test_build_master_args_filters_client_flags():
+    args, extra = _parse([
+        "train",
+        "--model_zoo", "model_zoo",
+        "--model_def", "m.m.custom_model",
+        "--image_name", "img:1",
+        "--minibatch_size", "64",
+    ])
+    master_args = api.build_master_args(args, extra)
+    assert "--image_name" not in master_args
+    assert "--detach" not in master_args
+    i = master_args.index("--minibatch_size")
+    assert master_args[i + 1] == "64"
+
+
+def test_zoo_init_scaffolds_valid_module(tmp_path):
+    args, _ = _parse(["zoo", "init", "--path", str(tmp_path)])
+    api.init_zoo(args)
+    assert (tmp_path / "requirements.txt").exists()
+    assert (tmp_path / "Dockerfile").exists()
+    # the generated template is a loadable zoo spec
+    from elasticdl_tpu.common.model_utils import get_model_spec
+
+    spec = get_model_spec(str(tmp_path), "my_model.custom_model")
+    model = spec.create_model("")
+    assert model is not None
+    assert "mse" in spec.eval_metrics_fn()
+
+
+def test_submit_master_pod_manifest():
+    class FakeApi(object):
+        def __init__(self):
+            self.pods = []
+
+        def create_namespaced_pod(self, namespace, manifest):
+            self.pods.append((namespace, manifest))
+
+        def read_namespaced_pod(self, namespace, name):
+            return None
+
+    args, extra = _parse([
+        "train",
+        "--model_zoo", "model_zoo",
+        "--model_def", "m.m.custom_model",
+        "--image_name", "img:1",
+        "--job_name", "cli-test",
+        "--detach",
+    ])
+    fake = FakeApi()
+    api._submit_master_pod(args, api.build_master_args(args, extra),
+                           core_api=fake)
+    ns, manifest = fake.pods[0]
+    assert manifest["metadata"]["name"] == "elasticdl-cli-test-master"
+    assert manifest["metadata"]["ownerReferences"] == []
+    container = manifest["spec"]["containers"][0]
+    assert container["command"][-1] == "elasticdl_tpu.master.main"
+    assert "--model_zoo" in container["args"]
+
+
+class _FakeMonClient(object):
+    def __init__(self, phases, log="line1\nline2"):
+        self._phases = list(phases)
+        self._log = log
+        self.namespace = "ns"
+
+        class Inner(object):
+            def read_namespaced_pod_log(inner_self, name, ns, **kw):
+                return self._log
+
+        self.client = Inner()
+
+    def get_master_pod_name(self):
+        return "elasticdl-x-master"
+
+    def get_pod(self, name):
+        phase = self._phases.pop(0) if len(self._phases) > 1 else (
+            self._phases[0]
+        )
+        return {"status": {"phase": phase}}
+
+
+def test_pod_monitor_returns_on_success():
+    client = _FakeMonClient(["Pending", "Running", "Succeeded"])
+    monitor = PodMonitor(client, "elasticdl-x-master", poll_interval=0)
+    assert monitor.monitor_status() == "Succeeded"
+
+
+def test_job_monitor_raises_on_failure():
+    client = _FakeMonClient(["Running", "Failed"])
+    monitor = EdlJobMonitor(client, poll_interval=0)
+    with pytest.raises(RuntimeError, match="Job failed"):
+        monitor.monitor_job_status()
+
+
+@pytest.mark.integration
+def test_cli_train_local_end_to_end(tmp_path):
+    """`elasticdl-tpu train` with no image runs the master in-process
+    with subprocess workers and completes."""
+    from elasticdl_tpu.client.main import main as cli_main
+    from elasticdl_tpu.data import recordio_gen
+
+    train_dir = str(tmp_path / "train")
+    recordio_gen.gen_mnist_like(train_dir, num_files=1,
+                                records_per_file=48)
+    rc = cli_main([
+        "train",
+        "--model_zoo",
+        os.path.join(os.path.dirname(__file__), "..", "model_zoo"),
+        "--model_def",
+        "mnist_functional_api.mnist_functional_api.custom_model",
+        "--training_data", train_dir,
+        "--minibatch_size", "16",
+        "--records_per_task", "24",
+        "--num_workers", "1",
+        "--port", "0",
+    ])
+    assert rc == 0
+
+
+def test_pod_monitor_gives_up_on_missing_pod():
+    class GoneClient(object):
+        def get_pod(self, name):
+            return None
+
+    monitor = PodMonitor(GoneClient(), "gone-pod", poll_interval=0)
+    assert monitor.monitor_status() == "NotFound"
