@@ -13,11 +13,16 @@ import subprocess
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 
+_FRAMEWORK_DIR = ".elasticdl_tpu_framework"
+
 _DOCKERFILE = """\
 FROM {base_image}
 COPY . /model_zoo
 RUN pip install --no-cache-dir {pypi_flag} -r /model_zoo/requirements.txt
-ENV PYTHONPATH=/model_zoo:$PYTHONPATH
+# the framework itself rides in the build context so the job image can
+# run `python -m elasticdl_tpu...` (the reference embeds the framework
+# wheel the same way, image_builder.py)
+ENV PYTHONPATH=/model_zoo:/model_zoo/{framework_dir}:$PYTHONPATH
 {cluster_spec_line}
 """
 
@@ -40,6 +45,7 @@ def write_dockerfile(zoo_path, base_image="python:3.10",
         base_image=base_image,
         pypi_flag=pypi_flag,
         cluster_spec_line=cluster_spec_line,
+        framework_dir=_FRAMEWORK_DIR,
     )
     dockerfile = os.path.join(zoo_path, "Dockerfile")
     with open(dockerfile, "w") as f:
@@ -57,12 +63,29 @@ def _docker(*cmd):
     subprocess.run(["docker", *cmd], check=True)
 
 
+def _copy_framework_into_context(zoo_path):
+    """Vendor the installed elasticdl_tpu package into the build context
+    so the image can run master/worker entrypoints."""
+    import elasticdl_tpu
+
+    src = os.path.dirname(os.path.abspath(elasticdl_tpu.__file__))
+    dst = os.path.join(zoo_path, _FRAMEWORK_DIR, "elasticdl_tpu")
+    if os.path.exists(dst):
+        shutil.rmtree(dst)
+    shutil.copytree(
+        src, dst,
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
+    )
+    return dst
+
+
 def build_image(zoo_path, image):
     """docker build the zoo directory (reference
     build_and_push_docker_image's build step)."""
     dockerfile = os.path.join(zoo_path, "Dockerfile")
     if not os.path.exists(dockerfile):
         write_dockerfile(zoo_path)
+    _copy_framework_into_context(zoo_path)
     _docker("build", "-t", image, zoo_path)
 
 

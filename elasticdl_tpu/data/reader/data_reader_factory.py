@@ -57,6 +57,7 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
         if (
             _odps_env() is not None
             and data_origin
+            and os.sep not in data_origin
             and not os.path.exists(data_origin)
         ):
             return _make_odps_reader(data_origin, kwargs)

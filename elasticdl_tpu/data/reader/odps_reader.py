@@ -51,14 +51,19 @@ class ODPSReader(object):
         self._num_prefetch = max(1, num_prefetch)
         self._window_size = window_size
 
-    def _read_window(self, start, count):
+    def _read_window(self, session, start, count):
+        """Read one window from the open `session` holder, reopening the
+        reader session only after a failure (one session per range, not
+        per window — session creation is a service round trip)."""
         last_error = None
-        for _ in range(_MAX_RETRIES):
+        for attempt in range(_MAX_RETRIES):
             try:
-                with self._table.open_reader() as reader:
-                    return list(reader.read(start, count))
+                if session[0] is None:
+                    session[0] = self._table.open_reader().__enter__()
+                return list(session[0].read(start, count))
             except Exception as e:  # retry transient fetch failures
                 last_error = e
+                session[0] = None
                 logger.warning(
                     "ODPS window read (%d, %d) failed: %s; retrying",
                     start, count, e,
@@ -75,10 +80,12 @@ class ODPSReader(object):
         results = queue.Queue(maxsize=self._num_prefetch)
 
         def producer():
+            session = [None]
             for w_start, w_count in windows:
                 try:
                     results.put(
-                        ("ok", self._read_window(w_start, w_count))
+                        ("ok",
+                         self._read_window(session, w_start, w_count))
                     )
                 except Exception as e:
                     results.put(("error", e))

@@ -1,0 +1,120 @@
+"""Host-spill embedding engine: tables too large for HBM.
+
+The third tier of the sparse embedding design (embedding/layer.py holds
+HBM-sharded tables; this holds host-DRAM tables), playing the role PS
+pod memory played in the reference: rows live host-side
+(native/host_embedding.cc store — or its numpy fallback), the device
+only ever sees the rows a batch touches.
+
+Two-phase step around the jit-compiled device computation:
+
+    unique_ids, rows, inverse = engine.pull(batch_ids)
+    # device: embed = rows[inverse]; forward/backward under jit;
+    # grads come back per unique row (dedup already done by pull)
+    engine.apply_gradients(unique_ids, row_grads)
+
+Optimizer slots are co-located host-side with constant-zero init
+(reference: slot tables use constant init —
+ps/embedding_table.py create_embedding_table / OptimizerWrapper)."""
+
+import numpy as np
+
+from elasticdl_tpu.native.host_embedding import HostEmbeddingStore
+
+_SLOT_NAMES = {
+    "sgd": (),
+    "momentum": ("momentum",),
+    "adam": ("m", "v"),
+    "adagrad": ("accumulator",),
+}
+
+
+class HostSpillEmbeddingEngine(object):
+    def __init__(self, dim, optimizer="adam", seed=0,
+                 init_low=-0.05, init_high=0.05, force_python=False,
+                 **hyperparams):
+        if optimizer not in _SLOT_NAMES:
+            raise ValueError(
+                "Unknown optimizer %r (supported: %s)"
+                % (optimizer, sorted(_SLOT_NAMES))
+            )
+        self.dim = dim
+        self.optimizer = optimizer
+        self.hyperparams = hyperparams
+        self.param = HostEmbeddingStore(
+            dim, seed=seed, init_low=init_low, init_high=init_high,
+            force_python=force_python,
+        )
+        # slot stores: constant-zero lazy init
+        self.slots = {
+            name: HostEmbeddingStore(
+                dim, seed=seed, init_low=0.0, init_high=0.0,
+                force_python=force_python,
+            )
+            for name in _SLOT_NAMES[optimizer]
+        }
+        self._step = 0
+
+    # ------------------------------------------------------------- pull
+
+    def pull(self, ids):
+        """Dedup `ids` (any shape) and fetch their rows.
+
+        Returns (unique_ids [k], rows [k, dim] float32, inverse with
+        the original shape) so the device computes
+        `rows[inverse]` — the dedup the reference worker does before
+        talking to the PS (worker.py:505-617)."""
+        ids = np.asarray(ids, np.int64)
+        unique_ids, inverse = np.unique(ids, return_inverse=True)
+        rows = self.param.lookup(unique_ids)
+        return unique_ids, rows, inverse.reshape(ids.shape)
+
+    # ------------------------------------------------------- apply grads
+
+    def apply_gradients(self, unique_ids, row_grads, lr=None):
+        """Apply per-unique-row gradients with the engine's optimizer.
+        Only these rows (and their slots) move."""
+        self._step += 1
+        hp = dict(self.hyperparams)
+        if lr is not None:
+            hp["lr"] = lr
+        hp.setdefault("lr", 0.001 if self.optimizer == "adam" else 0.1)
+        if self.optimizer == "sgd":
+            self.param.sgd(unique_ids, row_grads, hp["lr"])
+        elif self.optimizer == "momentum":
+            self.param.momentum(
+                self.slots["momentum"], unique_ids, row_grads,
+                hp["lr"], hp.get("momentum", 0.9),
+                hp.get("nesterov", False),
+            )
+        elif self.optimizer == "adam":
+            self.param.adam(
+                self.slots["m"], self.slots["v"], unique_ids, row_grads,
+                hp["lr"], hp.get("beta1", 0.9), hp.get("beta2", 0.999),
+                hp.get("eps", 1e-8), step=self._step,
+            )
+        elif self.optimizer == "adagrad":
+            self.param.adagrad(
+                self.slots["accumulator"], unique_ids, row_grads,
+                hp["lr"], hp.get("eps", 1e-10),
+            )
+
+    # ------------------------------------------------------- checkpoint
+
+    def state_dict(self):
+        """Exportable state: param + slot rows + step (the re-shardable
+        checkpoint payload, reference checkpoint.go SaveModelToCheckpoint
+        semantics)."""
+        ids, values = self.param.export_rows()
+        out = {"step": self._step, "param": (ids, values)}
+        for name, store in self.slots.items():
+            out[name] = store.export_rows()
+        return out
+
+    def load_state_dict(self, state):
+        self._step = int(state["step"])
+        ids, values = state["param"]
+        self.param.set_rows(ids, values)
+        for name, store in self.slots.items():
+            ids, values = state[name]
+            store.set_rows(ids, values)

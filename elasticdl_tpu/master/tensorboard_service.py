@@ -20,16 +20,23 @@ class TensorboardService(object):
         self._port = port
         self._writer = None
         self._tb_process = None
+        self._closed = False
 
     def _ensure_writer(self):
+        if self._closed:
+            return None
         if self._writer is None:
             self._writer = EventFileWriter(self._log_dir)
         return self._writer
 
     def write_dict_to_summary(self, dictionary, version):
         """Scalar per metric at step=version (reference
-        write_dict_to_summary, tensorboard_service.py:41-49)."""
+        write_dict_to_summary, tensorboard_service.py:41-49). Writes
+        after stop() are dropped (a worker RPC can race shutdown)."""
         writer = self._ensure_writer()
+        if writer is None:
+            logger.debug("Dropping metrics after stop(): %s", dictionary)
+            return
         for key, value in dictionary.items():
             try:
                 writer.add_scalar(key, float(value), version)
@@ -68,6 +75,7 @@ class TensorboardService(object):
         )
 
     def stop(self):
+        self._closed = True
         if self._writer:
             self._writer.close()
             self._writer = None

@@ -1,0 +1,271 @@
+"""Host-DRAM embedding store: ctypes bindings over
+elasticdl_tpu/native/host_embedding.cc, with a numpy fallback when the
+shared object hasn't been built (`make -C elasticdl_tpu/native`).
+
+This is the host-spill tier of the sparse embedding engine: tables too
+large for HBM keep their rows here (the role PS pod RAM played in the
+reference — ps/embedding_table.py / go/pkg/common/embedding_table.go),
+with lazy deterministic row init and the sparse optimizer kernel family
+applied host-side (go/pkg/kernel/capi/kernel_api.cc)."""
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "libhostembedding.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        c_i64 = ctypes.c_int64
+        c_f32p = ctypes.POINTER(ctypes.c_float)
+        c_i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.host_embedding_new.restype = ctypes.c_void_p
+        lib.host_embedding_new.argtypes = [
+            c_i64, ctypes.c_uint64, ctypes.c_float, ctypes.c_float,
+        ]
+        lib.host_embedding_free.argtypes = [ctypes.c_void_p]
+        lib.host_embedding_dim.restype = c_i64
+        lib.host_embedding_dim.argtypes = [ctypes.c_void_p]
+        lib.host_embedding_size.restype = c_i64
+        lib.host_embedding_size.argtypes = [ctypes.c_void_p]
+        lib.host_embedding_lookup.argtypes = [
+            ctypes.c_void_p, c_i64p, c_i64, c_f32p,
+        ]
+        lib.host_embedding_set.argtypes = [
+            ctypes.c_void_p, c_i64p, c_i64, c_f32p,
+        ]
+        lib.host_embedding_export.restype = c_i64
+        lib.host_embedding_export.argtypes = [
+            ctypes.c_void_p, c_i64p, c_f32p, c_i64,
+        ]
+        lib.host_embedding_sgd.argtypes = [
+            ctypes.c_void_p, c_i64p, c_f32p, c_i64, ctypes.c_float,
+        ]
+        lib.host_embedding_momentum.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, c_i64p, c_f32p, c_i64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int,
+        ]
+        lib.host_embedding_adam.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, c_i64p,
+            c_f32p, c_i64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, c_i64,
+        ]
+        lib.host_embedding_adagrad.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, c_i64p, c_f32p, c_i64,
+            ctypes.c_float, ctypes.c_float,
+        ]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available():
+    return _load() is not None
+
+
+def _as_ids(ids):
+    return np.ascontiguousarray(ids, dtype=np.int64)
+
+
+def _as_rows(values, dim):
+    out = np.ascontiguousarray(values, dtype=np.float32)
+    return out.reshape(-1, dim)
+
+
+class _NativeStore(object):
+    def __init__(self, dim, seed, init_low, init_high):
+        self._lib = _load()
+        self._handle = self._lib.host_embedding_new(
+            dim, seed, init_low, init_high
+        )
+        self.dim = dim
+
+    def __del__(self):
+        if getattr(self, "_handle", None) and _LIB is not None:
+            self._lib.host_embedding_free(self._handle)
+            self._handle = None
+
+    @staticmethod
+    def _ptr(arr, ctype):
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    def lookup(self, ids):
+        ids = _as_ids(ids)
+        out = np.empty((len(ids), self.dim), np.float32)
+        self._lib.host_embedding_lookup(
+            self._handle,
+            self._ptr(ids, ctypes.c_int64),
+            len(ids),
+            self._ptr(out, ctypes.c_float),
+        )
+        return out
+
+    def set_rows(self, ids, values):
+        ids = _as_ids(ids)
+        values = _as_rows(values, self.dim)
+        self._lib.host_embedding_set(
+            self._handle,
+            self._ptr(ids, ctypes.c_int64),
+            len(ids),
+            self._ptr(values, ctypes.c_float),
+        )
+
+    def __len__(self):
+        return int(self._lib.host_embedding_size(self._handle))
+
+    def export_rows(self):
+        n = len(self)
+        ids = np.empty((n,), np.int64)
+        values = np.empty((n, self.dim), np.float32)
+        written = 0
+        if n:
+            written = self._lib.host_embedding_export(
+                self._handle,
+                self._ptr(ids, ctypes.c_int64),
+                self._ptr(values, ctypes.c_float),
+                n,
+            )
+        return ids[:written], values[:written]
+
+    def sgd(self, ids, grads, lr):
+        ids = _as_ids(ids)
+        grads = _as_rows(grads, self.dim)
+        self._lib.host_embedding_sgd(
+            self._handle, self._ptr(ids, ctypes.c_int64),
+            self._ptr(grads, ctypes.c_float), len(ids), lr,
+        )
+
+    def momentum(self, vel, ids, grads, lr, mu=0.9, nesterov=False):
+        ids = _as_ids(ids)
+        grads = _as_rows(grads, self.dim)
+        self._lib.host_embedding_momentum(
+            self._handle, vel._handle, self._ptr(ids, ctypes.c_int64),
+            self._ptr(grads, ctypes.c_float), len(ids), lr, mu,
+            1 if nesterov else 0,
+        )
+
+    def adam(self, m, v, ids, grads, lr, beta1=0.9, beta2=0.999,
+             eps=1e-8, step=1):
+        ids = _as_ids(ids)
+        grads = _as_rows(grads, self.dim)
+        self._lib.host_embedding_adam(
+            self._handle, m._handle, v._handle,
+            self._ptr(ids, ctypes.c_int64),
+            self._ptr(grads, ctypes.c_float), len(ids),
+            lr, beta1, beta2, eps, step,
+        )
+
+    def adagrad(self, accum, ids, grads, lr, eps=1e-10):
+        ids = _as_ids(ids)
+        grads = _as_rows(grads, self.dim)
+        self._lib.host_embedding_adagrad(
+            self._handle, accum._handle,
+            self._ptr(ids, ctypes.c_int64),
+            self._ptr(grads, ctypes.c_float), len(ids), lr, eps,
+        )
+
+
+class _PythonStore(object):
+    """Same semantics in numpy (lazy deterministic init, sparse
+    updates); the no-native fallback."""
+
+    def __init__(self, dim, seed, init_low, init_high):
+        self.dim = dim
+        self._seed = seed
+        self._low = init_low
+        self._high = init_high
+        self._rows = {}
+        self._lock = threading.Lock()
+
+    def _init_row(self, row_id):
+        gen = np.random.default_rng(
+            (self._seed ^ (row_id * 0x9E3779B97F4A7C15)) % (2**64)
+        )
+        return gen.uniform(self._low, self._high, self.dim).astype(
+            np.float32
+        )
+
+    def _get(self, row_id):
+        row = self._rows.get(row_id)
+        if row is None:
+            with self._lock:
+                row = self._rows.setdefault(
+                    row_id, self._init_row(row_id)
+                )
+        return row
+
+    def lookup(self, ids):
+        return np.stack([self._get(int(i)) for i in _as_ids(ids)])
+
+    def set_rows(self, ids, values):
+        values = _as_rows(values, self.dim)
+        with self._lock:
+            for i, row_id in enumerate(_as_ids(ids)):
+                self._rows[int(row_id)] = values[i].copy()
+
+    def __len__(self):
+        return len(self._rows)
+
+    def export_rows(self):
+        if not self._rows:
+            return (np.empty((0,), np.int64),
+                    np.empty((0, self.dim), np.float32))
+        ids = np.fromiter(self._rows, np.int64, len(self._rows))
+        return ids, np.stack([self._rows[int(i)] for i in ids])
+
+    def sgd(self, ids, grads, lr):
+        grads = _as_rows(grads, self.dim)
+        for i, row_id in enumerate(_as_ids(ids)):
+            self._get(int(row_id))[:] -= lr * grads[i]
+
+    def momentum(self, vel, ids, grads, lr, mu=0.9, nesterov=False):
+        grads = _as_rows(grads, self.dim)
+        for i, row_id in enumerate(_as_ids(ids)):
+            p = self._get(int(row_id))
+            v = vel._get(int(row_id))
+            v[:] = mu * v + grads[i]
+            p[:] -= lr * ((mu * v + grads[i]) if nesterov else v)
+
+    def adam(self, m, v, ids, grads, lr, beta1=0.9, beta2=0.999,
+             eps=1e-8, step=1):
+        grads = _as_rows(grads, self.dim)
+        alpha = lr * np.sqrt(1 - beta2**step) / (1 - beta1**step)
+        for i, row_id in enumerate(_as_ids(ids)):
+            p = self._get(int(row_id))
+            mi = m._get(int(row_id))
+            vi = v._get(int(row_id))
+            mi[:] = beta1 * mi + (1 - beta1) * grads[i]
+            vi[:] = beta2 * vi + (1 - beta2) * grads[i] ** 2
+            p[:] -= alpha * mi / (np.sqrt(vi) + eps)
+
+    def adagrad(self, accum, ids, grads, lr, eps=1e-10):
+        grads = _as_rows(grads, self.dim)
+        for i, row_id in enumerate(_as_ids(ids)):
+            p = self._get(int(row_id))
+            a = accum._get(int(row_id))
+            a[:] += grads[i] ** 2
+            p[:] -= lr * grads[i] / (np.sqrt(a) + eps)
+
+
+def HostEmbeddingStore(dim, seed=0, init_low=-0.05, init_high=0.05,
+                       force_python=False):
+    """Factory: native store when libhostembedding.so is built, numpy
+    fallback otherwise. Default init matches the reference's Go table
+    (uniform [-0.05, 0.05], embedding_table.go:50-54)."""
+    if not force_python and available():
+        return _NativeStore(dim, seed, init_low, init_high)
+    return _PythonStore(dim, seed, init_low, init_high)

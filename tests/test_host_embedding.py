@@ -1,0 +1,193 @@
+"""Host-DRAM embedding store + host-spill engine: native C++ store vs
+the numpy fallback vs hand-computed updates (the reference tests its
+Eigen kernels the same way, go/pkg/kernel/kernel_test.go)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding.host_spill import HostSpillEmbeddingEngine
+from elasticdl_tpu.native import host_embedding
+from elasticdl_tpu.native.host_embedding import HostEmbeddingStore
+
+DIM = 8
+
+BACKENDS = [True]  # force_python
+if host_embedding.available():
+    BACKENDS.append(False)
+
+
+@pytest.fixture(params=BACKENDS, ids=lambda p: "py" if p else "native")
+def force_python(request):
+    return request.param
+
+
+def test_native_library_built():
+    """Build the native lib when a toolchain exists; otherwise the
+    numpy-fallback parametrization still covers the semantics (precedent:
+    tests/test_native_recordio.py skips without the .so)."""
+    if not host_embedding.available():
+        import shutil as sh
+        import subprocess
+
+        if sh.which("g++") is None:
+            pytest.skip("no g++ and no prebuilt libhostembedding.so")
+        subprocess.run(
+            ["make", "-C", "elasticdl_tpu/native"], check=True,
+            cwd=os.path.join(os.path.dirname(__file__), ".."),
+        )
+        pytest.skip("native lib built; rerun picks it up (load is "
+                    "cached per process)")
+
+
+def test_lazy_init_bounds_and_determinism(force_python):
+    store = HostEmbeddingStore(DIM, seed=3, force_python=force_python)
+    rows = store.lookup([5, 9, 5])
+    assert rows.shape == (3, DIM)
+    assert np.all(rows >= -0.05) and np.all(rows <= 0.05)
+    # same id -> same row; repeat lookup stable
+    np.testing.assert_array_equal(rows[0], rows[2])
+    np.testing.assert_array_equal(store.lookup([5])[0], rows[0])
+    assert len(store) == 2
+    # a fresh store with the same seed initializes identically
+    store2 = HostEmbeddingStore(DIM, seed=3, force_python=force_python)
+    np.testing.assert_array_equal(store2.lookup([9])[0], rows[1])
+
+
+def test_native_and_python_agree_on_updates():
+    """Both backends produce identical SGD math given identical rows."""
+    if not host_embedding.available():
+        pytest.skip("native lib not built")
+    ids = [1, 2, 3]
+    grads = np.random.RandomState(0).rand(3, DIM).astype(np.float32)
+    stores = []
+    for force in (False, True):
+        store = HostEmbeddingStore(DIM, seed=1, force_python=force)
+        rows = np.arange(3 * DIM, dtype=np.float32).reshape(3, DIM)
+        store.set_rows(ids, rows)
+        store.sgd(ids, grads, lr=0.5)
+        stores.append(store.lookup(ids))
+    np.testing.assert_allclose(stores[0], stores[1], rtol=1e-6)
+
+
+def test_sgd_update(force_python):
+    store = HostEmbeddingStore(DIM, force_python=force_python)
+    base = store.lookup([7]).copy()
+    g = np.ones((1, DIM), np.float32)
+    store.sgd([7], g, lr=0.1)
+    np.testing.assert_allclose(
+        store.lookup([7]), base - 0.1, rtol=1e-6
+    )
+
+
+def test_adam_update_matches_reference(force_python):
+    store = HostEmbeddingStore(DIM, force_python=force_python)
+    m = HostEmbeddingStore(DIM, init_low=0, init_high=0,
+                           force_python=force_python)
+    v = HostEmbeddingStore(DIM, init_low=0, init_high=0,
+                           force_python=force_python)
+    p0 = store.lookup([4]).copy()
+    g = np.full((1, DIM), 0.5, np.float32)
+    store.adam(m, v, [4], g, lr=0.01, step=1)
+    exp_m = 0.1 * g
+    exp_v = 0.001 * g * g
+    alpha = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    exp_p = p0 - alpha * exp_m / (np.sqrt(exp_v) + 1e-8)
+    np.testing.assert_allclose(store.lookup([4]), exp_p, rtol=1e-4)
+    np.testing.assert_allclose(m.lookup([4]), exp_m, rtol=1e-4)
+    np.testing.assert_allclose(v.lookup([4]), exp_v, rtol=1e-4)
+
+
+def test_momentum_and_adagrad(force_python):
+    p = HostEmbeddingStore(DIM, force_python=force_python)
+    vel = HostEmbeddingStore(DIM, init_low=0, init_high=0,
+                             force_python=force_python)
+    p0 = p.lookup([1]).copy()
+    g = np.full((1, DIM), 2.0, np.float32)
+    p.momentum(vel, [1], g, lr=0.1, mu=0.9)
+    np.testing.assert_allclose(p.lookup([1]), p0 - 0.2, rtol=1e-5)
+    np.testing.assert_allclose(vel.lookup([1]), g, rtol=1e-6)
+
+    pa = HostEmbeddingStore(DIM, force_python=force_python)
+    accum = HostEmbeddingStore(DIM, init_low=0, init_high=0,
+                               force_python=force_python)
+    pa0 = pa.lookup([2]).copy()
+    pa.adagrad(accum, [2], g, lr=0.1)
+    exp = pa0 - 0.1 * g / (np.sqrt(g * g) + 1e-10)
+    np.testing.assert_allclose(pa.lookup([2]), exp, rtol=1e-5)
+
+
+def test_export_set_roundtrip(force_python):
+    store = HostEmbeddingStore(DIM, force_python=force_python)
+    store.lookup([10, 20, 30])
+    ids, values = store.export_rows()
+    assert sorted(ids.tolist()) == [10, 20, 30]
+    store2 = HostEmbeddingStore(DIM, seed=99, force_python=force_python)
+    store2.set_rows(ids, values)
+    np.testing.assert_array_equal(
+        store2.lookup(sorted(ids)), store.lookup(sorted(ids))
+    )
+
+
+# ------------------------------------------------------------- engine
+
+
+def test_engine_pull_dedups(force_python):
+    engine = HostSpillEmbeddingEngine(
+        DIM, optimizer="sgd", force_python=force_python
+    )
+    ids = np.array([[3, 5], [5, 3]])
+    unique_ids, rows, inverse = engine.pull(ids)
+    assert unique_ids.tolist() == [3, 5]
+    assert rows.shape == (2, DIM)
+    assert inverse.shape == ids.shape
+    np.testing.assert_array_equal(unique_ids[inverse], ids)
+
+
+def test_engine_training_moves_only_touched_rows(force_python):
+    engine = HostSpillEmbeddingEngine(
+        DIM, optimizer="adam", lr=0.01, force_python=force_python
+    )
+    before = engine.param.lookup([1, 2, 3]).copy()
+    unique_ids, rows, _ = engine.pull([1, 3])
+    engine.apply_gradients(
+        unique_ids, np.ones((2, DIM), np.float32)
+    )
+    after = engine.param.lookup([1, 2, 3])
+    assert not np.allclose(after[0], before[0])
+    np.testing.assert_array_equal(after[1], before[1])  # untouched
+    assert not np.allclose(after[2], before[2])
+
+
+def test_engine_checkpoint_roundtrip(force_python):
+    engine = HostSpillEmbeddingEngine(
+        DIM, optimizer="adam", lr=0.01, force_python=force_python
+    )
+    unique_ids, _, _ = engine.pull([1, 2])
+    engine.apply_gradients(unique_ids, np.ones((2, DIM), np.float32))
+    state = engine.state_dict()
+
+    restored = HostSpillEmbeddingEngine(
+        DIM, optimizer="adam", lr=0.01, force_python=force_python
+    )
+    restored.load_state_dict(state)
+    np.testing.assert_array_equal(
+        restored.param.lookup([1, 2]), engine.param.lookup([1, 2])
+    )
+    np.testing.assert_array_equal(
+        restored.slots["m"].lookup([1, 2]),
+        engine.slots["m"].lookup([1, 2]),
+    )
+    # continued training stays in lockstep
+    engine.apply_gradients(unique_ids, np.ones((2, DIM), np.float32))
+    restored.apply_gradients(unique_ids, np.ones((2, DIM), np.float32))
+    np.testing.assert_allclose(
+        restored.param.lookup([1, 2]), engine.param.lookup([1, 2]),
+        rtol=1e-6,
+    )
+
+
+def test_engine_rejects_unknown_optimizer(force_python):
+    with pytest.raises(ValueError, match="Unknown optimizer"):
+        HostSpillEmbeddingEngine(DIM, optimizer="ftrl")
