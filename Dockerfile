@@ -1,0 +1,22 @@
+# Framework image: elasticdl_tpu + native libs + model zoo. Job images
+# built by `elasticdl-tpu zoo build` layer a user zoo onto an image like
+# this one (reference elasticdl/docker/Dockerfile).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make zlib1g-dev \
+    && rm -rf /var/lib/apt/lists/*
+
+# TPU-enabled jax on a TPU VM; the cpu extra works everywhere else.
+ARG JAX_VARIANT=tpu
+RUN pip install --no-cache-dir "jax[${JAX_VARIANT}]" flax optax \
+        grpcio protobuf numpy kubernetes
+
+COPY elasticdl_tpu /framework/elasticdl_tpu
+COPY model_zoo /framework/model_zoo
+COPY pyproject.toml README.md /framework/
+RUN make -C /framework/elasticdl_tpu/native \
+    && pip install --no-cache-dir -e /framework
+
+ENV PYTHONPATH=/framework
+WORKDIR /framework

@@ -10,6 +10,7 @@ available and unit-tested."""
 import os
 import shutil
 import subprocess
+import tempfile
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 
@@ -63,15 +64,13 @@ def _docker(*cmd):
     subprocess.run(["docker", *cmd], check=True)
 
 
-def _copy_framework_into_context(zoo_path):
+def _copy_framework_into_context(context_dir):
     """Vendor the installed elasticdl_tpu package into the build context
     so the image can run master/worker entrypoints."""
     import elasticdl_tpu
 
     src = os.path.dirname(os.path.abspath(elasticdl_tpu.__file__))
-    dst = os.path.join(zoo_path, _FRAMEWORK_DIR, "elasticdl_tpu")
-    if os.path.exists(dst):
-        shutil.rmtree(dst)
+    dst = os.path.join(context_dir, _FRAMEWORK_DIR, "elasticdl_tpu")
     shutil.copytree(
         src, dst,
         ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
@@ -80,13 +79,29 @@ def _copy_framework_into_context(zoo_path):
 
 
 def build_image(zoo_path, image):
-    """docker build the zoo directory (reference
-    build_and_push_docker_image's build step)."""
-    dockerfile = os.path.join(zoo_path, "Dockerfile")
-    if not os.path.exists(dockerfile):
-        write_dockerfile(zoo_path)
-    _copy_framework_into_context(zoo_path)
-    _docker("build", "-t", image, zoo_path)
+    """docker build the zoo directory via a TEMP build context (the
+    user's zoo dir is never mutated — no vendored framework or generated
+    Dockerfile lands in their source tree)."""
+    with tempfile.TemporaryDirectory(prefix="edl_tpu_build_") as ctx:
+        context_dir = os.path.join(ctx, "context")
+        shutil.copytree(
+            zoo_path, context_dir,
+            ignore=shutil.ignore_patterns(
+                "__pycache__", "*.pyc", _FRAMEWORK_DIR
+            ),
+        )
+        dockerfile = os.path.join(context_dir, "Dockerfile")
+        regenerate = not os.path.exists(dockerfile)
+        if not regenerate and _FRAMEWORK_DIR not in open(dockerfile).read():
+            logger.info(
+                "Existing Dockerfile predates framework vendoring; "
+                "regenerating it inside the build context"
+            )
+            regenerate = True
+        if regenerate:
+            write_dockerfile(context_dir)
+        _copy_framework_into_context(context_dir)
+        _docker("build", "-t", image, context_dir)
 
 
 def push_image(image):

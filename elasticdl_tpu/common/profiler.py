@@ -1,0 +1,50 @@
+"""Profiling helpers: XLA/TPU traces + the phase taxonomy.
+
+The reference's tracing story is wall-clock accumulation per phase
+(common/timing_utils.py {task_process, batch_process, get_model,
+report_gradient}); timing_utils.py here keeps that taxonomy. This module
+adds the TPU-native layer on top: `jax.profiler` device traces viewable
+in TensorBoard/Perfetto, and per-step trace annotations.
+
+    with profile_trace("/tmp/trace"):          # whole-program trace
+        ...
+    with step_annotation(step):                # names one train step
+        state, loss = trainer.train_step(...)
+"""
+
+import contextlib
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir, create_perfetto_link=False):
+    """Capture a jax.profiler trace into `log_dir` for the duration of
+    the block. Safe no-op if the profiler can't start (e.g. a second
+    concurrent trace)."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(
+            log_dir, create_perfetto_link=create_perfetto_link
+        )
+        started = True
+        logger.info("Profiler trace started -> %s", log_dir)
+    except Exception as e:
+        logger.warning("Could not start profiler trace: %s", e)
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+            logger.info("Profiler trace written to %s", log_dir)
+
+
+def step_annotation(step_num):
+    """Label one training step in the device trace (shows up as
+    `train_step` rows in the trace viewer)."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation("train_step",
+                                            step_num=int(step_num))

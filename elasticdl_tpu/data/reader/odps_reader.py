@@ -51,19 +51,31 @@ class ODPSReader(object):
         self._num_prefetch = max(1, num_prefetch)
         self._window_size = window_size
 
+    @staticmethod
+    def _close_session(session):
+        cm = session.pop("cm", None)
+        session["reader"] = None
+        if cm is not None:
+            try:
+                cm.__exit__(None, None, None)
+            except Exception:
+                pass
+
     def _read_window(self, session, start, count):
         """Read one window from the open `session` holder, reopening the
         reader session only after a failure (one session per range, not
         per window — session creation is a service round trip)."""
         last_error = None
-        for attempt in range(_MAX_RETRIES):
+        for _ in range(_MAX_RETRIES):
             try:
-                if session[0] is None:
-                    session[0] = self._table.open_reader().__enter__()
-                return list(session[0].read(start, count))
+                if session.get("reader") is None:
+                    cm = self._table.open_reader()
+                    session["cm"] = cm
+                    session["reader"] = cm.__enter__()
+                return list(session["reader"].read(start, count))
             except Exception as e:  # retry transient fetch failures
                 last_error = e
-                session[0] = None
+                self._close_session(session)
                 logger.warning(
                     "ODPS window read (%d, %d) failed: %s; retrying",
                     start, count, e,
@@ -80,17 +92,20 @@ class ODPSReader(object):
         results = queue.Queue(maxsize=self._num_prefetch)
 
         def producer():
-            session = [None]
-            for w_start, w_count in windows:
-                try:
-                    results.put(
-                        ("ok",
-                         self._read_window(session, w_start, w_count))
-                    )
-                except Exception as e:
-                    results.put(("error", e))
-                    return
-            results.put(("done", None))
+            session = {}
+            try:
+                for w_start, w_count in windows:
+                    try:
+                        results.put(
+                            ("ok",
+                             self._read_window(session, w_start, w_count))
+                        )
+                    except Exception as e:
+                        results.put(("error", e))
+                        return
+                results.put(("done", None))
+            finally:
+                self._close_session(session)
 
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()

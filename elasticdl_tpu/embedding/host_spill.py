@@ -112,9 +112,14 @@ class HostSpillEmbeddingEngine(object):
         return out
 
     def load_state_dict(self, state):
+        """Restore REPLACES store contents: rows materialized since the
+        checkpoint revert to their deterministic lazy-init values, so
+        restore-into-used-engine == restore-into-fresh-engine."""
         self._step = int(state["step"])
         ids, values = state["param"]
+        self.param.clear()
         self.param.set_rows(ids, values)
         for name, store in self.slots.items():
             ids, values = state[name]
+            store.clear()
             store.set_rows(ids, values)

@@ -16,12 +16,21 @@
 #include <cstdint>
 #include <cstring>
 #include <mutex>
-#include <random>
 #include <shared_mutex>
 #include <unordered_map>
 #include <vector>
 
 namespace {
+
+// splitmix64: tiny, portable PRNG implemented identically in the numpy
+// fallback (native/host_embedding.py _splitmix64) so both backends
+// lazily initialize the same (seed, id) to the same row.
+inline uint64_t splitmix64(uint64_t* state) {
+  uint64_t z = (*state += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
 
 struct Store {
   int64_t dim;
@@ -35,13 +44,18 @@ struct Store {
   Store(int64_t d, uint64_t s, float lo, float hi)
       : dim(d), seed(s), init_low(lo), init_high(hi) {}
 
-  // Deterministic per-(seed, id) lazy init so restarts and replicas
-  // agree without coordination.
+  // Deterministic per-(seed, id) lazy init so restarts, replicas, and
+  // the numpy fallback all agree without coordination.
   void init_row(int64_t id, std::vector<float>* row) const {
     row->resize(dim);
-    std::mt19937_64 gen(seed ^ static_cast<uint64_t>(id) * 0x9E3779B97F4A7C15ULL);
-    std::uniform_real_distribution<float> dist(init_low, init_high);
-    for (int64_t i = 0; i < dim; ++i) (*row)[i] = dist(gen);
+    uint64_t state = seed ^ static_cast<uint64_t>(id) * 0x9E3779B97F4A7C15ULL;
+    const float span = init_high - init_low;
+    for (int64_t i = 0; i < dim; ++i) {
+      // top 53 bits -> uniform double in [0, 1)
+      double frac = static_cast<double>(splitmix64(&state) >> 11)
+                    * (1.0 / 9007199254740992.0);
+      (*row)[i] = init_low + static_cast<float>(frac) * span;
+    }
   }
 
   // Caller must hold `mu` exclusively: batch ops lock once per call
@@ -69,6 +83,12 @@ void host_embedding_free(void* handle) {
 
 int64_t host_embedding_dim(void* handle) {
   return static_cast<Store*>(handle)->dim;
+}
+
+void host_embedding_clear(void* handle) {
+  Store* store = static_cast<Store*>(handle);
+  std::unique_lock<std::shared_mutex> lock(store->mu);
+  store->rows.clear();
 }
 
 int64_t host_embedding_size(void* handle) {

@@ -41,6 +41,7 @@ def _load():
         lib.host_embedding_dim.argtypes = [ctypes.c_void_p]
         lib.host_embedding_size.restype = c_i64
         lib.host_embedding_size.argtypes = [ctypes.c_void_p]
+        lib.host_embedding_clear.argtypes = [ctypes.c_void_p]
         lib.host_embedding_lookup.argtypes = [
             ctypes.c_void_p, c_i64p, c_i64, c_f32p,
         ]
@@ -127,6 +128,9 @@ class _NativeStore(object):
     def __len__(self):
         return int(self._lib.host_embedding_size(self._handle))
 
+    def clear(self):
+        self._lib.host_embedding_clear(self._handle)
+
     def export_rows(self):
         n = len(self)
         ids = np.empty((n,), np.int64)
@@ -179,6 +183,26 @@ class _NativeStore(object):
         )
 
 
+_MASK64 = (1 << 64) - 1
+
+
+def _splitmix64_row(seed, row_id, dim, low, high):
+    """Identical algorithm to the C++ store's init_row (splitmix64 over
+    seed ^ id*golden), so both backends initialize the same row."""
+    state = (seed ^ ((row_id * 0x9E3779B97F4A7C15) & _MASK64)) & _MASK64
+    out = np.empty((dim,), np.float32)
+    span = high - low
+    for i in range(dim):
+        state = (state + 0x9E3779B97F4A7C15) & _MASK64
+        z = state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK64
+        z = z ^ (z >> 31)
+        frac = (z >> 11) * (1.0 / 9007199254740992.0)
+        out[i] = low + np.float32(frac) * span
+    return out
+
+
 class _PythonStore(object):
     """Same semantics in numpy (lazy deterministic init, sparse
     updates); the no-native fallback."""
@@ -192,11 +216,8 @@ class _PythonStore(object):
         self._lock = threading.Lock()
 
     def _init_row(self, row_id):
-        gen = np.random.default_rng(
-            (self._seed ^ (row_id * 0x9E3779B97F4A7C15)) % (2**64)
-        )
-        return gen.uniform(self._low, self._high, self.dim).astype(
-            np.float32
+        return _splitmix64_row(
+            self._seed, row_id, self.dim, self._low, self._high
         )
 
     def _get(self, row_id):
@@ -219,6 +240,10 @@ class _PythonStore(object):
 
     def __len__(self):
         return len(self._rows)
+
+    def clear(self):
+        with self._lock:
+            self._rows.clear()
 
     def export_rows(self):
         if not self._rows:

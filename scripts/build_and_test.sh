@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# CI entry (reference scripts/build_and_test.sh): build native libs, run
+# the full pytest suite on the virtual 8-device CPU mesh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C elasticdl_tpu/native
+python -m pytest tests/ -q "$@"

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Poll a submitted job's pods until the master finishes — the CI
+validation step after `elasticdl-tpu train` (reference
+scripts/validate_job_status.py, 171 LoC: polls pod phases via the k8s
+API and exits nonzero if the job failed).
+
+Usage: validate_job_status.py <job_name> [namespace] [timeout_secs]
+"""
+
+import sys
+import time
+
+from elasticdl_tpu.common.k8s_client import Client
+
+
+def validate(job_name, namespace="default", timeout=1800,
+             poll_interval=10, core_api=None):
+    client = Client(
+        image_name="", namespace=namespace, job_name=job_name,
+        core_api=core_api,
+    )
+    deadline = time.time() + timeout
+    master_name = client.get_master_pod_name()
+    while time.time() < deadline:
+        pod = client.get_pod(master_name)
+        if pod is None:
+            print("master pod %s not found" % master_name)
+            time.sleep(poll_interval)
+            continue
+        status = (
+            pod.get("status", {}) if isinstance(pod, dict)
+            else pod.status
+        )
+        phase = (
+            status.get("phase") if isinstance(status, dict)
+            else status.phase
+        )
+        print("master phase: %s" % phase)
+        if phase == "Succeeded":
+            return 0
+        if phase == "Failed":
+            return 1
+        time.sleep(poll_interval)
+    print("timed out after %ds" % timeout)
+    return 2
+
+
+if __name__ == "__main__":
+    job = sys.argv[1]
+    ns = sys.argv[2] if len(sys.argv) > 2 else "default"
+    t = int(sys.argv[3]) if len(sys.argv) > 3 else 1800
+    sys.exit(validate(job, ns, t))

@@ -192,3 +192,46 @@ def test_collective_rejects_bad_op():
     assert status == CollectiveCommunicatorStatus.FAILED
     status, _ = comm.allreduce(None)
     assert status == CollectiveCommunicatorStatus.FAILED
+
+
+# ------------------------------------------------------------ profiler
+
+
+def test_profile_trace_writes_trace(tmp_path):
+    import glob as _glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.common.profiler import (
+        profile_trace,
+        step_annotation,
+    )
+
+    with profile_trace(str(tmp_path)):
+        with step_annotation(0):
+            jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+    files = _glob.glob(str(tmp_path / "**" / "*.xplane.pb"),
+                       recursive=True)
+    assert files, "no xplane trace written"
+
+
+def test_validate_job_status_fake_api():
+    from scripts.validate_job_status import validate
+
+    class FakeApi(object):
+        def __init__(self, phases):
+            self._phases = phases
+
+        def read_namespaced_pod(self, namespace, name):
+            phase = (
+                self._phases.pop(0) if len(self._phases) > 1
+                else self._phases[0]
+            )
+            return {"status": {"phase": phase}}
+
+    ok = validate("j", core_api=FakeApi(["Running", "Succeeded"]),
+                  poll_interval=0)
+    assert ok == 0
+    bad = validate("j", core_api=FakeApi(["Failed"]), poll_interval=0)
+    assert bad == 1

@@ -2,8 +2,6 @@
 the numpy fallback vs hand-computed updates (the reference tests its
 Eigen kernels the same way, go/pkg/kernel/kernel_test.go)."""
 
-import os
-
 import numpy as np
 import pytest
 
@@ -24,21 +22,12 @@ def force_python(request):
 
 
 def test_native_library_built():
-    """Build the native lib when a toolchain exists; otherwise the
+    """Informational gate: skip (not fail) when the .so is absent — the
     numpy-fallback parametrization still covers the semantics (precedent:
-    tests/test_native_recordio.py skips without the .so)."""
+    tests/test_native_recordio.py). Build with
+    `make -C elasticdl_tpu/native`."""
     if not host_embedding.available():
-        import shutil as sh
-        import subprocess
-
-        if sh.which("g++") is None:
-            pytest.skip("no g++ and no prebuilt libhostembedding.so")
-        subprocess.run(
-            ["make", "-C", "elasticdl_tpu/native"], check=True,
-            cwd=os.path.join(os.path.dirname(__file__), ".."),
-        )
-        pytest.skip("native lib built; rerun picks it up (load is "
-                    "cached per process)")
+        pytest.skip("libhostembedding.so not built")
 
 
 def test_lazy_init_bounds_and_determinism(force_python):
@@ -191,3 +180,14 @@ def test_engine_checkpoint_roundtrip(force_python):
 def test_engine_rejects_unknown_optimizer(force_python):
     with pytest.raises(ValueError, match="Unknown optimizer"):
         HostSpillEmbeddingEngine(DIM, optimizer="ftrl")
+
+
+def test_lazy_init_identical_across_backends():
+    """splitmix64 init must agree bit-for-bit between C++ and numpy
+    (divergent lazy init would silently fork replica models)."""
+    if not host_embedding.available():
+        pytest.skip("libhostembedding.so not built")
+    native = HostEmbeddingStore(DIM, seed=42, force_python=False)
+    python = HostEmbeddingStore(DIM, seed=42, force_python=True)
+    ids = [0, 1, 7, 123456789, 2**40]
+    np.testing.assert_array_equal(native.lookup(ids), python.lookup(ids))
