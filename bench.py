@@ -1,70 +1,225 @@
-"""Headline benchmark: training throughput of the flagship model on real
+"""Headline benchmark: transformer-LM training throughput + MFU on real
 hardware. Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 The reference publishes no hardware throughput numbers (BASELINE.md), so
-vs_baseline is measured against the target set in BASELINE.json round 1
-(established here); until a prior round exists, vs_baseline=1.0.
+the baseline is *established* here: round 1 produced no number (its TPU
+backend crashed on init), so vs_baseline stays 1.0 until a prior round's
+tokens/sec exists to compare against.
+
+Robustness contract (VERDICT.md round-1 item #1): the TPU backend in this
+environment is a tunneled PJRT plugin that can crash or hang on init. The
+accelerator is therefore probed in a *subprocess* with a hard deadline; on
+probe failure the bench falls back to CPU (clearly tagged
+"platform": "cpu") rather than crashing or hanging, so the driver always
+records a JSON line with rc=0.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+# Peak bf16 matmul FLOP/s per chip, by TPU generation (public specs).
+_PEAK_FLOPS = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+_PROBE_CODE = r"""
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+(x @ x).block_until_ready()
+d = jax.devices()[0]
+print("PROBE_OK|%s|%s" % (jax.default_backend(),
+                          getattr(d, "device_kind", "") or ""))
+"""
 
 
-def main():
+def probe_accelerator(timeout_s):
+    """Try to initialize the ambient (TPU) backend in a child process.
+
+    Returns (backend, device_kind) on success with a non-CPU backend,
+    else (None, None). The child is killed on timeout, so a hung PJRT
+    tunnel cannot hang the bench itself.
+    """
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("bench: accelerator probe timed out after %ss\n"
+                         % timeout_s)
+        return None, None
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write("bench: accelerator probe error: %r\n" % (e,))
+        return None, None
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("PROBE_OK|"):
+            _, backend, kind = line.split("|", 2)
+            if backend != "cpu":
+                return backend, kind
+            sys.stderr.write("bench: probe found only CPU backend\n")
+            return None, None
+    tail = (r.stderr or "")[-2000:]
+    sys.stderr.write("bench: accelerator probe failed (rc=%s):\n%s\n"
+                     % (r.returncode, tail))
+    return None, None
+
+
+def _peak_flops(device_kind):
+    kind = (device_kind or "").lower().replace("tpu", "").strip(" -_")
+    for key, peak in _PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    # tunneled plugins may hide the kind; fall back to the generation
+    # advertised by the tunnel env, else assume v5e (this pool's chip)
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    return _PEAK_FLOPS.get(gen, _PEAK_FLOPS["v5e"])
+
+
+def transformer_flops_per_step(batch, seq, d_model, n_layers, vocab):
+    """Matmul FLOPs for one fwd+bwd train step (backward = 2x forward).
+
+    Per token forward: qkv (2*d*3d) + attn proj (2*d*d) + MLP
+    (2*d*4d in + 2*4d*d out) = 24*d^2; attention scores+values add
+    4*seq*d per token per layer; LM head 2*d*vocab.
+    """
+    per_token_layer = 24 * d_model * d_model + 4 * seq * d_model
+    fwd = batch * seq * (n_layers * per_token_layer + 2 * d_model * vocab)
+    return 3 * fwd
+
+
+def run_transformer_bench(on_tpu):
+    import jax
     import numpy as np
 
     from elasticdl_tpu.common.model_utils import load_model_spec_from_module
     from elasticdl_tpu.parallel import mesh as mesh_lib
     from elasticdl_tpu.training.trainer import Trainer
-    from model_zoo.mnist_functional_api import mnist_functional_api as zoo
+    from model_zoo.transformer_lm import transformer_lm as zoo
 
-    batch_size = 1024
+    if on_tpu:
+        cfg = dict(vocab_size=32000, seq_len=1024, embed_dim=512,
+                   num_heads=8, num_layers=8)
+        batch_size, iters, warmup = 32, 30, 5
+    else:
+        # CPU fallback: same code path, toy size (the number is tagged
+        # "platform": "cpu" and is not a hardware claim)
+        cfg = dict(vocab_size=1024, seq_len=128, embed_dim=128,
+                   num_heads=4, num_layers=2)
+        batch_size, iters, warmup = 8, 10, 2
+
+    from elasticdl_tpu.common.model_utils import format_params_str
+
+    params = dict(cfg)
+    if on_tpu:
+        params["dtype"] = "bf16"
+    model_params = format_params_str(params)
+
     spec = load_model_spec_from_module(zoo)
     mesh = mesh_lib.build_mesh()  # all available chips, dp-filled
-    trainer = Trainer(spec, mesh=mesh)
+    trainer = Trainer(spec, mesh=mesh, model_params=model_params)
 
     rng = np.random.RandomState(0)
-    features = {"image": rng.rand(batch_size, 28, 28).astype(np.float32)}
-    labels = rng.randint(10, size=(batch_size,)).astype(np.int32)
-    batch = (features, labels)
+    tokens = rng.randint(
+        0, cfg["vocab_size"], size=(batch_size, cfg["seq_len"] + 1)
+    ).astype(np.int32)
+    batch = ({"tokens": tokens[:, :-1]}, tokens[:, 1:])
 
     state = trainer.init_state(batch)
     # Pre-stage the batch in HBM with the batch sharding: the benchmark
-    # measures the compiled step, not host->device transfer (a real input
-    # pipeline double-buffers transfers behind the step).
-    import jax
-
+    # measures the compiled step (a real input pipeline double-buffers
+    # host->device transfers behind the step).
     batch = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
-    # warmup (compile + first steps)
-    for _ in range(5):
+
+    for _ in range(warmup):
         state, loss = trainer.train_step(state, batch)
     jax.block_until_ready(state.params)
 
-    iters = 50
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss = trainer.train_step(state, batch)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
+    assert np.isfinite(float(loss)), "non-finite loss in bench"
 
     n_chips = max(1, len(jax.devices()))
-    samples_per_sec = batch_size * iters / dt
-    value = samples_per_sec / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_cnn_train_throughput_per_chip",
-                "value": round(value, 2),
-                "unit": "samples/sec/chip",
-                "vs_baseline": 1.0,
-            }
-        )
+    dev = jax.devices()[0]
+    step_time = dt / iters
+    tokens_per_sec = batch_size * cfg["seq_len"] * iters / dt
+    flops = transformer_flops_per_step(
+        batch_size, cfg["seq_len"], cfg["embed_dim"], cfg["num_layers"],
+        cfg["vocab_size"],
     )
+    platform = jax.default_backend()
+    if platform == "cpu":
+        mfu = None
+    else:
+        mfu = round(flops / step_time / (_peak_flops(
+            getattr(dev, "device_kind", "")) * n_chips), 4)
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree.leaves(state.params))
+    return {
+        "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / n_chips, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "mfu": mfu,
+        "samples_per_sec_per_chip": round(
+            batch_size * iters / dt / n_chips, 2),
+        "step_time_ms": round(step_time * 1e3, 2),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "") or platform,
+        "params_m": round(n_params / 1e6, 1),
+        "config": cfg,
+        "batch_size": batch_size,
+    }
+
+
+def main():
+    probe_timeout = float(os.environ.get("EDL_BENCH_PROBE_TIMEOUT", "300"))
+    backend, kind = probe_accelerator(probe_timeout)
+    on_tpu = backend is not None
+    if not on_tpu:
+        # Pin CPU before the first in-process jax import so a broken TPU
+        # tunnel can't crash or hang backend init (round-1 failure mode).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        sys.stderr.write("bench: accelerator ready: %s (%s)\n"
+                         % (backend, kind))
+
+    try:
+        result = run_transformer_bench(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        if not on_tpu:
+            raise
+        # One retry without the Pallas kernels (flash attention): an
+        # unproven Mosaic lowering must degrade to the XLA path, not
+        # kill the bench.
+        sys.stderr.write("bench: TPU run failed (%r); retrying with "
+                         "Pallas disabled\n" % (e,))
+        os.environ["ELASTICDL_TPU_DISABLE_PALLAS"] = "1"
+        result = run_transformer_bench(on_tpu)
+        result["pallas_disabled"] = True
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -53,6 +53,13 @@ def get_dict_from_params_str(params_str):
     return out
 
 
+def format_params_str(params):
+    """Inverse of get_dict_from_params_str: render a dict as the
+    'k1=v1; k2=v2' wire format, repr-ing values so strings survive the
+    eval on the parse side."""
+    return "; ".join("%s=%r" % (k, v) for k, v in params.items())
+
+
 def _get_spec_value(spec_key, model_zoo, default_module, required=False):
     """Resolve a spec item either from the model-def module (bare name) or a
     separate module path 'a.b.name' under model_zoo

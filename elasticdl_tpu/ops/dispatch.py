@@ -20,7 +20,25 @@ def use_pallas():
 
 
 def interpret_mode():
-    """interpret= flag for pallas_call: compiled only on a real TPU."""
+    """interpret= flag for pallas_call: compiled only on a real TPU.
+
+    The TPU backend may register under a plugin platform name (e.g. a
+    tunneled PJRT plugin) rather than "tpu", so identify hardware by the
+    device's platform/kind, not the backend string alone.
+    """
     if os.environ.get("ELASTICDL_TPU_FORCE_INTERPRET", "") == "1":
         return True
-    return jax.default_backend() != "tpu"
+    return not is_tpu_backend()
+
+
+def is_tpu_backend():
+    """True when the default backend is real TPU hardware (including
+    TPU plugins registered under a non-"tpu" platform name)."""
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return True
+    if backend in ("cpu", "gpu", "cuda", "rocm"):
+        return False
+    # Unknown plugin platform: the only plugins this framework targets
+    # are TPU tunnels, so treat it as TPU hardware.
+    return True

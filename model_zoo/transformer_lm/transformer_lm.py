@@ -19,7 +19,7 @@ from flax import linen as nn
 
 from elasticdl_tpu.common.constants import MeshAxis, Mode
 from elasticdl_tpu.data.example_codec import decode_example
-from elasticdl_tpu.ops.attention import flash_attention
+from elasticdl_tpu.ops.attention import blockwise_attention, flash_attention
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.parallel.context_parallel import ring_attention
 
@@ -27,39 +27,50 @@ from elasticdl_tpu.parallel.context_parallel import ring_attention
 class CausalSelfAttention(nn.Module):
     num_heads: int
     head_dim: int
+    dtype: object = None  # compute dtype (bf16 on TPU); params stay fp32
+    attn_impl: str = "auto"  # "auto": Pallas flash on TPU; "xla": blockwise
 
     @nn.compact
     def __call__(self, x, training=False):
         b, l, e = x.shape
         h, d = self.num_heads, self.head_dim
-        qkv = nn.Dense(3 * h * d, use_bias=False, name="qkv")(x)
+        qkv = nn.Dense(
+            3 * h * d, use_bias=False, dtype=self.dtype, name="qkv"
+        )(x)
         qkv = qkv.reshape(b, l, 3, h, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:
             out = ring_attention(q, k, v, mesh, causal=True)
+        elif self.attn_impl == "xla":
+            out = blockwise_attention(q, k, v, causal=True)
         else:
             out = flash_attention(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
-        return nn.Dense(e, use_bias=False, name="proj")(out)
+        return nn.Dense(
+            e, use_bias=False, dtype=self.dtype, name="proj"
+        )(out)
 
 
 class Block(nn.Module):
     num_heads: int
     head_dim: int
     mlp_ratio: int = 4
+    dtype: object = None
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, training=False):
         e = x.shape[-1]
-        y = nn.LayerNorm()(x)
-        x = x + CausalSelfAttention(self.num_heads, self.head_dim)(
-            y, training
-        )
-        y = nn.LayerNorm()(x)
-        y = nn.Dense(self.mlp_ratio * e)(y)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.head_dim, dtype=self.dtype,
+            attn_impl=self.attn_impl,
+        )(y, training)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(y)
         y = nn.gelu(y)
-        y = nn.Dense(e)(y)
+        y = nn.Dense(e, dtype=self.dtype)(y)
         return x + y
 
 
@@ -69,25 +80,50 @@ class TransformerLM(nn.Module):
     embed_dim: int = 128
     num_heads: int = 4
     num_layers: int = 2
+    dtype: object = None  # compute dtype; None = fp32
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, features, training=False):
         tokens = features["tokens"]  # int32 [b, seq_len]
-        x = nn.Embed(self.vocab_size, self.embed_dim, name="wte")(tokens)
-        pos = nn.Embed(self.seq_len, self.embed_dim, name="wpe")(
-            jnp.arange(tokens.shape[1])[None, :]
-        )
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype, name="wte"
+        )(tokens)
+        pos = nn.Embed(
+            self.seq_len, self.embed_dim, dtype=self.dtype, name="wpe"
+        )(jnp.arange(tokens.shape[1])[None, :])
         x = x + pos
         head_dim = self.embed_dim // self.num_heads
         for i in range(self.num_layers):
-            x = Block(self.num_heads, head_dim, name="block_%d" % i)(
-                x, training
-            )
-        x = nn.LayerNorm(name="ln_f")(x)
-        return nn.Dense(self.vocab_size, use_bias=False, name="head")(x)
+            x = Block(
+                self.num_heads, head_dim, dtype=self.dtype,
+                attn_impl=self.attn_impl, name="block_%d" % i,
+            )(x, training)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=self.dtype, name="head"
+        )(x)
+        # loss math (softmax xent) wants fp32 logits regardless of the
+        # compute dtype
+        return logits.astype(jnp.float32)
+
+
+_DTYPES = {
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "fp32": jnp.float32, "float32": jnp.float32,
+    "fp16": jnp.float16, "float16": jnp.float16,
+}
 
 
 def custom_model(**kwargs):
+    dtype = kwargs.get("dtype")
+    if isinstance(dtype, str):
+        if dtype.lower() not in _DTYPES:
+            raise ValueError(
+                "Unknown dtype %r for transformer_lm (valid: %s)"
+                % (dtype, sorted(_DTYPES))
+            )
+        kwargs["dtype"] = _DTYPES[dtype.lower()]
     return TransformerLM(**kwargs)
 
 
