@@ -85,7 +85,11 @@ def _unflatten_into(state, flat):
         new_leaves.append(arr)
     if missing:
         raise ValueError(
-            "Checkpoint is missing %d leaves, e.g. %s"
+            "Checkpoint is missing %d leaves, e.g. %s. A common cause is "
+            "a changed optimizer-state layout — e.g. an embedding table "
+            "crossing the sparse-grad threshold (embedding/sparse_update"
+            ".py) between save and restore; pin sparse_grads on the layer "
+            "to restore older checkpoints."
             % (len(missing), missing[:3])
         )
     return treedef.unflatten(new_leaves)

@@ -63,23 +63,16 @@ def get_initializer(name_or_fn):
     raise ValueError("Unknown embeddings_initializer %r" % name_or_fn)
 
 
-def safe_embedding_lookup(table, ids, combiner="mean", weights=None):
-    """Combined lookup over padded ragged ids (PADDING_ID = absent).
-
-    Parity with the reference's safe_embedding_lookup_sparse re-impl
-    (embedding_delegate.py:108-230): rows with no ids yield zero vectors;
-    `weights`, when given, weight each id's vector before combining (and the
-    mean/sqrtn denominators use weight totals, as in TF).
-
-    table: [vocab, dim]; ids: int [batch, max_ids]; weights: float like ids.
-    Returns [batch, dim].
-    """
-    mask = (ids != PADDING_ID).astype(table.dtype)
+def combine_gathered(gathered, ids, combiner="mean", weights=None):
+    """Combiner math over already-gathered rows [B, L, D]; see
+    safe_embedding_lookup. Split out so the sparse-grad tap can sit
+    between the gather and the (linear-in-rows) combiner."""
+    dtype = gathered.dtype
+    mask = (ids != PADDING_ID).astype(dtype)
     if weights is not None:
-        w = jnp.asarray(weights, table.dtype) * mask
+        w = jnp.asarray(weights, dtype) * mask
     else:
         w = mask
-    gathered = jnp.take(table, jnp.maximum(ids, 0), axis=0)  # [B, L, D]
     summed = jnp.einsum("bl,bld->bd", w, gathered)
     if combiner == "sum":
         return summed
@@ -91,6 +84,22 @@ def safe_embedding_lookup(table, ids, combiner="mean", weights=None):
     else:
         raise ValueError("Unknown combiner %r" % combiner)
     return summed / jnp.maximum(denom, 1e-12)
+
+
+def safe_embedding_lookup(table, ids, combiner="mean", weights=None):
+    """Combined lookup over padded ragged ids (PADDING_ID = absent).
+
+    Parity with the reference's safe_embedding_lookup_sparse re-impl
+    (embedding_delegate.py:108-230): rows with no ids yield zero vectors;
+    `weights`, when given, weight each id's vector before combining (and the
+    mean/sqrtn denominators use weight totals, as in TF).
+
+    table: [vocab, dim]; ids: int [batch, max_ids]; weights: float like ids.
+    Returns [batch, dim].
+    """
+    gathered = jnp.take(table, jnp.maximum(ids, 0), axis=0)  # [B, L, D]
+    return combine_gathered(gathered, ids, combiner=combiner,
+                            weights=weights)
 
 
 class Embedding(nn.Module):
@@ -110,6 +119,15 @@ class Embedding(nn.Module):
     embeddings_initializer: str = "uniform"
     combiner: str = None
     param_dtype: jnp.dtype = jnp.float32
+    # Row-sparse gradient tap (embedding/sparse_update.py). None = auto:
+    # tables >= constants.EMBEDDING_PARTITION_THRESHOLD_BYTES (the global
+    # 2 MB default — NOT the Trainer's embedding_partition_threshold
+    # kwarg, which governs sharding only) stop-gradient the dense table
+    # and expose per-row grads through a flax perturbation, so training
+    # cost per step is O(touched rows) instead of O(vocab) — the TPU
+    # analogue of the reference auto-moving layers > 2 MB to the PS
+    # (common/model_handler.py:98-102). Set True/False to override.
+    sparse_grads: bool = None
 
     @nn.compact
     def __call__(self, ids, weights=None):
@@ -120,22 +138,85 @@ class Embedding(nn.Module):
             self.param_dtype,
         )
         ids = jnp.asarray(ids)
-        if self.combiner is None:
-            return jnp.take(table, jnp.maximum(ids, 0), axis=0)
-        if ids.ndim != 2:
+        if self.combiner is not None and ids.ndim != 2:
             raise ValueError(
                 "combiner=%r needs [batch, max_ids] padded ids, got shape %s"
                 % (self.combiner, ids.shape)
             )
-        return safe_embedding_lookup(
-            table, ids, combiner=self.combiner, weights=weights
+        sparse = self._sparse_enabled() and self._tap_active()
+        lookup_table = jax.lax.stop_gradient(table) if sparse else table
+        gathered = jnp.take(lookup_table, jnp.maximum(ids, 0), axis=0)
+        if sparse:
+            gathered = self._tap_rows(gathered, ids)
+        if self.combiner is None:
+            return gathered
+        return combine_gathered(
+            gathered, ids, combiner=self.combiner, weights=weights
         )
+
+    # ------------------------------------------------ sparse-grad tap
+
+    def _sparse_enabled(self):
+        if self.sparse_grads is not None:
+            return self.sparse_grads
+        from elasticdl_tpu.common import constants
+
+        itemsize = jnp.dtype(self.param_dtype).itemsize
+        return (
+            self.input_dim * self.output_dim * itemsize
+            >= constants.EMBEDDING_PARTITION_THRESHOLD_BYTES
+        )
+
+    def _tap_active(self):
+        """Mirror nn.Module.perturb's activation rule: the tap is live
+        during init (collection mutable) and whenever the caller passes
+        the perturbations collection to apply. Plain inference applies
+        (no perturbations) take the ordinary dense path."""
+        if self.scope is None:
+            return False
+        from elasticdl_tpu.embedding.sparse_update import PERTURB_COLLECTION
+
+        if self.is_mutable_collection(PERTURB_COLLECTION):
+            return True
+        try:
+            return PERTURB_COLLECTION in self.scope.root._variables
+        except Exception:
+            return False
+
+    def _tap_rows(self, gathered, ids):
+        from elasticdl_tpu.embedding.sparse_update import (
+            PERTURB_COLLECTION,
+            PERTURB_NAME,
+            SPARSE_IDS_COLLECTION,
+        )
+
+        if self.is_mutable_collection(PERTURB_COLLECTION) and (
+            self.scope.has_variable(PERTURB_COLLECTION, PERTURB_NAME)
+        ):
+            # Same restriction the reference hits: an embedding layer
+            # called twice per forward breaks the grad bookkeeping
+            # (worker.py:689-699 forces eager mode there; here we fail
+            # fast). Instantiate one Embedding per call site instead.
+            raise ValueError(
+                "sparse-grad Embedding %r called more than once per "
+                "forward; use one layer instance per call site or set "
+                "sparse_grads=False" % self.name
+            )
+        out = self.perturb(PERTURB_NAME, gathered)
+        self.sow(SPARSE_IDS_COLLECTION, "ids", ids)
+        return out
 
 
 def is_embedding_path(path):
-    """True if a pytree key path addresses an embedding table param."""
-    return any(
-        getattr(k, "key", None) == EMBEDDING_PARAM_NAME
-        or getattr(k, "name", None) == EMBEDDING_PARAM_NAME
-        for k in path
-    )
+    """True if a pytree key path addresses an embedding table param (or a
+    leaf of the per-table row-optimizer state, whose dict key is the
+    table's serialized path — embedding/sparse_update.py path_str)."""
+    for k in path:
+        key = getattr(k, "key", None)
+        if key is None:
+            key = getattr(k, "name", None)
+        if key == EMBEDDING_PARAM_NAME:
+            return True
+        if isinstance(key, str) and key.endswith("/" + EMBEDDING_PARAM_NAME):
+            return True
+    return False

@@ -1,0 +1,222 @@
+"""Row-sparse embedding update engine: O(touched rows) per step.
+
+The reference's OptimizerWrapper (ps/optimizer_wrapper.py:70-351) moves
+ONLY the embedding rows a minibatch touched — it looks rows + slot values
+up from the PS kv store, applies the stock optimizer to those rows, and
+writes them back. `make_row_sparse` (sparse_optim.py) reproduces the
+*semantics* with a dense update + mask, which costs O(vocab) memory
+traffic per step; this module reproduces the *cost model* too:
+
+* the Embedding layer stop-gradients its table and taps the gathered
+  rows with a flax perturbation (`Embedding._tap_rows`), so the backward
+  pass produces a [batch, ids, dim] row-gradient instead of a dense
+  [vocab, dim] scatter-add — nothing O(vocab) is materialized;
+* the layer sows the minibatch ids in the `edl_sparse_ids` collection;
+* the Trainer excludes tapped tables from the dense optax transform
+  (optax.multi_transform with set_to_zero) and instead calls
+  `apply_row_updates`: dedup ids (static shapes), gather the touched
+  rows and their optimizer-state rows, run the *same* optax transform on
+  just those rows, scatter results back in place (donated buffers).
+
+Per-step cost: O(batch_ids * dim) reads/writes regardless of vocab,
+which is the Go PS's cost model (go/pkg/ps/optimizer.go per-row kernel
+dispatch) rebuilt on XLA gather/scatter.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import traverse_util
+
+from elasticdl_tpu.embedding.layer import EMBEDDING_PARAM_NAME
+from elasticdl_tpu.ops.embedding_ops import dedup_indexed_slices
+
+# Collection the Embedding layer sows minibatch ids into.
+SPARSE_IDS_COLLECTION = "edl_sparse_ids"
+# Collection + leaf name of the row-gradient tap (flax perturbations).
+PERTURB_COLLECTION = "perturbations"
+PERTURB_NAME = "rows"
+
+
+def sparse_table_paths(perturb_tree):
+    """Map each perturbation tap to its embedding-table param path.
+
+    The layer names its tap `rows` at its own module path, so the table
+    lives at the same path with leaf name EMBEDDING_PARAM_NAME.
+    Returns {table_path_tuple: perturb_path_tuple} (paths are flax
+    flatten_dict key tuples within the params / perturbations trees).
+    """
+    flat = traverse_util.flatten_dict(_plain_dict(perturb_tree))
+    out = {}
+    for path in flat:
+        if path and path[-1] == PERTURB_NAME:
+            out[path[:-1] + (EMBEDDING_PARAM_NAME,)] = path
+    return out
+
+
+def _plain_dict(tree):
+    try:
+        from flax.core import unfreeze
+
+        return unfreeze(tree)
+    except Exception:  # already a plain mapping
+        return dict(tree)
+
+
+def path_str(path):
+    return "/".join(str(p) for p in path)
+
+
+def make_label_tree(params, sparse_paths):
+    """Per-leaf labels for optax.multi_transform: 'sparse' for tapped
+    embedding tables (their dense grads are identically zero — the layer
+    stop-gradients the table), 'dense' for everything else. Built with
+    tree_map so the label tree's pytree structure matches params
+    exactly (dict / FrozenDict agnostic)."""
+    sset = {tuple(str(x) for x in p) for p in sparse_paths}
+
+    def label(key_path, _leaf):
+        keys = tuple(
+            str(getattr(k, "key", getattr(k, "name", k)))
+            for k in key_path
+        )
+        return "sparse" if keys in sset else "dense"
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+def split_dense_tx(tx, sparse_paths):
+    """Wrap `tx` so tapped tables are excluded from the dense update."""
+    if not sparse_paths:
+        return tx
+    sset = set(sparse_paths)
+    return optax.multi_transform(
+        {"dense": tx, "sparse": optax.set_to_zero()},
+        lambda params: make_label_tree(params, sset),
+    )
+
+
+def init_row_opt_states(row_tx, params, sparse_paths):
+    """{table_path_str: row_tx.init(table)} — the per-table optimizer
+    slots (Adam mu/nu etc.), co-shaped with the table so the sharding
+    rules place slot rows next to their embedding rows (the reference
+    keeps slot tables on the same PS shard, ps/parameters.py
+    create_slot_params)."""
+    flat = traverse_util.flatten_dict(_plain_dict(params))
+    return {
+        path_str(p): row_tx.init(flat[p]) for p in sorted(sparse_paths)
+    }
+
+
+def _get_path(tree, path):
+    node = tree
+    for k in path:
+        node = node[k]
+    return node
+
+
+def _set_path(tree, path, value):
+    """Replace one leaf, preserving the tree's exact pytree structure
+    (dict vs FrozenDict) so optimizer/sharding trees keep matching."""
+    target = tuple(str(p) for p in path)
+
+    def repl(key_path, leaf):
+        keys = tuple(
+            str(getattr(k, "key", getattr(k, "name", k)))
+            for k in key_path
+        )
+        return value if keys == target else leaf
+
+    return jax.tree_util.tree_map_with_path(repl, tree)
+
+
+def row_sparse_apply(row_tx, table, row_opt_state, ids, row_grads):
+    """Apply `row_tx` to exactly the rows named by `ids`.
+
+    ids: int [n] (may repeat; PADDING_ID/-1 entries are dropped);
+    row_grads: [n, dim] gradient wrt the gathered rows.
+    Returns (new_table, new_row_opt_state). All data movement is
+    O(n * dim); scalar state leaves (step counts) advance globally,
+    matching the reference where the wrapped optimizer's `iterations`
+    is shared (optimizer_wrapper.py applies through the stock optimizer).
+    """
+    vocab = table.shape[0]
+    ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+    row_grads = row_grads.reshape(ids.shape[0], -1).astype(table.dtype)
+    uniq, summed = dedup_indexed_slices(ids, row_grads)
+    safe = jnp.clip(uniq, 0, vocab - 1)
+    # out-of-range and padding ids must not scatter anywhere: .at[] wraps
+    # negatives, so push them past the table and drop
+    scatter_ids = jnp.where((uniq < 0) | (uniq >= vocab), vocab, uniq)
+
+    def gather_rows(leaf):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == vocab:
+            return jnp.take(leaf, safe, axis=0)
+        return leaf
+
+    row_params = jnp.take(table, safe, axis=0)
+    row_state = jax.tree.map(gather_rows, row_opt_state)
+    updates, new_row_state = row_tx.update(summed, row_state, row_params)
+    new_table = table.at[scatter_ids].add(
+        updates.astype(table.dtype), mode="drop"
+    )
+
+    k = uniq.shape[0]
+
+    def scatter_rows(old, new):
+        if (
+            getattr(old, "ndim", 0) >= 1
+            and old.shape[0] == vocab
+            and getattr(new, "shape", None) == (k,) + old.shape[1:]
+        ):
+            return old.at[scatter_ids].set(
+                new.astype(old.dtype), mode="drop"
+            )
+        return new
+
+    new_opt_state = jax.tree.map(scatter_rows, row_opt_state, new_row_state)
+    return new_table, new_opt_state
+
+
+def extract_ids(ids_collection, perturb_path):
+    """The sown ids for a tap: sow() stores a 1-tuple per call. The layer
+    raises on double calls at init time; a second call that only happens
+    under training=True would slip past that guard and sum both call
+    sites' gradients into one tap, so fail loudly here too."""
+    node = _get_path(_plain_dict(ids_collection), perturb_path[:-1])
+    ids = node["ids"]
+    if isinstance(ids, (tuple, list)):
+        if len(ids) != 1:
+            raise ValueError(
+                "sparse-grad Embedding at %r was called %d times in one "
+                "forward; its row gradients cannot be attributed. Use one "
+                "layer instance per call site or set sparse_grads=False."
+                % ("/".join(perturb_path[:-1]), len(ids))
+            )
+        ids = ids[0]
+    return ids
+
+
+def apply_row_updates(row_tx, params, embed_opt_state, perturb_grads,
+                      ids_collection, sparse_paths):
+    """Run the row-sparse update for every tapped table.
+
+    params: full params tree (tables still at their original paths);
+    perturb_grads: grads of the perturbation tree (dL/d gathered rows);
+    ids_collection: the sown `edl_sparse_ids` collection from the same
+    forward. Returns (new_params, new_embed_opt_state).
+    """
+    new_params = params
+    new_embed = dict(embed_opt_state)
+    pg_flat = traverse_util.flatten_dict(_plain_dict(perturb_grads))
+    for table_path, perturb_path in sorted(sparse_paths.items()):
+        key = path_str(table_path)
+        table = _get_path(params, table_path)
+        ids = extract_ids(ids_collection, perturb_path)
+        grads = pg_flat[perturb_path]
+        new_table, new_state = row_sparse_apply(
+            row_tx, table, embed_opt_state[key], ids, grads
+        )
+        new_params = _set_path(new_params, table_path, new_table)
+        new_embed[key] = new_state
+    return new_params, new_embed

@@ -45,6 +45,10 @@ class TrainState:
     opt_state: any = struct.field(pytree_node=True)
     model_state: any = struct.field(pytree_node=True)  # batch_stats etc.
     rng: jax.Array = struct.field(pytree_node=True)
+    # Per-table row-optimizer slots for sparse-grad embedding tables
+    # ({table_path_str: optax state}); empty for dense-only models.
+    # See embedding/sparse_update.py.
+    embed_opt_state: any = struct.field(pytree_node=True, default_factory=dict)
 
     @property
     def version(self):
@@ -80,10 +84,20 @@ class Trainer(object):
         if callbacks is None and model_spec.callbacks_fn is not None:
             callbacks = model_spec.callbacks_fn()
         tx = _apply_lr_scheduler(tx, callbacks)
-        # Row-sparse embedding semantics (reference OptimizerWrapper:
-        # untouched rows and slots don't move). Identity for models
-        # without embedding tables.
+        # The raw transform: reused per-table by the row-sparse engine
+        # (embedding/sparse_update.py — optax state leaves are
+        # elementwise, so applying the same tx to gathered rows is the
+        # reference OptimizerWrapper's "stock optimizer on looked-up
+        # rows+slots", ps/optimizer_wrapper.py:70-351).
+        self._base_tx = tx
+        # Row-sparse embedding semantics for small (non-tapped) tables
+        # (dense update + mask: untouched rows and slots don't move).
+        # Identity for models without embedding tables.
         self.tx = make_row_sparse(tx)
+        # Filled by init_state once the model structure is known:
+        self._sparse_paths = {}
+        self._train_tx = None
+        self._perturb_shapes = {}
         self.embedding_partition_threshold = embedding_partition_threshold
         self.mesh = mesh if mesh is not None else mesh_lib.local_mesh()
         self.seed = seed
@@ -112,10 +126,35 @@ class Trainer(object):
         `_run_model_call_before_training`); here the same "first batch
         defines the variables" contract seeds a sharded jit init.
         """
+        from elasticdl_tpu.embedding import sparse_update
+
         features, _ = _split_label(example_batch)
         features = jax.tree.map(jnp.asarray, features)
         root_rng = jax.random.PRNGKey(self.seed)
         init_rng, state_rng = jax.random.split(root_rng)
+
+        # Structure pass: discover sparse-grad embedding taps (flax
+        # perturbations the layer creates at init) and derive the dense
+        # transform that excludes those tables.
+        var_shapes = jax.eval_shape(
+            lambda r, f: self.model.init(
+                {"params": r, "dropout": r}, f, training=False
+            ),
+            init_rng, features,
+        )
+        perturb_shapes = dict(var_shapes).get(
+            sparse_update.PERTURB_COLLECTION, {}
+        )
+        self._perturb_shapes = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
+            perturb_shapes,
+        )
+        self._sparse_paths = sparse_update.sparse_table_paths(
+            perturb_shapes
+        )
+        self._train_tx = sparse_update.split_dense_tx(
+            self.tx, set(self._sparse_paths)
+        )
 
         def init_fn(rng, feats):
             variables = self.model.init(
@@ -123,13 +162,19 @@ class Trainer(object):
             )
             variables = dict(variables)
             params = variables.pop("params")
-            opt_state = self.tx.init(params)
+            variables.pop(sparse_update.PERTURB_COLLECTION, None)
+            variables.pop(sparse_update.SPARSE_IDS_COLLECTION, None)
+            opt_state = self._train_tx.init(params)
+            embed_opt = sparse_update.init_row_opt_states(
+                self._base_tx, params, self._sparse_paths
+            )
             return TrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=params,
                 opt_state=opt_state,
                 model_state=FrozenDict(variables),
                 rng=state_rng,
+                embed_opt_state=embed_opt,
             )
 
         state_shapes = jax.eval_shape(init_fn, init_rng, features)
@@ -162,23 +207,42 @@ class Trainer(object):
         return self.spec.loss(labels, predictions)
 
     def _build_train_step(self):
+        from elasticdl_tpu.embedding import sparse_update
+
         batch_sh = mesh_lib.batch_sharding(self.mesh)
         repl = mesh_lib.replicated(self.mesh)
+        tx = self._train_tx if self._train_tx is not None else self.tx
+        sparse_paths = self._sparse_paths
+        perturb_shapes = self._perturb_shapes
+        ids_coll = sparse_update.SPARSE_IDS_COLLECTION
 
         def train_step(state, features, labels, weights):
             dropout_rng = jax.random.fold_in(state.rng, state.step)
+            # The row-grad taps are identically-zero perturbations
+            # rebuilt every step (XLA folds the zeros); their gradients
+            # are the per-row embedding grads.
+            perturbs = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), perturb_shapes
+            )
 
-            def loss_fn(params):
+            def loss_fn(params, perturbs):
                 variables = {"params": params, **state.model_state}
+                if sparse_paths:
+                    variables[sparse_update.PERTURB_COLLECTION] = perturbs
                 mutable = [k for k in state.model_state if k != "params"]
+                if sparse_paths:
+                    mutable = mutable + [ids_coll]
                 if mutable:
-                    preds, new_model_state = self.model.apply(
+                    preds, new_mut = self.model.apply(
                         variables,
                         features,
                         training=True,
                         mutable=mutable,
                         rngs={"dropout": dropout_rng},
                     )
+                    new_mut = dict(new_mut)
+                    ids = new_mut.pop(ids_coll, {})
+                    new_model_state = new_mut
                 else:
                     preds = self.model.apply(
                         variables,
@@ -187,27 +251,36 @@ class Trainer(object):
                         rngs={"dropout": dropout_rng},
                     )
                     new_model_state = state.model_state
+                    ids = {}
                 return (
                     self._compute_loss(labels, preds, weights),
-                    new_model_state,
+                    (new_model_state, ids),
                 )
 
-            (loss_val, new_model_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(state.params)
-            updates, new_opt_state = self.tx.update(
-                grads, state.opt_state, state.params
+            (loss_val, (new_model_state, ids)), grads = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(state.params, perturbs)
+            param_grads, perturb_grads = grads
+            updates, new_opt_state = tx.update(
+                param_grads, state.opt_state, state.params
             )
             new_params = jax.tree.map(
                 lambda p, u: (p + u).astype(p.dtype),
                 state.params,
                 updates,
             )
+            embed_opt = state.embed_opt_state
+            if sparse_paths:
+                new_params, embed_opt = sparse_update.apply_row_updates(
+                    self._base_tx, new_params, embed_opt,
+                    perturb_grads, ids, sparse_paths,
+                )
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,
                 opt_state=new_opt_state,
                 model_state=FrozenDict(new_model_state),
+                embed_opt_state=embed_opt,
             )
             return new_state, loss_val
 
