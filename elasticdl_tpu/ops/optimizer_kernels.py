@@ -9,6 +9,15 @@ kernels run under the Pallas interpreter on CPU (tests).
 
 The update rules live in update_math.py, shared with the sparse row
 kernels and the pure-jnp fallback (ELASTICDL_TPU_DISABLE_PALLAS=1).
+
+Measured on TPU v5e (scripts/bench_optimizer_kernels.py, 64M f32 params,
+chained fetch-forced timing): Pallas and XLA-fused optax are identical
+within noise — SGD 3.47 vs 3.46 ms, Adam 5.00 vs 5.04 ms (~230/375 GB/s;
+HBM-bound either way). The Trainer therefore keeps stock optax, which XLA
+additionally fuses into the compiled train step; these kernels remain the
+standalone/native update path (parity with the reference's kernel API)
+and the TPU smoke suite (tests/test_tpu_smoke.py) proves them compiled
+on hardware.
 """
 
 import math

@@ -17,8 +17,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 _platform = os.environ.get("EDL_TPU_TEST_PLATFORM", "cpu")
-os.environ["JAX_PLATFORMS"] = _platform
+if _platform in ("tpu", "ambient"):
+    # Hardware rig (tests/test_tpu_smoke.py): let jax pick the ambient
+    # accelerator. Pinning JAX_PLATFORMS=tpu here can select a local
+    # libtpu registration instead of the tunneled plugin and fail with
+    # "No jellyfish device found".
+    os.environ.pop("JAX_PLATFORMS", None)
 
-import jax  # noqa: E402
+    import jax  # noqa: E402
+else:
+    os.environ["JAX_PLATFORMS"] = _platform
 
-jax.config.update("jax_platforms", _platform)
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", _platform)
