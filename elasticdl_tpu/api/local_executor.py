@@ -52,6 +52,9 @@ class LocalExecutor(object):
         self.trainer = Trainer(
             model_spec, mesh=mesh, model_params=model_params, seed=seed
         )
+        from elasticdl_tpu.embedding.host_bridge import attach_from_spec
+
+        self._host_manager = attach_from_spec(self.trainer, model_spec)
         self.state = None
         self.losses = []
         self._checkpoint_dir_for_init = checkpoint_dir_for_init
@@ -63,6 +66,11 @@ class LocalExecutor(object):
                 checkpoint_dir,
                 checkpoint_steps=checkpoint_steps,
                 keep_max_version=keep_checkpoint_max,
+                extra_state_fn=(
+                    self._host_manager.flat_state
+                    if self._host_manager
+                    else None
+                ),
             )
 
     def _reader(self, data_origin):
@@ -92,12 +100,14 @@ class LocalExecutor(object):
             padded, _ = pad_batch(batch, self.minibatch_size)
             self.state = self.trainer.init_state(padded)
             if self._checkpoint_dir_for_init:
-                from elasticdl_tpu.checkpoint import (
-                    restore_state_from_checkpoint,
+                from elasticdl_tpu.embedding.host_bridge import (
+                    restore_with_host_state,
                 )
 
-                self.state, version = restore_state_from_checkpoint(
-                    self.state, self._checkpoint_dir_for_init
+                self.state, version = restore_with_host_state(
+                    self.state,
+                    self._host_manager,
+                    self._checkpoint_dir_for_init,
                 )
                 logger.info(
                     "Restored model version %d from %s",

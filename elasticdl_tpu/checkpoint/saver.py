@@ -105,10 +105,15 @@ class CheckpointSaver(object):
     """
 
     def __init__(self, checkpoint_dir, checkpoint_steps=0,
-                 keep_max_version=0, num_shards=None):
+                 keep_max_version=0, num_shards=None,
+                 extra_state_fn=None):
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_steps = int(checkpoint_steps)
         self.keep_max_version = int(keep_max_version)
+        # Optional () -> {keystr: ndarray} merged into every save — the
+        # host-spill embedding engines ride the same sharded checkpoint
+        # (embedding/host_bridge.HostEmbeddingManager.flat_state).
+        self.extra_state_fn = extra_state_fn
         self.num_shards = int(
             num_shards if num_shards is not None else jax.process_count()
         )
@@ -137,6 +142,8 @@ class CheckpointSaver(object):
         """Write version-<V> atomically (temp dir + rename), then prune."""
         version = int(version)
         flat = flatten_state(state)
+        if self.extra_state_fn is not None:
+            flat.update(self.extra_state_fn())
         final_dir = self._version_dir(version)
         os.makedirs(self.checkpoint_dir, exist_ok=True)
 
@@ -302,8 +309,15 @@ def load_checkpoint(checkpoint_dir, version=None):
     return flat, version
 
 
+def restore_state_from_flat(state, flat):
+    """Rebuild a TrainState-shaped pytree from an already-loaded flat
+    checkpoint dict, re-sharded to `state`'s own shardings. Extra keys
+    (e.g. host-embedding engine state) are ignored here."""
+    return _unflatten_into(state, flat)
+
+
 def restore_state_from_checkpoint(state, checkpoint_dir, version=None):
     """Rebuild a TrainState-shaped pytree from a checkpoint, re-sharded to
     `state`'s own shardings. Returns (new_state, restored_version)."""
     flat, version = load_checkpoint(checkpoint_dir, version)
-    return _unflatten_into(state, flat), version
+    return restore_state_from_flat(state, flat), version

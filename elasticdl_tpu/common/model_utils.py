@@ -97,6 +97,7 @@ class ModelSpec(object):
         callbacks_fn=None,
         feature_shapes=None,
         module=None,
+        host_embeddings_fn=None,
     ):
         self.model_fn = model_fn
         self.dataset_fn = dataset_fn
@@ -108,6 +109,9 @@ class ModelSpec(object):
         self.callbacks_fn = callbacks_fn
         self.feature_shapes = feature_shapes
         self.module = module
+        # Optional zoo convention `host_embeddings()` declaring host-DRAM
+        # resident tables (embedding/host_bridge.build_manager_from_spec).
+        self.host_embeddings_fn = host_embeddings_fn
 
     def create_model(self, model_params_str=""):
         kwargs = get_dict_from_params_str(model_params_str)
@@ -154,6 +158,7 @@ def get_model_spec(
         callbacks_fn=module.get(callbacks, None),
         feature_shapes=module.get("feature_shapes", None),
         module=module,
+        host_embeddings_fn=module.get("host_embeddings", None),
     )
 
 
@@ -172,4 +177,5 @@ def load_model_spec_from_module(module):
         callbacks_fn=d.get("callbacks"),
         feature_shapes=d.get("feature_shapes"),
         module=module,
+        host_embeddings_fn=d.get("host_embeddings"),
     )

@@ -1,0 +1,275 @@
+"""Host-spill embedding bridge: trains models whose embedding tables live
+in host DRAM (embedding/host_spill.HostSpillEmbeddingEngine) — the third
+storage tier after replicated-HBM and sharded-HBM tables.
+
+This is the TPU-native integration of the reference's PS-resident
+embedding path (ps/embedding_table.py:23-136 + worker.py:380-409
+pull_embedding_vectors / :505-617 report_gradient_to_ps): where the
+reference worker RPC'd rows out of PS pod memory before the forward and
+RPC'd row gradients back after the backward, here the host side of the
+*same process* pulls rows out of the C++ host store before the compiled
+step and applies row gradients after it:
+
+    features = manager.prepare(features)   # pull + dedup, host-side
+    state, loss, host_grads = compiled_train_step(...)
+    manager.apply(host_grads)              # native row optimizer update
+
+Inside the jit step the pulled rows are an ordinary *differentiable
+input* (`<table>.rows` [cap, dim]): the backward of `rows[idx]` is the
+scatter-add XLA inserts, so the per-unique-row gradient needs no custom
+machinery at all — `jax.grad` w.r.t. the rows input IS the deduped row
+gradient the reference assembled by hand (tensor_utils
+deduplicate_indexed_slices).
+
+Static shapes: the pulled-row count varies per batch, so rows are padded
+to a fixed cap (the id tensor's size rounded up), keeping one compiled
+step. Scope: per-process tables (the reference's PS pods were also
+per-pod stores); the SPMD multi-host path shards HBM tables instead
+(parallel/sharding.py).
+"""
+
+import numpy as np
+from flax import linen as nn
+import jax.numpy as jnp
+
+from elasticdl_tpu.embedding.layer import PADDING_ID, combine_gathered
+
+# Feature-key suffixes the manager adds and HostEmbedding consumes.
+ROWS_SUFFIX = ".rows"
+IDX_SUFFIX = ".idx"
+
+# Checkpoint key prefix for engine state (merged into the sharded
+# checkpoint's flat {keystr: ndarray} map, checkpoint/saver.py).
+CKPT_PREFIX = ".host_embeddings"
+
+
+class HostEmbedding(nn.Module):
+    """Model-side lookup over pre-pulled host rows.
+
+    A drop-in for embedding.Embedding when the table is registered with a
+    HostEmbeddingManager under `table`: reads `<table>.rows` (the pulled
+    unique rows) and `<table>.idx` (each id slot's row index) from the
+    features dict the manager prepared. With a combiner, `ids_feature`
+    names the raw padded-ragged id tensor used for the PADDING_ID mask
+    (reference Embedding._sparse_input_call semantics).
+    """
+
+    table: str
+    ids_feature: str = None
+    combiner: str = None
+
+    @nn.compact
+    def __call__(self, features, weights=None):
+        rows = jnp.asarray(features[self.table + ROWS_SUFFIX])
+        idx = jnp.asarray(features[self.table + IDX_SUFFIX])
+        gathered = jnp.take(rows, idx, axis=0)
+        if self.combiner is None:
+            return gathered
+        if self.ids_feature is None:
+            raise ValueError(
+                "HostEmbedding(table=%r): combiner=%r needs ids_feature "
+                "for the padding mask" % (self.table, self.combiner)
+            )
+        ids = jnp.asarray(features[self.ids_feature])
+        return combine_gathered(
+            gathered, ids, combiner=self.combiner, weights=weights
+        )
+
+
+class _HostTable(object):
+    def __init__(self, name, ids_feature, engine):
+        self.name = name
+        self.ids_feature = ids_feature
+        self.engine = engine
+        self.last_unique = None
+
+
+def _round_up(n, k):
+    return ((n + k - 1) // k) * k
+
+
+class HostEmbeddingManager(object):
+    """Owns the host engines and the pull/apply halves of the step."""
+
+    def __init__(self, pad_multiple=8):
+        self._tables = {}
+        self.pad_multiple = int(pad_multiple)
+
+    def register(self, name, ids_feature, engine):
+        if name in self._tables:
+            raise ValueError("host table %r already registered" % name)
+        self._tables[name] = _HostTable(name, ids_feature, engine)
+        return self
+
+    def __bool__(self):
+        return bool(self._tables)
+
+    def tables(self):
+        return dict(self._tables)
+
+    def rows_keys(self):
+        """Feature keys holding differentiable pulled rows, sorted for a
+        stable compiled-signature order."""
+        return tuple(sorted(n + ROWS_SUFFIX for n in self._tables))
+
+    # -------------------------------------------------------------- pull
+
+    def prepare(self, features):
+        """Pull + dedup each registered table's rows for this batch.
+
+        Returns a new features dict with `<table>.rows` [cap, dim] f32 and
+        `<table>.idx` (id-tensor shape, int32) added. PADDING_ID ids map
+        to row 0 — their gradient contribution is zeroed by the combiner
+        mask / the model's own mask, exactly like the reference's padded
+        lookups (embedding_delegate.py safe lookup).
+        """
+        features = dict(features)
+        for name, t in self._tables.items():
+            ids = np.asarray(features[t.ids_feature])
+            clean = np.where(ids == PADDING_ID, 0, ids).astype(np.int64)
+            unique_ids, rows, inverse = t.engine.pull(clean)
+            cap = _round_up(max(int(ids.size), 1), self.pad_multiple)
+            padded = np.zeros((cap, t.engine.dim), np.float32)
+            padded[: unique_ids.size] = rows
+            features[name + ROWS_SUFFIX] = padded
+            features[name + IDX_SUFFIX] = inverse.astype(np.int32)
+            t.last_unique = unique_ids
+        return features
+
+    # ------------------------------------------------------------- apply
+
+    def apply(self, host_grads, lr_scale=1.0):
+        """Apply the step's row gradients ({rows_key: [cap, dim]}, the
+        grads of the compiled step w.r.t. the pulled rows) through each
+        engine's native optimizer. Must follow the prepare() that fed the
+        same step. `lr_scale` multiplies each engine's own lr — the LR
+        scheduler the Trainer compiled into the dense chain applies to
+        host rows through this knob."""
+        # Materialize EVERY table's gradients before mutating ANY engine:
+        # np.asarray is where async device errors surface, and engines
+        # update in place — an error after table 1 of 2 would otherwise
+        # leave a half-applied step that a retry double-applies.
+        staged = []
+        for name, t in self._tables.items():
+            if t.last_unique is None:
+                raise RuntimeError(
+                    "apply() before prepare() for host table %r" % name
+                )
+            grads = np.asarray(host_grads[name + ROWS_SUFFIX])
+            staged.append((t, grads[: t.last_unique.size]))
+        for t, grads in staged:
+            t.engine.apply_gradients(
+                t.last_unique, grads, lr_scale=lr_scale
+            )
+
+    # -------------------------------------------------------- checkpoint
+
+    def flat_state(self):
+        """Engine state as checkpoint leaves {keystr: ndarray}, merged
+        into the sharded checkpoint next to the TrainState leaves."""
+        out = {}
+        for name, t in self._tables.items():
+            sd = t.engine.state_dict()
+            base = "%s['%s']" % (CKPT_PREFIX, name)
+            out[base + ".step"] = np.asarray(sd["step"], np.int64)
+            for key, value in sd.items():
+                if key == "step":
+                    continue
+                ids, values = value
+                out["%s.%s.ids" % (base, key)] = np.asarray(ids)
+                out["%s.%s.values" % (base, key)] = np.asarray(values)
+        return out
+
+    def load_flat_state(self, flat):
+        """Inverse of flat_state(); restore REPLACES engine contents
+        (host_spill.load_state_dict semantics)."""
+        for name, t in self._tables.items():
+            base = "%s['%s']" % (CKPT_PREFIX, name)
+            step_key = base + ".step"
+            if step_key not in flat:
+                raise KeyError(
+                    "checkpoint has no host-embedding state for table %r"
+                    % name
+                )
+            state = {"step": int(flat[step_key])}
+            for key in ["param"] + list(t.engine.slots):
+                state[key] = (
+                    flat["%s.%s.ids" % (base, key)],
+                    flat["%s.%s.values" % (base, key)],
+                )
+            t.engine.load_state_dict(state)
+
+
+def build_manager_from_spec(spec, force_python=False):
+    """Construct a HostEmbeddingManager from the zoo convention: a module
+    -level `host_embeddings()` returning
+
+        {table_name: dict(ids_feature=..., dim=..., optimizer="adam",
+                          <hyperparams>)}
+
+    Returns None when the spec declares no host tables. (The reference's
+    analogue is the model handler auto-moving Embedding layers to the PS;
+    host placement here is an explicit model declaration, because HBM
+    sharding — not host DRAM — is the default home for big tables.)
+    """
+    from elasticdl_tpu.embedding.host_spill import HostSpillEmbeddingEngine
+
+    fn = getattr(spec, "host_embeddings_fn", None)
+    if fn is None:
+        return None
+    config = fn()
+    if not config:
+        return None
+    manager = HostEmbeddingManager()
+    for name, cfg in config.items():
+        cfg = dict(cfg)
+        ids_feature = cfg.pop("ids_feature")
+        dim = cfg.pop("dim")
+        engine = HostSpillEmbeddingEngine(
+            dim, force_python=force_python, **cfg
+        )
+        manager.register(name, ids_feature, engine)
+    return manager
+
+
+def attach_from_spec(trainer, spec, force_python=False):
+    """Build the manager a spec declares (if any) and attach it to the
+    trainer. The single wiring point shared by Worker and LocalExecutor.
+    Returns the manager or None."""
+    manager = build_manager_from_spec(spec, force_python=force_python)
+    if manager:
+        trainer.attach_host_embeddings(manager)
+    return manager
+
+
+def restore_host_state(manager, checkpoint_dir, version=None):
+    """Restore engine state from a checkpoint that was written with the
+    manager's flat_state() merged in (see CheckpointSaver extra_state_fn).
+
+    Callers that already restored the TrainState should prefer
+    restore_with_host_state (ONE checkpoint read, one version).
+    """
+    from elasticdl_tpu.checkpoint.saver import load_checkpoint
+
+    flat, version = load_checkpoint(checkpoint_dir, version)
+    manager.load_flat_state(flat)
+    return version
+
+
+def restore_with_host_state(state, manager, checkpoint_dir, version=None):
+    """Restore the TrainState AND (when `manager` is truthy) the host
+    engines from one checkpoint read — the shared resume path for Worker
+    and LocalExecutor. A single load also pins both tiers to the same
+    version: resolving "latest" twice could straddle a concurrent save
+    and mix dense params from version N with host rows from N+k.
+    Returns (new_state, version)."""
+    from elasticdl_tpu.checkpoint.saver import (
+        load_checkpoint,
+        restore_state_from_flat,
+    )
+
+    flat, version = load_checkpoint(checkpoint_dir, version)
+    new_state = restore_state_from_flat(state, flat)
+    if manager:
+        manager.load_flat_state(flat)
+    return new_state, version

@@ -71,14 +71,17 @@ class HostSpillEmbeddingEngine(object):
 
     # ------------------------------------------------------- apply grads
 
-    def apply_gradients(self, unique_ids, row_grads, lr=None):
+    def apply_gradients(self, unique_ids, row_grads, lr=None, lr_scale=1.0):
         """Apply per-unique-row gradients with the engine's optimizer.
-        Only these rows (and their slots) move."""
+        Only these rows (and their slots) move. `lr` overrides the
+        engine's configured rate; `lr_scale` multiplies it (scheduler
+        hook, host_bridge.HostEmbeddingManager.apply)."""
         self._step += 1
         hp = dict(self.hyperparams)
         if lr is not None:
             hp["lr"] = lr
         hp.setdefault("lr", 0.001 if self.optimizer == "adam" else 0.1)
+        hp["lr"] = hp["lr"] * float(lr_scale)
         if self.optimizer == "sgd":
             self.param.sgd(unique_ids, row_grads, hp["lr"])
         elif self.optimizer == "momentum":

@@ -83,7 +83,7 @@ class Trainer(object):
         tx = model_spec.optimizer()
         if callbacks is None and model_spec.callbacks_fn is not None:
             callbacks = model_spec.callbacks_fn()
-        tx = _apply_lr_scheduler(tx, callbacks)
+        tx, self._lr_multiplier_fn = _apply_lr_scheduler(tx, callbacks)
         # The raw transform: reused per-table by the row-sparse engine
         # (embedding/sparse_update.py — optax state leaves are
         # elementwise, so applying the same tx to gathered rows is the
@@ -115,6 +115,32 @@ class Trainer(object):
         self._eval_step = None
         self._predict_step = None
         self._state_sharding = None
+        # Host-spill embedding bridge (embedding/host_bridge.py): pulls
+        # rows before the compiled step, applies row grads after it.
+        self._host_manager = None
+
+    # ------------------------------------------------------- host bridge
+
+    def attach_host_embeddings(self, manager):
+        """Register a HostEmbeddingManager. Must happen before the first
+        init_state/train_step so the compiled signature includes the
+        pulled-row inputs. Per-process tables: unsupported together with
+        the multi-host SPMD assembled path."""
+        if self._train_step is not None or self._eval_step is not None:
+            raise RuntimeError(
+                "attach_host_embeddings must precede step compilation"
+            )
+        self._host_manager = manager
+        return self
+
+    @property
+    def host_manager(self):
+        return self._host_manager
+
+    def _host_prepare(self, features):
+        if self._host_manager:
+            return self._host_manager.prepare(features)
+        return features
 
     # ---------------------------------------------------------------- init
 
@@ -129,6 +155,7 @@ class Trainer(object):
         from elasticdl_tpu.embedding import sparse_update
 
         features, _ = _split_label(example_batch)
+        features = self._host_prepare(features)
         features = jax.tree.map(jnp.asarray, features)
         root_rng = jax.random.PRNGKey(self.seed)
         init_rng, state_rng = jax.random.split(root_rng)
@@ -215,6 +242,12 @@ class Trainer(object):
         sparse_paths = self._sparse_paths
         perturb_shapes = self._perturb_shapes
         ids_coll = sparse_update.SPARSE_IDS_COLLECTION
+        # Pulled host-table rows are differentiable inputs: their grads
+        # (the backward scatter-add of rows[idx]) are the per-unique-row
+        # gradients the host engines apply (embedding/host_bridge.py).
+        host_keys = (
+            self._host_manager.rows_keys() if self._host_manager else ()
+        )
 
         def train_step(state, features, labels, weights):
             dropout_rng = jax.random.fold_in(state.rng, state.step)
@@ -224,8 +257,13 @@ class Trainer(object):
             perturbs = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype), perturb_shapes
             )
+            host_rows = {k: features[k] for k in host_keys}
+            base_features = {
+                k: v for k, v in features.items() if k not in host_keys
+            }
 
-            def loss_fn(params, perturbs):
+            def loss_fn(params, perturbs, host_rows):
+                features = dict(base_features, **host_rows)
                 variables = {"params": params, **state.model_state}
                 if sparse_paths:
                     variables[sparse_update.PERTURB_COLLECTION] = perturbs
@@ -258,9 +296,9 @@ class Trainer(object):
                 )
 
             (loss_val, (new_model_state, ids)), grads = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True
-            )(state.params, perturbs)
-            param_grads, perturb_grads = grads
+                loss_fn, argnums=(0, 1, 2), has_aux=True
+            )(state.params, perturbs, host_rows)
+            param_grads, perturb_grads, host_grads = grads
             updates, new_opt_state = tx.update(
                 param_grads, state.opt_state, state.params
             )
@@ -282,13 +320,13 @@ class Trainer(object):
                 model_state=FrozenDict(new_model_state),
                 embed_opt_state=embed_opt,
             )
-            return new_state, loss_val
+            return new_state, loss_val, host_grads
 
         return jax.jit(
             train_step,
             donate_argnums=(0,),
             in_shardings=(self._state_sharding, batch_sh, batch_sh, batch_sh),
-            out_shardings=(self._state_sharding, repl),
+            out_shardings=(self._state_sharding, repl, repl),
         )
 
     def _build_eval_step(self):
@@ -315,11 +353,35 @@ class Trainer(object):
         features, labels = _split_label(batch)
         bsz = _leading_dim(features)
         weights = _make_weights(bsz, true_count)
-        return self.train_step_assembled(state, features, labels, weights)
+        features = self._host_prepare(features)
+        if self._host_manager:
+            # scale_by_schedule counts applied updates from 0, i.e. the
+            # pre-update step number — mirror it for the host tier. The
+            # multiplier runs BEFORE the donating compiled step: a user
+            # schedule that raises must fail while the caller's state
+            # buffers are still alive and the batch retryable.
+            scale = (
+                float(self._lr_multiplier_fn(int(state.step)))
+                if self._lr_multiplier_fn is not None
+                else 1.0
+            )
+        state, loss, host_grads = self._run_train_step(
+            state, features, labels, weights
+        )
+        if self._host_manager:
+            self._host_manager.apply(host_grads, lr_scale=scale)
+        return state, loss
 
     def train_step_assembled(self, state, features, labels, weights):
         """Run the compiled step on already-prepared (possibly global
-        multi-host) arrays — the SPMD path (parallel/spmd.py)."""
+        multi-host) arrays — the SPMD path (parallel/spmd.py). Host-spill
+        tables are per-process and bypass this path (Trainer.train_step)."""
+        state, loss, _ = self._run_train_step(
+            state, features, labels, weights
+        )
+        return state, loss
+
+    def _run_train_step(self, state, features, labels, weights):
         if self._train_step is None:
             self._train_step = self._build_train_step()
         with self.mesh:
@@ -328,6 +390,7 @@ class Trainer(object):
     def forward(self, state, features):
         """Inference forward pass (evaluation / prediction). Output is
         replicated to every host."""
+        features = self._host_prepare(features)
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         with self.mesh:
@@ -358,7 +421,9 @@ class Trainer(object):
 def _apply_lr_scheduler(tx, callbacks):
     """Chain an optax scale_by_schedule when a LearningRateScheduler
     callback is present (api/callbacks.py: version → LR multiplier,
-    compiled into the step)."""
+    compiled into the step). Returns (tx, multiplier_fn or None) — the
+    multiplier also scales host-engine row updates so every parameter
+    tier sees the same schedule."""
     import optax
 
     from elasticdl_tpu.api.callbacks import LearningRateScheduler
@@ -367,8 +432,8 @@ def _apply_lr_scheduler(tx, callbacks):
         if isinstance(cb, LearningRateScheduler):
             return optax.chain(
                 tx, optax.scale_by_schedule(cb.multiplier_fn)
-            )
-    return tx
+            ), cb.multiplier_fn
+    return tx, None
 
 
 def _leading_dim(features):

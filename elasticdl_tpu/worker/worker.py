@@ -96,6 +96,19 @@ class Worker(object):
         self.trainer = Trainer(
             model_spec, mesh=mesh, model_params=model_params, seed=seed
         )
+        from elasticdl_tpu.embedding.host_bridge import attach_from_spec
+
+        self._host_manager = attach_from_spec(self.trainer, model_spec)
+        if self._host_manager and spmd:
+            # Host tables are per-process stores; the SPMD assembled path
+            # feeds global arrays without the pulled-row features and
+            # multi-host savers would interleave per-process engine
+            # state. Fail fast instead of KeyError'ing mid-training.
+            raise ValueError(
+                "host_embeddings() models are not supported in SPMD "
+                "lockstep mode; shard the table over HBM (embedding."
+                "Embedding) for multi-host training"
+            )
         self.state = None
         self._task_data_service = TaskDataService(
             self,
@@ -114,6 +127,8 @@ class Worker(object):
         # with the PS gone the worker that owns the jit state does, on the
         # same every-checkpoint_steps cadence.
         self._checkpoint_saver = checkpoint_saver
+        if checkpoint_saver is not None and self._host_manager:
+            checkpoint_saver.extra_state_fn = self._host_manager.flat_state
         self._checkpoint_dir_for_init = checkpoint_dir_for_init
         self.spmd = spmd
         self._spmd_ctx = None
@@ -215,12 +230,14 @@ class Worker(object):
         if self.state is None:
             self.state = self.trainer.init_state(batch)
             if self._checkpoint_dir_for_init:
-                from elasticdl_tpu.checkpoint import (
-                    restore_state_from_checkpoint,
+                from elasticdl_tpu.embedding.host_bridge import (
+                    restore_with_host_state,
                 )
 
-                self.state, version = restore_state_from_checkpoint(
-                    self.state, self._checkpoint_dir_for_init
+                self.state, version = restore_with_host_state(
+                    self.state,
+                    self._host_manager,
+                    self._checkpoint_dir_for_init,
                 )
                 logger.info(
                     "Restored model version %d from %s",

@@ -1,0 +1,109 @@
+"""DeepFM whose embedding tables live in HOST DRAM via the host-spill
+bridge — the model a user picks when the tables exceed HBM.
+
+Same math as model_zoo/deepfm_edl_embedding (itself the rebuild of the
+reference model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py:29-120),
+but the two tables are declared through the `host_embeddings()` zoo
+convention: their rows are stored in the native C++ host store
+(native/host_embedding.cc), pulled per batch by HostEmbeddingManager, and
+updated by the engine's native row optimizer — the role PS pod memory +
+OptimizerWrapper played in the reference (ps/embedding_table.py:23-136,
+ps/optimizer_wrapper.py:70-351)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.embedding.host_bridge import HostEmbedding
+from elasticdl_tpu.training.metrics import AUC
+
+
+class DeepFMHostModel(nn.Module):
+    input_length: int = 10
+    fc_unit: int = 64
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        ids = features["feature"].astype(jnp.int32)  # [B, L]
+        mask = (ids != 0).astype(jnp.float32)[..., None]  # mask_zero
+
+        emb = HostEmbedding(table="edl_embedding")(features)
+        emb = emb * mask
+
+        emb_sum = jnp.sum(emb, axis=1)
+        second_order = 0.5 * jnp.sum(
+            jnp.square(emb_sum) - jnp.sum(jnp.square(emb), axis=1), axis=1
+        )
+
+        id_bias = HostEmbedding(table="edl_id_bias")(features) * mask
+        first_order = jnp.sum(id_bias, axis=(1, 2))
+        fm_output = first_order + second_order
+
+        nn_input = emb.reshape(emb.shape[0], -1)
+        deep = nn.Dense(1)(nn.Dense(self.fc_unit)(nn_input)).reshape(-1)
+
+        logits = fm_output + deep
+        probs = jnp.reshape(nn.sigmoid(logits), (-1, 1))
+        return {"logits": logits, "probs": probs}
+
+
+def custom_model(input_length=10, fc_unit=64):
+    return DeepFMHostModel(input_length=input_length, fc_unit=fc_unit)
+
+
+def host_embeddings(embedding_dim=64):
+    """Host-DRAM table declarations (embedding/host_bridge
+    build_manager_from_spec). The engines' SGD matches optimizer()
+    below so dense params and embedding rows step identically."""
+    return {
+        "edl_embedding": dict(
+            ids_feature="feature", dim=embedding_dim,
+            optimizer="sgd", lr=0.1,
+        ),
+        "edl_id_bias": dict(
+            ids_feature="feature", dim=1, optimizer="sgd", lr=0.1,
+        ),
+    }
+
+
+def loss(labels, predictions):
+    logits = predictions["logits"].reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {"feature": ex["feature"].astype(np.int32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex["label"].astype(np.int32)[0]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "logits": {
+            "accuracy": lambda labels, predictions: (
+                (np.asarray(predictions).reshape(-1) > 0.0).astype(np.int32)
+                == np.asarray(labels).reshape(-1)
+            ).astype(np.float32)
+        },
+        "probs": {"auc": AUC()},
+    }
+
+
+def feature_shapes():
+    return {"feature": (10,)}

@@ -1,0 +1,256 @@
+"""Host-spill embedding tier, integrated end-to-end (VERDICT.md round-1
+item #5): deepfm trains with tables in the host store, loss matches the
+HBM path on the same data, and engine state rides the checkpoint."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from elasticdl_tpu.api.local_executor import LocalExecutor
+from elasticdl_tpu.common.model_utils import (
+    format_params_str,
+    get_model_spec,
+    load_model_spec_from_module,
+)
+from elasticdl_tpu.data import recordio_gen
+from elasticdl_tpu.embedding.host_bridge import (
+    HostEmbeddingManager,
+    build_manager_from_spec,
+    restore_host_state,
+)
+from elasticdl_tpu.embedding.host_spill import HostSpillEmbeddingEngine
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.training.trainer import Trainer
+
+MODEL_ZOO = "model_zoo"
+VOCAB, DIM, LENGTH, FC = 100, 8, 5, 4
+
+
+def _batches(n, batch=8, seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, VOCAB, size=(batch, LENGTH)).astype(np.int32)
+        labels = rng.randint(0, 2, size=(batch,)).astype(np.int32)
+        out.append(({"feature": ids}, labels))
+    return out
+
+
+def _host_trainer():
+    from model_zoo.deepfm_host_embedding import deepfm_host_embedding as zoo
+
+    spec = load_model_spec_from_module(zoo)
+    trainer = Trainer(
+        spec,
+        mesh=mesh_lib.local_mesh(),
+        model_params=format_params_str(
+            dict(input_length=LENGTH, fc_unit=FC)
+        ),
+    )
+    manager = HostEmbeddingManager()
+    manager.register(
+        "edl_embedding", "feature",
+        HostSpillEmbeddingEngine(DIM, optimizer="sgd", lr=0.1),
+    )
+    manager.register(
+        "edl_id_bias", "feature",
+        HostSpillEmbeddingEngine(1, optimizer="sgd", lr=0.1),
+    )
+    trainer.attach_host_embeddings(manager)
+    return trainer, manager
+
+
+def _hbm_trainer():
+    from model_zoo.deepfm_edl_embedding import deepfm_edl_embedding as zoo
+
+    spec = load_model_spec_from_module(zoo)
+    return Trainer(
+        spec,
+        mesh=mesh_lib.local_mesh(),
+        model_params=format_params_str(
+            dict(input_dim=VOCAB, embedding_dim=DIM,
+                 input_length=LENGTH, fc_unit=FC)
+        ),
+    )
+
+
+def test_parity_with_hbm_path():
+    """Same data, same init, same optimizer: host-tier deepfm's loss
+    trajectory matches the HBM-tier deepfm (the reference proved its PS
+    path this way — worker_ps_interaction_test.py:197-265 trains against
+    a local baseline)."""
+    batches = _batches(6)
+
+    hbm = _hbm_trainer()
+    hbm_state = hbm.init_state(batches[0])
+    hbm_params = jax.tree.map(np.asarray, jax.device_get(hbm_state.params))
+
+    host, manager = _host_trainer()
+    host_state = host.init_state(batches[0])
+
+    # Seed the host engines with the HBM model's initial tables, and copy
+    # the dense (Dense_*) params so both models start identically.
+    all_ids = np.arange(VOCAB, dtype=np.int64)
+    tables = manager.tables()
+    tables["edl_embedding"].engine.param.set_rows(
+        all_ids, hbm_params["edl_embedding"]["embedding_table"]
+    )
+    tables["edl_id_bias"].engine.param.set_rows(
+        all_ids, hbm_params["edl_id_bias"]["embedding_table"]
+    )
+    new_params = {
+        k: hbm_params[k] for k in host_state.params
+    }
+    host_state = host_state.replace(
+        params=jax.device_put(
+            new_params,
+            jax.tree.map(lambda x: x.sharding, dict(host_state.params)),
+        )
+    )
+
+    hbm_losses, host_losses = [], []
+    for b in batches:
+        hbm_state, l1 = hbm.train_step(hbm_state, b)
+        host_state, l2 = host.train_step(host_state, b)
+        hbm_losses.append(float(l1))
+        host_losses.append(float(l2))
+    np.testing.assert_allclose(host_losses, hbm_losses, rtol=2e-4,
+                               atol=2e-5)
+
+    # and the trained tables themselves match
+    ids, values = tables["edl_embedding"].engine.param.export_rows()
+    order = np.argsort(ids)
+    final_hbm = np.asarray(
+        jax.device_get(hbm_state.params["edl_embedding"]["embedding_table"])
+    )
+    np.testing.assert_allclose(
+        values[order], final_hbm[np.sort(ids)], rtol=2e-4, atol=2e-5
+    )
+
+
+def test_gradients_only_touch_pulled_rows():
+    """Untouched host rows never move (reference OptimizerWrapper
+    semantics: only looked-up rows and slots are written back)."""
+    host, manager = _host_trainer()
+    batches = _batches(1)
+    state = host.init_state(batches[0])
+    engine = manager.tables()["edl_embedding"].engine
+
+    all_ids = np.arange(VOCAB, dtype=np.int64)
+    before = engine.param.lookup(all_ids).copy()
+    state, _ = host.train_step(state, batches[0])
+    after = engine.param.lookup(all_ids)
+
+    touched = np.unique(batches[0][0]["feature"])
+    untouched = np.setdiff1d(all_ids, touched)
+    np.testing.assert_array_equal(after[untouched], before[untouched])
+    assert np.abs(after[touched] - before[touched]).max() > 0
+
+
+def test_zoo_e2e_local_executor(tmp_path):
+    """The deepfm_host_embedding zoo family trains + evaluates through
+    the LocalExecutor like every other family (test_model_zoo pattern)."""
+    train_dir, val_dir = str(tmp_path / "train"), str(tmp_path / "val")
+    recordio_gen.gen_frappe_like(train_dir, num_files=1,
+                                 records_per_file=32)
+    recordio_gen.gen_frappe_like(val_dir, num_files=1,
+                                 records_per_file=32, seed=7)
+    spec = get_model_spec(
+        MODEL_ZOO, "deepfm_host_embedding.deepfm_host_embedding.custom_model"
+    )
+    executor = LocalExecutor(
+        spec,
+        training_data=train_dir,
+        validation_data=val_dir,
+        minibatch_size=8,
+        num_epochs=1,
+        records_per_task=32,
+    )
+    state, metrics = executor.run()
+    assert int(state.step) == 4
+    assert np.isfinite(executor.losses).all()
+    assert 0.0 <= metrics["logits_accuracy"] <= 1.0
+    # the engines actually hold trained rows
+    ids, _ = (
+        executor._host_manager.tables()["edl_embedding"]
+        .engine.param.export_rows()
+    )
+    assert ids.size > 0
+
+
+def test_checkpoint_roundtrip_and_resume(tmp_path):
+    """Engine state rides the sharded checkpoint: a fresh manager
+    restored from disk equals the trained one, and a resumed executor
+    continues from the saved version."""
+    train_dir = str(tmp_path / "train")
+    ckpt_dir = str(tmp_path / "ckpt")
+    recordio_gen.gen_frappe_like(train_dir, num_files=1,
+                                 records_per_file=32)
+    spec = get_model_spec(
+        MODEL_ZOO, "deepfm_host_embedding.deepfm_host_embedding.custom_model"
+    )
+    executor = LocalExecutor(
+        spec,
+        training_data=train_dir,
+        minibatch_size=8,
+        num_epochs=1,
+        records_per_task=32,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=4,  # == final step: last save captures the end
+    )
+    executor.run()
+    trained_flat = executor._host_manager.flat_state()
+
+    manager2 = build_manager_from_spec(spec)
+    version = restore_host_state(manager2, ckpt_dir)
+    assert version == 4
+    restored_flat = manager2.flat_state()
+    assert set(restored_flat) == set(trained_flat)
+    for key in trained_flat:
+        got, want = restored_flat[key], trained_flat[key]
+        if got.ndim:  # row exports: order-insensitive compare
+            np.testing.assert_allclose(np.sort(got, axis=0),
+                                       np.sort(want, axis=0))
+        else:
+            assert got == want
+
+    resumed = LocalExecutor(
+        spec,
+        training_data=train_dir,
+        minibatch_size=8,
+        num_epochs=1,
+        records_per_task=32,
+        checkpoint_dir_for_init=ckpt_dir,
+    )
+    resumed.run()
+    assert int(resumed.state.step) > 4  # continued past the restore
+    assert np.isfinite(resumed.losses).all()
+
+
+def test_lr_scale_reaches_engine():
+    """The scheduler multiplier scales host-row updates (Trainer passes
+    lr_scale so every parameter tier sees the same schedule)."""
+    eng_a = HostSpillEmbeddingEngine(4, optimizer="sgd", lr=0.5)
+    eng_b = HostSpillEmbeddingEngine(4, optimizer="sgd", lr=0.5)
+    ids = np.array([1, 2], np.int64)
+    _, rows_a, _ = eng_a.pull(ids)
+    eng_b.pull(ids)
+    grads = np.ones((2, 4), np.float32)
+    eng_a.apply_gradients(ids, grads, lr_scale=1.0)
+    eng_b.apply_gradients(ids, grads, lr_scale=0.5)
+    np.testing.assert_allclose(
+        eng_a.param.lookup(ids), rows_a - 0.5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        eng_b.param.lookup(ids), rows_a - 0.25, atol=1e-6
+    )
+
+
+def test_apply_before_prepare_raises():
+    manager = HostEmbeddingManager()
+    manager.register(
+        "t", "feature", HostSpillEmbeddingEngine(4, optimizer="sgd")
+    )
+    with pytest.raises(RuntimeError):
+        manager.apply({"t.rows": np.zeros((8, 4), np.float32)})
