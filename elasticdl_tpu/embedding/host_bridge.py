@@ -146,9 +146,13 @@ class HostEmbeddingManager(object):
         scheduler the Trainer compiled into the dense chain applies to
         host rows through this knob."""
         # Materialize EVERY table's gradients before mutating ANY engine:
-        # np.asarray is where async device errors surface, and engines
-        # update in place — an error after table 1 of 2 would otherwise
-        # leave a half-applied step that a retry double-applies.
+        # np.asarray is where async device errors surface, keeping the
+        # common failure out of the mutation loop. A failure INSIDE an
+        # engine's in-place update (realistically only host OOM) can
+        # still leave later tables un-stepped — the Trainer therefore
+        # never retries an apply (trainer.train_step logs and moves on),
+        # so a partial step degrades to "those rows missed one update"
+        # rather than double-applying.
         staged = []
         for name, t in self._tables.items():
             if t.last_unique is None:

@@ -369,7 +369,21 @@ class Trainer(object):
             state, features, labels, weights
         )
         if self._host_manager:
-            self._host_manager.apply(host_grads, lr_scale=scale)
+            # A failure here must NOT propagate: the compiled step donated
+            # the caller's old state buffers, so a retry would replay on
+            # deleted arrays (bricking the worker's 64-retry loop) and
+            # double-apply any engine that did step. Instead the affected
+            # rows miss this one update — the degradation the reference's
+            # PS path also accepted (dropped grads on PS restart; fault
+            # tolerance is task-requeue-first, README.md:62-66).
+            try:
+                self._host_manager.apply(host_grads, lr_scale=scale)
+            except Exception:
+                logger.exception(
+                    "host-embedding apply failed at step %d; affected "
+                    "rows miss this update (no retry: state is donated)",
+                    int(state.step),
+                )
         return state, loss
 
     def train_step_assembled(self, state, features, labels, weights):

@@ -12,6 +12,14 @@ master_port = sys.argv[3]
 coord_port = sys.argv[4]
 data_dir = sys.argv[5]
 local_devices = int(sys.argv[6])
+# Optional (elastic re-formation drill, test_elastic_reformation.py):
+# die_after_steps: os._exit(137) after N train steps (preemption SIGKILL
+# exit code, the one the reference's instance manager special-cases —
+# k8s_instance_manager.py:310-338); ckpt_dir/ckpt_steps: cooperative
+# sharded checkpointing.
+die_after_steps = int(sys.argv[7]) if len(sys.argv) > 7 else -1
+ckpt_dir = sys.argv[8] if len(sys.argv) > 8 else ""
+ckpt_steps = int(sys.argv[9]) if len(sys.argv) > 9 else 0
 
 os.environ["XLA_FLAGS"] = (
     "--xla_force_host_platform_device_count=%d" % local_devices
@@ -35,6 +43,11 @@ from elasticdl_tpu.worker.worker import JobType, Worker
 from model_zoo.mnist_functional_api import mnist_functional_api as zoo
 
 mesh = mesh_lib.build_mesh({"dp": num_procs * local_devices})
+saver = None
+if ckpt_dir and ckpt_steps:
+    from elasticdl_tpu.checkpoint import CheckpointSaver
+
+    saver = CheckpointSaver(ckpt_dir, checkpoint_steps=ckpt_steps)
 worker = Worker(
     proc_id,
     load_model_spec_from_module(zoo),
@@ -45,7 +58,26 @@ worker = Worker(
     wait_sleep_secs=0.1,
     mesh=mesh,
     spmd=True,
+    checkpoint_saver=saver,
 )
+
+if die_after_steps > 0:
+    # Preemption injection: vanish without goodbye (no task reporting, no
+    # cleanup) after the Nth completed global step — the surviving hosts
+    # and the master must recover on their own.
+    real_step = worker.trainer.train_step_assembled
+    counter = {"n": 0}
+
+    def _counting_step(*args, **kwargs):
+        out = real_step(*args, **kwargs)
+        counter["n"] += 1
+        if counter["n"] >= die_after_steps:
+            sys.stdout.flush()
+            os._exit(137)
+        return out
+
+    worker.trainer.train_step_assembled = _counting_step
+
 state = worker.run()
 print(
     "SPMD_PROC_DONE pid=%d steps=%d real_batches=%d"

@@ -207,13 +207,25 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
     assert version == 4
     restored_flat = manager2.flat_state()
     assert set(restored_flat) == set(trained_flat)
+
+    def rows_by_id(flat, base):
+        ids = np.asarray(flat[base + ".ids"])
+        values = np.asarray(flat[base + ".values"])
+        return values[np.argsort(ids)], np.sort(ids)
+
     for key in trained_flat:
-        got, want = restored_flat[key], trained_flat[key]
-        if got.ndim:  # row exports: order-insensitive compare
-            np.testing.assert_allclose(np.sort(got, axis=0),
-                                       np.sort(want, axis=0))
+        if key.endswith(".values"):
+            continue  # compared id-aligned below
+        if key.endswith(".ids"):
+            base = key[: -len(".ids")]
+            got_v, got_i = rows_by_id(restored_flat, base)
+            want_v, want_i = rows_by_id(trained_flat, base)
+            np.testing.assert_array_equal(got_i, want_i)
+            # id-aligned row compare: catches restores that re-associate
+            # rows with the wrong ids (column-wise sorting would not)
+            np.testing.assert_allclose(got_v, want_v)
         else:
-            assert got == want
+            assert restored_flat[key] == trained_flat[key]
 
     resumed = LocalExecutor(
         spec,
