@@ -195,3 +195,188 @@ def gen_iris_csv(data_dir, num_files=2, rows_per_file=64, seed=0):
                         % (*vals, rng.randint(3)))
         paths.append(path)
     return paths
+
+
+# -------------------------------------------------- real-dataset converters
+#
+# Counterparts of the reference's data/recordio_gen/ converters that worked
+# on REAL inputs rather than synthetic fixtures: image_label.py (image
+# arrays / directories -> sharded records) and heart_recordio_gen.py
+# (CSV -> records via pandas). Same sharding semantics: records_per_shard
+# records per file, files named <prefix>-NNNNN.
+
+
+class _ShardedWriter(object):
+    """Shard-rollover writer shared by the converters: every
+    records_per_shard writes closes the current file and opens
+    <prefix>-NNNNN.trec. O(1) memory regardless of dataset size."""
+
+    def __init__(self, data_dir, prefix, records_per_shard):
+        os.makedirs(data_dir, exist_ok=True)
+        self._data_dir = data_dir
+        self._prefix = prefix
+        self._per_shard = int(records_per_shard)
+        self._writer = None
+        self._written = 0
+        self.paths = []
+
+    def write(self, example):
+        if self._written % self._per_shard == 0:
+            self._roll()
+        self._writer.write(encode_example(example))
+        self._written += 1
+
+    def _roll(self):
+        if self._writer is not None:
+            self._writer.close()
+        path = os.path.join(
+            self._data_dir, "%s-%05d.trec" % (self._prefix, len(self.paths))
+        )
+        self.paths.append(path)
+        self._writer = RecordWriter(path)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._writer is not None:
+            self._writer.close()
+        return False
+
+
+def convert_arrays(data_dir, x, y, records_per_shard=1024, fraction=1.0,
+                   prefix="data"):
+    """Image/label numpy arrays -> sharded TRec files (reference
+    image_label.py convert(): shard rollover every records_per_shard,
+    optional leading `fraction` of the data)."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if len(x) != len(y):
+        raise ValueError("x and y lengths differ: %d vs %d"
+                         % (len(x), len(y)))
+    n = int(len(x) * fraction)
+    with _ShardedWriter(data_dir, prefix, records_per_shard) as w:
+        for row in range(n):
+            w.write({
+                "image": np.asarray(x[row], np.float32),
+                "label": np.asarray(y[row], np.int64).reshape(()),
+            })
+        return w.paths
+
+
+def convert_image_dir(image_dir, data_dir, records_per_shard=1024,
+                      image_size=None, image_mode=None):
+    """Directory of <class-name>/<image files> -> sharded TRec files with
+    integer labels by sorted class-dir order (the image-directory path of
+    reference image_label.py, PIL-gated like the reference's TF datasets
+    dependency). Images are written INCREMENTALLY (O(1) memory).
+
+    Real directories mix modes and sizes: pass `image_mode` (e.g. "RGB",
+    "L") to normalize channels and `image_size` (w, h) to normalize
+    dimensions; without them, a shape mismatch raises naming the file.
+    Returns (paths, class_names)."""
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(
+            "convert_image_dir needs pillow (PIL) to decode images"
+        ) from e
+    classes = sorted(
+        d for d in os.listdir(image_dir)
+        if os.path.isdir(os.path.join(image_dir, d))
+    )
+    if not classes:
+        raise ValueError("no class subdirectories under %r" % image_dir)
+    expect_shape = None
+    with _ShardedWriter(data_dir, "images", records_per_shard) as w:
+        for label, cls in enumerate(classes):
+            cls_dir = os.path.join(image_dir, cls)
+            for name in sorted(os.listdir(cls_dir)):
+                img = Image.open(os.path.join(cls_dir, name))
+                if image_mode is not None:
+                    img = img.convert(image_mode)
+                if image_size is not None:
+                    img = img.resize(image_size)
+                arr = np.asarray(img, np.float32)
+                if expect_shape is None:
+                    expect_shape = arr.shape
+                elif arr.shape != expect_shape:
+                    raise ValueError(
+                        "image %s/%s has shape %s, expected %s; pass "
+                        "image_size and/or image_mode to normalize"
+                        % (cls, name, arr.shape, expect_shape)
+                    )
+                w.write({
+                    "image": arr,
+                    "label": np.array(label, np.int64),
+                })
+        return w.paths, classes
+
+
+def convert_csv(csv_path, data_dir, records_per_shard=1024, label_column=None,
+                prefix=None):
+    """CSV file -> sharded TRec files, one feature per column with dtype
+    sniffing int64 / float32 / bytes (reference heart_recordio_gen.py
+    convert_series_to_tf_feature semantics, without the pandas
+    dependency). Returns the written paths."""
+    import csv as _csv
+
+    prefix = prefix or os.path.splitext(os.path.basename(csv_path))[0]
+
+    def sniff_column(values):
+        """int64 if every value parses as int, else float32 if every value
+        parses as float, else bytes — whole-column promotion (a first-row
+        "233" must not pin a column that later holds "250.5" to int)."""
+        dtype = np.int64
+        for v in values:
+            if dtype is np.int64:
+                try:
+                    int(v)
+                    continue
+                except ValueError:
+                    dtype = np.float32
+            try:
+                float(v)
+            except ValueError:
+                return None  # string/bytes
+        return dtype
+
+    with open(csv_path, newline="") as f:
+        reader = _csv.reader(f)
+        header = next(reader)
+        rows = []
+        for lineno, row in enumerate(reader, start=2):
+            if not row:
+                continue
+            if len(row) != len(header):
+                raise ValueError(
+                    "%s line %d has %d fields, header has %d"
+                    % (csv_path, lineno, len(row), len(header))
+                )
+            rows.append(row)
+    if not rows:
+        return []
+    if label_column is not None and label_column not in header:
+        raise ValueError(
+            "label column %r not in CSV header %s" % (label_column, header)
+        )
+    dtypes = [
+        sniff_column([row[i] for row in rows]) for i in range(len(header))
+    ]
+    with _ShardedWriter(data_dir, prefix, records_per_shard) as w:
+        for row in rows:
+            ex = {}
+            for name, value, dtype in zip(header, row, dtypes):
+                if name == label_column:
+                    ex[name] = np.array(int(float(value)), np.int64)
+                elif dtype is None:
+                    # exact-length bytes dtype: no silent truncation
+                    ex[name] = np.array(value.encode("utf-8"))
+                else:
+                    ex[name] = np.array(
+                        dtype(float(value))
+                        if dtype is np.float32 else int(value),
+                        dtype,
+                    )
+            w.write(ex)
+        return w.paths

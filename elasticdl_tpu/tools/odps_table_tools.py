@@ -1,0 +1,205 @@
+"""Key-value table flattening for MaxCompute/ODPS tables — the rebuild of
+reference tools/odps_table_tools/ (transform_kv_table.py +
+normalize_kv_udf.py, ~380 LoC): SQLFlow-style tables often store features
+as one "k1:v1,k2:v2" string column; training wants one column per key.
+
+Pieces (pure functions first, so the flattening logic is testable and
+reusable host-side without an ODPS cluster; the cluster path is gated on
+pyodps like the reader/writer):
+
+* parse_kv_string          "k1:v1,k2:v2" -> {"k1": "v1", ...}
+* analyze_feature_names    key-name discovery over the first N records
+                           (reference get_feature_names, head(100))
+* flatten_kv_record        one record -> per-feature values, missing -> ""
+* KVFlatter                the UDTF class (reference normalize_kv_udf
+                           KVFlatter.process arg protocol: kv value,
+                           *append columns, names csv, pair sep, kv sep)
+* generate_transform_sql   CREATE TABLE ... AS SELECT <udtf>(...) FROM ...
+* transform_kv_table       end-to-end driver against a live ODPS entry
+
+Separator naming: `pair_sep` splits the string into pairs (reference
+call sites pass ","), `kv_sep` splits key from value (":").
+"""
+
+import time
+
+# Defaults matching the reference tables' "k1:v1,k2:v2" layout.
+PAIR_SEPARATOR = ","
+KV_SEPARATOR = ":"
+
+UDF_CLASS_NAME = "KVFlatter"
+ANALYZE_FEATURE_RECORDS_COUNT = 100
+
+_TRANSFORM_SQL_TEMPLATE = (
+    "CREATE TABLE IF NOT EXISTS {output_table} LIFECYCLE 7 AS \n"
+    "    SELECT \n"
+    "        {udf} \n"
+    "    FROM {input_table}"
+)
+
+
+def parse_kv_string(kvs_string, pair_sep=PAIR_SEPARATOR,
+                    kv_sep=KV_SEPARATOR):
+    """"k1:v1,k2:v2" -> {"k1": "v1", "k2": "v2"}; malformed pairs (no
+    kv_sep, or extra separators) are skipped, as in the reference."""
+    out = {}
+    for pair in kvs_string.split(pair_sep):
+        key_and_value = pair.split(kv_sep)
+        if len(key_and_value) == 2:
+            out[key_and_value[0]] = key_and_value[1]
+    return out
+
+
+def analyze_feature_names(records, kv_value_fn=None,
+                          pair_sep=PAIR_SEPARATOR, kv_sep=KV_SEPARATOR,
+                          max_records=ANALYZE_FEATURE_RECORDS_COUNT):
+    """Discover the union of key names over the first `max_records`
+    records, sorted (reference get_feature_names over table.head(100)).
+    `kv_value_fn` extracts the kv string from a record (default: the
+    record itself is the string)."""
+    names = set()
+    for i, record in enumerate(records):
+        if i >= max_records:
+            break
+        value = kv_value_fn(record) if kv_value_fn is not None else record
+        names.update(parse_kv_string(value, pair_sep, kv_sep).keys())
+    return sorted(names)
+
+
+def flatten_kv_record(kvs_string, feature_names,
+                      pair_sep=PAIR_SEPARATOR, kv_sep=KV_SEPARATOR):
+    """One kv string -> [value for each feature name], missing keys
+    becoming "" (reference normalize_kv_udf parse_kv_string_to_dict)."""
+    kv = parse_kv_string(kvs_string, pair_sep, kv_sep)
+    return [kv.get(name, "") for name in feature_names]
+
+
+class KVFlatter(object):
+    """The UDTF that runs the flattening inside ODPS SQL.
+
+    Argument protocol (must match generate_transform_sql's projection,
+    which is the reference's — normalize_kv_udf.py KVFlatter.process):
+    args[0] = kv column value; args[1:-3] = append-column values (copied
+    through, stringified); args[-3] = comma-joined feature names;
+    args[-2] = pair separator; args[-1] = key-value separator.
+
+    Outside an ODPS runtime `forward` collects rows locally, so the
+    class is testable (and usable for host-side normalization) as-is;
+    under odps.udf the subclass in the generated resource inherits
+    BaseUDTF whose forward emits into the SQL engine.
+    """
+
+    def __init__(self):
+        self.collected = []
+
+    def forward(self, *values):
+        self.collected.append(list(values))
+
+    def process(self, *args):
+        if len(args) < 4:
+            raise ValueError(
+                "The input values number can not be less than 4"
+            )
+        feature_names = args[-3].split(",")
+        pair_sep, kv_sep = args[-2], args[-1]
+        values = flatten_kv_record(args[0], feature_names, pair_sep, kv_sep)
+        for append_value in args[1:-3]:
+            values.append(str(append_value))
+        self.forward(*values)
+
+
+def generate_transform_sql(
+    input_table,
+    output_table,
+    feature_names,
+    kv_column,
+    udf_function,
+    append_columns=None,
+    input_table_partition=None,
+    pair_sep=PAIR_SEPARATOR,
+    kv_sep=KV_SEPARATOR,
+):
+    """The CREATE-TABLE-AS-SELECT statement flattening `kv_column` into
+    one column per feature name, carrying `append_columns` (e.g. the
+    label) through (reference generate_sql)."""
+    append_columns = list(append_columns or [])
+    output_columns = list(feature_names) + append_columns
+    input_columns = [kv_column] + append_columns
+    udf = (
+        '{udf}({input_cols},\n'
+        '    "{features}", "{pair_sep}", "{kv_sep}")\n'
+        '    as ({output_cols})'.format(
+            udf=udf_function,
+            input_cols=",".join(input_columns),
+            features=",".join(feature_names),
+            output_cols=",".join(output_columns),
+            pair_sep=pair_sep,
+            kv_sep=kv_sep,
+        )
+    )
+    sql = _TRANSFORM_SQL_TEMPLATE.format(
+        output_table=output_table, udf=udf, input_table=input_table
+    )
+    if input_table_partition:
+        sql += " where {}".format(input_table_partition)
+    return sql
+
+
+def transform_kv_table(
+    odps_entry,
+    input_table,
+    output_table,
+    kv_column,
+    append_columns=None,
+    input_table_partition=None,
+    pair_sep=PAIR_SEPARATOR,
+    kv_sep=KV_SEPARATOR,
+    udf_file_path=None,
+):
+    """End-to-end driver against a live ODPS entry (reference
+    transform_kv_table.py main): analyze key names from the table head,
+    register the UDTF resource+function, run the transform SQL, drop the
+    temporaries. Requires pyodps (the entry object)."""
+    source = odps_entry.get_table(input_table)
+    names = analyze_feature_names(
+        source.head(
+            ANALYZE_FEATURE_RECORDS_COUNT, partition=input_table_partition
+        ),
+        kv_value_fn=lambda rec: rec[kv_column],
+        pair_sep=pair_sep,
+        kv_sep=kv_sep,
+    )
+    stamp = int(time.time())
+    resource_name = "edl_tpu_kv_flat_%d.py" % stamp
+    function_name = "edl_tpu_kv_flat_func_%d" % stamp
+    if udf_file_path is None:
+        udf_file_path = __file__
+    resource = odps_entry.create_resource(
+        resource_name, type="py", file_obj=open(udf_file_path)
+    )
+    try:
+        function = odps_entry.create_function(
+            function_name,
+            class_type="%s.%s" % (resource_name[:-3], UDF_CLASS_NAME),
+            resources=[resource],
+        )
+        try:
+            sql = generate_transform_sql(
+                input_table,
+                output_table,
+                names,
+                kv_column,
+                function_name,
+                append_columns=append_columns,
+                input_table_partition=input_table_partition,
+                pair_sep=pair_sep,
+                kv_sep=kv_sep,
+            )
+            instance = odps_entry.run_sql(sql)
+            instance.wait_for_success()
+        finally:
+            odps_entry.delete_function(function_name)
+            function = None  # noqa: F841
+    finally:
+        odps_entry.delete_resource(resource_name)
+    return names

@@ -379,10 +379,13 @@ class Trainer(object):
             try:
                 self._host_manager.apply(host_grads, lr_scale=scale)
             except Exception:
+                # The log itself must not touch device values: with an
+                # async device error poisoning this step's outputs,
+                # int(state.step) would re-raise the very exception this
+                # handler exists to contain.
                 logger.exception(
-                    "host-embedding apply failed at step %d; affected "
-                    "rows miss this update (no retry: state is donated)",
-                    int(state.step),
+                    "host-embedding apply failed; affected rows miss "
+                    "this update (no retry: state is donated)"
                 )
         return state, loss
 

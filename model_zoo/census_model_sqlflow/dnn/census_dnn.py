@@ -1,0 +1,96 @@
+"""Census DNN (SQLFlow feature-column style) — rebuild of reference
+model_zoo/census_model_sqlflow/dnn/ (census_feature_column.py:34-52 +
+census_functional.py:27-37): numeric columns pass through; each
+categorical column is hashed into 64 buckets and embedded to 16 dims
+(the feature-column DenseFeatures concat); Dense 16/16 relu + sigmoid
+head. The hashing runs host-side in dataset_fn (strings never enter
+XLA); the embeddings are in-model."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.preprocessing.layers import Hashing
+
+CATEGORICAL_FEATURE_KEYS = [
+    "workclass", "education", "marital-status", "occupation",
+    "relationship", "race", "sex", "native-country",
+]
+NUMERIC_FEATURE_KEYS = [
+    "age", "capital-gain", "capital-loss", "hours-per-week",
+]
+LABEL_KEY = "label"
+
+HASH_BUCKETS = 64
+EMBEDDING_DIM = 16
+
+
+class CensusDNN(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        parts = [
+            features[name].astype(jnp.float32).reshape(-1, 1)
+            for name in NUMERIC_FEATURE_KEYS
+        ]
+        for name in CATEGORICAL_FEATURE_KEYS:
+            ids = features[name].astype(jnp.int32).reshape(-1)
+            emb = nn.Embed(
+                HASH_BUCKETS, EMBEDDING_DIM,
+                name="%s_embedding" % name.replace("-", "_"),
+            )(ids)
+            parts.append(emb)
+        x = jnp.concatenate(parts, axis=1)
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.sigmoid(nn.Dense(1)(x))
+
+
+def custom_model():
+    return CensusDNN()
+
+
+def loss(labels, predictions):
+    probs = jnp.clip(predictions.reshape(-1), 1e-7, 1 - 1e-7)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    return -jnp.mean(
+        labels * jnp.log(probs) + (1.0 - labels) * jnp.log(1.0 - probs)
+    )
+
+
+def optimizer(lr=0.001):
+    return optax.adam(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    hashers = {
+        name: Hashing(num_bins=HASH_BUCKETS)
+        for name in CATEGORICAL_FEATURE_KEYS
+    }
+
+    def _parse(record):
+        ex = decode_example(record)
+        features = {
+            name: np.asarray(ex[name], np.float32).reshape(())
+            for name in NUMERIC_FEATURE_KEYS
+        }
+        for name in CATEGORICAL_FEATURE_KEYS:
+            features[name] = np.asarray(
+                hashers[name](ex[name]), np.int64
+            ).reshape(())
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex[LABEL_KEY].astype(np.int32).reshape(())
+
+    return dataset.map(_parse)
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            (np.asarray(predictions).reshape(-1) > 0.5).astype(np.int32)
+            == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }

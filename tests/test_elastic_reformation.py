@@ -132,10 +132,11 @@ def test_mesh_reformation_after_host_loss(tmp_path):
         # completion: every task accounted for on the re-formed mesh
         assert master.task_d.finished()
         # the checkpoint restore really fed phase 2 (not a fresh init):
-        # the worker logged a restore by construction; assert indirectly
-        # via step count — a fresh init would need >= 12 steps for 192
-        # records, while the resumed job needs only the re-queued tail.
+        # the final step count must equal restored version 4 + exactly
+        # the batches phase 2 ran — a fresh init would start at 0 and
+        # give step == len(losses).
         assert len(worker.losses) >= 1
+        assert int(state.step) == 4 + len(worker.losses)
     finally:
         for p in procs:
             if p.poll() is None:
