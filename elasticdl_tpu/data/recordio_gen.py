@@ -292,7 +292,22 @@ def convert_image_dir(image_dir, data_dir, records_per_shard=1024,
         for label, cls in enumerate(classes):
             cls_dir = os.path.join(image_dir, cls)
             for name in sorted(os.listdir(cls_dir)):
-                img = Image.open(os.path.join(cls_dir, name))
+                path = os.path.join(cls_dir, name)
+                if not os.path.isfile(path):
+                    continue  # nested dirs etc.
+                try:
+                    img = Image.open(path)
+                except Exception:
+                    # real directories carry .DS_Store/README strays —
+                    # skip loudly rather than abort the conversion
+                    from elasticdl_tpu.common.log_utils import (
+                        default_logger,
+                    )
+
+                    default_logger.warning(
+                        "skipping non-image file %s", path
+                    )
+                    continue
                 if image_mode is not None:
                     img = img.convert(image_mode)
                 if image_size is not None:

@@ -58,16 +58,44 @@ def _embedding_pspec(shape, ep_size, fsdp_size, threshold_bytes, itemsize=4):
     return _auto_pspec(shape, fsdp_size)
 
 
-def infer_state_pspec(state_shapes, mesh, embedding_threshold_bytes=None):
+def collect_annotations(boxed_params):
+    """{param path tuple -> PartitionSpec} for every flax ``Partitioned``
+    leaf (``nn.with_partitioning`` annotations in model code — the TP
+    model families). Paths are within the params tree."""
+    import flax.linen as nn
+    from flax import traverse_util
+
+    try:
+        from flax.core import unfreeze
+
+        tree = unfreeze(boxed_params)
+    except Exception:
+        tree = dict(boxed_params)
+    flat = traverse_util.flatten_dict(
+        tree, is_leaf=lambda _, v: isinstance(v, nn.Partitioned)
+    )
+    return {
+        tuple(str(k) for k in path): P(*leaf.names)
+        for path, leaf in flat.items()
+        if isinstance(leaf, nn.Partitioned)
+    }
+
+
+def infer_state_pspec(state_shapes, mesh, embedding_threshold_bytes=None,
+                      annotations=None):
     """PartitionSpecs for a whole TrainState from its eval_shape pytree.
 
-    Embedding-table leaves (key path containing EMBEDDING_PARAM_NAME) get
-    row sharding over (ep, fsdp); everything else the automatic fsdp rule.
-    Both apply uniformly across params AND optimizer state: optax moments
-    (mu/nu) mirror their param's path and shape, so they land on the same
-    spec — the co-sharding the reference gets by keeping slot tables next to
-    embedding shards on the same PS pod (ps/parameters.py
-    create_slot_params).
+    Precedence per leaf:
+    1. an explicit ``nn.with_partitioning`` annotation (`annotations`:
+       {param path tuple -> PartitionSpec}, see collect_annotations) —
+       matched by path SUFFIX so optax moments (mu/nu mirror their
+       param's path under opt_state) co-shard with their param;
+    2. embedding-table leaves (key path containing EMBEDDING_PARAM_NAME):
+       row sharding over (ep, fsdp);
+    3. the automatic fsdp rule.
+    The suffix matching gives optimizer state the same placement the
+    reference gets by keeping slot tables next to embedding shards on the
+    same PS pod (ps/parameters.py create_slot_params).
     """
     from elasticdl_tpu.common.constants import (
         EMBEDDING_PARTITION_THRESHOLD_BYTES,
@@ -78,9 +106,26 @@ def infer_state_pspec(state_shapes, mesh, embedding_threshold_bytes=None):
         embedding_threshold_bytes = EMBEDDING_PARTITION_THRESHOLD_BYTES
     fsdp = mesh.shape[MeshAxis.FSDP]
     ep = mesh.shape[MeshAxis.EP]
+    annotations = annotations or {}
+
+    def annotated_spec(keys, shape):
+        for param_path, spec in annotations.items():
+            if (
+                len(keys) >= len(param_path)
+                and keys[-len(param_path):] == param_path
+                and len(spec) <= len(shape)
+            ):
+                return spec
+        return None
 
     def leaf_spec(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()))
+        keys = tuple(
+            str(getattr(k, "key", getattr(k, "name", k))) for k in path
+        )
+        spec = annotated_spec(keys, shape)
+        if spec is not None:
+            return spec
         if is_embedding_path(path):
             itemsize = getattr(
                 getattr(leaf, "dtype", None), "itemsize", 4

@@ -75,18 +75,16 @@ def flatten_kv_record(kvs_string, feature_names,
 
 
 class KVFlatter(object):
-    """The UDTF that runs the flattening inside ODPS SQL.
+    """Local twin of the UDTF that runs the flattening inside ODPS SQL
+    (host-side normalization + tests; the cluster-side resource is the
+    self-contained BaseUDTF source UDF_RESOURCE_SOURCE below — a plain
+    object here because odps.udf only exists inside the ODPS runtime).
 
     Argument protocol (must match generate_transform_sql's projection,
     which is the reference's — normalize_kv_udf.py KVFlatter.process):
     args[0] = kv column value; args[1:-3] = append-column values (copied
     through, stringified); args[-3] = comma-joined feature names;
     args[-2] = pair separator; args[-1] = key-value separator.
-
-    Outside an ODPS runtime `forward` collects rows locally, so the
-    class is testable (and usable for host-side normalization) as-is;
-    under odps.udf the subclass in the generated resource inherits
-    BaseUDTF whose forward emits into the SQL engine.
     """
 
     def __init__(self):
@@ -106,6 +104,36 @@ class KVFlatter(object):
         for append_value in args[1:-3]:
             values.append(str(append_value))
         self.forward(*values)
+
+
+# The source uploaded as the ODPS python resource: a real BaseUDTF whose
+# forward() emits into the SQL engine. Self-contained (no imports from
+# this package — the cluster only has the resource file) with the same
+# process() protocol as the local KVFlatter above.
+UDF_RESOURCE_SOURCE = '''\
+from odps.udf import BaseUDTF
+
+
+class KVFlatter(BaseUDTF):
+    """Flatten "k1:v1,k2:v2" kv strings into per-feature columns."""
+
+    def process(self, *args):
+        if len(args) < 4:
+            raise ValueError(
+                "The input values number can not be less than 4"
+            )
+        feature_names = args[-3].split(",")
+        pair_sep, kv_sep = args[-2], args[-1]
+        kv = {}
+        for pair in args[0].split(pair_sep):
+            key_and_value = pair.split(kv_sep)
+            if len(key_and_value) == 2:
+                kv[key_and_value[0]] = key_and_value[1]
+        values = [kv.get(name, "") for name in feature_names]
+        for append_value in args[1:-3]:
+            values.append(str(append_value))
+        self.forward(*values)
+'''
 
 
 def generate_transform_sql(
@@ -172,11 +200,18 @@ def transform_kv_table(
     stamp = int(time.time())
     resource_name = "edl_tpu_kv_flat_%d.py" % stamp
     function_name = "edl_tpu_kv_flat_func_%d" % stamp
-    if udf_file_path is None:
-        udf_file_path = __file__
-    resource = odps_entry.create_resource(
-        resource_name, type="py", file_obj=open(udf_file_path)
-    )
+    if udf_file_path is not None:
+        with open(udf_file_path) as f:
+            resource = odps_entry.create_resource(
+                resource_name, type="py", file_obj=f
+            )
+    else:
+        import io
+
+        resource = odps_entry.create_resource(
+            resource_name, type="py",
+            file_obj=io.StringIO(UDF_RESOURCE_SOURCE),
+        )
     try:
         function = odps_entry.create_function(
             function_name,

@@ -172,6 +172,14 @@ class Trainer(object):
         perturb_shapes = dict(var_shapes).get(
             sparse_update.PERTURB_COLLECTION, {}
         )
+        # nn.with_partitioning annotations (TP model families): collected
+        # from the boxed init shapes, honored by infer_state_pspec, and
+        # stripped from the stored params below (unbox).
+        from elasticdl_tpu.parallel.sharding import collect_annotations
+
+        self._param_annotations = collect_annotations(
+            dict(var_shapes).get("params", {})
+        )
         self._perturb_shapes = jax.tree.map(
             lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
             perturb_shapes,
@@ -184,10 +192,12 @@ class Trainer(object):
         )
 
         def init_fn(rng, feats):
+            from flax.linen import meta as nn_meta
+
             variables = self.model.init(
                 {"params": rng, "dropout": rng}, feats, training=False
             )
-            variables = dict(variables)
+            variables = dict(nn_meta.unbox(variables))
             params = variables.pop("params")
             variables.pop(sparse_update.PERTURB_COLLECTION, None)
             variables.pop(sparse_update.SPARSE_IDS_COLLECTION, None)
@@ -205,7 +215,7 @@ class Trainer(object):
             )
 
         state_shapes = jax.eval_shape(init_fn, init_rng, features)
-        kwargs = {}
+        kwargs = {"annotations": self._param_annotations}
         if self.embedding_partition_threshold is not None:
             kwargs["embedding_threshold_bytes"] = (
                 self.embedding_partition_threshold

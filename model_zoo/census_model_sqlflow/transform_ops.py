@@ -175,28 +175,47 @@ def _host_layer(op):
                 "Bucketize %r needs boundaries for host execution" % op.name
             )
         return Discretization(bins=op.boundaries)
+    if op.op_type == TransformOpType.CONCAT:
+        return ConcatenateWithOffset(op.id_offsets)
     raise ValueError("%r is not a host-stage op" % op)
 
 
+class HostOpExecutor(object):
+    """Compiled form of the host stages: layers (vocab tables, hash
+    functions, bucket arrays) are built ONCE here, then reused for every
+    example — dataset_fn runs this per record, so per-record layer
+    construction (re-reading vocabulary files etc.) is the difference
+    between O(1) and O(dataset) setup work."""
+
+    def __init__(self, ops):
+        self._ops = [
+            (op, _host_layer(op))
+            for op in ops
+            if op.op_type not in (
+                TransformOpType.EMBEDDING, TransformOpType.ARRAY
+            )
+        ]
+
+    def __call__(self, example):
+        """One example dict -> {name: np.ndarray} including the source
+        columns; EMBEDDING/ARRAY stages live in the model."""
+        values = dict(example)
+        for op, layer in self._ops:
+            if op.op_type == TransformOpType.CONCAT:
+                parts = [
+                    np.asarray(values[name]).reshape(-1)
+                    for name in op.inputs
+                ]
+                values[op.output] = layer(parts)
+            else:
+                value = values[op.input]
+                if op.op_type == TransformOpType.BUCKETIZE:
+                    value = np.asarray(value, np.float32)
+                values[op.output] = np.asarray(layer(value)).reshape(-1)
+        return values
+
+
 def execute_host_ops(ops, example):
-    """Run the HASH/LOOKUP/BUCKETIZE/CONCAT stages of an (already
-    topo-sorted) op list over one example dict; EMBEDDING/ARRAY stages
-    are skipped (they live in the model). Returns {name: np.ndarray}
-    with the source columns included."""
-    values = dict(example)
-    for op in ops:
-        if op.op_type in (TransformOpType.EMBEDDING, TransformOpType.ARRAY):
-            continue
-        if op.op_type == TransformOpType.CONCAT:
-            parts = [
-                np.asarray(values[name]).reshape(-1) for name in op.inputs
-            ]
-            values[op.output] = ConcatenateWithOffset(op.id_offsets)(parts)
-        else:
-            value = values[op.input]
-            if op.op_type == TransformOpType.BUCKETIZE:
-                value = np.asarray(value, np.float32)
-            values[op.output] = np.asarray(
-                _host_layer(op)(value)
-            ).reshape(-1)
-    return values
+    """One-shot convenience over HostOpExecutor (tests); hot paths build
+    the executor once instead."""
+    return HostOpExecutor(ops)(example)

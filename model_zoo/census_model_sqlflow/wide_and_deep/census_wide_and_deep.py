@@ -23,14 +23,16 @@ from elasticdl_tpu.data.example_codec import decode_example
 from elasticdl_tpu.training.metrics import AUC
 from model_zoo.census_model_sqlflow import feature_configs as cfg
 from model_zoo.census_model_sqlflow.transform_ops import (
+    HostOpExecutor,
     TransformOpType,
-    execute_host_ops,
     topo_sort,
 )
 
 _SOURCE_COLUMNS = [s.name for s in cfg.INPUT_SCHEMAS]
 _SORTED_OPS = topo_sort(cfg.FEATURE_TRANSFORM_INFO, _SOURCE_COLUMNS)
 _OPS_BY_OUTPUT = {op.output: op for op in _SORTED_OPS}
+# layers built once (vocab tables etc.), reused for every record
+_EXECUTOR = HostOpExecutor(_SORTED_OPS)
 
 
 class SQLFlowWideDeep(nn.Module):
@@ -88,7 +90,7 @@ def dataset_fn(dataset, mode, _):
 
     def _parse(record):
         ex = decode_example(record)
-        values = execute_host_ops(_SORTED_OPS, ex)
+        values = _EXECUTOR(ex)
         features = {
             name: values[name].astype(np.int64) for name in group_names
         }

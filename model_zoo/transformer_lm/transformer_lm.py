@@ -24,18 +24,37 @@ from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.parallel.context_parallel import ring_attention
 
 
+def _tp_dense_init(split_axis):
+    """Megatron-style kernel annotation: split_axis=1 is column-parallel
+    (outputs sharded over tp), split_axis=0 row-parallel (inputs sharded;
+    XLA inserts the all-reduce on the partial sums). The annotations are
+    metadata only — on a tp=1 mesh they are no-ops; on tp>1 meshes
+    parallel/sharding.py collect_annotations turns them into placements
+    and GSPMD propagates through the activations."""
+    names = [None, None]
+    names[split_axis] = MeshAxis.TP
+    return nn.with_partitioning(
+        nn.initializers.lecun_normal(), tuple(names)
+    )
+
+
 class CausalSelfAttention(nn.Module):
     num_heads: int
     head_dim: int
     dtype: object = None  # compute dtype (bf16 on TPU); params stay fp32
     attn_impl: str = "auto"  # "auto": Pallas flash on TPU; "xla": blockwise
+    tp_shard: bool = True
 
     @nn.compact
     def __call__(self, x, training=False):
         b, l, e = x.shape
         h, d = self.num_heads, self.head_dim
         qkv = nn.Dense(
-            3 * h * d, use_bias=False, dtype=self.dtype, name="qkv"
+            3 * h * d, use_bias=False, dtype=self.dtype, name="qkv",
+            kernel_init=(
+                _tp_dense_init(1) if self.tp_shard
+                else nn.initializers.lecun_normal()
+            ),
         )(x)
         qkv = qkv.reshape(b, l, 3, h, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
@@ -48,7 +67,11 @@ class CausalSelfAttention(nn.Module):
             out = flash_attention(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
         return nn.Dense(
-            e, use_bias=False, dtype=self.dtype, name="proj"
+            e, use_bias=False, dtype=self.dtype, name="proj",
+            kernel_init=(
+                _tp_dense_init(0) if self.tp_shard
+                else nn.initializers.lecun_normal()
+            ),
         )(out)
 
 
@@ -58,6 +81,7 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     dtype: object = None
     attn_impl: str = "auto"
+    tp_shard: bool = True
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -65,12 +89,26 @@ class Block(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
             self.num_heads, self.head_dim, dtype=self.dtype,
-            attn_impl=self.attn_impl,
+            attn_impl=self.attn_impl, tp_shard=self.tp_shard,
+            name="attn",
         )(y, training)
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(y)
+        up_init = (
+            _tp_dense_init(1) if self.tp_shard
+            else nn.initializers.lecun_normal()
+        )
+        down_init = (
+            _tp_dense_init(0) if self.tp_shard
+            else nn.initializers.lecun_normal()
+        )
+        y = nn.Dense(
+            self.mlp_ratio * e, dtype=self.dtype, kernel_init=up_init,
+            name="mlp_up",
+        )(y)
         y = nn.gelu(y)
-        y = nn.Dense(e, dtype=self.dtype)(y)
+        y = nn.Dense(
+            e, dtype=self.dtype, kernel_init=down_init, name="mlp_down"
+        )(y)
         return x + y
 
 
@@ -82,6 +120,7 @@ class TransformerLM(nn.Module):
     num_layers: int = 2
     dtype: object = None  # compute dtype; None = fp32
     attn_impl: str = "auto"
+    tp_shard: bool = True  # annotate kernels over the tp mesh axis
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -97,11 +136,16 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = Block(
                 self.num_heads, head_dim, dtype=self.dtype,
-                attn_impl=self.attn_impl, name="block_%d" % i,
+                attn_impl=self.attn_impl, tp_shard=self.tp_shard,
+                name="block_%d" % i,
             )(x, training)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(
-            self.vocab_size, use_bias=False, dtype=self.dtype, name="head"
+            self.vocab_size, use_bias=False, dtype=self.dtype, name="head",
+            kernel_init=(
+                _tp_dense_init(1) if self.tp_shard
+                else nn.initializers.lecun_normal()
+            ),
         )(x)
         # loss math (softmax xent) wants fp32 logits regardless of the
         # compute dtype

@@ -224,6 +224,7 @@ def test_transform_kv_table_end_to_end_fake():
 
         def create_resource(self, name, type=None, file_obj=None):
             self.resources.add(name)
+            self.resource_content = file_obj.read()
             return name
 
         def delete_resource(self, name):
@@ -246,5 +247,9 @@ def test_transform_kv_table_end_to_end_fake():
     )
     assert names == ["f1", "f2", "f3"]
     assert len(entry.sql) == 1 and "FROM src" in entry.sql[0]
+    # the uploaded resource is a real cluster-side UDTF (BaseUDTF with
+    # a forwarding process()), not the local test twin
+    assert "BaseUDTF" in entry.resource_content
+    assert "def process" in entry.resource_content
     # temporaries cleaned up
     assert not entry.resources and not entry.functions

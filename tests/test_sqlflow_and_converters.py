@@ -198,6 +198,13 @@ def test_convert_image_dir_mixed_shapes(tmp_path):
     )
     records = [decode_example(r) for p in paths for r in Scanner(p)]
     assert all(r["image"].shape == (8, 8, 3) for r in records)
+    # stray non-image files and nested dirs are skipped, not fatal
+    (img_root / "a" / ".DS_Store").write_bytes(b"\x00junk")
+    (img_root / "a" / "nested").mkdir()
+    paths, _ = recordio_gen.convert_image_dir(
+        str(img_root), str(tmp_path / "o3"), image_mode="RGB"
+    )
+    assert sum(1 for p in paths for _ in Scanner(p)) == 2
 
 
 def test_convert_csv_ragged_row_and_long_strings(tmp_path):
