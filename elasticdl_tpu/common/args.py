@@ -21,7 +21,7 @@ MASTER_ONLY_ARGS = {
     "worker_resource_limit", "relaunch_on_worker_failure",
     "disable_relaunch", "task_timeout_check_interval", "cluster_spec",
     "image_pull_policy", "restart_policy", "volume", "need_tensorboard",
-    "tensorboard_log_dir", "export_saved_model",
+    "tensorboard_log_dir", "export_saved_model", "job_status_file",
 }
 
 
@@ -172,6 +172,12 @@ def add_master_params(parser):
         parser, "--export_saved_model", False,
         help="Export the model at train end via the TRAIN_END_CALLBACK "
              "task",
+    )
+    parser.add_argument(
+        "--job_status_file", default="",
+        help="Write the job phase (Pending/Running/Succeeded/Failed) to "
+             "this JSON file — the local-master twin of the k8s master-"
+             "pod status label, polled by scripts/validate_job_status.py",
     )
 
 

@@ -1,0 +1,66 @@
+"""Job status as a file — the local-master twin of the reference's
+"master pod labels carry job status" contract (common/k8s_client.py
+update_master_label; the CLI job monitor and scripts/
+validate_job_status.py poll it). Phases mirror pod phases so the same
+validator logic covers both the k8s and the no-cluster path.
+"""
+
+import json
+import os
+import tempfile
+import time
+
+PENDING = "Pending"
+RUNNING = "Running"
+SUCCEEDED = "Succeeded"
+FAILED = "Failed"
+
+PHASES = (PENDING, RUNNING, SUCCEEDED, FAILED)
+TERMINAL = (SUCCEEDED, FAILED)
+
+
+def write_job_status(path, status, **extra):
+    """Atomically write {"status": ..., "time": ..., **extra}. IO errors
+    are swallowed (returning False): status reporting is best-effort and
+    must never mask the actual job outcome — in particular not inside
+    the master's failure handler, where an OSError here would replace
+    the real traceback. Unknown phases still raise (caller bug)."""
+    if not path:
+        return False
+    if status not in PHASES:
+        raise ValueError("unknown job status %r (valid: %s)"
+                         % (status, PHASES))
+    payload = dict(extra, status=status, time=time.time())
+    tmp = None
+    try:
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, prefix=".job_status.")
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        tmp = None
+        return True
+    except OSError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "failed to write job status %r to %s", status, path,
+            exc_info=True,
+        )
+        return False
+    finally:
+        if tmp is not None and os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def read_job_status(path):
+    """The parsed status dict, or None when absent/partially written."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -11,6 +11,7 @@ from elasticdl_tpu.common.args import (
     parse_master_args,
     parse_resource_spec,
 )
+from elasticdl_tpu.common import job_status
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.common.model_utils import get_model_spec
 from elasticdl_tpu.master.master import Master
@@ -107,6 +108,21 @@ def create_instance_manager(args, task_d, master_port):
 
 def main(argv=None):
     args = parse_master_args(argv)
+    status_file = getattr(args, "job_status_file", "")
+    job_status.write_job_status(status_file, job_status.PENDING)
+    try:
+        rc = _run_master(args, status_file)
+    except BaseException:
+        job_status.write_job_status(status_file, job_status.FAILED)
+        raise
+    job_status.write_job_status(
+        status_file,
+        job_status.SUCCEEDED if rc == 0 else job_status.FAILED,
+    )
+    return rc
+
+
+def _run_master(args, status_file=""):
     spec = get_model_spec(args.model_zoo, args.model_def)
     callbacks_list = None
     if spec.callbacks_fn is not None:
@@ -151,6 +167,7 @@ def main(argv=None):
     if instance_manager:
         instance_manager.start_workers()
     logger.info("Master ready on port %d", master.port)
+    job_status.write_job_status(status_file, job_status.RUNNING)
     return master.run()
 
 

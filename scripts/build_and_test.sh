@@ -1,8 +1,26 @@
 #!/usr/bin/env bash
-# CI entry (reference scripts/build_and_test.sh): build native libs, run
-# the full pytest suite on the virtual 8-device CPU mesh.
+# CI entry (reference scripts/build_and_test.sh:17-32): build both native
+# libs from a clean tree, run the full pytest suite on the virtual
+# 8-device CPU mesh, then run one real local training job and validate
+# its status (the reference's minikube job drill, scripts/travis/
+# run_job.sh, without a cluster). One command, green, from a fresh clone.
+#
+#   scripts/build_and_test.sh            everything
+#   scripts/build_and_test.sh --no-drill suite only (plus pytest args)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+RUN_DRILL=1
+if [ "${1:-}" = "--no-drill" ]; then
+    RUN_DRILL=0
+    shift
+fi
+
+make -C elasticdl_tpu/native clean
 make -C elasticdl_tpu/native
+
 python -m pytest tests/ -q "$@"
+
+if [ "$RUN_DRILL" = "1" ]; then
+    bash scripts/run_local_job_drill.sh
+fi

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Local job drill (reference scripts/travis/run_job.sh:32-45 without the
+# minikube cluster): submit one `elasticdl-tpu train` job through the
+# client CLI — local master + 2 subprocess workers pulling tasks over
+# real gRPC — and validate its terminal status with
+# scripts/validate_job_status.py, exactly as the reference CI validated
+# pod phases.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+unset XLA_FLAGS || true
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+WORK_DIR=$(mktemp -d)
+trap 'rm -rf "$WORK_DIR"' EXIT
+DATA_DIR="$WORK_DIR/train"
+STATUS_FILE="$WORK_DIR/job_status.json"
+
+python - "$DATA_DIR" <<'EOF'
+import sys
+from elasticdl_tpu.data import recordio_gen
+recordio_gen.gen_mnist_like(sys.argv[1], num_files=2, records_per_file=48)
+EOF
+
+# setsid: own process group, so cleanup can kill master AND the worker
+# subprocesses LocalInstanceManager spawns (a bare kill of the master
+# skips Master.stop and would orphan them)
+setsid python -m elasticdl_tpu.client.main train \
+    --model_zoo model_zoo \
+    --model_def mnist_functional_api.mnist_functional_api.custom_model \
+    --training_data "$DATA_DIR" \
+    --num_workers 2 \
+    --minibatch_size 16 \
+    --records_per_task 24 \
+    --num_epochs 1 \
+    --job_name ci-local-drill \
+    --job_status_file "$STATUS_FILE" &
+MASTER_PID=$!
+
+# the validator also watches the master pid: a master that dies without
+# a terminal status fails fast (rc 3) instead of eating the timeout
+if python scripts/validate_job_status.py \
+    --status_file "$STATUS_FILE" 600 "$MASTER_PID"
+then
+    wait "$MASTER_PID"
+    echo "local job drill: PASSED"
+else
+    rc=$?
+    kill -- "-$MASTER_PID" 2>/dev/null || kill "$MASTER_PID" 2>/dev/null || true
+    echo "local job drill: FAILED (validator rc=$rc)" >&2
+    exit "$rc"
+fi

@@ -1,20 +1,85 @@
 #!/usr/bin/env python
-"""Poll a submitted job's pods until the master finishes — the CI
-validation step after `elasticdl-tpu train` (reference
-scripts/validate_job_status.py, 171 LoC: polls pod phases via the k8s
-API and exits nonzero if the job failed).
+"""Poll a submitted job until the master finishes — the CI validation
+step after `elasticdl-tpu train` (reference scripts/
+validate_job_status.py, 171 LoC: polls pod phases via the k8s API and
+exits nonzero if the job failed).
 
-Usage: validate_job_status.py <job_name> [namespace] [timeout_secs]
+Two modes, same phase semantics (Pending/Running/Succeeded/Failed):
+
+    validate_job_status.py <job_name> [namespace] [timeout_secs]
+        k8s mode: polls the master pod's phase.
+
+    validate_job_status.py --status_file <path> [timeout_secs] [pid]
+        local mode: polls the JSON status file the local master writes
+        when started with --job_status_file (the no-cluster twin of the
+        master-pod status label); with [pid], fails fast when that
+        master process dies without a terminal phase. Used by
+        scripts/build_and_test.sh.
+
+Exit codes: 0 Succeeded, 1 Failed, 2 timeout, 3 master died silently.
 """
 
+import os
 import sys
 import time
 
-from elasticdl_tpu.common.k8s_client import Client
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def validate_status_file(path, timeout=1800, poll_interval=1.0, pid=None):
+    """Local mode: poll the master's --job_status_file until a terminal
+    phase (common/job_status.py write/read). With `pid`, also watch the
+    master process: a master that dies without writing a terminal phase
+    (bad flag, OOM kill) fails fast (rc 3) instead of burning the whole
+    timeout."""
+    from elasticdl_tpu.common.job_status import (
+        FAILED,
+        SUCCEEDED,
+        read_job_status,
+    )
+
+    def check(status):
+        phase = status.get("status") if status else None
+        if phase == SUCCEEDED:
+            return 0
+        if phase == FAILED:
+            return 1
+        return None
+
+    deadline = time.time() + timeout
+    last = object()
+    while time.time() < deadline:
+        status = read_job_status(path)
+        phase = status.get("status") if status else None
+        if phase != last:
+            print("job phase: %s" % phase)
+            last = phase
+        rc = check(status)
+        if rc is not None:
+            return rc
+        if pid is not None and not _alive(pid):
+            # grace re-read: the terminal write may have just landed
+            time.sleep(poll_interval)
+            rc = check(read_job_status(path))
+            if rc is not None:
+                return rc
+            print("master process %d exited without terminal status" % pid)
+            return 3
+        time.sleep(poll_interval)
+    print("timed out after %ds" % timeout)
+    return 2
 
 
 def validate(job_name, namespace="default", timeout=1800,
              poll_interval=10, core_api=None):
+    from elasticdl_tpu.common.k8s_client import Client
+
     client = Client(
         image_name="", namespace=namespace, job_name=job_name,
         core_api=core_api,
@@ -46,6 +111,15 @@ def validate(job_name, namespace="default", timeout=1800,
 
 
 if __name__ == "__main__":
+    sys.path.insert(
+        0,
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if sys.argv[1] == "--status_file":
+        path = sys.argv[2]
+        t = int(sys.argv[3]) if len(sys.argv) > 3 else 1800
+        pid = int(sys.argv[4]) if len(sys.argv) > 4 else None
+        sys.exit(validate_status_file(path, t, pid=pid))
     job = sys.argv[1]
     ns = sys.argv[2] if len(sys.argv) > 2 else "default"
     t = int(sys.argv[3]) if len(sys.argv) > 3 else 1800

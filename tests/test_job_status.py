@@ -1,0 +1,121 @@
+"""Job-status file + validator (the CI drill's contract,
+scripts/run_local_job_drill.sh): phases mirror pod phases, writes are
+atomic, the validator exits 0/1/2 for Succeeded/Failed/timeout."""
+
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.common import job_status
+
+
+def test_write_read_roundtrip(tmp_path):
+    path = str(tmp_path / "status.json")
+    assert job_status.read_job_status(path) is None
+    job_status.write_job_status(path, job_status.PENDING)
+    assert job_status.read_job_status(path)["status"] == "Pending"
+    job_status.write_job_status(path, job_status.RUNNING, step=3)
+    got = job_status.read_job_status(path)
+    assert got["status"] == "Running" and got["step"] == 3
+    assert got["time"] <= time.time()
+
+
+def test_write_rejects_unknown_phase(tmp_path):
+    with pytest.raises(ValueError, match="unknown job status"):
+        job_status.write_job_status(str(tmp_path / "s"), "Exploded")
+
+
+def test_empty_path_is_noop():
+    job_status.write_job_status("", job_status.RUNNING)  # no crash
+
+
+def test_partial_file_reads_none(tmp_path):
+    path = tmp_path / "s.json"
+    path.write_text('{"status": "Run')  # torn write
+    assert job_status.read_job_status(str(path)) is None
+
+
+def _validator():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "validate_job_status",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "validate_job_status.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_validator_success_and_failure(tmp_path):
+    v = _validator()
+    path = str(tmp_path / "s.json")
+    job_status.write_job_status(path, job_status.SUCCEEDED)
+    assert v.validate_status_file(path, timeout=5, poll_interval=0.01) == 0
+    job_status.write_job_status(path, job_status.FAILED)
+    assert v.validate_status_file(path, timeout=5, poll_interval=0.01) == 1
+
+
+def test_validator_polls_until_terminal(tmp_path):
+    v = _validator()
+    path = str(tmp_path / "s.json")
+    job_status.write_job_status(path, job_status.RUNNING)
+
+    def finish():
+        time.sleep(0.3)
+        job_status.write_job_status(path, job_status.SUCCEEDED)
+
+    t = threading.Thread(target=finish)
+    t.start()
+    assert v.validate_status_file(path, timeout=10, poll_interval=0.05) == 0
+    t.join()
+
+
+def test_validator_fails_fast_on_dead_master(tmp_path):
+    """A master pid that no longer exists -> rc 3 well before timeout."""
+    import subprocess
+    import sys as _sys
+
+    v = _validator()
+    path = str(tmp_path / "s.json")
+    job_status.write_job_status(path, job_status.RUNNING)
+    proc = subprocess.Popen([_sys.executable, "-c", "pass"])
+    proc.wait()
+    t0 = time.time()
+    rc = v.validate_status_file(
+        path, timeout=30, poll_interval=0.05, pid=proc.pid
+    )
+    assert rc == 3
+    assert time.time() - t0 < 5
+
+    # ...but a dead pid with a terminal status still validates normally
+    job_status.write_job_status(path, job_status.SUCCEEDED)
+    assert v.validate_status_file(
+        path, timeout=5, poll_interval=0.05, pid=proc.pid
+    ) == 0
+
+
+def test_validator_timeout(tmp_path):
+    v = _validator()
+    path = str(tmp_path / "never.json")
+    assert v.validate_status_file(
+        path, timeout=0.3, poll_interval=0.05
+    ) == 2
+
+
+def test_master_main_writes_failed_on_bad_model(tmp_path):
+    """master.main marks the job Failed when it dies before running."""
+    from elasticdl_tpu.master.main import main
+
+    path = str(tmp_path / "s.json")
+    with pytest.raises(Exception):
+        main([
+            "--model_zoo", "model_zoo",
+            "--model_def", "no_such.module.custom_model",
+            "--job_status_file", path,
+            "--training_data", str(tmp_path),
+        ])
+    assert job_status.read_job_status(path)["status"] == "Failed"
