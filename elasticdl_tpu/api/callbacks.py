@@ -49,7 +49,10 @@ class SavedModelExporter(Callback):
             logger.warning("No trained state to export")
             return
         path = export_model(
-            worker.trainer.model, worker.state, self.export_dir
+            worker.trainer.model,
+            worker.state,
+            self.export_dir,
+            host_manager=worker.trainer.host_manager,
         )
         logger.info("Exported trained model to %s", path)
 

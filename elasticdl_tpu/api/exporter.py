@@ -39,13 +39,31 @@ def _gather_full(tree):
     return jax.tree.map(leaf, tree)
 
 
-def export_model(model, state, export_dir):
-    """Write the export artifact from a live TrainState. Returns the dir."""
+def export_model(model, state, export_dir, host_manager=None):
+    """Write the export artifact from a live TrainState. Returns the dir.
+
+    With `host_manager` (embedding/host_bridge), the artifact also
+    carries every host-resident table's trained rows — the reference's
+    export restored PS-resident embedding rows into the exported model
+    (model_handler.py get_model_to_export); here the host tier is the
+    PS-resident tier, so serving needs those rows too
+    (make_serving_fn re-seeds a manager from them)."""
     os.makedirs(export_dir, exist_ok=True)
     payload = {
         "params": _gather_full(state.params),
         "model_state": _gather_full(dict(state.model_state)),
     }
+    if host_manager and jax.process_index() == 0:
+        # host stores are process-local and only process 0 serializes, so
+        # don't materialize full-table copies on the other processes
+        host = {}
+        for name, table in host_manager.tables().items():
+            ids, values = table.engine.param.export_rows()
+            host[name] = {
+                "ids": np.asarray(ids, np.int64),
+                "values": np.asarray(values, np.float32),
+            }
+        payload["host_embeddings"] = host
     if jax.process_index() == 0:
         with open(os.path.join(export_dir, PARAMS_FILE), "wb") as f:
             f.write(serialization.to_bytes(payload))
@@ -65,16 +83,24 @@ def export_model(model, state, export_dir):
     return export_dir
 
 
-def export_from_checkpoint(model, template_state, checkpoint_dir, export_dir):
+def export_from_checkpoint(model, template_state, checkpoint_dir, export_dir,
+                           host_manager=None):
     """Export the LATEST valid checkpoint (the reference export path reads
-    the newest checkpoint, not live PS state — model_handler.py:247-273)."""
-    from elasticdl_tpu.checkpoint import restore_state_from_checkpoint
+    the newest checkpoint, not live PS state — model_handler.py:247-273).
+    With `host_manager`, host rows are restored from the SAME checkpoint
+    version — into a FRESH clone of the manager, never the caller's
+    engines: those mutate in place, and rewinding a live training job's
+    host tier to the checkpoint while its dense state stays live would
+    silently corrupt subsequent updates."""
+    from elasticdl_tpu.embedding.host_bridge import restore_with_host_state
 
-    state, version = restore_state_from_checkpoint(
-        template_state, checkpoint_dir
+    export_manager = host_manager.fresh_clone() if host_manager else None
+    state, version = restore_with_host_state(
+        template_state, export_manager, checkpoint_dir
     )
     logger.info("Exporting checkpoint version %d", version)
-    return export_model(model, state, export_dir)
+    return export_model(model, state, export_dir,
+                        host_manager=export_manager)
 
 
 def load_exported(export_dir):
@@ -89,12 +115,47 @@ def load_exported(export_dir):
     return payload, meta
 
 
-def make_serving_fn(model, payload):
-    """A jitted features → predictions callable over exported weights."""
+def make_serving_fn(model, payload, host_manager=None):
+    """A jitted features → predictions callable over exported weights.
+
+    Exported host tables (payload["host_embeddings"]) need a manager
+    whose registrations match the model (embedding/host_bridge
+    build_manager_from_spec): its engines are re-seeded from the
+    exported rows and `serve` pulls them per batch outside the jit,
+    exactly as in training."""
     variables = {"params": payload["params"], **payload.get("model_state", {})}
+    host_rows = payload.get("host_embeddings") or {}
+    if host_rows and host_manager is None:
+        raise ValueError(
+            "exported model carries host-resident tables %s; pass the "
+            "spec's HostEmbeddingManager (build_manager_from_spec)"
+            % sorted(host_rows)
+        )
+    if host_rows:
+        tables = host_manager.tables()
+        if set(tables) != set(host_rows):
+            # strict equality: a manager table ABSENT from the artifact
+            # would otherwise serve lazily-initialized random rows
+            raise ValueError(
+                "host-table mismatch: artifact has %s, manager has %s"
+                % (sorted(host_rows), sorted(tables))
+            )
+        for name, rec in host_rows.items():
+            engine = tables[name].engine
+            engine.param.clear()
+            engine.param.set_rows(
+                np.asarray(rec["ids"], np.int64),
+                np.asarray(rec["values"], np.float32),
+            )
 
     @jax.jit
-    def serve(features):
+    def apply_fn(features):
         return model.apply(variables, features, training=False)
+
+    if not host_rows:
+        return apply_fn
+
+    def serve(features):
+        return apply_fn(host_manager.prepare(dict(features)))
 
     return serve

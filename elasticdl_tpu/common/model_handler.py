@@ -44,10 +44,14 @@ class ModelHandler(object):
         docstring). Kept for API parity with the reference's rewrite."""
         return model
 
-    def get_model_to_export(self, model, state, export_dir):
+    def get_model_to_export(self, model, state, export_dir,
+                            host_manager=None):
         """Gather weights (preferring the latest checkpoint when one exists,
         as the reference does — model_handler.py:247-273) and write the
-        export artifact."""
+        export artifact. `host_manager` carries the host-resident tier
+        into the artifact (the reference restored PS-resident embedding
+        rows into the exported model — its rows lived on PS pods; ours
+        live in the host store)."""
         from elasticdl_tpu.api import exporter
         from elasticdl_tpu.checkpoint import get_latest_checkpoint_version
 
@@ -59,9 +63,12 @@ class ModelHandler(object):
                 "Exporting from checkpoint dir %s", self._checkpoint_dir
             )
             return exporter.export_from_checkpoint(
-                model, state, self._checkpoint_dir, export_dir
+                model, state, self._checkpoint_dir, export_dir,
+                host_manager=host_manager,
             )
-        return exporter.export_model(model, state, export_dir)
+        return exporter.export_model(
+            model, state, export_dir, host_manager=host_manager
+        )
 
 
 class LocalModelHandler(ModelHandler):
@@ -70,4 +77,49 @@ class LocalModelHandler(ModelHandler):
 
 class MeshModelHandler(ModelHandler):
     """Mesh (PS-equivalent) strategy (reference
-    ParameterServerModelHandler, model_handler.py:207-466)."""
+    ParameterServerModelHandler, model_handler.py:207-466).
+
+    The reference's handler did two jobs: (1) swap oversized native
+    embedding layers for PS-backed ones at train time, (2) invert the
+    swap + restore PS rows at export. On TPU, (1) is a sharding/tier
+    decision the Embedding layer + infer_state_pspec make from the same
+    2 MB threshold, and (2) is the host_manager plumbing in
+    get_model_to_export. What remains strategy-specific is validation:
+    the mesh path must refuse an export artifact that silently drops a
+    distributed tier (sharded params that failed to gather, host tables
+    missing from the payload)."""
+
+    def get_model_to_export(self, model, state, export_dir,
+                            host_manager=None):
+        out = super().get_model_to_export(
+            model, state, export_dir, host_manager=host_manager
+        )
+        self._validate_export(state, export_dir, host_manager)
+        return out
+
+    def _validate_export(self, state, export_dir, host_manager):
+        import jax
+
+        from elasticdl_tpu.api.exporter import load_exported
+
+        if jax.process_index() != 0:
+            # only process 0 writes the artifact; other processes may not
+            # even share its filesystem
+            return
+        payload, _ = load_exported(export_dir)
+        n_state = len(jax.tree.leaves(state.params))
+        n_export = len(jax.tree.leaves(payload["params"]))
+        if n_export != n_state:
+            raise RuntimeError(
+                "export dropped parameters: %d leaves exported, state "
+                "has %d" % (n_export, n_state)
+            )
+        if host_manager:
+            exported = set(payload.get("host_embeddings") or {})
+            expected = set(host_manager.tables())
+            if exported != expected:
+                raise RuntimeError(
+                    "export host-table mismatch: artifact has %s, "
+                    "manager has %s"
+                    % (sorted(exported), sorted(expected))
+                )

@@ -107,6 +107,15 @@ class HostEmbeddingManager(object):
     def tables(self):
         return dict(self._tables)
 
+    def fresh_clone(self):
+        """A NEW manager with the same registrations but fresh, empty
+        engines — for restoring checkpoint state without touching the
+        live stores (engines mutate in place)."""
+        clone = HostEmbeddingManager(pad_multiple=self.pad_multiple)
+        for name, t in self._tables.items():
+            clone.register(name, t.ids_feature, t.engine.fresh_clone())
+        return clone
+
     def rows_keys(self):
         """Feature keys holding differentiable pulled rows, sorted for a
         stable compiled-signature order."""

@@ -41,6 +41,10 @@ class HostSpillEmbeddingEngine(object):
         self.dim = dim
         self.optimizer = optimizer
         self.hyperparams = hyperparams
+        self._ctor_kwargs = dict(
+            seed=seed, init_low=init_low, init_high=init_high,
+            force_python=force_python,
+        )
         self.param = HostEmbeddingStore(
             dim, seed=seed, init_low=init_low, init_high=init_high,
             force_python=force_python,
@@ -54,6 +58,15 @@ class HostSpillEmbeddingEngine(object):
             for name in _SLOT_NAMES[optimizer]
         }
         self._step = 0
+
+    def fresh_clone(self):
+        """A NEW empty engine with this one's configuration — used to
+        restore checkpoint state without mutating live stores
+        (api/exporter.export_from_checkpoint)."""
+        return HostSpillEmbeddingEngine(
+            self.dim, optimizer=self.optimizer, **self._ctor_kwargs,
+            **self.hyperparams,
+        )
 
     # ------------------------------------------------------------- pull
 
