@@ -131,18 +131,30 @@ def make_serving_fn(model, payload, host_manager=None):
             "spec's HostEmbeddingManager (build_manager_from_spec)"
             % sorted(host_rows)
         )
+    if host_manager and not host_rows:
+        # a host-tier model whose artifact lacks rows (export_model was
+        # called without the manager) must fail HERE with a clear
+        # message, not later as a KeyError on '<table>.rows' inside jit
+        raise ValueError(
+            "manager declares host tables %s but the artifact carries "
+            "none — re-export with host_manager passed to export_model"
+            % sorted(host_manager.tables())
+        )
     if host_rows:
-        tables = host_manager.tables()
-        if set(tables) != set(host_rows):
+        if set(host_manager.tables()) != set(host_rows):
             # strict equality: a manager table ABSENT from the artifact
             # would otherwise serve lazily-initialized random rows
             raise ValueError(
                 "host-table mismatch: artifact has %s, manager has %s"
-                % (sorted(host_rows), sorted(tables))
+                % (sorted(host_rows), sorted(host_manager.tables()))
             )
+        # NEVER mutate the caller's engines (they may be a live training
+        # tier whose slots/step must stay aligned with its rows): serve
+        # from a fresh clone seeded with the exported rows
+        host_manager = host_manager.fresh_clone()
+        tables = host_manager.tables()
         for name, rec in host_rows.items():
             engine = tables[name].engine
-            engine.param.clear()
             engine.param.set_rows(
                 np.asarray(rec["ids"], np.int64),
                 np.asarray(rec["values"], np.float32),

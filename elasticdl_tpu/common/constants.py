@@ -64,6 +64,7 @@ class MeshAxis(object):
     ep    expert / embedding-shard axis (sparse tables are sharded over it)
     tp    tensor parallel
     sp    sequence / context parallel (ring attention)
+    pp    pipeline parallel (layer stages, parallel/pipeline.py)
     """
 
     DP = "dp"
@@ -71,7 +72,8 @@ class MeshAxis(object):
     EP = "ep"
     TP = "tp"
     SP = "sp"
-    ALL = (DP, FSDP, EP, TP, SP)
+    PP = "pp"
+    ALL = (DP, FSDP, EP, TP, SP, PP)
 
 
 # Max retries for a dispatched task before the job fails

@@ -1,0 +1,144 @@
+"""Pipeline parallelism over the ``pp`` mesh axis: GPipe-style
+microbatch streaming built from shard_map + ppermute.
+
+Net-new capability like ring attention (the reference has no pipeline
+axis anywhere — SURVEY.md §2.5 "TP / PP / SP ... absent"); the design is
+the standard TPU recipe (jax-ml scaling-book "pipelining"): each device
+holds a contiguous chunk of the layer stack (leading dim of the stacked
+params sharded over ``pp``), microbatches stream through the stages, and
+the activation handoff between consecutive stages is a ``ppermute`` ring
+step. The whole pipeline is a pure function, so jax AD derives the
+backward pipeline (reverse ppermutes, transposed schedule) for free and
+the Trainer's compiled step needs no changes.
+
+Schedule: plain GPipe — M microbatches over P stages take M + P - 1
+ticks; the (P-1)/(M+P-1) bubble fraction shrinks as M grows. Stages
+compute garbage during fill/drain ticks (masked out at collection), the
+same trade the canonical SPMD pipelines make: a no-op tick would still
+have to execute the stage body under SPMD.
+"""
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        # manual-collectives mode: the body mixes per-stage values with
+        # replicated ones, which the varying-manual-axes checker rejects
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_old(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.common.constants import MeshAxis
+
+
+def stage_size(mesh):
+    return mesh.shape[MeshAxis.PP]
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches,
+                   batch_spec=None):
+    """Run `x` through all pipeline stages in order.
+
+    stage_fn(local_params, x_mb) -> y_mb: one STAGE's computation (the
+        local chunk of the layer stack; same output shape as input).
+    stacked_params: pytree whose every leaf has leading dim == total
+        layers (or stages) divisible by pp, sharded P("pp") on dim 0 —
+        each device receives its contiguous chunk.
+    x: [batch, ...]; batch must divide into num_microbatches, and the
+        per-device batch (after dp/fsdp sharding) too.
+    batch_spec: PartitionSpec of x (default: batch over (dp, fsdp)).
+
+    Returns y with x's shape/sharding (replicated over pp).
+    """
+    n_stages = stage_size(mesh)
+    m = int(num_microbatches)
+    if m < 1:
+        raise ValueError("num_microbatches must be >= 1")
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] % n_stages != 0:
+            raise ValueError(
+                "stacked param leading dim %d not divisible by pp=%d"
+                % (leaf.shape[0], n_stages)
+            )
+    if batch_spec is None:
+        batch_spec = P((MeshAxis.DP, MeshAxis.FSDP))
+
+    def body(params, xb):
+        stage = jax.lax.axis_index(MeshAxis.PP)
+        b_loc = xb.shape[0]
+        if b_loc % m:
+            raise ValueError(
+                "per-device batch %d not divisible by %d microbatches"
+                % (b_loc, m)
+            )
+        mbs = xb.reshape((m, b_loc // m) + xb.shape[1:])
+        outs0 = jnp.zeros_like(mbs)
+        act0 = jnp.zeros_like(mbs[0])
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            act, outs = carry
+            # stage 0 ingests microbatch t (clipped: fill/drain ticks
+            # compute garbage that never leaves the pipe)
+            feed = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            inp = jnp.where(stage == 0, feed, act)
+            out = stage_fn(params, inp)
+            # the LAST stage banks microbatch t-(P-1)'s result
+            idx = t - (n_stages - 1)
+            idx_c = jnp.clip(idx, 0, m - 1)
+            current = jax.lax.dynamic_index_in_dim(
+                outs, idx_c, 0, keepdims=False
+            )
+            banked = jnp.where(idx >= 0, out, current)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, banked, idx_c, 0
+            )
+            act = jax.lax.ppermute(out, MeshAxis.PP, fwd)
+            return (act, outs), None
+
+        (act, outs), _ = jax.lax.scan(
+            tick, (act0, outs0), jnp.arange(m + n_stages - 1)
+        )
+        # broadcast the last stage's banked outputs to every pp rank
+        mask = (stage == n_stages - 1).astype(outs.dtype)
+        outs = jax.lax.psum(outs * mask, MeshAxis.PP)
+        return outs.reshape(xb.shape)
+
+    return shard_map(
+        body,
+        mesh,
+        (P(MeshAxis.PP), batch_spec),
+        batch_spec,
+    )(stacked_params, x)
+
+
+def sequential_apply(stage_fn, stacked_params, x, n_stages):
+    """Oracle: the same stages run one after another without the mesh —
+    what pipeline_apply must equal numerically (tests + the pp=1 path).
+    """
+    chunk = jax.tree.leaves(stacked_params)[0].shape[0] // n_stages
+
+    def one(i, xv):
+        local = jax.tree.map(
+            lambda l: jax.lax.dynamic_slice_in_dim(l, i * chunk, chunk, 0),
+            stacked_params,
+        )
+        return stage_fn(local, xv)
+
+    for i in range(n_stages):
+        x = one(i, x)
+    return x

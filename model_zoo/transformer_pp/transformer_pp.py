@@ -1,0 +1,200 @@
+"""Pipeline-parallel causal transformer LM: the layer stack streams
+through pp stages (parallel/pipeline.py GPipe schedule), composing with
+dp/fsdp on the same mesh.
+
+Net-new beyond the reference (which has no pipeline axis — SURVEY.md
+§2.5) and beyond transformer_lm: where that family annotates kernels for
+TENSOR parallelism, this one stacks all blocks' params with a leading
+layer dim annotated over ``pp`` (nn.with_partitioning, so each device
+holds its contiguous chunk of layers + co-sharded optimizer moments) and
+runs the stack through pipeline_apply. With pp=1 the identical stage
+function runs sequentially — the single-device oracle the tests compare
+against. Zoo spec surface matches every other family.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import MeshAxis, Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.ops.attention import blockwise_attention
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.parallel.pipeline import pipeline_apply, sequential_apply
+
+# One transformer block's parameter shapes, given embed dim e, heads h,
+# mlp ratio r: {name: shape-without-the-leading-layer-dim}.
+
+
+def _block_param_shapes(e, r):
+    return {
+        "ln1_scale": (e,), "ln1_bias": (e,),
+        "qkv_w": (e, 3 * e),
+        "proj_w": (e, e),
+        "ln2_scale": (e,), "ln2_bias": (e,),
+        "up_w": (e, r * e), "up_b": (r * e,),
+        "down_w": (r * e, e), "down_b": (e,),
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _block_apply(p, x, num_heads):
+    """One block, pure-fn form of transformer_lm.Block (pre-LN attention
+    + MLP residuals); p holds ONE layer's params (no leading dim)."""
+    b, l, e = x.shape
+    d = e // num_heads
+    y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = (y @ p["qkv_w"]).reshape(b, l, 3, num_heads, d)
+    qkv = qkv.transpose(2, 0, 3, 1, 4)
+    out = blockwise_attention(qkv[0], qkv[1], qkv[2], causal=True)
+    out = out.transpose(0, 2, 1, 3).reshape(b, l, e)
+    x = x + out @ p["proj_w"]
+    y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    y = jax.nn.gelu(y @ p["up_w"] + p["up_b"])
+    return x + y @ p["down_w"] + p["down_b"]
+
+
+def _stage_fn(num_heads):
+    """A pipeline stage = its contiguous chunk of layers, scanned."""
+
+    def stage(local_params, x):
+        def body(carry, layer_params):
+            return _block_apply(layer_params, carry, num_heads), None
+
+        out, _ = jax.lax.scan(body, x, local_params)
+        return out
+
+    return stage
+
+
+def _stacked_init(name, shape):
+    """Per-layer initializer for a stacked [L, ...] param."""
+    if name.endswith(("_bias", "_b")):
+        return nn.initializers.zeros
+    if name.endswith("_scale"):
+        return nn.initializers.ones
+
+    base = nn.initializers.lecun_normal()
+
+    def init(key, full_shape, dtype=jnp.float32):
+        n_layers = full_shape[0]
+        keys = jax.random.split(key, n_layers)
+        return jnp.stack(
+            [base(k, full_shape[1:], dtype) for k in keys]
+        )
+
+    return init
+
+
+class TransformerPP(nn.Module):
+    vocab_size: int = 256
+    seq_len: int = 128
+    embed_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 4
+    mlp_ratio: int = 4
+    num_microbatches: int = 2
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        tokens = features["tokens"]
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="wte")(tokens)
+        pos = nn.Embed(self.seq_len, self.embed_dim, name="wpe")(
+            jnp.arange(tokens.shape[1])[None, :]
+        )
+        x = x + pos
+
+        blocks = {}
+        for name, shape in _block_param_shapes(
+            self.embed_dim, self.mlp_ratio
+        ).items():
+            blocks[name] = self.param(
+                "blk_%s" % name,
+                nn.with_partitioning(
+                    _stacked_init(name, shape),
+                    (MeshAxis.PP,) + (None,) * len(shape),
+                ),
+                (self.num_layers,) + shape,
+            )
+
+        stage = _stage_fn(self.num_heads)
+        mesh = mesh_lib.current_mesh()
+        pp = mesh.shape.get(MeshAxis.PP, 1) if mesh is not None else 1
+        if pp > 1:
+            if self.num_layers % pp:
+                raise ValueError(
+                    "num_layers=%d not divisible by pp=%d"
+                    % (self.num_layers, pp)
+                )
+            x = pipeline_apply(
+                stage, blocks, x, mesh, self.num_microbatches
+            )
+        else:
+            x = sequential_apply(stage, blocks, x, 1)
+
+        x = _layer_norm(
+            x,
+            self.param("lnf_scale", nn.initializers.ones,
+                       (self.embed_dim,)),
+            self.param("lnf_bias", nn.initializers.zeros,
+                       (self.embed_dim,)),
+        )
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, name="head"
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def custom_model(**kwargs):
+    return TransformerPP(**kwargs)
+
+
+def loss(labels, predictions, sample_weights=None):
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels
+    ).mean(axis=-1)
+    if sample_weights is None:
+        return jnp.mean(ce)
+    return jnp.sum(ce * sample_weights) / jnp.maximum(
+        jnp.sum(sample_weights), 1.0
+    )
+
+
+def optimizer(lr=3e-4):
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        ex = decode_example(record)
+        tokens = ex["tokens"].astype(np.int32)
+        features = {"tokens": tokens[:-1]}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, tokens[1:]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "token_accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=-1)
+            == np.asarray(labels)
+        ).astype(np.float32).reshape(len(labels), -1).mean(axis=1)
+    }
+
+
+def feature_shapes(seq_len=128):
+    return {"tokens": (seq_len,)}

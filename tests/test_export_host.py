@@ -84,6 +84,42 @@ def test_serving_without_manager_raises(tmp_path):
     with pytest.raises(ValueError, match="host-table mismatch"):
         exporter.make_serving_fn(trainer.model, payload,
                                  host_manager=bigger)
+    # artifact written WITHOUT the manager + host-tier manager at serve
+    # time -> clear construction-time error, not a KeyError inside jit
+    bare_dir = str(tmp_path / "bare")
+    exporter.export_model(trainer.model, state, bare_dir)
+    bare_payload, _ = exporter.load_exported(bare_dir)
+    with pytest.raises(ValueError, match="artifact carries none"):
+        exporter.make_serving_fn(trainer.model, bare_payload,
+                                 host_manager=_fresh_manager())
+
+
+def test_serving_never_mutates_callers_manager(tmp_path):
+    """make_serving_fn seeds a fresh clone: a live training manager
+    passed in keeps its rows (slots/step stay aligned)."""
+    trainer, manager, state, batches = _train(2)
+    export_dir = str(tmp_path / "export")
+    exporter.export_model(
+        trainer.model, state, export_dir, host_manager=manager
+    )
+    # train one more step: live rows move past the exported ones
+    state, _ = trainer.train_step(state, batches[0])
+    engine = manager.tables()["edl_embedding"].engine
+    ids_live, vals_live = engine.param.export_rows()
+    ids_live, vals_live = ids_live.copy(), vals_live.copy()
+
+    payload, _ = exporter.load_exported(export_dir)
+    serve = exporter.make_serving_fn(
+        trainer.model, payload, host_manager=manager
+    )
+    serve(dict(batches[0][0]))  # serving works...
+    ids_after, vals_after = engine.param.export_rows()
+    # ...and the live engine is bit-identical to before
+    np.testing.assert_array_equal(np.sort(ids_after), np.sort(ids_live))
+    np.testing.assert_allclose(
+        vals_after[np.argsort(ids_after)],
+        vals_live[np.argsort(ids_live)], atol=0,
+    )
 
 
 def test_mesh_handler_validates_and_exports(tmp_path):
