@@ -197,6 +197,24 @@ def gen_iris_csv(data_dir, num_files=2, rows_per_file=64, seed=0):
     return paths
 
 
+def gen_tokens_like(data_dir, num_files=2, records_per_file=128, seed=0,
+                    seq_len=33, vocab_size=64):
+    """Token-sequence records for the sequence families (transformer_lm /
+    transformer_pp consume seq_len+1 tokens per record; bert masks
+    seq_len tokens). Each record is self-describing (carries
+    vocab_size), so dataset_fns can mask without out-of-band config."""
+    def example(rng):
+        return {
+            "tokens": rng.randint(
+                0, vocab_size, size=(seq_len,)
+            ).astype(np.int64),
+            "vocab_size": np.array(vocab_size, np.int64),
+        }
+
+    return _generate(data_dir, "tokens", example, num_files,
+                     records_per_file, seed)
+
+
 # -------------------------------------------------- real-dataset converters
 #
 # Counterparts of the reference's data/recordio_gen/ converters that worked

@@ -1,0 +1,168 @@
+"""BERT-class bidirectional encoder with a masked-LM objective — the
+"BERT-base config" scale target SURVEY.md §7 stage 8 reserves (the
+reference zoo tops out at ResNet50 and has no sequence model at all).
+
+Same zoo spec surface as every family. The encoder reuses
+transformer_lm's Block with causal=False, so attention dispatch (flash /
+blockwise / ring over `sp`), Megatron TP annotations, and the bf16
+compute knob live in ONE place.
+
+Masking (dataset_fn, host-side): 15% of positions are targets; of those
+80% -> [MASK], 10% -> random token, 10% -> unchanged — the standard BERT
+recipe, STATIC per record (positions derive from the record's content,
+original-BERT style: every epoch re-masks a record identically, but
+distinct records mask independently). [MASK] is a RESERVED id one past
+the data vocabulary: the model's embedding table has vocab_size + 1
+rows, so a genuine token can never collide with the mask. Labels carry
+the ORIGINAL token at target positions and IGNORE_LABEL elsewhere; the
+loss averages cross-entropy over target positions only.
+"""
+
+import zlib
+
+import numpy as np
+
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from model_zoo.transformer_lm.transformer_lm import (
+    Block,
+    _tp_dense_init,
+    resolve_dtype,
+)
+
+IGNORE_LABEL = -1
+MASK_PROB = 0.15
+
+
+class BertEncoder(nn.Module):
+    vocab_size: int = 256  # DATA vocabulary; [MASK] gets one extra row
+    seq_len: int = 128
+    embed_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    dtype: object = None
+    attn_impl: str = "auto"
+    tp_shard: bool = True
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        tokens = features["tokens"]
+        x = nn.Embed(
+            self.vocab_size + 1, self.embed_dim, dtype=self.dtype,
+            name="wte",
+        )(tokens)
+        pos = nn.Embed(
+            self.seq_len, self.embed_dim, dtype=self.dtype, name="wpe"
+        )(jnp.arange(tokens.shape[1])[None, :])
+        x = x + pos
+        head_dim = self.embed_dim // self.num_heads
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads, head_dim, dtype=self.dtype,
+                attn_impl=self.attn_impl, tp_shard=self.tp_shard,
+                causal=False, name="layer_%d" % i,
+            )(x, training)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # MLM head: transform + vocab projection (BERT's cls/predictions)
+        x = nn.gelu(
+            nn.Dense(self.embed_dim, dtype=self.dtype, name="mlm_dense")(x)
+        )
+        x = nn.LayerNorm(dtype=self.dtype, name="mlm_ln")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=True, dtype=self.dtype,
+            name="mlm_head",
+            kernel_init=(
+                _tp_dense_init(1) if self.tp_shard
+                else nn.initializers.lecun_normal()
+            ),
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def custom_model(**kwargs):
+    return BertEncoder(**resolve_dtype(kwargs, "bert"))
+
+
+def loss(labels, predictions, sample_weights=None):
+    """Cross-entropy over masked positions only; labels == IGNORE_LABEL
+    elsewhere."""
+    mask = (labels != IGNORE_LABEL).astype(jnp.float32)
+    safe_labels = jnp.maximum(labels, 0)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        predictions, safe_labels
+    ) * mask
+    if sample_weights is not None:
+        ce = ce * sample_weights[:, None]
+        mask = mask * sample_weights[:, None]
+    return jnp.sum(ce) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def optimizer(lr=1e-4):
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def _mask_tokens(tokens, vocab_size, rng):
+    """The 80/10/10 BERT masking recipe over one sequence. [MASK] is the
+    reserved id `vocab_size` (one past the data vocabulary); random
+    replacements draw from the DATA vocabulary only."""
+    mask_id = vocab_size
+    targets = rng.rand(tokens.size) < MASK_PROB
+    labels = np.where(targets, tokens, IGNORE_LABEL).astype(np.int32)
+    roll = rng.rand(tokens.size)
+    masked = tokens.copy()
+    masked[targets & (roll < 0.8)] = mask_id
+    rand_pos = targets & (roll >= 0.8) & (roll < 0.9)
+    masked[rand_pos] = rng.randint(
+        0, vocab_size, size=int(rand_pos.sum())
+    )
+    return masked, labels
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        ex = decode_example(record)
+        tokens = ex["tokens"].astype(np.int32)
+        if mode == Mode.PREDICTION:
+            return {"tokens": tokens}
+        vocab = int(ex.get("vocab_size", np.array(256)))
+        # static masking seeded by the record's CONTENT: deterministic
+        # per record, independent across records (constant seeds would
+        # replay one mask stream over every task — original BERT's
+        # static masking, done right)
+        rng = np.random.RandomState(
+            zlib.crc32(tokens.tobytes()) & 0x7FFFFFFF
+        )
+        masked, labels = _mask_tokens(tokens, vocab, rng)
+        return {"tokens": masked}, labels
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    def masked_accuracy(labels, predictions):
+        labels = np.asarray(labels)
+        preds = np.argmax(np.asarray(predictions), axis=-1)
+        valid = labels != IGNORE_LABEL
+        per_example = []
+        for row_pred, row_label, row_valid in zip(preds, labels, valid):
+            n = row_valid.sum()
+            if n == 0:
+                continue  # nothing masked: no opinion, don't inflate
+            per_example.append(
+                float((row_pred[row_valid] == row_label[row_valid]).sum())
+                / n
+            )
+        return np.asarray(per_example, np.float32)
+
+    return {"masked_token_accuracy": masked_accuracy}
+
+
+def feature_shapes(seq_len=128):
+    return {"tokens": (seq_len,)}

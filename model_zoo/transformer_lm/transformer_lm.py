@@ -39,11 +39,15 @@ def _tp_dense_init(split_axis):
 
 
 class CausalSelfAttention(nn.Module):
+    """Self-attention block shared by the decoder (causal=True) and the
+    BERT-class encoder (causal=False, model_zoo/bert)."""
+
     num_heads: int
     head_dim: int
     dtype: object = None  # compute dtype (bf16 on TPU); params stay fp32
     attn_impl: str = "auto"  # "auto": Pallas flash on TPU; "xla": blockwise
     tp_shard: bool = True
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -60,11 +64,11 @@ class CausalSelfAttention(nn.Module):
         q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:
-            out = ring_attention(q, k, v, mesh, causal=True)
+            out = ring_attention(q, k, v, mesh, causal=self.causal)
         elif self.attn_impl == "xla":
-            out = blockwise_attention(q, k, v, causal=True)
+            out = blockwise_attention(q, k, v, causal=self.causal)
         else:
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=self.causal)
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
         return nn.Dense(
             e, use_bias=False, dtype=self.dtype, name="proj",
@@ -82,6 +86,7 @@ class Block(nn.Module):
     dtype: object = None
     attn_impl: str = "auto"
     tp_shard: bool = True
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -90,7 +95,7 @@ class Block(nn.Module):
         x = x + CausalSelfAttention(
             self.num_heads, self.head_dim, dtype=self.dtype,
             attn_impl=self.attn_impl, tp_shard=self.tp_shard,
-            name="attn",
+            causal=self.causal, name="attn",
         )(y, training)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
@@ -159,16 +164,22 @@ _DTYPES = {
 }
 
 
-def custom_model(**kwargs):
+def resolve_dtype(kwargs, family):
+    """Shared "dtype": "bf16" -> jnp dtype resolution for the sequence
+    families' custom_model kwargs."""
     dtype = kwargs.get("dtype")
     if isinstance(dtype, str):
         if dtype.lower() not in _DTYPES:
             raise ValueError(
-                "Unknown dtype %r for transformer_lm (valid: %s)"
-                % (dtype, sorted(_DTYPES))
+                "Unknown dtype %r for %s (valid: %s)"
+                % (dtype, family, sorted(_DTYPES))
             )
         kwargs["dtype"] = _DTYPES[dtype.lower()]
-    return TransformerLM(**kwargs)
+    return kwargs
+
+
+def custom_model(**kwargs):
+    return TransformerLM(**resolve_dtype(kwargs, "transformer_lm"))
 
 
 def loss(labels, predictions, sample_weights=None):
