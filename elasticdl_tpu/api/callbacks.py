@@ -71,6 +71,12 @@ class MaxStepsStopping(Callback):
     def set_task_dispatcher(self, dispatcher):
         self._dispatcher = dispatcher
 
+    def set_completed_steps(self, steps):
+        """Seed the counter on resume — the reference master sets this to
+        the checkpoint's model version so max_steps counts TOTAL job
+        steps, not steps-since-restart (master.py:176-192)."""
+        self._completed_steps = int(steps)
+
     def on_task_end(self, task):
         from elasticdl_tpu.master.task_dispatcher import TaskType
 

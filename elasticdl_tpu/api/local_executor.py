@@ -93,7 +93,9 @@ class LocalExecutor(object):
     def _task_dataset(self, reader, task, mode):
         ds = Dataset.from_generator(lambda: reader.read_records(task))
         ds = self.spec.dataset_fn(ds, mode, reader.metadata)
-        return ds.batch(self.minibatch_size)
+        # background-thread prefetch overlaps host parsing with the
+        # device step (the worker does the same — worker.py)
+        return ds.batch(self.minibatch_size).prefetch(1)
 
     def _ensure_state(self, batch):
         if self.state is None:

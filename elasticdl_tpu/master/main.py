@@ -156,6 +156,7 @@ def _run_master(args, status_file=""):
         callbacks_list=callbacks_list,
         export_saved_model=args.export_saved_model,
         tensorboard_service=tensorboard_service,
+        checkpoint_dir_for_init=args.checkpoint_dir_for_init,
     )
     # gRPC port is bound in prepare(); the instance manager needs the
     # final address, so wire it afterwards.

@@ -45,6 +45,7 @@ class Master(object):
         callbacks_list=None,
         export_saved_model=False,
         tensorboard_service=None,
+        checkpoint_dir_for_init=None,
     ):
         from elasticdl_tpu.data.reader.data_reader_factory import (
             create_data_reader,
@@ -75,6 +76,26 @@ class Master(object):
             for cb in callbacks_list.callbacks:
                 if hasattr(cb, "set_task_dispatcher"):
                     cb.set_task_dispatcher(self.task_d)
+        # resume: validate the init checkpoint up front (fail fast at the
+        # master, not minutes later in a worker's restore) and seed
+        # step-counting callbacks with its version so max_steps counts
+        # TOTAL job steps (reference _set_completed_steps_by_checkpoint,
+        # master.py:176-192)
+        if checkpoint_dir_for_init:
+            from elasticdl_tpu.checkpoint import (
+                get_latest_checkpoint_version,
+            )
+
+            version = get_latest_checkpoint_version(checkpoint_dir_for_init)
+            if version < 0:
+                raise ValueError(
+                    "Invalid checkpoint directory %r"
+                    % checkpoint_dir_for_init
+                )
+            if callbacks_list is not None:
+                for cb in callbacks_list.callbacks:
+                    if hasattr(cb, "set_completed_steps"):
+                        cb.set_completed_steps(version)
 
         eval_only = bool(validation_data) and not training_data
         self.tensorboard_service = tensorboard_service

@@ -1,14 +1,25 @@
 """On-chip microbenchmark: XLA-fused optax updates vs the Pallas dense
-optimizer kernels (ops/optimizer_kernels.py).
+optimizer kernels (ops/optimizer_kernels.py), and (BENCH_SPARSE=1) the
+XLA gather->update->scatter row path vs the Pallas sparse row kernels.
 
 Answers VERDICT.md round-1 item #3's "wire them or retire them with
-data" for the *dense* kernels: the reference's C++ Eigen kernels were its
-PS hot loop (go/pkg/kernel/capi/kernel_api.cc:6-96), but on TPU the
-optimizer update is fused by XLA into the compiled train step, so a
-standalone kernel must beat the fused update to earn the Trainer slot.
+data": the reference's C++ Eigen kernels were its PS hot loop
+(go/pkg/kernel/capi/kernel_api.cc:6-96), but on TPU the optimizer update
+is fused by XLA into the compiled train step, so a standalone kernel
+must beat the fused update to earn the Trainer slot.
+
+Methodology (both matter on this rig):
+* the mutable state is a CARRY donated back into the jit on every
+  iteration (donate_argnums=0) — without donation XLA copies the whole
+  buffer per call, and for the sparse case that ~512 MB table copy
+  would swamp the ~4 MB of touched-row work being compared;
+* the clock stops on a host FETCH of a carry-dependent scalar:
+  block_until_ready can return early over the tunneled PJRT device
+  (reads >10 TB/s effective HBM on small ops).
 
 Run on hardware:  python scripts/bench_optimizer_kernels.py
-Prints one JSON line per (optimizer, size) with both step times.
+                  BENCH_SPARSE=1 python scripts/bench_optimizer_kernels.py
+Prints one JSON line per (path, size).
 """
 
 import json
@@ -23,78 +34,81 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from elasticdl_tpu.ops import embedding_ops as eo
 from elasticdl_tpu.ops import optimizer_kernels as ok
+from elasticdl_tpu.ops import update_math as um
 
 
-def timed(fn, p, *rest, iters=30, warmup=5):
-    """Chain iterations through the updated param and stop the clock on a
-    host fetch: over a tunneled PJRT device, block_until_ready can return
-    before execution finishes, so ready-based timing of small ops reads
-    absurdly fast (>10 TB/s effective HBM). A fetch of a dependent scalar
-    is the only sync this rig honors."""
+def _fetch(carry):
+    leaf = jax.tree.leaves(carry)[0]
+    return float(np.asarray(jax.device_get(leaf.reshape(-1)[0])))
 
-    def fetch(out):
-        arr = out[0] if isinstance(out, tuple) else out
-        return float(np.asarray(jax.device_get(arr[0])))
 
-    x = p
+def timed_carry(step, carry, iters=30, warmup=5):
+    """step(carry) -> carry, jitted with the carry donated. Timing
+    continues from the warmed carry (the pre-warmup buffers are consumed
+    by donation)."""
+    fn = jax.jit(step, donate_argnums=(0,))
     for _ in range(warmup):
-        out = fn(x, *rest)
-        x = out[0] if isinstance(out, tuple) else out
-    fetch(out)
+        carry = fn(carry)
+    _fetch(carry)
     t0 = time.perf_counter()
-    x = p
     for _ in range(iters):
-        out = fn(x, *rest)
-        x = out[0] if isinstance(out, tuple) else out
-    fetch(out)
+        carry = fn(carry)
+    _fetch(carry)
     return (time.perf_counter() - t0) / iters
 
 
 def main():
     n = int(os.environ.get("N_PARAMS", str(64 * 1024 * 1024)))  # 64M f32
     rng = np.random.default_rng(0)
-    p = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    # host originals: each timed run donates (consumes) its device
+    # buffers, so every path gets a fresh device copy
+    p_host = rng.standard_normal(n).astype(np.float32)
     g = jnp.asarray(rng.standard_normal(n), jnp.float32)
-    m = jnp.zeros_like(p)
-    v = jnp.zeros_like(p)
+
+    def fresh_p():
+        return jnp.asarray(p_host)
 
     results = []
 
     # --- SGD ---
     opt = optax.sgd(0.1)
-    opt_state = opt.init(p)
 
-    @jax.jit
-    def optax_sgd(p, g, s):
+    def optax_sgd(carry):
+        p, s = carry
         u, s = opt.update(g, s, p)
         return optax.apply_updates(p, u), s
 
-    @jax.jit
-    def pallas_sgd(p, g):
-        return ok.sgd_update(p, g, 0.1)
+    def pallas_sgd(carry):
+        (p,) = carry
+        return (ok.sgd_update(p, g, 0.1),)
 
-    t_optax = timed(optax_sgd, p, g, opt_state)
-    t_pallas = timed(pallas_sgd, p, g)
+    p0 = fresh_p()
+    t_optax = timed_carry(optax_sgd, (p0, opt.init(p0)))
+    t_pallas = timed_carry(pallas_sgd, (fresh_p(),))
     results.append(dict(optimizer="sgd", n=n,
                         optax_ms=round(t_optax * 1e3, 3),
                         pallas_ms=round(t_pallas * 1e3, 3)))
 
     # --- Adam ---
     aopt = optax.adam(1e-3)
-    astate = aopt.init(p)
 
-    @jax.jit
-    def optax_adam(p, g, s):
+    def optax_adam(carry):
+        p, s = carry
         u, s = aopt.update(g, s, p)
         return optax.apply_updates(p, u), s
 
-    @jax.jit
-    def pallas_adam(p, m, v, g):
+    def pallas_adam(carry):
+        p, m, v = carry
         return ok.adam_update(p, m, v, g, step=1, lr=1e-3)
 
-    t_optax = timed(optax_adam, p, g, astate)
-    t_pallas = timed(pallas_adam, p, m, v, g)
+    p0 = fresh_p()
+    t_optax = timed_carry(optax_adam, (p0, aopt.init(p0)))
+    p1 = fresh_p()
+    t_pallas = timed_carry(
+        pallas_adam, (p1, jnp.zeros_like(p1), jnp.zeros_like(p1))
+    )
     results.append(dict(optimizer="adam", n=n,
                         optax_ms=round(t_optax * 1e3, 3),
                         pallas_ms=round(t_pallas * 1e3, 3)))
@@ -109,5 +123,46 @@ def main():
         print(json.dumps(r))
 
 
+def sparse_main():
+    """Sparse row update: Pallas row kernels vs the XLA gather->update->
+    scatter path the Trainer uses (embedding/sparse_update
+    .row_sparse_apply). The table is the donated carry, so neither path
+    pays a full-table copy — exactly the Trainer's situation (donated
+    TrainState)."""
+    vocab = int(os.environ.get("SPARSE_VOCAB", str(2_000_000)))
+    dim = int(os.environ.get("SPARSE_DIM", "64"))
+    n_ids = int(os.environ.get("SPARSE_IDS", "8192"))
+    rng = np.random.default_rng(0)
+    table_host = rng.standard_normal((vocab, dim)).astype(np.float32)
+    ids = jnp.asarray(
+        np.unique(rng.integers(0, vocab, size=n_ids)), jnp.int32
+    )
+    grads = jnp.asarray(
+        rng.standard_normal((ids.shape[0], dim)), jnp.float32
+    )
+
+    def xla_sparse_sgd(carry):
+        (table,) = carry
+        rows = jnp.take(table, ids, axis=0)
+        return (table.at[ids].set(um.sgd_math(rows, grads, 0.1)),)
+
+    def pallas_sparse_sgd(carry):
+        (table,) = carry
+        return (eo.sparse_sgd_update(table, ids, grads, 0.1),)
+
+    for name, step in (("xla", xla_sparse_sgd),
+                       ("pallas", pallas_sparse_sgd)):
+        t = timed_carry(step, (jnp.asarray(table_host),), iters=20)
+        gb = 2 * ids.shape[0] * dim * 4 / 1e9  # touched rows r/w
+        print(json.dumps(dict(
+            path=name, vocab=vocab, dim=dim, n_rows=int(ids.shape[0]),
+            ms=round(t * 1e3, 3), touched_gbps=round(gb / t, 2),
+            platform=jax.default_backend(),
+        )))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_SPARSE") == "1":
+        sparse_main()
+    else:
+        main()

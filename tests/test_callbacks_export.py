@@ -70,6 +70,44 @@ class TestMaxStepsStopping:
         cb.on_task_end(Task("f", 0, 100, TaskType.EVALUATION))
         assert not dispatcher.stop_training
 
+    def test_resume_seeds_completed_steps(self, tmp_path):
+        """The master seeds MaxStepsStopping with the checkpoint version
+        on resume, so max_steps counts TOTAL job steps (reference
+        _set_completed_steps_by_checkpoint, master.py:176-192)."""
+        from elasticdl_tpu.api.callbacks import CallbackList
+        from elasticdl_tpu.master.master import Master
+
+        # a valid version-7 checkpoint dir (content irrelevant here)
+        vdir = tmp_path / "ckpt" / "version-7"
+        vdir.mkdir(parents=True)
+        (vdir / "variables-0-of-1.ckpt").write_bytes(b"")
+
+        from model_zoo.mnist_functional_api import (
+            mnist_functional_api as zoo,
+        )
+
+        cb = MaxStepsStopping(max_steps=8, minibatch_size=100)
+        master = Master(
+            load_model_spec_from_module(zoo),
+            training_data=None,
+            create_data_reader_fn=lambda *a, **k: None,
+            callbacks_list=CallbackList([cb]),
+            checkpoint_dir_for_init=str(tmp_path / "ckpt"),
+        )
+        assert cb._completed_steps == 7
+        # one more 100-record task crosses max_steps=8
+        cb.on_task_end(Task("f", 0, 100, TaskType.TRAINING))
+        assert master.task_d.stop_training
+
+        with pytest.raises(ValueError, match="Invalid checkpoint"):
+            Master(
+                load_model_spec_from_module(zoo),
+                training_data=None,
+                create_data_reader_fn=lambda *a, **k: None,
+                callbacks_list=CallbackList([MaxStepsStopping(1)]),
+                checkpoint_dir_for_init=str(tmp_path / "nope"),
+            )
+
 
 class TestLearningRateScheduler:
     def test_schedule_compiled_into_step(self, spec, batch):
