@@ -1,0 +1,124 @@
+"""Mixture-of-experts over the ``ep`` mesh axis (expert parallelism).
+
+Net-new beyond the reference (which has no expert axis — SURVEY.md §2.5;
+``ep`` existed for embedding-row sharding only). The design is the
+GShard/Switch static-shape formulation, which is what XLA wants:
+
+* top-1 routing with a CAPACITY per expert (ceil(tokens/E) *
+  capacity_factor): every tensor keeps a static shape; tokens over
+  capacity are dropped from the expert path (their combine weight is 0,
+  so they pass through the residual only);
+* dispatch and combine are one-hot einsums — no gather/scatter with
+  dynamic shapes;
+* expert weights are stacked [E, ...] and annotated over ``ep``
+  (nn.with_partitioning); GSPMD inserts the all-to-alls when the einsums
+  cross the token (dp-sharded) and expert (ep-sharded) dims;
+* the load-balancing auxiliary loss is the standard fraction*prob dot
+  (Switch Transformer eq. 4), returned to the caller to add to the task
+  loss.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_dispatch(router_logits, capacity):
+    """Static-shape top-1 routing.
+
+    router_logits: [T, E]; capacity: int C.
+    Returns (dispatch [T, E, C] 0/1, combine [T, E, C] float, aux_loss
+    scalar, stats dict). combine = dispatch * router prob of the chosen
+    expert; tokens beyond an expert's capacity have all-zero rows.
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=probs.dtype)  # [T, E]
+
+    # position of each token within its expert's queue (arrival order)
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T, E]
+    within = (position >= 0) & (position < capacity)
+    kept = onehot * within.astype(probs.dtype)
+
+    pos_onehot = jax.nn.one_hot(
+        jnp.clip(position, 0, capacity - 1).astype(jnp.int32),
+        capacity,
+        dtype=probs.dtype,
+    )  # [T, E, C]
+    dispatch = kept[..., None] * pos_onehot
+    gate = jnp.sum(probs * kept, axis=-1)  # chosen prob, 0 if dropped
+    combine = dispatch * gate[:, None, None]
+
+    # Switch aux loss: E * sum_e fraction_e * mean-prob_e
+    fraction = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(fraction * mean_prob)
+    stats = {
+        "dropped_fraction": 1.0 - jnp.sum(kept) / t,
+        "expert_fraction": fraction,
+    }
+    return dispatch, combine, aux_loss, stats
+
+
+def expert_capacity(num_tokens, num_experts, capacity_factor):
+    return max(1, int(num_tokens * capacity_factor / num_experts + 0.5))
+
+
+def moe_mlp_apply(params, x, capacity_factor=1.25, activation=jax.nn.gelu):
+    """Functional MoE MLP: x [T, D] through E expert FFNs.
+
+    params: {"router": [D, E], "w_up": [E, D, H], "b_up": [E, H],
+             "w_down": [E, H, D], "b_down": [E, D]} — stacked expert
+    leaves sharded over ep by the caller's annotations.
+    Returns (y [T, D], aux_loss, stats).
+    """
+    t = x.shape[0]
+    e = params["router"].shape[-1]
+    capacity = expert_capacity(t, e, capacity_factor)
+    logits = x @ params["router"]
+    dispatch, combine, aux_loss, stats = top1_dispatch(logits, capacity)
+    # [T,E,C] x [T,D] -> [E,C,D]: the all-to-all boundary under GSPMD
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    h = activation(
+        jnp.einsum("ecd,edh->ech", expert_in, params["w_up"])
+        + params["b_up"][:, None, :]
+    )
+    expert_out = (
+        jnp.einsum("ech,ehd->ecd", h, params["w_down"])
+        + params["b_down"][:, None, :]
+    )
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y, aux_loss, stats
+
+
+def moe_reference(params, x, capacity_factor=1.25,
+                  activation=jax.nn.gelu):
+    """Oracle: loop over tokens/experts in plain numpy-style code (tests
+    compare the einsum formulation against this)."""
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    router = np.asarray(params["router"], np.float32)
+    t, _ = x.shape
+    e = router.shape[-1]
+    capacity = expert_capacity(t, e, capacity_factor)
+    logits = x @ router
+    exps = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = exps / exps.sum(-1, keepdims=True)
+    chosen = probs.argmax(-1)
+    counts = {i: 0 for i in range(e)}
+    y = np.zeros_like(x)
+    for ti in range(t):
+        ei = int(chosen[ti])
+        if counts[ei] >= capacity:
+            continue
+        counts[ei] += 1
+        h = np.asarray(activation(
+            jnp.asarray(x[ti] @ np.asarray(params["w_up"][ei])
+                        + np.asarray(params["b_up"][ei]))
+        ))
+        out = h @ np.asarray(params["w_down"][ei]) + np.asarray(
+            params["b_down"][ei]
+        )
+        y[ti] = probs[ti, ei] * out
+    return y

@@ -1,0 +1,197 @@
+"""Mixture-of-experts causal transformer LM: every block's MLP is a
+top-1-routed expert bank sharded over the ``ep`` mesh axis
+(parallel/moe.py) — the family that makes ``ep`` a true expert axis.
+
+Attention reuses transformer_lm's CausalSelfAttention (flash/ring/TP
+annotations in one place). Training-mode outputs are a dict
+{"logits", "aux_loss"}: loss() adds the Switch load-balancing aux term;
+inference returns bare logits (eval metrics see one array).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import MeshAxis, Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.parallel.moe import moe_mlp_apply
+from model_zoo.transformer_lm.transformer_lm import (
+    CausalSelfAttention,
+    resolve_dtype,
+)
+
+AUX_LOSS_WEIGHT = 0.01
+
+
+def _expert_init(name, shape):
+    if name.startswith("b_"):
+        return nn.initializers.zeros
+    base = nn.initializers.lecun_normal()
+
+    def init(key, full_shape, dtype=jnp.float32):
+        import jax
+
+        keys = jax.random.split(key, full_shape[0])
+        return jnp.stack([base(k, full_shape[1:], dtype) for k in keys])
+
+    return init
+
+
+class MoEBlock(nn.Module):
+    num_heads: int
+    head_dim: int
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: object = None
+    attn_impl: str = "auto"
+    tp_shard: bool = True
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        b, l, e = x.shape
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.head_dim, dtype=self.dtype,
+            attn_impl=self.attn_impl, tp_shard=self.tp_shard, name="attn",
+        )(y, training)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+
+        h = self.mlp_ratio * e
+        n_exp = self.num_experts
+        params = {
+            "router": self.param(
+                "router", nn.initializers.lecun_normal(), (e, n_exp)
+            ),
+            "w_up": self.param(
+                "w_up",
+                nn.with_partitioning(
+                    _expert_init("w_up", (e, h)),
+                    (MeshAxis.EP, None, None),
+                ),
+                (n_exp, e, h),
+            ),
+            "b_up": self.param(
+                "b_up",
+                nn.with_partitioning(
+                    _expert_init("b_up", (h,)), (MeshAxis.EP, None)
+                ),
+                (n_exp, h),
+            ),
+            "w_down": self.param(
+                "w_down",
+                nn.with_partitioning(
+                    _expert_init("w_down", (h, e)),
+                    (MeshAxis.EP, None, None),
+                ),
+                (n_exp, h, e),
+            ),
+            "b_down": self.param(
+                "b_down",
+                nn.with_partitioning(
+                    _expert_init("b_down", (e,)), (MeshAxis.EP, None)
+                ),
+                (n_exp, e),
+            ),
+        }
+        flat = y.reshape(b * l, e)
+        out, aux_loss, _ = moe_mlp_apply(
+            params, flat, capacity_factor=self.capacity_factor
+        )
+        return x + out.reshape(b, l, e), aux_loss
+
+
+class TransformerMoE(nn.Module):
+    vocab_size: int = 256
+    seq_len: int = 128
+    embed_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    num_experts: int = 4
+    capacity_factor: float = 1.25
+    dtype: object = None
+    attn_impl: str = "auto"
+    tp_shard: bool = True
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        tokens = features["tokens"]
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype, name="wte"
+        )(tokens)
+        pos = nn.Embed(
+            self.seq_len, self.embed_dim, dtype=self.dtype, name="wpe"
+        )(jnp.arange(tokens.shape[1])[None, :])
+        x = x + pos
+        head_dim = self.embed_dim // self.num_heads
+        aux_total = 0.0
+        for i in range(self.num_layers):
+            x, aux = MoEBlock(
+                self.num_heads, head_dim, num_experts=self.num_experts,
+                capacity_factor=self.capacity_factor, dtype=self.dtype,
+                attn_impl=self.attn_impl, tp_shard=self.tp_shard,
+                name="block_%d" % i,
+            )(x, training)
+            aux_total = aux_total + aux
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=self.dtype, name="head"
+        )(x).astype(jnp.float32)
+        if not training:
+            return logits
+        return {
+            "logits": logits,
+            "aux_loss": jnp.asarray(aux_total, jnp.float32),
+        }
+
+
+def custom_model(**kwargs):
+    return TransformerMoE(**resolve_dtype(kwargs, "transformer_moe"))
+
+
+def loss(labels, predictions, sample_weights=None):
+    logits = predictions["logits"]
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels
+    ).mean(axis=-1)
+    if sample_weights is None:
+        task_loss = jnp.mean(ce)
+    else:
+        task_loss = jnp.sum(ce * sample_weights) / jnp.maximum(
+            jnp.sum(sample_weights), 1.0
+        )
+    return task_loss + AUX_LOSS_WEIGHT * predictions["aux_loss"]
+
+
+def optimizer(lr=3e-4):
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def _parse(record):
+        ex = decode_example(record)
+        tokens = ex["tokens"].astype(np.int32)
+        features = {"tokens": tokens[:-1]}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, tokens[1:]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "token_accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=-1)
+            == np.asarray(labels)
+        ).astype(np.float32).reshape(len(labels), -1).mean(axis=1)
+    }
+
+
+def feature_shapes(seq_len=128):
+    return {"tokens": (seq_len,)}
