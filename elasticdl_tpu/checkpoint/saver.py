@@ -106,7 +106,7 @@ class CheckpointSaver(object):
 
     def __init__(self, checkpoint_dir, checkpoint_steps=0,
                  keep_max_version=0, num_shards=None,
-                 extra_state_fn=None):
+                 extra_state_fn=None, async_save=False):
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_steps = int(checkpoint_steps)
         self.keep_max_version = int(keep_max_version)
@@ -114,6 +114,21 @@ class CheckpointSaver(object):
         # host-spill embedding engines ride the same sharded checkpoint
         # (embedding/host_bridge.HostEmbeddingManager.flat_state).
         self.extra_state_fn = extra_state_fn
+        # async_save: device->host materialization stays synchronous
+        # (correct snapshot of donated buffers), but serialization + IO
+        # + pruning move to a background thread so the train loop only
+        # pays the copy, not the disk. Single-process only: multi-host
+        # saves are collective (process_allgather) and must stay on the
+        # calling thread.
+        self.async_save = bool(async_save) and jax.process_count() == 1
+        self._write_thread = None
+        self._write_error = None
+        if self.async_save:
+            import atexit
+
+            # drain an in-flight write on clean interpreter exit so the
+            # final checkpoint is never lost to the daemon thread dying
+            atexit.register(self.wait)
         self.num_shards = int(
             num_shards if num_shards is not None else jax.process_count()
         )
@@ -139,11 +154,59 @@ class CheckpointSaver(object):
         return True
 
     def save(self, state, version):
-        """Write version-<V> atomically (temp dir + rename), then prune."""
+        """Write version-<V> atomically (temp dir + rename), then prune.
+
+        With async_save, returns after materializing the snapshot; the
+        write happens in a background thread (at most one in flight —
+        a new save joins the previous one first)."""
         version = int(version)
         flat = flatten_state(state)
         if self.extra_state_fn is not None:
             flat.update(self.extra_state_fn())
+        if self.async_save:
+            import threading
+
+            self.wait()  # at most one in-flight write; re-raises failures
+            self._write_thread = threading.Thread(
+                target=self._write_guarded,
+                args=(flat, version),
+                daemon=True,
+                name="ckpt-write-v%d" % version,
+            )
+            # eager: maybe_save must not double-fire this version while
+            # the write is in flight (a FAILED write resets this so the
+            # next cadence retries)
+            self._last_saved_version = version
+            self._write_thread.start()
+            return self._version_dir(version)
+        out = self._write_and_log(flat, version)
+        self._last_saved_version = version
+        return out
+
+    def wait(self):
+        """Block until any in-flight async write completes, re-raising
+        its failure (call before reading the checkpoint back; also
+        registered atexit so clean shutdown drains the write)."""
+        if self._write_thread is not None:
+            self._write_thread.join()
+            self._write_thread = None
+        if self._write_error is not None:
+            err, self._write_error = self._write_error, None
+            raise err
+
+    def _write_guarded(self, flat, version):
+        try:
+            self._write_and_log(flat, version)
+        except BaseException as e:  # noqa: BLE001 - re-raised in wait()
+            self._write_error = e
+            # the version was NOT durably written: let maybe_save retry
+            self._last_saved_version = -1
+            logger.error(
+                "async checkpoint write of version-%d failed: %s",
+                version, e,
+            )
+
+    def _write_and_log(self, flat, version):
         final_dir = self._version_dir(version)
         os.makedirs(self.checkpoint_dir, exist_ok=True)
 
@@ -197,7 +260,6 @@ class CheckpointSaver(object):
         finally:
             if tmp_dir is not None and os.path.isdir(tmp_dir):
                 shutil.rmtree(tmp_dir, ignore_errors=True)
-        self._last_saved_version = version
         logger.info(
             "Saved checkpoint version-%d (%d shards) to %s",
             version, self.num_shards, self.checkpoint_dir,

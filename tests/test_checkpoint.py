@@ -174,3 +174,76 @@ def test_local_executor_checkpoint_and_resume(tmp_path):
     state2, _ = ex2.run()
     # resumed from step 4, trained one more epoch of 4 steps
     assert int(state2.step) == 8
+
+
+def test_async_save_roundtrip(tmp_path, trainer_and_state):
+    """async_save: save() returns after materializing; wait() makes the
+    artifact durable and byte-equivalent to a sync save; a snapshot taken
+    before further training is immune to donated-buffer reuse."""
+    trainer, state, batch = trainer_and_state
+    saver = CheckpointSaver(
+        str(tmp_path / "async"), checkpoint_steps=1, num_shards=2,
+        async_save=True,
+    )
+    want = _flat_np(state)
+    saver.save(state, version=1)
+    # train ON while the write is (possibly) still in flight: the step
+    # donates the old buffers — the snapshot must not be affected
+    state2, _ = trainer.train_step(state, batch)
+    saver.wait()
+    assert get_latest_checkpoint_version(str(tmp_path / "async")) == 1
+
+    restored, version = restore_state_from_checkpoint(
+        state2, str(tmp_path / "async")
+    )
+    assert version == 1
+    got = _flat_np(restored)
+    for key, arr in want.items():
+        np.testing.assert_array_equal(got[key], arr)
+
+
+def test_async_save_serializes_inflight_writes(tmp_path, trainer_and_state):
+    trainer, state, batch = trainer_and_state
+    saver = CheckpointSaver(
+        str(tmp_path / "seq"), checkpoint_steps=1, keep_max_version=1,
+        async_save=True,
+    )
+    saver.save(state, version=1)
+    state, _ = trainer.train_step(state, batch)
+    saver.save(state, version=2)  # joins v1's write first
+    saver.wait()
+    import os as _os
+
+    kept = sorted(
+        d for d in _os.listdir(str(tmp_path / "seq"))
+        if d.startswith("version-")
+    )
+    assert kept == ["version-2"]  # pruning still applies in order
+
+
+def test_async_save_failure_surfaces_and_retries(tmp_path,
+                                                 trainer_and_state):
+    """A failed background write re-raises in wait() and resets the
+    saved-version marker so the next cadence retries."""
+    _, state, _ = trainer_and_state
+    saver = CheckpointSaver(
+        str(tmp_path / "fail"), checkpoint_steps=1, async_save=True
+    )
+
+    real_write = saver._write_and_log
+    calls = {"n": 0}
+
+    def flaky(flat, version):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("disk full")
+        return real_write(flat, version)
+
+    saver._write_and_log = flaky
+    saver.save(state, version=1)
+    with pytest.raises(OSError, match="disk full"):
+        saver.wait()
+    # the failed version is NOT marked saved: maybe_save retries it
+    assert saver.maybe_save(state, version=1)
+    saver.wait()
+    assert get_latest_checkpoint_version(str(tmp_path / "fail")) == 1

@@ -91,7 +91,7 @@ def test_mesh_reformation_after_host_loss(tmp_path):
         # the master. Give it a moment, then treat the whole phase-1 job
         # as dead (what the instance manager concludes from pod events).
         try:
-            procs[0].communicate(timeout=30)
+            procs[0].communicate(timeout=60)
         except subprocess.TimeoutExpired:
             procs[0].kill()
             procs[0].communicate()
