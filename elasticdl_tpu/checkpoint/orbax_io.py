@@ -1,0 +1,82 @@
+"""Orbax interop: read/write TrainState checkpoints in the JAX
+ecosystem's standard format.
+
+The framework's own sharded format (saver.py — the reference's
+`variables-i-of-M.ckpt` semantics, re-shardable by construction) remains
+the primary; this adapter lets users exchange checkpoints with the rest
+of the JAX world (orbax is what flax/t5x/maxtext standardize on):
+
+    save_with_orbax(state, path)                 # one orbax step dir
+    state = restore_with_orbax(template, path)   # re-sharded onto the
+                                                 # template's mesh
+    import_orbax_to_native(template, orbax_path, saver, version)
+
+Restores go through the same `restore_state_from_flat` machinery as the
+native format, so a checkpoint written on one mesh restores onto any
+other (device_put against the template's shardings).
+"""
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.checkpoint.saver import (
+    flatten_state,
+    restore_state_from_flat,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+def _checkpointer():
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:
+        raise RuntimeError(
+            "The orbax-checkpoint package is not installed; pip install "
+            "elasticdl-tpu[orbax] (or orbax-checkpoint) for orbax interop"
+        ) from e
+    return ocp.PyTreeCheckpointer()
+
+
+def save_with_orbax(state, path):
+    """Write `state` as an orbax PyTree checkpoint at `path` (a directory
+    that must not already exist — orbax owns its layout). The tree is
+    flattened to {keystr: ndarray} first (flatten_state materializes
+    host-side), so device shardings never leak into the artifact."""
+    flat = flatten_state(state)  # materializes every leaf host-side
+    _checkpointer().save(path, flat)
+    logger.info("Saved orbax checkpoint to %s (%d leaves)",
+                path, len(flat))
+    return path
+
+
+def restore_with_orbax(template_state, path):
+    """Rebuild a TrainState-shaped pytree from an orbax checkpoint,
+    re-sharded to `template_state`'s own shardings."""
+    flat = _checkpointer().restore(path)
+    flat = {key: np.asarray(value) for key, value in flat.items()}
+    return restore_state_from_flat(template_state, flat)
+
+
+def export_native_to_orbax(checkpoint_dir, orbax_path, version=None):
+    """Convert a native sharded checkpoint (saver.py layout) into an
+    orbax one without needing the model: the flat {keystr: ndarray} map
+    is the common currency. Returns (orbax_path, version)."""
+    from elasticdl_tpu.checkpoint.saver import load_checkpoint
+
+    flat, version = load_checkpoint(checkpoint_dir, version)
+    _checkpointer().save(orbax_path, flat)
+    logger.info(
+        "Exported native checkpoint version-%d to orbax at %s",
+        version, orbax_path,
+    )
+    return orbax_path, version
+
+
+def import_orbax_to_native(template_state, orbax_path, saver, version):
+    """Bring an orbax checkpoint into the native format: restore onto the
+    template's mesh, then write through the given CheckpointSaver."""
+    state = restore_with_orbax(template_state, orbax_path)
+    saver.save(state, version)
+    if getattr(saver, "async_save", False):
+        saver.wait()
+    return state

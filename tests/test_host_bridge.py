@@ -259,6 +259,26 @@ def test_lr_scale_reaches_engine():
     )
 
 
+def test_worker_rejects_host_model_in_spmd_mode():
+    """Host tables are per-process; SPMD lockstep must fail fast at
+    construction, not KeyError mid-training (worker.py guard)."""
+    from model_zoo.deepfm_host_embedding import deepfm_host_embedding as zoo
+
+    from elasticdl_tpu.common.model_utils import load_model_spec_from_module
+    from elasticdl_tpu.worker.worker import Worker
+
+    class _FakeMaster(object):
+        pass
+
+    with pytest.raises(ValueError, match="SPMD"):
+        Worker(
+            0,
+            load_model_spec_from_module(zoo),
+            master_servicer=_FakeMaster(),
+            spmd=True,
+        )
+
+
 def test_apply_before_prepare_raises():
     manager = HostEmbeddingManager()
     manager.register(
