@@ -12,6 +12,7 @@ and in interpreter mode elsewhere (tests exercise both).
 """
 
 from elasticdl_tpu.ops.dispatch import use_pallas  # noqa: F401
+from elasticdl_tpu.ops.losses import chunked_softmax_xent  # noqa: F401
 from elasticdl_tpu.ops.embedding_ops import (  # noqa: F401
     dedup_indexed_slices,
     embedding_gather,

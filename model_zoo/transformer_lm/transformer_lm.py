@@ -20,6 +20,7 @@ from flax import linen as nn
 from elasticdl_tpu.common.constants import MeshAxis, Mode
 from elasticdl_tpu.data.example_codec import decode_example
 from elasticdl_tpu.ops.attention import blockwise_attention, flash_attention
+from elasticdl_tpu.ops.losses import chunked_softmax_xent
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.parallel.context_parallel import ring_attention
 
@@ -117,6 +118,32 @@ class Block(nn.Module):
         return x + y
 
 
+class LMHead(nn.Module):
+    """Vocab projection. In fused mode it returns the hidden states and
+    the kernel instead of running the matmul, so the loss can stream the
+    head over sequence chunks (ops/losses.chunked_softmax_xent) and never
+    materialize the full [b, s, vocab] fp32 logits — peak residency is
+    O(b * s/num_chunks * vocab). The param path stays `head/kernel`,
+    checkpoint-compatible with the plain Dense."""
+
+    vocab_size: int
+    dtype: object = None
+    kernel_init: object = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x, fused=False):
+        kernel = self.param(
+            "kernel", self.kernel_init,
+            (x.shape[-1], self.vocab_size), jnp.float32,
+        )
+        if fused:
+            return x, kernel
+        logits = x @ jnp.asarray(kernel, self.dtype or x.dtype)
+        # loss math (softmax xent) wants fp32 logits regardless of the
+        # compute dtype
+        return logits.astype(jnp.float32)
+
+
 class TransformerLM(nn.Module):
     vocab_size: int = 256
     seq_len: int = 128
@@ -126,6 +153,7 @@ class TransformerLM(nn.Module):
     dtype: object = None  # compute dtype; None = fp32
     attn_impl: str = "auto"
     tp_shard: bool = True  # annotate kernels over the tp mesh axis
+    fused_head: bool = False  # stream the LM head inside the loss
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -145,16 +173,17 @@ class TransformerLM(nn.Module):
                 name="block_%d" % i,
             )(x, training)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        logits = nn.Dense(
-            self.vocab_size, use_bias=False, dtype=self.dtype, name="head",
+        head = LMHead(
+            self.vocab_size, dtype=self.dtype, name="head",
             kernel_init=(
                 _tp_dense_init(1) if self.tp_shard
                 else nn.initializers.lecun_normal()
             ),
-        )(x)
-        # loss math (softmax xent) wants fp32 logits regardless of the
-        # compute dtype
-        return logits.astype(jnp.float32)
+        )
+        if self.fused_head and training:
+            hidden, kernel = head(x, fused=True)
+            return {"lm_hidden": hidden, "lm_head_kernel": kernel}
+        return head(x)
 
 
 _DTYPES = {
@@ -183,10 +212,17 @@ def custom_model(**kwargs):
 
 
 def loss(labels, predictions, sample_weights=None):
-    # labels [b, l] int, predictions [b, l, vocab]
-    ce = optax.softmax_cross_entropy_with_integer_labels(
-        predictions, labels
-    ).mean(axis=-1)
+    # labels [b, l] int; predictions [b, l, vocab] logits, or the fused
+    # {lm_hidden, lm_head_kernel} dict when fused_head is on (the head
+    # matmul then streams inside the loss — ops/losses.py)
+    if isinstance(predictions, dict) and "lm_hidden" in predictions:
+        ce = chunked_softmax_xent(
+            predictions["lm_hidden"], predictions["lm_head_kernel"], labels
+        ).mean(axis=-1)
+    else:
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            predictions, labels
+        ).mean(axis=-1)
     if sample_weights is None:
         return jnp.mean(ce)
     return jnp.sum(ce * sample_weights) / jnp.maximum(

@@ -59,6 +59,94 @@ def test_training_reduces_loss_on_ring_mesh():
     assert float(loss) < first, (first, float(loss))
 
 
+def test_chunked_xent_matches_direct():
+    import jax.numpy as jnp
+    import optax
+
+    from elasticdl_tpu.ops.losses import chunked_softmax_xent
+
+    rs = np.random.RandomState(3)
+    b, s, d, v = 4, 32, 16, 64
+    hidden = jnp.asarray(rs.randn(b, s, d).astype(np.float32))
+    kernel = jnp.asarray(rs.randn(d, v).astype(np.float32) * 0.1)
+    labels = jnp.asarray(rs.randint(0, v, size=(b, s)).astype(np.int32))
+
+    def direct(h, k):
+        logits = (h @ k).astype(np.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    def chunked(h, k):
+        return chunked_softmax_xent(h, k, labels, num_chunks=4).mean()
+
+    np.testing.assert_allclose(
+        float(chunked(hidden, kernel)), float(direct(hidden, kernel)),
+        rtol=1e-6,
+    )
+    gh_c, gk_c = jax.grad(chunked, argnums=(0, 1))(hidden, kernel)
+    gh_d, gk_d = jax.grad(direct, argnums=(0, 1))(hidden, kernel)
+    np.testing.assert_allclose(
+        np.asarray(gh_c), np.asarray(gh_d), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(gk_c), np.asarray(gk_d), rtol=1e-5, atol=1e-6
+    )
+    # non-divisible chunk request degrades to the largest divisor (4)
+    ce = chunked_softmax_xent(hidden, kernel, labels, num_chunks=5)
+    assert ce.shape == (b, s)
+    np.testing.assert_allclose(
+        float(ce.mean()), float(direct(hidden, kernel)), rtol=1e-6
+    )
+    # prime length: zero-padded to the chunk multiple, tail dropped
+    ce1 = chunked_softmax_xent(
+        hidden[:, :31], kernel, labels[:, :31], num_chunks=8
+    )
+    assert ce1.shape == (b, 31)
+    logits31 = hidden[:, :31] @ kernel
+    ref31 = optax.softmax_cross_entropy_with_integer_labels(
+        logits31, labels[:, :31]
+    )
+    np.testing.assert_allclose(
+        np.asarray(ce1), np.asarray(ref31), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_fused_head_trains_identically():
+    """fused_head streams the LM head through the loss; the training
+    trajectory must match the plain-logits path bit-for-bit in fp32
+    (same params pytree — head/kernel path is checkpoint-compatible)."""
+    spec = load_model_spec_from_module(zoo)
+    batch = _batch(seed=4)
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+
+    t_plain = Trainer(spec, mesh=mesh, model_params=PARAMS)
+    t_fused = Trainer(
+        spec, mesh=mesh, model_params=PARAMS + "; fused_head=True"
+    )
+    s_plain = t_plain.init_state(batch)
+    s_fused = t_fused.init_state(batch)
+    assert (
+        jax.tree.structure(s_plain.params)
+        == jax.tree.structure(s_fused.params)
+    )
+    for _ in range(3):
+        s_plain, loss_plain = t_plain.train_step(s_plain, batch)
+        s_fused, loss_fused = t_fused.train_step(s_fused, batch)
+    np.testing.assert_allclose(
+        float(loss_plain), float(loss_fused), rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree.leaves(s_plain.params), jax.tree.leaves(s_fused.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+    # eval path still returns logits under fused_head
+    outputs, labels = t_fused.evaluate_batch(s_fused, batch)
+    assert outputs.shape == (8, 16, 32)
+
+
 def test_eval_metrics():
     spec = load_model_spec_from_module(zoo)
     mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
