@@ -38,6 +38,7 @@ class LocalExecutor(object):
         checkpoint_steps=0,
         keep_checkpoint_max=0,
         checkpoint_dir_for_init=None,
+        grad_accum_steps=1,
     ):
         self.spec = model_spec
         self.minibatch_size = minibatch_size
@@ -50,7 +51,8 @@ class LocalExecutor(object):
         self.validation_data = validation_data
         self.prediction_data = prediction_data
         self.trainer = Trainer(
-            model_spec, mesh=mesh, model_params=model_params, seed=seed
+            model_spec, mesh=mesh, model_params=model_params, seed=seed,
+            grad_accum_steps=grad_accum_steps,
         )
         from elasticdl_tpu.embedding.host_bridge import attach_from_spec
 

@@ -72,6 +72,13 @@ def add_common_params(parser):
         help="Model constructor kwargs, 'k1=v1; k2=v2'",
     )
     parser.add_argument("--minibatch_size", type=pos_int, default=32)
+    parser.add_argument(
+        "--grad_accum_steps", "--get_model_steps", dest="grad_accum_steps",
+        type=pos_int, default=1,
+        help="Apply the dense optimizer every N minibatches on the "
+             "averaged gradient (the reference's local-update mode, "
+             "--get_model_steps; worker.py:1007-1089)",
+    )
     parser.add_argument("--num_epochs", type=pos_int, default=1)
     parser.add_argument(
         "--records_per_task", type=pos_int, default=256,

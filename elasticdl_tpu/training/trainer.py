@@ -75,7 +75,7 @@ class Trainer(object):
 
     def __init__(self, model_spec, mesh=None, model_params="", seed=0,
                  compute_dtype=None, callbacks=None,
-                 embedding_partition_threshold=None):
+                 embedding_partition_threshold=None, grad_accum_steps=1):
         self.spec = model_spec
         self.model = model_spec.create_model(model_params)
         from elasticdl_tpu.embedding.sparse_optim import make_row_sparse
@@ -94,6 +94,17 @@ class Trainer(object):
         # (dense update + mask: untouched rows and slots don't move).
         # Identity for models without embedding tables.
         self.tx = make_row_sparse(tx)
+        # Gradient accumulation (the reference worker's local-update mode,
+        # worker.py:822-828/1007-1089: accumulate per-minibatch gradients
+        # and push to the PS every `get_model_steps`). Here the PS round
+        # trip is gone, so the TPU-native semantics are optax.MultiSteps:
+        # each train_step call is one microbatch; the dense optimizer
+        # applies the averaged gradient every Nth call and emits zero
+        # updates in between. Sparse-tapped embedding tables and host-
+        # spill tables keep their per-microbatch row updates (the
+        # reference likewise pushed embedding grads through the
+        # OptimizerWrapper on every report).
+        self.grad_accum_steps = max(1, int(grad_accum_steps))
         # Filled by init_state once the model structure is known:
         self._sparse_paths = {}
         self._train_tx = None
@@ -190,6 +201,25 @@ class Trainer(object):
         self._train_tx = sparse_update.split_dense_tx(
             self.tx, set(self._sparse_paths)
         )
+        if self.grad_accum_steps > 1:
+            if self._sparse_paths or self._host_manager:
+                # Sparse-row and host-spill tiers apply per microbatch
+                # while MultiSteps defers the dense tier — an LR schedule
+                # would advance at different rates per tier, and the
+                # accumulator would hold O(vocab*dim) zeros for tapped
+                # tables. The reference likewise forces get_model_steps=1
+                # outside plain async dense training (common/args.py:156).
+                raise ValueError(
+                    "grad_accum_steps > 1 requires a dense-only model: "
+                    "sparse-tapped / host-spill embedding tables update "
+                    "every microbatch and would train on a divergent "
+                    "schedule"
+                )
+            import optax
+
+            self._train_tx = optax.MultiSteps(
+                self._train_tx, every_k_schedule=self.grad_accum_steps
+            )
 
         def init_fn(rng, feats):
             from flax.linen import meta as nn_meta

@@ -63,6 +63,7 @@ def build_worker(args):
         spmd=spmd,
         checkpoint_saver=checkpoint_saver,
         checkpoint_dir_for_init=args.checkpoint_dir_for_init or None,
+        grad_accum_steps=args.grad_accum_steps,
     )
 
 

@@ -77,6 +77,7 @@ class Worker(object):
         spmd=False,
         checkpoint_saver=None,
         checkpoint_dir_for_init=None,
+        grad_accum_steps=1,
     ):
         """Connect either over gRPC (master_addr) or in-process
         (master_servicer — the test harness path, mirroring the reference's
@@ -94,7 +95,8 @@ class Worker(object):
         else:
             raise ValueError("need master_addr or master_servicer")
         self.trainer = Trainer(
-            model_spec, mesh=mesh, model_params=model_params, seed=seed
+            model_spec, mesh=mesh, model_params=model_params, seed=seed,
+            grad_accum_steps=grad_accum_steps,
         )
         from elasticdl_tpu.embedding.host_bridge import attach_from_spec
 

@@ -1,0 +1,131 @@
+"""Gradient accumulation (the reference worker's local-update mode,
+--get_model_steps: accumulate minibatch gradients, sync every Nth —
+reference worker.py:1007-1089). TPU-native form: optax.MultiSteps inside
+the compiled step — N train_step calls, one averaged dense update."""
+
+import numpy as np
+
+import jax
+
+from elasticdl_tpu.common.args import parse_worker_args
+from elasticdl_tpu.common.model_utils import load_model_spec_from_module
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.training.trainer import Trainer
+from model_zoo.transformer_lm import transformer_lm as zoo
+
+PARAMS = (
+    "vocab_size=32; seq_len=16; embed_dim=32; num_heads=2; num_layers=1"
+)
+
+
+def _tokens(bsz, seed):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, 32, size=(bsz, 17)).astype(np.int32)
+
+
+def _as_batch(tokens):
+    return {"tokens": tokens[:, :-1]}, tokens[:, 1:]
+
+
+def test_two_microbatches_match_one_big_batch():
+    import optax
+
+    spec = load_model_spec_from_module(zoo)
+    # SGD is linear in the gradient, so mean-of-microbatch-grads must
+    # reproduce the big-batch update exactly (adamw's rsqrt normalization
+    # amplifies fp32 reassociation noise on near-zero gradients).
+    spec.optimizer = lambda: optax.sgd(0.1)
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tokens = _tokens(8, seed=0)
+
+    big = Trainer(spec, mesh=mesh, model_params=PARAMS)
+    s_big = big.init_state(_as_batch(tokens))
+    s_big, _ = big.train_step(s_big, _as_batch(tokens))
+
+    accum = Trainer(spec, mesh=mesh, model_params=PARAMS,
+                    grad_accum_steps=2)
+    s_acc = accum.init_state(_as_batch(tokens[:4]))
+    params0 = jax.tree.map(np.asarray, s_acc.params)
+    s_acc, _ = accum.train_step(s_acc, _as_batch(tokens[:4]))
+    # non-boundary microbatch: dense params must not move
+    for a, b in zip(
+        jax.tree.leaves(params0), jax.tree.leaves(s_acc.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    s_acc, _ = accum.train_step(s_acc, _as_batch(tokens[4:]))
+
+    # boundary: averaged-gradient update == one big-batch update
+    for a, b in zip(
+        jax.tree.leaves(s_big.params), jax.tree.leaves(s_acc.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_accum_training_reduces_loss():
+    spec = load_model_spec_from_module(zoo)
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(spec, mesh=mesh, model_params=PARAMS,
+                      grad_accum_steps=4)
+    batch = _as_batch(_tokens(8, seed=1))
+    state = trainer.init_state(batch)
+    first = None
+    for _ in range(24):
+        state, loss = trainer.train_step(state, batch)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first, (first, float(loss))
+    assert int(state.step) == 24
+
+
+def test_accum_rejects_sparse_tapped_models():
+    """Sparse-row tables update every microbatch; combining them with a
+    deferred dense update would train tiers on divergent schedules, so
+    init_state must fail fast (reference forces get_model_steps=1 outside
+    plain async dense training, common/args.py:156)."""
+    import optax
+    import pytest
+    from flax import linen as nn
+
+    from elasticdl_tpu.common.model_utils import ModelSpec
+    from elasticdl_tpu.embedding.layer import Embedding
+
+    class Rec(nn.Module):
+        @nn.compact
+        def __call__(self, features, training=False):
+            emb = Embedding(
+                input_dim=64, output_dim=8, sparse_grads=True, name="cat"
+            )(features["ids"])
+            return nn.Dense(1, name="out")(emb)[:, 0]
+
+    spec = ModelSpec(
+        model_fn=Rec,
+        dataset_fn=lambda ds, mode, meta: ds,
+        loss=lambda y, p: ((p - y) ** 2).mean(),
+        optimizer=lambda: optax.sgd(0.1),
+        eval_metrics_fn=lambda: {},
+    )
+    trainer = Trainer(
+        spec, mesh=mesh_lib.local_mesh(), grad_accum_steps=2
+    )
+    rs = np.random.RandomState(0)
+    batch = (
+        {"ids": rs.randint(0, 16, size=(8, 4)).astype(np.int32)},
+        rs.rand(8).astype(np.float32),
+    )
+    with pytest.raises(ValueError, match="dense-only"):
+        trainer.init_state(batch)
+
+
+def test_get_model_steps_cli_alias():
+    base = [
+        "--worker_id", "0", "--model_zoo", "model_zoo",
+        "--model_def", "m.m.custom_model", "--master_addr", "x:1",
+    ]
+    args = parse_worker_args(base + ["--grad_accum_steps", "4"])
+    assert args.grad_accum_steps == 4
+    args = parse_worker_args(base + ["--get_model_steps", "3"])
+    assert args.grad_accum_steps == 3
+    args = parse_worker_args(base)
+    assert args.grad_accum_steps == 1

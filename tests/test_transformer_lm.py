@@ -92,7 +92,7 @@ def test_chunked_xent_matches_direct():
     np.testing.assert_allclose(
         np.asarray(gk_c), np.asarray(gk_d), rtol=1e-5, atol=1e-6
     )
-    # non-divisible chunk request degrades to the largest divisor (4)
+    # non-divisible chunk request zero-pads to 5 chunks of 7, drops tail
     ce = chunked_softmax_xent(hidden, kernel, labels, num_chunks=5)
     assert ce.shape == (b, s)
     np.testing.assert_allclose(
