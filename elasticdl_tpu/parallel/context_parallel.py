@@ -1,16 +1,24 @@
-"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+"""Sequence/context parallelism over the `sp` mesh axis: ring attention
+and Ulysses (all-to-all head/sequence transpose).
 
 The reference has no long-context story (SURVEY.md §5: no ring attention,
 no sequence parallelism anywhere in the tree); this module is the
-TPU-native design the rebuild reserves the `sp` axis for: the sequence
-axis of q/k/v is sharded over `sp`, each device computes its query
-shard's attention against the key/value shard it currently holds, and
-key/value shards rotate around the ring with `jax.lax.ppermute` (ICI
-neighbor exchange) while partial softmax results merge online — the
-all-gather of the full sequence never materializes.
+TPU-native design the rebuild reserves the `sp` axis for. Two schemes,
+both inside `jit` via `shard_map` and differentiable (ppermute and
+all_to_all have transpose rules), so the same code paths train:
 
-Works inside `jit` via `shard_map`; differentiable (ppermute has a
-transpose rule), so the same code path trains.
+* **Ring** (`ring_attention`): the sequence axis of q/k/v is sharded
+  over `sp`; key/value shards rotate around the ring with
+  `jax.lax.ppermute` (ICI neighbor exchange) while partial softmax
+  results merge online — the full sequence never materializes anywhere.
+  Works for any head count; communication is 2(sp-1) neighbor hops of
+  the local kv shard per attention.
+* **Ulysses** (`ulysses_attention`): one `all_to_all` re-shards heads
+  against sequence so each device holds heads/sp *full-sequence* heads,
+  runs the local flash/blockwise kernel over the whole sequence, and
+  transposes back. Requires heads % sp == 0; communication is 4
+  all-to-alls of the activations per attention, and the inner kernel
+  sees the full sequence (better MXU tiling than sp-chunked ring steps).
 """
 
 import functools
@@ -22,6 +30,8 @@ from jax.sharding import PartitionSpec as P
 from elasticdl_tpu.common.constants import MeshAxis
 from elasticdl_tpu.ops.attention import (
     NEG_INF as _NEG_INF,
+    blockwise_attention,
+    flash_attention,
     softmax_finalize,
     softmax_merge,
 )
@@ -87,6 +97,67 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
             axis_name=seq_axis,
             causal=causal,
             scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
+                            attn_impl="auto"):
+    """Per-device body: q/k/v are local sequence shards
+    [batch, heads, local_len, dim]. One tiled all_to_all turns them into
+    [batch, heads/sp, full_len, dim] (device i holds head block i), the
+    full-sequence attention kernel runs locally, and the inverse
+    all_to_all restores the sequence-sharded layout."""
+
+    def to_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    local_attn = (
+        blockwise_attention if attn_impl == "xla" else flash_attention
+    )
+    out = local_attn(
+        to_heads(q), to_heads(k), to_heads(v), causal=causal, scale=scale
+    )
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
+                      attn_impl="auto",
+                      seq_axis=MeshAxis.SP, batch_axes=(MeshAxis.DP,
+                                                        MeshAxis.FSDP)):
+    """Global-view Ulysses attention: q/k/v are [batch, heads, seq, dim];
+    the sequence axis is laid out over `seq_axis`. Each device computes
+    heads/sp full-sequence heads between two all-to-all transposes.
+
+    With an sp=1 mesh this degenerates to one shard_map program == plain
+    attention. Requires heads to divide evenly over the sp axis — use
+    ring attention otherwise.
+    """
+    sp = mesh.shape.get(seq_axis, 1)
+    heads = q.shape[1]
+    if heads % sp:
+        raise ValueError(
+            "ulysses_attention needs num_heads (%d) divisible by the %s "
+            "axis (%d); use ring attention for this config"
+            % (heads, seq_axis, sp)
+        )
+    spec = P(batch_axes, None, seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(
+            ulysses_attention_local,
+            axis_name=seq_axis,
+            causal=causal,
+            scale=scale,
+            attn_impl=attn_impl,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

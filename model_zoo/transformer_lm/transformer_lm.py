@@ -22,7 +22,10 @@ from elasticdl_tpu.data.example_codec import decode_example
 from elasticdl_tpu.ops.attention import blockwise_attention, flash_attention
 from elasticdl_tpu.ops.losses import chunked_softmax_xent
 from elasticdl_tpu.parallel import mesh as mesh_lib
-from elasticdl_tpu.parallel.context_parallel import ring_attention
+from elasticdl_tpu.parallel.context_parallel import (
+    ring_attention,
+    ulysses_attention,
+)
 
 
 def _tp_dense_init(split_axis):
@@ -47,6 +50,7 @@ class CausalSelfAttention(nn.Module):
     head_dim: int
     dtype: object = None  # compute dtype (bf16 on TPU); params stay fp32
     attn_impl: str = "auto"  # "auto": Pallas flash on TPU; "xla": blockwise
+    sp_impl: str = "ring"  # sp>1 scheme: "ring" | "ulysses"
     tp_shard: bool = True
     causal: bool = True
 
@@ -65,7 +69,18 @@ class CausalSelfAttention(nn.Module):
         q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:
-            out = ring_attention(q, k, v, mesh, causal=self.causal)
+            if self.sp_impl == "ulysses":
+                out = ulysses_attention(
+                    q, k, v, mesh, causal=self.causal,
+                    attn_impl=self.attn_impl,
+                )
+            elif self.sp_impl == "ring":
+                out = ring_attention(q, k, v, mesh, causal=self.causal)
+            else:
+                raise ValueError(
+                    "Unknown sp_impl %r (valid: 'ring', 'ulysses')"
+                    % (self.sp_impl,)
+                )
         elif self.attn_impl == "xla":
             out = blockwise_attention(q, k, v, causal=self.causal)
         else:
@@ -86,6 +101,7 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     dtype: object = None
     attn_impl: str = "auto"
+    sp_impl: str = "ring"
     tp_shard: bool = True
     causal: bool = True
 
@@ -95,8 +111,8 @@ class Block(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
             self.num_heads, self.head_dim, dtype=self.dtype,
-            attn_impl=self.attn_impl, tp_shard=self.tp_shard,
-            causal=self.causal, name="attn",
+            attn_impl=self.attn_impl, sp_impl=self.sp_impl,
+            tp_shard=self.tp_shard, causal=self.causal, name="attn",
         )(y, training)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
@@ -152,6 +168,7 @@ class TransformerLM(nn.Module):
     num_layers: int = 2
     dtype: object = None  # compute dtype; None = fp32
     attn_impl: str = "auto"
+    sp_impl: str = "ring"  # sequence-parallel scheme: "ring" | "ulysses"
     tp_shard: bool = True  # annotate kernels over the tp mesh axis
     fused_head: bool = False  # stream the LM head inside the loss
 
@@ -169,8 +186,8 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = Block(
                 self.num_heads, head_dim, dtype=self.dtype,
-                attn_impl=self.attn_impl, tp_shard=self.tp_shard,
-                name="block_%d" % i,
+                attn_impl=self.attn_impl, sp_impl=self.sp_impl,
+                tp_shard=self.tp_shard, name="block_%d" % i,
             )(x, training)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(
