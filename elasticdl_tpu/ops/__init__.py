@@ -7,8 +7,9 @@ kernel family as Pallas TPU kernels — dense whole-tensor updates and
 sparse row updates against an HBM-resident embedding table — plus the
 row-gather that replaces the reference's pull_embedding_vectors RPC.
 
-Every op has a pure-jnp reference path; Pallas kernels run compiled on TPU
-and in interpreter mode elsewhere (tests exercise both).
+Every op has a pure-jnp reference path, used off-TPU; Pallas kernels run
+compiled on TPU (tests opt into interpreter mode via
+ELASTICDL_TPU_FORCE_INTERPRET=1 to exercise the kernel code anywhere).
 """
 
 from elasticdl_tpu.ops.dispatch import use_pallas  # noqa: F401

@@ -1,5 +1,7 @@
 """Attention ops: naive reference, blockwise (memory-efficient), and a
-Pallas flash-attention TPU kernel.
+Pallas flash-attention TPU kernel with a two-pass Pallas backward
+(query-parallel dq, key-parallel dk/dv, P recomputed from the saved
+logsumexp).
 
 The reference framework has no attention/sequence stack at all
 (SURVEY.md §5 "long-context: absent") — this is net-new TPU-first
@@ -42,8 +44,8 @@ def softmax_finalize(o, l):
 
 
 def naive_attention(q, k, v, causal=False, scale=None):
-    """Reference softmax(q k^T) v; O(L^2) memory. Test oracle and the
-    custom-vjp backward for the flash kernel."""
+    """Reference softmax(q k^T) v; O(L^2) memory. The test oracle (the
+    flash backward is the Pallas two-pass _flash_backward below)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
@@ -106,8 +108,29 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512):
 # --------------------------------------------------------- flash kernel
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                  *, scale, causal, block_q, block_k, n_k):
+def _dims(contract_a, contract_b):
+    return (((contract_a,), (contract_b,)), ((), ()))
+
+
+def _causal_run(qi, ki, block_q, block_k):
+    """Whether query block qi overlaps key block ki under the causal
+    mask (the block-skip invariant shared by forward and both backward
+    kernels: any q position >= the block's first k position)."""
+    return qi * block_q + block_q - 1 >= ki * block_k
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, scale, causal, block_q, block_k, n_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -118,46 +141,54 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # causal: skip key blocks that lie entirely after this query block
-    run = (
-        qi * block_q + block_q - 1 >= ki * block_k if causal else True
-    )
+    run = _causal_run(qi, ki, block_q, block_k) if causal else True
 
     @pl.when(run)
     def _():
         q = q_ref[0] * scale
         s = jax.lax.dot_general(
-            q, k_ref[0],
-            dimension_numbers=(((1,), (1,)), ((), ())),
+            q, k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
         l_scr[:] = l_scr[:] * corr + p.sum(-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v_ref[0],
-            dimension_numbers=(((1,), (0,)), ((), ())),
+            p, v_ref[0], dimension_numbers=_dims(1, 0),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = m_new
 
     @pl.when(ki == n_k - 1)
     def _():
-        o_ref[0] = (
-            acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
-        ).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # logsumexp residual for the backward kernels: exp(s - lse) == P
+        lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _outer_spec(block, d):
+    """Block indexed by grid dim 1 (the parallel/output dimension)."""
+    return pl.BlockSpec(
+        (1, block, d), lambda i, j, t: (i, j, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _inner_spec(block, d):
+    """Block indexed by grid dim 2 (the sequential/streamed dimension)."""
+    return pl.BlockSpec(
+        (1, block, d), lambda i, j, t: (i, t, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   with_residuals=False):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     bh = b * h
@@ -174,32 +205,23 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         block_k=block_k,
         n_k=n_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
         in_specs=[
-            pl.BlockSpec(
-                (1, block_q, d),
-                lambda i, j, t: (i, j, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_k, d),
-                lambda i, j, t: (i, t, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_k, d),
-                lambda i, j, t: (i, t, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            _outer_spec(block_q, d), _inner_spec(block_k, d),
+            _inner_spec(block_k, d),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d),
-            lambda i, j, t: (i, j, 0),
-            memory_space=pltpu.VMEM,
+        out_specs=(
+            _outer_spec(block_q, d),
+            # lse rides as [bh, lq, 1] so stores stay (block_q, 1)
+            # sublane columns — no 1-D reshape/transpose in the kernel
+            _outer_spec(block_q, 1),
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -207,7 +229,162 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret_mode() if interpret is None else interpret,
     )(q3, k3, v3)
-    return out.reshape(b, h, lq, d)
+    out = out.reshape(b, h, lq, d)
+    if with_residuals:
+        return out, lse.reshape(b, h, lq, 1)
+    return out
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, scale, causal, block_q,
+                         block_k, n_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = _causal_run(qi, ki, block_q, block_k) if causal else True
+
+    @pl.when(run)
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], dimension_numbers=_dims(1, 1),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k_ref[0], dimension_numbers=_dims(1, 0),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          scale, causal, block_q, block_k, n_q):
+    ki = pl.program_id(1)  # key block is the outer (parallel) dim here
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = _causal_run(qi, ki, block_q, block_k) if causal else True
+
+    @pl.when(run)
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
+        # dV_j += P^T dO ; dP = dO V^T ; dS = P*(dP - D) ; dK_j += dS^T Q
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do_ref[0], dimension_numbers=_dims(0, 0),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], dimension_numbers=_dims(1, 1),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q_ref[0], dimension_numbers=_dims(0, 0),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
+                    block_k, interpret):
+    """Two-pass flash backward: a dq kernel parallel over query blocks
+    and a dk/dv kernel parallel over key blocks, both recomputing P from
+    the saved logsumexp (the standard flash-attention backward; one
+    matmul recompute instead of the O(L) blockwise-vjp scan)."""
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bh = b * h
+    interp = interpret_mode() if interpret is None else interpret
+    n_q = lq // block_q
+    n_k = lk // block_k
+    # D_i = rowsum(dO * O), the softmax-jacobian diagonal term
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )
+    q3 = q.reshape(bh, lq, d)
+    k3 = k.reshape(bh, lk, d)
+    v3 = v.reshape(bh, lk, d)
+    do3 = g.reshape(bh, lq, d)
+    lse3 = lse.reshape(bh, lq, 1)
+    delta3 = delta.reshape(bh, lq, 1)
+
+    col_q = _outer_spec(block_q, 1)
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_k=n_k,
+        ),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            _outer_spec(block_q, d), _inner_spec(block_k, d),
+            _inner_spec(block_k, d), _outer_spec(block_q, d),
+            col_q, col_q,
+        ],
+        out_specs=_outer_spec(block_q, d),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interp,
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    # key-block-parallel pass: q-side inputs stream over the inner dim
+    col_q_t = _inner_spec(block_q, 1)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_q=n_q,
+        ),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            _inner_spec(block_q, d), _outer_spec(block_k, d),
+            _outer_spec(block_k, d), _inner_spec(block_q, d),
+            col_q_t, col_q_t,
+        ],
+        out_specs=(_outer_spec(block_k, d), _outer_spec(block_k, d)),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interp,
+    )(q3, k3, v3, do3, lse3, delta3)
+    return (
+        dq.reshape(b, h, lq, d),
+        dk.reshape(b, h, lk, d),
+        dv.reshape(b, h, lk, d),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -217,21 +394,15 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret, with_residuals=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # flash backward = recompute: vjp of the O(L)-memory blockwise path
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, scale=scale
-        ),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
+                           block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

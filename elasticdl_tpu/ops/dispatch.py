@@ -1,12 +1,17 @@
 """Backend dispatch for Pallas kernels.
 
-Compiled Pallas requires a TPU; everywhere else (CPU tests, the virtual
-8-device mesh in tests/conftest.py) kernels run in Pallas interpreter mode
-so the exact same kernel code is what the tests verify.
+Compiled Pallas requires a TPU. Off-TPU the call sites take their
+pure-jnp/XLA reference paths (interpreted Pallas is orders of magnitude
+slower than XLA on CPU); kernel tests opt into interpreter mode with
+ELASTICDL_TPU_FORCE_INTERPRET=1 so the exact same kernel code is what
+they verify (tests/test_attention.py, tests/test_ops.py fixtures).
 
 Env knobs:
   ELASTICDL_TPU_DISABLE_PALLAS=1  force the pure-jnp reference paths
-  ELASTICDL_TPU_FORCE_INTERPRET=1 force interpreter mode even on TPU
+  ELASTICDL_TPU_FORCE_INTERPRET=1 run the kernels in interpreter mode
+                                  (opts non-TPU backends INTO the kernel
+                                  path; on TPU, debugs the kernel without
+                                  Mosaic)
 """
 
 import os
@@ -15,8 +20,18 @@ import jax
 
 
 def use_pallas():
-    """Whether call sites should route through the Pallas kernels at all."""
-    return os.environ.get("ELASTICDL_TPU_DISABLE_PALLAS", "") != "1"
+    """Whether call sites should route through the Pallas kernels at all.
+
+    On non-TPU backends the kernels could only run interpreted — orders
+    of magnitude slower than the pure-jnp/XLA reference paths — so
+    production CPU runs (the bench fallback, CPU-only users) take the
+    reference paths and kernel tests opt in via FORCE_INTERPRET=1.
+    """
+    if os.environ.get("ELASTICDL_TPU_DISABLE_PALLAS", "") == "1":
+        return False
+    if os.environ.get("ELASTICDL_TPU_FORCE_INTERPRET", "") == "1":
+        return True
+    return is_tpu_backend()
 
 
 def interpret_mode():

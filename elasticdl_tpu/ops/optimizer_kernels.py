@@ -5,7 +5,8 @@ Kernel-family parity with the reference's C++ Eigen kernels
 Adam(+amsgrad, bias-corrected), Adagrad), rebuilt for the TPU VPU: tensors
 are viewed as (rows, 128) lane-aligned matrices and updated block-by-block
 in VMEM. On TPU these compile to single fused passes over HBM; the same
-kernels run under the Pallas interpreter on CPU (tests).
+kernels run compiled on TPU; kernel tests opt into the Pallas
+interpreter off-TPU (ELASTICDL_TPU_FORCE_INTERPRET=1).
 
 The update rules live in update_math.py, shared with the sparse row
 kernels and the pure-jnp fallback (ELASTICDL_TPU_DISABLE_PALLAS=1).

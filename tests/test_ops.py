@@ -24,6 +24,15 @@ from elasticdl_tpu.ops import (
     sparse_sgd_update,
 )
 
+
+@pytest.fixture(autouse=True)
+def _opt_into_interpreted_kernels(monkeypatch):
+    """use_pallas() routes to the jnp reference paths off-TPU; these
+    tests exist to exercise the kernel code itself, so they opt into
+    Pallas interpreter mode explicitly."""
+    monkeypatch.setenv("ELASTICDL_TPU_FORCE_INTERPRET", "1")
+
+
 DIM = 16
 VOCAB = 32
 
