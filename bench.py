@@ -149,14 +149,13 @@ def run_transformer_bench(on_tpu):
     # host->device transfers behind the step).
     batch = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
 
+    from elasticdl_tpu.common.timing_utils import fetch_sync
+
     def sync(state):
-        # On tunneled PJRT devices block_until_ready can return before
-        # execution finishes (observed reading >10 TB/s effective HBM on
-        # small ops); fetching a scalar that depends on the final params
-        # is the sync this rig honors. For the flagship step both methods
-        # agree (~315 ms), but only the fetch is trustworthy in general.
-        leaf = jax.tree.leaves(state.params)[0]
-        return float(np.asarray(jax.device_get(leaf.reshape(-1)[0])))
+        # fetch-forced sync: see fetch_sync (block_until_ready can
+        # return early over tunneled PJRT plugins). For the flagship
+        # step both methods agree (~315 ms cross-checked).
+        return fetch_sync(state.params)
 
     for _ in range(warmup):
         state, loss = trainer.train_step(state, batch)
@@ -185,11 +184,30 @@ def run_transformer_bench(on_tpu):
             getattr(dev, "device_kind", "")) * n_chips), 4)
     n_params = sum(int(np.prod(x.shape))
                    for x in jax.tree.leaves(state.params))
+    # vs_baseline: ratio to the committed hardware baseline
+    # (BENCH_BASELINE.json, the best prior measured TPU number for the
+    # same config). Only meaningful for same-platform, same-config runs;
+    # 1.0 otherwise.
+    vs_baseline = 1.0
+    try:
+        with open(os.path.join(REPO, "BENCH_BASELINE.json")) as f:
+            base = json.load(f)
+        if (platform != "cpu" and base.get("platform") != "cpu"
+                and base.get("config") == cfg
+                and base.get("batch_size") == batch_size
+                and base.get("device_kind") == getattr(
+                    dev, "device_kind", "")
+                and base.get("value")):
+            vs_baseline = round(
+                tokens_per_sec / n_chips / float(base["value"]), 4
+            )
+    except (OSError, ValueError):
+        pass
     return {
         "metric": "transformer_lm_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / n_chips, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": 1.0,
+        "vs_baseline": vs_baseline,
         "mfu": mfu,
         "samples_per_sec_per_chip": round(
             batch_size * iters / dt / n_chips, 2),

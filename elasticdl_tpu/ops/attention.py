@@ -187,6 +187,16 @@ def _inner_spec(block, d):
     )
 
 
+
+def _mosaic_params():
+    """Grid semantics for all three flash kernels: (bh, output-block,
+    streamed-block) = two parallel dims + one arbitrary (sequential
+    accumulation over scratch). Lets Mosaic pipeline the parallel dims."""
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
                    with_residuals=False):
     b, h, lq, d = q.shape
@@ -227,6 +237,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        compiler_params=_mosaic_params(),
         interpret=interpret_mode() if interpret is None else interpret,
     )(q3, k3, v3)
     out = out.reshape(b, h, lq, d)
@@ -353,6 +364,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
         out_specs=_outer_spec(block_q, d),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_mosaic_params(),
         interpret=interp,
     )(q3, k3, v3, do3, lse3, delta3)
 
@@ -378,6 +390,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=_mosaic_params(),
         interpret=interp,
     )(q3, k3, v3, do3, lse3, delta3)
     return (

@@ -1,0 +1,108 @@
+"""Flash-attention kernel tuning bench (run on real TPU).
+
+Sweeps block sizes for the Pallas forward + two-pass backward at the
+flagship shape and compares against the XLA blockwise path and jax's
+bundled TPU flash kernel. Timing is fetch-forced (block_until_ready can
+return early over the tunneled PJRT plugin — see BENCHNOTES.md).
+
+Usage:  python scripts/bench_attention.py [b h s d]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from elasticdl_tpu.common.timing_utils import fetch_sync as fetch  # noqa: E402
+
+
+def timed(fn, args, iters=20):
+    out = fn(*args)
+    fetch(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    fetch(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops.attention import (
+        blockwise_attention,
+        flash_attention,
+    )
+
+    args = sys.argv[1:5]
+    if args and len(args) != 4:
+        sys.exit("usage: bench_attention.py [b h s d]")
+    try:
+        shape = [int(a) for a in args] or [32, 8, 1024, 128]
+    except ValueError:
+        sys.exit("usage: bench_attention.py [b h s d] (ints)")
+    b, h, s, d = shape
+    rs = np.random.RandomState(0)
+
+    def mk():
+        return jnp.asarray(
+            rs.randn(b, h, s, d).astype(np.float32) * 0.1, jnp.bfloat16
+        )
+
+    q, k, v = mk(), mk(), mk()
+    flops_fwd = 2 * 2 * b * h * s * s * d / 2  # causal
+    print("shape b=%d h=%d s=%d d=%d   causal fwd %.1f GFLOP"
+          % (b, h, s, d, flops_fwd / 1e9))
+
+    def report(tag, t_f, t_b):
+        print("%-34s fwd %7.2f ms (%5.1f TF/s)   fwd+bwd %7.2f ms"
+              % (tag, t_f * 1e3, flops_fwd / t_f / 1e12, t_b * 1e3))
+
+    def bench_pair(mk_fn, tag):
+        fwd = jax.jit(mk_fn)
+        grad = jax.jit(jax.grad(
+            lambda q, k, v: mk_fn(q, k, v).astype(jnp.float32).mean(),
+            argnums=(0, 1, 2),
+        ))
+        try:
+            report(tag, timed(fwd, (q, k, v)), timed(grad, (q, k, v)))
+        except Exception as e:  # noqa: BLE001
+            print("%-34s FAILED: %r" % (tag, repr(e)[:90]))
+
+    for bq, bk in [(128, 128), (128, 256), (128, 512), (256, 256),
+                   (256, 512), (512, 512), (256, 1024), (512, 1024)]:
+        if s % bq or s % bk:
+            continue
+        bench_pair(
+            lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk
+            ),
+            "ours pallas bq=%d bk=%d" % (bq, bk),
+        )
+
+    bench_pair(
+        lambda q, k, v: blockwise_attention(q, k, v, causal=True),
+        "xla blockwise (scan)",
+    )
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash,
+        )
+
+        sm = 1.0 / np.sqrt(d)
+        bench_pair(
+            lambda q, k, v: jax_flash(q, k, v, causal=True, sm_scale=sm),
+            "jax bundled flash",
+        )
+    except ImportError:
+        pass
+
+
+if __name__ == "__main__":
+    main()

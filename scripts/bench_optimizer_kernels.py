@@ -39,9 +39,7 @@ from elasticdl_tpu.ops import optimizer_kernels as ok
 from elasticdl_tpu.ops import update_math as um
 
 
-def _fetch(carry):
-    leaf = jax.tree.leaves(carry)[0]
-    return float(np.asarray(jax.device_get(leaf.reshape(-1)[0])))
+from elasticdl_tpu.common.timing_utils import fetch_sync as _fetch  # noqa: E402
 
 
 def timed_carry(step, carry, iters=30, warmup=5):
