@@ -4,10 +4,11 @@ Net-new beyond the reference (which has no expert axis — SURVEY.md §2.5;
 ``ep`` existed for embedding-row sharding only). The design is the
 GShard/Switch static-shape formulation, which is what XLA wants:
 
-* top-1 routing with a CAPACITY per expert (ceil(tokens/E) *
-  capacity_factor): every tensor keeps a static shape; tokens over
-  capacity are dropped from the expert path (their combine weight is 0,
-  so they pass through the residual only);
+* top-k routing (k=1 Switch, k=2 GShard) with a CAPACITY per expert
+  (ceil(k*tokens/E) * capacity_factor): every tensor keeps a static
+  shape; choices over capacity are dropped from the expert path (their
+  combine weight is 0, so over-capacity tokens pass through the
+  residual only);
 * dispatch and combine are one-hot einsums — no gather/scatter with
   dynamic shapes;
 * expert weights are stacked [E, ...] and annotated over ``ep``
@@ -23,38 +24,68 @@ import jax.numpy as jnp
 
 
 def top1_dispatch(router_logits, capacity):
-    """Static-shape top-1 routing.
+    """Static-shape top-1 routing (Switch). See topk_dispatch."""
+    return topk_dispatch(router_logits, capacity, k=1)
 
-    router_logits: [T, E]; capacity: int C.
+
+def topk_dispatch(router_logits, capacity, k=1):
+    """Static-shape top-k routing (k=1 Switch, k=2 GShard).
+
+    router_logits: [T, E]; capacity: int C per expert.
     Returns (dispatch [T, E, C] 0/1, combine [T, E, C] float, aux_loss
-    scalar, stats dict). combine = dispatch * router prob of the chosen
-    expert; tokens beyond an expert's capacity have all-zero rows.
+    scalar, stats dict). Each token routes to its k highest-probability
+    experts; capacity queues fill primary choices first (all rank-0
+    picks, then rank-1, ...), so under load the second choices are the
+    ones dropped — GShard's policy. Combine weights are the chosen
+    experts' router probs renormalized over the *kept* choices; a token
+    whose every choice was dropped has an all-zero combine row and rides
+    the residual only.
     """
     t, e = router_logits.shape
+    if not 1 <= k <= e:
+        raise ValueError("top-k k=%d must be in [1, %d experts]" % (k, e))
     probs = jax.nn.softmax(router_logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=probs.dtype)  # [T, E]
 
-    # position of each token within its expert's queue (arrival order)
-    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T, E]
+    # lax.top_k guarantees k DISTINCT indices per token (an iterative
+    # mask-and-argmax can pick an expert twice when the masked row
+    # underflows to all zeros under a saturated router)
+    _, topk_idx = jax.lax.top_k(probs, k)  # [T, k]
+    onehots = [
+        jax.nn.one_hot(topk_idx[:, r], e, dtype=probs.dtype)
+        for r in range(k)
+    ]
+
+    # queue positions over (rank, arrival) order: rank-0 choices claim
+    # capacity before any rank-1 choice
+    flat = jnp.concatenate(onehots, axis=0)  # [k*T, E], rank-major
+    position = jnp.cumsum(flat, axis=0) * flat - 1.0  # [k*T, E]
     within = (position >= 0) & (position < capacity)
-    kept = onehot * within.astype(probs.dtype)
-
+    kept_flat = flat * within.astype(probs.dtype)
     pos_onehot = jax.nn.one_hot(
         jnp.clip(position, 0, capacity - 1).astype(jnp.int32),
         capacity,
         dtype=probs.dtype,
-    )  # [T, E, C]
-    dispatch = kept[..., None] * pos_onehot
-    gate = jnp.sum(probs * kept, axis=-1)  # chosen prob, 0 if dropped
-    combine = dispatch * gate[:, None, None]
+    )  # [k*T, E, C]
+    dispatch_flat = kept_flat[..., None] * pos_onehot
+    dispatch = dispatch_flat.reshape(k, t, e, capacity).sum(0)  # [T,E,C]
 
-    # Switch aux loss: E * sum_e fraction_e * mean-prob_e
-    fraction = jnp.mean(onehot, axis=0)
+    # combine weights: k=1 keeps the raw chosen prob (Switch eq. 2 — the
+    # magnitude is the router's gradient path); k>1 renormalizes the
+    # kept choices' probs per token (GShard's g1/g2 normalization)
+    kept = kept_flat.reshape(k, t, e).sum(0)  # [T, E]
+    gates = probs * kept
+    if k == 1:
+        combine = dispatch * gates[..., None]
+    else:
+        denom = jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+        combine = dispatch * (gates / denom)[..., None]
+
+    # Switch aux loss on the primary choice: E * sum_e frac_e * prob_e
+    fraction = jnp.mean(onehots[0], axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux_loss = e * jnp.sum(fraction * mean_prob)
     stats = {
-        "dropped_fraction": 1.0 - jnp.sum(kept) / t,
+        "dropped_fraction": 1.0 - jnp.sum(kept) / (k * t),
         "expert_fraction": fraction,
     }
     return dispatch, combine, aux_loss, stats
@@ -64,7 +95,8 @@ def expert_capacity(num_tokens, num_experts, capacity_factor):
     return max(1, int(num_tokens * capacity_factor / num_experts + 0.5))
 
 
-def moe_mlp_apply(params, x, capacity_factor=1.25, activation=jax.nn.gelu):
+def moe_mlp_apply(params, x, capacity_factor=1.25, activation=jax.nn.gelu,
+                  router_top_k=1):
     """Functional MoE MLP: x [T, D] through E expert FFNs.
 
     params: {"router": [D, E], "w_up": [E, D, H], "b_up": [E, H],
@@ -74,9 +106,13 @@ def moe_mlp_apply(params, x, capacity_factor=1.25, activation=jax.nn.gelu):
     """
     t = x.shape[0]
     e = params["router"].shape[-1]
-    capacity = expert_capacity(t, e, capacity_factor)
+    capacity = expert_capacity(
+        t * router_top_k, e, capacity_factor
+    )
     logits = x @ params["router"]
-    dispatch, combine, aux_loss, stats = top1_dispatch(logits, capacity)
+    dispatch, combine, aux_loss, stats = topk_dispatch(
+        logits, capacity, k=router_top_k
+    )
     # [T,E,C] x [T,D] -> [E,C,D]: the all-to-all boundary under GSPMD
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
     h = activation(
@@ -92,33 +128,47 @@ def moe_mlp_apply(params, x, capacity_factor=1.25, activation=jax.nn.gelu):
 
 
 def moe_reference(params, x, capacity_factor=1.25,
-                  activation=jax.nn.gelu):
+                  activation=jax.nn.gelu, router_top_k=1):
     """Oracle: loop over tokens/experts in plain numpy-style code (tests
-    compare the einsum formulation against this)."""
+    compare the einsum formulation against this). Mirrors topk_dispatch:
+    rank-0 choices claim capacity before rank-1, combine weights are raw
+    probs for k=1 and renormalized over kept choices for k>1."""
     import numpy as np
 
     x = np.asarray(x, np.float32)
     router = np.asarray(params["router"], np.float32)
     t, _ = x.shape
     e = router.shape[-1]
-    capacity = expert_capacity(t, e, capacity_factor)
+    k = router_top_k
+    capacity = expert_capacity(t * k, e, capacity_factor)
     logits = x @ router
     exps = np.exp(logits - logits.max(-1, keepdims=True))
     probs = exps / exps.sum(-1, keepdims=True)
-    chosen = probs.argmax(-1)
+    order = np.argsort(-probs, axis=-1)[:, :k]  # [T, k]
     counts = {i: 0 for i in range(e)}
-    y = np.zeros_like(x)
-    for ti in range(t):
-        ei = int(chosen[ti])
-        if counts[ei] >= capacity:
-            continue
-        counts[ei] += 1
+    kept = [[] for _ in range(t)]  # (expert, prob) kept per token
+    for rank in range(k):
+        for ti in range(t):
+            ei = int(order[ti, rank])
+            if counts[ei] >= capacity:
+                continue
+            counts[ei] += 1
+            kept[ti].append((ei, probs[ti, ei]))
+
+    def expert_out(ti, ei):
         h = np.asarray(activation(
             jnp.asarray(x[ti] @ np.asarray(params["w_up"][ei])
                         + np.asarray(params["b_up"][ei]))
         ))
-        out = h @ np.asarray(params["w_down"][ei]) + np.asarray(
+        return h @ np.asarray(params["w_down"][ei]) + np.asarray(
             params["b_down"][ei]
         )
-        y[ti] = probs[ti, ei] * out
+
+    y = np.zeros_like(x)
+    for ti in range(t):
+        if not kept[ti]:
+            continue
+        denom = sum(p for _, p in kept[ti]) if k > 1 else 1.0
+        for ei, p in kept[ti]:
+            y[ti] += (p / denom) * expert_out(ti, ei)
     return y

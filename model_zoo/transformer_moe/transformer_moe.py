@@ -1,5 +1,5 @@
 """Mixture-of-experts causal transformer LM: every block's MLP is a
-top-1-routed expert bank sharded over the ``ep`` mesh axis
+top-k-routed expert bank (router_top_k: 1 = Switch, 2 = GShard) sharded over the ``ep`` mesh axis
 (parallel/moe.py) — the family that makes ``ep`` a true expert axis.
 
 Attention reuses transformer_lm's CausalSelfAttention (flash/ring/TP
@@ -45,6 +45,7 @@ class MoEBlock(nn.Module):
     num_experts: int = 4
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
+    router_top_k: int = 1  # 1 = Switch; 2 = GShard top-2
     dtype: object = None
     attn_impl: str = "auto"
     tp_shard: bool = True
@@ -98,7 +99,8 @@ class MoEBlock(nn.Module):
         }
         flat = y.reshape(b * l, e)
         out, aux_loss, _ = moe_mlp_apply(
-            params, flat, capacity_factor=self.capacity_factor
+            params, flat, capacity_factor=self.capacity_factor,
+            router_top_k=self.router_top_k,
         )
         return x + out.reshape(b, l, e), aux_loss
 
@@ -111,6 +113,7 @@ class TransformerMoE(nn.Module):
     num_layers: int = 2
     num_experts: int = 4
     capacity_factor: float = 1.25
+    router_top_k: int = 1  # 1 = Switch; 2 = GShard top-2
     dtype: object = None
     attn_impl: str = "auto"
     tp_shard: bool = True
@@ -130,7 +133,8 @@ class TransformerMoE(nn.Module):
         for i in range(self.num_layers):
             x, aux = MoEBlock(
                 self.num_heads, head_dim, num_experts=self.num_experts,
-                capacity_factor=self.capacity_factor, dtype=self.dtype,
+                capacity_factor=self.capacity_factor,
+                router_top_k=self.router_top_k, dtype=self.dtype,
                 attn_impl=self.attn_impl, tp_shard=self.tp_shard,
                 name="block_%d" % i,
             )(x, training)

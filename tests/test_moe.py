@@ -44,6 +44,64 @@ def test_moe_matches_token_loop_oracle():
     assert 0.0 <= float(stats["dropped_fraction"]) < 1.0
 
 
+def test_top2_matches_token_loop_oracle():
+    params = _moe_params(seed=3)
+    x = jnp.asarray(
+        np.random.default_rng(4).standard_normal((32, 8)), jnp.float32
+    )
+    y, aux, stats = moe.moe_mlp_apply(
+        params, x, capacity_factor=2.0, router_top_k=2
+    )
+    want = moe.moe_reference(
+        params, x, capacity_factor=2.0, router_top_k=2
+    )
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-5, rtol=1e-4)
+    assert float(aux) > 0
+
+
+def test_top2_combine_weights_renormalized():
+    """Every token kept in both choices must have combine weights that
+    sum to exactly 1 (GShard g1/g2 normalization); with generous
+    capacity no token is dropped."""
+    params = _moe_params(seed=5)
+    x = jnp.asarray(
+        np.random.default_rng(6).standard_normal((16, 8)), jnp.float32
+    )
+    logits = x @ params["router"]
+    capacity = moe.expert_capacity(32, 4, 4.0)
+    dispatch, combine, _, stats = moe.topk_dispatch(logits, capacity, k=2)
+    assert float(stats["dropped_fraction"]) == 0.0
+    sums = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    np.testing.assert_allclose(sums, np.ones(16), rtol=1e-5)
+    # each token occupies exactly two expert queue slots
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(dispatch, axis=(1, 2))), 2 * np.ones(16)
+    )
+
+
+def test_topk_capacity_prioritizes_primary_choice():
+    """Under overflow, rank-0 choices must claim capacity before any
+    rank-1 choice: force every token's top pick to expert 0 and check
+    the kept rank-0 count is the full capacity."""
+    t, e = 16, 4
+    logits = np.zeros((t, e), np.float32)
+    logits[:, 0] = 4.0  # every token: top-1 = expert 0
+    logits[:, 1] = 2.0  # every token: top-2 = expert 1
+    capacity = 4
+    dispatch, combine, _, _ = moe.topk_dispatch(
+        jnp.asarray(logits), capacity, k=2
+    )
+    d = np.asarray(dispatch)
+    # expert 0 queue: filled by the FIRST 4 tokens' rank-0 picks
+    assert d[:4, 0].sum() == 4.0 and d[4:, 0].sum() == 0.0
+    # expert 1 queue: rank-1 picks, also first 4 tokens by arrival
+    assert d[:4, 1].sum() == 4.0 and d[4:, 1].sum() == 0.0
+    import pytest
+
+    with pytest.raises(ValueError, match="top-k"):
+        moe.topk_dispatch(jnp.asarray(logits), capacity, k=5)
+
+
 def test_capacity_drops_overflow_tokens():
     """With capacity_factor tiny, most tokens overflow: their MoE output
     must be exactly zero (residual-only passthrough)."""
