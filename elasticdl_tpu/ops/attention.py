@@ -105,6 +105,32 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512):
     return softmax_finalize(o, l)
 
 
+def apply_rope(x, positions, theta=10000.0):
+    """Rotary position embedding (RoPE) over the head dimension.
+
+    x: [b, h, l, d]; positions: [l] int/float absolute positions.
+    Rotates feature pairs (i, i+d/2) by positions * theta^(-2i/d), so
+    q·k after rotation depends only on RELATIVE distance — the property
+    that lets ring/Ulysses sequence shards use their global positions
+    with no learned table. Math in fp32, result in x.dtype. An odd tail
+    feature (d % 2) passes through unrotated.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, None]  # [1, 1, l, half]
+    sin = jnp.sin(angles)[None, None]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:2 * half]
+    rot = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    if d % 2:
+        rot = jnp.concatenate([rot, xf[..., 2 * half:]], axis=-1)
+    return rot.astype(x.dtype)
+
+
 # --------------------------------------------------------- flash kernel
 
 

@@ -5,8 +5,8 @@ Net-new beyond the reference (which has no expert axis — SURVEY.md §2.5;
 GShard/Switch static-shape formulation, which is what XLA wants:
 
 * top-k routing (k=1 Switch, k=2 GShard) with a CAPACITY per expert
-  (ceil(k*tokens/E) * capacity_factor): every tensor keeps a static
-  shape; choices over capacity are dropped from the expert path (their
+  (round(k * tokens * capacity_factor / E), expert_capacity()): every
+  tensor keeps a static shape; choices over capacity are dropped from the expert path (their
   combine weight is 0, so over-capacity tokens pass through the
   residual only);
 * dispatch and combine are one-hot einsums — no gather/scatter with

@@ -19,7 +19,11 @@ from flax import linen as nn
 
 from elasticdl_tpu.common.constants import MeshAxis, Mode
 from elasticdl_tpu.data.example_codec import decode_example
-from elasticdl_tpu.ops.attention import blockwise_attention, flash_attention
+from elasticdl_tpu.ops.attention import (
+    apply_rope,
+    blockwise_attention,
+    flash_attention,
+)
 from elasticdl_tpu.ops.losses import chunked_softmax_xent
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.parallel.context_parallel import (
@@ -53,6 +57,7 @@ class CausalSelfAttention(nn.Module):
     sp_impl: str = "ring"  # sp>1 scheme: "ring" | "ulysses"
     tp_shard: bool = True
     causal: bool = True
+    use_rope: bool = False  # rotary q/k (global positions; sp-safe)
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -67,6 +72,10 @@ class CausalSelfAttention(nn.Module):
         )(x)
         qkv = qkv.reshape(b, l, 3, h, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
+        if self.use_rope:
+            pos = jnp.arange(l)
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:
             if self.sp_impl == "ulysses":
@@ -104,6 +113,7 @@ class Block(nn.Module):
     sp_impl: str = "ring"
     tp_shard: bool = True
     causal: bool = True
+    use_rope: bool = False
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -112,7 +122,8 @@ class Block(nn.Module):
         x = x + CausalSelfAttention(
             self.num_heads, self.head_dim, dtype=self.dtype,
             attn_impl=self.attn_impl, sp_impl=self.sp_impl,
-            tp_shard=self.tp_shard, causal=self.causal, name="attn",
+            tp_shard=self.tp_shard, causal=self.causal,
+            use_rope=self.use_rope, name="attn",
         )(y, training)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
@@ -169,6 +180,7 @@ class TransformerLM(nn.Module):
     dtype: object = None  # compute dtype; None = fp32
     attn_impl: str = "auto"
     sp_impl: str = "ring"  # sequence-parallel scheme: "ring" | "ulysses"
+    pos_emb: str = "learned"  # "learned" wpe table | "rope" rotary q/k
     tp_shard: bool = True  # annotate kernels over the tp mesh axis
     fused_head: bool = False  # stream the LM head inside the loss
 
@@ -178,16 +190,24 @@ class TransformerLM(nn.Module):
         x = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype, name="wte"
         )(tokens)
-        pos = nn.Embed(
-            self.seq_len, self.embed_dim, dtype=self.dtype, name="wpe"
-        )(jnp.arange(tokens.shape[1])[None, :])
-        x = x + pos
+        if self.pos_emb == "learned":
+            pos = nn.Embed(
+                self.seq_len, self.embed_dim, dtype=self.dtype,
+                name="wpe",
+            )(jnp.arange(tokens.shape[1])[None, :])
+            x = x + pos
+        elif self.pos_emb != "rope":
+            raise ValueError(
+                "Unknown pos_emb %r (valid: 'learned', 'rope')"
+                % (self.pos_emb,)
+            )
         head_dim = self.embed_dim // self.num_heads
         for i in range(self.num_layers):
             x = Block(
                 self.num_heads, head_dim, dtype=self.dtype,
                 attn_impl=self.attn_impl, sp_impl=self.sp_impl,
-                tp_shard=self.tp_shard, name="block_%d" % i,
+                tp_shard=self.tp_shard,
+                use_rope=self.pos_emb == "rope", name="block_%d" % i,
             )(x, training)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(

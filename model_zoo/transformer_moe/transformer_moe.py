@@ -1,6 +1,7 @@
 """Mixture-of-experts causal transformer LM: every block's MLP is a
-top-k-routed expert bank (router_top_k: 1 = Switch, 2 = GShard) sharded over the ``ep`` mesh axis
-(parallel/moe.py) — the family that makes ``ep`` a true expert axis.
+top-k-routed expert bank (router_top_k: 1 = Switch, 2 = GShard)
+sharded over the ``ep`` mesh axis (parallel/moe.py) — the family that
+makes ``ep`` a true expert axis.
 
 Attention reuses transformer_lm's CausalSelfAttention (flash/ring/TP
 annotations in one place). Training-mode outputs are a dict

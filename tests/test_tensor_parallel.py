@@ -111,6 +111,30 @@ def test_compiled_step_contains_tp_collectives():
     assert "all-reduce" not in hlo_off
 
 
+def test_tp_fused_head_matches_plain():
+    """fused_head's chunked cross-entropy must compose with the
+    tp-sharded (vocab-split) head kernel: same losses as the plain-head
+    tp trainer from the same init."""
+    batch = _batch()
+    mesh = mesh_lib.build_mesh({"tp": 8})
+
+    plain = _trainer(mesh)
+    p_state = plain.init_state(batch)
+    fused = _trainer(mesh_lib.build_mesh({"tp": 8}),
+                     extra={"fused_head": True})
+    f_state = fused.init_state(batch)
+
+    for _ in range(2):
+        p_state, lp = plain.train_step(p_state, batch)
+        f_state, lf = fused.train_step(f_state, batch)
+        np.testing.assert_allclose(float(lf), float(lp), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_state.params),
+                    jax.tree.leaves(f_state.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
 def test_tp_loss_matches_single_device():
     """The tp=8 compiled step computes the same loss and updates as the
     single-device model from the same init."""

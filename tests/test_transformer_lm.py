@@ -102,6 +102,61 @@ def test_single_device_vs_ulysses_same_step():
         )
 
 
+def test_rope_relative_position_property():
+    """Rotated q.k must depend only on relative distance: shifting every
+    position by a constant leaves all attention scores unchanged."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops.attention import apply_rope
+
+    rs = np.random.RandomState(9)
+    q = jnp.asarray(rs.randn(2, 2, 8, 16).astype(np.float32))
+    k = jnp.asarray(rs.randn(2, 2, 8, 16).astype(np.float32))
+    pos = jnp.arange(8)
+    s0 = jnp.einsum(
+        "bhqd,bhkd->bhqk", apply_rope(q, pos), apply_rope(k, pos)
+    )
+    s_shift = jnp.einsum(
+        "bhqd,bhkd->bhqk",
+        apply_rope(q, pos + 100), apply_rope(k, pos + 100),
+    )
+    np.testing.assert_allclose(
+        np.asarray(s0), np.asarray(s_shift), rtol=1e-4, atol=1e-4
+    )
+    # norm-preserving rotation
+    np.testing.assert_allclose(
+        np.asarray(jnp.linalg.norm(apply_rope(q, pos), axis=-1)),
+        np.asarray(jnp.linalg.norm(q, axis=-1)),
+        rtol=1e-5,
+    )
+
+
+def test_rope_model_trains_without_wpe():
+    params = PARAMS + "; pos_emb='rope'"
+    spec = load_model_spec_from_module(zoo)
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(spec, mesh=mesh, model_params=params)
+    batch = _batch(seed=5)
+    state = trainer.init_state(batch)
+    assert "wpe" not in state.params, list(state.params)
+    first = None
+    for _ in range(15):
+        state, loss = trainer.train_step(state, batch)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first, (first, float(loss))
+
+    # sp mesh parity: rope positions are global under the ring shards
+    mesh8 = mesh_lib.build_mesh({"dp": 2, "sp": 4})
+    t8 = Trainer(spec, mesh=mesh8, model_params=params)
+    s8 = t8.init_state(batch)
+    s1 = Trainer(spec, mesh=mesh, model_params=params)
+    st1 = s1.init_state(batch)
+    st1, l1 = s1.train_step(st1, batch)
+    s8, l8 = t8.train_step(s8, batch)
+    np.testing.assert_allclose(float(l1), float(l8), rtol=1e-3)
+
+
 def test_training_reduces_loss_on_ring_mesh():
     spec = load_model_spec_from_module(zoo)
     mesh = mesh_lib.build_mesh({"sp": 8})
