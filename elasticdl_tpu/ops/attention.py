@@ -43,28 +43,38 @@ def softmax_finalize(o, l):
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
-def naive_attention(q, k, v, causal=False, scale=None):
+def naive_attention(q, k, v, causal=False, scale=None, window=None):
     """Reference softmax(q k^T) v; O(L^2) memory. The test oracle (the
-    flash backward is the Pallas two-pass _flash_backward below)."""
+    flash backward is the Pallas two-pass _flash_backward below).
+    `window` (sliding-window/local attention): query at position p sees
+    keys in (p - window, p] under causal, |p - k| < window otherwise —
+    None means unbounded."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    lq, lk = scores.shape[-2], scores.shape[-1]
+    q_pos = jnp.arange(lq)[:, None]
+    k_pos = jnp.arange(lk)[None, :]
+    mask = jnp.ones((lq, lk), bool)
     if causal:
-        lq, lk = scores.shape[-2], scores.shape[-1]
-        mask = (
-            jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
-        )
-        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        mask &= q_pos >= k_pos
+    if window is not None:
+        mask &= q_pos - k_pos < window
+        if not causal:
+            mask &= k_pos - q_pos < window
+    scores = jnp.where(mask[None, None], scores, _NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
-def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512):
+def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
+                        window=None):
     """Online-softmax attention via lax.scan over key blocks: O(L) memory,
     differentiable, pure jnp (the fallback when the flash kernel can't
     run). Matches naive_attention to float tolerance."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     b, h, lq, d = q.shape
     lk = k.shape[2]
+    _check_window(window, lq, lk)
     block = min(block_size, lk)
     if lk % block:
         # pad keys; padded positions masked below via k_pos >= lk
@@ -82,11 +92,13 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512):
         kb, vb, kb_idx = inputs
         s = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, kb)
         k_pos = kb_idx * block + jnp.arange(block)
-        valid = k_pos < lk
+        valid = jnp.broadcast_to((k_pos < lk)[None, :], (lq, block))
         if causal:
-            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
-        else:
-            valid = jnp.broadcast_to(valid[None, :], (lq, block))
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        if window is not None:
+            valid = valid & (q_pos[:, None] - k_pos[None, :] < window)
+            if not causal:
+                valid = valid & (k_pos[None, :] - q_pos[:, None] < window)
         s = jnp.where(valid[None, None], s, _NEG_INF)
         return softmax_merge(o, l, m, s, vb), None
 
@@ -103,6 +115,23 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512):
         ),
     )
     return softmax_finalize(o, l)
+
+
+def _check_window(window, lq, lk):
+    """Sliding-window attention is defined for square self-attention
+    only: with lq != lk a window can leave query rows with NO visible
+    key, whose softmax is undefined (the jnp paths would emit mean(v),
+    the kernel 0). Square shapes + window >= 1 guarantee the diagonal
+    is always visible, so every row has at least one key."""
+    if window is None:
+        return
+    if window < 1:
+        raise ValueError("window must be >= 1, got %r" % (window,))
+    if lq != lk:
+        raise ValueError(
+            "sliding-window attention requires square self-attention "
+            "(lq == lk), got lq=%d lk=%d" % (lq, lk)
+        )
 
 
 def apply_rope(x, positions, theta=10000.0):
@@ -138,25 +167,49 @@ def _dims(contract_a, contract_b):
     return (((contract_a,), (contract_b,)), ((), ()))
 
 
-def _causal_run(qi, ki, block_q, block_k):
+def _block_run(qi, ki, block_q, block_k, causal, window):
     """Whether query block qi overlaps key block ki under the causal
-    mask (the block-skip invariant shared by forward and both backward
-    kernels: any q position >= the block's first k position)."""
-    return qi * block_q + block_q - 1 >= ki * block_k
+    and/or sliding-window mask — the block-skip invariant shared by the
+    forward and both backward kernels. Causal: some q position >= the
+    block's first k position. Window: some k position inside the newest
+    window of some q position (last k pos > first q pos - window)."""
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+    if window is not None:
+        # newest k in block inside some q's lookback window
+        back = ki * block_k + block_k - 1 > qi * block_q - window
+        run = jnp.logical_and(run, back) if causal else back
+        if not causal:
+            # oldest k in block inside some q's lookahead window
+            fwd = qi * block_q + block_q - 1 > ki * block_k - window
+            run = jnp.logical_and(run, fwd)
+    return run
 
 
-def _causal_mask(s, qi, ki, block_q, block_k):
+def _block_mask(s, qi, ki, block_q, block_k, causal, window):
+    if not causal and window is None:
+        return s
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    keep = True
+    if causal:
+        keep = q_pos >= k_pos
+    if window is not None:
+        in_w = q_pos - k_pos < window
+        keep = jnp.logical_and(keep, in_w) if causal else in_w
+        if not causal:
+            keep = jnp.logical_and(keep, k_pos - q_pos < window)
+    return jnp.where(keep, s, _NEG_INF)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                  acc_scr, *, scale, causal, block_q, block_k, n_k):
+                  acc_scr, *, scale, causal, window, block_q, block_k,
+                  n_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -166,8 +219,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: skip key blocks that lie entirely after this query block
-    run = _causal_run(qi, ki, block_q, block_k) if causal else True
+    # skip key blocks fully outside the causal/window mask
+    run = _block_run(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _():
@@ -176,8 +229,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             q, k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+        s = _block_mask(s, qi, ki, block_q, block_k, causal, window)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -191,10 +243,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
     @pl.when(ki == n_k - 1)
     def _():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        # logsumexp residual for the backward kernels: exp(s - lse) == P
-        lse_ref[0] = m_scr[:] + jnp.log(l)
+        l = l_scr[:]
+        o_ref[0] = (
+            acc_scr[:] / jnp.maximum(l, 1e-30)
+        ).astype(o_ref.dtype)
+        # logsumexp residual for the backward kernels: exp(s - lse) == P.
+        # Defense in depth: a fully-skipped row (l == 0; unreachable for
+        # the square shapes _check_window enforces) gets a +inf-class
+        # sentinel so the backward's exp(-1e30 - lse) underflows to 0
+        # instead of exploding.
+        lse_ref[0] = jnp.where(
+            l > 0.0,
+            m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)),
+            -_NEG_INF,
+        )
 
 
 def _outer_spec(block, d):
@@ -224,7 +286,7 @@ def _mosaic_params():
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
-                   with_residuals=False):
+                   window=None, with_residuals=False):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     bh = b * h
@@ -237,6 +299,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         _flash_kernel,
         scale=scale,
         causal=causal,
+        window=window,
         block_q=block_q,
         block_k=block_k,
         n_k=n_k,
@@ -273,8 +336,8 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, scale, causal, block_q,
-                         block_k, n_k):
+                         dq_ref, dq_scr, *, scale, causal, window,
+                         block_q, block_k, n_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -282,7 +345,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = _causal_run(qi, ki, block_q, block_k) if causal else True
+    run = _block_run(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _():
@@ -290,8 +353,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+        s = _block_mask(s, qi, ki, block_q, block_k, causal, window)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], dimension_numbers=_dims(1, 1),
@@ -310,7 +372,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                          scale, causal, block_q, block_k, n_q):
+                          scale, causal, window, block_q, block_k, n_q):
     ki = pl.program_id(1)  # key block is the outer (parallel) dim here
     qi = pl.program_id(2)
 
@@ -319,7 +381,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = _causal_run(qi, ki, block_q, block_k) if causal else True
+    run = _block_run(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _():
@@ -327,8 +389,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+        s = _block_mask(s, qi, ki, block_q, block_k, causal, window)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
         # dV_j += P^T dO ; dP = dO V^T ; dS = P*(dP - D) ; dK_j += dS^T Q
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
@@ -352,7 +413,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                    block_k, interpret):
+                    block_k, interpret, window=None):
     """Two-pass flash backward: a dq kernel parallel over query blocks
     and a dk/dv kernel parallel over key blocks, both recomputing P from
     the saved logsumexp (the standard flash-attention backward; one
@@ -379,7 +440,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, n_k=n_k,
+            window=window, block_q=block_q, block_k=block_k, n_k=n_k,
         ),
         grid=(bh, n_q, n_k),
         in_specs=[
@@ -399,7 +460,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, n_q=n_q,
+            window=window, block_q=block_q, block_k=block_k, n_q=n_q,
         ),
         grid=(bh, n_k, n_q),
         in_specs=[
@@ -426,37 +487,44 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, window):
     return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+                          interpret, window=window)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               window):
     out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              interpret, with_residuals=True)
+                              interpret, window=window,
+                              with_residuals=True)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
+               g):
     q, k, v, out, lse = res
     return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                           block_k, interpret)
+                           block_k, interpret, window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+                    block_k=128, interpret=None, window=None):
     """Tiled online-softmax attention (Pallas). head_dim is zero-padded
     to the 128-lane width (zeros don't change q·k or add output columns
     that survive the final slice); falls back to blockwise_attention when
-    Pallas is disabled or the sequence doesn't tile into the blocks."""
+    Pallas is disabled or the sequence doesn't tile into the blocks.
+    `window`: sliding-window/local attention (see naive_attention) — the
+    block-skip predicate prunes out-of-window key blocks, so compute
+    scales with window, not sequence."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
+    _check_window(window, lq, lk)
     tiles = (
         lq % block_q == 0 and lk % block_k == 0
         and block_q % 8 == 0 and block_k % 8 == 0
@@ -468,12 +536,14 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                 "does not tile into (%d, %d) blocks",
                 lq, lk, block_q, block_k,
             )
-        return blockwise_attention(q, k, v, causal=causal, scale=scale)
+        return blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                   window=window)
     if d % 128:
         pad = 128 - d % 128
         widths = ((0, 0), (0, 0), (0, 0), (0, pad))
         q = jnp.pad(q, widths)
         k = jnp.pad(k, widths)
         v = jnp.pad(v, widths)
-    out = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    out = _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+                 window)
     return out[..., :d]

@@ -58,6 +58,7 @@ class CausalSelfAttention(nn.Module):
     tp_shard: bool = True
     causal: bool = True
     use_rope: bool = False  # rotary q/k (global positions; sp-safe)
+    window: int = 0  # sliding-window size; 0 = full attention
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -76,8 +77,14 @@ class CausalSelfAttention(nn.Module):
             pos = jnp.arange(l)
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
+        window = self.window or None
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:
+            if window is not None:
+                raise NotImplementedError(
+                    "sliding-window attention is single-shard only; "
+                    "drop the sp axis or the window"
+                )
             if self.sp_impl == "ulysses":
                 out = ulysses_attention(
                     q, k, v, mesh, causal=self.causal,
@@ -91,9 +98,13 @@ class CausalSelfAttention(nn.Module):
                     % (self.sp_impl,)
                 )
         elif self.attn_impl == "xla":
-            out = blockwise_attention(q, k, v, causal=self.causal)
+            out = blockwise_attention(
+                q, k, v, causal=self.causal, window=window
+            )
         else:
-            out = flash_attention(q, k, v, causal=self.causal)
+            out = flash_attention(
+                q, k, v, causal=self.causal, window=window
+            )
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
         return nn.Dense(
             e, use_bias=False, dtype=self.dtype, name="proj",
@@ -114,6 +125,7 @@ class Block(nn.Module):
     tp_shard: bool = True
     causal: bool = True
     use_rope: bool = False
+    window: int = 0
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -123,7 +135,7 @@ class Block(nn.Module):
             self.num_heads, self.head_dim, dtype=self.dtype,
             attn_impl=self.attn_impl, sp_impl=self.sp_impl,
             tp_shard=self.tp_shard, causal=self.causal,
-            use_rope=self.use_rope, name="attn",
+            use_rope=self.use_rope, window=self.window, name="attn",
         )(y, training)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
@@ -181,6 +193,7 @@ class TransformerLM(nn.Module):
     attn_impl: str = "auto"
     sp_impl: str = "ring"  # sequence-parallel scheme: "ring" | "ulysses"
     pos_emb: str = "learned"  # "learned" wpe table | "rope" rotary q/k
+    attn_window: int = 0  # sliding-window attention; 0 = full
     tp_shard: bool = True  # annotate kernels over the tp mesh axis
     fused_head: bool = False  # stream the LM head inside the loss
 
@@ -207,7 +220,8 @@ class TransformerLM(nn.Module):
                 self.num_heads, head_dim, dtype=self.dtype,
                 attn_impl=self.attn_impl, sp_impl=self.sp_impl,
                 tp_shard=self.tp_shard,
-                use_rope=self.pos_emb == "rope", name="block_%d" % i,
+                use_rope=self.pos_emb == "rope",
+                window=self.attn_window, name="block_%d" % i,
             )(x, training)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(
