@@ -1,0 +1,89 @@
+"""Autoregressive decoding for the sequence model families.
+
+The reference's inference story is batch prediction (PREDICTION tasks →
+`Worker._predict_only`); for the net-new LM families this adds the
+sequence counterpart: a jit-compiled greedy/temperature decode loop.
+One `lax.fori_loop` runs on device — the full forward is recomputed per
+step (O(n) forwards of the compiled model; correct and simple — a KV
+cache is a layout optimization this API can adopt without changing its
+contract), and the causal mask guarantees positions >= i never
+influence the token sampled at i.
+
+Works with any zoo model following the sequence convention
+(features {"tokens": int32 [b, L]} -> logits [b, L, vocab]).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def autoregressive_generate(trainer, state, prompt, max_new_tokens,
+                            temperature=0.0, seed=0):
+    """Generate continuations of `prompt` with the trained model.
+
+    trainer: Trainer whose model maps {"tokens": [b, L]} -> [b, L, V]
+             logits (L = the model's static sequence length).
+    state:   TrainState from the trainer.
+    prompt:  int32 [b, p] with 1 <= p, p + max_new_tokens <= L.
+    temperature: 0.0 = greedy argmax; > 0 = categorical sampling.
+    Returns int32 [b, p + max_new_tokens].
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    model = trainer.model
+    seq_len = getattr(model, "seq_len", None)
+    if seq_len is None:
+        raise ValueError(
+            "model %r has no seq_len attribute; autoregressive_generate "
+            "needs the sequence-family convention" % type(model).__name__
+        )
+    total = p + int(max_new_tokens)
+    if max_new_tokens < 1 or p < 1 or total > seq_len:
+        raise ValueError(
+            "need prompt length >= 1 and max_new_tokens >= 1 with "
+            "prompt %d + new %d <= the model's seq_len %d"
+            % (p, max_new_tokens, seq_len)
+        )
+
+    # one compiled decode per (batch, prompt-len, total, temperature) —
+    # cached on the trainer so repeated calls don't retrace, and
+    # variables ride as arguments so params aren't baked into the
+    # compiled program as constants
+    cache = trainer.__dict__.setdefault("_generate_cache", {})
+    key = (b, p, total, float(temperature))
+    decode_fn = cache.get(key)
+    if decode_fn is None:
+        def decode(variables, tokens, rng):
+            def body(i, carry):
+                tokens, rng = carry
+                logits = model.apply(
+                    variables, {"tokens": tokens}, training=False
+                )
+                # logits at position i-1 predict token i
+                step_logits = jax.lax.dynamic_slice_in_dim(
+                    logits, i - 1, 1, axis=1
+                )[:, 0]  # [b, V]
+                if temperature > 0.0:
+                    rng, sub = jax.random.split(rng)
+                    nxt = jax.random.categorical(
+                        sub, step_logits / temperature, axis=-1
+                    )
+                else:
+                    nxt = jnp.argmax(step_logits, axis=-1)
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, nxt.astype(jnp.int32)[:, None], (0, i)
+                )
+                return tokens, rng
+
+            tokens, _ = jax.lax.fori_loop(p, total, body, (tokens, rng))
+            return tokens
+
+        decode_fn = jax.jit(decode)
+        cache[key] = decode_fn
+
+    variables = {"params": state.params, **state.model_state}
+    buf = jnp.zeros((b, seq_len), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    with trainer.mesh:
+        out = decode_fn(variables, buf, jax.random.PRNGKey(seed))
+    return out[:, :total]

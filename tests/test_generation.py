@@ -1,0 +1,77 @@
+"""Autoregressive decoding: shape/contract checks and an end-to-end
+learn-a-pattern test (train a tiny LM on a deterministic cycle, greedy
+decode must reproduce it)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from elasticdl_tpu.api.generation import autoregressive_generate
+from elasticdl_tpu.common.model_utils import load_model_spec_from_module
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.training.trainer import Trainer
+from model_zoo.transformer_lm import transformer_lm as zoo
+
+PARAMS = (
+    "vocab_size=8; seq_len=16; embed_dim=32; num_heads=2; num_layers=1"
+)
+
+
+def _trainer():
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    return Trainer(
+        load_model_spec_from_module(zoo), mesh=mesh, model_params=PARAMS
+    )
+
+
+def _cycle_batch(bsz=8, seq_len=16, vocab=8, seed=0):
+    rs = np.random.RandomState(seed)
+    starts = rs.randint(0, vocab, size=(bsz, 1))
+    tokens = (starts + np.arange(seq_len + 1)[None, :]) % vocab
+    tokens = tokens.astype(np.int32)
+    return {"tokens": tokens[:, :-1]}, tokens[:, 1:]
+
+
+def test_generate_contract():
+    trainer = _trainer()
+    batch = _cycle_batch()
+    state = trainer.init_state(batch)
+    prompt = np.asarray([[1, 2, 3], [4, 5, 6]], np.int32)
+    out = autoregressive_generate(trainer, state, prompt, 5)
+    assert out.shape == (2, 8)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[:, :3], prompt)
+    assert out.min() >= 0 and out.max() < 8
+    # greedy decode is deterministic
+    out2 = np.asarray(autoregressive_generate(trainer, state, prompt, 5))
+    np.testing.assert_array_equal(out, out2)
+    # temperature sampling is seed-deterministic
+    s1 = np.asarray(autoregressive_generate(
+        trainer, state, prompt, 5, temperature=1.0, seed=7))
+    s2 = np.asarray(autoregressive_generate(
+        trainer, state, prompt, 5, temperature=1.0, seed=7))
+    np.testing.assert_array_equal(s1, s2)
+    with pytest.raises(ValueError, match="seq_len"):
+        autoregressive_generate(trainer, state, prompt, 14)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        autoregressive_generate(trainer, state, prompt, -6)
+    # repeated calls reuse the cached compiled decode
+    assert len(trainer._generate_cache) == 2  # greedy + temperature
+
+
+def test_generate_learned_cycle():
+    """Train on the deterministic next = (tok + 1) % vocab cycle; greedy
+    decode must continue the cycle from any prompt."""
+    trainer = _trainer()
+    state = trainer.init_state(_cycle_batch())
+    for step in range(200):
+        batch = _cycle_batch(seed=step)
+        state, loss = trainer.train_step(state, batch)
+    assert float(loss) < 0.1, float(loss)
+    prompt = np.asarray([[3, 4, 5, 6]], np.int32)
+    out = np.asarray(
+        autoregressive_generate(trainer, state, prompt, 8)
+    )[0]
+    want = (3 + np.arange(12)) % 8
+    np.testing.assert_array_equal(out, want)
