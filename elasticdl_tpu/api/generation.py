@@ -37,6 +37,13 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
             "model %r has no seq_len attribute; autoregressive_generate "
             "needs the sequence-family convention" % type(model).__name__
         )
+    if not getattr(model, "causal", True):
+        # e.g. the BERT encoder: bidirectional attention would let every
+        # decode step see the zero-padded future positions
+        raise ValueError(
+            "model %r is not causal; autoregressive decoding needs a "
+            "causal (left-to-right) model" % type(model).__name__
+        )
     total = p + int(max_new_tokens)
     if max_new_tokens < 1 or p < 1 or total > seq_len:
         raise ValueError(
@@ -45,15 +52,15 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
             % (p, max_new_tokens, seq_len)
         )
 
-    # one compiled decode per (batch, prompt-len, total, temperature) —
-    # cached on the trainer so repeated calls don't retrace, and
-    # variables ride as arguments so params aren't baked into the
-    # compiled program as constants
+    # One compiled decode per (batch, sampling-mode) — the loop bounds
+    # ride as traced scalars (lax.fori_loop accepts them under jit), so
+    # every prompt/continuation length reuses the same executable.
+    # Variables ride as arguments so params aren't baked in as constants.
     cache = trainer.__dict__.setdefault("_generate_cache", {})
-    key = (b, p, total, float(temperature))
+    key = (b, temperature > 0.0, float(temperature))
     decode_fn = cache.get(key)
     if decode_fn is None:
-        def decode(variables, tokens, rng):
+        def decode(variables, tokens, rng, start, stop):
             def body(i, carry):
                 tokens, rng = carry
                 logits = model.apply(
@@ -75,7 +82,9 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
                 )
                 return tokens, rng
 
-            tokens, _ = jax.lax.fori_loop(p, total, body, (tokens, rng))
+            tokens, _ = jax.lax.fori_loop(
+                start, stop, body, (tokens, rng)
+            )
             return tokens
 
         decode_fn = jax.jit(decode)
@@ -85,5 +94,8 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
     buf = jnp.zeros((b, seq_len), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
     with trainer.mesh:
-        out = decode_fn(variables, buf, jax.random.PRNGKey(seed))
+        out = decode_fn(
+            variables, buf, jax.random.PRNGKey(seed),
+            jnp.asarray(p, jnp.int32), jnp.asarray(total, jnp.int32),
+        )
     return out[:, :total]

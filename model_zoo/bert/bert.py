@@ -39,6 +39,8 @@ MASK_PROB = 0.15
 
 
 class BertEncoder(nn.Module):
+    # bidirectional encoder: api/generation.py refuses to decode it
+    causal: bool = False
     vocab_size: int = 256  # DATA vocabulary; [MASK] gets one extra row
     seq_len: int = 128
     embed_dim: int = 128

@@ -56,8 +56,32 @@ def test_generate_contract():
         autoregressive_generate(trainer, state, prompt, 14)
     with pytest.raises(ValueError, match="max_new_tokens"):
         autoregressive_generate(trainer, state, prompt, -6)
-    # repeated calls reuse the cached compiled decode
+    # one executable per (batch, sampling mode): varied prompt lengths
+    # and token counts reuse it (loop bounds are traced scalars)
+    out3 = np.asarray(
+        autoregressive_generate(trainer, state, prompt[:, :2], 7)
+    )
+    assert out3.shape == (2, 9)
     assert len(trainer._generate_cache) == 2  # greedy + temperature
+
+    # a bidirectional model must be refused
+    from model_zoo.bert import bert as bert_zoo
+
+    t_bert = Trainer(
+        load_model_spec_from_module(bert_zoo),
+        mesh=mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        model_params=(
+            "vocab_size=8; seq_len=16; embed_dim=32; num_heads=2; "
+            "num_layers=1"
+        ),
+    )
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 8, size=(2, 16)).astype(np.int32)
+    b_state = t_bert.init_state(
+        ({"tokens": toks}, {"ids": toks, "mask": np.ones_like(toks)})
+    )
+    with pytest.raises(ValueError, match="causal"):
+        autoregressive_generate(t_bert, b_state, prompt, 5)
 
 
 def test_generate_learned_cycle():

@@ -83,6 +83,64 @@ def test_flash_attention_grad_compiled():
         )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_sliding_window_compiled(causal):
+    """Windowed flash fwd + two-pass Pallas bwd compiled on hardware,
+    with a window smaller than a block (block-skip predicate active)."""
+    rng = np.random.default_rng(5)
+    b, h, seq, d = 1, 2, 256, 128
+    q = jnp.asarray(_rand(rng, b, h, seq, d))
+    k = jnp.asarray(_rand(rng, b, h, seq, d))
+    v = jnp.asarray(_rand(rng, b, h, seq, d))
+    w = 48
+
+    def loss_flash(q, k, v):
+        return (attention.flash_attention(
+            q, k, v, causal=causal, window=w, interpret=False
+        ) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (attention.naive_attention(
+            q, k, v, causal=causal, window=w
+        ) ** 2).sum()
+
+    out = attention.flash_attention(q, k, v, causal=causal, window=w,
+                                    interpret=False)
+    oracle = attention.naive_attention(q, k, v, causal=causal, window=w)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(oracle), atol=2e-2, rtol=2e-2
+    )
+    grads = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    refs = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, r in zip(grads, refs):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), atol=5e-2, rtol=0
+        )
+
+
+def test_rope_flash_compiled():
+    """RoPE'd q/k through the compiled flash kernel vs the fp32 oracle."""
+    rng = np.random.default_rng(6)
+    b, h, seq, d = 1, 2, 256, 128
+    q = jnp.asarray(_rand(rng, b, h, seq, d), jnp.bfloat16)
+    k = jnp.asarray(_rand(rng, b, h, seq, d), jnp.bfloat16)
+    v = jnp.asarray(_rand(rng, b, h, seq, d), jnp.bfloat16)
+    pos = jnp.arange(seq)
+    qr = attention.apply_rope(q, pos)
+    kr = attention.apply_rope(k, pos)
+    out = attention.flash_attention(qr, kr, v, causal=True,
+                                    interpret=False)
+    oracle = attention.naive_attention(
+        attention.apply_rope(q.astype(jnp.float32), pos),
+        attention.apply_rope(k.astype(jnp.float32), pos),
+        v.astype(jnp.float32), causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(oracle),
+        atol=2e-2, rtol=2e-2,
+    )
+
+
 # ------------------------------------------------- dense optimizer kernels
 
 
