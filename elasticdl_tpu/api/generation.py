@@ -17,8 +17,24 @@ import jax
 import jax.numpy as jnp
 
 
+def _next_token(step_logits, rng, position, temperature):
+    """Sample/argmax the token for `position`. The RNG key is derived by
+    fold_in(rng, position), NOT by sequentially splitting a stream, so
+    the full-forward and KV-cached paths produce identical samples for
+    the same (seed, temperature) regardless of how many model steps each
+    runs."""
+    if temperature > 0.0:
+        sub = jax.random.fold_in(rng, position)
+        nxt = jax.random.categorical(
+            sub, step_logits / temperature, axis=-1
+        )
+    else:
+        nxt = jnp.argmax(step_logits, axis=-1)
+    return nxt.astype(jnp.int32)
+
+
 def autoregressive_generate(trainer, state, prompt, max_new_tokens,
-                            temperature=0.0, seed=0):
+                            temperature=0.0, seed=0, use_cache=False):
     """Generate continuations of `prompt` with the trained model.
 
     trainer: Trainer whose model maps {"tokens": [b, L]} -> [b, L, V]
@@ -26,6 +42,14 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
     state:   TrainState from the trainer.
     prompt:  int32 [b, p] with 1 <= p, p + max_new_tokens <= L.
     temperature: 0.0 = greedy argmax; > 0 = categorical sampling.
+    use_cache: decode through the model's KV cache (decode=True path,
+             one single-token step per position: O(L) attention per
+             token instead of a full-sequence forward). Requires the
+             model to support decode mode (the transformer_lm family).
+             Greedy decoding matches the full-forward path exactly;
+             temperature sampling uses the same position-derived RNG
+             keys but can diverge where the two paths' logits differ in
+             kernel numerics.
     Returns int32 [b, p + max_new_tokens].
     """
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -50,6 +74,21 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
             "need prompt length >= 1 and max_new_tokens >= 1 with "
             "prompt %d + new %d <= the model's seq_len %d"
             % (p, max_new_tokens, seq_len)
+        )
+
+    if use_cache:
+        import inspect
+
+        if "decode" not in inspect.signature(
+            type(model).__call__
+        ).parameters:
+            raise ValueError(
+                "model %r has no decode mode; use_cache=True needs the "
+                "KV-cache convention (transformer_lm family)"
+                % type(model).__name__
+            )
+        return _kv_generate(
+            trainer, state, prompt, p, total, temperature, seed
         )
 
     # One compiled decode per (batch, sampling-mode) — the loop bounds
@@ -97,5 +136,81 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
         out = decode_fn(
             variables, buf, jax.random.PRNGKey(seed),
             jnp.asarray(p, jnp.int32), jnp.asarray(total, jnp.int32),
+        )
+    return out[:, :total]
+
+
+def _kv_generate(trainer, state, prompt, p, total, temperature, seed):
+    """KV-cached decode: one single-token model step per position.
+
+    The first p-1 steps are the prefill (the known prompt token is kept,
+    the model step only populates the per-layer caches); from there each
+    step's logits pick the next token. One lax.scan, compiled once per
+    (batch, total, sampling mode).
+    """
+    model = trainer.model
+    b = prompt.shape[0]
+    seq_len = model.seq_len
+
+    cache = trainer.__dict__.setdefault("_generate_cache", {})
+    key = ("kv", b, total, float(temperature))
+    fn = cache.get(key)
+    if fn is None:
+        # cache buffers: structure from an eval_shape'd decode init (no
+        # real params are materialized); depends only on the batch size,
+        # so it is cached separately from the compiled decodes
+        kv_shapes = cache.get(("kv_shapes", b))
+        if kv_shapes is None:
+            def init_shapes():
+                return model.init(
+                    jax.random.PRNGKey(0),
+                    {"tokens": jnp.zeros((b, 1), jnp.int32)},
+                    training=False, decode=True,
+                )
+
+            kv_shapes = jax.eval_shape(init_shapes)["cache"]
+            cache[("kv_shapes", b)] = kv_shapes
+
+        def run(variables, tokens, rng, p_len):
+            kv = jax.tree.map(
+                lambda sh: jnp.zeros(sh.shape, sh.dtype), kv_shapes
+            )
+
+            def step(carry, i):
+                tokens, kv, rng = carry
+                tok = jax.lax.dynamic_slice(tokens, (0, i), (b, 1))
+                logits, upd = model.apply(
+                    dict(variables, cache=kv),
+                    {"tokens": tok},
+                    training=False, decode=True, mutable=["cache"],
+                )
+                step_logits = logits[:, 0]  # [b, V]
+                # iteration i writes position i+1
+                nxt = _next_token(step_logits, rng, i + 1, temperature)
+                # keep the known prompt token during prefill
+                prev = jax.lax.dynamic_slice(
+                    tokens, (0, i + 1), (b, 1)
+                )[:, 0]
+                val = jnp.where(i + 1 < p_len, prev, nxt)
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, val.astype(jnp.int32)[:, None], (0, i + 1)
+                )
+                return (tokens, upd["cache"], rng), None
+
+            (tokens, _, _), _ = jax.lax.scan(
+                step, (tokens, kv, rng), jnp.arange(total - 1)
+            )
+            return tokens
+
+        fn = jax.jit(run)
+        cache[key] = fn
+
+    variables = {"params": state.params, **state.model_state}
+    buf = jnp.zeros((b, seq_len), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    with trainer.mesh:
+        out = fn(
+            variables, buf, jax.random.PRNGKey(seed),
+            jnp.asarray(p, jnp.int32),
         )
     return out[:, :total]

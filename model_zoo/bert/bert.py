@@ -39,8 +39,11 @@ MASK_PROB = 0.15
 
 
 class BertEncoder(nn.Module):
-    # bidirectional encoder: api/generation.py refuses to decode it
-    causal: bool = False
+    # bidirectional encoder: api/generation.py refuses to decode it.
+    # Deliberately a plain class attribute (NOT a dataclass field) so
+    # model_params cannot override it out of sync with the hard-coded
+    # causal=False attention below.
+    causal = False
     vocab_size: int = 256  # DATA vocabulary; [MASK] gets one extra row
     seq_len: int = 128
     embed_dim: int = 128

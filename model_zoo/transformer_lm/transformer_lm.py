@@ -12,6 +12,7 @@ tokens[1:]) built in dataset_fn, so seq_len below is the model's input
 length and records carry seq_len + 1 tokens.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -20,6 +21,7 @@ from flax import linen as nn
 from elasticdl_tpu.common.constants import MeshAxis, Mode
 from elasticdl_tpu.data.example_codec import decode_example
 from elasticdl_tpu.ops.attention import (
+    NEG_INF,
     apply_rope,
     blockwise_attention,
     flash_attention,
@@ -59,9 +61,10 @@ class CausalSelfAttention(nn.Module):
     causal: bool = True
     use_rope: bool = False  # rotary q/k (global positions; sp-safe)
     window: int = 0  # sliding-window size; 0 = full attention
+    cache_len: int = 0  # KV-cache capacity for decode mode
 
     @nn.compact
-    def __call__(self, x, training=False):
+    def __call__(self, x, training=False, decode=False):
         b, l, e = x.shape
         h, d = self.num_heads, self.head_dim
         qkv = nn.Dense(
@@ -73,6 +76,8 @@ class CausalSelfAttention(nn.Module):
         )(x)
         qkv = qkv.reshape(b, l, 3, h, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
+        if decode:
+            return self._decode_step(q, k, v, e)
         if self.use_rope:
             pos = jnp.arange(l)
             q = apply_rope(q, pos)
@@ -106,6 +111,9 @@ class CausalSelfAttention(nn.Module):
                 q, k, v, causal=self.causal, window=window
             )
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
+        return self._proj(out, e)
+
+    def _proj(self, out, e):
         return nn.Dense(
             e, use_bias=False, dtype=self.dtype, name="proj",
             kernel_init=(
@@ -113,6 +121,53 @@ class CausalSelfAttention(nn.Module):
                 else nn.initializers.lecun_normal()
             ),
         )(out)
+
+    def _decode_step(self, q, k, v, e):
+        """Single-token decode against the KV cache: q/k/v are
+        [b, h, 1, d]; cached keys/values live in the `cache` collection
+        ([b, h, cache_len, d] + a position index). RoPE rotates q and
+        the cached k at the true absolute position; causal masking is
+        `k_pos <= index`, windowing `k_pos > index - window`."""
+        if not self.causal:
+            raise ValueError("decode mode requires a causal model")
+        if self.cache_len < 1:
+            raise ValueError("decode mode needs cache_len >= 1")
+        b, h, _, d = q.shape
+        dtype = q.dtype
+        ck = self.variable(
+            "cache", "k", jnp.zeros, (b, h, self.cache_len, d), dtype
+        )
+        cv = self.variable(
+            "cache", "v", jnp.zeros, (b, h, self.cache_len, d), dtype
+        )
+        ci = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = ci.value
+        if self.use_rope:
+            pos = jnp.full((1,), idx)
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(dtype), (0, 0, idx, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(dtype), (0, 0, idx, 0)
+        )
+        ci.value = idx + 1
+        scale = d ** -0.5
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q * scale, ck.value
+        ).astype(jnp.float32)  # [b, h, 1, L]
+        k_pos = jnp.arange(self.cache_len)
+        valid = k_pos <= idx
+        if self.window:
+            valid = valid & (k_pos > idx - self.window)
+        s = jnp.where(valid[None, None, None, :], s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1).astype(dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w, cv.value)
+        out = out.transpose(0, 2, 1, 3).reshape(b, 1, h * d)
+        return self._proj(out, e)
 
 
 class Block(nn.Module):
@@ -126,17 +181,19 @@ class Block(nn.Module):
     causal: bool = True
     use_rope: bool = False
     window: int = 0
+    cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x, training=False):
+    def __call__(self, x, training=False, decode=False):
         e = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
             self.num_heads, self.head_dim, dtype=self.dtype,
             attn_impl=self.attn_impl, sp_impl=self.sp_impl,
             tp_shard=self.tp_shard, causal=self.causal,
-            use_rope=self.use_rope, window=self.window, name="attn",
-        )(y, training)
+            use_rope=self.use_rope, window=self.window,
+            cache_len=self.cache_len, name="attn",
+        )(y, training, decode=decode)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
             _tp_dense_init(1) if self.tp_shard
@@ -198,17 +255,25 @@ class TransformerLM(nn.Module):
     fused_head: bool = False  # stream the LM head inside the loss
 
     @nn.compact
-    def __call__(self, features, training=False):
-        tokens = features["tokens"]  # int32 [b, seq_len]
+    def __call__(self, features, training=False, decode=False):
+        tokens = features["tokens"]  # [b, seq_len]; [b, 1] when decode
         x = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype, name="wte"
         )(tokens)
         if self.pos_emb == "learned":
-            pos = nn.Embed(
+            wpe = nn.Embed(
                 self.seq_len, self.embed_dim, dtype=self.dtype,
                 name="wpe",
-            )(jnp.arange(tokens.shape[1])[None, :])
-            x = x + pos
+            )
+            if decode:
+                # single-token step: position = own cache counter
+                pi = self.variable(
+                    "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+                )
+                x = x + wpe(pi.value[None, None])
+                pi.value = pi.value + 1
+            else:
+                x = x + wpe(jnp.arange(tokens.shape[1])[None, :])
         elif self.pos_emb != "rope":
             raise ValueError(
                 "Unknown pos_emb %r (valid: 'learned', 'rope')"
@@ -221,8 +286,9 @@ class TransformerLM(nn.Module):
                 attn_impl=self.attn_impl, sp_impl=self.sp_impl,
                 tp_shard=self.tp_shard,
                 use_rope=self.pos_emb == "rope",
-                window=self.attn_window, name="block_%d" % i,
-            )(x, training)
+                window=self.attn_window,
+                cache_len=self.seq_len, name="block_%d" % i,
+            )(x, training, decode=decode)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(
             self.vocab_size, dtype=self.dtype, name="head",

@@ -82,6 +82,66 @@ def test_generate_contract():
     )
     with pytest.raises(ValueError, match="causal"):
         autoregressive_generate(t_bert, b_state, prompt, 5)
+    # a causal model without decode support must be refused for
+    # use_cache, not crash inside tracing
+    from model_zoo.transformer_moe import transformer_moe as moe_zoo
+
+    t_moe = Trainer(
+        load_model_spec_from_module(moe_zoo),
+        mesh=mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        model_params=(
+            "vocab_size=8; seq_len=16; embed_dim=32; num_heads=2; "
+            "num_layers=1; num_experts=2; attn_impl='xla'"
+        ),
+    )
+    m_state = t_moe.init_state(_cycle_batch())
+    with pytest.raises(ValueError, match="decode"):
+        autoregressive_generate(t_moe, m_state, prompt, 5,
+                                use_cache=True)
+
+
+def test_kv_cache_matches_full_forward():
+    """The KV-cached decode must produce the SAME tokens as the
+    full-forward decode, for plain, RoPE and windowed configs."""
+    for extra in ("", "; pos_emb='rope'", "; attn_window=4"):
+        mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+        trainer = Trainer(
+            load_model_spec_from_module(zoo), mesh=mesh,
+            model_params=PARAMS + extra,
+        )
+        state = trainer.init_state(_cycle_batch())
+        for step in range(30):
+            state, _ = trainer.train_step(state, _cycle_batch(seed=step))
+        prompt = np.asarray([[2, 3, 4], [5, 6, 7]], np.int32)
+        full = np.asarray(
+            autoregressive_generate(trainer, state, prompt, 6)
+        )
+        kv = np.asarray(
+            autoregressive_generate(
+                trainer, state, prompt, 6, use_cache=True
+            )
+        )
+        np.testing.assert_array_equal(full, kv, err_msg=extra)
+
+
+def test_sampling_keys_are_position_derived():
+    """Both decode paths key sampling by fold_in(rng, position), so for
+    IDENTICAL logits they draw identical tokens — the streams cannot
+    drift apart from the paths running different numbers of model steps
+    (exact end-to-end sampled parity is still only as exact as the two
+    paths' logits, which differ in kernel numerics)."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.api.generation import _next_token
+
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(2, 8).astype(np.float32))
+    rng = jax.random.PRNGKey(3)
+    a = np.asarray(_next_token(logits, rng, 5, 0.8))
+    b = np.asarray(_next_token(logits, rng, 5, 0.8))
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(_next_token(logits, rng, 6, 0.8))
+    assert a.shape == c.shape  # different position, same contract
 
 
 def test_generate_learned_cycle():
@@ -99,3 +159,9 @@ def test_generate_learned_cycle():
     )[0]
     want = (3 + np.arange(12)) % 8
     np.testing.assert_array_equal(out, want)
+    # the cached decode continues the cycle identically
+    out_kv = np.asarray(
+        autoregressive_generate(trainer, state, prompt, 8,
+                                use_cache=True)
+    )[0]
+    np.testing.assert_array_equal(out_kv, want)
