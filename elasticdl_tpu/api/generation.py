@@ -3,11 +3,12 @@
 The reference's inference story is batch prediction (PREDICTION tasks →
 `Worker._predict_only`); for the net-new LM families this adds the
 sequence counterpart: a jit-compiled greedy/temperature decode loop.
-One `lax.fori_loop` runs on device — the full forward is recomputed per
-step (O(n) forwards of the compiled model; correct and simple — a KV
-cache is a layout optimization this API can adopt without changing its
-contract), and the causal mask guarantees positions >= i never
-influence the token sampled at i.
+Two execution strategies behind one call: the default recomputes the
+full forward per step inside a `lax.fori_loop` (simple, zero model
+requirements beyond the convention), and `use_cache=True` streams
+single-token steps through the model's per-layer KV caches (O(L)
+attention per token). The causal mask guarantees positions >= i never
+influence the token sampled at i in either strategy.
 
 Works with any zoo model following the sequence convention
 (features {"tokens": int32 [b, L]} -> logits [b, L, vocab]).
@@ -96,12 +97,11 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
     # every prompt/continuation length reuses the same executable.
     # Variables ride as arguments so params aren't baked in as constants.
     cache = trainer.__dict__.setdefault("_generate_cache", {})
-    key = (b, temperature > 0.0, float(temperature))
+    key = (b, float(temperature))
     decode_fn = cache.get(key)
     if decode_fn is None:
         def decode(variables, tokens, rng, start, stop):
-            def body(i, carry):
-                tokens, rng = carry
+            def body(i, tokens):
                 logits = model.apply(
                     variables, {"tokens": tokens}, training=False
                 )
@@ -109,22 +109,12 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
                 step_logits = jax.lax.dynamic_slice_in_dim(
                     logits, i - 1, 1, axis=1
                 )[:, 0]  # [b, V]
-                if temperature > 0.0:
-                    rng, sub = jax.random.split(rng)
-                    nxt = jax.random.categorical(
-                        sub, step_logits / temperature, axis=-1
-                    )
-                else:
-                    nxt = jnp.argmax(step_logits, axis=-1)
-                tokens = jax.lax.dynamic_update_slice(
-                    tokens, nxt.astype(jnp.int32)[:, None], (0, i)
+                nxt = _next_token(step_logits, rng, i, temperature)
+                return jax.lax.dynamic_update_slice(
+                    tokens, nxt[:, None], (0, i)
                 )
-                return tokens, rng
 
-            tokens, _ = jax.lax.fori_loop(
-                start, stop, body, (tokens, rng)
-            )
-            return tokens
+            return jax.lax.fori_loop(start, stop, body, tokens)
 
         decode_fn = jax.jit(decode)
         cache[key] = decode_fn
@@ -177,7 +167,7 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed):
             )
 
             def step(carry, i):
-                tokens, kv, rng = carry
+                tokens, kv = carry
                 tok = jax.lax.dynamic_slice(tokens, (0, i), (b, 1))
                 logits, upd = model.apply(
                     dict(variables, cache=kv),
@@ -195,10 +185,10 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed):
                 tokens = jax.lax.dynamic_update_slice(
                     tokens, val.astype(jnp.int32)[:, None], (0, i + 1)
                 )
-                return (tokens, upd["cache"], rng), None
+                return (tokens, upd["cache"]), None
 
-            (tokens, _, _), _ = jax.lax.scan(
-                step, (tokens, kv, rng), jnp.arange(total - 1)
+            (tokens, _), _ = jax.lax.scan(
+                step, (tokens, kv), jnp.arange(total - 1)
             )
             return tokens
 

@@ -64,7 +64,7 @@ class CausalSelfAttention(nn.Module):
     cache_len: int = 0  # KV-cache capacity for decode mode
 
     @nn.compact
-    def __call__(self, x, training=False, decode=False):
+    def __call__(self, x, training=False, decode=False, decode_pos=None):
         b, l, e = x.shape
         h, d = self.num_heads, self.head_dim
         qkv = nn.Dense(
@@ -77,7 +77,7 @@ class CausalSelfAttention(nn.Module):
         qkv = qkv.reshape(b, l, 3, h, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
         if decode:
-            return self._decode_step(q, k, v, e)
+            return self._decode_step(q, k, v, e, decode_pos)
         if self.use_rope:
             pos = jnp.arange(l)
             q = apply_rope(q, pos)
@@ -122,16 +122,20 @@ class CausalSelfAttention(nn.Module):
             ),
         )(out)
 
-    def _decode_step(self, q, k, v, e):
+    def _decode_step(self, q, k, v, e, decode_pos):
         """Single-token decode against the KV cache: q/k/v are
-        [b, h, 1, d]; cached keys/values live in the `cache` collection
-        ([b, h, cache_len, d] + a position index). RoPE rotates q and
-        the cached k at the true absolute position; causal masking is
-        `k_pos <= index`, windowing `k_pos > index - window`."""
+        [b, h, 1, d]; cached keys/values live in the `cache` collection.
+        The absolute position `decode_pos` comes from the model's single
+        cache counter (one source of truth — per-layer counters could
+        only drift apart). RoPE rotates q and the cached k at that
+        position; causal masking is `k_pos <= pos`, windowing
+        `k_pos > pos - window`."""
         if not self.causal:
             raise ValueError("decode mode requires a causal model")
         if self.cache_len < 1:
             raise ValueError("decode mode needs cache_len >= 1")
+        if decode_pos is None:
+            raise ValueError("decode mode needs decode_pos")
         b, h, _, d = q.shape
         dtype = q.dtype
         ck = self.variable(
@@ -140,10 +144,7 @@ class CausalSelfAttention(nn.Module):
         cv = self.variable(
             "cache", "v", jnp.zeros, (b, h, self.cache_len, d), dtype
         )
-        ci = self.variable(
-            "cache", "index", lambda: jnp.zeros((), jnp.int32)
-        )
-        idx = ci.value
+        idx = decode_pos
         if self.use_rope:
             pos = jnp.full((1,), idx)
             q = apply_rope(q, pos)
@@ -154,7 +155,6 @@ class CausalSelfAttention(nn.Module):
         cv.value = jax.lax.dynamic_update_slice(
             cv.value, v.astype(dtype), (0, 0, idx, 0)
         )
-        ci.value = idx + 1
         scale = d ** -0.5
         s = jnp.einsum(
             "bhqd,bhkd->bhqk", q * scale, ck.value
@@ -184,7 +184,7 @@ class Block(nn.Module):
     cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x, training=False, decode=False):
+    def __call__(self, x, training=False, decode=False, decode_pos=None):
         e = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
@@ -193,7 +193,7 @@ class Block(nn.Module):
             tp_shard=self.tp_shard, causal=self.causal,
             use_rope=self.use_rope, window=self.window,
             cache_len=self.cache_len, name="attn",
-        )(y, training, decode=decode)
+        )(y, training, decode=decode, decode_pos=decode_pos)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
             _tp_dense_init(1) if self.tp_shard
@@ -260,18 +260,22 @@ class TransformerLM(nn.Module):
         x = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype, name="wte"
         )(tokens)
+        decode_pos = None
+        if decode:
+            # THE decode position counter: every layer's cache write,
+            # RoPE rotation and the wpe lookup read this one value
+            pi = self.variable(
+                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+            )
+            decode_pos = pi.value
+            pi.value = decode_pos + 1
         if self.pos_emb == "learned":
             wpe = nn.Embed(
                 self.seq_len, self.embed_dim, dtype=self.dtype,
                 name="wpe",
             )
             if decode:
-                # single-token step: position = own cache counter
-                pi = self.variable(
-                    "cache", "pos", lambda: jnp.zeros((), jnp.int32)
-                )
-                x = x + wpe(pi.value[None, None])
-                pi.value = pi.value + 1
+                x = x + wpe(decode_pos[None, None])
             else:
                 x = x + wpe(jnp.arange(tokens.shape[1])[None, :])
         elif self.pos_emb != "rope":
@@ -288,7 +292,7 @@ class TransformerLM(nn.Module):
                 use_rope=self.pos_emb == "rope",
                 window=self.attn_window,
                 cache_len=self.seq_len, name="block_%d" % i,
-            )(x, training, decode=decode)
+            )(x, training, decode=decode, decode_pos=decode_pos)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(
             self.vocab_size, dtype=self.dtype, name="head",

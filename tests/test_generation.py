@@ -125,11 +125,10 @@ def test_kv_cache_matches_full_forward():
 
 
 def test_sampling_keys_are_position_derived():
-    """Both decode paths key sampling by fold_in(rng, position), so for
-    IDENTICAL logits they draw identical tokens — the streams cannot
-    drift apart from the paths running different numbers of model steps
-    (exact end-to-end sampled parity is still only as exact as the two
-    paths' logits, which differ in kernel numerics)."""
+    """_next_token derives its key from (rng, position) only: same
+    inputs reproduce the draw, and the position changes the key (checked
+    across many positions — at temperature 5 over 8 classes, identical
+    draws at every position would mean the position is ignored)."""
     import jax.numpy as jnp
 
     from elasticdl_tpu.api.generation import _next_token
@@ -137,11 +136,14 @@ def test_sampling_keys_are_position_derived():
     rs = np.random.RandomState(0)
     logits = jnp.asarray(rs.randn(2, 8).astype(np.float32))
     rng = jax.random.PRNGKey(3)
-    a = np.asarray(_next_token(logits, rng, 5, 0.8))
-    b = np.asarray(_next_token(logits, rng, 5, 0.8))
+    a = np.asarray(_next_token(logits, rng, 5, 5.0))
+    b = np.asarray(_next_token(logits, rng, 5, 5.0))
     np.testing.assert_array_equal(a, b)
-    c = np.asarray(_next_token(logits, rng, 6, 0.8))
-    assert a.shape == c.shape  # different position, same contract
+    draws = [
+        tuple(np.asarray(_next_token(logits, rng, i, 5.0)))
+        for i in range(16)
+    ]
+    assert len(set(draws)) > 1, "position does not affect the draw"
 
 
 def test_generate_learned_cycle():
@@ -165,3 +167,12 @@ def test_generate_learned_cycle():
                                 use_cache=True)
     )[0]
     np.testing.assert_array_equal(out_kv, want)
+    # sampled decode: both paths key the draw by fold_in(rng, position);
+    # on this sharply-trained model (decisive logit margins) the kv and
+    # full paths must sample identical tokens
+    st = np.asarray(autoregressive_generate(
+        trainer, state, prompt, 8, temperature=0.7, seed=11))
+    skv = np.asarray(autoregressive_generate(
+        trainer, state, prompt, 8, temperature=0.7, seed=11,
+        use_cache=True))
+    np.testing.assert_array_equal(st, skv)
