@@ -103,6 +103,26 @@ def transformer_flops_per_step(batch, seq, d_model, n_layers, vocab):
     return 3 * fwd
 
 
+def _measure_steps(trainer, state, batch, iters, warmup):
+    """Timed compiled-step loop with fetch-forced sync (see
+    common/timing_utils.fetch_sync; block_until_ready can return early
+    over tunneled PJRT plugins). Returns (step_time_s, last_loss)."""
+    import numpy as np
+
+    from elasticdl_tpu.common.timing_utils import fetch_sync
+
+    for _ in range(warmup):
+        state, loss = trainer.train_step(state, batch)
+    fetch_sync(state.params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = trainer.train_step(state, batch)
+    fetch_sync(state.params)
+    dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(float(loss)), "non-finite loss in bench"
+    return dt, float(loss)
+
+
 def run_transformer_bench(on_tpu):
     import jax
     import numpy as np
@@ -149,29 +169,12 @@ def run_transformer_bench(on_tpu):
     # host->device transfers behind the step).
     batch = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
 
-    from elasticdl_tpu.common.timing_utils import fetch_sync
-
-    def sync(state):
-        # fetch-forced sync: see fetch_sync (block_until_ready can
-        # return early over tunneled PJRT plugins). For the flagship
-        # step both methods agree (~315 ms cross-checked).
-        return fetch_sync(state.params)
-
-    for _ in range(warmup):
-        state, loss = trainer.train_step(state, batch)
-    sync(state)
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = trainer.train_step(state, batch)
-    sync(state)
-    dt = time.perf_counter() - t0
-    assert np.isfinite(float(loss)), "non-finite loss in bench"
+    step_time, loss = _measure_steps(trainer, state, batch, iters,
+                                     warmup)
 
     n_chips = max(1, len(jax.devices()))
     dev = jax.devices()[0]
-    step_time = dt / iters
-    tokens_per_sec = batch_size * cfg["seq_len"] * iters / dt
+    tokens_per_sec = batch_size * cfg["seq_len"] / step_time
     flops = transformer_flops_per_step(
         batch_size, cfg["seq_len"], cfg["embed_dim"], cfg["num_layers"],
         cfg["vocab_size"],
@@ -210,7 +213,7 @@ def run_transformer_bench(on_tpu):
         "vs_baseline": vs_baseline,
         "mfu": mfu,
         "samples_per_sec_per_chip": round(
-            batch_size * iters / dt / n_chips, 2),
+            batch_size / step_time / n_chips, 2),
         "step_time_ms": round(step_time * 1e3, 2),
         "platform": platform,
         "device_kind": getattr(dev, "device_kind", "") or platform,
@@ -220,7 +223,114 @@ def run_transformer_bench(on_tpu):
     }
 
 
+def _run_zoo_bench(zoo, batch, iters, warmup, model_params=""):
+    """Shared setup + measurement for the secondary benches: spec ->
+    mesh -> Trainer -> init -> pre-staged batch -> timed steps. Returns
+    (step_time_s, n_chips, device, platform)."""
+    import jax
+
+    from elasticdl_tpu.common.model_utils import load_model_spec_from_module
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+    from elasticdl_tpu.training.trainer import Trainer
+
+    spec = load_model_spec_from_module(zoo)
+    mesh = mesh_lib.build_mesh()
+    trainer = Trainer(spec, mesh=mesh, model_params=model_params)
+    state = trainer.init_state(batch)
+    batch = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
+    step_time, _ = _measure_steps(trainer, state, batch, iters, warmup)
+    dev = jax.devices()[0]
+    return (step_time, max(1, len(jax.devices())), dev,
+            jax.default_backend())
+
+
+def run_resnet50_bench(on_tpu):
+    """BASELINE.md secondary target: ResNet-50 images/sec (train)."""
+    import numpy as np
+
+    from model_zoo.imagenet_resnet50 import imagenet_resnet50 as zoo
+
+    if on_tpu:
+        batch_size, size, iters, warmup = 64, 224, 20, 3
+    else:
+        batch_size, size, iters, warmup = 4, 64, 3, 1
+
+    rng = np.random.RandomState(0)
+    batch = (
+        {"image": rng.rand(batch_size, size, size, 3).astype(np.float32)},
+        rng.randint(1000, size=(batch_size, 1)).astype(np.int32),
+    )
+    step_time, n_chips, dev, platform = _run_zoo_bench(
+        zoo, batch, iters, warmup
+    )
+    # ResNet-50 fwd ~4.1 GFLOP per 224x224 image; bwd = 2x fwd
+    flops = 3 * batch_size * 4.1e9 * (size / 224.0) ** 2
+    mfu = None if platform == "cpu" else round(
+        flops / step_time / (_peak_flops(
+            getattr(dev, "device_kind", "")) * n_chips), 4)
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(batch_size / step_time / n_chips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+        "mfu": mfu,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "") or platform,
+        "batch_size": batch_size,
+        "image_size": size,
+    }
+
+
+def run_deepfm_bench(on_tpu):
+    """BASELINE.md primary recsys target: DeepFM samples/sec (frappe
+    schema; embedding + FM + DNN). MFU is not reported — the model is
+    lookup/bandwidth-bound, not matmul-bound."""
+    import numpy as np
+
+    from model_zoo.deepfm_functional_api import deepfm_functional_api as zoo
+
+    if on_tpu:
+        batch_size, iters, warmup = 8192, 30, 5
+    else:
+        batch_size, iters, warmup = 256, 5, 1
+
+    rng = np.random.RandomState(0)
+    batch = (
+        {"feature": rng.randint(
+            5383, size=(batch_size, 10)).astype(np.int32)},
+        rng.randint(2, size=(batch_size,)).astype(np.int32),
+    )
+    step_time, n_chips, dev, platform = _run_zoo_bench(
+        zoo, batch, iters, warmup
+    )
+    return {
+        "metric": "deepfm_train_samples_per_sec_per_chip",
+        "value": round(batch_size / step_time / n_chips, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": 1.0,
+        "mfu": None,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "") or platform,
+        "batch_size": batch_size,
+    }
+
+
+_BENCHES = {
+    "transformer": run_transformer_bench,
+    "resnet50": run_resnet50_bench,
+    "deepfm": run_deepfm_bench,
+}
+
+
 def main():
+    model_name = os.environ.get("EDL_BENCH_MODEL", "transformer")
+    if model_name not in _BENCHES:
+        sys.exit(
+            "bench: unknown EDL_BENCH_MODEL %r (valid: %s)"
+            % (model_name, ", ".join(sorted(_BENCHES)))
+        )
     probe_timeout = float(os.environ.get("EDL_BENCH_PROBE_TIMEOUT", "300"))
     backend, kind = probe_accelerator(probe_timeout)
     on_tpu = backend is not None
@@ -235,8 +345,11 @@ def main():
         sys.stderr.write("bench: accelerator ready: %s (%s)\n"
                          % (backend, kind))
 
+    # the driver always runs the default (transformer) flagship; the
+    # secondary BASELINE.md targets run via EDL_BENCH_MODEL=resnet50|deepfm
+    bench_fn = _BENCHES[model_name]
     try:
-        result = run_transformer_bench(on_tpu)
+        result = bench_fn(on_tpu)
     except Exception as e:  # noqa: BLE001
         if not on_tpu:
             raise
@@ -246,7 +359,7 @@ def main():
         sys.stderr.write("bench: TPU run failed (%r); retrying with "
                          "Pallas disabled\n" % (e,))
         os.environ["ELASTICDL_TPU_DISABLE_PALLAS"] = "1"
-        result = run_transformer_bench(on_tpu)
+        result = bench_fn(on_tpu)
         result["pallas_disabled"] = True
 
     print(json.dumps(result))
