@@ -124,12 +124,8 @@ def _measure_steps(trainer, state, batch, iters, warmup):
 
 
 def run_transformer_bench(on_tpu):
-    import jax
     import numpy as np
 
-    from elasticdl_tpu.common.model_utils import load_model_spec_from_module
-    from elasticdl_tpu.parallel import mesh as mesh_lib
-    from elasticdl_tpu.training.trainer import Trainer
     from model_zoo.transformer_lm import transformer_lm as zoo
 
     if on_tpu:
@@ -153,40 +149,24 @@ def run_transformer_bench(on_tpu):
         params["dtype"] = "bf16"
     model_params = format_params_str(params)
 
-    spec = load_model_spec_from_module(zoo)
-    mesh = mesh_lib.build_mesh()  # all available chips, dp-filled
-    trainer = Trainer(spec, mesh=mesh, model_params=model_params)
-
     rng = np.random.RandomState(0)
     tokens = rng.randint(
         0, cfg["vocab_size"], size=(batch_size, cfg["seq_len"] + 1)
     ).astype(np.int32)
     batch = ({"tokens": tokens[:, :-1]}, tokens[:, 1:])
-
-    state = trainer.init_state(batch)
-    # Pre-stage the batch in HBM with the batch sharding: the benchmark
-    # measures the compiled step (a real input pipeline double-buffers
-    # host->device transfers behind the step).
-    batch = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
-
-    step_time, loss = _measure_steps(trainer, state, batch, iters,
-                                     warmup)
-
-    n_chips = max(1, len(jax.devices()))
-    dev = jax.devices()[0]
+    step_time, n_chips, dev, platform, n_params = _run_zoo_bench(
+        zoo, batch, iters, warmup, model_params=model_params
+    )
     tokens_per_sec = batch_size * cfg["seq_len"] / step_time
     flops = transformer_flops_per_step(
         batch_size, cfg["seq_len"], cfg["embed_dim"], cfg["num_layers"],
         cfg["vocab_size"],
     )
-    platform = jax.default_backend()
     if platform == "cpu":
         mfu = None
     else:
         mfu = round(flops / step_time / (_peak_flops(
             getattr(dev, "device_kind", "")) * n_chips), 4)
-    n_params = sum(int(np.prod(x.shape))
-                   for x in jax.tree.leaves(state.params))
     # vs_baseline: ratio to the committed hardware baseline
     # (BENCH_BASELINE.json, the best prior measured TPU number for the
     # same config). Only meaningful for same-platform, same-config runs;
@@ -224,10 +204,13 @@ def run_transformer_bench(on_tpu):
 
 
 def _run_zoo_bench(zoo, batch, iters, warmup, model_params=""):
-    """Shared setup + measurement for the secondary benches: spec ->
-    mesh -> Trainer -> init -> pre-staged batch -> timed steps. Returns
-    (step_time_s, n_chips, device, platform)."""
+    """Shared setup + measurement for every bench target: spec -> mesh
+    -> Trainer -> init -> pre-staged batch (the benchmark measures the
+    compiled step; a real input pipeline double-buffers host->device
+    transfers behind it) -> timed steps. Returns
+    (step_time_s, n_chips, device, platform, n_params)."""
     import jax
+    import numpy as np
 
     from elasticdl_tpu.common.model_utils import load_model_spec_from_module
     from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -237,11 +220,14 @@ def _run_zoo_bench(zoo, batch, iters, warmup, model_params=""):
     mesh = mesh_lib.build_mesh()
     trainer = Trainer(spec, mesh=mesh, model_params=model_params)
     state = trainer.init_state(batch)
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(state.params)
+    )
     batch = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
     step_time, _ = _measure_steps(trainer, state, batch, iters, warmup)
     dev = jax.devices()[0]
     return (step_time, max(1, len(jax.devices())), dev,
-            jax.default_backend())
+            jax.default_backend(), n_params)
 
 
 def run_resnet50_bench(on_tpu):
@@ -260,7 +246,7 @@ def run_resnet50_bench(on_tpu):
         {"image": rng.rand(batch_size, size, size, 3).astype(np.float32)},
         rng.randint(1000, size=(batch_size, 1)).astype(np.int32),
     )
-    step_time, n_chips, dev, platform = _run_zoo_bench(
+    step_time, n_chips, dev, platform, _ = _run_zoo_bench(
         zoo, batch, iters, warmup
     )
     # ResNet-50 fwd ~4.1 GFLOP per 224x224 image; bwd = 2x fwd
@@ -298,10 +284,10 @@ def run_deepfm_bench(on_tpu):
     rng = np.random.RandomState(0)
     batch = (
         {"feature": rng.randint(
-            5383, size=(batch_size, 10)).astype(np.int32)},
+            zoo.INPUT_DIM, size=(batch_size, 10)).astype(np.int32)},
         rng.randint(2, size=(batch_size,)).astype(np.int32),
     )
-    step_time, n_chips, dev, platform = _run_zoo_bench(
+    step_time, n_chips, dev, platform, _ = _run_zoo_bench(
         zoo, batch, iters, warmup
     )
     return {

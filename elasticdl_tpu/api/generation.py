@@ -18,31 +18,59 @@ import jax
 import jax.numpy as jnp
 
 
-def _next_token(step_logits, rng, position, temperature):
+def _filter_logits(logits, top_k, top_p):
+    """Standard sampling filters, static-shape: top-k keeps the k
+    highest logits per row; nucleus (top-p) keeps the smallest set of
+    tokens whose cumulative probability reaches p (always at least the
+    argmax). Filtered entries drop to -inf before the categorical."""
+    neg = jnp.asarray(-jnp.inf, logits.dtype)
+    if top_k and top_k > 0:
+        k = min(int(top_k), logits.shape[-1])  # clamp to the vocab
+        kth = jnp.sort(logits, axis=-1)[..., -k, None]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        # keep while the mass BEFORE the token is < p (first always kept)
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        thr = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thr, neg, logits)
+    return logits
+
+
+def _next_token(step_logits, rng, position, temperature, top_k=0,
+                top_p=1.0):
     """Sample/argmax the token for `position`. The RNG key is derived by
     fold_in(rng, position), NOT by sequentially splitting a stream, so
     the full-forward and KV-cached paths produce identical samples for
     the same (seed, temperature) regardless of how many model steps each
     runs."""
     if temperature > 0.0:
+        # temperature first, filters on the ACTUAL sampling
+        # distribution (the conventional top-p semantics)
+        scaled = step_logits / temperature
+        scaled = _filter_logits(scaled, top_k, top_p)
         sub = jax.random.fold_in(rng, position)
-        nxt = jax.random.categorical(
-            sub, step_logits / temperature, axis=-1
-        )
+        nxt = jax.random.categorical(sub, scaled, axis=-1)
     else:
         nxt = jnp.argmax(step_logits, axis=-1)
     return nxt.astype(jnp.int32)
 
 
 def autoregressive_generate(trainer, state, prompt, max_new_tokens,
-                            temperature=0.0, seed=0, use_cache=False):
+                            temperature=0.0, seed=0, use_cache=False,
+                            top_k=0, top_p=1.0):
     """Generate continuations of `prompt` with the trained model.
 
     trainer: Trainer whose model maps {"tokens": [b, L]} -> [b, L, V]
              logits (L = the model's static sequence length).
     state:   TrainState from the trainer.
     prompt:  int32 [b, p] with 1 <= p, p + max_new_tokens <= L.
-    temperature: 0.0 = greedy argmax; > 0 = categorical sampling.
+    temperature: 0.0 = greedy argmax; > 0 = categorical sampling,
+             optionally filtered by top_k (keep k highest logits) and/or
+             top_p (nucleus: smallest set reaching cumulative prob p).
     use_cache: decode through the model's KV cache (decode=True path,
              one single-token step per position: O(L) attention per
              token instead of a full-sequence forward). Requires the
@@ -69,6 +97,10 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
             "model %r is not causal; autoregressive decoding needs a "
             "causal (left-to-right) model" % type(model).__name__
         )
+    if temperature <= 0.0:
+        # greedy ignores the filters; normalize them out of the compile
+        # cache keys so greedy configs share one executable
+        top_k, top_p = 0, 1.0
     total = p + int(max_new_tokens)
     if max_new_tokens < 1 or p < 1 or total > seq_len:
         raise ValueError(
@@ -89,7 +121,8 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
                 % type(model).__name__
             )
         return _kv_generate(
-            trainer, state, prompt, p, total, temperature, seed
+            trainer, state, prompt, p, total, temperature, seed,
+            top_k, top_p,
         )
 
     # One compiled decode per (batch, sampling-mode) — the loop bounds
@@ -97,7 +130,7 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
     # every prompt/continuation length reuses the same executable.
     # Variables ride as arguments so params aren't baked in as constants.
     cache = trainer.__dict__.setdefault("_generate_cache", {})
-    key = (b, float(temperature))
+    key = (b, float(temperature), int(top_k), float(top_p))
     decode_fn = cache.get(key)
     if decode_fn is None:
         def decode(variables, tokens, rng, start, stop):
@@ -109,7 +142,8 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
                 step_logits = jax.lax.dynamic_slice_in_dim(
                     logits, i - 1, 1, axis=1
                 )[:, 0]  # [b, V]
-                nxt = _next_token(step_logits, rng, i, temperature)
+                nxt = _next_token(step_logits, rng, i, temperature,
+                                  top_k, top_p)
                 return jax.lax.dynamic_update_slice(
                     tokens, nxt[:, None], (0, i)
                 )
@@ -130,7 +164,8 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
     return out[:, :total]
 
 
-def _kv_generate(trainer, state, prompt, p, total, temperature, seed):
+def _kv_generate(trainer, state, prompt, p, total, temperature, seed,
+                 top_k=0, top_p=1.0):
     """KV-cached decode: one single-token model step per position.
 
     The first p-1 steps are the prefill (the known prompt token is kept,
@@ -143,7 +178,8 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed):
     seq_len = model.seq_len
 
     cache = trainer.__dict__.setdefault("_generate_cache", {})
-    key = ("kv", b, total, float(temperature))
+    key = ("kv", b, total, float(temperature), int(top_k),
+           float(top_p))
     fn = cache.get(key)
     if fn is None:
         # cache buffers: structure from an eval_shape'd decode init (no
@@ -176,7 +212,8 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed):
                 )
                 step_logits = logits[:, 0]  # [b, V]
                 # iteration i writes position i+1
-                nxt = _next_token(step_logits, rng, i + 1, temperature)
+                nxt = _next_token(step_logits, rng, i + 1, temperature,
+                                  top_k, top_p)
                 # keep the known prompt token during prefill
                 prev = jax.lax.dynamic_slice(
                     tokens, (0, i + 1), (b, 1)

@@ -56,7 +56,10 @@ class DeepFMModel(nn.Module):
         return {"logits": logits, "probs": probs}
 
 
-def custom_model(input_dim=5383, embedding_dim=64, input_length=10,
+INPUT_DIM = 5383  # frappe vocabulary (reference dataset_fn)
+
+
+def custom_model(input_dim=INPUT_DIM, embedding_dim=64, input_length=10,
                  fc_unit=64):
     return DeepFMModel(
         input_dim=input_dim,

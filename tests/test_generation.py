@@ -146,6 +146,52 @@ def test_sampling_keys_are_position_derived():
     assert len(set(draws)) > 1, "position does not affect the draw"
 
 
+def test_topk_topp_filters():
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.api.generation import _filter_logits, _next_token
+
+    logits = jnp.asarray(
+        [[3.0, 2.0, 1.0, 0.0, -1.0], [0.0, 5.0, 4.0, -2.0, 1.0]]
+    )
+    # top_k=2: exactly the two highest survive per row
+    f = np.asarray(_filter_logits(logits, top_k=2, top_p=1.0))
+    assert np.isfinite(f).sum(axis=1).tolist() == [2, 2]
+    assert np.isfinite(f[0, [0, 1]]).all() and np.isfinite(
+        f[1, [1, 2]]
+    ).all()
+    # tiny top_p: only the argmax survives
+    f = np.asarray(_filter_logits(logits, top_k=0, top_p=1e-6))
+    assert np.isfinite(f).sum(axis=1).tolist() == [1, 1]
+    # top_p=1.0 keeps everything
+    f = np.asarray(_filter_logits(logits, top_k=0, top_p=1.0))
+    assert np.isfinite(f).all()
+    # sampling with top_k=1 is greedy at any temperature
+    rng = jax.random.PRNGKey(0)
+    for pos in range(8):
+        nxt = np.asarray(
+            _next_token(logits, rng, pos, temperature=3.0, top_k=1)
+        )
+        np.testing.assert_array_equal(nxt, [0, 1])
+    # top_k=3 draws stay inside the top-3 set
+    for pos in range(32):
+        nxt = np.asarray(
+            _next_token(logits, rng, pos, temperature=3.0, top_k=3)
+        )
+        assert nxt[0] in (0, 1, 2) and nxt[1] in (1, 2, 4)
+
+
+def test_generate_topk_end_to_end():
+    trainer = _trainer()
+    state = trainer.init_state(_cycle_batch())
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    out = np.asarray(autoregressive_generate(
+        trainer, state, prompt, 5, temperature=1.0, top_k=2, top_p=0.9
+    ))
+    assert out.shape == (1, 8)
+    assert out.min() >= 0 and out.max() < 8
+
+
 def test_generate_learned_cycle():
     """Train on the deterministic next = (tok + 1) % vocab cycle; greedy
     decode must continue the cycle from any prompt."""
