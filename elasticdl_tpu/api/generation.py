@@ -97,6 +97,13 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
             "model %r is not causal; autoregressive decoding needs a "
             "causal (left-to-right) model" % type(model).__name__
         )
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            "top_p must be in (0, 1], got %r (top_p -> 0 keeps nothing; "
+            "use temperature=0 for greedy)" % (top_p,)
+        )
+    if top_k < 0:
+        raise ValueError("top_k must be >= 0, got %r" % (top_k,))
     if temperature <= 0.0:
         # greedy ignores the filters; normalize them out of the compile
         # cache keys so greedy configs share one executable
@@ -239,5 +246,99 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed,
         out = fn(
             variables, buf, jax.random.PRNGKey(seed),
             jnp.asarray(p, jnp.int32),
+        )
+    return out[:, :total]
+
+
+def beam_search_generate(trainer, state, prompt, max_new_tokens,
+                         num_beams=4):
+    """Beam-search decoding (full-forward strategy): keeps the
+    `num_beams` highest-log-probability continuations per batch row and
+    returns the best one. Deterministic; beams ride as extra batch rows
+    so the compiled model is the same one the greedy path uses.
+
+    Initial beam scores are [0, -inf, ...], which both deduplicates the
+    first expansion (all beams start as copies of the prompt) and keeps
+    every tensor static-shape. Returns int32 [b, p + max_new_tokens].
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    model = trainer.model
+    seq_len = getattr(model, "seq_len", None)
+    if seq_len is None or not getattr(model, "causal", True):
+        raise ValueError(
+            "beam search needs a causal sequence-family model"
+        )
+    total = p + int(max_new_tokens)
+    if max_new_tokens < 1 or p < 1 or total > seq_len:
+        raise ValueError(
+            "need prompt length >= 1 and max_new_tokens >= 1 with "
+            "prompt %d + new %d <= the model's seq_len %d"
+            % (p, max_new_tokens, seq_len)
+        )
+    k = int(num_beams)
+    vocab = getattr(model, "vocab_size", None)
+    if k < 1 or (vocab is not None and k > vocab):
+        raise ValueError(
+            "num_beams must be in [1, vocab_size], got %d" % k
+        )
+
+    cache = trainer.__dict__.setdefault("_generate_cache", {})
+    key = ("beam", b, k)
+    fn = cache.get(key)
+    if fn is None:
+        def run(variables, tokens, start, stop):
+            # tokens [b, k, L]; scores [b, k]
+            neg = jnp.asarray(-jnp.inf, jnp.float32)
+            scores = jnp.where(
+                jnp.arange(k)[None, :] == 0, 0.0, neg
+            ) * jnp.ones((b, 1), jnp.float32)
+
+            def body(i, carry):
+                tokens, scores = carry
+                logits = model.apply(
+                    variables,
+                    {"tokens": tokens.reshape(b * k, -1)},
+                    training=False,
+                )
+                step = jax.nn.log_softmax(
+                    jax.lax.dynamic_slice_in_dim(
+                        logits, i - 1, 1, axis=1
+                    )[:, 0].reshape(b, k, -1).astype(jnp.float32),
+                    axis=-1,
+                )  # [b, k, V]
+                cand = scores[:, :, None] + step
+                v = cand.shape[-1]
+                vals, idx = jax.lax.top_k(cand.reshape(b, k * v), k)
+                beam_src = idx // v  # [b, k]
+                tok = (idx % v).astype(jnp.int32)
+                tokens = jnp.take_along_axis(
+                    tokens, beam_src[:, :, None], axis=1
+                )
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, tok[..., None], (0, 0, i)
+                )
+                return tokens, vals
+
+            tokens, scores = jax.lax.fori_loop(
+                start, stop, body, (tokens, scores)
+            )
+            best = jnp.argmax(scores, axis=-1)  # [b]
+            return jnp.take_along_axis(
+                tokens, best[:, None, None], axis=1
+            )[:, 0], scores
+
+        fn = jax.jit(run)
+        cache[key] = fn
+
+    variables = {"params": state.params, **state.model_state}
+    buf = jnp.zeros((b, k, seq_len), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(
+        buf, jnp.broadcast_to(prompt[:, None, :], (b, k, p)), (0, 0, 0)
+    )
+    with trainer.mesh:
+        out, _ = fn(
+            variables, buf,
+            jnp.asarray(p, jnp.int32), jnp.asarray(total, jnp.int32),
         )
     return out[:, :total]

@@ -23,9 +23,11 @@ from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.data.example_codec import decode_example
 from elasticdl_tpu.training.metrics import AUC
 
+INPUT_DIM = 5383  # frappe vocabulary (reference dataset_fn)
+
 
 class DeepFMModel(nn.Module):
-    input_dim: int = 5383
+    input_dim: int = INPUT_DIM
     embedding_dim: int = 64
     input_length: int = 10
     fc_unit: int = 64
@@ -54,9 +56,6 @@ class DeepFMModel(nn.Module):
         logits = fm_output + deep
         probs = jnp.reshape(nn.sigmoid(logits), (-1, 1))
         return {"logits": logits, "probs": probs}
-
-
-INPUT_DIM = 5383  # frappe vocabulary (reference dataset_fn)
 
 
 def custom_model(input_dim=INPUT_DIM, embedding_dim=64, input_length=10,

@@ -181,6 +181,20 @@ def test_topk_topp_filters():
         assert nxt[0] in (0, 1, 2) and nxt[1] in (1, 2, 4)
 
 
+def test_topp_range_validated():
+    import pytest
+
+    trainer = _trainer()
+    state = trainer.init_state(_cycle_batch())
+    prompt = np.asarray([[1, 2]], np.int32)
+    with pytest.raises(ValueError, match="top_p"):
+        autoregressive_generate(trainer, state, prompt, 3,
+                                temperature=1.0, top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        autoregressive_generate(trainer, state, prompt, 3,
+                                temperature=1.0, top_k=-2)
+
+
 def test_generate_topk_end_to_end():
     trainer = _trainer()
     state = trainer.init_state(_cycle_batch())
@@ -190,6 +204,35 @@ def test_generate_topk_end_to_end():
     ))
     assert out.shape == (1, 8)
     assert out.min() >= 0 and out.max() < 8
+
+
+def test_beam_search():
+    from elasticdl_tpu.api.generation import beam_search_generate
+
+    trainer = _trainer()
+    state = trainer.init_state(_cycle_batch())
+    # beams=1 must equal greedy decoding exactly (untrained model)
+    prompt = np.asarray([[1, 2, 3], [4, 5, 6]], np.int32)
+    greedy = np.asarray(autoregressive_generate(trainer, state, prompt, 5))
+    beam1 = np.asarray(
+        beam_search_generate(trainer, state, prompt, 5, num_beams=1)
+    )
+    np.testing.assert_array_equal(greedy, beam1)
+    import pytest
+
+    with pytest.raises(ValueError, match="num_beams"):
+        beam_search_generate(trainer, state, prompt, 5, num_beams=9)
+
+    # trained cycle model: every beam width finds the cycle
+    for step in range(200):
+        state, loss = trainer.train_step(state, _cycle_batch(seed=step))
+    assert float(loss) < 0.1
+    out = np.asarray(
+        beam_search_generate(trainer, state,
+                             np.asarray([[3, 4, 5, 6]], np.int32), 8,
+                             num_beams=3)
+    )[0]
+    np.testing.assert_array_equal(out, (3 + np.arange(12)) % 8)
 
 
 def test_generate_learned_cycle():
