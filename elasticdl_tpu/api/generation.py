@@ -2,13 +2,15 @@
 
 The reference's inference story is batch prediction (PREDICTION tasks →
 `Worker._predict_only`); for the net-new LM families this adds the
-sequence counterpart: a jit-compiled greedy/temperature decode loop.
-Two execution strategies behind one call: the default recomputes the
-full forward per step inside a `lax.fori_loop` (simple, zero model
-requirements beyond the convention), and `use_cache=True` streams
-single-token steps through the model's per-layer KV caches (O(L)
-attention per token). The causal mask guarantees positions >= i never
-influence the token sampled at i in either strategy.
+sequence counterpart: jit-compiled decoding with greedy argmax,
+temperature sampling (top-k / nucleus filtered), and beam search
+(`beam_search_generate`). Two execution strategies behind
+`autoregressive_generate`: the default recomputes the full forward per
+step inside a `lax.fori_loop` (simple, zero model requirements beyond
+the convention), and `use_cache=True` streams single-token steps
+through the model's per-layer KV caches (O(L) attention per token).
+The causal mask guarantees positions >= i never influence the token
+sampled at i in either strategy.
 
 Works with any zoo model following the sequence convention
 (features {"tokens": int32 [b, L]} -> logits [b, L, vocab]).
