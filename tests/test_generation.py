@@ -235,6 +235,33 @@ def test_beam_search():
     np.testing.assert_array_equal(out, (3 + np.arange(12)) % 8)
 
 
+def test_generate_on_sharded_mesh():
+    """Decoding composes with dp*fsdp-sharded trainer state: same greedy
+    tokens as the single-device trainer from the same seed. Trained
+    first so argmax margins are decisive (cross-mesh reduction order can
+    differ by ULPs; an untrained 8-way vocab has near-ties)."""
+    t1 = _trainer()
+    s1 = t1.init_state(_cycle_batch())
+
+    mesh8 = mesh_lib.build_mesh({"dp": 4, "fsdp": 2})
+    t8 = Trainer(load_model_spec_from_module(zoo), mesh=mesh8,
+                 model_params=PARAMS)
+    s8 = t8.init_state(_cycle_batch())
+    for step in range(30):
+        batch = _cycle_batch(seed=step)
+        s1, _ = t1.train_step(s1, batch)
+        s8, _ = t8.train_step(s8, batch)
+
+    prompt = np.asarray([[1, 2, 3], [4, 5, 6]], np.int32)
+    out1 = np.asarray(autoregressive_generate(t1, s1, prompt, 5))
+    out8 = np.asarray(autoregressive_generate(t8, s8, prompt, 5))
+    np.testing.assert_array_equal(out1, out8)
+    kv8 = np.asarray(
+        autoregressive_generate(t8, s8, prompt, 5, use_cache=True)
+    )
+    np.testing.assert_array_equal(out1, kv8)
+
+
 def test_generate_learned_cycle():
     """Train on the deterministic next = (tok + 1) % vocab cycle; greedy
     decode must continue the cycle from any prompt."""
