@@ -40,17 +40,38 @@ import jax, jax.numpy as jnp
 x = jnp.ones((128, 128), jnp.bfloat16)
 (x @ x).block_until_ready()
 d = jax.devices()[0]
-print("PROBE_OK|%s|%s" % (jax.default_backend(),
-                          getattr(d, "device_kind", "") or ""))
+# jax_platforms distinguishes "host has no TPU plugin at all" from "the
+# plugin is configured but its init failed and jax fell back to CPU"
+# (the axon sitecustomize force-sets jax_platforms="axon,cpu").
+platforms = getattr(jax.config, "jax_platforms", "") or ""
+print("PROBE_OK|%s|%s|%s" % (jax.default_backend(),
+                             getattr(d, "device_kind", "") or "",
+                             platforms))
 """
 
 
-def probe_accelerator(timeout_s):
-    """Try to initialize the ambient (TPU) backend in a child process.
+def _env_float(value, env_key, default, floor):
+    """Explicit value, else env var (malformed values warn and fall back
+    to the default — the bench's rc=0 contract forbids crashing on bad
+    config), floored to keep the retry loop sane."""
+    if value is None:
+        raw = os.environ.get(env_key, "")
+        try:
+            value = float(raw) if raw else default
+        except ValueError:
+            sys.stderr.write("bench: ignoring bad %s=%r\n" % (env_key, raw))
+            value = default
+    return max(float(value), floor)
 
-    Returns (backend, device_kind) on success with a non-CPU backend,
-    else (None, None). The child is killed on timeout, so a hung PJRT
-    tunnel cannot hang the bench itself.
+
+def _probe_once(timeout_s):
+    """One bounded child-process attempt at TPU backend init.
+
+    Returns (status, backend, device_kind): status "ok" with a live
+    non-CPU backend, "cpu_only" when the probe definitively found only a
+    CPU backend (no point retrying), or "fail" on crash/timeout (worth
+    retrying — the tunnel flaps). The child is killed on timeout, so a
+    hung PJRT tunnel cannot hang the bench itself.
     """
     try:
         r = subprocess.run(
@@ -61,23 +82,78 @@ def probe_accelerator(timeout_s):
             cwd=REPO,
         )
     except subprocess.TimeoutExpired:
-        sys.stderr.write("bench: accelerator probe timed out after %ss\n"
-                         % timeout_s)
-        return None, None
+        sys.stderr.write("bench: accelerator probe attempt timed out "
+                         "after %.0fs\n" % timeout_s)
+        return "fail", None, None
     except Exception as e:  # noqa: BLE001
         sys.stderr.write("bench: accelerator probe error: %r\n" % (e,))
-        return None, None
+        return "fail", None, None
     for line in (r.stdout or "").splitlines():
         if line.startswith("PROBE_OK|"):
-            _, backend, kind = line.split("|", 2)
+            parts = line.split("|", 3)
+            backend, kind = parts[1], parts[2]
+            platforms = parts[3] if len(parts) > 3 else ""
             if backend != "cpu":
-                return backend, kind
+                return "ok", backend, kind
+            non_cpu_configured = any(
+                p.strip() and p.strip() != "cpu"
+                for p in platforms.split(","))
+            if non_cpu_configured:
+                # A TPU plugin is configured but init fell back to CPU:
+                # that's the flapping tunnel, not a CPU-only host.
+                sys.stderr.write(
+                    "bench: probe fell back to CPU (platforms=%r); "
+                    "retrying\n" % platforms)
+                return "fail", None, None
             sys.stderr.write("bench: probe found only CPU backend\n")
-            return None, None
+            return "cpu_only", None, None
     tail = (r.stderr or "")[-2000:]
-    sys.stderr.write("bench: accelerator probe failed (rc=%s):\n%s\n"
+    sys.stderr.write("bench: accelerator probe attempt failed (rc=%s):\n%s\n"
                      % (r.returncode, tail))
-    return None, None
+    return "fail", None, None
+
+
+def probe_accelerator(deadline_s, attempt_s=None, retry_pause_s=None):
+    """Probe the accelerator repeatedly within a total deadline.
+
+    The round-2 failure mode was a single attempt pinned to the full
+    deadline: one wedged tunnel burned all 300 s and the bench fell back
+    to CPU even though the tunnel flaps back within a minute or two. So:
+    short bounded attempts (default 75 s each — healthy init over the
+    tunnel is ~10-40 s), retried until the deadline, with a short pause
+    after fast failures (crash-on-init) so a flapping plugin gets time to
+    come back. When the remaining budget is too small for a pause plus
+    attempt, the pause is skipped so a final short attempt still runs. A
+    definitive CPU-only answer (host has no TPU plugin at all) stops the
+    retries immediately.
+    """
+    attempt_s = _env_float(attempt_s, "EDL_BENCH_PROBE_ATTEMPT", 75.0, 5.0)
+    retry_pause_s = _env_float(retry_pause_s, "EDL_BENCH_PROBE_PAUSE",
+                               10.0, 0.0)
+    deadline = time.monotonic() + deadline_s
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 1.0:
+            sys.stderr.write(
+                "bench: accelerator probe gave up after %d attempts / "
+                "%.0fs deadline\n" % (attempt, deadline_s))
+            return None, None
+        attempt += 1
+        t0 = time.monotonic()
+        status, backend, kind = _probe_once(min(attempt_s, remaining))
+        if status == "ok":
+            return backend, kind
+        if status == "cpu_only":
+            return None, None
+        # Fast failure (crash, not hang): pause so a flapping tunnel can
+        # recover — unless that pause would eat the budget for a last
+        # real attempt, in which case retry immediately.
+        elapsed = time.monotonic() - t0
+        if elapsed < attempt_s - 1.0:
+            budget_after_pause = deadline - time.monotonic() - retry_pause_s
+            if budget_after_pause > 5.0:
+                time.sleep(retry_pause_s)
 
 
 def _peak_flops(device_kind):
@@ -317,7 +393,7 @@ def main():
             "bench: unknown EDL_BENCH_MODEL %r (valid: %s)"
             % (model_name, ", ".join(sorted(_BENCHES)))
         )
-    probe_timeout = float(os.environ.get("EDL_BENCH_PROBE_TIMEOUT", "300"))
+    probe_timeout = _env_float(None, "EDL_BENCH_PROBE_TIMEOUT", 300.0, 0.0)
     backend, kind = probe_accelerator(probe_timeout)
     on_tpu = backend is not None
     if not on_tpu:
