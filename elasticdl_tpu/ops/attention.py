@@ -43,6 +43,17 @@ def softmax_finalize(o, l):
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
+def lse_merge(o, lse, o_i, lse_i):
+    """Merge two NORMALIZED attention partials (o, logsumexp) over the
+    same queries but disjoint key sets — the combine step of ring
+    attention (parallel/context_parallel.py). A fully-masked partial
+    (lse_i == NEG_INF) contributes zero weight. Accumulate in float32."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    w = jnp.exp(lse - lse_new)[..., None]
+    w_i = jnp.exp(lse_i - lse_new)[..., None]
+    return o * w + o_i * w_i, lse_new
+
+
 def naive_attention(q, k, v, causal=False, scale=None, window=None):
     """Reference softmax(q k^T) v; O(L^2) memory. The test oracle (the
     flash backward is the Pallas two-pass _flash_backward below).
@@ -50,6 +61,7 @@ def naive_attention(q, k, v, causal=False, scale=None, window=None):
     keys in (p - window, p] under causal, |p - k| < window otherwise —
     None means unbounded."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    _check_window(window, q.shape[2], k.shape[2])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     lq, lk = scores.shape[-2], scores.shape[-1]
     q_pos = jnp.arange(lq)[:, None]
@@ -67,10 +79,12 @@ def naive_attention(q, k, v, causal=False, scale=None, window=None):
 
 
 def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
-                        window=None):
+                        window=None, with_lse=False):
     """Online-softmax attention via lax.scan over key blocks: O(L) memory,
     differentiable, pure jnp (the fallback when the flash kernel can't
-    run). Matches naive_attention to float tolerance."""
+    run). Matches naive_attention to float tolerance. With
+    `with_lse=True` also returns the float32 logsumexp [b, h, lq] (the
+    ring-attention partial form; see attention_forward_lse)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -114,7 +128,11 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
             jnp.arange(n_blocks),
         ),
     )
-    return softmax_finalize(o, l)
+    out = softmax_finalize(o, l)
+    if with_lse:
+        lse = (m + jnp.log(jnp.maximum(l, 1e-30))).astype(jnp.float32)
+        return out, lse
+    return out
 
 
 def _check_window(window, lq, lk):
@@ -413,15 +431,21 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                    block_k, interpret, window=None):
+                    block_k, interpret, window=None, grad_dtype=None):
     """Two-pass flash backward: a dq kernel parallel over query blocks
     and a dk/dv kernel parallel over key blocks, both recomputing P from
     the saved logsumexp (the standard flash-attention backward; one
-    matmul recompute instead of the O(L) blockwise-vjp scan)."""
+    matmul recompute instead of the O(L) blockwise-vjp scan).
+    `grad_dtype` overrides the output dtype (ring attention asks for
+    float32 partials so its cross-shard accumulation stays exact); the
+    in-kernel accumulation is float32 either way."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
     bh = b * h
     interp = interpret_mode() if interpret is None else interpret
+    dq_dtype = grad_dtype or q.dtype
+    dk_dtype = grad_dtype or k.dtype
+    dv_dtype = grad_dtype or v.dtype
     n_q = lq // block_q
     n_k = lk // block_k
     # D_i = rowsum(dO * O), the softmax-jacobian diagonal term
@@ -449,7 +473,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
             col_q, col_q,
         ],
         out_specs=_outer_spec(block_q, d),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), dq_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_mosaic_params(),
         interpret=interp,
@@ -470,8 +494,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
         ],
         out_specs=(_outer_spec(block_k, d), _outer_spec(block_k, d)),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), dk_dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), dv_dtype),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -525,10 +549,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     _check_window(window, lq, lk)
-    tiles = (
-        lq % block_q == 0 and lk % block_k == 0
-        and block_q % 8 == 0 and block_k % 8 == 0
-    )
+    tiles = _flash_tiles(lq, lk, block_q, block_k)
     if not (use_pallas() and tiles):
         if use_pallas():
             logger.debug(
@@ -538,12 +559,89 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
             )
         return blockwise_attention(q, k, v, causal=causal, scale=scale,
                                    window=window)
-    if d % 128:
-        pad = 128 - d % 128
-        widths = ((0, 0), (0, 0), (0, 0), (0, pad))
-        q = jnp.pad(q, widths)
-        k = jnp.pad(k, widths)
-        v = jnp.pad(v, widths)
+    q, k, v = _pad_lanes([q, k, v], d)
     out = _flash(q, k, v, causal, scale, block_q, block_k, interpret,
                  window)
     return out[..., :d]
+
+
+# ------------------------------------------- ring-attention local compute
+# Ring attention (parallel/context_parallel.py) needs attention in its
+# "partial" form — (normalized output, logsumexp) per kv shard, merged
+# online across ppermute rotations — and a backward that recomputes this
+# shard's slice of the GLOBAL softmax from the merged logsumexp. These
+# two functions are that surface: the Pallas kernels when they can run,
+# the jnp paths otherwise. They are not differentiable themselves; the
+# ring's custom_vjp composes them.
+
+
+def _pad_lanes(arrays, d):
+    if d % 128 == 0:
+        return arrays
+    widths = ((0, 0), (0, 0), (0, 0), (0, 128 - d % 128))
+    return [jnp.pad(x, widths) for x in arrays]
+
+
+def _flash_tiles(lq, lk, block_q, block_k):
+    return (lq % block_q == 0 and lk % block_k == 0
+            and block_q % 8 == 0 and block_k % 8 == 0)
+
+
+def attention_forward_lse(q, k, v, causal=False, scale=None, block_q=128,
+                          block_k=128, interpret=None):
+    """Attention returning (out, logsumexp): out [b,h,lq,d] in q.dtype,
+    lse float32 [b,h,lq]. Pallas flash kernel when available and the
+    sequence tiles, else the blockwise scan."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    if use_pallas() and _flash_tiles(lq, lk, bq, bk):
+        qp, kp, vp = _pad_lanes([q, k, v], d)
+        out, lse = _flash_forward(qp, kp, vp, causal, scale, bq, bk,
+                                  interpret, with_residuals=True)
+        return out[..., :d], lse[..., 0]
+    return blockwise_attention(q, k, v, causal=causal, scale=scale,
+                               with_lse=True)
+
+
+def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
+                           block_q=128, block_k=128, interpret=None,
+                           grad_dtype=None):
+    """(dq, dk, dv) for attention given a saved logsumexp.
+
+    `lse` may be the GLOBAL logsumexp of a ring while k/v are one shard:
+    P = exp(q·k*scale - lse) is then this shard's exact slice of the
+    global softmax, so per-shard partials sum to the exact gradient
+    (`out`/`g` are the global output and its cotangent, entering through
+    delta = rowsum(g*out)). Pallas two-pass kernels when available, else
+    a dense jnp recompute (O(L^2) memory — the CPU/test fallback).
+    `grad_dtype` (e.g. float32 for ring partial accumulation) overrides
+    the default input-dtype outputs."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    if use_pallas() and _flash_tiles(lq, lk, bq, bk):
+        qp, kp, vp, outp, gp = _pad_lanes([q, k, v, out, g], d)
+        dq, dk, dv = _flash_backward(
+            qp, kp, vp, outp, lse[..., None], gp, causal, scale, bq, bk,
+            interpret, grad_dtype=grad_dtype,
+        )
+        return dq[..., :d], dk[..., :d], dv[..., :d]
+    f32 = jnp.float32
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32), k.astype(f32)) * scale
+    if causal:
+        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jnp.exp(s - lse.astype(f32)[..., None])
+    gf = g.astype(f32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v.astype(f32))
+    delta = jnp.sum(gf * out.astype(f32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(f32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(f32))
+    return (dq.astype(grad_dtype or q.dtype),
+            dk.astype(grad_dtype or k.dtype),
+            dv.astype(grad_dtype or v.dtype))

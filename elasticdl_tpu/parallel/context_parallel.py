@@ -30,56 +30,181 @@ from jax.sharding import PartitionSpec as P
 from elasticdl_tpu.common.constants import MeshAxis
 from elasticdl_tpu.ops.attention import (
     NEG_INF as _NEG_INF,
+    attention_backward_lse,
+    attention_forward_lse,
     blockwise_attention,
     flash_attention,
-    softmax_finalize,
-    softmax_merge,
+    lse_merge,
 )
 
 
-def ring_attention_local(q, k, v, axis_name, causal=False, scale=None):
-    """Per-device body: q/k/v are the local sequence shards
-    [batch, heads, local_len, dim]. Call inside shard_map/pjit with a
-    named `axis_name` axis; returns the local output shard."""
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
+def _ring_case(src, my):
+    """Causal visibility of kv shard `src` from query shard `my` with
+    equal shard lengths: 0 = fully visible (src strictly older), 1 =
+    diagonal (local causal mask), 2 = fully masked (src strictly newer —
+    skipped, no compute). This is why the per-shard kernels never need a
+    dynamic position offset: the offsets only matter on the diagonal,
+    where they cancel."""
+    return jnp.where(src == my, 1, jnp.where(src < my, 0, 2)).astype(
+        jnp.int32
+    )
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k):
+    """Ring forward: per rotation, the LOCAL flash kernel produces a
+    normalized partial (o_i, lse_i) for the currently-held kv shard,
+    merged online via lse_merge; kv shards rotate with ppermute. The full
+    sequence never materializes. Returns (o [q.dtype], lse [f32])."""
     size = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
-    b, h, lq, d = q.shape
-    lk = k.shape[2]
-    q_scaled = q * scale
-    q_pos = my * lq + jnp.arange(lq)
+    b, h, lq, _ = q.shape
     perm = [((j + 1) % size, j) for j in range(size)]
+    f32 = jnp.float32
 
-    def merge_shard(o, l, m, k_cur, v_cur, i):
+    def full(qq, kk, vv):
+        o, lse = attention_forward_lse(qq, kk, vv, causal=False,
+                                       scale=scale, block_q=block_q,
+                                       block_k=block_k)
+        return o.astype(f32), lse
+
+    def diag(qq, kk, vv):
+        o, lse = attention_forward_lse(qq, kk, vv, causal=True,
+                                       scale=scale, block_q=block_q,
+                                       block_k=block_k)
+        return o.astype(f32), lse
+
+    def skip(qq, kk, vv):
+        return (jnp.zeros(qq.shape, f32),
+                jnp.full((b, h, lq), _NEG_INF, f32))
+
+    def merge(o, lse, k_cur, v_cur, i):
         # after i rotations device `my` holds the shard born on my+i
-        src = (my + i) % size
-        s = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, k_cur)
         if causal:
-            k_pos = src * lk + jnp.arange(lk)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-        return softmax_merge(o, l, m, s, v_cur)
+            o_i, lse_i = jax.lax.switch(
+                _ring_case((my + i) % size, my), (full, diag, skip),
+                q, k_cur, v_cur,
+            )
+        else:
+            o_i, lse_i = full(q, k_cur, v_cur)
+        return lse_merge(o, lse, o_i, lse_i)
 
     def step(carry, i):
-        o, l, m, k_cur, v_cur = carry
-        o, l, m = merge_shard(o, l, m, k_cur, v_cur, i)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o, l, m, k_nxt, v_nxt), None
+        o, lse, k_cur, v_cur = carry
+        o, lse = merge(o, lse, k_cur, v_cur, i)
+        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis_name, perm)
+        return (o, lse, k_nxt, v_nxt), None
 
-    o0 = jnp.zeros_like(q)
-    l0 = jnp.zeros((b, h, lq), q.dtype)
-    m0 = jnp.full((b, h, lq), _NEG_INF, q.dtype)
+    o0 = jnp.zeros(q.shape, f32)
+    lse0 = jnp.full((b, h, lq), _NEG_INF, f32)
     # the last shard's rotation would be discarded — merge it outside the
     # scan so each step pays exactly the ppermutes it uses
-    (o, l, m, k_last, v_last), _ = jax.lax.scan(
-        step, (o0, l0, m0, k, v), jnp.arange(size - 1)
+    (o, lse, k_last, v_last), _ = jax.lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(size - 1)
     )
-    o, l, m = merge_shard(o, l, m, k_last, v_last, size - 1)
-    return softmax_finalize(o, l)
+    o, lse = merge(o, lse, k_last, v_last, size - 1)
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_attention(q, k, v, axis_name, causal, scale, block_q, block_k):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q,
+                          block_k)
+    return o
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q,
+                            block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, g):
+    """Ring backward: a second ring pass. Each rotation recomputes this
+    shard's slice of the global softmax from the saved global logsumexp
+    (attention_backward_lse — the Pallas two-pass kernels on TPU), adds
+    dq locally, and accumulates dk/dv into buffers that TRAVEL WITH
+    their kv shard around the ring; after the full cycle of ppermutes
+    every dk/dv accumulator is back on the device that owns its shard."""
+    q, k, v, o, lse = res
+    size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [((j + 1) % size, j) for j in range(size)]
+    f32 = jnp.float32
+
+    def full(kk, vv):
+        return attention_backward_lse(q, kk, vv, o, lse, g, causal=False,
+                                      scale=scale, block_q=block_q,
+                                      block_k=block_k, grad_dtype=f32)
+
+    def diag(kk, vv):
+        return attention_backward_lse(q, kk, vv, o, lse, g, causal=True,
+                                      scale=scale, block_q=block_q,
+                                      block_k=block_k, grad_dtype=f32)
+
+    def skip(kk, vv):
+        return (jnp.zeros(q.shape, f32), jnp.zeros(kk.shape, f32),
+                jnp.zeros(vv.shape, f32))
+
+    def grads(k_cur, v_cur, i):
+        if causal:
+            return jax.lax.switch(
+                _ring_case((my + i) % size, my), (full, diag, skip),
+                k_cur, v_cur,
+            )
+        return full(k_cur, v_cur)
+
+    def step(carry, i):
+        dq, k_cur, v_cur, dk_acc, dv_acc = carry
+        dq_i, dk_i, dv_i = grads(k_cur, v_cur, i)
+        dq = dq + dq_i
+        k_cur, v_cur, dk_acc, dv_acc = jax.lax.ppermute(
+            (k_cur, v_cur, dk_acc + dk_i, dv_acc + dv_i),
+            axis_name, perm,
+        )
+        return (dq, k_cur, v_cur, dk_acc, dv_acc), None
+
+    (dq, k_last, v_last, dk_acc, dv_acc), _ = jax.lax.scan(
+        step,
+        (jnp.zeros(q.shape, f32), k, v, jnp.zeros(k.shape, f32),
+         jnp.zeros(v.shape, f32)),
+        jnp.arange(size - 1),
+    )
+    # final shard: compute in place, then one last hop delivers the
+    # accumulators home (kv shards themselves are done rotating)
+    dq_i, dk_i, dv_i = grads(k_last, v_last, size - 1)
+    dq = dq + dq_i
+    dk_acc, dv_acc = jax.lax.ppermute(
+        (dk_acc + dk_i, dv_acc + dv_i), axis_name, perm
+    )
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+_ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
+                         block_q=128, block_k=128):
+    """Per-device body: q/k/v are the local sequence shards
+    [batch, heads, local_len, dim]. Call inside shard_map/pjit with a
+    named `axis_name` axis; returns the local output shard. The local
+    compute per rotation is the Pallas flash kernel (fwd + two-pass bwd)
+    when it can run, with a blockwise/dense jnp fallback."""
+    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    if causal and q.shape[2] != k.shape[2]:
+        # The three-way shard classification (_ring_case) relies on
+        # equal-length q/kv shards so diagonal offsets cancel; unequal
+        # lengths would need per-shard position offsets in the kernel.
+        raise ValueError(
+            "causal ring attention requires equal q/kv sequence lengths "
+            "per shard, got lq=%d lk=%d" % (q.shape[2], k.shape[2])
+        )
+    return _ring_attention(q, k, v, axis_name, causal, scale, block_q,
+                           block_k)
 
 
 def ring_attention(q, k, v, mesh, causal=False, scale=None,
+                   block_q=128, block_k=128,
                    seq_axis=MeshAxis.SP, batch_axes=(MeshAxis.DP,
                                                      MeshAxis.FSDP)):
     """Global-view ring attention: q/k/v are [batch, heads, seq, dim]
@@ -97,6 +222,8 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
             axis_name=seq_axis,
             causal=causal,
             scale=scale,
+            block_q=block_q,
+            block_k=block_k,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
