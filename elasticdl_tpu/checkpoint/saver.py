@@ -160,16 +160,33 @@ class CheckpointSaver(object):
         write happens in a background thread (at most one in flight —
         a new save joins the previous one first)."""
         version = int(version)
-        flat = flatten_state(state)
+        extra = {}
         if self.extra_state_fn is not None:
-            flat.update(self.extra_state_fn())
+            # Extra leaves (host-spill engine state) are PROCESS-LOCAL:
+            # each host's flat map holds only its own engines, so these
+            # keys must land in a shard file THIS process writes — the
+            # hash assignment in _partition would route them to files
+            # other processes own, silently dropping them multi-host.
+            # The shard-count check runs on EVERY process BEFORE the
+            # collective flatten: raising on a subset mid-save would let
+            # the rest finish a valid-looking checkpoint missing those
+            # hosts' partitions.
+            if self.num_shards < jax.process_count():
+                raise ValueError(
+                    "process-local checkpoint state (extra_state_fn) "
+                    "needs num_shards (%d) >= process count (%d) so "
+                    "every process has a shard file to write"
+                    % (self.num_shards, jax.process_count())
+                )
+            extra = dict(self.extra_state_fn())
+        flat = flatten_state(state)
         if self.async_save:
             import threading
 
             self.wait()  # at most one in-flight write; re-raises failures
             self._write_thread = threading.Thread(
                 target=self._write_guarded,
-                args=(flat, version),
+                args=(flat, extra, version),
                 daemon=True,
                 name="ckpt-write-v%d" % version,
             )
@@ -179,7 +196,7 @@ class CheckpointSaver(object):
             self._last_saved_version = version
             self._write_thread.start()
             return self._version_dir(version)
-        out = self._write_and_log(flat, version)
+        out = self._write_and_log(flat, extra, version)
         self._last_saved_version = version
         return out
 
@@ -194,9 +211,9 @@ class CheckpointSaver(object):
             err, self._write_error = self._write_error, None
             raise err
 
-    def _write_guarded(self, flat, version):
+    def _write_guarded(self, flat, extra, version):
         try:
-            self._write_and_log(flat, version)
+            self._write_and_log(flat, extra, version)
         except BaseException as e:  # noqa: BLE001 - re-raised in wait()
             self._write_error = e
             # the version was NOT durably written: let maybe_save retry
@@ -206,7 +223,7 @@ class CheckpointSaver(object):
                 version, e,
             )
 
-    def _write_and_log(self, flat, version):
+    def _write_and_log(self, flat, extra, version):
         final_dir = self._version_dir(version)
         os.makedirs(self.checkpoint_dir, exist_ok=True)
 
@@ -237,6 +254,9 @@ class CheckpointSaver(object):
                         except OSError:
                             pass
             shards = self._partition(flat)
+            if extra:
+                # process-local leaves ride this process's first shard
+                shards[proc].update(extra)
             for i in range(proc, self.num_shards, nproc):
                 path = os.path.join(
                     write_dir,
@@ -248,6 +268,9 @@ class CheckpointSaver(object):
                 meta = {
                     "version": version,
                     "num_shards": self.num_shards,
+                    # counts the GLOBAL (dense-state) leaves only:
+                    # process-local extra leaves live in per-process
+                    # shards whose counts process 0 cannot know
                     "leaf_count": len(flat),
                 }
                 with open(os.path.join(write_dir, "meta.json"), "w") as f:

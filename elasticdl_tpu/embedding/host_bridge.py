@@ -23,9 +23,10 @@ deduplicate_indexed_slices).
 
 Static shapes: the pulled-row count varies per batch, so rows are padded
 to a fixed cap (the id tensor's size rounded up), keeping one compiled
-step. Scope: per-process tables (the reference's PS pods were also
-per-pod stores); the SPMD multi-host path shards HBM tables instead
-(parallel/sharding.py).
+step. Multi-host SPMD: `HostEmbeddingManager.enable_spmd` partitions the
+id space over hosts (owner_of) so capacity scales with the fleet — see
+the class docstring; HBM sharding (parallel/sharding.py) remains the
+default home for big tables.
 """
 
 import numpy as np
@@ -88,18 +89,53 @@ def _round_up(n, k):
     return ((n + k - 1) // k) * k
 
 
+def owner_of(ids, num_partitions):
+    """Host partition owning each id: id % num_partitions — the same
+    scatter rule the reference used to spread ids over PS pods
+    (elasticdl/python/common/hash_utils.py:17-27 int_to_id). With the
+    splitmix64-deterministic lazy init (native/host_embedding.cc), the
+    owner materializes an id's initial row identically on every host, so
+    the partitioning needs no coordination."""
+    return np.asarray(ids, np.int64) % int(num_partitions)
+
+
 class HostEmbeddingManager(object):
-    """Owns the host engines and the pull/apply halves of the step."""
+    """Owns the host engines and the pull/apply halves of the step.
+
+    Two modes:
+    * single-process (default): each batch's unique rows are pulled from
+      the local store and fed to the step as `<table>.rows` / `.idx`.
+    * SPMD multi-host (enable_spmd): the id space is partitioned over
+      hosts (owner_of) the way the reference scattered ids over PS pods;
+      each host stores and updates ONLY its owned rows, so embedding
+      capacity scales with the worker fleet (the reference's
+      parameter_server.md:42-78 scaling property). Per round, hosts
+      allgather the batch's candidate ids, each pulls its owned subset,
+      and the global `rows` feature is assembled batch-sharded with
+      `idx` pointing at GLOBAL row positions; the row-gradient output is
+      replicated, so each host applies exactly its owned slice.
+    """
 
     def __init__(self, pad_multiple=8):
         self._tables = {}
         self.pad_multiple = int(pad_multiple)
+        self._spmd_ctx = None
 
     def register(self, name, ids_feature, engine):
         if name in self._tables:
             raise ValueError("host table %r already registered" % name)
         self._tables[name] = _HostTable(name, ids_feature, engine)
         return self
+
+    def enable_spmd(self, ctx):
+        """Switch to id-partitioned multi-host mode (no-op for a
+        single-process context: the local path is already exact)."""
+        self._spmd_ctx = ctx if ctx.is_multiprocess else None
+        return self
+
+    @property
+    def spmd_ctx(self):
+        return self._spmd_ctx
 
     def __bool__(self):
         return bool(self._tables)
@@ -114,6 +150,7 @@ class HostEmbeddingManager(object):
         clone = HostEmbeddingManager(pad_multiple=self.pad_multiple)
         for name, t in self._tables.items():
             clone.register(name, t.ids_feature, t.engine.fresh_clone())
+        clone._spmd_ctx = self._spmd_ctx
         return clone
 
     def rows_keys(self):
@@ -132,6 +169,8 @@ class HostEmbeddingManager(object):
         mask / the model's own mask, exactly like the reference's padded
         lookups (embedding_delegate.py safe lookup).
         """
+        if self._spmd_ctx is not None:
+            return self._prepare_spmd(features)
         features = dict(features)
         for name, t in self._tables.items():
             ids = np.asarray(features[t.ids_feature])
@@ -143,6 +182,61 @@ class HostEmbeddingManager(object):
             features[name + ROWS_SUFFIX] = padded
             features[name + IDX_SUFFIX] = inverse.astype(np.int32)
             t.last_unique = unique_ids
+        return features
+
+    def _spmd_cap(self, total_slots):
+        """Static per-host row capacity: must hold the worst case (every
+        global id slot unique AND owned by one host) and divide evenly
+        over the batch sharding's dim-0 partitions after the nproc
+        blocks are concatenated."""
+        ctx = self._spmd_ctx
+        unit = self.pad_multiple * ctx.batch_partitions
+        return _round_up(max(int(total_slots), 1), unit)
+
+    def _prepare_spmd(self, features):
+        """Multi-host prepare: one host-level allgather of the batch's
+        ids per table, then each host pulls only the globally-unique ids
+        it OWNS. `<table>.rows` is this host's padded owned block (the
+        SPMD assemble concatenates the blocks batch-sharded), and
+        `<table>.idx` maps every local id slot to its row's GLOBAL
+        position. Every host must call this the same number of times per
+        round (the allgather is a host collective) — the lockstep loop
+        guarantees that."""
+        ctx = self._spmd_ctx
+        nproc, rank = ctx.num_processes, ctx.process_index
+        features = dict(features)
+        # one allgather + partition per DISTINCT ids_feature: tables
+        # sharing an id tensor (e.g. deepfm's embedding + id-bias) must
+        # not pay the host collective twice per step
+        shared = {}
+        for name, t in self._tables.items():
+            if t.ids_feature not in shared:
+                ids = np.asarray(features[t.ids_feature])
+                clean = np.where(
+                    ids == PADDING_ID, 0, ids
+                ).astype(np.int64)
+                uniq = np.unique(ctx.allgather(clean))  # sorted; same
+                # on every host
+                owners = owner_of(uniq, nproc)
+                owned = [uniq[owners == p] for p in range(nproc)]
+                cap = self._spmd_cap(int(clean.size) * nproc)
+                pos = ctx.rows_positions(nproc * cap)
+                # global row position of every globally-unique id:
+                # owner p's j-th owned id sits at p's j-th local row
+                # (uniq[owners==p] IS owned[p], in order)
+                uniq_pos = np.zeros(uniq.size, np.int64)
+                for p in range(nproc):
+                    uniq_pos[owners == p] = pos[p][: owned[p].size]
+                idx = uniq_pos[np.searchsorted(uniq, clean)]
+                shared[t.ids_feature] = (owned[rank], cap, idx)
+            mine, cap, idx = shared[t.ids_feature]
+            padded = np.zeros((cap, t.engine.dim), np.float32)
+            if mine.size:
+                _, rows, _ = t.engine.pull(mine)
+                padded[: mine.size] = rows
+            features[name + ROWS_SUFFIX] = padded
+            features[name + IDX_SUFFIX] = idx.astype(np.int32)
+            t.last_unique = mine
         return features
 
     # ------------------------------------------------------------- apply
@@ -162,13 +256,20 @@ class HostEmbeddingManager(object):
         # never retries an apply (trainer.train_step logs and moves on),
         # so a partial step degrades to "those rows missed one update"
         # rather than double-applying.
+        ctx = self._spmd_ctx
         staged = []
         for name, t in self._tables.items():
             if t.last_unique is None:
                 raise RuntimeError(
                     "apply() before prepare() for host table %r" % name
                 )
+            # replicated output: np.asarray works across hosts too
             grads = np.asarray(host_grads[name + ROWS_SUFFIX])
+            if ctx is not None:
+                # global [nproc*cap, dim] -> this host's rows, in the
+                # local order prepare laid them out
+                grads = grads[ctx.rows_positions(grads.shape[0])[
+                    ctx.process_index]]
             staged.append((t, grads[: t.last_unique.size]))
         for t, grads in staged:
             t.engine.apply_gradients(
@@ -177,13 +278,23 @@ class HostEmbeddingManager(object):
 
     # -------------------------------------------------------- checkpoint
 
+    def _ckpt_base(self, name):
+        """Checkpoint key base for a table. In SPMD mode the keys carry
+        the host partition (``.partP``): each host's flat map holds only
+        its owned rows, and the saver routes these process-local leaves
+        into a shard file this process writes (checkpoint/saver.py)."""
+        base = "%s['%s']" % (CKPT_PREFIX, name)
+        if self._spmd_ctx is not None:
+            base += ".part%d" % self._spmd_ctx.process_index
+        return base
+
     def flat_state(self):
         """Engine state as checkpoint leaves {keystr: ndarray}, merged
         into the sharded checkpoint next to the TrainState leaves."""
         out = {}
         for name, t in self._tables.items():
             sd = t.engine.state_dict()
-            base = "%s['%s']" % (CKPT_PREFIX, name)
+            base = self._ckpt_base(name)
             out[base + ".step"] = np.asarray(sd["step"], np.int64)
             for key, value in sd.items():
                 if key == "step":
@@ -195,21 +306,47 @@ class HostEmbeddingManager(object):
 
     def load_flat_state(self, flat):
         """Inverse of flat_state(); restore REPLACES engine contents
-        (host_spill.load_state_dict semantics)."""
+        (host_spill.load_state_dict semantics).
+
+        Re-partitions on load: all ``.partP`` blocks present in the
+        merged checkpoint (load_checkpoint merges every shard file) are
+        concatenated, then filtered to the ids THIS host owns under the
+        current process count — so a checkpoint written by M hosts
+        restores onto N hosts, the same re-shard-on-load property the
+        HBM tiers have."""
+        import re
+
         for name, t in self._tables.items():
             base = "%s['%s']" % (CKPT_PREFIX, name)
-            step_key = base + ".step"
-            if step_key not in flat:
+            esc = re.escape(base)
+            part_re = re.compile(esc + r"(\.part\d+)?\.step$")
+            bases = sorted(
+                m.group(0)[: -len(".step")]
+                for m in (part_re.match(k) for k in flat)
+                if m
+            )
+            if not bases:
                 raise KeyError(
                     "checkpoint has no host-embedding state for table %r"
                     % name
                 )
-            state = {"step": int(flat[step_key])}
+            step = max(int(flat[b + ".step"]) for b in bases)
+            state = {"step": step}
             for key in ["param"] + list(t.engine.slots):
-                state[key] = (
-                    flat["%s.%s.ids" % (base, key)],
-                    flat["%s.%s.values" % (base, key)],
+                ids = np.concatenate(
+                    [np.atleast_1d(flat["%s.%s.ids" % (b, key)])
+                     for b in bases]
                 )
+                values = np.concatenate(
+                    [np.atleast_2d(flat["%s.%s.values" % (b, key)])
+                     for b in bases]
+                ) if ids.size else np.zeros((0, t.engine.dim), np.float32)
+                if self._spmd_ctx is not None and ids.size:
+                    sel = owner_of(
+                        ids, self._spmd_ctx.num_processes
+                    ) == self._spmd_ctx.process_index
+                    ids, values = ids[sel], values[sel]
+                state[key] = (ids, values)
             t.engine.load_state_dict(state)
 
 

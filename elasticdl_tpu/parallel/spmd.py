@@ -59,21 +59,17 @@ class SPMDContext(object):
         self._batch_sharding = mesh_lib.batch_sharding(mesh)
         self.num_processes = jax.process_count()
         self.process_index = jax.process_index()
-        self._row_cache = {}
+        self._proc_rows_cache = {}
+        self._batch_partitions = None
 
     @property
     def is_multiprocess(self):
         return self.num_processes > 1
 
     def local_rows(self, global_batch_size):
-        """Cached local_row_positions for the batch sharding."""
-        rows = self._row_cache.get(global_batch_size)
-        if rows is None:
-            rows = local_row_positions(
-                self._batch_sharding, global_batch_size
-            )
-            self._row_cache[global_batch_size] = rows
-        return rows
+        """This host's global row positions for the batch sharding
+        (cached via rows_positions)."""
+        return self.rows_positions(global_batch_size)[self.process_index]
 
     def assemble(self, local_pytree):
         """Host-local numpy (leading dim = per-host batch) -> global sharded
@@ -86,6 +82,48 @@ class SPMDContext(object):
             ),
             local_pytree,
         )
+
+    def allgather(self, local_np):
+        """Host-level allgather: local [s...] -> [num_processes, s...],
+        identical on every host (deterministic process order)."""
+        if not self.is_multiprocess:
+            return np.asarray(local_np)[None]
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(np.asarray(local_np))
+        )
+
+    def rows_positions(self, global_len):
+        """{process_index: global row positions} for a length-global_len
+        dim-0 batch-sharded array, each in the host-local row order of
+        assemble()/make_array_from_process_local_data. Cached per length
+        (the mapping is static for a given mesh)."""
+        cached = self._proc_rows_cache.get(global_len)
+        if cached is None:
+            cached = process_row_positions(
+                self._batch_sharding, global_len
+            )
+            self._proc_rows_cache[global_len] = cached
+        return cached
+
+    @property
+    def batch_partitions(self):
+        """Number of distinct dim-0 partitions of the batch sharding (the
+        divisor global batch-like lengths must honor)."""
+        if self._batch_partitions is None:
+            spec = self._batch_sharding.spec
+            axes = spec[0] if spec else None
+            if axes is None:
+                self._batch_partitions = 1
+            else:
+                if isinstance(axes, str):
+                    axes = (axes,)
+                size = 1
+                for a in axes:
+                    size *= self.mesh.shape[a]
+                self._batch_partitions = size
+        return self._batch_partitions
 
     def all_done(self, local_done):
         """True iff every host reports done (host-level consensus)."""
@@ -116,15 +154,29 @@ def local_row_positions(batch_sharding, global_batch_size):
     Used to slice a replicated global output back down to the rows this
     host contributed (robust against device-mesh reordering on real ICI
     topologies, where host rows need not be one contiguous block)."""
-    index_map = batch_sharding.devices_indices_map((global_batch_size,))
-    blocks = []
-    for dev in batch_sharding.addressable_devices:
-        sl = index_map[dev][0]
+    return process_row_positions(batch_sharding, global_batch_size)[
+        jax.process_index()
+    ]
+
+
+def process_row_positions(batch_sharding, global_len):
+    """{process_index: global row indices}, each in that host's local row
+    order (distinct index blocks sorted by start — the order both
+    make_array_from_process_local_data consumes host-local rows and
+    addressable shards enumerate). Replicated devices (e.g. tp) map to
+    the same block; duplicates are dropped."""
+    index_map = batch_sharding.devices_indices_map((global_len,))
+    per_proc = {}
+    for dev, idx in index_map.items():
+        sl = idx[0]
         start = sl.start or 0
-        stop = sl.stop if sl.stop is not None else global_batch_size
-        blocks.append(np.arange(start, stop))
-    blocks.sort(key=lambda a: a[0] if a.size else 0)
-    return np.concatenate(blocks) if blocks else np.arange(0)
+        stop = sl.stop if sl.stop is not None else global_len
+        per_proc.setdefault(dev.process_index, {})[start] = stop
+    out = {}
+    for p, blocks in per_proc.items():
+        segs = [np.arange(s, e) for s, e in sorted(blocks.items())]
+        out[p] = np.concatenate(segs) if segs else np.arange(0)
+    return out
 
 
 # Round modes, in priority order (lower wins the consensus):

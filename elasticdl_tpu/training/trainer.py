@@ -135,8 +135,10 @@ class Trainer(object):
     def attach_host_embeddings(self, manager):
         """Register a HostEmbeddingManager. Must happen before the first
         init_state/train_step so the compiled signature includes the
-        pulled-row inputs. Per-process tables: unsupported together with
-        the multi-host SPMD assembled path."""
+        pulled-row inputs. Multi-host SPMD: enable_spmd the manager and
+        drive training through the assembled path (worker._spmd_step) —
+        the local train_step/forward entry points reject SPMD-mode
+        managers."""
         if self._train_step is not None or self._eval_step is not None:
             raise RuntimeError(
                 "attach_host_embeddings must precede step compilation"
@@ -393,49 +395,60 @@ class Trainer(object):
         features, labels = _split_label(batch)
         bsz = _leading_dim(features)
         weights = _make_weights(bsz, true_count)
+        self._reject_spmd_host_local_path("train_step")
         features = self._host_prepare(features)
-        if self._host_manager:
-            # scale_by_schedule counts applied updates from 0, i.e. the
-            # pre-update step number — mirror it for the host tier. The
-            # multiplier runs BEFORE the donating compiled step: a user
-            # schedule that raises must fail while the caller's state
-            # buffers are still alive and the batch retryable.
-            scale = (
-                float(self._lr_multiplier_fn(int(state.step)))
-                if self._lr_multiplier_fn is not None
-                else 1.0
-            )
+        scale = self._host_lr_scale(state)
         state, loss, host_grads = self._run_train_step(
             state, features, labels, weights
         )
-        if self._host_manager:
-            # A failure here must NOT propagate: the compiled step donated
-            # the caller's old state buffers, so a retry would replay on
-            # deleted arrays (bricking the worker's 64-retry loop) and
-            # double-apply any engine that did step. Instead the affected
-            # rows miss this one update — the degradation the reference's
-            # PS path also accepted (dropped grads on PS restart; fault
-            # tolerance is task-requeue-first, README.md:62-66).
-            try:
-                self._host_manager.apply(host_grads, lr_scale=scale)
-            except Exception:
-                # The log itself must not touch device values: with an
-                # async device error poisoning this step's outputs,
-                # int(state.step) would re-raise the very exception this
-                # handler exists to contain.
-                logger.exception(
-                    "host-embedding apply failed; affected rows miss "
-                    "this update (no retry: state is donated)"
-                )
+        self._host_apply(host_grads, scale)
         return state, loss
+
+    def _host_lr_scale(self, state):
+        """scale_by_schedule counts applied updates from 0, i.e. the
+        pre-update step number — mirror it for the host tier. The
+        multiplier runs BEFORE the donating compiled step: a user
+        schedule that raises must fail while the caller's state
+        buffers are still alive and the batch retryable."""
+        if self._host_manager and self._lr_multiplier_fn is not None:
+            return float(self._lr_multiplier_fn(int(state.step)))
+        return 1.0
+
+    def _host_apply(self, host_grads, scale):
+        """Apply host-tier row grads after the compiled step. A failure
+        here must NOT propagate: the compiled step donated the caller's
+        old state buffers, so a retry would replay on deleted arrays
+        (bricking the worker's 64-retry loop) and double-apply any
+        engine that did step. Instead the affected rows miss this one
+        update — the degradation the reference's PS path also accepted
+        (dropped grads on PS restart; fault tolerance is
+        task-requeue-first, README.md:62-66)."""
+        if not self._host_manager:
+            return
+        try:
+            self._host_manager.apply(host_grads, lr_scale=scale)
+        except Exception:
+            # The log itself must not touch device values: with an
+            # async device error poisoning this step's outputs,
+            # int(state.step) would re-raise the very exception this
+            # handler exists to contain.
+            logger.exception(
+                "host-embedding apply failed; affected rows miss "
+                "this update (no retry: state is donated)"
+            )
 
     def train_step_assembled(self, state, features, labels, weights):
         """Run the compiled step on already-prepared (possibly global
         multi-host) arrays — the SPMD path (parallel/spmd.py). Host-spill
-        tables are per-process and bypass this path (Trainer.train_step)."""
-        state, loss, _ = self._run_train_step(
+        features must already be prepared (the worker calls
+        host_manager.prepare BEFORE assembling, since the multi-host
+        prepare is itself a host-level collective); the row grads are
+        applied here, each host updating its owned id partition."""
+        scale = self._host_lr_scale(state)
+        state, loss, host_grads = self._run_train_step(
             state, features, labels, weights
         )
+        self._host_apply(host_grads, scale)
         return state, loss
 
     def _run_train_step(self, state, features, labels, weights):
@@ -447,7 +460,29 @@ class Trainer(object):
     def forward(self, state, features):
         """Inference forward pass (evaluation / prediction). Output is
         replicated to every host."""
+        self._reject_spmd_host_local_path("forward")
         features = self._host_prepare(features)
+        return self.forward_assembled(state, features)
+
+    def _reject_spmd_host_local_path(self, entry):
+        """With the host manager in SPMD mode, prepare() emits idx over
+        GLOBAL row positions — feeding that to the local (un-assembled)
+        step would make jnp.take clamp out-of-range rows silently. Fail
+        fast instead: the worker's assembled path is the only correct
+        entry."""
+        if (self._host_manager is not None
+                and self._host_manager.spmd_ctx is not None):
+            raise ValueError(
+                "%s() is the local single-host path, but the host-"
+                "embedding manager is in SPMD mode; prepare locally and "
+                "use train_step_assembled / forward_assembled (see "
+                "worker._spmd_step)" % entry
+            )
+
+    def forward_assembled(self, state, features):
+        """Forward on already-prepared (possibly global multi-host)
+        arrays — the SPMD eval path; host-spill features must already be
+        prepared (worker._spmd_eval_step prepares before assembling)."""
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         with self.mesh:

@@ -101,16 +101,6 @@ class Worker(object):
         from elasticdl_tpu.embedding.host_bridge import attach_from_spec
 
         self._host_manager = attach_from_spec(self.trainer, model_spec)
-        if self._host_manager and spmd:
-            # Host tables are per-process stores; the SPMD assembled path
-            # feeds global arrays without the pulled-row features and
-            # multi-host savers would interleave per-process engine
-            # state. Fail fast instead of KeyError'ing mid-training.
-            raise ValueError(
-                "host_embeddings() models are not supported in SPMD "
-                "lockstep mode; shard the table over HBM (embedding."
-                "Embedding) for multi-host training"
-            )
         self.state = None
         self._task_data_service = TaskDataService(
             self,
@@ -142,6 +132,12 @@ class Worker(object):
             from elasticdl_tpu.parallel.spmd import SPMDContext
 
             self._spmd_ctx = SPMDContext(self.trainer.mesh)
+            if self._host_manager:
+                # Multi-host host-spill: partition the id space over
+                # hosts (embedding/host_bridge.py enable_spmd) so table
+                # capacity scales with the fleet, like the reference's
+                # PS pods (docs/designs/parameter_server.md:42-78).
+                self._host_manager.enable_spmd(self._spmd_ctx)
 
     # ----------------------------------------------------------- RPC layer
 
@@ -483,7 +479,12 @@ class Worker(object):
         padded, n = item
         features, labels = _split_label(padded)
         weights = self.trainer.make_weights(self.minibatch_size, n)
-        gf, gl, gw = self._spmd_ctx.assemble((features, labels, weights))
+        # Host-spill prepare runs on the LOCAL features before assembly
+        # (the multi-host prepare is itself a host-level collective that
+        # every host must enter this round — the lockstep loop ensures
+        # every host is in this call).
+        prepped = self.trainer._host_prepare(features)
+        gf, gl, gw = self._spmd_ctx.assemble((prepped, labels, weights))
         self._ensure_state(padded)
         self.state, loss = self.trainer.train_step_assembled(
             self.state, gf, gl, gw
@@ -541,9 +542,9 @@ class Worker(object):
         else:
             (padded, n), task_pb = item
         features, labels = _split_label(padded)
-        gf = self._spmd_ctx.assemble(features)
+        gf = self._spmd_ctx.assemble(self.trainer._host_prepare(features))
         self._ensure_state(padded)
-        global_out = self.trainer.forward(self.state, gf)
+        global_out = self.trainer.forward_assembled(self.state, gf)
         if task_pb is None:
             return
         self._template_batch = (features, labels)

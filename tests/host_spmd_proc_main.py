@@ -1,0 +1,85 @@
+"""Subprocess entry for the 2-process host-spill embedding SPMD test
+(test_spmd_multiprocess.py::test_two_process_host_embedding_parity).
+
+Each process is one 'host' owning a partition of the embedding id space
+(embedding/host_bridge.py enable_spmd). Batches are generated from a
+shared seed so the parent can train the identical global stream
+single-process and compare losses + the merged trained tables.
+"""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+num_procs = int(sys.argv[2])
+coord_port = sys.argv[3]
+out_dir = sys.argv[4]
+local_devices = int(sys.argv[5])
+steps = int(sys.argv[6])
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=%d" % local_devices
+)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from elasticdl_tpu.parallel.spmd import initialize_distributed
+
+initialize_distributed(
+    coordinator_addr="localhost:%s" % coord_port,
+    num_processes=num_procs,
+    process_id=proc_id,
+    platform="cpu",
+)
+
+import numpy as np
+
+from elasticdl_tpu.common.model_utils import load_model_spec_from_module
+from elasticdl_tpu.embedding.host_bridge import attach_from_spec
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.parallel.spmd import SPMDContext
+from elasticdl_tpu.training.trainer import Trainer
+from model_zoo.deepfm_host_embedding import deepfm_host_embedding as zoo
+
+GLOBAL_BATCH = 16
+VOCAB = 50
+
+mesh = mesh_lib.build_mesh({"dp": num_procs * local_devices})
+spec = load_model_spec_from_module(zoo)
+trainer = Trainer(spec, mesh=mesh)
+manager = attach_from_spec(trainer, spec)
+ctx = SPMDContext(mesh)
+manager.enable_spmd(ctx)
+
+my_rows = ctx.rows_positions(GLOBAL_BATCH)[ctx.process_index]
+rng = np.random.RandomState(7)
+losses = []
+state = None
+for _ in range(steps):
+    ids = rng.randint(0, VOCAB, size=(GLOBAL_BATCH, 10)).astype(np.int32)
+    labels = rng.randint(0, 2, size=(GLOBAL_BATCH,)).astype(np.int32)
+    feats = {"feature": ids[my_rows]}
+    local_labels = labels[my_rows]
+    if state is None:
+        state = trainer.init_state((feats, local_labels))
+    prepped = trainer._host_prepare(feats)
+    gf, gl, gw = ctx.assemble(
+        (prepped, local_labels,
+         np.ones((len(my_rows),), np.float32))
+    )
+    state, loss = trainer.train_step_assembled(state, gf, gl, gw)
+    losses.append(float(loss))
+
+tables = {}
+for name, t in manager.tables().items():
+    ids_t, vals_t = t.engine.param.export_rows()
+    tables[name + ".ids"] = ids_t
+    tables[name + ".values"] = vals_t
+np.savez(
+    os.path.join(out_dir, "proc%d.npz" % proc_id),
+    losses=np.asarray(losses, np.float64),
+    **tables
+)
+print("HOST_SPMD_DONE pid=%d steps=%d" % (proc_id, len(losses)),
+      flush=True)

@@ -233,11 +233,11 @@ def test_async_save_failure_surfaces_and_retries(tmp_path,
     real_write = saver._write_and_log
     calls = {"n": 0}
 
-    def flaky(flat, version):
+    def flaky(flat, extra, version):
         calls["n"] += 1
         if calls["n"] == 1:
             raise OSError("disk full")
-        return real_write(flat, version)
+        return real_write(flat, extra, version)
 
     saver._write_and_log = flaky
     saver.save(state, version=1)

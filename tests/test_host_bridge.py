@@ -259,24 +259,185 @@ def test_lr_scale_reaches_engine():
     )
 
 
-def test_worker_rejects_host_model_in_spmd_mode():
-    """Host tables are per-process; SPMD lockstep must fail fast at
-    construction, not KeyError mid-training (worker.py guard)."""
+class _FakeSPMDCtx(object):
+    """Emulates a 2-host SPMDContext inside one process: the test sets
+    `gathered` to the stacked per-host id tensors before each prepare,
+    and rows_positions pretends host p's rows occupy the contiguous
+    block [p*cap, (p+1)*cap) — consistent with how the test assembles
+    the global rows feature by concatenation."""
+
+    def __init__(self, process_index, num_processes=2):
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.is_multiprocess = True
+        self.batch_partitions = 1
+        self.gathered = None
+
+    def allgather(self, local_np):
+        return self.gathered
+
+    def rows_positions(self, global_len):
+        cap = global_len // self.num_processes
+        return {
+            p: np.arange(p * cap, (p + 1) * cap)
+            for p in range(self.num_processes)
+        }
+
+
+def _spmd_host_manager(ctx):
+    manager = HostEmbeddingManager()
+    manager.register(
+        "edl_embedding", "feature",
+        HostSpillEmbeddingEngine(DIM, optimizer="sgd", lr=0.1),
+    )
+    manager.register(
+        "edl_id_bias", "feature",
+        HostSpillEmbeddingEngine(1, optimizer="sgd", lr=0.1),
+    )
+    manager.enable_spmd(ctx)
+    return manager
+
+
+def test_spmd_host_embedding_parity():
+    """Two emulated hosts with id-partitioned host tables train to
+    exactly the single-process result: same per-step losses, and the
+    union of the hosts' owned rows equals the single-store table (the
+    reference's PS scatter — each id lives on one pod — reproduced as
+    owner_of partitioning)."""
     from model_zoo.deepfm_host_embedding import deepfm_host_embedding as zoo
+    from elasticdl_tpu.embedding.host_bridge import (
+        IDX_SUFFIX,
+        ROWS_SUFFIX,
+        owner_of,
+    )
 
-    from elasticdl_tpu.common.model_utils import load_model_spec_from_module
-    from elasticdl_tpu.worker.worker import Worker
+    spec = load_model_spec_from_module(zoo)
+    mp = format_params_str(dict(input_length=LENGTH, fc_unit=FC))
+    batches = _batches(5, batch=8)
 
-    class _FakeMaster(object):
-        pass
+    # ---- baseline: one process, one store
+    base = Trainer(spec, mesh=mesh_lib.local_mesh(), model_params=mp)
+    base_mgr = HostEmbeddingManager()
+    base_mgr.register(
+        "edl_embedding", "feature",
+        HostSpillEmbeddingEngine(DIM, optimizer="sgd", lr=0.1),
+    )
+    base_mgr.register(
+        "edl_id_bias", "feature",
+        HostSpillEmbeddingEngine(1, optimizer="sgd", lr=0.1),
+    )
+    base.attach_host_embeddings(base_mgr)
+    base_state = base.init_state(batches[0])
+    base_losses = []
+    for b in batches:
+        base_state, loss = base.train_step(base_state, b)
+        base_losses.append(float(loss))
 
-    with pytest.raises(ValueError, match="SPMD"):
-        Worker(
-            0,
-            load_model_spec_from_module(zoo),
-            master_servicer=_FakeMaster(),
-            spmd=True,
+    # ---- emulated 2-host SPMD over the same global batches
+    ctxs = [_FakeSPMDCtx(0), _FakeSPMDCtx(1)]
+    mgrs = [_spmd_host_manager(c) for c in ctxs]
+    spmd = Trainer(spec, mesh=mesh_lib.local_mesh(), model_params=mp)
+    spmd.attach_host_embeddings(mgrs[0])
+
+    def run_round(state, batch, init_only=False):
+        (features, labels) = batch
+        ids = np.asarray(features["feature"])
+        half = ids.shape[0] // 2
+        locals_ = [ids[:half], ids[half:]]
+        stacked = np.stack(locals_)
+        prepped = []
+        for p in range(2):
+            ctxs[p].gathered = stacked
+            prepped.append(mgrs[p].prepare({"feature": locals_[p]}))
+        cap = prepped[0]["edl_embedding" + ROWS_SUFFIX].shape[0]
+        gf = {
+            "feature": ids,
+        }
+        for key in ("edl_embedding", "edl_id_bias"):
+            gf[key + ROWS_SUFFIX] = np.concatenate(
+                [pr[key + ROWS_SUFFIX] for pr in prepped]
+            )
+            gf[key + IDX_SUFFIX] = np.concatenate(
+                [pr[key + IDX_SUFFIX] for pr in prepped]
+            )
+        if init_only:
+            return gf
+        gw = np.ones((ids.shape[0],), np.float32)
+        state, loss, host_grads = spmd._run_train_step(
+            state, gf, labels, gw
         )
+        for p in range(2):
+            mgrs[p].apply(host_grads)
+        return state, float(loss)
+
+    gf0 = run_round(None, batches[0], init_only=True)
+    spmd_state = spmd.init_state((gf0, batches[0][1]))
+    spmd_losses = []
+    for b in batches:
+        spmd_state, loss = run_round(spmd_state, b)
+        spmd_losses.append(loss)
+
+    np.testing.assert_allclose(spmd_losses, base_losses, rtol=1e-5,
+                               atol=1e-6)
+
+    # ownership is disjoint+exhaustive and the union matches the baseline
+    for table in ("edl_embedding", "edl_id_bias"):
+        base_ids, base_vals = (
+            base_mgr.tables()[table].engine.param.export_rows()
+        )
+        merged = {}
+        for p in range(2):
+            ids_p, vals_p = (
+                mgrs[p].tables()[table].engine.param.export_rows()
+            )
+            assert np.all(owner_of(ids_p, 2) == p)
+            merged.update(zip(ids_p.tolist(), vals_p))
+        assert sorted(merged) == sorted(base_ids.tolist())
+        base_map = dict(zip(base_ids.tolist(), base_vals))
+        for i in merged:
+            np.testing.assert_allclose(
+                merged[i], base_map[i], rtol=1e-5, atol=1e-6
+            )
+
+
+def test_spmd_host_state_repartitions_on_load():
+    """A checkpoint written by 2 partitioned hosts restores onto 1 host
+    (merge) and back onto a 2-host manager (filter to owned) — the
+    host-tier analogue of the re-shardable dense checkpoint."""
+    ctxs = [_FakeSPMDCtx(0), _FakeSPMDCtx(1)]
+    mgrs = [_spmd_host_manager(c) for c in ctxs]
+    # touch disjoint owned rows on each "host"
+    for p, mgr in enumerate(mgrs):
+        eng = mgr.tables()["edl_embedding"].engine
+        ids = np.asarray([i for i in range(20) if i % 2 == p], np.int64)
+        eng.pull(ids)
+        eng.apply_gradients(ids, np.ones((ids.size, DIM), np.float32))
+    flat = {}
+    for mgr in mgrs:
+        flat.update(mgr.flat_state())
+
+    # restore into a single-process manager: gets ALL rows
+    single = HostEmbeddingManager()
+    single.register(
+        "edl_embedding", "feature",
+        HostSpillEmbeddingEngine(DIM, optimizer="sgd", lr=0.1),
+    )
+    single.register(
+        "edl_id_bias", "feature",
+        HostSpillEmbeddingEngine(1, optimizer="sgd", lr=0.1),
+    )
+    single.load_flat_state(flat)
+    ids, vals = single.tables()["edl_embedding"].engine.param.export_rows()
+    assert sorted(ids.tolist()) == list(range(20))
+
+    # restore the single-process state back into partitioned managers:
+    # each keeps only its owned ids
+    single_flat = single.flat_state()
+    for p in range(2):
+        fresh = _spmd_host_manager(_FakeSPMDCtx(p))
+        fresh.load_flat_state(single_flat)
+        got, _ = fresh.tables()["edl_embedding"].engine.param.export_rows()
+        assert sorted(got.tolist()) == [i for i in range(20) if i % 2 == p]
 
 
 def test_apply_before_prepare_raises():
