@@ -20,11 +20,46 @@ import jax
 import jax.numpy as jnp
 
 
+class _LRUCache(dict):
+    """Insertion-ordered bounded cache for compiled decode fns. Every
+    distinct (batch, sampling-knob, length) combination compiles its own
+    executable; a sweep over sampling configs or prompt lengths would
+    otherwise accumulate compiled programs on the Trainer without bound.
+    get() refreshes recency; inserting beyond max_entries evicts the
+    least-recently-used entry (its executable is re-compiled on next
+    use — correctness is unaffected)."""
+
+    max_entries = 16
+
+    def get(self, key, default=None):
+        if key in self:
+            val = super().pop(key)
+            super().__setitem__(key, val)
+            return val
+        return default
+
+    def __setitem__(self, key, value):
+        if key in self:
+            super().pop(key)
+        elif len(self) >= self.max_entries:
+            super().pop(next(iter(self)))
+        super().__setitem__(key, value)
+
+
+def _decode_cache(trainer):
+    return trainer.__dict__.setdefault("_generate_cache", _LRUCache())
+
+
 def _filter_logits(logits, top_k, top_p):
     """Standard sampling filters, static-shape: top-k keeps the k
     highest logits per row; nucleus (top-p) keeps the smallest set of
     tokens whose cumulative probability reaches p (always at least the
-    argmax). Filtered entries drop to -inf before the categorical."""
+    argmax). Filtered entries drop to -inf before the categorical.
+
+    Tie semantics (the usual static-shape formulation): every logit
+    EQUAL to the k-th value survives top-k (>= k tokens on ties), and
+    ties at the nucleus threshold likewise all survive — with float
+    logits exact ties are measure-zero, so in practice exactly k."""
     neg = jnp.asarray(-jnp.inf, logits.dtype)
     if top_k and top_k > 0:
         k = min(int(top_k), logits.shape[-1])  # clamp to the vocab
@@ -138,7 +173,7 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
     # ride as traced scalars (lax.fori_loop accepts them under jit), so
     # every prompt/continuation length reuses the same executable.
     # Variables ride as arguments so params aren't baked in as constants.
-    cache = trainer.__dict__.setdefault("_generate_cache", {})
+    cache = _decode_cache(trainer)
     key = (b, float(temperature), int(top_k), float(top_p))
     decode_fn = cache.get(key)
     if decode_fn is None:
@@ -186,7 +221,7 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed,
     b = prompt.shape[0]
     seq_len = model.seq_len
 
-    cache = trainer.__dict__.setdefault("_generate_cache", {})
+    cache = _decode_cache(trainer)
     key = ("kv", b, total, float(temperature), int(top_k),
            float(top_p))
     fn = cache.get(key)
@@ -285,7 +320,7 @@ def beam_search_generate(trainer, state, prompt, max_new_tokens,
             "num_beams must be in [1, vocab_size], got %d" % k
         )
 
-    cache = trainer.__dict__.setdefault("_generate_cache", {})
+    cache = _decode_cache(trainer)
     key = ("beam", b, k)
     fn = cache.get(key)
     if fn is None:

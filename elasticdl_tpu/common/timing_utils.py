@@ -56,7 +56,13 @@ def fetch_sync(tree):
     trustworthy device sync over tunneled PJRT plugins, where
     block_until_ready can return before execution finishes (observed
     reading >10 TB/s effective HBM on small ops). Shared by bench.py and
-    the scripts/bench_* microbenchmarks so the workaround lives once."""
+    the scripts/bench_* microbenchmarks so the workaround lives once.
+
+    Assumes ONE jit executable produced the whole tree: fetching the
+    first leaf is a barrier only because a single executable's output
+    buffers complete together. Timing a multi-executable region (e.g.
+    host-spill callbacks or separate sparse updates) needs one fetched
+    scalar per distinct executable output, or it under-reports."""
     import jax
     import numpy as np
 

@@ -36,10 +36,13 @@ def topk_dispatch(router_logits, capacity, k=1):
     scalar, stats dict). Each token routes to its k highest-probability
     experts; capacity queues fill primary choices first (all rank-0
     picks, then rank-1, ...), so under load the second choices are the
-    ones dropped — GShard's policy. Combine weights are the chosen
-    experts' router probs renormalized over the *kept* choices; a token
-    whose every choice was dropped has an all-zero combine row and rides
-    the residual only.
+    ones dropped — GShard's policy. Combine weights follow GShard's
+    g1/g2 normalization: each chosen expert's router prob is normalized
+    over ALL k chosen experts BEFORE capacity drops, so a dropped choice
+    contributes zero while the surviving choice keeps its pre-drop
+    weight (e.g. p2/(p1+p2) — never amplified to 1.0). A token whose
+    every choice was dropped has an all-zero combine row and rides the
+    residual only.
     """
     t, e = router_logits.shape
     if not 1 <= k <= e:
@@ -70,15 +73,19 @@ def topk_dispatch(router_logits, capacity, k=1):
     dispatch = dispatch_flat.reshape(k, t, e, capacity).sum(0)  # [T,E,C]
 
     # combine weights: k=1 keeps the raw chosen prob (Switch eq. 2 — the
-    # magnitude is the router's gradient path); k>1 renormalizes the
-    # kept choices' probs per token (GShard's g1/g2 normalization)
-    kept = kept_flat.reshape(k, t, e).sum(0)  # [T, E]
-    gates = probs * kept
+    # magnitude is the router's gradient path); k>1 normalizes each
+    # chosen prob over the CHOSEN set before capacity drops (GShard
+    # g1/g2): a capacity-dropped primary zeroes its own weight but does
+    # not inflate the secondary's.
+    kept = kept_flat.reshape(k, t, e).sum(0)  # [T, E] post-drop
+    chosen = flat.reshape(k, t, e).sum(0)     # [T, E] pre-drop
     if k == 1:
-        combine = dispatch * gates[..., None]
+        combine = dispatch * (probs * kept)[..., None]
     else:
-        denom = jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
-        combine = dispatch * (gates / denom)[..., None]
+        denom = jnp.maximum(
+            jnp.sum(probs * chosen, axis=-1, keepdims=True), 1e-9
+        )
+        combine = dispatch * (probs * kept / denom)[..., None]
 
     # Switch aux loss on the primary choice: E * sum_e frac_e * prob_e
     fraction = jnp.mean(onehots[0], axis=0)
@@ -132,7 +139,8 @@ def moe_reference(params, x, capacity_factor=1.25,
     """Oracle: loop over tokens/experts in plain numpy-style code (tests
     compare the einsum formulation against this). Mirrors topk_dispatch:
     rank-0 choices claim capacity before rank-1, combine weights are raw
-    probs for k=1 and renormalized over kept choices for k>1."""
+    probs for k=1 and, for k>1, normalized over the CHOSEN (pre-drop)
+    experts — GShard g1/g2, drops zero their own weight only."""
     import numpy as np
 
     x = np.asarray(x, np.float32)
@@ -168,7 +176,12 @@ def moe_reference(params, x, capacity_factor=1.25,
     for ti in range(t):
         if not kept[ti]:
             continue
-        denom = sum(p for _, p in kept[ti]) if k > 1 else 1.0
+        # g1/g2: normalize over the CHOSEN experts, drops excluded from
+        # the numerator only
+        denom = (
+            sum(probs[ti, int(order[ti, r])] for r in range(k))
+            if k > 1 else 1.0
+        )
         for ei, p in kept[ti]:
             y[ti] += (p / denom) * expert_out(ti, ei)
     return y
