@@ -120,6 +120,8 @@ class HostEmbeddingManager(object):
         self._tables = {}
         self.pad_multiple = int(pad_multiple)
         self._spmd_ctx = None
+        # gradient-accumulation staging: {table: [(ids, grads), ...]}
+        self._staged = {}
 
     def register(self, name, ids_feature, engine):
         if name in self._tables:
@@ -256,25 +258,62 @@ class HostEmbeddingManager(object):
         # never retries an apply (trainer.train_step logs and moves on),
         # so a partial step degrades to "those rows missed one update"
         # rather than double-applying.
-        ctx = self._spmd_ctx
-        staged = []
-        for name, t in self._tables.items():
-            if t.last_unique is None:
-                raise RuntimeError(
-                    "apply() before prepare() for host table %r" % name
-                )
-            # replicated output: np.asarray works across hosts too
-            grads = np.asarray(host_grads[name + ROWS_SUFFIX])
-            if ctx is not None:
-                # global [nproc*cap, dim] -> this host's rows, in the
-                # local order prepare laid them out
-                grads = grads[ctx.rows_positions(grads.shape[0])[
-                    ctx.process_index]]
-            staged.append((t, grads[: t.last_unique.size]))
+        staged = self._local_row_grads(host_grads)
         for t, grads in staged:
             t.engine.apply_gradients(
                 t.last_unique, grads, lr_scale=lr_scale
             )
+
+    # ------------------------------------------- gradient accumulation
+
+    def _local_row_grads(self, host_grads):
+        """Materialize each table's row grads for THIS host (SPMD mode
+        slices the replicated global output down to the owned block)."""
+        ctx = self._spmd_ctx
+        out = []
+        for name, t in self._tables.items():
+            if t.last_unique is None:
+                raise RuntimeError(
+                    "apply()/stage() before prepare() for host table %r"
+                    % name
+                )
+            grads = np.asarray(host_grads[name + ROWS_SUFFIX])
+            if ctx is not None:
+                grads = grads[ctx.rows_positions(grads.shape[0])[
+                    ctx.process_index]]
+            out.append((t, grads[: t.last_unique.size]))
+        return out
+
+    def stage(self, host_grads, weight=1.0):
+        """Accumulate one microbatch's row grads (times `weight`, e.g.
+        1/accum_steps so the macro apply is the mean) without touching
+        the engines. Paired with apply_staged at the macro boundary.
+        Staged grads live in process memory only: a preemption inside an
+        accumulation cycle drops the partial cycle — the same
+        miss-one-update degradation the non-accumulated apply path
+        accepts on failure."""
+        for t, grads in self._local_row_grads(host_grads):
+            self._staged.setdefault(t.name, []).append(
+                (t.last_unique.copy(), grads * weight)
+            )
+
+    def apply_staged(self, lr_scale=1.0):
+        """Apply all staged microbatches in ONE engine update per table
+        (dedup-summed across microbatches), advancing each engine's step
+        once per macro step — the schedule every other tier follows."""
+        from elasticdl_tpu.common.tensor_utils import (
+            deduplicate_indexed_slices,
+        )
+
+        staged, self._staged = self._staged, {}
+        for name, t in self._tables.items():
+            pairs = staged.get(name, [])
+            if not pairs:
+                continue
+            ids = np.concatenate([p[0] for p in pairs])
+            grads = np.concatenate([p[1] for p in pairs])
+            summed, uniq = deduplicate_indexed_slices(grads, ids)
+            t.engine.apply_gradients(uniq, summed, lr_scale=lr_scale)
 
     # -------------------------------------------------------- checkpoint
 

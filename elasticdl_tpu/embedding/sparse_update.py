@@ -197,6 +197,31 @@ def extract_ids(ids_collection, perturb_path):
     return ids
 
 
+def apply_flat_row_updates(row_tx, params, embed_opt_state, staged,
+                           sparse_paths):
+    """Row-sparse update from pre-flattened (ids, grads) per table —
+    the macro-step application of gradient accumulation (the trainer
+    stages each microbatch's row grads host-side and applies the
+    concatenation once per cycle; dedup_indexed_slices inside
+    row_sparse_apply sums repeats across microbatches).
+
+    staged: {table_path_str: (ids [m], grads [m, dim])}.
+    Returns (new_params, new_embed_opt_state).
+    """
+    new_params = params
+    new_embed = dict(embed_opt_state)
+    for table_path, _ in sorted(sparse_paths.items()):
+        key = path_str(table_path)
+        ids, grads = staged[key]
+        new_table, new_state = row_sparse_apply(
+            row_tx, _get_path(params, table_path), embed_opt_state[key],
+            ids, grads,
+        )
+        new_params = _set_path(new_params, table_path, new_table)
+        new_embed[key] = new_state
+    return new_params, new_embed
+
+
 def apply_row_updates(row_tx, params, embed_opt_state, perturb_grads,
                       ids_collection, sparse_paths):
     """Run the row-sparse update for every tapped table.

@@ -126,6 +126,9 @@ class Trainer(object):
         self._eval_step = None
         self._predict_step = None
         self._state_sharding = None
+        self._defer_sparse = False
+        self._sparse_stage = []
+        self._apply_rows_fn = None
         # Host-spill embedding bridge (embedding/host_bridge.py): pulls
         # rows before the compiled step, applies row grads after it.
         self._host_manager = None
@@ -204,24 +207,25 @@ class Trainer(object):
             self.tx, set(self._sparse_paths)
         )
         if self.grad_accum_steps > 1:
-            if self._sparse_paths or self._host_manager:
-                # Sparse-row and host-spill tiers apply per microbatch
-                # while MultiSteps defers the dense tier — an LR schedule
-                # would advance at different rates per tier, and the
-                # accumulator would hold O(vocab*dim) zeros for tapped
-                # tables. The reference likewise forces get_model_steps=1
-                # outside plain async dense training (common/args.py:156).
-                raise ValueError(
-                    "grad_accum_steps > 1 requires a dense-only model: "
-                    "sparse-tapped / host-spill embedding tables update "
-                    "every microbatch and would train on a divergent "
-                    "schedule"
-                )
+            # Every tier shares ONE schedule (k microbatches -> one
+            # applied update): the dense tier through optax.MultiSteps
+            # (mean of k grads), the sparse-row tier by staging each
+            # microbatch's (ids, row grads)/k host-side and applying the
+            # concatenation at the macro boundary (apply_flat_row_updates
+            # — dedup sums across microbatches), and the host-spill tier
+            # via HostEmbeddingManager.stage/apply_staged. Engines and
+            # row_tx step counters therefore advance once per macro step,
+            # exactly like a k-times-larger batch.
             import optax
 
             self._train_tx = optax.MultiSteps(
                 self._train_tx, every_k_schedule=self.grad_accum_steps
             )
+        self._defer_sparse = bool(
+            self._sparse_paths and self.grad_accum_steps > 1
+        )
+        self._sparse_stage = []
+        self._apply_rows_fn = None
 
         def init_fn(rng, feats):
             from flax.linen import meta as nn_meta
@@ -350,11 +354,33 @@ class Trainer(object):
                 updates,
             )
             embed_opt = state.embed_opt_state
-            if sparse_paths:
+            sparse_aux = {}
+            if sparse_paths and not self._defer_sparse:
                 new_params, embed_opt = sparse_update.apply_row_updates(
                     self._base_tx, new_params, embed_opt,
                     perturb_grads, ids, sparse_paths,
                 )
+            elif sparse_paths:
+                # gradient accumulation: defer the row update — emit this
+                # microbatch's (ids, row grads) per table for host-side
+                # staging; the macro boundary applies the concatenation
+                # (apply_flat_row_updates)
+                pg_flat = {}
+                from flax import traverse_util
+
+                flat = traverse_util.flatten_dict(dict(perturb_grads))
+                for table_path, perturb_path in sparse_paths.items():
+                    key = sparse_update.path_str(table_path)
+                    ids_flat = jnp.asarray(
+                        sparse_update.extract_ids(ids, perturb_path),
+                        jnp.int32,
+                    ).reshape(-1)
+                    grads = flat[perturb_path]
+                    pg_flat[key] = (
+                        ids_flat,
+                        grads.reshape(ids_flat.shape[0], -1),
+                    )
+                sparse_aux = pg_flat
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,
@@ -362,13 +388,13 @@ class Trainer(object):
                 model_state=FrozenDict(new_model_state),
                 embed_opt_state=embed_opt,
             )
-            return new_state, loss_val, host_grads
+            return new_state, loss_val, host_grads, sparse_aux
 
         return jax.jit(
             train_step,
             donate_argnums=(0,),
             in_shardings=(self._state_sharding, batch_sh, batch_sh, batch_sh),
-            out_shardings=(self._state_sharding, repl, repl),
+            out_shardings=(self._state_sharding, repl, repl, repl),
         )
 
     def _build_eval_step(self):
@@ -397,22 +423,105 @@ class Trainer(object):
         weights = _make_weights(bsz, true_count)
         self._reject_spmd_host_local_path("train_step")
         features = self._host_prepare(features)
-        scale = self._host_lr_scale(state)
-        state, loss, host_grads = self._run_train_step(
+        # int(state.step) forces a host sync (blocks on the previous
+        # step's output); only pay it when a host/sparse tier actually
+        # consumes it, so dense models keep async dispatch overlap
+        tiers = self._host_manager is not None or self._defer_sparse
+        pre_step = int(state.step) if tiers else 0
+        scale = self._host_lr_scale(pre_step) if tiers else 1.0
+        state, loss, host_grads, sparse_aux = self._run_train_step(
             state, features, labels, weights
         )
-        self._host_apply(host_grads, scale)
+        if tiers:
+            state = self._post_step_tiers(
+                pre_step, state, host_grads, sparse_aux, scale
+            )
         return state, loss
 
-    def _host_lr_scale(self, state):
+    def _host_lr_scale(self, pre_step):
         """scale_by_schedule counts applied updates from 0, i.e. the
-        pre-update step number — mirror it for the host tier. The
-        multiplier runs BEFORE the donating compiled step: a user
-        schedule that raises must fail while the caller's state
-        buffers are still alive and the batch retryable."""
+        pre-update step number — mirror it for the host tier (under
+        gradient accumulation: the macro-step index). The multiplier
+        runs BEFORE the donating compiled step: a user schedule that
+        raises must fail while the caller's state buffers are still
+        alive and the batch retryable."""
         if self._host_manager and self._lr_multiplier_fn is not None:
-            return float(self._lr_multiplier_fn(int(state.step)))
+            return float(
+                self._lr_multiplier_fn(pre_step // self.grad_accum_steps)
+            )
         return 1.0
+
+    def _post_step_tiers(self, pre_step, state, host_grads, sparse_aux,
+                         scale):
+        """Apply (or stage) the host-spill and sparse-row tiers after
+        the compiled step. With grad_accum_steps == 1 this is the
+        immediate apply; otherwise each microbatch stages its row grads
+        weighted 1/k and the macro boundary (every k-th microbatch)
+        applies the merged cycle, keeping every tier on the MultiSteps
+        schedule."""
+        accum = self.grad_accum_steps
+        boundary = accum == 1 or pre_step % accum == accum - 1
+        if self._host_manager:
+            if accum == 1:
+                self._host_apply(host_grads, scale)
+            else:
+                try:
+                    self._host_manager.stage(host_grads,
+                                             weight=1.0 / accum)
+                    if boundary:
+                        self._host_manager.apply_staged(lr_scale=scale)
+                except Exception:
+                    logger.exception(
+                        "host-embedding stage/apply failed; affected "
+                        "rows miss this cycle (no retry: state donated)"
+                    )
+        if self._defer_sparse:
+            self._sparse_stage.append(
+                jax.tree.map(np.asarray, sparse_aux)
+            )
+            if boundary:
+                state = self._apply_sparse_staged(state)
+        return state
+
+    def _apply_sparse_staged(self, state):
+        """Macro-boundary sparse-row apply: concatenate the staged
+        microbatches per table (grads pre-scaled by 1/k at stage time)
+        and run ONE row_sparse update — identical math to a k-times
+        batch (dedup sums repeats across microbatches; row_tx scalar
+        step advances once)."""
+        from elasticdl_tpu.embedding import sparse_update
+
+        staged, self._sparse_stage = self._sparse_stage, []
+        merged = {}
+        for key in staged[0]:
+            ids = np.concatenate([m[key][0] for m in staged])
+            grads = np.concatenate(
+                [m[key][1] / self.grad_accum_steps for m in staged]
+            )
+            merged[key] = (ids, grads)
+        if self._apply_rows_fn is None:
+            repl = mesh_lib.replicated(self.mesh)
+
+            def apply_rows(state, merged):
+                new_params, new_embed = (
+                    sparse_update.apply_flat_row_updates(
+                        self._base_tx, state.params,
+                        state.embed_opt_state, merged,
+                        self._sparse_paths,
+                    )
+                )
+                return state.replace(
+                    params=new_params, embed_opt_state=new_embed
+                )
+
+            self._apply_rows_fn = jax.jit(
+                apply_rows,
+                donate_argnums=(0,),
+                in_shardings=(self._state_sharding, repl),
+                out_shardings=self._state_sharding,
+            )
+        with self.mesh:
+            return self._apply_rows_fn(state, merged)
 
     def _host_apply(self, host_grads, scale):
         """Apply host-tier row grads after the compiled step. A failure
@@ -444,11 +553,16 @@ class Trainer(object):
         host_manager.prepare BEFORE assembling, since the multi-host
         prepare is itself a host-level collective); the row grads are
         applied here, each host updating its owned id partition."""
-        scale = self._host_lr_scale(state)
-        state, loss, host_grads = self._run_train_step(
+        tiers = self._host_manager is not None or self._defer_sparse
+        pre_step = int(state.step) if tiers else 0
+        scale = self._host_lr_scale(pre_step) if tiers else 1.0
+        state, loss, host_grads, sparse_aux = self._run_train_step(
             state, features, labels, weights
         )
-        self._host_apply(host_grads, scale)
+        if tiers:
+            state = self._post_step_tiers(
+                pre_step, state, host_grads, sparse_aux, scale
+            )
         return state, loss
 
     def _run_train_step(self, state, features, labels, weights):

@@ -79,13 +79,8 @@ def test_accum_training_reduces_loss():
     assert int(state.step) == 24
 
 
-def test_accum_rejects_sparse_tapped_models():
-    """Sparse-row tables update every microbatch; combining them with a
-    deferred dense update would train tiers on divergent schedules, so
-    init_state must fail fast (reference forces get_model_steps=1 outside
-    plain async dense training, common/args.py:156)."""
+def _sparse_spec():
     import optax
-    import pytest
     from flax import linen as nn
 
     from elasticdl_tpu.common.model_utils import ModelSpec
@@ -97,25 +92,126 @@ def test_accum_rejects_sparse_tapped_models():
             emb = Embedding(
                 input_dim=64, output_dim=8, sparse_grads=True, name="cat"
             )(features["ids"])
-            return nn.Dense(1, name="out")(emb)[:, 0]
+            return nn.Dense(1, name="out")(emb.mean(axis=1))[:, 0]
 
-    spec = ModelSpec(
+    return ModelSpec(
         model_fn=Rec,
         dataset_fn=lambda ds, mode, meta: ds,
-        loss=lambda y, p: ((p - y) ** 2).mean(),
+        loss=lambda y, p, w: (w * (p - y) ** 2).sum() / w.sum(),
         optimizer=lambda: optax.sgd(0.1),
         eval_metrics_fn=lambda: {},
     )
-    trainer = Trainer(
-        spec, mesh=mesh_lib.local_mesh(), grad_accum_steps=2
-    )
+
+
+def test_accum_sparse_row_parity():
+    """Sparse-tapped tables under accumulation: k microbatches stage
+    their dedup'd row grads and apply once per macro step — the final
+    table, dense params, AND row-optimizer slots must equal the one
+    big-batch update (VERDICT round-2 item #6; reference local-update
+    semantics, worker.py:822-828)."""
     rs = np.random.RandomState(0)
-    batch = (
-        {"ids": rs.randint(0, 16, size=(8, 4)).astype(np.int32)},
-        rs.rand(8).astype(np.float32),
+    ids = rs.randint(0, 16, size=(8, 4)).astype(np.int32)
+    labels = rs.rand(8).astype(np.float32)
+
+    big = Trainer(_sparse_spec(), mesh=mesh_lib.local_mesh())
+    s_big = big.init_state(({"ids": ids}, labels))
+    s_big, _ = big.train_step(s_big, ({"ids": ids}, labels))
+
+    acc = Trainer(_sparse_spec(), mesh=mesh_lib.local_mesh(),
+                  grad_accum_steps=2)
+    s_acc = acc.init_state(({"ids": ids[:4]}, labels[:4]))
+    table0 = np.asarray(
+        jax.tree.leaves(s_acc.params["cat"])[0]
+    ).copy()
+    s_acc, _ = acc.train_step(s_acc, ({"ids": ids[:4]}, labels[:4]))
+    # non-boundary microbatch: the embedding table must not move
+    np.testing.assert_array_equal(
+        table0, np.asarray(jax.tree.leaves(s_acc.params["cat"])[0])
     )
-    with pytest.raises(ValueError, match="dense-only"):
-        trainer.init_state(batch)
+    s_acc, _ = acc.train_step(s_acc, ({"ids": ids[4:]}, labels[4:]))
+
+    for a, b in zip(
+        jax.tree.leaves(s_big.params), jax.tree.leaves(s_acc.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+    for a, b in zip(
+        jax.tree.leaves(s_big.embed_opt_state),
+        jax.tree.leaves(s_acc.embed_opt_state),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_accum_host_spill_parity():
+    """Host-spill tables under accumulation: staged row grads (weighted
+    1/k) apply through the engines once per macro step; the trained
+    host rows must equal one big-batch step's."""
+    from elasticdl_tpu.common.model_utils import (
+        format_params_str,
+        load_model_spec_from_module,
+    )
+    from elasticdl_tpu.embedding.host_bridge import (
+        HostEmbeddingManager,
+    )
+    from elasticdl_tpu.embedding.host_spill import HostSpillEmbeddingEngine
+    from model_zoo.deepfm_host_embedding import deepfm_host_embedding as z
+
+    def build(accum):
+        spec = load_model_spec_from_module(z)
+        tr = Trainer(
+            spec, mesh=mesh_lib.local_mesh(),
+            model_params=format_params_str(
+                dict(input_length=5, fc_unit=4)
+            ),
+            grad_accum_steps=accum,
+        )
+        mgr = HostEmbeddingManager()
+        mgr.register(
+            "edl_embedding", "feature",
+            HostSpillEmbeddingEngine(8, optimizer="sgd", lr=0.1),
+        )
+        mgr.register(
+            "edl_id_bias", "feature",
+            HostSpillEmbeddingEngine(1, optimizer="sgd", lr=0.1),
+        )
+        tr.attach_host_embeddings(mgr)
+        return tr, mgr
+
+    rs = np.random.RandomState(3)
+    ids = rs.randint(0, 40, size=(8, 5)).astype(np.int32)
+    labels = rs.randint(0, 2, size=(8,)).astype(np.int32)
+
+    big, big_mgr = build(1)
+    s_big = big.init_state(({"feature": ids}, labels))
+    s_big, _ = big.train_step(s_big, ({"feature": ids}, labels))
+
+    acc, acc_mgr = build(2)
+    s_acc = acc.init_state(({"feature": ids[:4]}, labels[:4]))
+    s_acc, _ = acc.train_step(s_acc, ({"feature": ids[:4]}, labels[:4]))
+    # mid-cycle: engines untouched, step counters unmoved
+    assert acc_mgr.tables()["edl_embedding"].engine._step == 0
+    s_acc, _ = acc.train_step(s_acc, ({"feature": ids[4:]}, labels[4:]))
+    assert acc_mgr.tables()["edl_embedding"].engine._step == 1
+
+    for table in ("edl_embedding", "edl_id_bias"):
+        bids, bvals = big_mgr.tables()[table].engine.param.export_rows()
+        aids, avals = acc_mgr.tables()[table].engine.param.export_rows()
+        bmap = dict(zip(bids.tolist(), bvals))
+        amap = dict(zip(aids.tolist(), avals))
+        assert sorted(bmap) == sorted(amap)
+        for i in bmap:
+            np.testing.assert_allclose(
+                amap[i], bmap[i], rtol=1e-5, atol=1e-7
+            )
+    for a, b in zip(
+        jax.tree.leaves(s_big.params), jax.tree.leaves(s_acc.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
 
 
 def test_get_model_steps_cli_alias():

@@ -363,7 +363,7 @@ def test_spmd_host_embedding_parity():
         if init_only:
             return gf
         gw = np.ones((ids.shape[0],), np.float32)
-        state, loss, host_grads = spmd._run_train_step(
+        state, loss, host_grads, _ = spmd._run_train_step(
             state, gf, labels, gw
         )
         for p in range(2):
