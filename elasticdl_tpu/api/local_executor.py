@@ -12,6 +12,7 @@ import numpy as np
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.data.dataset import Dataset, pad_batch
+from elasticdl_tpu.common.model_utils import resolve_dataset_fn
 from elasticdl_tpu.data.reader.data_reader_factory import create_data_reader
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher, TaskType
 from elasticdl_tpu.training.metrics import MetricsAggregator
@@ -94,7 +95,9 @@ class LocalExecutor(object):
 
     def _task_dataset(self, reader, task, mode):
         ds = Dataset.from_generator(lambda: reader.read_records(task))
-        ds = self.spec.dataset_fn(ds, mode, reader.metadata)
+        ds = resolve_dataset_fn(self.spec, reader)(
+            ds, mode, reader.metadata
+        )
         # background-thread prefetch overlaps host parsing with the
         # device step (the worker does the same — worker.py)
         return ds.batch(self.minibatch_size).prefetch(1)

@@ -145,7 +145,10 @@ def get_model_spec(
     ) else prediction_outputs_processor
     return ModelSpec(
         model_fn=model_fn,
-        dataset_fn=_get_spec_value(dataset_fn, model_zoo, module, required=True),
+        # dataset_fn may be omitted when the data reader provides a
+        # schema-driven default (resolve_dataset_fn; reference
+        # worker.py:194-205 falls back to reader.default_dataset_fn)
+        dataset_fn=_get_spec_value(dataset_fn, model_zoo, module),
         loss=_get_spec_value(loss, model_zoo, module, required=True),
         optimizer=_get_spec_value(optimizer, model_zoo, module, required=True),
         eval_metrics_fn=_get_spec_value(
@@ -168,7 +171,7 @@ def load_model_spec_from_module(module):
     d = module.__dict__
     return ModelSpec(
         model_fn=d["custom_model"],
-        dataset_fn=d["dataset_fn"],
+        dataset_fn=d.get("dataset_fn"),
         loss=d["loss"],
         optimizer=d["optimizer"],
         eval_metrics_fn=d["eval_metrics_fn"],
@@ -179,3 +182,20 @@ def load_model_spec_from_module(module):
         module=module,
         host_embeddings_fn=d.get("host_embeddings"),
     )
+
+
+def resolve_dataset_fn(spec, reader):
+    """spec.dataset_fn, else the reader's schema-driven default — a
+    reader (e.g. data/reader/odps_reader.ODPSDataReader) may derive a
+    dataset_fn from table metadata (reference worker.py:194-205 falls
+    back to data_reader.default_dataset_fn()). Resolved once and cached
+    on the spec so the returned closure is stable across tasks."""
+    if spec.dataset_fn is None:
+        default = getattr(reader, "default_dataset_fn", None)
+        if default is None:
+            raise ValueError(
+                "dataset_fn is required if the data reader used does "
+                "not provide a default implementation of dataset_fn"
+            )
+        spec.dataset_fn = default()
+    return spec.dataset_fn

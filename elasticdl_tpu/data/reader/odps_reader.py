@@ -19,6 +19,8 @@ or install pyodps and pass access keys."""
 import queue
 import threading
 
+import numpy as np
+
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.data.reader.data_reader import (
     AbstractDataReader,
@@ -81,6 +83,47 @@ class ODPSReader(object):
                     start, count, e,
                 )
         raise last_error
+
+    def to_iterator(self, num_workers, worker_index, batch_size,
+                    epochs=1, shuffle=False, limit=-1, table_size=None):
+        """Yield record batches for ONE worker of a fleet — the
+        reference's standalone consumption surface
+        (odps_io.py:222-324 `to_iterator`): the table's row space is cut
+        into large windows, windows are split round-robin over
+        `num_workers`, optionally shuffled, repeated for `epochs`, and
+        this worker's windows stream through the prefetching reader and
+        re-chunk into `batch_size` slices."""
+        if not 0 <= worker_index < num_workers:
+            raise ValueError(
+                "index of worker should be in [0, number of workers)"
+            )
+        if batch_size <= 0:
+            raise ValueError("batch_size should be positive")
+        if table_size is None:
+            with self._table.open_reader() as reader:
+                table_size = reader.count
+        if 0 < limit < table_size:
+            table_size = limit
+        window = max(self._window_size, batch_size)
+        starts = list(range(0, table_size, window))
+        if len(starts) < num_workers:
+            # fall back to one window per worker (reference behavior for
+            # tiny tables)
+            window = max(1, table_size // num_workers)
+            starts = list(range(0, table_size, window))
+        my_starts = [
+            s for i, s in enumerate(starts) if i % num_workers ==
+            worker_index
+        ]
+        if shuffle:
+            import random
+
+            random.shuffle(my_starts)
+        my_starts = my_starts * max(1, int(epochs))
+        for s in my_starts:
+            rows = list(self.read_range(s, min(s + window, table_size)))
+            for i in range(0, len(rows), batch_size):
+                yield rows[i:i + batch_size]
 
     def read_range(self, start, end):
         """Yield rows of [start, end) with windows fetched ahead on a
@@ -146,6 +189,7 @@ class ODPSDataReader(AbstractDataReader):
         self._records_per_task = records_per_task
         self._parse_fn = parse_fn
         self._columns = columns
+        self._kwargs = kwargs
         self._reader = ODPSReader(
             table, num_prefetch=num_prefetch, window_size=window_size
         )
@@ -184,3 +228,51 @@ class ODPSDataReader(AbstractDataReader):
             c.name: str(getattr(c, "type", "")) for c in schema.columns
         }
         return Metadata(names, dtypes)
+
+    def default_dataset_fn(self):
+        """Schema-driven dataset_fn for specs that declare none
+        (reference odps_reader.py:140-192 `default_dataset_fn`): every
+        column parses to float32, the `label_col` named in the reader
+        params becomes the label, and the remaining columns concatenate
+        into the feature vector. Prediction mode drops the label (or
+        passes all columns through when the table has none); training
+        shuffles with the reference's buffer of 200."""
+        from elasticdl_tpu.common.constants import Mode
+        from elasticdl_tpu.data.reader.data_reader import (
+            check_required_kwargs,
+        )
+
+        check_required_kwargs(["label_col"], self._kwargs)
+        label_col = self._kwargs["label_col"]
+
+        def dataset_fn(dataset, mode, metadata):
+            names = list(metadata.column_names or [])
+            label_idx = names.index(label_col) if label_col in names \
+                else None
+            if mode != Mode.PREDICTION and label_idx is None:
+                raise ValueError(
+                    "Missing the label column '%s' in the retrieved "
+                    "ODPS table during %s mode." % (label_col, mode)
+                )
+
+            def parse(record):
+                row = np.asarray(
+                    [float(v) for v in record], np.float32
+                )
+                if mode == Mode.PREDICTION:
+                    if label_idx is None:
+                        return {"feature": row}
+                    feats = np.delete(row, label_idx)
+                    return {"feature": feats}
+                feats = np.delete(row, label_idx)
+                return (
+                    {"feature": feats},
+                    np.float32(row[label_idx]),
+                )
+
+            dataset = dataset.map(parse)
+            if mode == Mode.TRAINING:
+                dataset = dataset.shuffle(buffer_size=200)
+            return dataset
+
+        return dataset_fn

@@ -27,6 +27,7 @@ from elasticdl_tpu.common.constants import (
     Mode,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.model_utils import resolve_dataset_fn
 from elasticdl_tpu.common.tensor_utils import serialize_ndarray_dict
 from elasticdl_tpu.common.timing_utils import Timing
 from elasticdl_tpu.data.dataset import pad_batch
@@ -287,7 +288,9 @@ class Worker(object):
             if dataset is None:
                 self._process_train_end_callback_task_if_needed()
                 break
-            dataset = self.spec.dataset_fn(
+            dataset = resolve_dataset_fn(
+                self.spec, self._task_data_service.data_reader
+            )(
                 dataset,
                 Mode.TRAINING,
                 self._task_data_service.data_reader.metadata,
@@ -410,7 +413,9 @@ class Worker(object):
                 dataset = self._task_data_service.get_dataset()
                 if dataset is None:
                     return ("done",)
-                dataset = self.spec.dataset_fn(
+                dataset = resolve_dataset_fn(
+                    self.spec, self._task_data_service.data_reader
+                )(
                     dataset,
                     Mode.TRAINING,
                     self._task_data_service.data_reader.metadata,
@@ -468,7 +473,9 @@ class Worker(object):
         from elasticdl_tpu.data.dataset import Dataset
 
         ds = Dataset.from_generator(lambda: reader.read_records(task))
-        ds = self.spec.dataset_fn(ds, mode, reader.metadata)
+        ds = resolve_dataset_fn(self.spec, reader)(
+            ds, mode, reader.metadata
+        )
         return ds.batch(self.minibatch_size)
 
     def _spmd_step(self, item):

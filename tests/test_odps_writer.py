@@ -253,3 +253,195 @@ def test_transform_kv_table_end_to_end_fake():
     assert "def process" in entry.resource_content
     # temporaries cleaned up
     assert not entry.resources and not entry.functions
+
+
+# ------------------------------- round-2 depth: import seam, schema fn
+
+
+class _NumFakeTable(_FakeTable):
+    """Fake table with a numeric schema and preloaded rows."""
+
+    def __init__(self, names, rows):
+        super().__init__()
+        self.schema = _FakeSchema(names)
+        self.partitions = {"worker=0": list(rows)}
+
+
+def _install_fake_pyodps(monkeypatch, table):
+    """Inject a fake `odps` package into sys.modules so the REAL import
+    seams (`from odps import ODPS`, `from odps.models import Schema`)
+    execute — the paths a live pyodps install would take."""
+    import sys
+    import types
+
+    created = {}
+
+    class _Client(object):
+        def __init__(self, access_id, access_key, project, endpoint):
+            self.args = (access_id, access_key, project, endpoint)
+
+        def get_table(self, name, project=None):
+            return table
+
+        def exist_table(self, name, project=None):
+            return False
+
+        def create_table(self, name, schema):
+            created["name"] = name
+            created["schema"] = schema
+            return table
+
+    class _Schema(object):
+        @staticmethod
+        def from_lists(cols, types, part_cols, part_types):
+            return ("schema", tuple(cols), tuple(types),
+                    tuple(part_cols), tuple(part_types))
+
+    odps_mod = types.ModuleType("odps")
+    odps_mod.ODPS = _Client
+    models_mod = types.ModuleType("odps.models")
+    models_mod.Schema = _Schema
+    odps_mod.models = models_mod
+    monkeypatch.setitem(sys.modules, "odps", odps_mod)
+    monkeypatch.setitem(sys.modules, "odps.models", models_mod)
+    return created
+
+
+def test_reader_import_seam_with_fake_pyodps(monkeypatch):
+    """ODPSDataReader given credentials (no table object) must go
+    through the real `from odps import ODPS` seam."""
+    table = _NumFakeTable(["a", "b"], [(1, 2), (3, 4)])
+    _install_fake_pyodps(monkeypatch, table)
+    reader = ODPSDataReader(
+        table="mytable", project="p", access_id="id", access_key="key",
+        endpoint="http://e", records_per_task=1,
+    )
+    assert reader.create_shards() == {
+        "sink:0": (0, 1), "sink:1": (1, 1)
+    }
+    from elasticdl_tpu.master.task_dispatcher import Task, TaskType
+
+    rows = list(reader.read_records(
+        Task("sink:0", 0, 2, TaskType.TRAINING)
+    ))
+    assert rows == [(1, 2), (3, 4)]
+
+
+def test_writer_import_seam_creates_table(monkeypatch):
+    """ODPSWriter without a table object exercises the real pyodps
+    import + Schema.from_lists + create_table path (reference
+    _initialize_table, odps_io.py:490-506)."""
+    table = _FakeTable()
+    created = _install_fake_pyodps(monkeypatch, table)
+    writer = ODPSWriter(
+        table_name="proj.sink", access_id="i", access_key="k",
+        endpoint="http://e", columns=["a", "b"],
+        column_types=["bigint", "string"],
+    )
+    writer.write_records([(1, "x"), (2, "y")])
+    assert created["name"] == "sink"
+    assert created["schema"][1] == ("a", "b")
+    assert created["schema"][3] == ("worker",)
+    assert sorted(table.partitions["worker=0"]) == [(1, "x"), (2, "y")]
+
+
+def test_default_dataset_fn_schema_driven():
+    """Reader-derived dataset_fn (reference odps_reader.py:140-192):
+    label_col becomes the label, remaining columns the float32 feature
+    vector; prediction mode drops the label; a missing label column
+    fails loudly in training."""
+    import numpy as np
+
+    from elasticdl_tpu.common.constants import Mode
+    from elasticdl_tpu.data.dataset import Dataset
+
+    table = _NumFakeTable(
+        ["f0", "label", "f1"],
+        [(0.5, 1, 2.0), (1.5, 0, 3.0)],
+    )
+    reader = ODPSDataReader(table=table, label_col="label")
+    fn = reader.default_dataset_fn()
+
+    ds = fn(
+        Dataset.from_list(list(table.partitions["worker=0"])),
+        Mode.EVALUATION, reader.metadata,
+    )
+    got = list(ds)
+    assert len(got) == 2
+    feats, label = got[0]
+    np.testing.assert_allclose(feats["feature"], [0.5, 2.0])
+    assert label == 1.0
+
+    ds = fn(
+        Dataset.from_list(list(table.partitions["worker=0"])),
+        Mode.PREDICTION, reader.metadata,
+    )
+    pred = list(ds)[0]
+    np.testing.assert_allclose(pred["feature"], [0.5, 2.0])
+
+    bad = ODPSDataReader(
+        table=_NumFakeTable(["f0", "f1"], [(1.0, 2.0)]),
+        label_col="label",
+    )
+    with pytest.raises(ValueError, match="label"):
+        bad.default_dataset_fn()(
+            Dataset.from_list([(1.0, 2.0)]), Mode.TRAINING, bad.metadata
+        )
+
+    with pytest.raises(ValueError, match="label_col"):
+        ODPSDataReader(table=table).default_dataset_fn()
+
+
+def test_spec_falls_back_to_reader_default_dataset_fn():
+    """Specs may omit dataset_fn when the reader derives one
+    (reference worker.py:194-205)."""
+    from elasticdl_tpu.common.model_utils import (
+        ModelSpec,
+        resolve_dataset_fn,
+    )
+
+    table = _NumFakeTable(["x", "label"], [(1.0, 0)])
+    reader = ODPSDataReader(table=table, label_col="label")
+    spec = ModelSpec(
+        model_fn=lambda: None, dataset_fn=None, loss=lambda y, p: 0,
+        optimizer=lambda: None, eval_metrics_fn=lambda: {},
+    )
+    fn = resolve_dataset_fn(spec, reader)
+    assert callable(fn)
+    assert resolve_dataset_fn(spec, reader) is fn  # cached on the spec
+
+    class _NoDefault(object):
+        pass
+
+    spec2 = ModelSpec(
+        model_fn=lambda: None, dataset_fn=None, loss=lambda y, p: 0,
+        optimizer=lambda: None, eval_metrics_fn=lambda: {},
+    )
+    with pytest.raises(ValueError, match="dataset_fn is required"):
+        resolve_dataset_fn(spec2, _NoDefault())
+
+
+def test_to_iterator_covers_table_across_workers():
+    """The standalone consumption surface (reference odps_io.py
+    to_iterator): two workers' batch streams together cover every row
+    exactly once per epoch."""
+    rows = [(i,) for i in range(100)]
+    table = _NumFakeTable(["v"], rows)
+    from elasticdl_tpu.data.reader.odps_reader import ODPSReader
+
+    seen = []
+    for w in range(2):
+        r = ODPSReader(table, window_size=16)
+        for batch in r.to_iterator(2, w, batch_size=7):
+            assert len(batch) <= 7
+            seen.extend(batch)
+    assert sorted(seen) == sorted(rows)
+
+    r = ODPSReader(table, window_size=16)
+    two_epochs = []
+    for batch in r.to_iterator(1, 0, batch_size=10, epochs=2):
+        two_epochs.extend(batch)
+    assert len(two_epochs) == 200
+
+    with pytest.raises(ValueError, match="worker"):
+        next(r.to_iterator(2, 5, batch_size=4))
