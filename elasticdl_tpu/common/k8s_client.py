@@ -290,6 +290,61 @@ class Client(object):
             self.namespace, manifest
         )
 
+    def get_tensorboard_service_name(self):
+        """Reference k8s_client.py:219-220."""
+        return self.job_name + "-tensorboard"
+
+    def create_tensorboard_service(self, port=80, target_port=6006,
+                                   service_type="LoadBalancer"):
+        """Expose the master pod's TensorBoard through a LoadBalancer
+        service (reference k8s_client.py:222-237
+        create_tensorboard_service: port 80 -> master's 6006)."""
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": self.get_tensorboard_service_name(),
+                "labels": {
+                    "app": ELASTICDL_APP_NAME,
+                    ELASTICDL_JOB_KEY: self.job_name,
+                },
+                "ownerReferences": self._owner_reference(),
+            },
+            "spec": {
+                "selector": {
+                    ELASTICDL_JOB_KEY: self.job_name,
+                    ELASTICDL_REPLICA_TYPE_KEY: "master",
+                    ELASTICDL_REPLICA_INDEX_KEY: "0",
+                },
+                "ports": [
+                    {
+                        "port": int(port),
+                        "targetPort": int(target_port),
+                        "protocol": "TCP",
+                    }
+                ],
+                "type": service_type,
+            },
+        }
+        return self.client.create_namespaced_service(
+            self.namespace, manifest
+        )
+
+    def read_service(self, name):
+        """Read a namespaced service; None when unreadable (mirrors the
+        reference TB client's tolerant read,
+        k8s_tensorboard_client.py:41-51)."""
+        try:
+            svc = self.client.read_namespaced_service(
+                name=name, namespace=self.namespace
+            )
+            return svc.to_dict() if hasattr(svc, "to_dict") else svc
+        except Exception as e:  # noqa: BLE001 - absent/denied -> None
+            logger.warning(
+                "Exception when reading service %s: %s", name, e
+            )
+            return None
+
     def create_master_pod(self, *, command, args, resource_requests,
                           resource_limits=None, priority_class=None,
                           restart_policy="Never",

@@ -1,0 +1,78 @@
+"""k8s TensorBoard exposure: create a LoadBalancer service in front of
+the master's TensorBoard and wait for its external URL.
+
+Parity with the reference's
+elasticdl/python/common/k8s_tensorboard_client.py:22-66
+(`TensorBoardClient`): `start_tensorboard_service` creates the service
+via the shared k8s client and polls the service's load-balancer ingress
+until an external IP appears or the timeout lapses. The subprocess that
+actually runs TensorBoard is master/tensorboard_service.py; this module
+is only the cluster-networking half.
+"""
+
+import time
+
+from elasticdl_tpu.common.k8s_client import Client
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class TensorBoardClient(object):
+    def __init__(self, client=None, **kwargs):
+        """`client`: an existing k8s_client.Client (tests pass one with
+        a fake core_api); otherwise one is built from **kwargs exactly
+        like the reference constructor."""
+        self._k8s_client = client if client is not None else Client(
+            **kwargs
+        )
+
+    def start_tensorboard_service(self, check_interval=5,
+                                  wait_timeout=120):
+        try:
+            self._k8s_client.create_tensorboard_service()
+        except Exception as e:  # noqa: BLE001
+            # Tolerate an already-existing service (409 on master
+            # restart/resubmission under the same job name) — the poll
+            # below answers whether a usable service is there either way.
+            logger.warning(
+                "create_tensorboard_service failed (%s); polling the "
+                "existing service", e,
+            )
+        logger.info("Waiting for the URL for TensorBoard service...")
+        tb_url = self._get_tensorboard_url(
+            check_interval=check_interval, wait_timeout=wait_timeout
+        )
+        if tb_url:
+            logger.info(
+                "TensorBoard service is available at: %s", tb_url
+            )
+        else:
+            logger.warning(
+                "Unable to get the URL for TensorBoard service"
+            )
+        return tb_url
+
+    def _get_tensorboard_service(self):
+        return self._k8s_client.read_service(
+            self._k8s_client.get_tensorboard_service_name()
+        )
+
+    def _get_tensorboard_url(self, check_interval=5, wait_timeout=120):
+        """Poll until the LoadBalancer reports an ingress IP (reference
+        k8s_tensorboard_client.py:53-66)."""
+        start_time = time.time()
+        while True:
+            service = self._get_tensorboard_service()
+            ingress = None
+            if service is not None:
+                ingress = (
+                    service.get("status", {})
+                    .get("load_balancer", {})
+                    .get("ingress")
+                )
+            if ingress:
+                return ingress[0].get("ip") or ingress[0].get(
+                    "hostname"
+                )
+            if time.time() - start_time > wait_timeout:
+                return None
+            time.sleep(check_interval)

@@ -122,8 +122,58 @@ def main(argv=None):
     return rc
 
 
+def _validate_dataset_fn(spec, args):
+    """Specs may omit dataset_fn only when the configured data reader
+    derives one from its schema (model_utils.resolve_dataset_fn). Check
+    at SUBMISSION time — the reader type is already known here — so a
+    misconfiguration fails the master fast instead of crash-looping
+    every worker on its first task."""
+    if spec.dataset_fn is not None:
+        return
+    from elasticdl_tpu.common.model_utils import (
+        get_dict_from_params_str,
+        resolve_dataset_fn,
+    )
+    from elasticdl_tpu.data.reader.data_reader_factory import (
+        create_data_reader,
+    )
+
+    data = (args.training_data or args.validation_data
+            or args.prediction_data)
+    create_fn = spec.custom_data_reader or create_data_reader
+    reader = create_fn(
+        data, args.records_per_task,
+        **get_dict_from_params_str(args.data_reader_params)
+    )
+    resolve_dataset_fn(spec, reader)
+
+
+def _expose_tensorboard(instance_manager):
+    """Cluster path only: publish the master's TensorBoard through a
+    LoadBalancer service (reference k8s_tensorboard_client.py), waiting
+    for the ingress IP on a daemon thread so master startup is not
+    blocked."""
+    import threading
+
+    from elasticdl_tpu.common.k8s_tensorboard_client import (
+        TensorBoardClient,
+    )
+
+    k8s_cli = getattr(instance_manager, "_client", None)
+    if k8s_cli is None:
+        return
+    threading.Thread(
+        target=lambda: TensorBoardClient(
+            client=k8s_cli
+        ).start_tensorboard_service(),
+        daemon=True,
+        name="tensorboard-exposure",
+    ).start()
+
+
 def _run_master(args, status_file=""):
     spec = get_model_spec(args.model_zoo, args.model_def)
+    _validate_dataset_fn(spec, args)
     callbacks_list = None
     if spec.callbacks_fn is not None:
         from elasticdl_tpu.api.callbacks import CallbackList
@@ -167,6 +217,8 @@ def _run_master(args, status_file=""):
     master.instance_manager = instance_manager
     if instance_manager:
         instance_manager.start_workers()
+    if tensorboard_service is not None and args.worker_image:
+        _expose_tensorboard(instance_manager)
     logger.info("Master ready on port %d", master.port)
     job_status.write_job_status(status_file, job_status.RUNNING)
     return master.run()

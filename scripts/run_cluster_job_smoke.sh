@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Cluster-manifest smoke (reference scripts/travis/run_job.sh:32-45,
+# which ran a real minikube job in CI): validate manifests/ against a
+# REAL cluster's API server, and optionally run the full job.
+#
+# Levels:
+#   (no cluster reachable)  -> exit 3 (callers/tests skip)
+#   default                 -> server-side dry-run apply of every
+#                              manifest (schema + admission validation
+#                              by the API server, no workloads created)
+#   EDL_CLUSTER_FULL=1      -> apply RBAC, create the master pod with
+#                              EDL_SMOKE_IMAGE, wait for Succeeded
+#                              (kind/minikube compatible)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if ! kubectl cluster-info >/dev/null 2>&1; then
+    echo "cluster smoke: no reachable cluster (kubectl cluster-info failed)"
+    exit 3
+fi
+
+echo "cluster smoke: server-side dry-run of manifests/"
+for m in manifests/*.yaml; do
+    # the example master manifest carries a placeholder image; that is
+    # fine for validation (the API server does not pull on dry-run)
+    kubectl apply --dry-run=server -f "$m"
+done
+
+if [[ "${EDL_CLUSTER_FULL:-0}" != "1" ]]; then
+    echo "cluster smoke: dry-run OK (set EDL_CLUSTER_FULL=1 for a real job)"
+    exit 0
+fi
+
+: "${EDL_SMOKE_IMAGE:?EDL_CLUSTER_FULL=1 needs EDL_SMOKE_IMAGE (a built elasticdl-tpu-zoo image loadable by the cluster)}"
+
+kubectl apply -f manifests/elasticdl-tpu-rbac.yaml
+WORK=$(mktemp -d); trap 'rm -rf "$WORK"' EXIT
+sed "s|YOUR_REGISTRY/elasticdl-tpu-zoo:latest|$EDL_SMOKE_IMAGE|g" \
+    manifests/master-example.yaml > "$WORK/master.yaml"
+kubectl delete pod elasticdl-demo-master --ignore-not-found
+kubectl apply -f "$WORK/master.yaml"
+
+echo "cluster smoke: waiting for master pod to finish..."
+for _ in $(seq 1 120); do
+    PHASE=$(kubectl get pod elasticdl-demo-master \
+        -o jsonpath='{.status.phase}' 2>/dev/null || echo Unknown)
+    case "$PHASE" in
+        Succeeded) echo "cluster smoke: job Succeeded"; exit 0 ;;
+        Failed)
+            kubectl logs elasticdl-demo-master | tail -50
+            echo "cluster smoke: job FAILED"; exit 1 ;;
+    esac
+    sleep 5
+done
+echo "cluster smoke: timed out waiting for the master pod"
+exit 1

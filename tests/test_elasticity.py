@@ -381,3 +381,106 @@ def test_stop_does_not_relaunch_killed_workers():
     manager.event_cb(_event(1, "Failed", exit_code=137))
     assert len(api.created_pods) == 2  # no relaunches
     assert task_d.recovered == []
+
+
+def test_tensorboard_service_exposure():
+    """TB k8s exposure parity (reference k8s_tensorboard_client.py):
+    create_tensorboard_service builds a LoadBalancer in front of the
+    master pod, and TensorBoardClient polls until the ingress IP
+    appears."""
+    from elasticdl_tpu.common.k8s_tensorboard_client import (
+        TensorBoardClient,
+    )
+
+    class _TBFakeApi(FakeCoreApi):
+        def __init__(self):
+            super().__init__()
+            self.reads = 0
+
+        def read_namespaced_service(self, name, namespace):
+            self.reads += 1
+            ingress = (
+                [{"ip": "203.0.113.7"}] if self.reads >= 2 else None
+            )
+            return {
+                "metadata": {"name": name, "namespace": namespace},
+                "status": {"load_balancer": {"ingress": ingress}},
+            }
+
+    api = _TBFakeApi()
+    tb = TensorBoardClient(client=_client(api))
+    url = tb.start_tensorboard_service(check_interval=0, wait_timeout=5)
+    assert url == "203.0.113.7"
+    assert api.reads >= 2  # first poll saw no ingress, second did
+
+    (namespace, manifest), = api.services
+    assert namespace == "ns"
+    assert manifest["metadata"]["name"] == "testjob-tensorboard"
+    assert manifest["spec"]["type"] == "LoadBalancer"
+    assert manifest["spec"]["ports"] == [
+        {"port": 80, "targetPort": 6006, "protocol": "TCP"}
+    ]
+    sel = manifest["spec"]["selector"]
+    assert sel["elasticdl-replica-type"] == "master"
+
+
+def test_tensorboard_url_timeout_returns_none():
+    from elasticdl_tpu.common.k8s_tensorboard_client import (
+        TensorBoardClient,
+    )
+
+    class _NoIngressApi(FakeCoreApi):
+        def read_namespaced_service(self, name, namespace):
+            return {"status": {"load_balancer": {"ingress": None}}}
+
+    tb = TensorBoardClient(client=_client(_NoIngressApi()))
+    assert tb.start_tensorboard_service(
+        check_interval=0, wait_timeout=0.2
+    ) is None
+
+
+def test_master_main_exposes_tensorboard_via_manager():
+    """_run_master's cluster branch publishes TB through the instance
+    manager's k8s client (wiring check for _expose_tensorboard)."""
+    import time
+
+    from elasticdl_tpu.master import main as master_main
+
+    class _IngressApi(FakeCoreApi):
+        def read_namespaced_service(self, name, namespace):
+            return {"status": {"load_balancer": {
+                "ingress": [{"ip": "198.51.100.1"}]}}}
+
+    api = _IngressApi()
+
+    class _Manager(object):
+        _client = _client(api)
+
+    master_main._expose_tensorboard(_Manager())
+    deadline = time.time() + 5
+    while not api.services and time.time() < deadline:
+        time.sleep(0.05)
+    (_, manifest), = api.services
+    assert manifest["metadata"]["name"] == "testjob-tensorboard"
+
+
+def test_master_validates_missing_dataset_fn_at_submission():
+    """A spec without dataset_fn and a reader that derives none must
+    fail at master submission, not on the workers' first task."""
+    import argparse
+
+    import pytest as _pytest
+
+    from elasticdl_tpu.common.model_utils import ModelSpec
+    from elasticdl_tpu.master import main as master_main
+
+    spec = ModelSpec(
+        model_fn=lambda: None, dataset_fn=None, loss=lambda y, p: 0,
+        optimizer=lambda: None, eval_metrics_fn=lambda: {},
+    )
+    args = argparse.Namespace(
+        training_data="/tmp/nope", validation_data="", prediction_data="",
+        records_per_task=16, data_reader_params="",
+    )
+    with _pytest.raises(ValueError, match="dataset_fn is required"):
+        master_main._validate_dataset_fn(spec, args)

@@ -186,6 +186,46 @@ def test_embedding_column():
     assert layer.input_dim == 8 and layer.output_dim == 3
 
 
+def test_analyzer_env_channel(monkeypatch):
+    """Reference parity: accessors keyed by feature NAME read the
+    SQLFlow analysis env vars (_<name>_min etc., constants.py:15-22),
+    falling back to defaults; publish_analysis is the analysis pass
+    that fills them."""
+    assert analyzer_utils.get_min("age", default=-1.0) == -1.0
+    assert analyzer_utils.get_distinct_count("age", default=7) == 7
+    monkeypatch.setenv("_age_min", "18")
+    monkeypatch.setenv("_age_stddev", "2.5")
+    monkeypatch.setenv("_age_boundaries", "30,10,20,10")
+    monkeypatch.setenv("_age_distinct_count", "42")
+    monkeypatch.setenv("_city_vocab", "sf,nyc")
+    assert analyzer_utils.get_min("age", default=-1.0) == 18.0
+    assert analyzer_utils.get_stddev("age") == 2.5
+    assert analyzer_utils.get_bucket_boundaries("age") == [
+        10.0, 20.0, 30.0,
+    ]
+    assert analyzer_utils.get_distinct_count("age") == 42
+    assert analyzer_utils.get_vocabulary("city") == ["sf", "nyc"]
+    monkeypatch.setenv("_city_vocab", "/data/vocab/city.txt")
+    assert analyzer_utils.get_vocabulary("city") == "/data/vocab/city.txt"
+
+    col = np.asarray([4.0, 1.0, 3.0, 2.0])
+    published = analyzer_utils.publish_analysis("wage", col, num_buckets=2)
+    assert analyzer_utils.get_min("wage") == 1.0
+    assert analyzer_utils.get_max("wage") == 4.0
+    assert analyzer_utils.get_distinct_count("wage") == 4
+    assert len(analyzer_utils.get_bucket_boundaries("wage")) == 1
+    for k in published:
+        monkeypatch.delenv(k)
+
+    analyzer_utils.publish_analysis("town", np.array(["b", "a", "b"]))
+    assert analyzer_utils.get_vocabulary("town") == ["b", "a"]
+    assert analyzer_utils.get_distinct_count("town") == 2
+    import os
+    for k in list(os.environ):
+        if k.startswith("_town_"):
+            monkeypatch.delenv(k)
+
+
 def test_analyzer_utils():
     col = np.asarray([1.0, 2.0, 3.0, 4.0])
     assert analyzer_utils.get_min(col) == 1.0
