@@ -218,11 +218,26 @@ def run_transformer_bench(on_tpu):
                    num_heads=4, num_layers=2)
         batch_size, iters, warmup = 8, 10, 2
 
-    from elasticdl_tpu.common.model_utils import format_params_str
+    from elasticdl_tpu.common.model_utils import (
+        format_params_str,
+        get_dict_from_params_str,
+    )
+
+    # EDL_BENCH_EXTRA_PARAMS ("fused_head=True; seq_len=2048") lets the
+    # hardware-session sweeps A/B model knobs through the same bench.
+    # Shape-affecting keys merge INTO cfg so the synthetic batch follows
+    # (and vs_baseline correctly degrades to 1.0 on config mismatch);
+    # EDL_BENCH_BATCH overrides the batch size.
+    extra = get_dict_from_params_str(
+        os.environ.get("EDL_BENCH_EXTRA_PARAMS", "")
+    )
+    cfg.update({k: v for k, v in extra.items() if k in cfg})
+    batch_size = int(os.environ.get("EDL_BENCH_BATCH", batch_size))
 
     params = dict(cfg)
     if on_tpu:
         params["dtype"] = "bf16"
+    params.update({k: v for k, v in extra.items() if k not in cfg})
     model_params = format_params_str(params)
 
     rng = np.random.RandomState(0)

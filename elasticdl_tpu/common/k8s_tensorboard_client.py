@@ -62,13 +62,11 @@ class TensorBoardClient(object):
         start_time = time.time()
         while True:
             service = self._get_tensorboard_service()
-            ingress = None
-            if service is not None:
-                ingress = (
-                    service.get("status", {})
-                    .get("load_balancer", {})
-                    .get("ingress")
-                )
+            # the k8s client's to_dict() emits unset fields as explicit
+            # None values, so chained .get(..., {}) defaults don't help
+            status = (service or {}).get("status") or {}
+            lb = status.get("load_balancer") or {}
+            ingress = lb.get("ingress")
             if ingress:
                 return ingress[0].get("ip") or ingress[0].get(
                     "hostname"

@@ -73,3 +73,24 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
     if reader_type == ReaderType.ODPS:
         return _make_odps_reader(data_origin, kwargs)
     raise ValueError("Unknown reader_type %s" % reader_type)
+
+
+def build_data_reader(data_origin, records_per_task=None,
+                      data_reader_params=None, custom_data_reader=None):
+    """The ONE reader-construction contract shared by the worker's
+    TaskDataService and the master's submission-time validation
+    (master/main.py _validate_dataset_fn): a spec-declared
+    custom_data_reader wins, else the factory; params may be the
+    'k=v; k=v' wire string or an already-parsed dict. Keeping both
+    callers on this helper means the master validates against exactly
+    the reader the workers will build."""
+    if isinstance(data_reader_params, str):
+        from elasticdl_tpu.common.model_utils import (
+            get_dict_from_params_str,
+        )
+
+        data_reader_params = get_dict_from_params_str(data_reader_params)
+    create_fn = custom_data_reader or create_data_reader
+    return create_fn(
+        data_origin, records_per_task, **(data_reader_params or {})
+    )

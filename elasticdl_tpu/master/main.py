@@ -130,20 +130,16 @@ def _validate_dataset_fn(spec, args):
     every worker on its first task."""
     if spec.dataset_fn is not None:
         return
-    from elasticdl_tpu.common.model_utils import (
-        get_dict_from_params_str,
-        resolve_dataset_fn,
-    )
+    from elasticdl_tpu.common.model_utils import resolve_dataset_fn
     from elasticdl_tpu.data.reader.data_reader_factory import (
-        create_data_reader,
+        build_data_reader,
     )
 
     data = (args.training_data or args.validation_data
             or args.prediction_data)
-    create_fn = spec.custom_data_reader or create_data_reader
-    reader = create_fn(
-        data, args.records_per_task,
-        **get_dict_from_params_str(args.data_reader_params)
+    reader = build_data_reader(
+        data, args.records_per_task, args.data_reader_params,
+        custom_data_reader=spec.custom_data_reader,
     )
     resolve_dataset_fn(spec, reader)
 

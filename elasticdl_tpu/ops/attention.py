@@ -13,6 +13,8 @@ Layout convention: [batch, heads, seq, head_dim].
 """
 
 import functools
+import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +26,52 @@ from elasticdl_tpu.ops.dispatch import interpret_mode, use_pallas
 
 _NEG_INF = -1e30
 NEG_INF = _NEG_INF  # masking constant shared with context_parallel
+
+# Tuned flash block defaults: hardware sweeps (scripts/bench_attention.py
+# via scripts/hw_session.py) persist their winner here so every call site
+# that leaves block sizes unset — the model zoo, ring attention — picks
+# it up. Resolution order: explicit argument > EDL_FLASH_BLOCK_Q/K env >
+# ops/flash_tuning.json > 128.
+_TUNING_FILE = os.path.join(os.path.dirname(__file__),
+                            "flash_tuning.json")
+_tuning_cache = None
+
+
+def _tuned_blocks():
+    global _tuning_cache
+    if _tuning_cache is None:
+        cfg = {}
+        try:
+            with open(_TUNING_FILE) as f:
+                cfg = json.load(f)
+        except (OSError, ValueError):
+            pass
+        _tuning_cache = cfg if isinstance(cfg, dict) else {}
+    return _tuning_cache
+
+
+def _align8(value):
+    """Flash blocks must be multiples of 8 (_flash_tiles) — a misaligned
+    tuned value would silently disable the kernel repo-wide, so round
+    down instead."""
+    return max(8, (int(value) // 8) * 8)
+
+
+def resolve_block(explicit, which):
+    """Resolve a flash block size: `which` is "q" or "k"."""
+    if explicit is not None:
+        return int(explicit)
+    raw = os.environ.get("EDL_FLASH_BLOCK_%s" % which.upper(), "")
+    if raw:
+        try:
+            return _align8(raw)
+        except ValueError:
+            pass
+    value = _tuned_blocks().get("block_%s" % which)
+    try:
+        return _align8(value) if value else 128
+    except (TypeError, ValueError):
+        return 128
 
 
 def softmax_merge(o, l, m, s, v_blk):
@@ -535,8 +583,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None, window=None):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None, window=None):
     """Tiled online-softmax attention (Pallas). head_dim is zero-padded
     to the 128-lane width (zeros don't change q·k or add output columns
     that survive the final slice); falls back to blockwise_attention when
@@ -546,8 +594,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     scales with window, not sequence."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
+    block_q = min(resolve_block(block_q, "q"), lq)
+    block_k = min(resolve_block(block_k, "k"), lk)
     _check_window(window, lq, lk)
     tiles = _flash_tiles(lq, lk, block_q, block_k)
     if not (use_pallas() and tiles):
@@ -587,15 +635,15 @@ def _flash_tiles(lq, lk, block_q, block_k):
             and block_q % 8 == 0 and block_k % 8 == 0)
 
 
-def attention_forward_lse(q, k, v, causal=False, scale=None, block_q=128,
-                          block_k=128, interpret=None):
+def attention_forward_lse(q, k, v, causal=False, scale=None,
+                          block_q=None, block_k=None, interpret=None):
     """Attention returning (out, logsumexp): out [b,h,lq,d] in q.dtype,
     lse float32 [b,h,lq]. Pallas flash kernel when available and the
     sequence tiles, else the blockwise scan."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
-    bq = min(block_q, lq)
-    bk = min(block_k, lk)
+    bq = min(resolve_block(block_q, "q"), lq)
+    bk = min(resolve_block(block_k, "k"), lk)
     if use_pallas() and _flash_tiles(lq, lk, bq, bk):
         qp, kp, vp = _pad_lanes([q, k, v], d)
         out, lse = _flash_forward(qp, kp, vp, causal, scale, bq, bk,
@@ -606,7 +654,7 @@ def attention_forward_lse(q, k, v, causal=False, scale=None, block_q=128,
 
 
 def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
-                           block_q=128, block_k=128, interpret=None,
+                           block_q=None, block_k=None, interpret=None,
                            grad_dtype=None):
     """(dq, dk, dv) for attention given a saved logsumexp.
 
@@ -620,8 +668,8 @@ def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
     the default input-dtype outputs."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
-    bq = min(block_q, lq)
-    bk = min(block_k, lk)
+    bq = min(resolve_block(block_q, "q"), lq)
+    bk = min(resolve_block(block_k, "k"), lk)
     if use_pallas() and _flash_tiles(lq, lk, bq, bk):
         qp, kp, vp, outp, gp = _pad_lanes([q, k, v, out, g], d)
         dq, dk, dv = _flash_backward(

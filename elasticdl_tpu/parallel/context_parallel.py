@@ -35,6 +35,7 @@ from elasticdl_tpu.ops.attention import (
     blockwise_attention,
     flash_attention,
     lse_merge,
+    resolve_block,
 )
 
 
@@ -184,13 +185,17 @@ _ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
-                         block_q=128, block_k=128):
+                         block_q=None, block_k=None):
     """Per-device body: q/k/v are the local sequence shards
     [batch, heads, local_len, dim]. Call inside shard_map/pjit with a
     named `axis_name` axis; returns the local output shard. The local
     compute per rotation is the Pallas flash kernel (fwd + two-pass bwd)
     when it can run, with a blockwise/dense jnp fallback."""
     scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    # resolve tuned defaults here: the custom_vjp's nondiff args must be
+    # concrete ints
+    block_q = resolve_block(block_q, "q")
+    block_k = resolve_block(block_k, "k")
     if causal and q.shape[2] != k.shape[2]:
         # The three-way shard classification (_ring_case) relies on
         # equal-length q/kv shards so diagonal offsets cancel; unequal
@@ -204,7 +209,7 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
 
 
 def ring_attention(q, k, v, mesh, causal=False, scale=None,
-                   block_q=128, block_k=128,
+                   block_q=None, block_k=None,
                    seq_axis=MeshAxis.SP, batch_axes=(MeshAxis.DP,
                                                      MeshAxis.FSDP)):
     """Global-view ring attention: q/k/v are [batch, heads, seq, dim]

@@ -106,6 +106,15 @@ def get_vocabulary(feature=None, default=None):
         value = _env(AnalysisEnvTemplate.VOCABULARY_ENV, feature)
         if value is None:
             return fallback
+        if value.startswith("["):
+            # publish_analysis writes JSON so values containing commas
+            # or path separators round-trip exactly
+            import json
+
+            try:
+                return json.loads(value)
+            except ValueError:
+                pass
         if "," not in value and os.sep in value:
             return value  # vocabulary file path, reference passthrough
         return value.split(",")
@@ -130,7 +139,9 @@ def publish_analysis(feature_name, column, num_buckets=10,
     t = AnalysisEnvTemplate
     out = {}
     if is_categorical:
-        out[t.VOCABULARY_ENV.format(feature_name)] = ",".join(
+        import json as _json
+
+        out[t.VOCABULARY_ENV.format(feature_name)] = _json.dumps(
             get_vocabulary(column)
         )
     else:

@@ -21,7 +21,7 @@ from collections import deque
 from elasticdl_tpu.common.constants import TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.data.dataset import Dataset
-from elasticdl_tpu.data.reader.data_reader_factory import create_data_reader
+from elasticdl_tpu.data.reader.data_reader_factory import build_data_reader
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 
@@ -40,9 +40,9 @@ class TaskDataService(object):
         self._pending_dataset = True
         self._pending_train_end_callback_task = None
         self._wait_sleep_secs = wait_sleep_secs
-        create_fn = custom_data_reader or create_data_reader
-        self.data_reader = create_fn(
-            data_origin, records_per_task, **(data_reader_params or {})
+        self.data_reader = build_data_reader(
+            data_origin, records_per_task, data_reader_params,
+            custom_data_reader=custom_data_reader,
         )
         self._failed_record_count = 0
         self._reported_record_count = 0

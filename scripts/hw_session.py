@@ -1,0 +1,222 @@
+"""One-shot hardware measurement session: run EVERY pending TPU
+measurement the moment the tunnel is up, saving results incrementally so
+even a short window is fully exploited (the tunnel flaps: up for
+minutes-to-hours, then wedged — see BENCHNOTES.md).
+
+    python scripts/hw_session.py [--out hw_session_results.json]
+
+Steps (each in its own bounded subprocess; a hang or crash moves on):
+  1. probe             — bounded accelerator init; abort if wedged
+  2. attention sweep   — scripts/bench_attention.py block-size sweep;
+                         the best (block_q, block_k) is persisted to
+                         elasticdl_tpu/ops/flash_tuning.json (the
+                         repo-wide tuned default) when it beats 128/128
+  3. flagship bench    — python bench.py before/after the tuned blocks
+  4. resnet50 bench    — EDL_BENCH_MODEL=resnet50 (BASELINE.md target)
+  5. deepfm bench      — EDL_BENCH_MODEL=deepfm  (BASELINE.md target)
+  6. profile           — scripts/profile_step.py (attention share)
+  7. fused-head A/B    — bench with fused_head=True at the flagship
+                         shape AND at seq_len=2048 (the regime VERDICT
+                         asks to prove or prune)
+
+Everything lands in --out (JSON, appended after each step) plus the raw
+logs next to it; BENCH_BASELINE.json is updated ONLY when the flagship
+run beats the committed baseline on the same config.
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(cmd, timeout, env_extra=None, tag=""):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO, env=env,
+        )
+        return {
+            "tag": tag, "cmd": cmd, "rc": r.returncode,
+            "secs": round(time.time() - t0, 1),
+            "stdout": r.stdout[-20000:], "stderr": r.stderr[-4000:],
+        }
+    except subprocess.TimeoutExpired:
+        return {"tag": tag, "cmd": cmd, "rc": -1, "timeout": timeout,
+                "secs": round(time.time() - t0, 1),
+                "stdout": "", "stderr": "TIMEOUT"}
+
+
+def save(results, out_path):
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+def last_json_line(text):
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def parse_sweep(stdout):
+    """bench_attention lines:
+    'flash bq=.. bk=..            fwd  x ms (...)   fwd+bwd  y ms'
+    Returns [(bq, bk, fwd_ms, fwdbwd_ms)]."""
+    rows = []
+    pat = re.compile(
+        r"bq=(\d+)\s+bk=(\d+).*?fwd\s+([\d.]+)\s*ms.*?fwd\+bwd\s+"
+        r"([\d.]+)\s*ms"
+    )
+    for line in (stdout or "").splitlines():
+        m = pat.search(line)
+        if m:
+            rows.append((int(m.group(1)), int(m.group(2)),
+                         float(m.group(3)), float(m.group(4))))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "hw_session_results.json"))
+    ap.add_argument("--skip-sweep", action="store_true")
+    args = ap.parse_args()
+    results = {"started": time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                                        time.gmtime()),
+               "steps": []}
+
+    def record(step):
+        results["steps"].append(step)
+        save(results, args.out)
+        print("[hw_session] %s rc=%s (%.0fs)" % (
+            step.get("tag"), step.get("rc"), step.get("secs", 0)),
+            flush=True)
+
+    # 1. probe
+    probe = run(
+        [sys.executable, "-c",
+         "import jax, jax.numpy as jnp;"
+         "x = jnp.ones((256, 256), jnp.bfloat16);"
+         "(x @ x).block_until_ready();"
+         "print('PROBE_OK', jax.default_backend(), jax.devices())"],
+        timeout=120, tag="probe",
+    )
+    record(probe)
+    if "PROBE_OK" not in probe["stdout"]:
+        print("[hw_session] tunnel wedged; aborting")
+        return 1
+
+    # 2. attention block sweep -> persist tuned default
+    if not args.skip_sweep:
+        sweep = run([sys.executable, "scripts/bench_attention.py"],
+                    timeout=1800, tag="attention_sweep")
+        record(sweep)
+        rows = parse_sweep(sweep["stdout"])
+        if rows:
+            best = min(rows, key=lambda r: r[3])
+            base = [r for r in rows if r[0] == 128 and r[1] == 128]
+            results["sweep_best"] = {
+                "block_q": best[0], "block_k": best[1],
+                "fwd_bwd_ms": best[3],
+                "base_128_fwd_bwd_ms": base[0][3] if base else None,
+            }
+            if base and best[3] < base[0][3] * 0.99:
+                tuning = os.path.join(
+                    REPO, "elasticdl_tpu", "ops", "flash_tuning.json")
+                with open(tuning, "w") as f:
+                    json.dump({"block_q": best[0], "block_k": best[1],
+                               "tuned_on": "v5e flagship sweep"}, f)
+                print("[hw_session] tuned blocks -> %s" % (best[:2],))
+            save(results, args.out)
+
+    # 3. flagship bench (tuned defaults now in effect via tuning file)
+    bench = run([sys.executable, "bench.py"], timeout=1800,
+                env_extra={"EDL_BENCH_PROBE_TIMEOUT": "150"},
+                tag="bench_flagship")
+    record(bench)
+    flag = last_json_line(bench["stdout"])
+    if flag:
+        results["flagship"] = flag
+        save(results, args.out)
+        # refresh the committed baseline when strictly better on the
+        # same config+platform (driver compares future runs against it)
+        base_path = os.path.join(REPO, "BENCH_BASELINE.json")
+        try:
+            with open(base_path) as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            old = {}
+        better = (
+            flag.get("platform") not in (None, "cpu")
+            and (old.get("platform") == "cpu" or not old
+                 or (flag.get("config") == old.get("config")
+                     # baseline identity includes the chip generation
+                     # (bench.py's vs_baseline checks device_kind too)
+                     and flag.get("device_kind") == old.get(
+                         "device_kind")
+                     and flag.get("value", 0) > old.get("value", 0)))
+        )
+        if better:
+            with open(base_path, "w") as f:
+                json.dump(flag, f, indent=1)
+            print("[hw_session] BENCH_BASELINE.json updated")
+
+    # 4./5. secondary BASELINE.md targets
+    for model in ("resnet50", "deepfm"):
+        step = run([sys.executable, "bench.py"], timeout=1800,
+                   env_extra={"EDL_BENCH_MODEL": model,
+                              "EDL_BENCH_PROBE_TIMEOUT": "150"},
+                   tag="bench_%s" % model)
+        record(step)
+        parsed = last_json_line(step["stdout"])
+        if parsed and parsed.get("platform") not in (None, "cpu"):
+            results[model] = parsed
+            with open(os.path.join(
+                    REPO, "BENCH_BASELINE_%s.json" % model.upper()),
+                    "w") as f:
+                json.dump(parsed, f, indent=1)
+            save(results, args.out)
+
+    # 6. step profile (attention share of step time)
+    prof = run([sys.executable, "scripts/profile_step.py"],
+               timeout=1800, tag="profile_step")
+    record(prof)
+
+    # 7. fused LM head A/B: flagship shape and the long-seq regime
+    for tag, extra in (
+        ("fused_head_flagship", {"EDL_BENCH_EXTRA_PARAMS":
+                                 "fused_head=True"}),
+        ("baseline_seq2048", {"EDL_BENCH_EXTRA_PARAMS": "seq_len=2048",
+                              "EDL_BENCH_BATCH": "16"}),
+        ("fused_head_seq2048", {"EDL_BENCH_EXTRA_PARAMS":
+                                "fused_head=True; seq_len=2048",
+                                "EDL_BENCH_BATCH": "16"}),
+    ):
+        extra["EDL_BENCH_PROBE_TIMEOUT"] = "150"
+        step = run([sys.executable, "bench.py"], timeout=1800,
+                   env_extra=extra, tag=tag)
+        record(step)
+        parsed = last_json_line(step["stdout"])
+        if parsed:
+            results[tag] = parsed
+            save(results, args.out)
+
+    print("[hw_session] complete -> %s" % args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
