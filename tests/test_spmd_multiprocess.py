@@ -163,19 +163,24 @@ def test_two_process_spmd_train(tmp_path):
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, "proc %d failed:\n%s" % (i, out[-3000:])
             assert "SPMD_PROC_DONE" in out
-        assert master.task_d.finished()
+        tail = "\n--- proc0 ---\n%s\n--- proc1 ---\n%s" % (
+            outs[0][-1500:], outs[1][-1500:])
+        assert master.task_d.finished(), (
+            "dispatcher not finished; todo=%r doing=%r%s"
+            % (master.task_d._todo, master.task_d._doing, tail))
         # both hosts agreed on the same number of global steps
         import re
 
         steps = [
             int(re.search(r"steps=(\d+)", o).group(1)) for o in outs
         ]
-        assert steps[0] == steps[1]
+        assert steps[0] == steps[1], (steps, tail)
         # 128 records / 16 global batch = 8 full global rounds minimum;
         # uneven task streams can add padded rounds, never lose records
-        assert steps[0] >= 128 // 16
+        assert steps[0] >= 128 // 16, (steps, tail)
         # eval ran and aggregated on the master
-        assert master.evaluation_service.completed_job_metrics
+        assert master.evaluation_service.completed_job_metrics, (
+            "no completed eval jobs%s" % tail)
     finally:
         for p in procs:
             if p.poll() is None:
