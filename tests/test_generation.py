@@ -294,3 +294,23 @@ def test_generate_learned_cycle():
             trainer, state, prompt, 8, temperature=0.7, seed=11,
             use_cache=True))
         np.testing.assert_array_equal(st, skv)
+
+
+def test_decode_cache_is_bounded_lru():
+    """Sampling-knob sweeps must not accumulate compiled executables
+    without bound (advisor finding): the decode cache evicts
+    least-recently-used entries past max_entries, and get() refreshes
+    recency."""
+    from elasticdl_tpu.api.generation import _LRUCache
+
+    cache = _LRUCache()
+    cache.max_entries = 3
+    for i in range(3):
+        cache[("k", i)] = i
+    assert cache.get(("k", 0)) == 0  # refresh 0's recency
+    cache[("k", 3)] = 3              # evicts 1 (LRU), not 0
+    assert ("k", 1) not in cache
+    assert cache.get(("k", 0)) == 0
+    assert len(cache) == 3
+    cache[("k", 0)] = 99             # overwrite does not evict
+    assert len(cache) == 3 and cache.get(("k", 0)) == 99
