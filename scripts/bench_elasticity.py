@@ -1,0 +1,302 @@
+"""Elasticity benchmark: gang vs elastic scheduling makespan.
+
+The reference's ONLY published benchmark is its cluster-elasticity
+report (`docs/benchmark/report_cn.md:70-120`): two training jobs on a
+fixed-capacity cluster finish sooner under elastic scheduling (job 2
+starts immediately on leftover slots and scales up when job 1's
+resources free) than under gang scheduling (job 2 waits for its full
+worker count), with convergence invariant to the changing worker count.
+This script reproduces that experiment with REAL elasticdl_tpu jobs —
+in-process masters, subprocess workers pulling tasks over gRPC — and a
+fixed pool of worker slots played by the script (the reference's
+scheduler was k8s, likewise external to the framework). Elastic scale-up
+needs no framework support beyond what exists: a late worker simply
+registers and starts pulling tasks from the dynamic-sharding queue.
+
+    python scripts/bench_elasticity.py [--slots 3] [--workers-per-job 2]
+
+Prints ONE JSON line:
+    {"metric": "elastic_vs_gang_makespan_speedup", "value": ...,
+     "gang": {...}, "elastic": {...}}
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+class Job(object):
+    """One training job: an in-process master plus up to
+    `target_workers` subprocess workers the scheduler may start."""
+
+    def __init__(self, name, data_dir, target_workers, minibatch=8,
+                 records_per_task=32):
+        from elasticdl_tpu.common.model_utils import (
+            load_model_spec_from_module,
+        )
+        from elasticdl_tpu.master.master import Master
+        from model_zoo.mnist_functional_api import (
+            mnist_functional_api as zoo,
+        )
+
+        self.name = name
+        self.target_workers = target_workers
+        self.minibatch = minibatch
+        self.master = Master(
+            load_model_spec_from_module(zoo),
+            training_data=data_dir,
+            minibatch_size=minibatch,
+            records_per_task=records_per_task,
+            num_epochs=1,
+            port=0,
+        )
+        self.master.prepare()
+        self._data_dir = data_dir
+        self.procs = []
+        self.log_paths = []
+        self.recovered = set()
+        self.failures = 0
+        self.max_failures = 3
+        self.peak_workers = 0
+        self.t_submit = None
+        self.t_first_worker = None
+        self.t_done = None
+
+    def launch_worker(self):
+        wid = len(self.procs)
+        cmd = [
+            sys.executable, "-m", "elasticdl_tpu.worker.main",
+            "--worker_id", str(wid),
+            "--model_zoo", "model_zoo",
+            "--model_def",
+            "mnist_functional_api.mnist_functional_api.custom_model",
+            "--master_addr", "localhost:%d" % self.master.port,
+            "--training_data", self._data_dir,
+            "--job_type", "training_only",
+            "--minibatch_size", str(self.minibatch),
+        ]
+        log_path = os.path.join(
+            tempfile.gettempdir(),
+            "edl_elastic_%s_w%d.log" % (self.name, wid),
+        )
+        log = open(log_path, "w")
+        proc = subprocess.Popen(
+            cmd, env=_worker_env(), cwd=REPO,
+            stdout=log, stderr=subprocess.STDOUT,
+        )
+        log.close()
+        self.procs.append(proc)
+        self.log_paths.append(log_path)
+        if self.t_first_worker is None:
+            self.t_first_worker = time.time()
+        return proc
+
+    @property
+    def live_workers(self):
+        live = sum(1 for p in self.procs if p.poll() is None)
+        self.peak_workers = max(self.peak_workers, live)
+        return live
+
+    def crashed_workers(self):
+        return [
+            (i, p.returncode) for i, p in enumerate(self.procs)
+            if p.poll() is not None and p.returncode != 0
+        ]
+
+    @property
+    def todo_count(self):
+        return len(self.master.task_d._todo)
+
+    @property
+    def wants_workers(self):
+        # more workers help ONLY while undispatched tasks remain: a
+        # cleanly-exited worker ("no more tasks" while a peer still
+        # holds the last ones) must not trigger futile relaunches
+        return (
+            not self.finished
+            and self.live_workers < self.target_workers
+            and self.todo_count > 0
+        )
+
+    @property
+    def finished(self):
+        if self.t_done is not None:
+            return True
+        if self.master.task_d.finished() and self.live_workers == 0:
+            self.t_done = time.time()
+            return True
+        return False
+
+    def stop(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+        self.master.stop()
+
+
+def run_cluster(mode, slots, make_jobs, job2_delay, timeout=900):
+    """Schedule `make_jobs()`'s two jobs over `slots` worker slots.
+
+    gang: a job launches only when ALL its workers fit at once.
+    elastic: a job launches as soon as ONE slot is free and scales up
+    whenever more slots free (report_cn.md's elastic policy).
+    """
+    job1, job2 = make_jobs()
+    t0 = time.time()
+    job1.t_submit = t0
+    job2.t_submit = t0 + job2_delay
+    pending = [job1]
+    deadline = t0 + timeout
+    try:
+        while time.time() < deadline:
+            now = time.time()
+            if job2 not in pending and job2.t_submit <= now and (
+                    job2.t_first_worker is None):
+                pending.append(job2)
+            running = [j for j in (job1, job2) if j.procs]
+            used = sum(j.live_workers for j in running)
+            free = slots - used
+            for job in list(pending):
+                if job.t_first_worker is None:
+                    need = (
+                        job.target_workers if mode == "gang" else 1
+                    )
+                    if free >= need:
+                        n = (job.target_workers if mode == "gang"
+                             else min(free, job.target_workers))
+                        for _ in range(n):
+                            job.launch_worker()
+                        free -= n
+                        pending.remove(job)
+            for job in (job1, job2):
+                # a crashed worker's in-flight tasks go back to todo
+                # (the script plays the instance manager's recover
+                # role); repeated failures surface the worker log
+                # instead of hanging to the timeout
+                for i, rc in job.crashed_workers():
+                    if i in job.recovered:
+                        continue
+                    job.recovered.add(i)
+                    job.failures += 1
+                    job.master.task_d.recover_tasks(i)
+                    if job.failures > job.max_failures:
+                        tail = ""
+                        try:
+                            with open(job.log_paths[i]) as f:
+                                tail = f.read()[-2000:]
+                        except OSError:
+                            pass
+                        raise RuntimeError(
+                            "%s worker %d exited rc=%d (failure %d):\n%s"
+                            % (job.name, i, rc, job.failures, tail)
+                        )
+            # launches: crash replacements in either mode; in elastic
+            # mode the same rule IS the scale-up policy (any free slot
+            # goes to a started job with undispatched tasks)
+            for job in (job1, job2):
+                while (free > 0 and job.t_first_worker is not None
+                       and job.wants_workers):
+                    job.launch_worker()
+                    free -= 1
+            if job1.finished and job2.finished:
+                break
+            time.sleep(0.25)
+        else:
+            raise TimeoutError("cluster run exceeded %ds" % timeout)
+        return {
+            "makespan_s": round(
+                max(job1.t_done, job2.t_done) - t0, 1),
+            "job1_s": round(job1.t_done - job1.t_submit, 1),
+            "job2_s": round(job2.t_done - job2.t_submit, 1),
+            "job2_wait_s": round(
+                job2.t_first_worker - job2.t_submit, 1),
+            "job2_peak_workers": job2.peak_workers,
+        }
+    finally:
+        job1.stop()
+        job2.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=3)
+    ap.add_argument("--workers-per-job", type=int, default=2)
+    ap.add_argument("--records", type=int, default=192)
+    ap.add_argument("--records2", type=int, default=0,
+                    help="job2 record count (default: same as --records;"
+                         " make job2 larger to guarantee it is still "
+                         "running when job1's slots free)")
+    ap.add_argument("--job2-delay", type=float, default=3.0)
+    ap.add_argument("--timeout", type=int, default=900)
+    args = ap.parse_args(argv)
+    if args.workers_per_job > args.slots:
+        ap.error(
+            "--workers-per-job (%d) must be <= --slots (%d): gang "
+            "scheduling could never place a job"
+            % (args.workers_per_job, args.slots)
+        )
+
+    from elasticdl_tpu.data import recordio_gen
+
+    work = tempfile.mkdtemp(prefix="edl_elastic_bench.")
+    try:
+        dirs = []
+        counts = [args.records, args.records2 or args.records]
+        for i in (1, 2):
+            d = os.path.join(work, "job%d" % i)
+            recordio_gen.gen_mnist_like(
+                d, num_files=2,
+                records_per_file=counts[i - 1] // 2, seed=i,
+            )
+            dirs.append(d)
+
+        def make_jobs():
+            return (
+                Job("job1", dirs[0], args.workers_per_job),
+                Job("job2", dirs[1], args.workers_per_job),
+            )
+
+        results = {}
+        for mode in ("gang", "elastic"):
+            results[mode] = run_cluster(
+                mode, args.slots, make_jobs, args.job2_delay,
+                timeout=args.timeout,
+            )
+            sys.stderr.write("%s: %s\n" % (mode, results[mode]))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    speedup = (
+        results["gang"]["makespan_s"]
+        / max(results["elastic"]["makespan_s"], 1e-9)
+    )
+    print(json.dumps({
+        "metric": "elastic_vs_gang_makespan_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "slots": args.slots,
+        "workers_per_job": args.workers_per_job,
+        "gang": results["gang"],
+        "elastic": results["elastic"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

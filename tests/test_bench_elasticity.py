@@ -1,0 +1,40 @@
+"""The reference's published benchmark, reproduced by machinery: elastic
+scheduling lets a second job start on leftover slots instead of waiting
+for gang capacity (docs/benchmark/report_cn.md:70-91 — the only
+performance numbers the reference ever published). The script runs real
+masters + subprocess workers; this test asserts the STRUCTURAL
+properties (which are load-independent), not wall-clock speedup (which
+needs a quiet machine — scripts/bench_elasticity.py reports it)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_elastic_scheduling_beats_gang_on_wait_time():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_elasticity.py"),
+         "--records", "64", "--records2", "1280", "--job2-delay", "2"],
+        capture_output=True, text=True, timeout=900, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    gang, elastic = out["gang"], out["elastic"]
+    # elastic job2 starts (nearly) immediately on the leftover slot;
+    # gang job2 must wait for job1 to release its full worker count
+    assert elastic["job2_wait_s"] <= 2.0, out
+    assert gang["job2_wait_s"] > elastic["job2_wait_s"], out
+    # both jobs complete under both policies (no lost work)
+    for mode in (gang, elastic):
+        assert mode["makespan_s"] > 0
+    # job2 has 40 tasks (20x job1's work), so undispatched tasks remain
+    # when job1's slots free: elastic must have scaled it up mid-job
+    # (peak counts CONCURRENT workers, not launches)
+    assert elastic["job2_peak_workers"] >= 2, out
