@@ -22,7 +22,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
-from elasticdl_tpu.ops.dispatch import interpret_mode, use_pallas
+from elasticdl_tpu.ops.dispatch import (
+    interpret_mode,
+    is_tpu_backend,
+    use_pallas,
+)
 
 _NEG_INF = -1e30
 NEG_INF = _NEG_INF  # masking constant shared with context_parallel
@@ -610,6 +614,34 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     q, k, v = _pad_lanes([q, k, v], d)
     out = _flash(q, k, v, causal, scale, block_q, block_k, interpret,
                  window)
+    return out[..., :d]
+
+
+def jax_flash_attention(q, k, v, causal=False, scale=None, window=None):
+    """Dispatch to jax's BUNDLED TPU flash kernel
+    (jax.experimental.pallas.ops.tpu.flash_attention) — an alternative
+    hot path the hardware sweep benchmarks against ours
+    (scripts/bench_attention.py), exposed as the model-zoo
+    attn_impl='jax_flash' so the flagship can adopt whichever kernel
+    wins on the target chip without code edits. Same [b, h, l, d]
+    layout; head_dim zero-padded to the 128-lane width like our kernel.
+    Sliding windows are not supported by the bundled kernel; off-TPU
+    falls back to the blockwise reference path."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if window is not None:
+        raise ValueError(
+            "attn_impl='jax_flash' does not support sliding-window "
+            "attention; use the built-in flash kernel (attn_impl='auto')"
+        )
+    if not is_tpu_backend():
+        return blockwise_attention(q, k, v, causal=causal, scale=scale)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _bundled,
+    )
+
+    d = q.shape[-1]
+    q, k, v = _pad_lanes([q, k, v], d)
+    out = _bundled(q, k, v, causal=causal, sm_scale=scale)
     return out[..., :d]
 
 

@@ -34,6 +34,7 @@ from elasticdl_tpu.ops.attention import (
     attention_forward_lse,
     blockwise_attention,
     flash_attention,
+    jax_flash_attention,
     lse_merge,
     resolve_block,
 )
@@ -238,6 +239,16 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
     return fn(q, k, v)
 
 
+# Local full-sequence attention per Ulysses impl choice; "jax_flash" is
+# jax's bundled TPU kernel (ops/attention.jax_flash_attention). Unknown
+# values are validated in ulysses_attention before tracing.
+_ULYSSES_LOCAL_ATTN = {
+    "auto": flash_attention,
+    "xla": blockwise_attention,
+    "jax_flash": jax_flash_attention,
+}
+
+
 def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
                             attn_impl="auto"):
     """Per-device body: q/k/v are local sequence shards
@@ -251,9 +262,7 @@ def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
 
-    local_attn = (
-        blockwise_attention if attn_impl == "xla" else flash_attention
-    )
+    local_attn = _ULYSSES_LOCAL_ATTN[attn_impl]
     out = local_attn(
         to_heads(q), to_heads(k), to_heads(v), causal=causal, scale=scale
     )
@@ -274,6 +283,11 @@ def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
     attention. Requires heads to divide evenly over the sp axis — use
     ring attention otherwise.
     """
+    if attn_impl not in _ULYSSES_LOCAL_ATTN:
+        raise ValueError(
+            "Unknown attn_impl %r (valid: %s)"
+            % (attn_impl, ", ".join(sorted(_ULYSSES_LOCAL_ATTN)))
+        )
     sp = mesh.shape.get(seq_axis, 1)
     heads = q.shape[1]
     if heads % sp:

@@ -25,6 +25,7 @@ from elasticdl_tpu.ops.attention import (
     apply_rope,
     blockwise_attention,
     flash_attention,
+    jax_flash_attention,
 )
 from elasticdl_tpu.ops.losses import chunked_softmax_xent
 from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -55,7 +56,9 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     head_dim: int
     dtype: object = None  # compute dtype (bf16 on TPU); params stay fp32
-    attn_impl: str = "auto"  # "auto": Pallas flash on TPU; "xla": blockwise
+    # "auto": our Pallas flash on TPU; "xla": blockwise scan;
+    # "jax_flash": jax's bundled TPU flash kernel (sweep alternative)
+    attn_impl: str = "auto"
     sp_impl: str = "ring"  # sp>1 scheme: "ring" | "ulysses"
     tp_shard: bool = True
     causal: bool = True
@@ -82,6 +85,11 @@ class CausalSelfAttention(nn.Module):
             pos = jnp.arange(l)
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
+        if self.attn_impl not in ("auto", "xla", "jax_flash"):
+            raise ValueError(
+                "Unknown attn_impl %r (valid: 'auto', 'xla', "
+                "'jax_flash')" % (self.attn_impl,)
+            )
         window = self.window or None
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:
@@ -96,6 +104,14 @@ class CausalSelfAttention(nn.Module):
                     attn_impl=self.attn_impl,
                 )
             elif self.sp_impl == "ring":
+                if self.attn_impl == "jax_flash":
+                    # the ring merges (o, logsumexp) partials per
+                    # rotation; jax's bundled kernel doesn't expose lse
+                    raise ValueError(
+                        "attn_impl='jax_flash' is incompatible with "
+                        "sp_impl='ring' (no logsumexp output); use "
+                        "sp_impl='ulysses' or attn_impl='auto'"
+                    )
                 out = ring_attention(q, k, v, mesh, causal=self.causal)
             else:
                 raise ValueError(
@@ -106,7 +122,11 @@ class CausalSelfAttention(nn.Module):
             out = blockwise_attention(
                 q, k, v, causal=self.causal, window=window
             )
-        else:
+        elif self.attn_impl == "jax_flash":
+            out = jax_flash_attention(
+                q, k, v, causal=self.causal, window=window
+            )
+        else:  # "auto" (validated above)
             out = flash_attention(
                 q, k, v, causal=self.causal, window=window
             )

@@ -223,8 +223,11 @@ def main():
                timeout=1800, tag="profile_step")
     record(prof)
 
-    # 7. fused LM head A/B: flagship shape and the long-seq regime
+    # 7. model-knob A/Bs: jax's bundled flash kernel at the flagship
+    # shape, and the fused LM head at the flagship + long-seq regimes
     for tag, extra in (
+        ("jax_flash_flagship", {"EDL_BENCH_EXTRA_PARAMS":
+                                "attn_impl='jax_flash'"}),
         ("fused_head_flagship", {"EDL_BENCH_EXTRA_PARAMS":
                                  "fused_head=True"}),
         ("baseline_seq2048", {"EDL_BENCH_EXTRA_PARAMS": "seq_len=2048",

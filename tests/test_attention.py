@@ -299,3 +299,17 @@ def test_ulysses_auto_picks_flash(monkeypatch):
                             attn_impl="auto")
     assert calls["n"] > 0, "ulysses auto did not reach the flash kernel"
     assert out.shape == (2, 8, 64, 16)
+
+
+def test_jax_flash_off_tpu_fallback_and_window_rejection():
+    """attn_impl='jax_flash' off-TPU falls back to the blockwise path
+    (values match naive); sliding windows are rejected explicitly."""
+    from elasticdl_tpu.ops.attention import jax_flash_attention
+
+    q, k, v = _qkv(13, l=32, d=16)
+    out = jax_flash_attention(q, k, v, causal=True)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="sliding-window"):
+        jax_flash_attention(q, k, v, causal=True, window=4)

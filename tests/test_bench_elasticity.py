@@ -21,8 +21,12 @@ def test_elastic_scheduling_beats_gang_on_wait_time():
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts",
                                       "bench_elasticity.py"),
-         "--records", "64", "--records2", "1280", "--job2-delay", "2"],
-        capture_output=True, text=True, timeout=900, cwd=REPO,
+         "--records", "64", "--records2", "1280", "--job2-delay", "2",
+         "--timeout", "350"],
+        # outer timeout > 2 modes x inner 350s + overhead: the script's
+        # own TimeoutError must fire first so its finally-cleanup runs
+        # and its diagnostics (worker log tails) surface
+        capture_output=True, text=True, timeout=880, cwd=REPO,
     )
     assert r.returncode == 0, r.stderr[-3000:]
     out = json.loads(r.stdout.strip().splitlines()[-1])

@@ -58,6 +58,26 @@ def test_flash_attention_compiled(causal, dtype):
     )
 
 
+def test_jax_flash_dispatch_compiled():
+    """attn_impl='jax_flash' routes to jax's bundled TPU flash kernel;
+    values must match the naive oracle (the hardware sweep compares its
+    speed against ours — scripts/bench_attention.py)."""
+    rng = np.random.default_rng(3)
+    b, h, seq, d = 2, 4, 256, 128
+    q = jnp.asarray(_rand(rng, b, h, seq, d), jnp.bfloat16)
+    k = jnp.asarray(_rand(rng, b, h, seq, d), jnp.bfloat16)
+    v = jnp.asarray(_rand(rng, b, h, seq, d), jnp.bfloat16)
+    out = attention.jax_flash_attention(q, k, v, causal=True)
+    oracle = attention.naive_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(oracle), atol=2e-2,
+        rtol=2e-2,
+    )
+
+
 def test_flash_attention_grad_compiled():
     rng = np.random.default_rng(1)
     b, h, seq, d = 1, 2, 128, 64
