@@ -1,0 +1,99 @@
+"""Gradient-plane collective bandwidth (BASELINE.md target:
+"PS→allreduce gradient bandwidth").
+
+The reference's gradient plane was gRPC push/pull to PS pods (256 MB
+message cap); ours is the psum XLA inserts inside the compiled step.
+This measures that plane directly: an all-reduce of a flagship-sized
+gradient pytree over every device the mesh has.
+
+* multi-chip TPU: the number is ICI all-reduce bandwidth — the
+  v5e-16 figure BASELINE.md asks to establish;
+* single chip: the collective degenerates to identity, so the bench
+  reports the in-place gradient update bandwidth (HBM) instead and
+  labels it as such;
+* CPU (virtual 8-device mesh): functional smoke only, labeled cpu.
+
+Timing is fetch-forced (common/timing_utils.fetch_sync): over the
+tunneled PJRT plugin block_until_ready can return early.
+
+    python scripts/bench_collectives.py [size_mb]
+
+Prints ONE JSON line {"metric": ..., "value": GB/s, ...}.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.common.timing_utils import fetch_sync
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+
+    size_mb = float(sys.argv[1]) if len(sys.argv) > 1 else 256.0
+    n = int(size_mb * 1e6 / 4)
+    mesh = mesh_lib.build_mesh()
+    n_dev = mesh.size
+    axes = tuple(mesh.axis_names)
+
+    def grad_allreduce(local):
+        # the gradient plane: sum over every mesh axis (what the
+        # batch-sharded loss's backward inserts for replicated params)
+        return jax.lax.psum(local, axes)
+
+    fn = jax.jit(
+        jax.shard_map(
+            grad_allreduce, mesh=mesh,
+            in_specs=P(axes[0]), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    rng = np.random.RandomState(0)
+    # leading dim divisible by every axis: pad up
+    rows = ((n // 128 + n_dev - 1) // n_dev) * n_dev
+    x = jnp.asarray(rng.rand(rows, 128).astype(np.float32))
+    bytes_payload = x.size * 4
+
+    out = fn(x)
+    fetch_sync(out)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    fetch_sync(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    platform = jax.default_backend()
+    # ring all-reduce moves 2*(n-1)/n of the payload per link; report
+    # the conventional algorithm bandwidth payload/time and the bus
+    # bandwidth alongside
+    algo_bw = bytes_payload / dt
+    bus_bw = algo_bw * (2 * (n_dev - 1) / n_dev if n_dev > 1 else 1.0)
+    print(json.dumps({
+        "metric": (
+            "grad_allreduce_bandwidth" if n_dev > 1
+            else "grad_reduce_hbm_bandwidth_single_device"
+        ),
+        "value": round(algo_bw / 1e9, 2),
+        "unit": "GB/s",
+        "vs_baseline": 1.0,
+        "bus_bandwidth_gbps": round(bus_bw / 1e9, 2),
+        "payload_mb": round(bytes_payload / 1e6, 1),
+        "devices": n_dev,
+        "mesh": dict(mesh.shape),
+        "platform": platform,
+        "step_ms": round(dt * 1e3, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -163,6 +163,8 @@ def run_cluster(mode, slots, make_jobs, job2_delay, timeout=900):
     job2.t_submit = t0 + job2_delay
     pending = [job1]
     deadline = t0 + timeout
+    used_slot_seconds = 0.0
+    t_prev = t0
     try:
         while time.time() < deadline:
             now = time.time()
@@ -172,6 +174,8 @@ def run_cluster(mode, slots, make_jobs, job2_delay, timeout=900):
             running = [j for j in (job1, job2) if j.procs]
             used = sum(j.live_workers for j in running)
             free = slots - used
+            used_slot_seconds += used * (now - t_prev)
+            t_prev = now
             for job in list(pending):
                 if job.t_first_worker is None:
                     need = (
@@ -227,6 +231,11 @@ def run_cluster(mode, slots, make_jobs, job2_delay, timeout=900):
             "job2_wait_s": round(
                 job2.t_first_worker - job2.t_submit, 1),
             "job2_peak_workers": job2.peak_workers,
+            # report_cn.md:88-91's utilization property: fraction of
+            # slot-seconds busy over the makespan
+            "utilization": round(
+                used_slot_seconds
+                / (slots * (max(job1.t_done, job2.t_done) - t0)), 3),
         }
     finally:
         job1.stop()

@@ -223,6 +223,17 @@ def main():
                timeout=1800, tag="profile_step")
     record(prof)
 
+    # 6b. gradient-plane collective bandwidth (BASELINE.md target;
+    # single-chip reports the HBM-degenerate number, multi-chip the
+    # ICI all-reduce figure)
+    coll = runner([sys.executable, "scripts/bench_collectives.py"],
+                  timeout=900, tag="collectives")
+    record(coll)
+    parsed = last_json_line(coll["stdout"])
+    if parsed and parsed.get("platform") not in (None, "cpu"):
+        results["collectives"] = parsed
+        save(results, args.out)
+
     # 7. model-knob A/Bs: jax's bundled flash kernel at the flagship
     # shape, and the fused LM head at the flagship + long-seq regimes
     for tag, extra in (
