@@ -202,8 +202,8 @@ def main():
                 json.dump(flag, f, indent=1)
             print("[hw_session] BENCH_BASELINE.json updated")
 
-    # 4./5. secondary BASELINE.md targets
-    for model in ("resnet50", "deepfm"):
+    # 4./5. secondary BASELINE.md targets + decode throughput
+    for model in ("resnet50", "deepfm", "decode"):
         step = runner([sys.executable, "bench.py"], timeout=1800,
                    env_extra={"EDL_BENCH_MODEL": model,
                               "EDL_BENCH_PROBE_TIMEOUT": "150"},
