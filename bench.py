@@ -471,11 +471,60 @@ def run_decode_bench(on_tpu):
     }
 
 
+def run_dlrm_bench(on_tpu):
+    """BASELINE.json configs[4]: DLRM with ~1B embedding parameters
+    (26 tables x 1.2M rows x 32 dims = 4 GB fp32 in sharded HBM,
+    sparse-row updates). Samples/sec/chip; MFU not reported (the model
+    is gather/bandwidth-bound)."""
+    import numpy as np
+
+    from model_zoo.dlrm import dlrm as zoo
+
+    if on_tpu:
+        table_size, dim, batch_size, iters, warmup = (
+            1_200_000, 32, 4096, 20, 3)
+    else:
+        table_size, dim, batch_size, iters, warmup = 2048, 8, 64, 3, 1
+
+    from elasticdl_tpu.common.model_utils import format_params_str
+
+    rng = np.random.RandomState(0)
+    batch = (
+        {
+            "dense": rng.rand(batch_size, 13).astype(np.float32),
+            "sparse": rng.randint(
+                0, table_size, size=(batch_size, 26)
+            ).astype(np.int32),
+        },
+        rng.randint(2, size=(batch_size,)).astype(np.int32),
+    )
+    step_time, n_chips, dev, platform, n_params = _run_zoo_bench(
+        zoo, batch, iters, warmup,
+        model_params=format_params_str(
+            dict(table_size=table_size, embedding_dim=dim)
+        ),
+    )
+    return {
+        "metric": "dlrm_train_samples_per_sec_per_chip",
+        "value": round(batch_size / step_time / n_chips, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": 1.0,
+        "mfu": None,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "params_b": round(n_params / 1e9, 3),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "") or platform,
+        "batch_size": batch_size,
+        "table_size": table_size,
+    }
+
+
 _BENCHES = {
     "transformer": run_transformer_bench,
     "resnet50": run_resnet50_bench,
     "deepfm": run_deepfm_bench,
     "decode": run_decode_bench,
+    "dlrm": run_dlrm_bench,
 }
 
 

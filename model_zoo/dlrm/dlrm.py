@@ -1,0 +1,171 @@
+"""DLRM (Deep Learning Recommendation Model, Naumov et al. 2019) — the
+BASELINE.json configs[4] "DLRM-1B-embedding" stress family: 26
+categorical tables whose combined parameter count reaches the billions,
+exercising the sparse PS-replacement tiers at scale (SURVEY.md §7 build
+order #8). The reference has no DLRM; this is the net-new config its
+north star names.
+
+Architecture (the canonical one):
+    dense [b, 13] -> bottom MLP -> [b, d]
+    26 categorical ids -> per-table Embedding lookups -> [b, 26, d]
+    pairwise dot-product feature interactions over the 27 vectors
+    concat(bottom, interactions) -> top MLP -> logit
+
+TPU-first mapping: every table is the framework Embedding layer, so
+tables past the 2 MB threshold shard over the (ep, fsdp) mesh axes with
+O(touched rows) sparse-row updates (embedding/sparse_update.py) — the
+billion-parameter capacity lives in sharded HBM where the reference's
+PS pods held it in pod RAM. The interaction is one batched einsum
+(MXU-friendly) with a static upper-triangle gather.
+
+Size knobs: `table_size` rows per table x `num_tables` tables x
+`embedding_dim` -> 26 x 1.5e6 x 32 ≈ 1.2B embedding parameters at the
+stress config (bench.py EDL_BENCH_MODEL=dlrm uses a single-chip-sized
+default; scale table_size for the full stress).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.embedding.layer import Embedding
+from elasticdl_tpu.training.metrics import AUC
+
+NUM_DENSE = 13
+NUM_SPARSE = 26
+
+
+def _mlp(x, sizes, name):
+    for i, width in enumerate(sizes):
+        x = nn.Dense(width, name="%s_%d" % (name, i))(x)
+        if i < len(sizes) - 1:
+            x = nn.relu(x)
+    return x
+
+
+class DLRM(nn.Module):
+    table_size: int = 100_000  # rows per categorical table
+    num_tables: int = NUM_SPARSE
+    embedding_dim: int = 32
+    bottom_mlp: tuple = (64, 32)
+    top_mlp: tuple = (64, 1)
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        dense = features["dense"].astype(jnp.float32)  # [b, 13]
+        # fold hashed ids into this model's table range (ids arrive
+        # hashed modulo HASH_BUCKETS; a smaller table double-hashes)
+        ids = features["sparse"].astype(jnp.int32) % self.table_size
+        d = self.embedding_dim
+
+        bottom = _mlp(dense, self.bottom_mlp + (d,), "bottom")  # [b, d]
+        embs = [
+            Embedding(
+                input_dim=self.table_size, output_dim=d,
+                name="table_%d" % t,
+            )(ids[:, t])
+            for t in range(self.num_tables)
+        ]
+        z = jnp.stack([bottom] + embs, axis=1)  # [b, T+1, d]
+
+        # pairwise dot-product interactions: one batched matmul, then
+        # the static upper triangle (i < j)
+        inter = jnp.einsum("bmd,bnd->bmn", z, z)
+        iu, ju = np.triu_indices(z.shape[1], k=1)
+        pairs = inter[:, iu, ju]  # [b, (T+1)T/2]
+
+        top_in = jnp.concatenate([bottom, pairs], axis=1)
+        logits = _mlp(
+            top_in, self.top_mlp, "top"
+        ).reshape(-1)
+        return {
+            "logits": logits,
+            "probs": nn.sigmoid(logits).reshape(-1, 1),
+        }
+
+
+def custom_model(table_size=100_000, num_tables=NUM_SPARSE,
+                 embedding_dim=32, bottom_mlp=(64, 32),
+                 top_mlp=(64, 1)):
+    return DLRM(
+        table_size=table_size,
+        num_tables=num_tables,
+        embedding_dim=embedding_dim,
+        bottom_mlp=tuple(bottom_mlp),
+        top_mlp=tuple(top_mlp),
+    )
+
+
+def loss(labels, predictions, sample_weights=None):
+    logits = predictions["logits"].reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    ce = optax.sigmoid_binary_cross_entropy(logits, labels)
+    if sample_weights is None:
+        return jnp.mean(ce)
+    w = sample_weights.reshape(-1)
+    return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1e-9)
+
+
+def optimizer(lr=0.01):
+    return optax.sgd(lr)
+
+
+# Hash modulus for categorical strings -> ids (DLRM's standard
+# preprocessing). Must be <= the model's table_size; the default model
+# uses exactly this value, and larger tables stay valid (ids < modulus).
+HASH_BUCKETS = 100_000
+
+
+def dataset_fn(dataset, mode, _):
+    """Criteo/DAC records (data/recordio_gen.gen_criteo_like: numeric
+    I1..I13, categorical strings C1..C26, binary label): dense features
+    log-normalized, categorical strings hashed into HASH_BUCKETS ids —
+    the canonical DLRM preprocessing for Criteo."""
+    from elasticdl_tpu.common.hash_utils import string_to_id
+
+    def _parse(record):
+        ex = decode_example(record)
+        dense = np.array(
+            [float(ex["I%d" % i]) for i in range(1, NUM_DENSE + 1)],
+            np.float32,
+        )
+        dense = np.log1p(np.maximum(dense, 0.0))
+        sparse = np.array(
+            [
+                string_to_id(
+                    np.asarray(ex["C%d" % i]).item().decode(),
+                    HASH_BUCKETS,
+                )
+                for i in range(1, NUM_SPARSE + 1)
+            ],
+            np.int32,
+        )
+        features = {"dense": dense, "sparse": sparse}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, np.int32(ex["label"])
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "logits": {
+            "accuracy": lambda labels, predictions: (
+                (np.asarray(predictions).reshape(-1) > 0.0).astype(
+                    np.int32)
+                == np.asarray(labels).reshape(-1)
+            ).astype(np.float32)
+        },
+        "probs": {"auc": AUC()},
+    }
+
+
+def feature_shapes():
+    return {"dense": (NUM_DENSE,), "sparse": (NUM_SPARSE,)}

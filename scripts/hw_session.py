@@ -12,12 +12,14 @@ Steps (each in its own bounded subprocess; a hang or crash moves on):
                          elasticdl_tpu/ops/flash_tuning.json (the
                          repo-wide tuned default) when it beats 128/128
   3. flagship bench    — python bench.py before/after the tuned blocks
-  4. resnet50 bench    — EDL_BENCH_MODEL=resnet50 (BASELINE.md target)
-  5. deepfm bench      — EDL_BENCH_MODEL=deepfm  (BASELINE.md target)
+  4./5. secondary benches — EDL_BENCH_MODEL=resnet50|deepfm|decode|dlrm
+                         (BASELINE.md targets + decode throughput +
+                         the 1B-embedding DLRM stress config)
   6. profile           — scripts/profile_step.py (attention share)
-  7. fused-head A/B    — bench with fused_head=True at the flagship
-                         shape AND at seq_len=2048 (the regime VERDICT
-                         asks to prove or prune)
+  6b. collectives      — gradient-plane all-reduce bandwidth
+  7. model-knob A/Bs   — jax's bundled flash kernel; fused LM head at
+                         the flagship shape AND seq_len=2048 (the
+                         regime VERDICT asks to prove or prune)
 
 Everything lands in --out (JSON, appended after each step) plus the raw
 logs next to it; BENCH_BASELINE.json is updated ONLY when the flagship
@@ -203,7 +205,7 @@ def main():
             print("[hw_session] BENCH_BASELINE.json updated")
 
     # 4./5. secondary BASELINE.md targets + decode throughput
-    for model in ("resnet50", "deepfm", "decode"):
+    for model in ("resnet50", "deepfm", "decode", "dlrm"):
         step = runner([sys.executable, "bench.py"], timeout=1800,
                    env_extra={"EDL_BENCH_MODEL": model,
                               "EDL_BENCH_PROBE_TIMEOUT": "150"},
