@@ -519,12 +519,71 @@ def run_dlrm_bench(on_tpu):
     }
 
 
+def run_bert_bench(on_tpu):
+    """BASELINE.json configs[4] first half: BERT-base-shape masked-LM
+    pretraining throughput (12 layers x 768 x 12 heads, seq 512)."""
+    import numpy as np
+
+    from model_zoo.bert import bert as zoo
+
+    if on_tpu:
+        cfg = dict(vocab_size=30522, seq_len=512, embed_dim=768,
+                   num_heads=12, num_layers=12)
+        batch_size, iters, warmup = 16, 20, 3
+    else:
+        cfg = dict(vocab_size=512, seq_len=64, embed_dim=64,
+                   num_heads=4, num_layers=2)
+        batch_size, iters, warmup = 4, 3, 1
+
+    from elasticdl_tpu.common.model_utils import format_params_str
+
+    params = dict(cfg)
+    if on_tpu:
+        params["dtype"] = "bf16"
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(
+        1, cfg["vocab_size"], size=(batch_size, cfg["seq_len"])
+    ).astype(np.int32)
+    # masked-LM batch: the zoo's dataset_fn masks host-side; feed the
+    # same shape it produces (masked tokens + labels)
+    labels = tokens.copy()
+    masked = tokens.copy()
+    masked[:, :: 7] = 0  # mask id
+    batch = ({"tokens": masked}, labels)
+    step_time, n_chips, dev, platform, n_params = _run_zoo_bench(
+        zoo, batch, iters, warmup,
+        model_params=format_params_str(params),
+    )
+    tokens_per_sec = batch_size * cfg["seq_len"] / step_time
+    flops = transformer_flops_per_step(
+        batch_size, cfg["seq_len"], cfg["embed_dim"],
+        cfg["num_layers"], cfg["vocab_size"],
+    )
+    mfu = None if platform == "cpu" else round(
+        flops / step_time / (_peak_flops(
+            getattr(dev, "device_kind", "")) * n_chips), 4)
+    return {
+        "metric": "bert_mlm_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / n_chips, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "mfu": mfu,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "params_m": round(n_params / 1e6, 1),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "") or platform,
+        "config": cfg,
+        "batch_size": batch_size,
+    }
+
+
 _BENCHES = {
     "transformer": run_transformer_bench,
     "resnet50": run_resnet50_bench,
     "deepfm": run_deepfm_bench,
     "decode": run_decode_bench,
     "dlrm": run_dlrm_bench,
+    "bert": run_bert_bench,
 }
 
 

@@ -12,7 +12,7 @@ Steps (each in its own bounded subprocess; a hang or crash moves on):
                          elasticdl_tpu/ops/flash_tuning.json (the
                          repo-wide tuned default) when it beats 128/128
   3. flagship bench    — python bench.py before/after the tuned blocks
-  4./5. secondary benches — EDL_BENCH_MODEL=resnet50|deepfm|decode|dlrm
+  4./5. secondary benches — EDL_BENCH_MODEL=resnet50|deepfm|decode|dlrm|bert
                          (BASELINE.md targets + decode throughput +
                          the 1B-embedding DLRM stress config)
   6. profile           — scripts/profile_step.py (attention share)
@@ -205,7 +205,7 @@ def main():
             print("[hw_session] BENCH_BASELINE.json updated")
 
     # 4./5. secondary BASELINE.md targets + decode throughput
-    for model in ("resnet50", "deepfm", "decode", "dlrm"):
+    for model in ("resnet50", "deepfm", "decode", "dlrm", "bert"):
         step = runner([sys.executable, "bench.py"], timeout=1800,
                    env_extra={"EDL_BENCH_MODEL": model,
                               "EDL_BENCH_PROBE_TIMEOUT": "150"},
