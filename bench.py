@@ -544,11 +544,15 @@ def run_bert_bench(on_tpu):
     tokens = rng.randint(
         1, cfg["vocab_size"], size=(batch_size, cfg["seq_len"])
     ).astype(np.int32)
-    # masked-LM batch: the zoo's dataset_fn masks host-side; feed the
-    # same shape it produces (masked tokens + labels)
-    labels = tokens.copy()
+    # masked-LM batch matching the zoo's recipe (model_zoo/bert/bert.py
+    # _mask_tokens): [MASK] is the reserved id vocab_size, and labels
+    # carry the original token at masked positions, IGNORE_LABEL (-1)
+    # elsewhere — so the bench loss is the real masked-subset loss
     masked = tokens.copy()
-    masked[:, :: 7] = 0  # mask id
+    mask_positions = np.zeros_like(tokens, bool)
+    mask_positions[:, ::7] = True
+    masked[mask_positions] = cfg["vocab_size"]
+    labels = np.where(mask_positions, tokens, -1).astype(np.int32)
     batch = ({"tokens": masked}, labels)
     step_time, n_chips, dev, platform, n_params = _run_zoo_bench(
         zoo, batch, iters, warmup,
