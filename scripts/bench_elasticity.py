@@ -242,6 +242,80 @@ def run_cluster(mode, slots, make_jobs, job2_delay, timeout=900):
         job2.stop()
 
 
+def run_mixed(slots, make_job, phases, timeout=900):
+    """Mixed deployment (report_cn.md:94-106): a latency-sensitive
+    service autoscales over `phases` = [(duration_s, slots_demanded)],
+    and a LOW-PRIORITY elastic training job runs on whatever is left —
+    yielding workers to the service via PREEMPTION (SIGKILL + task
+    recovery) on scale-up and reclaiming slots on scale-down. Returns
+    utilization of the whole cluster plus the training job's fate."""
+    job = make_job()
+    t0 = time.time()
+    job.t_submit = t0
+    deadline = t0 + timeout
+    busy_slot_seconds = 0.0
+    t_prev = t0
+    preemptions = 0
+
+    def demand_at(elapsed):
+        acc = 0.0
+        for dur, d in phases:
+            acc += dur
+            if elapsed < acc:
+                return d
+        return phases[-1][1]
+
+    try:
+        while time.time() < deadline:
+            now = time.time()
+            demand = demand_at(now - t0)
+            live = job.live_workers
+            busy_slot_seconds += min(demand + live, slots) * (
+                now - t_prev)
+            t_prev = now
+            for i, rc in job.crashed_workers():
+                if i not in job.recovered:
+                    job.recovered.add(i)
+                    job.master.task_d.recover_tasks(i)
+            free_for_training = slots - demand
+            if live > free_for_training:
+                # service scaled up: preempt the newest training
+                # workers (SIGKILL, the exit-137-class path); their
+                # tasks go back to todo
+                for idx in range(len(job.procs) - 1, -1, -1):
+                    if live <= free_for_training:
+                        break
+                    p = job.procs[idx]
+                    if p.poll() is None:
+                        p.kill()
+                        p.wait()
+                        job.recovered.add(idx)
+                        job.master.task_d.recover_tasks(idx)
+                        preemptions += 1
+                        live -= 1
+            else:
+                while (live < min(free_for_training,
+                                  job.target_workers)
+                       and not job.finished and job.todo_count > 0):
+                    job.launch_worker()
+                    live += 1
+            if job.finished:
+                break
+            time.sleep(0.25)
+        else:
+            raise TimeoutError("mixed run exceeded %ds" % timeout)
+        makespan = job.t_done - t0
+        return {
+            "utilization": round(
+                busy_slot_seconds / (slots * makespan), 3),
+            "training_makespan_s": round(makespan, 1),
+            "preemptions": preemptions,
+            "training_completed": True,
+        }
+    finally:
+        job.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=3)
@@ -253,6 +327,9 @@ def main(argv=None):
                          "running when job1's slots free)")
     ap.add_argument("--job2-delay", type=float, default=3.0)
     ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument("--mixed", action="store_true",
+                    help="run the mixed-deployment (service + "
+                         "low-priority training) scenario instead")
     args = ap.parse_args(argv)
     if args.workers_per_job > args.slots:
         ap.error(
@@ -280,6 +357,25 @@ def main(argv=None):
                 Job("job1", dirs[0], args.workers_per_job),
                 Job("job2", dirs[1], args.workers_per_job),
             )
+
+        if args.mixed:
+            # service demand: low -> high -> low (the reference's
+            # autoscaled-NGINX pattern); training takes the leftovers
+            mixed = run_mixed(
+                args.slots,
+                lambda: Job("train", dirs[1], args.workers_per_job),
+                phases=[(15, 1), (20, args.slots - 1), (10_000, 1)],
+                timeout=args.timeout,
+            )
+            print(json.dumps({
+                "metric": "mixed_deployment_cluster_utilization",
+                "value": mixed["utilization"],
+                "unit": "fraction",
+                "vs_baseline": 1.0,
+                "slots": args.slots,
+                **mixed,
+            }))
+            return 0
 
         results = {}
         for mode in ("gang", "elastic"):

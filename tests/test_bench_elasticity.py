@@ -42,3 +42,22 @@ def test_elastic_scheduling_beats_gang_on_wait_time():
     # when job1's slots free: elastic must have scaled it up mid-job
     # (peak counts CONCURRENT workers, not launches)
     assert elastic["job2_peak_workers"] >= 2, out
+
+
+@pytest.mark.slow
+def test_mixed_deployment_training_survives_preemption():
+    """report_cn.md:94-106: a low-priority elastic training job rides
+    leftover capacity under an autoscaling service — it must get
+    PREEMPTED on service scale-up (SIGKILL + task recovery), still
+    complete, and keep the cluster busy."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_elasticity.py"),
+         "--mixed", "--records2", "1280", "--timeout", "350"],
+        capture_output=True, text=True, timeout=880, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["training_completed"], out
+    assert out["preemptions"] >= 1, out
+    assert out["utilization"] > 0.85, out
