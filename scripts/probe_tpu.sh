@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Bounded TPU-tunnel probe, appending one timestamped line to
+# TUNNEL_LOG.md. The axon tunnel flaps (BENCHNOTES.md); this keeps an
+# auditable record of when hardware was reachable. Usage:
+#   scripts/probe_tpu.sh [timeout_s]
+set -u
+cd "$(dirname "$0")/.."
+T=${1:-90}
+TS=$(date -u +"%Y-%m-%d %H:%M UTC")
+OUT=$(PYTHONPATH=/root/.axon_site timeout "$T" python -c \
+  "import jax, jax.numpy as jnp; x = jnp.ones((256, 256)); \
+   print(float((x @ x).sum())); print('PROBE_UP', jax.devices())" 2>&1)
+if echo "$OUT" | grep -q PROBE_UP; then
+    STATUS="UP: $(echo "$OUT" | grep PROBE_UP | tail -c 120)"
+else
+    STATUS="wedged (no response in ${T}s)"
+fi
+echo "- $TS — $STATUS" >> TUNNEL_LOG.md
+echo "$STATUS"
