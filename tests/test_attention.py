@@ -313,3 +313,21 @@ def test_jax_flash_off_tpu_fallback_and_window_rejection():
                                rtol=1e-4, atol=1e-5)
     with pytest.raises(ValueError, match="sliding-window"):
         jax_flash_attention(q, k, v, causal=True, window=4)
+
+
+def test_ulysses_jax_flash_matches_naive():
+    """attn_impl='jax_flash' through Ulysses: the dispatch map routes
+    the local full-sequence attention to jax's bundled kernel (which
+    falls back to blockwise off-TPU) — values must match the naive
+    oracle on the sp mesh."""
+    from elasticdl_tpu.parallel.context_parallel import ulysses_attention
+
+    rs = np.random.RandomState(21)
+    mk = lambda: jnp.asarray(rs.randn(2, 8, 64, 16).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    mesh = mesh_lib.build_mesh({"sp": 8})
+    out = ulysses_attention(q, k, v, mesh, causal=True,
+                            attn_impl="jax_flash")
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,27 @@
+"""The gradient-plane bandwidth bench (BASELINE.md target) stays
+runnable: one small payload over the virtual 8-device mesh."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_collectives_bench_smoke():
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "bench_collectives.py"), "8"],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["devices"] == 8
+    assert out["value"] > 0
+    assert out["metric"] == "grad_allreduce_bandwidth"
