@@ -106,14 +106,46 @@ def lse_merge(o, lse, o_i, lse_i):
     return o * w + o_i * w_i, lse_new
 
 
+def group_size(q, k):
+    """Grouped-query group size: q heads per kv head. 1 for standard
+    multi-head attention; >1 when k/v carry fewer heads (GQA; ==num_heads
+    for multi-query). Validates divisibility."""
+    h, hkv = q.shape[1], k.shape[1]
+    if h % hkv:
+        raise ValueError(
+            "grouped-query attention needs num_heads %% num_kv_heads "
+            "== 0, got %d q heads / %d kv heads" % (h, hkv)
+        )
+    return h // hkv
+
+
+def expand_kv(kv, num_heads):
+    """Broadcast grouped-query K/V [b, hkv, l, d] to the full q head
+    count (head j reads kv head j // group — the standard GQA layout:
+    consecutive q heads share a kv head). Fallback for the jnp paths and
+    kernels without native grouping; the Pallas flash kernels instead
+    index kv blocks through the same j // group map, moving each kv
+    block HBM->VMEM once per group instead of materializing the repeat."""
+    hkv = kv.shape[1]
+    if hkv == num_heads:
+        return kv
+    if num_heads % hkv:
+        raise ValueError(
+            "cannot expand %d kv heads to %d q heads" % (hkv, num_heads)
+        )
+    return jnp.repeat(kv, num_heads // hkv, axis=1)
+
+
 def naive_attention(q, k, v, causal=False, scale=None, window=None):
     """Reference softmax(q k^T) v; O(L^2) memory. The test oracle (the
     flash backward is the Pallas two-pass _flash_backward below).
     `window` (sliding-window/local attention): query at position p sees
     keys in (p - window, p] under causal, |p - k| < window otherwise —
-    None means unbounded."""
+    None means unbounded. k/v may carry fewer heads than q (GQA)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     _check_window(window, q.shape[2], k.shape[2])
+    k = expand_kv(k, q.shape[1])
+    v = expand_kv(v, q.shape[1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     lq, lk = scores.shape[-2], scores.shape[-1]
     q_pos = jnp.arange(lq)[:, None]
@@ -141,6 +173,8 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
     b, h, lq, d = q.shape
     lk = k.shape[2]
     _check_window(window, lq, lk)
+    k = expand_kv(k, h)
+    v = expand_kv(v, h)
     block = min(block_size, lk)
     if lk % block:
         # pad keys; padded positions masked below via k_pos >= lk
@@ -345,6 +379,37 @@ def _inner_spec(block, d):
     )
 
 
+def _kv_inner_spec(block, d, h, hkv):
+    """Streamed kv spec for the forward/dq kernels when k/v carry fewer
+    heads than q (GQA): grid dim 0 indexes b*h q-rows; kv row = batch
+    offset + q_head // group. Degenerates to _inner_spec at h == hkv."""
+    if h == hkv:
+        return _inner_spec(block, d)
+    group = h // hkv
+    return pl.BlockSpec(
+        (1, block, d),
+        lambda i, j, t: ((i // h) * hkv + (i % h) // group, t, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _dkv_q_spec(block, d, h, hkv, n_q):
+    """Streamed q-side spec for the dk/dv kernel under GQA: grid dim 0
+    indexes b*hkv kv-rows and grid dim 2 enumerates (group, q_block)
+    pairs flattened as t = g * n_q + q_block, so each kv block
+    accumulates over every q head in its group."""
+    if h == hkv:
+        return _inner_spec(block, d)
+    group = h // hkv
+    return pl.BlockSpec(
+        (1, block, d),
+        lambda i, j, t: (
+            (i // hkv) * h + (i % hkv) * group + t // n_q, t % n_q, 0
+        ),
+        memory_space=pltpu.VMEM,
+    )
+
+
 
 def _mosaic_params():
     """Grid semantics for all three flash kernels: (bh, output-block,
@@ -358,11 +423,12 @@ def _mosaic_params():
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
                    window=None, with_residuals=False):
     b, h, lq, d = q.shape
+    hkv = k.shape[1]
     lk = k.shape[2]
     bh = b * h
     q3 = q.reshape(bh, lq, d)
-    k3 = k.reshape(bh, lk, d)
-    v3 = v.reshape(bh, lk, d)
+    k3 = k.reshape(b * hkv, lk, d)
+    v3 = v.reshape(b * hkv, lk, d)
     n_q = lq // block_q
     n_k = lk // block_k
     kernel = functools.partial(
@@ -378,8 +444,8 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         kernel,
         grid=(bh, n_q, n_k),
         in_specs=[
-            _outer_spec(block_q, d), _inner_spec(block_k, d),
-            _inner_spec(block_k, d),
+            _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
+            _kv_inner_spec(block_k, d, h, hkv),
         ],
         out_specs=(
             _outer_spec(block_q, d),
@@ -442,16 +508,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                          scale, causal, window, block_q, block_k, n_q):
+                          scale, causal, window, block_q, block_k, n_q,
+                          n_q_total):
     ki = pl.program_id(1)  # key block is the outer (parallel) dim here
     qi = pl.program_id(2)
+    # under GQA the streamed dim enumerates (q_head_in_group, q_block)
+    # pairs: the positional q block index for masking is qi % n_q
+    # (identity when n_q_total == n_q, i.e. standard MHA)
+    qb = qi % n_q
 
     @pl.when(qi == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = _block_run(qi, ki, block_q, block_k, causal, window)
+    run = _block_run(qb, ki, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _():
@@ -459,7 +530,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = _block_mask(s, qi, ki, block_q, block_k, causal, window)
+        s = _block_mask(s, qb, ki, block_q, block_k, causal, window)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
         # dV_j += P^T dO ; dP = dO V^T ; dS = P*(dP - D) ; dK_j += dS^T Q
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
@@ -476,7 +547,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(qi == n_q_total - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -490,8 +561,15 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     matmul recompute instead of the O(L) blockwise-vjp scan).
     `grad_dtype` overrides the output dtype (ring attention asks for
     float32 partials so its cross-shard accumulation stays exact); the
-    in-kernel accumulation is float32 either way."""
+    in-kernel accumulation is float32 either way.
+
+    GQA (hkv < h): the dq pass reads kv blocks through the head-group
+    index map; the dk/dv pass runs one kv-row per kv head and streams
+    (group, q_block) pairs, so dk/dv come out group-summed in the native
+    [b, hkv, lk, d] shape with no extra HBM round-trip."""
     b, h, lq, d = q.shape
+    hkv = k.shape[1]
+    group = h // hkv
     lk = k.shape[2]
     bh = b * h
     interp = interpret_mode() if interpret is None else interpret
@@ -506,8 +584,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
         keepdims=True,
     )
     q3 = q.reshape(bh, lq, d)
-    k3 = k.reshape(bh, lk, d)
-    v3 = v.reshape(bh, lk, d)
+    k3 = k.reshape(b * hkv, lk, d)
+    v3 = v.reshape(b * hkv, lk, d)
     do3 = g.reshape(bh, lq, d)
     lse3 = lse.reshape(bh, lq, 1)
     delta3 = delta.reshape(bh, lq, 1)
@@ -520,8 +598,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
         ),
         grid=(bh, n_q, n_k),
         in_specs=[
-            _outer_spec(block_q, d), _inner_spec(block_k, d),
-            _inner_spec(block_k, d), _outer_spec(block_q, d),
+            _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
+            _kv_inner_spec(block_k, d, h, hkv), _outer_spec(block_q, d),
             col_q, col_q,
         ],
         out_specs=_outer_spec(block_q, d),
@@ -532,22 +610,25 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     )(q3, k3, v3, do3, lse3, delta3)
 
     # key-block-parallel pass: q-side inputs stream over the inner dim
-    col_q_t = _inner_spec(block_q, 1)
+    # (all (group, q_block) pairs under GQA)
+    q_spec = _dkv_q_spec(block_q, d, h, hkv, n_q)
+    col_q_t = _dkv_q_spec(block_q, 1, h, hkv, n_q)
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
             window=window, block_q=block_q, block_k=block_k, n_q=n_q,
+            n_q_total=group * n_q,
         ),
-        grid=(bh, n_k, n_q),
+        grid=(b * hkv, n_k, group * n_q),
         in_specs=[
-            _inner_spec(block_q, d), _outer_spec(block_k, d),
-            _outer_spec(block_k, d), _inner_spec(block_q, d),
+            q_spec, _outer_spec(block_k, d),
+            _outer_spec(block_k, d), q_spec,
             col_q_t, col_q_t,
         ],
         out_specs=(_outer_spec(block_k, d), _outer_spec(block_k, d)),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, lk, d), dk_dtype),
-            jax.ShapeDtypeStruct((bh, lk, d), dv_dtype),
+            jax.ShapeDtypeStruct((b * hkv, lk, d), dk_dtype),
+            jax.ShapeDtypeStruct((b * hkv, lk, d), dv_dtype),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -558,8 +639,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     )(q3, k3, v3, do3, lse3, delta3)
     return (
         dq.reshape(b, h, lq, d),
-        dk.reshape(b, h, lk, d),
-        dv.reshape(b, h, lk, d),
+        dk.reshape(b, hkv, lk, d),
+        dv.reshape(b, hkv, lk, d),
     )
 
 
@@ -595,9 +676,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     Pallas is disabled or the sequence doesn't tile into the blocks.
     `window`: sliding-window/local attention (see naive_attention) — the
     block-skip predicate prunes out-of-window key blocks, so compute
-    scales with window, not sequence."""
+    scales with window, not sequence. k/v may carry fewer heads than q
+    (GQA/MQA): the kernels index kv blocks through the head-group map
+    natively, no repeat is materialized."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    group_size(q, k)  # validate GQA divisibility before kernel dispatch
     block_q = min(resolve_block(block_q, "q"), lq)
     block_k = min(resolve_block(block_k, "k"), lk)
     _check_window(window, lq, lk)
@@ -639,6 +723,9 @@ def jax_flash_attention(q, k, v, causal=False, scale=None, window=None):
         flash_attention as _bundled,
     )
 
+    # the bundled kernel wants equal head counts; expand GQA kv
+    k = expand_kv(k, q.shape[1])
+    v = expand_kv(v, q.shape[1])
     d = q.shape[-1]
     q, k, v = _pad_lanes([q, k, v], d)
     out = _bundled(q, k, v, causal=causal, sm_scale=scale)
@@ -671,8 +758,10 @@ def attention_forward_lse(q, k, v, causal=False, scale=None,
                           block_q=None, block_k=None, interpret=None):
     """Attention returning (out, logsumexp): out [b,h,lq,d] in q.dtype,
     lse float32 [b,h,lq]. Pallas flash kernel when available and the
-    sequence tiles, else the blockwise scan."""
+    sequence tiles, else the blockwise scan. k/v may carry fewer heads
+    than q (GQA)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    group_size(q, k)  # validate GQA divisibility
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
     bq = min(resolve_block(block_q, "q"), lq)
     bk = min(resolve_block(block_k, "k"), lk)
@@ -697,9 +786,12 @@ def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
     delta = rowsum(g*out)). Pallas two-pass kernels when available, else
     a dense jnp recompute (O(L^2) memory — the CPU/test fallback).
     `grad_dtype` (e.g. float32 for ring partial accumulation) overrides
-    the default input-dtype outputs."""
+    the default input-dtype outputs. Under GQA (k/v with fewer heads)
+    dk/dv come back group-summed in the kv head count."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    hkv = k.shape[1]
+    group = group_size(q, k)
     bq = min(resolve_block(block_q, "q"), lq)
     bk = min(resolve_block(block_k, "k"), lk)
     if use_pallas() and _flash_tiles(lq, lk, bq, bk):
@@ -710,6 +802,9 @@ def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
         )
         return dq[..., :d], dk[..., :d], dv[..., :d]
     f32 = jnp.float32
+    b = q.shape[0]
+    k = expand_kv(k, q.shape[1])
+    v = expand_kv(v, q.shape[1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32), k.astype(f32)) * scale
     if causal:
         mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
@@ -722,6 +817,9 @@ def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
     ds = p * (dp - delta) * scale
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(f32))
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(f32))
+    if group > 1:  # GQA: sum the expanded-head grads back per kv head
+        dk = dk.reshape(b, hkv, group, lk, d).sum(2)
+        dv = dv.reshape(b, hkv, group, lk, d).sum(2)
     return (dq.astype(grad_dtype or q.dtype),
             dk.astype(grad_dtype or k.dtype),
             dv.astype(grad_dtype or v.dtype))

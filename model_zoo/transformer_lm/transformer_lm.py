@@ -24,6 +24,7 @@ from elasticdl_tpu.ops.attention import (
     NEG_INF,
     apply_rope,
     blockwise_attention,
+    expand_kv,
     flash_attention,
     jax_flash_attention,
 )
@@ -65,20 +66,39 @@ class CausalSelfAttention(nn.Module):
     use_rope: bool = False  # rotary q/k (global positions; sp-safe)
     window: int = 0  # sliding-window size; 0 = full attention
     cache_len: int = 0  # KV-cache capacity for decode mode
+    # grouped-query attention: kv heads (0 = num_heads, i.e. standard
+    # MHA; 1 = multi-query). Q head j reads kv head j // group. Shrinks
+    # the qkv projection and the decode KV cache by num_heads/kv_heads;
+    # the Pallas flash kernels consume the grouped layout natively.
+    num_kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None):
         b, l, e = x.shape
         h, d = self.num_heads, self.head_dim
+        hkv = self.num_kv_heads or h
+        if h % hkv:
+            raise ValueError(
+                "num_heads (%d) must be a multiple of num_kv_heads (%d)"
+                % (h, hkv)
+            )
         qkv = nn.Dense(
-            3 * h * d, use_bias=False, dtype=self.dtype, name="qkv",
+            (h + 2 * hkv) * d, use_bias=False, dtype=self.dtype,
+            name="qkv",
             kernel_init=(
                 _tp_dense_init(1) if self.tp_shard
                 else nn.initializers.lecun_normal()
             ),
         )(x)
-        qkv = qkv.reshape(b, l, 3, h, d).transpose(2, 0, 3, 1, 4)
-        q, k, v = qkv[0], qkv[1], qkv[2]  # [b, h, l, d]
+        q = qkv[..., : h * d].reshape(b, l, h, d).transpose(0, 2, 1, 3)
+        k = (
+            qkv[..., h * d:(h + hkv) * d]
+            .reshape(b, l, hkv, d).transpose(0, 2, 1, 3)
+        )
+        v = (
+            qkv[..., (h + hkv) * d:]
+            .reshape(b, l, hkv, d).transpose(0, 2, 1, 3)
+        )  # q: [b, h, l, d]; k/v: [b, hkv, l, d]
         if decode:
             return self._decode_step(q, k, v, e, decode_pos)
         if self.use_rope:
@@ -98,6 +118,12 @@ class CausalSelfAttention(nn.Module):
                     "sliding-window attention is single-shard only; "
                     "drop the sp axis or the window"
                 )
+            # ring merges partials per kv rotation and ulysses
+            # all-to-alls the head axis over sp — both want the full
+            # head count, so GQA kv expands here (the grouped layout
+            # still pays off in params and the decode cache)
+            k = expand_kv(k, h)
+            v = expand_kv(v, h)
             if self.sp_impl == "ulysses":
                 out = ulysses_attention(
                     q, k, v, mesh, causal=self.causal,
@@ -143,13 +169,14 @@ class CausalSelfAttention(nn.Module):
         )(out)
 
     def _decode_step(self, q, k, v, e, decode_pos):
-        """Single-token decode against the KV cache: q/k/v are
-        [b, h, 1, d]; cached keys/values live in the `cache` collection.
-        The absolute position `decode_pos` comes from the model's single
-        cache counter (one source of truth — per-layer counters could
-        only drift apart). RoPE rotates q and the cached k at that
-        position; causal masking is `k_pos <= pos`, windowing
-        `k_pos > pos - window`."""
+        """Single-token decode against the KV cache: q is [b, h, 1, d],
+        k/v [b, hkv, 1, d]; cached keys/values live in the `cache`
+        collection in the GROUPED head count — the GQA memory win: cache
+        reads per token scale with hkv, not h. The absolute position
+        `decode_pos` comes from the model's single cache counter (one
+        source of truth — per-layer counters could only drift apart).
+        RoPE rotates q and the cached k at that position; causal masking
+        is `k_pos <= pos`, windowing `k_pos > pos - window`."""
         if not self.causal:
             raise ValueError("decode mode requires a causal model")
         if self.cache_len < 1:
@@ -157,12 +184,14 @@ class CausalSelfAttention(nn.Module):
         if decode_pos is None:
             raise ValueError("decode mode needs decode_pos")
         b, h, _, d = q.shape
+        hkv = k.shape[1]
+        group = h // hkv
         dtype = q.dtype
         ck = self.variable(
-            "cache", "k", jnp.zeros, (b, h, self.cache_len, d), dtype
+            "cache", "k", jnp.zeros, (b, hkv, self.cache_len, d), dtype
         )
         cv = self.variable(
-            "cache", "v", jnp.zeros, (b, h, self.cache_len, d), dtype
+            "cache", "v", jnp.zeros, (b, hkv, self.cache_len, d), dtype
         )
         idx = decode_pos
         if self.use_rope:
@@ -176,17 +205,20 @@ class CausalSelfAttention(nn.Module):
             cv.value, v.astype(dtype), (0, 0, idx, 0)
         )
         scale = d ** -0.5
+        # group the q heads under their kv head: [b, hkv, group, d]
+        qg = (q * scale)[:, :, 0, :].reshape(b, hkv, group, d)
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", q * scale, ck.value
-        ).astype(jnp.float32)  # [b, h, 1, L]
+            "bhgd,bhkd->bhgk", qg, ck.value
+        ).astype(jnp.float32)  # [b, hkv, group, L]
         k_pos = jnp.arange(self.cache_len)
         valid = k_pos <= idx
         if self.window:
             valid = valid & (k_pos > idx - self.window)
         s = jnp.where(valid[None, None, None, :], s, NEG_INF)
         w = jax.nn.softmax(s, axis=-1).astype(dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", w, cv.value)
-        out = out.transpose(0, 2, 1, 3).reshape(b, 1, h * d)
+        out = jnp.einsum("bhgk,bhkd->bhgd", w, cv.value)
+        # (hkv, group) flattens back to h in q's head order
+        out = out.reshape(b, 1, h * d)
         return self._proj(out, e)
 
 
@@ -202,6 +234,7 @@ class Block(nn.Module):
     use_rope: bool = False
     window: int = 0
     cache_len: int = 0
+    num_kv_heads: int = 0  # grouped-query attention (0 = MHA)
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None):
@@ -212,7 +245,8 @@ class Block(nn.Module):
             attn_impl=self.attn_impl, sp_impl=self.sp_impl,
             tp_shard=self.tp_shard, causal=self.causal,
             use_rope=self.use_rope, window=self.window,
-            cache_len=self.cache_len, name="attn",
+            cache_len=self.cache_len,
+            num_kv_heads=self.num_kv_heads, name="attn",
         )(y, training, decode=decode, decode_pos=decode_pos)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
@@ -273,6 +307,7 @@ class TransformerLM(nn.Module):
     attn_window: int = 0  # sliding-window attention; 0 = full
     tp_shard: bool = True  # annotate kernels over the tp mesh axis
     fused_head: bool = False  # stream the LM head inside the loss
+    num_kv_heads: int = 0  # grouped-query attention (0 = MHA)
 
     @nn.compact
     def __call__(self, features, training=False, decode=False):
@@ -311,7 +346,8 @@ class TransformerLM(nn.Module):
                 tp_shard=self.tp_shard,
                 use_rope=self.pos_emb == "rope",
                 window=self.attn_window,
-                cache_len=self.seq_len, name="block_%d" % i,
+                cache_len=self.seq_len,
+                num_kv_heads=self.num_kv_heads, name="block_%d" % i,
             )(x, training, decode=decode, decode_pos=decode_pos)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(

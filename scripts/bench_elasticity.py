@@ -231,6 +231,10 @@ def run_cluster(mode, slots, make_jobs, job2_delay, timeout=900):
             "job2_wait_s": round(
                 job2.t_first_worker - job2.t_submit, 1),
             "job2_peak_workers": job2.peak_workers,
+            # launches are the scheduler's structural decision; peak
+            # CONCURRENT workers additionally depends on how fast a
+            # late-launched worker process comes up (load-dependent)
+            "job2_workers_launched": len(job2.procs),
             # report_cn.md:88-91's utilization property: fraction of
             # slot-seconds busy over the makespan
             "utilization": round(

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Long-running tunnel watch: probe the axon PJRT tunnel on a cadence
+# and run the full hardware session (scripts/hw_session.py) the moment
+# a probe answers. Appends to TUNNEL_LOG.md via probe_tpu.sh. Exits
+# after a completed hardware session so the log shows one session per
+# window. Usage:
+#   scripts/probe_and_measure_loop.sh [interval_s] [probe_timeout_s]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+INTERVAL=${1:-420}
+PROBE_T=${2:-90}
+while true; do
+    STATUS=$(bash scripts/probe_tpu.sh "$PROBE_T")
+    if echo "$STATUS" | grep -q "^UP"; then
+        echo "[loop] tunnel UP at $(date -u +%H:%M:%S) — running hw_session"
+        rm -f hw_session_results.json  # a stale file must not read as success
+        python scripts/hw_session.py --out hw_session_results.json \
+            2>&1 | tee hw_session_run.log
+        RC=$?
+        echo "[loop] hw_session rc=$RC"
+        if [ "$RC" -eq 0 ] && [ -s hw_session_results.json ]; then
+            echo "[loop] results saved; exiting"
+            exit 0
+        fi
+        echo "[loop] hw_session incomplete — continuing to probe"
+    fi
+    sleep "$INTERVAL"
+done

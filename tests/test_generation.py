@@ -103,7 +103,8 @@ def test_generate_contract():
 def test_kv_cache_matches_full_forward():
     """The KV-cached decode must produce the SAME tokens as the
     full-forward decode, for plain, RoPE and windowed configs."""
-    for extra in ("", "; pos_emb='rope'", "; attn_window=4"):
+    for extra in ("", "; pos_emb='rope'", "; attn_window=4",
+                  "; num_kv_heads=1"):
         mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
         trainer = Trainer(
             load_model_spec_from_module(zoo), mesh=mesh,

@@ -270,3 +270,23 @@ def test_eval_metrics():
     acc = metrics["token_accuracy"](labels, outputs)
     assert acc.shape[0] == 8
     assert 0.0 <= float(np.mean(acc)) <= 1.0
+
+
+def test_gqa_model_trains_with_smaller_projection():
+    """num_kv_heads < num_heads: the model trains, the qkv projection
+    shrinks to (h + 2*hkv) * head_dim columns, and loss decreases —
+    grouped-query attention end-to-end through the trainer."""
+    spec = load_model_spec_from_module(zoo)
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    params = PARAMS + "; num_heads=4; num_kv_heads=2"
+    t = Trainer(spec, mesh=mesh, model_params=params)
+    batch = _batch()
+    state = t.init_state(batch)
+    qkv = state.params["block_0"]["attn"]["qkv"]["kernel"]
+    head_dim = 32 // 4
+    assert qkv.shape[-1] == (4 + 2 * 2) * head_dim  # vs 3*4*head_dim MHA
+    losses = []
+    for step in range(12):
+        state, loss = t.train_step(state, _batch(seed=step % 3))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
