@@ -199,6 +199,27 @@ def _measure_steps(trainer, state, batch, iters, warmup):
     return dt, float(loss)
 
 
+def apply_extra_params(cfg, batch_size, on_tpu):
+    """The A/B channel shared by the transformer and decode benches:
+    EDL_BENCH_EXTRA_PARAMS ("fused_head=True; seq_len=2048") model knobs
+    and EDL_BENCH_BATCH. Shape-affecting keys merge INTO cfg so the
+    synthetic batch follows (and vs_baseline correctly degrades to 1.0
+    on config mismatch); the rest ride as model params. Returns
+    (params_dict, extra_dict, batch_size); mutates cfg in place."""
+    from elasticdl_tpu.common.model_utils import get_dict_from_params_str
+
+    extra = get_dict_from_params_str(
+        os.environ.get("EDL_BENCH_EXTRA_PARAMS", "")
+    )
+    cfg.update({k: v for k, v in extra.items() if k in cfg})
+    batch_size = int(os.environ.get("EDL_BENCH_BATCH", batch_size))
+    params = dict(cfg)
+    if on_tpu:
+        params["dtype"] = "bf16"
+    params.update({k: v for k, v in extra.items() if k not in cfg})
+    return params, extra, batch_size
+
+
 def run_transformer_bench(on_tpu):
     import numpy as np
 
@@ -218,26 +239,9 @@ def run_transformer_bench(on_tpu):
                    num_heads=4, num_layers=2)
         batch_size, iters, warmup = 8, 10, 2
 
-    from elasticdl_tpu.common.model_utils import (
-        format_params_str,
-        get_dict_from_params_str,
-    )
+    from elasticdl_tpu.common.model_utils import format_params_str
 
-    # EDL_BENCH_EXTRA_PARAMS ("fused_head=True; seq_len=2048") lets the
-    # hardware-session sweeps A/B model knobs through the same bench.
-    # Shape-affecting keys merge INTO cfg so the synthetic batch follows
-    # (and vs_baseline correctly degrades to 1.0 on config mismatch);
-    # EDL_BENCH_BATCH overrides the batch size.
-    extra = get_dict_from_params_str(
-        os.environ.get("EDL_BENCH_EXTRA_PARAMS", "")
-    )
-    cfg.update({k: v for k, v in extra.items() if k in cfg})
-    batch_size = int(os.environ.get("EDL_BENCH_BATCH", batch_size))
-
-    params = dict(cfg)
-    if on_tpu:
-        params["dtype"] = "bf16"
-    params.update({k: v for k, v in extra.items() if k not in cfg})
+    params, extra, batch_size = apply_extra_params(cfg, batch_size, on_tpu)
     model_params = format_params_str(params)
 
     rng = np.random.RandomState(0)
@@ -290,6 +294,7 @@ def run_transformer_bench(on_tpu):
         "device_kind": getattr(dev, "device_kind", "") or platform,
         "params_m": round(n_params / 1e6, 1),
         "config": cfg,
+        "extra_params": extra or None,
         "batch_size": batch_size,
     }
 
@@ -423,9 +428,9 @@ def run_decode_bench(on_tpu):
 
     import jax
 
-    params = dict(cfg)
-    if on_tpu:
-        params["dtype"] = "bf16"
+    # same A/B channel as the training bench (e.g. num_kv_heads for the
+    # GQA decode-cache comparison)
+    params, extra, batch = apply_extra_params(cfg, batch, on_tpu)
     spec = load_model_spec_from_module(zoo)
     mesh = mesh_lib.build_mesh()
     trainer = Trainer(spec, mesh=mesh,
@@ -468,6 +473,7 @@ def run_decode_bench(on_tpu):
         "device_kind": getattr(jax.devices()[0], "device_kind", "")
         or platform,
         "config": cfg,
+        "extra_params": extra or None,
     }
 
 

@@ -248,6 +248,11 @@ def main():
         ("fused_head_seq2048", {"EDL_BENCH_EXTRA_PARAMS":
                                 "fused_head=True; seq_len=2048",
                                 "EDL_BENCH_BATCH": "16"}),
+        # GQA decode A/B: 8 -> 2 kv heads = 4x smaller KV cache; decode
+        # is cache-bandwidth-bound, so this measures the GQA win
+        ("decode_gqa2", {"EDL_BENCH_MODEL": "decode",
+                         "EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
+        ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
     ):
         extra["EDL_BENCH_PROBE_TIMEOUT"] = "150"
         step = runner([sys.executable, "bench.py"], timeout=1800,

@@ -435,3 +435,37 @@ def test_gqa_lse_surface_both_paths(monkeypatch):
         np.testing.assert_allclose(np.asarray(gk), np.asarray(gj),
                                    rtol=1e-3, atol=1e-4)
     assert grads_k[1].shape == k.shape and grads_k[2].shape == v.shape
+
+
+def test_gqa_sliding_window_gradients():
+    """Windowed GQA through BOTH Pallas backward passes: the dkv
+    kernel's remapped q-block index (qb = qi % n_q while the streamed
+    dim enumerates (group, q_block) pairs) drives the window mask — a
+    regression that masked with the raw streamed index would corrupt
+    dk/dv here and nowhere else in the suite."""
+    from elasticdl_tpu.ops.attention import expand_kv
+
+    rs = np.random.RandomState(35)
+    b, h, hkv, l, d = 1, 4, 2, 64, 128
+    q = jnp.asarray(rs.randn(b, h, l, d).astype(np.float32) * 0.3)
+    k = jnp.asarray(rs.randn(b, hkv, l, d).astype(np.float32) * 0.3)
+    v = jnp.asarray(rs.randn(b, hkv, l, d).astype(np.float32) * 0.3)
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(q, k, v, causal=True, window=16, block_q=16,
+                            block_k=16) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (
+            naive_attention(q, expand_kv(k, h), expand_kv(v, h),
+                            causal=True, window=16) ** 2
+        ).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        assert gf.shape == gr.shape
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-3, atol=1e-4)

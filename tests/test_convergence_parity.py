@@ -1,0 +1,118 @@
+"""Convergence invariance under elastic mesh changes — the reference's
+published benchmark property (docs/benchmark/report_cn.md:108-120:
+Wide&Deep / xDeepFM trained with elastic 4<->8 workers converge
+indistinguishably from fixed-size runs; the reference can only show this
+empirically because its async PS makes the math worker-count-dependent).
+
+Here the claim is EXACT, not statistical: synchronous data-parallel
+training with a fixed global batch makes the device count invisible to
+the training math, so a run that re-forms dp=8 -> dp=4 -> dp=8
+mid-training (checkpoint + re-shard restore — the elastic path of
+test_elastic_reformation) must reproduce the uninterrupted dp=8 run's
+losses step for step and land on the same final parameters."""
+
+import numpy as np
+
+import jax
+
+from elasticdl_tpu.checkpoint import (
+    CheckpointSaver,
+    restore_state_from_checkpoint,
+)
+from elasticdl_tpu.common.model_utils import load_model_spec_from_module
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.training.trainer import Trainer
+from model_zoo.mnist_functional_api import mnist_functional_api as zoo
+
+
+def _batches(n, bsz=16, seed=0):
+    """Fixed global-batch stream shared by every run (task order is held
+    constant; the property under test is the mesh size, not data order)."""
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        img = rs.rand(bsz, 28, 28).astype(np.float32)
+        lab = rs.randint(10, size=(bsz,)).astype(np.int32)
+        out.append(({"image": img}, lab))
+    return out
+
+
+def _flat(state):
+    from elasticdl_tpu.checkpoint.saver import flatten_state
+
+    return flatten_state(state)
+
+
+def test_elastic_mesh_changes_do_not_change_convergence(tmp_path):
+    import optax
+
+    # lr 0.01 instead of the zoo's 0.1: the property is exact equality
+    # of the update math, and a gentler optimizer keeps float
+    # reduction-order drift (different device counts sum in different
+    # orders) from being chaotically amplified over the 12 steps
+    spec = load_model_spec_from_module(zoo)
+    spec.optimizer = lambda: optax.sgd(0.01)
+    batches = _batches(12)
+
+    # ---- fixed-size run: dp=8 straight through
+    t_fixed = Trainer(spec, mesh=mesh_lib.build_mesh({"dp": 8}))
+    s = t_fixed.init_state(batches[0])
+    fixed_losses = []
+    for b in batches:
+        s, loss = t_fixed.train_step(s, b)
+        fixed_losses.append(float(loss))
+    fixed_final = _flat(s)
+
+    # ---- elastic run: dp=8 (4 steps), shrink to dp=4 (4 steps, e.g. a
+    # host was preempted), grow back to dp=8 (4 steps) — each transition
+    # through a sharded checkpoint + re-shard restore
+    elastic_losses = []
+
+    t8 = Trainer(spec, mesh=mesh_lib.build_mesh({"dp": 8}))
+    s = t8.init_state(batches[0])
+    for b in batches[:4]:
+        s, loss = t8.train_step(s, b)
+        elastic_losses.append(float(loss))
+    saver = CheckpointSaver(
+        str(tmp_path / "shrink"), checkpoint_steps=1, num_shards=2
+    )
+    saver.save(s, version=int(s.step))
+
+    t4 = Trainer(
+        spec,
+        mesh=mesh_lib.build_mesh({"dp": 4}, devices=jax.devices()[:4]),
+    )
+    s4 = t4.init_state(batches[0])
+    s4, version = restore_state_from_checkpoint(
+        s4, str(tmp_path / "shrink")
+    )
+    assert version == 4
+    for b in batches[4:8]:
+        s4, loss = t4.train_step(s4, b)
+        elastic_losses.append(float(loss))
+    saver = CheckpointSaver(
+        str(tmp_path / "grow"), checkpoint_steps=1, num_shards=3
+    )
+    saver.save(s4, version=int(s4.step))
+
+    t8b = Trainer(spec, mesh=mesh_lib.build_mesh({"dp": 8}))
+    s8 = t8b.init_state(batches[0])
+    s8, version = restore_state_from_checkpoint(s8, str(tmp_path / "grow"))
+    assert version == 8
+    for b in batches[8:]:
+        s8, loss = t8b.train_step(s8, b)
+        elastic_losses.append(float(loss))
+
+    # losses agree step for step and the final parameters coincide:
+    # convergence is invariant to the elastic resizes (tolerances cover
+    # reduction-order float drift across different device counts)
+    np.testing.assert_allclose(
+        elastic_losses, fixed_losses, rtol=1e-3, atol=1e-6
+    )
+    elastic_final = _flat(s8)
+    assert set(elastic_final) == set(fixed_final)
+    for key in fixed_final:
+        np.testing.assert_allclose(
+            elastic_final[key], fixed_final[key], rtol=1e-2, atol=1e-4,
+            err_msg=key,
+        )
