@@ -39,6 +39,22 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def run(cmd, timeout, env_extra=None, tag="", base_env=None):
     env = dict(os.environ)
+    # the session is a controlled measurement: ambient bench/kernel
+    # knobs left exported in the operator's shell (EDL_BENCH_MODEL,
+    # EDL_BENCH_BATCH, EDL_FLASH_BLOCK_Q, ...) must not contaminate
+    # steps — each step declares its own via env_extra
+    for key in [k for k in env
+                if k.startswith(("EDL_BENCH_", "EDL_FLASH_"))]:
+        del env[key]
+    # shared persistent compile cache: repeated configs across steps
+    # (flagship prelim -> tuned re-run, A/B sweeps) skip their 20-40 s
+    # compiles, so a short tunnel window yields more measurements.
+    # Skipped for CPU-pinned children (--force dry runs): XLA:CPU AOT
+    # cache entries carry host machine features and can SIGILL when
+    # loaded under a different feature set (see bench.py's guard).
+    if (base_env or {}).get("JAX_PLATFORMS") != "cpu":
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(REPO, ".jax_cache"))
     env.update(base_env or {})
     env.update(env_extra or {})
     t0 = time.time()
@@ -141,6 +157,71 @@ def main():
             return 1
         print("[hw_session] probe failed but --force: continuing (CPU)")
 
+    def maybe_update_baseline(cand, note=""):
+        """Refresh BENCH_BASELINE.json when `cand` is a default-knob TPU
+        run that is strictly better on the identical baseline identity.
+
+        Identity mirrors bench.py's vs_baseline check (config +
+        batch_size + device_kind, both sides non-cpu), and additionally
+        requires extra_params to be unset: an A/B run (or ambient
+        EDL_BENCH_BATCH / EDL_BENCH_EXTRA_PARAMS in the operator's
+        shell) must never become the committed baseline — bench.py's
+        default runs could then never match it and vs_baseline would
+        silently pin to 1.0."""
+        if not cand or cand.get("platform") in (None, "cpu"):
+            return
+        if cand.get("extra_params"):
+            return
+        base_path = os.path.join(REPO, "BENCH_BASELINE.json")
+        try:
+            with open(base_path) as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            old = {}
+        better = (
+            old.get("platform") == "cpu" or not old
+            or (cand.get("config") == old.get("config")
+                and cand.get("batch_size") == old.get("batch_size")
+                # baseline identity includes the chip generation
+                and cand.get("device_kind") == old.get("device_kind")
+                and cand.get("value", 0) > old.get("value", 0))
+        )
+        if better:
+            with open(base_path, "w") as f:
+                json.dump(cand, f, indent=1)
+            print("[hw_session] BENCH_BASELINE.json updated%s"
+                  % (" (%s)" % note if note else ""))
+
+    def flagship_bench(tag, update_baseline):
+        """Run the flagship bench and return the parsed JSON line.
+
+        update_baseline=False for the prelim insurance pass: it must
+        not refresh BENCH_BASELINE.json, or the tuned step-3 run would
+        compute vs_baseline against this same session's prelim instead
+        of the prior round's committed number."""
+        bench = runner([sys.executable, "bench.py"], timeout=1800,
+                       env_extra={"EDL_BENCH_PROBE_TIMEOUT": "150"},
+                       tag=tag)
+        record(bench)
+        flag = last_json_line(bench["stdout"])
+        if flag and update_baseline:
+            maybe_update_baseline(flag)
+        return flag
+
+    # 1b. flagship insurance pass BEFORE the (up to 30 min) sweep: the
+    # tunnel's windows can be minutes long, and the round's headline
+    # number must not be hostage to the sweep finishing. Current tuned
+    # defaults are already in flash_tuning.json if a prior session swept.
+    prelim = None
+    if on_tpu and not args.skip_sweep:
+        # with --skip-sweep nothing changes between here and step 3, so
+        # the insurance pass would just duplicate the flagship run
+        prelim = flagship_bench("bench_flagship_prelim",
+                                update_baseline=False)
+        if prelim:
+            results["flagship_prelim"] = prelim
+            save(results, args.out)
+
     # 2. attention block sweep -> persist tuned default
     if not args.skip_sweep:
         sweep_cmd = [sys.executable, "scripts/bench_attention.py"]
@@ -173,36 +254,20 @@ def main():
             save(results, args.out)
 
     # 3. flagship bench (tuned defaults now in effect via tuning file)
-    bench = runner([sys.executable, "bench.py"], timeout=1800,
-                env_extra={"EDL_BENCH_PROBE_TIMEOUT": "150"},
-                tag="bench_flagship")
-    record(bench)
-    flag = last_json_line(bench["stdout"])
+    flag = flagship_bench("bench_flagship", update_baseline=True)
     if flag:
         results["flagship"] = flag
         save(results, args.out)
-        # refresh the committed baseline when strictly better on the
-        # same config+platform (driver compares future runs against it)
-        base_path = os.path.join(REPO, "BENCH_BASELINE.json")
-        try:
-            with open(base_path) as f:
-                old = json.load(f)
-        except (OSError, ValueError):
-            old = {}
-        better = (
-            flag.get("platform") not in (None, "cpu")
-            and (old.get("platform") == "cpu" or not old
-                 or (flag.get("config") == old.get("config")
-                     # baseline identity includes the chip generation
-                     # (bench.py's vs_baseline checks device_kind too)
-                     and flag.get("device_kind") == old.get(
-                         "device_kind")
-                     and flag.get("value", 0) > old.get("value", 0)))
-        )
-        if better:
-            with open(base_path, "w") as f:
-                json.dump(flag, f, indent=1)
-            print("[hw_session] BENCH_BASELINE.json updated")
+    # the sweep can regress (tuned blocks persist only when strictly
+    # better, but noise happens): if the prelim pass beat the tuned run,
+    # let it refresh the committed baseline instead. A CPU-fallback
+    # step-3 result (tunnel wedged mid-session) counts as "no tuned
+    # run" — its toy-config value must not gate the prelim TPU number.
+    flag_tpu = flag if flag and flag.get("platform") not in (
+        None, "cpu") else None
+    if prelim and (not flag_tpu or prelim.get("value", 0)
+                   > flag_tpu.get("value", 0)):
+        maybe_update_baseline(prelim, note="prelim")
 
     # 4./5. secondary BASELINE.md targets + decode throughput
     for model in ("resnet50", "deepfm", "decode", "dlrm", "bert"):
@@ -214,11 +279,14 @@ def main():
         parsed = last_json_line(step["stdout"])
         if parsed and parsed.get("platform") not in (None, "cpu"):
             results[model] = parsed
+            save(results, args.out)
+            if parsed.get("extra_params"):
+                # non-default knobs must not become a committed record
+                continue
             with open(os.path.join(
                     REPO, "BENCH_BASELINE_%s.json" % model.upper()),
                     "w") as f:
                 json.dump(parsed, f, indent=1)
-            save(results, args.out)
 
     # 6. step profile (attention share of step time)
     prof = runner([sys.executable, "scripts/profile_step.py"],
