@@ -49,6 +49,22 @@ def initialize_distributed(coordinator_addr=None, num_processes=None,
         "jax.distributed initialized: process %d/%d, %d global devices",
         process_id, num_processes, len(jax.devices()),
     )
+    # Create the global communicator clique NOW, while every host sits
+    # at the same program point. The first collective's address exchange
+    # has a hard 30 s deadline inside XLA's rendezvous (gloo on CPU
+    # rigs: GetKeyValue DEADLINE_EXCEEDED), and deferring it to the
+    # first train step puts a variable-length jit compile between init
+    # and rendezvous — under machine load that skew exceeds the
+    # deadline. Here the inter-host skew is process-start noise only.
+    # Failure is non-fatal: the training step's own failure handling
+    # (task re-queue + host-loss recovery) owns that path.
+    try:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("edl_spmd_init_warmup")
+        logger.info("communicator warm-up barrier passed")
+    except Exception as e:  # noqa: BLE001
+        logger.warning("communicator warm-up barrier failed: %s", e)
 
 
 class SPMDContext(object):
