@@ -261,13 +261,26 @@ def run_transformer_bench(on_tpu):
     from elasticdl_tpu.common.model_utils import format_params_str
 
     params, extra, batch_size = apply_extra_params(cfg, batch_size, on_tpu)
+    # packed=N (bench knob, not a model kwarg): train on rows carrying
+    # N packed segments each — measures the segment-mask cost of the
+    # sequence-packing path on the same shapes
+    packed = int(params.pop("packed", 0))
     model_params = format_params_str(params)
 
     rng = np.random.RandomState(0)
     tokens = rng.randint(
         0, cfg["vocab_size"], size=(batch_size, cfg["seq_len"] + 1)
     ).astype(np.int32)
-    batch = ({"tokens": tokens[:, :-1]}, tokens[:, 1:])
+    features = {"tokens": tokens[:, :-1]}
+    if packed:
+        seg = np.minimum(
+            np.arange(cfg["seq_len"]) * packed // cfg["seq_len"],
+            packed - 1,
+        )
+        features["segment_ids"] = np.broadcast_to(
+            seg.astype(np.int32), (batch_size, cfg["seq_len"])
+        ).copy()
+    batch = (features, tokens[:, 1:])
     step_time, n_chips, dev, platform, n_params = _run_zoo_bench(
         zoo, batch, iters, warmup, model_params=model_params
     )
@@ -448,8 +461,25 @@ def run_decode_bench(on_tpu):
     import jax
 
     # same A/B channel as the training bench (e.g. num_kv_heads for the
-    # GQA decode-cache comparison)
+    # GQA decode-cache comparison; prompt/new_tokens for the batched-
+    # prefill A/B — they are bench knobs, not model kwargs, so they are
+    # popped out of the model params but stay in the reported extras)
     params, extra, batch = apply_extra_params(cfg, batch, on_tpu)
+    prompt = int(params.pop("prompt", prompt))
+    new_tokens = int(params.pop("new_tokens", new_tokens))
+    if prompt + new_tokens > cfg["seq_len"]:
+        # scale to fit (the CPU fallback shrinks seq_len under the same
+        # knobs; the rc=0 contract forbids dying on that) — the emitted
+        # prompt_len/new_tokens fields report what actually ran
+        f = cfg["seq_len"] / (prompt + new_tokens)
+        prompt = max(1, int(prompt * f))
+        new_tokens = max(1, min(cfg["seq_len"] - prompt,
+                                int(new_tokens * f)))
+        sys.stderr.write(
+            "bench: prompt+new_tokens exceed seq_len %d; scaled to "
+            "prompt=%d new_tokens=%d\n"
+            % (cfg["seq_len"], prompt, new_tokens)
+        )
     spec = load_model_spec_from_module(zoo)
     mesh = mesh_lib.build_mesh()
     trainer = Trainer(spec, mesh=mesh,

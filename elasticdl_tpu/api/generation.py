@@ -154,16 +154,7 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
         )
 
     if use_cache:
-        import inspect
-
-        if "decode" not in inspect.signature(
-            type(model).__call__
-        ).parameters:
-            raise ValueError(
-                "model %r has no decode mode; use_cache=True needs the "
-                "KV-cache convention (transformer_lm family)"
-                % type(model).__name__
-            )
+        _require_kv_convention(model)
         return _kv_generate(
             trainer, state, prompt, p, total, temperature, seed,
             top_k, top_p,
@@ -208,45 +199,109 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
     return out[:, :total]
 
 
+def _prefill_bucket(p, seq_len):
+    """Static prefill slab: smallest 64-multiple covering the prompt
+    (clamped to the cache capacity). Positions in [p, p_pad) hold pad
+    junk in the cache; decode overwrites each before attending to it."""
+    return min(seq_len, -(-p // 64) * 64)
+
+
+def _kv_shapes_for(cache, model, b):
+    """Cache-buffer structure from an eval_shape'd decode init (no real
+    params are materialized); depends only on the batch size, so it is
+    cached separately from the compiled decodes."""
+    kv_shapes = cache.get(("kv_shapes", b))
+    if kv_shapes is None:
+        def init_shapes():
+            return model.init(
+                jax.random.PRNGKey(0),
+                {"tokens": jnp.zeros((b, 1), jnp.int32)},
+                training=False, decode=True,
+            )
+
+        kv_shapes = jax.eval_shape(init_shapes)["cache"]
+        cache[("kv_shapes", b)] = kv_shapes
+    return kv_shapes
+
+
+def _run_prefill(model, variables, kv_shapes, tokens2d, p_len, p_pad):
+    """Shared batched-prefill contract for the greedy-KV and beam-KV
+    paths: zero caches, ONE prefill=True forward over the static
+    [:, :p_pad] slab, return (filled cache tree, logits at p_len-1).
+    tokens2d: [b, L] int32."""
+    b = tokens2d.shape[0]
+    kv = jax.tree.map(
+        lambda sh: jnp.zeros(sh.shape, sh.dtype), kv_shapes
+    )
+    logits, upd = model.apply(
+        dict(variables, cache=kv),
+        {"tokens": tokens2d[:, :p_pad]},
+        training=False, prefill=True, prompt_len=p_len,
+        mutable=["cache"],
+    )
+    last = jax.lax.dynamic_slice(
+        logits, (0, p_len - 1, 0), (b, 1, logits.shape[-1])
+    )[:, 0]  # [b, V]
+    return upd["cache"], last
+
+
+def _require_kv_convention(model):
+    """use_cache=True needs BOTH decode mode and the batched-prefill
+    mode; a clear error beats a TypeError from inside tracing."""
+    import inspect
+
+    params = inspect.signature(type(model).__call__).parameters
+    missing = [k for k in ("decode", "prefill") if k not in params]
+    if missing:
+        raise ValueError(
+            "model %r lacks %s mode(s); use_cache=True needs the "
+            "KV-cache convention (decode + prefill kwargs — the "
+            "transformer_lm family)"
+            % (type(model).__name__, "/".join(missing))
+        )
+
+
 def _kv_generate(trainer, state, prompt, p, total, temperature, seed,
                  top_k=0, top_p=1.0):
-    """KV-cached decode: one single-token model step per position.
+    """KV-cached decode: batched prefill, then one single-token model
+    step per generated position.
 
-    The first p-1 steps are the prefill (the known prompt token is kept,
-    the model step only populates the per-layer caches); from there each
-    step's logits pick the next token. One lax.scan, compiled once per
-    (batch, total, sampling mode).
+    The prompt is prefilled in ONE causal forward (the model's
+    prefill=True mode writes every layer's k/v for positions [0, p) in
+    a single MXU-friendly pass — the flash kernel runs over the whole
+    prompt instead of p-1 tiny single-token steps), then a fori_loop
+    with dynamic start runs the per-token decode. The prefill length is
+    padded to a 64 bucket so one executable serves nearby prompt
+    lengths; compiled once per (batch, total, bucket, sampling mode).
     """
     model = trainer.model
     b = prompt.shape[0]
     seq_len = model.seq_len
+    p_pad = _prefill_bucket(p, seq_len)
 
     cache = _decode_cache(trainer)
-    key = ("kv", b, total, float(temperature), int(top_k),
+    key = ("kv", b, total, p_pad, float(temperature), int(top_k),
            float(top_p))
     fn = cache.get(key)
     if fn is None:
-        # cache buffers: structure from an eval_shape'd decode init (no
-        # real params are materialized); depends only on the batch size,
-        # so it is cached separately from the compiled decodes
-        kv_shapes = cache.get(("kv_shapes", b))
-        if kv_shapes is None:
-            def init_shapes():
-                return model.init(
-                    jax.random.PRNGKey(0),
-                    {"tokens": jnp.zeros((b, 1), jnp.int32)},
-                    training=False, decode=True,
-                )
-
-            kv_shapes = jax.eval_shape(init_shapes)["cache"]
-            cache[("kv_shapes", b)] = kv_shapes
+        kv_shapes = _kv_shapes_for(cache, model, b)
 
         def run(variables, tokens, rng, p_len):
-            kv = jax.tree.map(
-                lambda sh: jnp.zeros(sh.shape, sh.dtype), kv_shapes
+            # ---- batched prefill: fill caches for [0, p), take the
+            # logits at p-1, write the first generated token at p
+            kv, last = _run_prefill(
+                model, variables, kv_shapes, tokens, p_len, p_pad
+            )
+            nxt = _next_token(last, rng, p_len, temperature,
+                              top_k, top_p)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt.astype(jnp.int32)[:, None], (0, p_len)
             )
 
-            def step(carry, i):
+            # ---- per-token decode, dynamic start at p (the prefill
+            # already produced the token at p): iteration i consumes
+            # the token at position i and writes position i+1
+            def body(i, carry):
                 tokens, kv = carry
                 tok = jax.lax.dynamic_slice(tokens, (0, i), (b, 1))
                 logits, upd = model.apply(
@@ -254,22 +309,15 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed,
                     {"tokens": tok},
                     training=False, decode=True, mutable=["cache"],
                 )
-                step_logits = logits[:, 0]  # [b, V]
-                # iteration i writes position i+1
-                nxt = _next_token(step_logits, rng, i + 1, temperature,
+                nxt = _next_token(logits[:, 0], rng, i + 1, temperature,
                                   top_k, top_p)
-                # keep the known prompt token during prefill
-                prev = jax.lax.dynamic_slice(
-                    tokens, (0, i + 1), (b, 1)
-                )[:, 0]
-                val = jnp.where(i + 1 < p_len, prev, nxt)
                 tokens = jax.lax.dynamic_update_slice(
-                    tokens, val.astype(jnp.int32)[:, None], (0, i + 1)
+                    tokens, nxt.astype(jnp.int32)[:, None], (0, i + 1)
                 )
-                return (tokens, upd["cache"]), None
+                return (tokens, upd["cache"])
 
-            (tokens, _), _ = jax.lax.scan(
-                step, (tokens, kv), jnp.arange(total - 1)
+            tokens, _ = jax.lax.fori_loop(
+                p_len, total - 1, body, (tokens, kv)
             )
             return tokens
 
@@ -288,16 +336,24 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed,
 
 
 def beam_search_generate(trainer, state, prompt, max_new_tokens,
-                         num_beams=4):
-    """Beam-search decoding (full-forward strategy): keeps the
-    `num_beams` highest-log-probability continuations per batch row and
-    returns the best one. Deterministic; beams ride as extra batch rows
-    so the compiled model is the same one the greedy path uses.
+                         num_beams=4, use_cache=False):
+    """Beam-search decoding: keeps the `num_beams` highest-log-
+    probability continuations per batch row and returns the best one.
+    Deterministic; beams ride as extra batch rows so the compiled model
+    is the same one the greedy path uses.
 
     Initial beam scores are [0, -inf, ...], which both deduplicates the
     first expansion (all beams start as copies of the prompt) and keeps
     every tensor static-shape. Returns int32 [b, p + max_new_tokens].
-    """
+
+    use_cache=True: KV-cached strategy — one batched prompt prefill
+    (beams share it: the caches are prefilled for b rows and tiled to
+    b*num_beams), then single-token decode steps; beam reordering
+    gathers the per-layer cache rows along the batch axis each step.
+    O(L) attention per token instead of a full forward per step."""
+    if use_cache:
+        return _beam_kv_generate(trainer, state, prompt, max_new_tokens,
+                                 num_beams)
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p = prompt.shape
     model = trainer.model
@@ -378,4 +434,138 @@ def beam_search_generate(trainer, state, prompt, max_new_tokens,
             variables, buf,
             jnp.asarray(p, jnp.int32), jnp.asarray(total, jnp.int32),
         )
+    return out[:, :total]
+
+
+def _beam_kv_generate(trainer, state, prompt, max_new_tokens, num_beams):
+    """KV-cached beam search (beam_search_generate use_cache=True).
+
+    Same selection math as the full-forward strategy — the [0, -inf]
+    initial scores and top-k over (beam, vocab) — so the two strategies
+    return identical tokens; only the attention cost differs. The
+    prompt is prefilled ONCE for the b true rows (model prefill mode,
+    see _kv_generate), the caches are row-tiled to b*num_beams, and
+    each step gathers the cache rows of the surviving beams.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    model = trainer.model
+    seq_len = getattr(model, "seq_len", None)
+    if seq_len is None or not getattr(model, "causal", True):
+        raise ValueError(
+            "beam search needs a causal sequence-family model"
+        )
+    _require_kv_convention(model)
+    total = p + int(max_new_tokens)
+    if max_new_tokens < 1 or p < 1 or total > seq_len:
+        raise ValueError(
+            "need prompt length >= 1 and max_new_tokens >= 1 with "
+            "prompt %d + new %d <= the model's seq_len %d"
+            % (p, max_new_tokens, seq_len)
+        )
+    k = int(num_beams)
+    vocab = getattr(model, "vocab_size", None)
+    if k < 1 or (vocab is not None and k > vocab):
+        raise ValueError(
+            "num_beams must be in [1, vocab_size], got %d" % k
+        )
+    bk = b * k
+    p_pad = _prefill_bucket(p, seq_len)
+
+    cache = _decode_cache(trainer)
+    key = ("beam_kv", b, k, total, p_pad)
+    fn = cache.get(key)
+    if fn is None:
+        kv_shapes = _kv_shapes_for(cache, model, b)
+
+        def run(variables, tokens, p_len):
+            # tokens [b, k, L]; shared prefill on the b true rows
+            kv, last = _run_prefill(
+                model, variables, kv_shapes, tokens[:, 0], p_len, p_pad
+            )
+            # beams share the prompt: tile each cache row k times
+            kv = jax.tree.map(
+                lambda a: (
+                    jnp.repeat(a, k, axis=0)
+                    if a.ndim and a.shape[0] == b else a
+                ),
+                kv,
+            )
+            neg = jnp.asarray(-jnp.inf, jnp.float32)
+            scores = jnp.where(
+                jnp.arange(k)[None, :] == 0, 0.0, neg
+            ) * jnp.ones((b, 1), jnp.float32)
+
+            def expand(i, tokens, scores, kv, step_logits):
+                """One beam expansion writing position i: the shared
+                top-k over (beam, vocab) + beam gathers."""
+                step = jax.nn.log_softmax(
+                    step_logits.reshape(b, k, -1).astype(jnp.float32),
+                    axis=-1,
+                )  # [b, k, V]
+                cand = scores[:, :, None] + step
+                v = cand.shape[-1]
+                vals, idx = jax.lax.top_k(cand.reshape(b, k * v), k)
+                beam_src = idx // v  # [b, k]
+                tok = (idx % v).astype(jnp.int32)
+                tokens = jnp.take_along_axis(
+                    tokens, beam_src[:, :, None], axis=1
+                )
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, tok[..., None], (0, 0, i)
+                )
+                flat_src = (
+                    jnp.arange(b)[:, None] * k + beam_src
+                ).reshape(bk)
+                kv = jax.tree.map(
+                    lambda a: (
+                        jnp.take(a, flat_src, axis=0)
+                        if a.ndim and a.shape[0] == bk else a
+                    ),
+                    kv,
+                )
+                return tokens, vals, kv
+
+            # first expansion (position p) from the prefill logits —
+            # the [0, -inf] scores make the beam gather a no-op on the
+            # identical tiled caches
+            first = jnp.broadcast_to(
+                last[:, None, :], (b, k, last.shape[-1])
+            ).reshape(bk, -1)
+            tokens, scores, kv = expand(p_len, tokens, scores, kv,
+                                        first)
+
+            def body(i, carry):
+                tokens, scores, kv = carry
+                tok = jax.lax.dynamic_slice(
+                    tokens.reshape(bk, -1), (0, i - 1), (bk, 1)
+                )
+                logits, upd = model.apply(
+                    dict(variables, cache=kv),
+                    {"tokens": tok},
+                    training=False, decode=True, mutable=["cache"],
+                )
+                tokens, scores, kv = expand(
+                    i, tokens, scores, upd["cache"], logits[:, 0]
+                )
+                return tokens, scores, kv
+
+            tokens, scores, _ = jax.lax.fori_loop(
+                p_len + 1, total, body, (tokens, scores, kv)
+            )
+            best = jnp.argmax(scores, axis=-1)  # [b]
+            return jnp.take_along_axis(
+                tokens, best[:, None, None], axis=1
+            )[:, 0]
+
+        fn = jax.jit(run)
+        cache[key] = fn
+
+    variables = {"params": state.params, **state.model_state}
+    buf = jnp.zeros((b, k, seq_len), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(
+        buf, jnp.broadcast_to(prompt[:, None, :], (b, k, p)), (0, 0, 0)
+    )
+    with trainer.mesh:
+        out = fn(variables, buf, jnp.asarray(p, jnp.int32))
     return out[:, :total]

@@ -1,0 +1,105 @@
+"""Sequence packing for LM training: fill fixed-length rows with
+multiple variable-length token sequences instead of padding each to the
+model length.
+
+TPU-first rationale: XLA wants static [batch, seq_len] shapes, so short
+documents either waste FLOPs as padding or waste data as truncation.
+Packing keeps the MXU busy on real tokens; correctness comes from the
+model side (model_zoo/transformer_lm accepts ``segment_ids``: attention
+is confined to each packed run by the flash kernels' segment masks and
+positions restart per run — ops/attention.py), and from the label side
+here (cross-segment next-token targets are masked with ``IGNORE_LABEL``
+so a document never predicts the first token of the next one).
+
+The reference has no packing story (its feature columns pad —
+/root/reference/elasticdl_preprocessing/layers/to_sparse.py handles
+ragged inputs by sparsifying instead); this is net-new surface.
+"""
+
+import numpy as np
+
+# target value the LM loss ignores (model_zoo/transformer_lm.loss
+# averages over labels >= 0 only)
+IGNORE_LABEL = -100
+
+
+def pack_sequences(sequences, row_len, pad_id=0):
+    """Greedy first-fit-decreasing packing.
+
+    sequences: iterable of 1-D int arrays/lists (token ids, each len
+    >= 2 — a sequence contributes len-1 next-token targets).
+    row_len: packed row length (the model seq_len).
+
+    Returns (tokens, segment_ids, labels), each [n_rows, row_len] int32:
+      * tokens      — packed ids, pad_id in the tail slack
+      * segment_ids — 0..k per row, one id per packed sequence; the pad
+                      tail gets its own fresh id (it attends only to
+                      itself and its labels are ignored)
+      * labels      — tokens shifted left WITHIN each segment; the last
+                      position of every segment and all pad positions
+                      are IGNORE_LABEL.
+
+    Sequences longer than row_len are split into row_len-sized chunks
+    (the standard LM blocking); a trailing chunk of length < 2 is
+    dropped (it would carry no target).
+    """
+    chunks = []
+    for seq in sequences:
+        seq = np.asarray(seq, np.int32).reshape(-1)
+        for start in range(0, len(seq), row_len):
+            chunk = seq[start:start + row_len]
+            if len(chunk) >= 2:
+                chunks.append(chunk)
+    if not chunks:
+        raise ValueError("no packable sequences (all shorter than 2)")
+    # first-fit-decreasing: longest chunks first, into the first row
+    # with enough slack
+    chunks.sort(key=len, reverse=True)
+    rows = []  # list of lists of chunks
+    slack = []
+    for chunk in chunks:
+        for i, s in enumerate(slack):
+            if len(chunk) <= s:
+                rows[i].append(chunk)
+                slack[i] -= len(chunk)
+                break
+        else:
+            rows.append([chunk])
+            slack.append(row_len - len(chunk))
+
+    n = len(rows)
+    tokens = np.full((n, row_len), pad_id, np.int32)
+    segment_ids = np.zeros((n, row_len), np.int32)
+    labels = np.full((n, row_len), IGNORE_LABEL, np.int32)
+    for r, row_chunks in enumerate(rows):
+        at = 0
+        for sid, chunk in enumerate(row_chunks):
+            m = len(chunk)
+            tokens[r, at:at + m] = chunk
+            segment_ids[r, at:at + m] = sid
+            # next-token targets within the segment; the segment's last
+            # position has no in-segment successor
+            labels[r, at:at + m - 1] = chunk[1:]
+            at += m
+        if at < row_len:
+            # pad tail: its own segment id, labels stay ignored
+            segment_ids[r, at:] = len(row_chunks)
+    return tokens, segment_ids, labels
+
+
+def packing_efficiency(sequences, row_len):
+    """Real-token fraction of the packed layout — the measure of what
+    packing buys on a given corpus (1.0 = rows fully filled with real
+    tokens). A segment of m tokens carries m-1 targets, so real tokens
+    per segment = its non-ignored labels + 1; pad segments carry no
+    targets and count 0."""
+    tokens, segment_ids, labels = pack_sequences(sequences, row_len)
+    real = 0
+    for r in range(tokens.shape[0]):
+        for sid in np.unique(segment_ids[r]):
+            targets = int(
+                (labels[r][segment_ids[r] == sid] != IGNORE_LABEL).sum()
+            )
+            if targets:
+                real += targets + 1
+    return real / tokens.size

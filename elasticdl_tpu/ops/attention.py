@@ -18,6 +18,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -136,14 +137,40 @@ def expand_kv(kv, num_heads):
     return jnp.repeat(kv, num_heads // hkv, axis=1)
 
 
-def naive_attention(q, k, v, causal=False, scale=None, window=None):
+def _check_segments(segments, b, lq, lk):
+    """Segment-id (sequence-packing) masking is defined for square
+    self-attention: q and k share one [b, l] id array; tokens attend
+    within their own segment only. Every position sees itself, so no
+    row is ever fully masked."""
+    if segments is None:
+        return None
+    segments = jnp.asarray(segments, jnp.int32)
+    if lq != lk:
+        raise ValueError(
+            "segment masking requires square self-attention (lq == "
+            "lk), got lq=%d lk=%d" % (lq, lk)
+        )
+    if segments.shape != (b, lq):
+        raise ValueError(
+            "segments must be [batch, seq] = (%d, %d), got %r"
+            % (b, lq, tuple(segments.shape))
+        )
+    return segments
+
+
+def naive_attention(q, k, v, causal=False, scale=None, window=None,
+                    segments=None):
     """Reference softmax(q k^T) v; O(L^2) memory. The test oracle (the
     flash backward is the Pallas two-pass _flash_backward below).
     `window` (sliding-window/local attention): query at position p sees
     keys in (p - window, p] under causal, |p - k| < window otherwise —
-    None means unbounded. k/v may carry fewer heads than q (GQA)."""
+    None means unbounded. k/v may carry fewer heads than q (GQA).
+    `segments` [b, l] int: sequence-packing mask — attention stays
+    within same-id runs (cross-segment scores are masked out)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     _check_window(window, q.shape[2], k.shape[2])
+    segments = _check_segments(segments, q.shape[0], q.shape[2],
+                               k.shape[2])
     k = expand_kv(k, q.shape[1])
     v = expand_kv(v, q.shape[1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -157,30 +184,40 @@ def naive_attention(q, k, v, causal=False, scale=None, window=None):
         mask &= q_pos - k_pos < window
         if not causal:
             mask &= k_pos - q_pos < window
-    scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    keep = jnp.broadcast_to(mask[None, None], scores.shape)
+    if segments is not None:
+        seg_mask = segments[:, :, None] == segments[:, None, :]
+        keep = keep & seg_mask[:, None]
+    scores = jnp.where(keep, scores, _NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
 def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
-                        window=None, with_lse=False):
+                        window=None, with_lse=False, segments=None):
     """Online-softmax attention via lax.scan over key blocks: O(L) memory,
     differentiable, pure jnp (the fallback when the flash kernel can't
     run). Matches naive_attention to float tolerance. With
     `with_lse=True` also returns the float32 logsumexp [b, h, lq] (the
-    ring-attention partial form; see attention_forward_lse)."""
+    ring-attention partial form; see attention_forward_lse).
+    `segments` [b, l] int: sequence-packing mask (see naive_attention)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     b, h, lq, d = q.shape
     lk = k.shape[2]
     _check_window(window, lq, lk)
+    segments = _check_segments(segments, b, lq, lk)
     k = expand_kv(k, h)
     v = expand_kv(v, h)
     block = min(block_size, lk)
+    seg_k = segments
     if lk % block:
         # pad keys; padded positions masked below via k_pos >= lk
         pad = block - lk % block
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        if seg_k is not None:
+            seg_k = jnp.pad(seg_k, ((0, 0), (0, pad)),
+                            constant_values=-1)
     n_blocks = k.shape[2] // block
     k_blocks = k.reshape(b, h, n_blocks, block, d)
     v_blocks = v.reshape(b, h, n_blocks, block, d)
@@ -189,7 +226,7 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
 
     def step(carry, inputs):
         o, l, m = carry
-        kb, vb, kb_idx = inputs
+        kb, vb, kb_idx = inputs[:3]
         s = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, kb)
         k_pos = kb_idx * block + jnp.arange(block)
         valid = jnp.broadcast_to((k_pos < lk)[None, :], (lq, block))
@@ -199,21 +236,28 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
             valid = valid & (q_pos[:, None] - k_pos[None, :] < window)
             if not causal:
                 valid = valid & (k_pos[None, :] - q_pos[:, None] < window)
-        s = jnp.where(valid[None, None], s, _NEG_INF)
+        keep = jnp.broadcast_to(valid[None, None], s.shape)
+        if segments is not None:
+            seg_kb = inputs[3]  # [b, block]
+            keep = keep & (
+                segments[:, :, None] == seg_kb[:, None, :]
+            )[:, None]
+        s = jnp.where(keep, s, _NEG_INF)
         return softmax_merge(o, l, m, s, vb), None
 
+    xs = [
+        jnp.moveaxis(k_blocks, 2, 0),
+        jnp.moveaxis(v_blocks, 2, 0),
+        jnp.arange(n_blocks),
+    ]
+    if segments is not None:
+        xs.append(
+            jnp.moveaxis(seg_k.reshape(b, n_blocks, block), 1, 0)
+        )
     o0 = jnp.zeros_like(q)
     l0 = jnp.zeros((b, h, lq), q.dtype)
     m0 = jnp.full((b, h, lq), _NEG_INF, q.dtype)
-    (o, l, m), _ = jax.lax.scan(
-        step,
-        (o0, l0, m0),
-        (
-            jnp.moveaxis(k_blocks, 2, 0),
-            jnp.moveaxis(v_blocks, 2, 0),
-            jnp.arange(n_blocks),
-        ),
-    )
+    (o, l, m), _ = jax.lax.scan(step, (o0, l0, m0), tuple(xs))
     out = softmax_finalize(o, l)
     if with_lse:
         lse = (m + jnp.log(jnp.maximum(l, 1e-30))).astype(jnp.float32)
@@ -238,22 +282,53 @@ def _check_window(window, lq, lk):
         )
 
 
+def packed_positions(segments):
+    """Per-token positions that RESTART at each segment boundary.
+
+    segments: [..., l] int ids forming contiguous same-id runs (the
+    sequence-packing layout). Returns int32 of the same shape: the
+    token's offset within its own segment — what RoPE / learned
+    position tables should see for packed rows."""
+    segments = jnp.asarray(segments)
+    l = segments.shape[-1]
+    idx = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32),
+                           segments.shape)
+    is_start = jnp.concatenate(
+        [
+            jnp.ones_like(segments[..., :1], bool),
+            segments[..., 1:] != segments[..., :-1],
+        ],
+        axis=-1,
+    )
+    starts = jax.lax.cummax(
+        jnp.where(is_start, idx, 0), axis=segments.ndim - 1
+    )
+    return idx - starts
+
+
 def apply_rope(x, positions, theta=10000.0):
     """Rotary position embedding (RoPE) over the head dimension.
 
-    x: [b, h, l, d]; positions: [l] int/float absolute positions.
-    Rotates feature pairs (i, i+d/2) by positions * theta^(-2i/d), so
-    q·k after rotation depends only on RELATIVE distance — the property
-    that lets ring/Ulysses sequence shards use their global positions
-    with no learned table. Math in fp32, result in x.dtype. An odd tail
-    feature (d % 2) passes through unrotated.
+    x: [b, h, l, d]; positions: [l] (shared across the batch) or
+    [b, l] (per-row, the packed-sequence case) int/float absolute
+    positions. Rotates feature pairs (i, i+d/2) by
+    positions * theta^(-2i/d), so q·k after rotation depends only on
+    RELATIVE distance — the property that lets ring/Ulysses sequence
+    shards use their global positions with no learned table. Math in
+    fp32, result in x.dtype. An odd tail feature (d % 2) passes
+    through unrotated.
     """
     d = x.shape[-1]
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, None]  # [1, 1, l, half]
-    sin = jnp.sin(angles)[None, None]
+    positions = jnp.asarray(positions)
+    angles = positions.astype(jnp.float32)[..., :, None] * freqs
+    if positions.ndim == 1:
+        cos = jnp.cos(angles)[None, None]  # [1, 1, l, half]
+        sin = jnp.sin(angles)[None, None]
+    else:  # [b, l] -> [b, 1, l, half]
+        cos = jnp.cos(angles)[:, None]
+        sin = jnp.sin(angles)[:, None]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:2 * half]
     rot = jnp.concatenate(
@@ -311,9 +386,12 @@ def _block_mask(s, qi, ki, block_q, block_k, causal, window):
     return jnp.where(keep, s, _NEG_INF)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                  acc_scr, *, scale, causal, window, block_q, block_k,
-                  n_k):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
+                  block_q, block_k, n_k, has_segs=False):
+    if has_segs:
+        qseg_ref, kseg_ref = rest[:2]
+        rest = rest[2:]
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -334,6 +412,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         s = _block_mask(s, qi, ki, block_q, block_k, causal, window)
+        if has_segs:
+            # sequence packing: mask cross-segment pairs.
+            # qseg (block_q, 1) == kseg (1, block_k) broadcasts to s
+            s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -420,8 +502,33 @@ def _mosaic_params():
     )
 
 
+def _seg_specs(block_q, block_k, heads, dkv=False, n_q=1):
+    """BlockSpec pair for the segment-id inputs: q-side ids ride as
+    [b, lq, 1] column tiles, k-side as [b, 1, lk] row tiles so the
+    in-kernel equality broadcasts to (block_q, block_k) without any
+    reshape. `heads` is the grid-dim-0 head count (h, or hkv for the
+    dk/dv kernel whose streamed dim enumerates (group, q_block))."""
+    if not dkv:
+        return (
+            pl.BlockSpec((1, block_q, 1),
+                         lambda i, j, t: (i // heads, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda i, j, t: (i // heads, 0, t),
+                         memory_space=pltpu.VMEM),
+        )
+    return (
+        pl.BlockSpec((1, block_q, 1),
+                     lambda i, j, t: (i // heads, t % n_q, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_k),
+                     lambda i, j, t: (i // heads, 0, j),
+                     memory_space=pltpu.VMEM),
+    )
+
+
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
-                   window=None, with_residuals=False):
+                   window=None, with_residuals=False, segments=None):
     b, h, lq, d = q.shape
     hkv = k.shape[1]
     lk = k.shape[2]
@@ -439,14 +546,23 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         block_q=block_q,
         block_k=block_k,
         n_k=n_k,
+        has_segs=segments is not None,
     )
+    in_specs = [
+        _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
+        _kv_inner_spec(block_k, d, h, hkv),
+    ]
+    inputs = [q3, k3, v3]
+    if segments is not None:
+        in_specs += list(_seg_specs(block_q, block_k, h))
+        inputs += [
+            segments.reshape(b, lq, 1),
+            segments.reshape(b, 1, lk),
+        ]
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
-        in_specs=[
-            _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
-            _kv_inner_spec(block_k, d, h, hkv),
-        ],
+        in_specs=in_specs,
         out_specs=(
             _outer_spec(block_q, d),
             # lse rides as [bh, lq, 1] so stores stay (block_q, 1)
@@ -464,7 +580,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         ],
         compiler_params=_mosaic_params(),
         interpret=interpret_mode() if interpret is None else interpret,
-    )(q3, k3, v3)
+    )(*inputs)
     out = out.reshape(b, h, lq, d)
     if with_residuals:
         return out, lse.reshape(b, h, lq, 1)
@@ -472,8 +588,12 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, scale, causal, window,
-                         block_q, block_k, n_k):
+                         *rest, scale, causal, window,
+                         block_q, block_k, n_k, has_segs=False):
+    if has_segs:
+        qseg_ref, kseg_ref = rest[:2]
+        rest = rest[2:]
+    dq_ref, dq_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -490,6 +610,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         s = _block_mask(s, qi, ki, block_q, block_k, causal, window)
+        if has_segs:
+            s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], dimension_numbers=_dims(1, 1),
@@ -507,9 +629,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                          scale, causal, window, block_q, block_k, n_q,
-                          n_q_total):
+                          delta_ref, *rest, scale, causal, window,
+                          block_q, block_k, n_q, n_q_total,
+                          has_segs=False):
+    if has_segs:
+        qseg_ref, kseg_ref = rest[:2]
+        rest = rest[2:]
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)  # key block is the outer (parallel) dim here
     qi = pl.program_id(2)
     # under GQA the streamed dim enumerates (q_head_in_group, q_block)
@@ -531,6 +657,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         s = _block_mask(s, qb, ki, block_q, block_k, causal, window)
+        if has_segs:
+            s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
         # dV_j += P^T dO ; dP = dO V^T ; dS = P*(dP - D) ; dK_j += dS^T Q
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
@@ -554,7 +682,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                    block_k, interpret, window=None, grad_dtype=None):
+                    block_k, interpret, window=None, grad_dtype=None,
+                    segments=None):
     """Two-pass flash backward: a dq kernel parallel over query blocks
     and a dk/dv kernel parallel over key blocks, both recomputing P from
     the saved logsumexp (the standard flash-attention backward; one
@@ -590,41 +719,58 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     lse3 = lse.reshape(bh, lq, 1)
     delta3 = delta.reshape(bh, lq, 1)
 
+    seg_inputs = []
+    if segments is not None:
+        seg_inputs = [
+            segments.reshape(b, lq, 1),
+            segments.reshape(b, 1, lk),
+        ]
+
     col_q = _outer_spec(block_q, 1)
+    dq_in_specs = [
+        _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
+        _kv_inner_spec(block_k, d, h, hkv), _outer_spec(block_q, d),
+        col_q, col_q,
+    ]
+    if segments is not None:
+        dq_in_specs += list(_seg_specs(block_q, block_k, h))
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal,
             window=window, block_q=block_q, block_k=block_k, n_k=n_k,
+            has_segs=segments is not None,
         ),
         grid=(bh, n_q, n_k),
-        in_specs=[
-            _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
-            _kv_inner_spec(block_k, d, h, hkv), _outer_spec(block_q, d),
-            col_q, col_q,
-        ],
+        in_specs=dq_in_specs,
         out_specs=_outer_spec(block_q, d),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), dq_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_mosaic_params(),
         interpret=interp,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(q3, k3, v3, do3, lse3, delta3, *seg_inputs)
 
     # key-block-parallel pass: q-side inputs stream over the inner dim
     # (all (group, q_block) pairs under GQA)
     q_spec = _dkv_q_spec(block_q, d, h, hkv, n_q)
     col_q_t = _dkv_q_spec(block_q, 1, h, hkv, n_q)
+    dkv_in_specs = [
+        q_spec, _outer_spec(block_k, d),
+        _outer_spec(block_k, d), q_spec,
+        col_q_t, col_q_t,
+    ]
+    if segments is not None:
+        dkv_in_specs += list(
+            _seg_specs(block_q, block_k, hkv, dkv=True, n_q=n_q)
+        )
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
             window=window, block_q=block_q, block_k=block_k, n_q=n_q,
             n_q_total=group * n_q,
+            has_segs=segments is not None,
         ),
         grid=(b * hkv, n_k, group * n_q),
-        in_specs=[
-            q_spec, _outer_spec(block_k, d),
-            _outer_spec(block_k, d), q_spec,
-            col_q_t, col_q_t,
-        ],
+        in_specs=dkv_in_specs,
         out_specs=(_outer_spec(block_k, d), _outer_spec(block_k, d)),
         out_shape=(
             jax.ShapeDtypeStruct((b * hkv, lk, d), dk_dtype),
@@ -636,7 +782,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
         ],
         compiler_params=_mosaic_params(),
         interpret=interp,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(q3, k3, v3, do3, lse3, delta3, *seg_inputs)
     return (
         dq.reshape(b, h, lq, d),
         dk.reshape(b, hkv, lk, d),
@@ -644,32 +790,41 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, segments, causal, scale, block_q, block_k,
+           interpret, window):
     return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret, window=window)
+                          interpret, window=window, segments=segments)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               window):
+def _flash_fwd(q, k, v, segments, causal, scale, block_q, block_k,
+               interpret, window):
     out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
                               interpret, window=window,
-                              with_residuals=True)
-    return out, (q, k, v, out, lse)
+                              with_residuals=True, segments=segments)
+    return out, (q, k, v, segments, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
                g):
-    q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                           block_k, interpret, window=window)
+    q, k, v, segments, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, scale,
+                                 block_q, block_k, interpret,
+                                 window=window, segments=segments)
+    # integer segment ids have a float0 (empty) cotangent
+    dseg = (
+        None if segments is None
+        else np.zeros(segments.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
-                    block_k=None, interpret=None, window=None):
+                    block_k=None, interpret=None, window=None,
+                    segments=None):
     """Tiled online-softmax attention (Pallas). head_dim is zero-padded
     to the 128-lane width (zeros don't change q·k or add output columns
     that survive the final slice); falls back to blockwise_attention when
@@ -678,13 +833,16 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     block-skip predicate prunes out-of-window key blocks, so compute
     scales with window, not sequence. k/v may carry fewer heads than q
     (GQA/MQA): the kernels index kv blocks through the head-group map
-    natively, no repeat is materialized."""
+    natively, no repeat is materialized. `segments` [b, l] int: sequence
+    packing — attention confined to same-id runs in forward AND backward
+    (the id tiles ride into the kernels as column/row blocks)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
     group_size(q, k)  # validate GQA divisibility before kernel dispatch
     block_q = min(resolve_block(block_q, "q"), lq)
     block_k = min(resolve_block(block_k, "k"), lk)
     _check_window(window, lq, lk)
+    segments = _check_segments(segments, q.shape[0], lq, lk)
     tiles = _flash_tiles(lq, lk, block_q, block_k)
     if not (use_pallas() and tiles):
         if use_pallas():
@@ -694,10 +852,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                 lq, lk, block_q, block_k,
             )
         return blockwise_attention(q, k, v, causal=causal, scale=scale,
-                                   window=window)
+                                   window=window, segments=segments)
     q, k, v = _pad_lanes([q, k, v], d)
-    out = _flash(q, k, v, causal, scale, block_q, block_k, interpret,
-                 window)
+    out = _flash(q, k, v, segments, causal, scale, block_q, block_k,
+                 interpret, window)
     return out[..., :d]
 
 

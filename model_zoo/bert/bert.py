@@ -28,6 +28,7 @@ from flax import linen as nn
 
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.ops.attention import packed_positions
 from model_zoo.transformer_lm.transformer_lm import (
     Block,
     _tp_dense_init,
@@ -56,13 +57,23 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, features, training=False):
         tokens = features["tokens"]
+        # sequence packing (same contract as transformer_lm): attention
+        # confined to same-id runs, learned positions restart per run
+        segments = features.get("segment_ids")
+        positions = None
+        if segments is not None:
+            segments = jnp.asarray(segments, jnp.int32)
+            positions = packed_positions(segments)
         x = nn.Embed(
             self.vocab_size + 1, self.embed_dim, dtype=self.dtype,
             name="wte",
         )(tokens)
         pos = nn.Embed(
             self.seq_len, self.embed_dim, dtype=self.dtype, name="wpe"
-        )(jnp.arange(tokens.shape[1])[None, :])
+        )(
+            positions if positions is not None
+            else jnp.arange(tokens.shape[1])[None, :]
+        )
         x = x + pos
         head_dim = self.embed_dim // self.num_heads
         for i in range(self.num_layers):
@@ -70,7 +81,7 @@ class BertEncoder(nn.Module):
                 self.num_heads, head_dim, dtype=self.dtype,
                 attn_impl=self.attn_impl, tp_shard=self.tp_shard,
                 causal=False, name="layer_%d" % i,
-            )(x, training)
+            )(x, training, segments=segments, positions=positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         # MLM head: transform + vocab projection (BERT's cls/predictions)
         x = nn.gelu(

@@ -27,6 +27,7 @@ from elasticdl_tpu.ops.attention import (
     expand_kv,
     flash_attention,
     jax_flash_attention,
+    packed_positions,
 )
 from elasticdl_tpu.ops.losses import chunked_softmax_xent
 from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -73,7 +74,8 @@ class CausalSelfAttention(nn.Module):
     num_kv_heads: int = 0
 
     @nn.compact
-    def __call__(self, x, training=False, decode=False, decode_pos=None):
+    def __call__(self, x, training=False, decode=False, decode_pos=None,
+                 prefill=False, segments=None, positions=None):
         b, l, e = x.shape
         h, d = self.num_heads, self.head_dim
         hkv = self.num_kv_heads or h
@@ -102,9 +104,46 @@ class CausalSelfAttention(nn.Module):
         if decode:
             return self._decode_step(q, k, v, e, decode_pos)
         if self.use_rope:
-            pos = jnp.arange(l)
+            pos = jnp.arange(l) if positions is None else positions
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
+        if prefill:
+            # Batched prompt prefill: one causal forward populates the
+            # decode KV cache for positions [0, l) — O(prompt) single-
+            # token steps collapse into one MXU-friendly pass. Cache
+            # layout/dtype matches _decode_step exactly (grouped hkv
+            # heads, k already RoPE-rotated at its absolute position).
+            # Positions >= the true prompt length hold pad-token junk;
+            # that is safe because decode masks k_pos <= counter and
+            # overwrites each position before first attending to it.
+            if not self.causal:
+                raise ValueError("prefill requires a causal model")
+            _mesh = mesh_lib.current_mesh()
+            if _mesh is not None and _mesh.shape.get(MeshAxis.SP, 1) > 1:
+                raise NotImplementedError(
+                    "prefill is single-shard (like decode); drop the "
+                    "sp axis for generation"
+                )
+            if self.cache_len < l:
+                raise ValueError(
+                    "prefill length %d exceeds cache_len %d"
+                    % (l, self.cache_len)
+                )
+            dtype = q.dtype
+            ck = self.variable(
+                "cache", "k", jnp.zeros, (b, hkv, self.cache_len, d),
+                dtype,
+            )
+            cv = self.variable(
+                "cache", "v", jnp.zeros, (b, hkv, self.cache_len, d),
+                dtype,
+            )
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(dtype), (0, 0, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(dtype), (0, 0, 0, 0)
+            )
         if self.attn_impl not in ("auto", "xla", "jax_flash"):
             raise ValueError(
                 "Unknown attn_impl %r (valid: 'auto', 'xla', "
@@ -117,6 +156,11 @@ class CausalSelfAttention(nn.Module):
                 raise NotImplementedError(
                     "sliding-window attention is single-shard only; "
                     "drop the sp axis or the window"
+                )
+            if segments is not None:
+                raise NotImplementedError(
+                    "packed-sequence masking is single-shard only; "
+                    "drop the sp axis or unpack the batch"
                 )
             # ring merges partials per kv rotation and ulysses
             # all-to-alls the head axis over sp — both want the full
@@ -146,15 +190,22 @@ class CausalSelfAttention(nn.Module):
                 )
         elif self.attn_impl == "xla":
             out = blockwise_attention(
-                q, k, v, causal=self.causal, window=window
+                q, k, v, causal=self.causal, window=window,
+                segments=segments,
             )
         elif self.attn_impl == "jax_flash":
+            if segments is not None:
+                raise ValueError(
+                    "attn_impl='jax_flash' does not support packed-"
+                    "sequence masking; use attn_impl='auto' or 'xla'"
+                )
             out = jax_flash_attention(
                 q, k, v, causal=self.causal, window=window
             )
         else:  # "auto" (validated above)
             out = flash_attention(
-                q, k, v, causal=self.causal, window=window
+                q, k, v, causal=self.causal, window=window,
+                segments=segments,
             )
         out = out.transpose(0, 2, 1, 3).reshape(b, l, h * d)
         return self._proj(out, e)
@@ -237,7 +288,8 @@ class Block(nn.Module):
     num_kv_heads: int = 0  # grouped-query attention (0 = MHA)
 
     @nn.compact
-    def __call__(self, x, training=False, decode=False, decode_pos=None):
+    def __call__(self, x, training=False, decode=False, decode_pos=None,
+                 prefill=False, segments=None, positions=None):
         e = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
@@ -247,7 +299,8 @@ class Block(nn.Module):
             use_rope=self.use_rope, window=self.window,
             cache_len=self.cache_len,
             num_kv_heads=self.num_kv_heads, name="attn",
-        )(y, training, decode=decode, decode_pos=decode_pos)
+        )(y, training, decode=decode, decode_pos=decode_pos,
+          prefill=prefill, segments=segments, positions=positions)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
             _tp_dense_init(1) if self.tp_shard
@@ -310,8 +363,25 @@ class TransformerLM(nn.Module):
     num_kv_heads: int = 0  # grouped-query attention (0 = MHA)
 
     @nn.compact
-    def __call__(self, features, training=False, decode=False):
+    def __call__(self, features, training=False, decode=False,
+                 prefill=False, prompt_len=None):
         tokens = features["tokens"]  # [b, seq_len]; [b, 1] when decode
+        if decode and prefill:
+            raise ValueError("decode and prefill are mutually exclusive")
+        # sequence packing: [b, seq_len] int ids of contiguous same-id
+        # runs. Attention is confined to each run and positions restart
+        # at run boundaries (the packed rows behave exactly like the
+        # unpacked sequences stacked into separate batch rows).
+        segments = features.get("segment_ids")
+        positions = None
+        if segments is not None:
+            if decode or prefill:
+                raise ValueError(
+                    "segment_ids apply to training/eval forwards, not "
+                    "decode/prefill"
+                )
+            segments = jnp.asarray(segments, jnp.int32)
+            positions = packed_positions(segments)
         x = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype, name="wte"
         )(tokens)
@@ -324,6 +394,17 @@ class TransformerLM(nn.Module):
             )
             decode_pos = pi.value
             pi.value = decode_pos + 1
+        elif prefill:
+            # Batched prefill: one causal forward fills the per-layer
+            # caches for positions [0, prefill length); the counter is
+            # set to the TRUE prompt length (may be < the padded prefill
+            # length) so the next decode step writes position prompt_len.
+            if prompt_len is None:
+                raise ValueError("prefill needs prompt_len")
+            pi = self.variable(
+                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+            )
+            pi.value = jnp.asarray(prompt_len, jnp.int32)
         if self.pos_emb == "learned":
             wpe = nn.Embed(
                 self.seq_len, self.embed_dim, dtype=self.dtype,
@@ -331,6 +412,8 @@ class TransformerLM(nn.Module):
             )
             if decode:
                 x = x + wpe(decode_pos[None, None])
+            elif positions is not None:
+                x = x + wpe(positions)  # [b, l] packed offsets
             else:
                 x = x + wpe(jnp.arange(tokens.shape[1])[None, :])
         elif self.pos_emb != "rope":
@@ -348,7 +431,8 @@ class TransformerLM(nn.Module):
                 window=self.attn_window,
                 cache_len=self.seq_len,
                 num_kv_heads=self.num_kv_heads, name="block_%d" % i,
-            )(x, training, decode=decode, decode_pos=decode_pos)
+            )(x, training, decode=decode, decode_pos=decode_pos,
+              prefill=prefill, segments=segments, positions=positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(
             self.vocab_size, dtype=self.dtype, name="head",
@@ -391,15 +475,23 @@ def custom_model(**kwargs):
 def loss(labels, predictions, sample_weights=None):
     # labels [b, l] int; predictions [b, l, vocab] logits, or the fused
     # {lm_hidden, lm_head_kernel} dict when fused_head is on (the head
-    # matmul then streams inside the loss — ops/losses.py)
+    # matmul then streams inside the loss — ops/losses.py).
+    # Negative labels are IGNORED (ce contribution 0; the packed-
+    # sequence data path marks cross-segment boundary targets -100) —
+    # rows average over their valid tokens only.
+    labels = jnp.asarray(labels)
+    valid = labels >= 0
+    safe = jnp.maximum(labels, 0)
     if isinstance(predictions, dict) and "lm_hidden" in predictions:
-        ce = chunked_softmax_xent(
-            predictions["lm_hidden"], predictions["lm_head_kernel"], labels
-        ).mean(axis=-1)
+        tok_ce = chunked_softmax_xent(
+            predictions["lm_hidden"], predictions["lm_head_kernel"], safe
+        )
     else:
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            predictions, labels
-        ).mean(axis=-1)
+        tok_ce = optax.softmax_cross_entropy_with_integer_labels(
+            predictions, safe
+        )
+    tok_ce = jnp.where(valid, tok_ce, 0.0)
+    ce = tok_ce.sum(axis=-1) / jnp.maximum(valid.sum(axis=-1), 1)
     if sample_weights is None:
         return jnp.mean(ce)
     return jnp.sum(ce * sample_weights) / jnp.maximum(

@@ -320,7 +320,15 @@ def main():
         # is cache-bandwidth-bound, so this measures the GQA win
         ("decode_gqa2", {"EDL_BENCH_MODEL": "decode",
                          "EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
+        # batched-prefill regime: long prompt, short continuation — the
+        # prefill collapses 512 single-token steps into one causal pass
+        ("decode_longprompt", {"EDL_BENCH_MODEL": "decode",
+                               "EDL_BENCH_EXTRA_PARAMS":
+                               "prompt=512; new_tokens=128"}),
         ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
+        # sequence-packing overhead: same shapes, 4 segments per row
+        # through the kernels' segment masks (vs the plain flagship)
+        ("packed4_flagship", {"EDL_BENCH_EXTRA_PARAMS": "packed=4"}),
     ):
         extra["EDL_BENCH_PROBE_TIMEOUT"] = "150"
         step = runner([sys.executable, "bench.py"], timeout=1800,
