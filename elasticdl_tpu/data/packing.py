@@ -72,19 +72,89 @@ def pack_sequences(sequences, row_len, pad_id=0):
     segment_ids = np.zeros((n, row_len), np.int32)
     labels = np.full((n, row_len), IGNORE_LABEL, np.int32)
     for r, row_chunks in enumerate(rows):
-        at = 0
-        for sid, chunk in enumerate(row_chunks):
-            m = len(chunk)
-            tokens[r, at:at + m] = chunk
-            segment_ids[r, at:at + m] = sid
-            # next-token targets within the segment; the segment's last
-            # position has no in-segment successor
-            labels[r, at:at + m - 1] = chunk[1:]
-            at += m
-        if at < row_len:
-            # pad tail: its own segment id, labels stay ignored
-            segment_ids[r, at:] = len(row_chunks)
+        tokens[r], segment_ids[r], labels[r] = _layout_row(
+            row_chunks, row_len, pad_id
+        )
     return tokens, segment_ids, labels
+
+
+def _layout_row(row_chunks, row_len, pad_id):
+    """One packed row from its list of chunks: (tokens, segment_ids,
+    labels), each 1-D [row_len] int32. Next-token targets stay within
+    each segment (the last position of a segment has no in-segment
+    successor); the pad tail gets its own fresh segment id and ignored
+    labels."""
+    tokens = np.full(row_len, pad_id, np.int32)
+    segment_ids = np.zeros(row_len, np.int32)
+    labels = np.full(row_len, IGNORE_LABEL, np.int32)
+    at = 0
+    for sid, chunk in enumerate(row_chunks):
+        m = len(chunk)
+        tokens[at:at + m] = chunk
+        segment_ids[at:at + m] = sid
+        labels[at:at + m - 1] = chunk[1:]
+        at += m
+    if at < row_len:
+        segment_ids[at:] = len(row_chunks)
+    return tokens, segment_ids, labels
+
+
+def pack_dataset(dataset, row_len, pad_id=0, open_rows=8):
+    """Streaming packer over a host Dataset pipeline.
+
+    dataset: a `data.dataset.Dataset` (or any iterable) of 1-D int
+    token sequences of VARIABLE length (e.g. the per-record output of
+    a tokenizing `map`). Returns a new Dataset of packed LM examples
+    `({"tokens": [row_len], "segment_ids": [row_len]}, labels)` —
+    `.batch(n)` stacks them into model-ready packed batches, so a zoo
+    ``dataset_fn`` can pack inside the worker's task stream instead of
+    offline.
+
+    First-fit over up to `open_rows` partially-filled rows: a row is
+    emitted as soon as its slack cannot hold another target (< 2
+    tokens), when room must be made, or at stream end — bounded memory,
+    single pass, deterministic for a given input order."""
+    from elasticdl_tpu.data.dataset import Dataset
+
+    def gen():
+        rows = []   # open rows: lists of chunks
+        slack = []  # remaining capacity per open row
+
+        def emit(i):
+            tokens, segment_ids, labels = _layout_row(
+                rows.pop(i), row_len, pad_id
+            )
+            slack.pop(i)
+            return (
+                {"tokens": tokens, "segment_ids": segment_ids},
+                labels,
+            )
+
+        for seq in dataset:
+            seq = np.asarray(seq, np.int32).reshape(-1)
+            for start in range(0, len(seq), row_len):
+                chunk = seq[start:start + row_len]
+                if len(chunk) < 2:
+                    continue
+                for i, s in enumerate(slack):
+                    if len(chunk) <= s:
+                        rows[i].append(chunk)
+                        slack[i] -= len(chunk)
+                        if slack[i] < 2:
+                            yield emit(i)
+                        break
+                else:
+                    if len(rows) >= open_rows:
+                        # make room: emit the fullest open row
+                        yield emit(int(np.argmin(slack)))
+                    rows.append([chunk])
+                    slack.append(row_len - len(chunk))
+                    if slack[-1] < 2:
+                        yield emit(len(rows) - 1)
+        while rows:
+            yield emit(0)
+
+    return Dataset(gen)
 
 
 def packing_efficiency(sequences, row_len):

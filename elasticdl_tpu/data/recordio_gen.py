@@ -413,3 +413,25 @@ def convert_csv(csv_path, data_dir, records_per_shard=1024, label_column=None,
                     )
             w.write(ex)
         return w.paths
+
+
+def gen_docs_like(data_dir, num_files=2, records_per_file=128, seed=0,
+                  vocab_size=64, min_len=4, max_len=48, cyclic=False):
+    """VARIABLE-length documents for the packed-LM family
+    (model_zoo/transformer_lm_packed): each record is one document of
+    min_len..max_len tokens. cyclic=True writes next=(tok+1)%vocab
+    cycles so tiny models can demonstrably learn from packed batches."""
+    def example(rng):
+        n = rng.randint(min_len, max_len + 1)
+        if cyclic:
+            tokens = (rng.randint(0, vocab_size)
+                      + np.arange(n)) % vocab_size
+        else:
+            tokens = rng.randint(0, vocab_size, size=(n,))
+        return {
+            "tokens": tokens.astype(np.int64),
+            "vocab_size": np.array(vocab_size, np.int64),
+        }
+
+    return _generate(data_dir, "docs", example, num_files,
+                     records_per_file, seed)

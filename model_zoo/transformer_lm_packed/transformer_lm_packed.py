@@ -1,0 +1,78 @@
+"""Packed transformer LM: VARIABLE-length documents packed into fixed
+rows inside the worker's task stream.
+
+Same model as model_zoo/transformer_lm (reused outright); the
+difference is the data path: records are whole documents
+(data/recordio_gen.gen_docs_like), and dataset_fn streams them through
+data/packing.pack_dataset — every training row carries `segment_ids`,
+so attention stays inside each document (the flash kernels' segment
+masks), positions restart per document, and cross-document next-token
+targets are label-masked. ROW_LEN is the packing row length AND the
+model's seq_len; dataset_fn cannot see model_params (it receives
+reader metadata by convention), so custom_model REJECTS a divergent
+seq_len instead of silently desynchronizing the packing width from
+the positional table — change ROW_LEN (or copy the family) for other
+lengths.
+
+The reference zoo has no sequence families at all (SURVEY.md §2.10);
+this packs on top of the net-new LM surface.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from elasticdl_tpu.data.packing import pack_dataset
+from model_zoo.transformer_lm.transformer_lm import (  # noqa: F401
+    TransformerLM,
+    loss,
+    optimizer,
+    resolve_dtype,
+)
+
+ROW_LEN = 128
+
+
+def custom_model(**kwargs):
+    seq_len = kwargs.setdefault("seq_len", ROW_LEN)
+    if seq_len != ROW_LEN:
+        raise ValueError(
+            "transformer_lm_packed packs %d-token rows; seq_len=%r "
+            "would desynchronize the positional table from the packed "
+            "width (edit ROW_LEN or copy the family for other lengths)"
+            % (ROW_LEN, seq_len)
+        )
+    return TransformerLM(
+        **resolve_dtype(kwargs, "transformer_lm_packed")
+    )
+
+
+def dataset_fn(dataset, mode, metadata):
+    if mode == Mode.PREDICTION:
+        raise ValueError(
+            "the packed family trains/evaluates; use transformer_lm "
+            "for prediction/decoding"
+        )
+    dataset = dataset.map(
+        lambda record: decode_example(record)["tokens"].astype(np.int32)
+    )
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=512, seed=0)
+    return pack_dataset(dataset, ROW_LEN)
+
+
+def eval_metrics_fn():
+    def token_accuracy(labels, predictions):
+        labels = np.asarray(labels)
+        preds = np.argmax(np.asarray(predictions), axis=-1)
+        valid = labels >= 0
+        return (
+            ((preds == labels) & valid).sum(axis=1)
+            / np.maximum(valid.sum(axis=1), 1)
+        ).astype(np.float32)
+
+    return {"token_accuracy": token_accuracy}
+
+
+def feature_shapes(seq_len=ROW_LEN):
+    return {"tokens": (seq_len,), "segment_ids": (seq_len,)}
