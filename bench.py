@@ -467,6 +467,7 @@ def run_decode_bench(on_tpu):
     params, extra, batch = apply_extra_params(cfg, batch, on_tpu)
     prompt = int(params.pop("prompt", prompt))
     new_tokens = int(params.pop("new_tokens", new_tokens))
+    quantize = bool(params.pop("quantize", 0))
     if prompt + new_tokens > cfg["seq_len"]:
         # scale to fit (the CPU fallback shrinks seq_len under the same
         # knobs; the rc=0 contract forbids dying on that) — the emitted
@@ -492,6 +493,12 @@ def run_decode_bench(on_tpu):
         ({"tokens": tokens[:, :-1]}, tokens[:, 1:])
     )
     prompt_ids = tokens[:, :prompt]
+    if quantize:
+        # weight-only int8 serving path (api/quantization.py): the
+        # decode program dequantizes in-jit, weights travel as int8
+        from elasticdl_tpu.api.quantization import quantize_params
+
+        state = state.replace(params=quantize_params(state.params))
 
     def decode():
         return autoregressive_generate(

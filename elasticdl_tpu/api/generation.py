@@ -50,6 +50,21 @@ def _decode_cache(trainer):
     return trainer.__dict__.setdefault("_generate_cache", _LRUCache())
 
 
+def _maybe_dequantize(variables, qz):
+    """Weight-only int8 support (api.quantization): dequantize INSIDE
+    the jitted decode program — XLA fuses `int8 -> compute * scale`
+    into each consuming matmul's operand read, so the weights travel
+    HBM->VMEM as int8. `qz` is trace-static (baked into the compiled
+    fn; the compile-cache keys carry it)."""
+    if not qz:
+        return variables
+    from elasticdl_tpu.api.quantization import dequantize_params
+
+    return dict(
+        variables, params=dequantize_params(variables["params"])
+    )
+
+
 def _filter_logits(logits, top_k, top_p):
     """Standard sampling filters, static-shape: top-k keeps the k
     highest logits per row; nucleus (top-p) keeps the smallest set of
@@ -164,11 +179,16 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
     # ride as traced scalars (lax.fori_loop accepts them under jit), so
     # every prompt/continuation length reuses the same executable.
     # Variables ride as arguments so params aren't baked in as constants.
+    from elasticdl_tpu.api.quantization import is_quantized
+
+    qz = is_quantized(state.params)
     cache = _decode_cache(trainer)
-    key = (b, float(temperature), int(top_k), float(top_p))
+    key = (b, float(temperature), int(top_k), float(top_p), qz)
     decode_fn = cache.get(key)
     if decode_fn is None:
         def decode(variables, tokens, rng, start, stop):
+            variables = _maybe_dequantize(variables, qz)
+
             def body(i, tokens):
                 logits = model.apply(
                     variables, {"tokens": tokens}, training=False
@@ -279,14 +299,18 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed,
     seq_len = model.seq_len
     p_pad = _prefill_bucket(p, seq_len)
 
+    from elasticdl_tpu.api.quantization import is_quantized
+
+    qz = is_quantized(state.params)
     cache = _decode_cache(trainer)
     key = ("kv", b, total, p_pad, float(temperature), int(top_k),
-           float(top_p))
+           float(top_p), qz)
     fn = cache.get(key)
     if fn is None:
         kv_shapes = _kv_shapes_for(cache, model, b)
 
         def run(variables, tokens, rng, p_len):
+            variables = _maybe_dequantize(variables, qz)
             # ---- batched prefill: fill caches for [0, p), take the
             # logits at p-1, write the first generated token at p
             kv, last = _run_prefill(
@@ -376,12 +400,16 @@ def beam_search_generate(trainer, state, prompt, max_new_tokens,
             "num_beams must be in [1, vocab_size], got %d" % k
         )
 
+    from elasticdl_tpu.api.quantization import is_quantized
+
+    qz = is_quantized(state.params)
     cache = _decode_cache(trainer)
-    key = ("beam", b, k)
+    key = ("beam", b, k, qz)
     fn = cache.get(key)
     if fn is None:
         def run(variables, tokens, start, stop):
             # tokens [b, k, L]; scores [b, k]
+            variables = _maybe_dequantize(variables, qz)
             neg = jnp.asarray(-jnp.inf, jnp.float32)
             scores = jnp.where(
                 jnp.arange(k)[None, :] == 0, 0.0, neg
@@ -472,14 +500,18 @@ def _beam_kv_generate(trainer, state, prompt, max_new_tokens, num_beams):
     bk = b * k
     p_pad = _prefill_bucket(p, seq_len)
 
+    from elasticdl_tpu.api.quantization import is_quantized
+
+    qz = is_quantized(state.params)
     cache = _decode_cache(trainer)
-    key = ("beam_kv", b, k, total, p_pad)
+    key = ("beam_kv", b, k, total, p_pad, qz)
     fn = cache.get(key)
     if fn is None:
         kv_shapes = _kv_shapes_for(cache, model, b)
 
         def run(variables, tokens, p_len):
             # tokens [b, k, L]; shared prefill on the b true rows
+            variables = _maybe_dequantize(variables, qz)
             kv, last = _run_prefill(
                 model, variables, kv_shapes, tokens[:, 0], p_len, p_pad
             )

@@ -325,6 +325,10 @@ def main():
         ("decode_longprompt", {"EDL_BENCH_MODEL": "decode",
                                "EDL_BENCH_EXTRA_PARAMS":
                                "prompt=512; new_tokens=128"}),
+        # weight-only int8 decode: weights travel HBM->VMEM as int8
+        # (dequant fused into the matmuls); vs the bf16 decode target
+        ("decode_int8", {"EDL_BENCH_MODEL": "decode",
+                         "EDL_BENCH_EXTRA_PARAMS": "quantize=1"}),
         ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
         # sequence-packing overhead: same shapes, 4 segments per row
         # through the kernels' segment masks (vs the plain flagship)

@@ -235,3 +235,40 @@ def test_packed_zoo_family_local_executor(tmp_path):
     assert np.isfinite(losses).all()
     assert losses[-3:].mean() < losses[:3].mean() * 0.7
     assert 0.0 <= metrics["token_accuracy"] <= 1.0
+
+
+def test_packed_training_on_sharded_mesh():
+    """Packed batches (segment_ids riding in features) shard over the
+    8-device dp*fsdp mesh: parity with the single-device trainer on the
+    same packed data, step for step."""
+    from elasticdl_tpu.data.packing import pack_sequences
+
+    rs = np.random.RandomState(5)
+    seqs = [
+        (np.arange(m) + s) % 16
+        for m, s in zip(rs.randint(6, 15, size=40),
+                        rs.randint(0, 16, size=40))
+    ]
+    tokens, seg, labels = pack_sequences(seqs, row_len=32, pad_id=0)
+    n = 8  # divisible by dp*fsdp
+    batch = (
+        {
+            "tokens": jnp.asarray(tokens[:n]),
+            "segment_ids": jnp.asarray(seg[:n]),
+        },
+        jnp.asarray(labels[:n]),
+    )
+    params = ("vocab_size=16; seq_len=32; embed_dim=32; num_heads=2; "
+              "num_layers=1")
+    spec1 = load_model_spec_from_module(zoo)
+    mesh1 = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    t1 = Trainer(spec1, mesh=mesh1, model_params=params)
+    s1 = t1.init_state(batch)
+    mesh8 = mesh_lib.build_mesh({"dp": 4, "fsdp": 2})
+    t8 = Trainer(load_model_spec_from_module(zoo), mesh=mesh8,
+                 model_params=params)
+    s8 = t8.init_state(batch)
+    for _ in range(5):
+        s1, l1 = t1.train_step(s1, batch)
+        s8, l8 = t8.train_step(s8, batch)
+        np.testing.assert_allclose(float(l1), float(l8), rtol=1e-4)
