@@ -138,24 +138,57 @@ def expand_kv(kv, num_heads):
 
 
 def _check_segments(segments, b, lq, lk):
-    """Segment-id (sequence-packing) masking is defined for square
-    self-attention: q and k share one [b, l] id array; tokens attend
-    within their own segment only. Every position sees itself, so no
-    row is ever fully masked."""
+    """Normalize the sequence-packing mask argument.
+
+    Accepted forms:
+      * one [b, l] id array — square self-attention (q and k share the
+        ids; every position sees itself, so no row is ever fully
+        masked), or
+      * a (q_seg [b, lq], k_seg [b, lk]) pair — rectangular, e.g. one
+        ring-attention rotation where the held kv shard's ids differ
+        from the local query shard's (rows CAN be fully masked there;
+        the lse sentinel handling in attention_forward_lse covers it).
+
+    Returns (q_seg, k_seg) int32 or None."""
     if segments is None:
         return None
-    segments = jnp.asarray(segments, jnp.int32)
-    if lq != lk:
+    if isinstance(segments, (tuple, list)):
+        if len(segments) != 2:
+            raise ValueError(
+                "segments pair must be (q_seg, k_seg), got %d items"
+                % len(segments)
+            )
+        q_seg = jnp.asarray(segments[0], jnp.int32)
+        k_seg = jnp.asarray(segments[1], jnp.int32)
+    else:
+        if lq != lk:
+            raise ValueError(
+                "a single segments array requires square self-"
+                "attention (lq == lk), got lq=%d lk=%d; pass a "
+                "(q_seg, k_seg) pair for rectangular shapes"
+                % (lq, lk)
+            )
+        q_seg = k_seg = jnp.asarray(segments, jnp.int32)
+    if q_seg.shape != (b, lq) or k_seg.shape != (b, lk):
         raise ValueError(
-            "segment masking requires square self-attention (lq == "
-            "lk), got lq=%d lk=%d" % (lq, lk)
+            "segments must be [batch, seq]: q side (%d, %d), k side "
+            "(%d, %d); got %r / %r"
+            % (b, lq, b, lk, tuple(q_seg.shape), tuple(k_seg.shape))
         )
-    if segments.shape != (b, lq):
-        raise ValueError(
-            "segments must be [batch, seq] = (%d, %d), got %r"
-            % (b, lq, tuple(segments.shape))
+    return q_seg, k_seg
+
+
+def segments_float0(segments):
+    """The float0 (empty) cotangent for integer segment ids — what a
+    custom_vjp backward must return for a segments argument. Accepts
+    None, one array, or the (q_seg, k_seg) pair."""
+    if segments is None:
+        return None
+    if isinstance(segments, (tuple, list)):
+        return tuple(
+            np.zeros(s.shape, jax.dtypes.float0) for s in segments
         )
-    return segments
+    return np.zeros(segments.shape, jax.dtypes.float0)
 
 
 def naive_attention(q, k, v, causal=False, scale=None, window=None,
@@ -186,7 +219,8 @@ def naive_attention(q, k, v, causal=False, scale=None, window=None,
             mask &= k_pos - q_pos < window
     keep = jnp.broadcast_to(mask[None, None], scores.shape)
     if segments is not None:
-        seg_mask = segments[:, :, None] == segments[:, None, :]
+        q_seg, k_seg = segments
+        seg_mask = q_seg[:, :, None] == k_seg[:, None, :]
         keep = keep & seg_mask[:, None]
     scores = jnp.where(keep, scores, _NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
@@ -209,14 +243,16 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
     k = expand_kv(k, h)
     v = expand_kv(v, h)
     block = min(block_size, lk)
-    seg_k = segments
+    q_seg = k_seg = None
+    if segments is not None:
+        q_seg, k_seg = segments
     if lk % block:
         # pad keys; padded positions masked below via k_pos >= lk
         pad = block - lk % block
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        if seg_k is not None:
-            seg_k = jnp.pad(seg_k, ((0, 0), (0, pad)),
+        if k_seg is not None:
+            k_seg = jnp.pad(k_seg, ((0, 0), (0, pad)),
                             constant_values=-1)
     n_blocks = k.shape[2] // block
     k_blocks = k.reshape(b, h, n_blocks, block, d)
@@ -240,7 +276,7 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
         if segments is not None:
             seg_kb = inputs[3]  # [b, block]
             keep = keep & (
-                segments[:, :, None] == seg_kb[:, None, :]
+                q_seg[:, :, None] == seg_kb[:, None, :]
             )[:, None]
         s = jnp.where(keep, s, _NEG_INF)
         return softmax_merge(o, l, m, s, vb), None
@@ -252,7 +288,7 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
     ]
     if segments is not None:
         xs.append(
-            jnp.moveaxis(seg_k.reshape(b, n_blocks, block), 1, 0)
+            jnp.moveaxis(k_seg.reshape(b, n_blocks, block), 1, 0)
         )
     o0 = jnp.zeros_like(q)
     l0 = jnp.zeros((b, h, lq), q.dtype)
@@ -554,10 +590,11 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     ]
     inputs = [q3, k3, v3]
     if segments is not None:
+        q_seg, k_seg = segments
         in_specs += list(_seg_specs(block_q, block_k, h))
         inputs += [
-            segments.reshape(b, lq, 1),
-            segments.reshape(b, 1, lk),
+            q_seg.reshape(b, lq, 1),
+            k_seg.reshape(b, 1, lk),
         ]
     out, lse = pl.pallas_call(
         kernel,
@@ -613,6 +650,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if has_segs:
             s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
+        if has_segs:
+            # a row fully masked by segments (possible only in the
+            # rectangular pair form) carries an lse of the -1e30 class,
+            # so exp(s - lse) = exp(0) = 1 there; its true softmax
+            # contribution is zero — force it so
+            p = jnp.where(lse_ref[0] < 0.5 * _NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
@@ -660,6 +703,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         if has_segs:
             s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
+        if has_segs:
+            # see _flash_bwd_dq_kernel: fully-segment-masked rows
+            # (rectangular pair form) must contribute zero to dk/dv
+            p = jnp.where(lse_ref[0] < 0.5 * _NEG_INF, 0.0, p)
         # dV_j += P^T dO ; dP = dO V^T ; dS = P*(dP - D) ; dK_j += dS^T Q
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do_ref[0], dimension_numbers=_dims(0, 0),
@@ -721,9 +768,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
 
     seg_inputs = []
     if segments is not None:
+        q_seg, k_seg = segments
         seg_inputs = [
-            segments.reshape(b, lq, 1),
-            segments.reshape(b, 1, lk),
+            q_seg.reshape(b, lq, 1),
+            k_seg.reshape(b, 1, lk),
         ]
 
     col_q = _outer_spec(block_q, 1)
@@ -811,12 +859,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, scale,
                                  block_q, block_k, interpret,
                                  window=window, segments=segments)
-    # integer segment ids have a float0 (empty) cotangent
-    dseg = (
-        None if segments is None
-        else np.zeros(segments.shape, jax.dtypes.float0)
-    )
-    return dq, dk, dv, dseg
+    return dq, dk, dv, segments_float0(segments)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -913,28 +956,43 @@ def _flash_tiles(lq, lk, block_q, block_k):
 
 
 def attention_forward_lse(q, k, v, causal=False, scale=None,
-                          block_q=None, block_k=None, interpret=None):
+                          block_q=None, block_k=None, interpret=None,
+                          segments=None):
     """Attention returning (out, logsumexp): out [b,h,lq,d] in q.dtype,
     lse float32 [b,h,lq]. Pallas flash kernel when available and the
     sequence tiles, else the blockwise scan. k/v may carry fewer heads
-    than q (GQA)."""
+    than q (GQA). `segments`: packing mask, single array or
+    (q_seg, k_seg) pair — the pair form serves ring rotations, where a
+    row CAN be fully masked; such rows come back as (o=0, lse=-1e30)
+    so an lse_merge treats them as zero-weight partials (the kernel's
+    own +inf-class backward sentinel is rewritten here)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     group_size(q, k)  # validate GQA divisibility
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    segments = _check_segments(segments, q.shape[0], lq, lk)
     bq = min(resolve_block(block_q, "q"), lq)
     bk = min(resolve_block(block_k, "k"), lk)
     if use_pallas() and _flash_tiles(lq, lk, bq, bk):
         qp, kp, vp = _pad_lanes([q, k, v], d)
         out, lse = _flash_forward(qp, kp, vp, causal, scale, bq, bk,
-                                  interpret, with_residuals=True)
-        return out[..., :d], lse[..., 0]
-    return blockwise_attention(q, k, v, causal=causal, scale=scale,
-                               with_lse=True)
+                                  interpret, with_residuals=True,
+                                  segments=segments)
+        out, lse = out[..., :d], lse[..., 0]
+        if segments is not None:
+            lse = jnp.where(lse > -_NEG_INF * 0.5, _NEG_INF, lse)
+        return out, lse
+    out, lse = blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                   with_lse=True, segments=segments)
+    if segments is not None:
+        # blockwise's empty-row lse is m+log(1e-30) ~ -1e30 already;
+        # normalize exactly for deterministic merges
+        lse = jnp.where(lse < _NEG_INF * 0.5, _NEG_INF, lse)
+    return out, lse
 
 
 def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
                            block_q=None, block_k=None, interpret=None,
-                           grad_dtype=None):
+                           grad_dtype=None, segments=None):
     """(dq, dk, dv) for attention given a saved logsumexp.
 
     `lse` may be the GLOBAL logsumexp of a ring while k/v are one shard:
@@ -950,13 +1008,14 @@ def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
     hkv = k.shape[1]
     group = group_size(q, k)
+    segments = _check_segments(segments, q.shape[0], lq, lk)
     bq = min(resolve_block(block_q, "q"), lq)
     bk = min(resolve_block(block_k, "k"), lk)
     if use_pallas() and _flash_tiles(lq, lk, bq, bk):
         qp, kp, vp, outp, gp = _pad_lanes([q, k, v, out, g], d)
         dq, dk, dv = _flash_backward(
             qp, kp, vp, outp, lse[..., None], gp, causal, scale, bq, bk,
-            interpret, grad_dtype=grad_dtype,
+            interpret, grad_dtype=grad_dtype, segments=segments,
         )
         return dq[..., :d], dk[..., :d], dv[..., :d]
     f32 = jnp.float32
@@ -967,7 +1026,18 @@ def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
     if causal:
         mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
+    if segments is not None:
+        q_seg, k_seg = segments
+        s = jnp.where(
+            (q_seg[:, :, None] == k_seg[:, None, :])[:, None],
+            s, _NEG_INF,
+        )
     p = jnp.exp(s - lse.astype(f32)[..., None])
+    if segments is not None:
+        # fully-segment-masked rows carry a -1e30-class lse; their true
+        # softmax contribution is zero (see _flash_bwd_dq_kernel)
+        p = jnp.where(lse.astype(f32)[..., None] < 0.5 * _NEG_INF,
+                      0.0, p)
     gf = g.astype(f32)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
     dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v.astype(f32))

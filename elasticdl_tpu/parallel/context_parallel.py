@@ -37,6 +37,7 @@ from elasticdl_tpu.ops.attention import (
     jax_flash_attention,
     lse_merge,
     resolve_block,
+    segments_float0,
 )
 
 
@@ -52,72 +53,92 @@ def _ring_case(src, my):
     )
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k):
+def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
+                   block_k):
     """Ring forward: per rotation, the LOCAL flash kernel produces a
     normalized partial (o_i, lse_i) for the currently-held kv shard,
     merged online via lse_merge; kv shards rotate with ppermute. The full
-    sequence never materializes. Returns (o [q.dtype], lse [f32])."""
+    sequence never materializes. Returns (o [q.dtype], lse [f32]).
+
+    `seg` (packed sequences): the LOCAL [b, lq] segment ids. The k-side
+    ids travel WITH their kv shard around the ring, and each rotation
+    masks with the rectangular (local q ids, held k ids) pair; a
+    rotation whose shard shares no segment with a query row yields a
+    (0, -inf) partial that the merge ignores (attention_forward_lse
+    guarantees that sentinel form)."""
     size = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, lq, _ = q.shape
     perm = [((j + 1) % size, j) for j in range(size)]
     f32 = jnp.float32
+    has_seg = seg is not None
+    # dummy keeps the scan carry structure uniform; ints are cheap
+    kseg0 = seg if has_seg else jnp.zeros((b, lq), jnp.int32)
 
-    def full(qq, kk, vv):
+    def _pair(kseg_cur):
+        return (seg, kseg_cur) if has_seg else None
+
+    def full(qq, kk, vv, kseg_cur):
         o, lse = attention_forward_lse(qq, kk, vv, causal=False,
                                        scale=scale, block_q=block_q,
-                                       block_k=block_k)
+                                       block_k=block_k,
+                                       segments=_pair(kseg_cur))
         return o.astype(f32), lse
 
-    def diag(qq, kk, vv):
+    def diag(qq, kk, vv, kseg_cur):
         o, lse = attention_forward_lse(qq, kk, vv, causal=True,
                                        scale=scale, block_q=block_q,
-                                       block_k=block_k)
+                                       block_k=block_k,
+                                       segments=_pair(kseg_cur))
         return o.astype(f32), lse
 
-    def skip(qq, kk, vv):
+    def skip(qq, kk, vv, kseg_cur):
         return (jnp.zeros(qq.shape, f32),
                 jnp.full((b, h, lq), _NEG_INF, f32))
 
-    def merge(o, lse, k_cur, v_cur, i):
+    def merge(o, lse, k_cur, v_cur, kseg_cur, i):
         # after i rotations device `my` holds the shard born on my+i
         if causal:
             o_i, lse_i = jax.lax.switch(
                 _ring_case((my + i) % size, my), (full, diag, skip),
-                q, k_cur, v_cur,
+                q, k_cur, v_cur, kseg_cur,
             )
         else:
-            o_i, lse_i = full(q, k_cur, v_cur)
+            o_i, lse_i = full(q, k_cur, v_cur, kseg_cur)
         return lse_merge(o, lse, o_i, lse_i)
 
     def step(carry, i):
-        o, lse, k_cur, v_cur = carry
-        o, lse = merge(o, lse, k_cur, v_cur, i)
-        k_nxt, v_nxt = jax.lax.ppermute((k_cur, v_cur), axis_name, perm)
-        return (o, lse, k_nxt, v_nxt), None
+        o, lse, k_cur, v_cur, kseg_cur = carry
+        o, lse = merge(o, lse, k_cur, v_cur, kseg_cur, i)
+        k_nxt, v_nxt, kseg_nxt = jax.lax.ppermute(
+            (k_cur, v_cur, kseg_cur), axis_name, perm
+        )
+        return (o, lse, k_nxt, v_nxt, kseg_nxt), None
 
     o0 = jnp.zeros(q.shape, f32)
     lse0 = jnp.full((b, h, lq), _NEG_INF, f32)
     # the last shard's rotation would be discarded — merge it outside the
     # scan so each step pays exactly the ppermutes it uses
-    (o, lse, k_last, v_last), _ = jax.lax.scan(
-        step, (o0, lse0, k, v), jnp.arange(size - 1)
+    (o, lse, k_last, v_last, kseg_last), _ = jax.lax.scan(
+        step, (o0, lse0, k, v, kseg0), jnp.arange(size - 1)
     )
-    o, lse = merge(o, lse, k_last, v_last, size - 1)
+    o, lse = merge(o, lse, k_last, v_last, kseg_last, size - 1)
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_attention(q, k, v, axis_name, causal, scale, block_q, block_k):
-    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q,
-                          block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_attention(q, k, v, seg, axis_name, causal, scale, block_q,
+                    block_k):
+    o, _ = _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale,
+                          block_q, block_k)
     return o
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
-    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q,
-                            block_k)
-    return o, (q, k, v, o, lse)
+def _ring_vjp_fwd(q, k, v, seg, axis_name, causal, scale, block_q,
+                  block_k):
+    o, lse = _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale,
+                            block_q, block_k)
+    return o, (q, k, v, seg, o, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, g):
@@ -127,71 +148,81 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, g):
     dq locally, and accumulates dk/dv into buffers that TRAVEL WITH
     their kv shard around the ring; after the full cycle of ppermutes
     every dk/dv accumulator is back on the device that owns its shard."""
-    q, k, v, o, lse = res
+    q, k, v, seg, o, lse = res
     size = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     perm = [((j + 1) % size, j) for j in range(size)]
     f32 = jnp.float32
+    has_seg = seg is not None
+    b, _, lq, _ = q.shape
+    kseg0 = seg if has_seg else jnp.zeros((b, lq), jnp.int32)
 
-    def full(kk, vv):
+    def _pair(kseg_cur):
+        return (seg, kseg_cur) if has_seg else None
+
+    def full(kk, vv, kseg_cur):
         return attention_backward_lse(q, kk, vv, o, lse, g, causal=False,
                                       scale=scale, block_q=block_q,
-                                      block_k=block_k, grad_dtype=f32)
+                                      block_k=block_k, grad_dtype=f32,
+                                      segments=_pair(kseg_cur))
 
-    def diag(kk, vv):
+    def diag(kk, vv, kseg_cur):
         return attention_backward_lse(q, kk, vv, o, lse, g, causal=True,
                                       scale=scale, block_q=block_q,
-                                      block_k=block_k, grad_dtype=f32)
+                                      block_k=block_k, grad_dtype=f32,
+                                      segments=_pair(kseg_cur))
 
-    def skip(kk, vv):
+    def skip(kk, vv, kseg_cur):
         return (jnp.zeros(q.shape, f32), jnp.zeros(kk.shape, f32),
                 jnp.zeros(vv.shape, f32))
 
-    def grads(k_cur, v_cur, i):
+    def grads(k_cur, v_cur, kseg_cur, i):
         if causal:
             return jax.lax.switch(
                 _ring_case((my + i) % size, my), (full, diag, skip),
-                k_cur, v_cur,
+                k_cur, v_cur, kseg_cur,
             )
-        return full(k_cur, v_cur)
+        return full(k_cur, v_cur, kseg_cur)
 
     def step(carry, i):
-        dq, k_cur, v_cur, dk_acc, dv_acc = carry
-        dq_i, dk_i, dv_i = grads(k_cur, v_cur, i)
+        dq, k_cur, v_cur, kseg_cur, dk_acc, dv_acc = carry
+        dq_i, dk_i, dv_i = grads(k_cur, v_cur, kseg_cur, i)
         dq = dq + dq_i
-        k_cur, v_cur, dk_acc, dv_acc = jax.lax.ppermute(
-            (k_cur, v_cur, dk_acc + dk_i, dv_acc + dv_i),
+        k_cur, v_cur, kseg_cur, dk_acc, dv_acc = jax.lax.ppermute(
+            (k_cur, v_cur, kseg_cur, dk_acc + dk_i, dv_acc + dv_i),
             axis_name, perm,
         )
-        return (dq, k_cur, v_cur, dk_acc, dv_acc), None
+        return (dq, k_cur, v_cur, kseg_cur, dk_acc, dv_acc), None
 
-    (dq, k_last, v_last, dk_acc, dv_acc), _ = jax.lax.scan(
+    (dq, k_last, v_last, kseg_last, dk_acc, dv_acc), _ = jax.lax.scan(
         step,
-        (jnp.zeros(q.shape, f32), k, v, jnp.zeros(k.shape, f32),
-         jnp.zeros(v.shape, f32)),
+        (jnp.zeros(q.shape, f32), k, v, kseg0,
+         jnp.zeros(k.shape, f32), jnp.zeros(v.shape, f32)),
         jnp.arange(size - 1),
     )
     # final shard: compute in place, then one last hop delivers the
     # accumulators home (kv shards themselves are done rotating)
-    dq_i, dk_i, dv_i = grads(k_last, v_last, size - 1)
+    dq_i, dk_i, dv_i = grads(k_last, v_last, kseg_last, size - 1)
     dq = dq + dq_i
     dk_acc, dv_acc = jax.lax.ppermute(
         (dk_acc + dk_i, dv_acc + dv_i), axis_name, perm
     )
     return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
-            dv_acc.astype(v.dtype))
+            dv_acc.astype(v.dtype), segments_float0(seg))
 
 
 _ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
-                         block_q=None, block_k=None):
+                         block_q=None, block_k=None, segments=None):
     """Per-device body: q/k/v are the local sequence shards
     [batch, heads, local_len, dim]. Call inside shard_map/pjit with a
     named `axis_name` axis; returns the local output shard. The local
     compute per rotation is the Pallas flash kernel (fwd + two-pass bwd)
-    when it can run, with a blockwise/dense jnp fallback."""
+    when it can run, with a blockwise/dense jnp fallback. `segments`:
+    the LOCAL [b, local_len] packed-sequence ids (k-side ids rotate
+    with their kv shard)."""
     scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
     # resolve tuned defaults here: the custom_vjp's nondiff args must be
     # concrete ints
@@ -205,38 +236,48 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
             "causal ring attention requires equal q/kv sequence lengths "
             "per shard, got lq=%d lk=%d" % (q.shape[2], k.shape[2])
         )
-    return _ring_attention(q, k, v, axis_name, causal, scale, block_q,
-                           block_k)
+    if segments is not None:
+        segments = jnp.asarray(segments, jnp.int32)
+    return _ring_attention(q, k, v, segments, axis_name, causal, scale,
+                           block_q, block_k)
 
 
 def ring_attention(q, k, v, mesh, causal=False, scale=None,
-                   block_q=None, block_k=None,
+                   block_q=None, block_k=None, segments=None,
                    seq_axis=MeshAxis.SP, batch_axes=(MeshAxis.DP,
                                                      MeshAxis.FSDP)):
     """Global-view ring attention: q/k/v are [batch, heads, seq, dim]
     arrays (sharded or not); the sequence axis is laid out over
     `seq_axis` and batch over `batch_axes`, and XLA inserts only the
-    ring ppermutes — no full-sequence gather.
+    ring ppermutes — no full-sequence gather. `segments` [batch, seq]:
+    packed-sequence ids, sequence-sharded like q (long-context packed
+    training; each rotation masks with the held shard's ids).
 
     With an sp=1 mesh this degenerates to one shard_map program == plain
     attention.
     """
     spec = P(batch_axes, None, seq_axis, None)
-    fn = jax.shard_map(
-        functools.partial(
-            ring_attention_local,
-            axis_name=seq_axis,
-            causal=causal,
-            scale=scale,
-            block_q=block_q,
-            block_k=block_k,
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
+    seg_spec = P(batch_axes, seq_axis)
+    local = functools.partial(
+        ring_attention_local,
+        axis_name=seq_axis,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
     )
-    return fn(q, k, v)
+    if segments is None:
+        fn = jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False,
+        )
+        return fn(q, k, v)
+    fn = jax.shard_map(
+        lambda qq, kk, vv, ss: local(qq, kk, vv, segments=ss),
+        mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, check_vma=False,
+    )
+    return fn(q, k, v, jnp.asarray(segments, jnp.int32))
 
 
 # Local full-sequence attention per Ulysses impl choice; "jax_flash" is
@@ -250,12 +291,15 @@ _ULYSSES_LOCAL_ATTN = {
 
 
 def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
-                            attn_impl="auto"):
+                            attn_impl="auto", segments=None):
     """Per-device body: q/k/v are local sequence shards
     [batch, heads, local_len, dim]. One tiled all_to_all turns them into
     [batch, heads/sp, full_len, dim] (device i holds head block i), the
     full-sequence attention kernel runs locally, and the inverse
-    all_to_all restores the sequence-sharded layout."""
+    all_to_all restores the sequence-sharded layout. `segments`: local
+    [b, local_len] packed ids — all-gathered to the full sequence (ints
+    are tiny next to the activation all-to-alls) since the local kernel
+    sees the whole sequence."""
 
     def to_heads(x):
         return jax.lax.all_to_all(
@@ -263,8 +307,15 @@ def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
         )
 
     local_attn = _ULYSSES_LOCAL_ATTN[attn_impl]
+    kwargs = {}
+    if segments is not None:
+        kwargs["segments"] = jax.lax.all_gather(
+            jnp.asarray(segments, jnp.int32), axis_name, axis=1,
+            tiled=True,
+        )
     out = local_attn(
-        to_heads(q), to_heads(k), to_heads(v), causal=causal, scale=scale
+        to_heads(q), to_heads(k), to_heads(v), causal=causal,
+        scale=scale, **kwargs
     )
     return jax.lax.all_to_all(
         out, axis_name, split_axis=2, concat_axis=1, tiled=True
@@ -272,7 +323,7 @@ def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
 
 
 def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
-                      attn_impl="auto",
+                      attn_impl="auto", segments=None,
                       seq_axis=MeshAxis.SP, batch_axes=(MeshAxis.DP,
                                                         MeshAxis.FSDP)):
     """Global-view Ulysses attention: q/k/v are [batch, heads, seq, dim];
@@ -288,6 +339,11 @@ def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
             "Unknown attn_impl %r (valid: %s)"
             % (attn_impl, ", ".join(sorted(_ULYSSES_LOCAL_ATTN)))
         )
+    if segments is not None and attn_impl == "jax_flash":
+        raise ValueError(
+            "attn_impl='jax_flash' does not support packed-sequence "
+            "masking; use attn_impl='auto' or 'xla'"
+        )
     sp = mesh.shape.get(seq_axis, 1)
     heads = q.shape[1]
     if heads % sp:
@@ -297,17 +353,23 @@ def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
             % (heads, seq_axis, sp)
         )
     spec = P(batch_axes, None, seq_axis, None)
-    fn = jax.shard_map(
-        functools.partial(
-            ulysses_attention_local,
-            axis_name=seq_axis,
-            causal=causal,
-            scale=scale,
-            attn_impl=attn_impl,
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
+    seg_spec = P(batch_axes, seq_axis)
+    local = functools.partial(
+        ulysses_attention_local,
+        axis_name=seq_axis,
+        causal=causal,
+        scale=scale,
+        attn_impl=attn_impl,
     )
-    return fn(q, k, v)
+    if segments is None:
+        fn = jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False,
+        )
+        return fn(q, k, v)
+    fn = jax.shard_map(
+        lambda qq, kk, vv, ss: local(qq, kk, vv, segments=ss),
+        mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, check_vma=False,
+    )
+    return fn(q, k, v, jnp.asarray(segments, jnp.int32))

@@ -157,11 +157,6 @@ class CausalSelfAttention(nn.Module):
                     "sliding-window attention is single-shard only; "
                     "drop the sp axis or the window"
                 )
-            if segments is not None:
-                raise NotImplementedError(
-                    "packed-sequence masking is single-shard only; "
-                    "drop the sp axis or unpack the batch"
-                )
             # ring merges partials per kv rotation and ulysses
             # all-to-alls the head axis over sp — both want the full
             # head count, so GQA kv expands here (the grouped layout
@@ -171,7 +166,7 @@ class CausalSelfAttention(nn.Module):
             if self.sp_impl == "ulysses":
                 out = ulysses_attention(
                     q, k, v, mesh, causal=self.causal,
-                    attn_impl=self.attn_impl,
+                    attn_impl=self.attn_impl, segments=segments,
                 )
             elif self.sp_impl == "ring":
                 if self.attn_impl == "jax_flash":
@@ -182,7 +177,8 @@ class CausalSelfAttention(nn.Module):
                         "sp_impl='ring' (no logsumexp output); use "
                         "sp_impl='ulysses' or attn_impl='auto'"
                     )
-                out = ring_attention(q, k, v, mesh, causal=self.causal)
+                out = ring_attention(q, k, v, mesh, causal=self.causal,
+                                     segments=segments)
             else:
                 raise ValueError(
                     "Unknown sp_impl %r (valid: 'ring', 'ulysses')"

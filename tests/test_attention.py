@@ -596,3 +596,143 @@ def test_loss_ignores_negative_labels():
         float(got), float((row0[0] + row0[1]) / 2), rtol=1e-6
     )
     assert not np.isclose(float(base), float(got))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_rectangular_segment_pair(causal):
+    """The (q_seg, k_seg) pair form on rectangular shapes — one ring
+    rotation's geometry — through the Pallas kernels, vs the oracle.
+    Rows with NO matching key in the k shard are exercised (ids 9)."""
+    rs = np.random.RandomState(21)
+    q = jnp.asarray(rs.randn(2, 2, 32, 128).astype(np.float32) * 0.3)
+    k = jnp.asarray(rs.randn(2, 2, 16, 128).astype(np.float32) * 0.3)
+    v = jnp.asarray(rs.randn(2, 2, 16, 128).astype(np.float32) * 0.3)
+    q_seg = jnp.asarray(
+        np.concatenate([np.zeros((2, 12)), np.full((2, 10), 1),
+                        np.full((2, 10), 9)], axis=1), jnp.int32)
+    k_seg = jnp.asarray(
+        np.concatenate([np.zeros((2, 8)), np.ones((2, 8))], axis=1),
+        jnp.int32)
+    # non-causal only for the oracle comparison of fully-masked rows:
+    # softmax over all -inf is implementation-defined; compare only
+    # rows with at least one visible key
+    ref = naive_attention(q, k, v, causal=causal,
+                          segments=(q_seg, k_seg))
+    out = flash_attention(q, k, v, causal=causal, block_q=16,
+                          block_k=16, segments=(q_seg, k_seg))
+    visible = np.asarray(q_seg[0]) != 9
+    np.testing.assert_allclose(
+        np.asarray(out)[:, :, visible], np.asarray(ref)[:, :, visible],
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def _packed_seg_for_ring(b, l, seed=31):
+    """Packing whose segments CROSS shard boundaries on an 8-way ring
+    (l=64 -> 8-token shards; cuts not at multiples of 8)."""
+    rs = np.random.RandomState(seed)
+    seg = np.zeros((b, l), np.int32)
+    for r in range(b):
+        cuts = sorted(rs.choice(np.arange(3, l - 1), size=3,
+                                replace=False))
+        sid, prev = 0, 0
+        for c in list(cuts) + [l]:
+            seg[r, prev:c] = sid
+            sid, prev = sid + 1, c
+    return jnp.asarray(seg)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_segments(causal):
+    """Packed long-context: ring attention with sequence-sharded
+    segment ids (k-side ids rotate with their shard) vs the oracle."""
+    mesh = mesh_lib.build_mesh({"sp": 8})
+    q, k, v = _qkv(24)
+    seg = _packed_seg_for_ring(B, L)
+    ref = naive_attention(q, k, v, causal=causal, segments=seg)
+    out = ring_attention(q, k, v, mesh, causal=causal, segments=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_segments_gradients():
+    mesh = mesh_lib.build_mesh({"sp": 8})
+    q, k, v = _qkv(25)
+    seg = _packed_seg_for_ring(B, L, seed=32)
+
+    def loss_ring(q, k, v):
+        return ring_attention(q, k, v, mesh, causal=True,
+                              segments=seg).sum()
+
+    def loss_ref(q, k, v):
+        return naive_attention(q, k, v, causal=True,
+                               segments=seg).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr_, gn in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr_), np.asarray(gn),
+                                   rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_segments(causal):
+    from elasticdl_tpu.parallel.context_parallel import ulysses_attention
+
+    mesh = mesh_lib.build_mesh({"dp": 4, "sp": 2})
+    rs = np.random.RandomState(26)
+    mk = lambda: jnp.asarray(rs.randn(4, 2, L, D).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    seg = _packed_seg_for_ring(4, L, seed=33)
+    ref = naive_attention(q, k, v, causal=causal, segments=seg)
+    out = ulysses_attention(q, k, v, mesh, causal=causal, segments=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_rectangular_pair_gradients():
+    """Backward through the rectangular (q_seg, k_seg) pair with rows
+    whose segment id is absent from the k shard: (a) with the masked
+    rows excluded from the loss (how packed losses behave), kernel
+    grads match the oracle; (b) with them included, grads stay finite
+    and the masked rows contribute ZERO (the -1e30-class lse rows are
+    forced to p=0 in both backward kernels — without that they would
+    contaminate dk/dv with p=1 garbage)."""
+    rs = np.random.RandomState(41)
+    q = jnp.asarray(rs.randn(2, 2, 32, 128).astype(np.float32) * 0.3)
+    k = jnp.asarray(rs.randn(2, 2, 16, 128).astype(np.float32) * 0.3)
+    v = jnp.asarray(rs.randn(2, 2, 16, 128).astype(np.float32) * 0.3)
+    q_seg = jnp.asarray(
+        np.concatenate([np.zeros((2, 12)), np.ones((2, 10)),
+                        np.full((2, 10), 9)], axis=1), jnp.int32)
+    k_seg = jnp.asarray(
+        np.concatenate([np.zeros((2, 8)), np.ones((2, 8))], axis=1),
+        jnp.int32)
+    visible = jnp.asarray((np.asarray(q_seg) != 9)[:, None, :, None])
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, block_q=16, block_k=16,
+                              segments=(q_seg, k_seg))
+        return (jnp.where(visible, out, 0.0) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        out = naive_attention(q, k, v, segments=(q_seg, k_seg))
+        return (jnp.where(visible, out, 0.0) ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-3, atol=1e-4)
+
+    # (b) loss reads every row, masked included: finite grads, zero
+    # contribution from the fully-masked rows
+    def loss_all(q, k, v):
+        return (flash_attention(q, k, v, block_q=16, block_k=16,
+                                segments=(q_seg, k_seg)) ** 2).sum()
+
+    dq, dk, dv = jax.grad(loss_all, argnums=(0, 1, 2))(q, k, v)
+    for g_ in (dq, dk, dv):
+        assert np.isfinite(np.asarray(g_)).all()
+    masked_dq = np.asarray(dq)[:, :, np.asarray(q_seg[0]) == 9]
+    np.testing.assert_array_equal(masked_dq, 0.0)
