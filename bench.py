@@ -468,6 +468,7 @@ def run_decode_bench(on_tpu):
     prompt = int(params.pop("prompt", prompt))
     new_tokens = int(params.pop("new_tokens", new_tokens))
     quantize = bool(params.pop("quantize", 0))
+    beams = int(params.pop("beams", 0))  # 0 = greedy KV decode
     if prompt + new_tokens > cfg["seq_len"]:
         # scale to fit (the CPU fallback shrinks seq_len under the same
         # knobs; the rc=0 contract forbids dying on that) — the emitted
@@ -500,10 +501,19 @@ def run_decode_bench(on_tpu):
 
         state = state.replace(params=quantize_params(state.params))
 
-    def decode():
-        return autoregressive_generate(
-            trainer, state, prompt_ids, new_tokens, use_cache=True
-        )
+    if beams:
+        from elasticdl_tpu.api.generation import beam_search_generate
+
+        def decode():
+            return beam_search_generate(
+                trainer, state, prompt_ids, new_tokens,
+                num_beams=beams, use_cache=True,
+            )
+    else:
+        def decode():
+            return autoregressive_generate(
+                trainer, state, prompt_ids, new_tokens, use_cache=True
+            )
 
     out = decode()  # compile
     fetch_sync(out)

@@ -963,9 +963,10 @@ def attention_forward_lse(q, k, v, causal=False, scale=None,
     sequence tiles, else the blockwise scan. k/v may carry fewer heads
     than q (GQA). `segments`: packing mask, single array or
     (q_seg, k_seg) pair — the pair form serves ring rotations, where a
-    row CAN be fully masked; such rows come back as (o=0, lse=-1e30)
-    so an lse_merge treats them as zero-weight partials (the kernel's
-    own +inf-class backward sentinel is rewritten here)."""
+    row CAN be fully masked; such rows come back with lse = exactly
+    _NEG_INF (their `o` is an unnormalized degenerate value, but an
+    lse_merge weights it exp(_NEG_INF - finite) = 0, so merged results
+    are exact)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     group_size(q, k)  # validate GQA divisibility
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
@@ -979,14 +980,21 @@ def attention_forward_lse(q, k, v, causal=False, scale=None,
                                   segments=segments)
         out, lse = out[..., :d], lse[..., 0]
         if segments is not None:
-            lse = jnp.where(lse > -_NEG_INF * 0.5, _NEG_INF, lse)
+            # a fully-segment-masked row leaves the kernel with
+            # lse = -1e30 + log(lk) (p = exp(0) accumulates l = lk);
+            # snap every +/-1e30-class value to exact _NEG_INF so the
+            # lse_merge weight exp(lse_i - lse) is deterministically 0
+            # and the flash/blockwise paths agree bit-for-bit
+            lse = jnp.where(jnp.abs(lse) > -_NEG_INF * 0.5,
+                            _NEG_INF, lse)
         return out, lse
     out, lse = blockwise_attention(q, k, v, causal=causal, scale=scale,
                                    with_lse=True, segments=segments)
     if segments is not None:
         # blockwise's empty-row lse is m+log(1e-30) ~ -1e30 already;
         # normalize exactly for deterministic merges
-        lse = jnp.where(lse < _NEG_INF * 0.5, _NEG_INF, lse)
+        lse = jnp.where(jnp.abs(lse) > -_NEG_INF * 0.5,
+                        _NEG_INF, lse)
     return out, lse
 
 

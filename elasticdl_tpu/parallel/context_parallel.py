@@ -72,8 +72,6 @@ def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
     perm = [((j + 1) % size, j) for j in range(size)]
     f32 = jnp.float32
     has_seg = seg is not None
-    # dummy keeps the scan carry structure uniform; ints are cheap
-    kseg0 = seg if has_seg else jnp.zeros((b, lq), jnp.int32)
 
     def _pair(kseg_cur):
         return (seg, kseg_cur) if has_seg else None
@@ -108,20 +106,28 @@ def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
         return lse_merge(o, lse, o_i, lse_i)
 
     def step(carry, i):
-        o, lse, k_cur, v_cur, kseg_cur = carry
+        # kseg rides the ring ONLY when packing is on (has_seg is
+        # trace-static): the default path keeps its original
+        # two-operand collective-permute shape
+        if has_seg:
+            o, lse, k_cur, v_cur, kseg_cur = carry
+        else:
+            (o, lse, k_cur, v_cur), kseg_cur = carry, None
         o, lse = merge(o, lse, k_cur, v_cur, kseg_cur, i)
-        k_nxt, v_nxt, kseg_nxt = jax.lax.ppermute(
-            (k_cur, v_cur, kseg_cur), axis_name, perm
-        )
-        return (o, lse, k_nxt, v_nxt, kseg_nxt), None
+        rot = (k_cur, v_cur, kseg_cur) if has_seg else (k_cur, v_cur)
+        rot = jax.lax.ppermute(rot, axis_name, perm)
+        return (o, lse) + rot, None
 
     o0 = jnp.zeros(q.shape, f32)
     lse0 = jnp.full((b, h, lq), _NEG_INF, f32)
+    carry0 = (o0, lse0, k, v) + ((seg,) if has_seg else ())
     # the last shard's rotation would be discarded — merge it outside the
     # scan so each step pays exactly the ppermutes it uses
-    (o, lse, k_last, v_last, kseg_last), _ = jax.lax.scan(
-        step, (o0, lse0, k, v, kseg0), jnp.arange(size - 1)
-    )
+    final, _ = jax.lax.scan(step, carry0, jnp.arange(size - 1))
+    if has_seg:
+        o, lse, k_last, v_last, kseg_last = final
+    else:
+        (o, lse, k_last, v_last), kseg_last = final, None
     o, lse = merge(o, lse, k_last, v_last, kseg_last, size - 1)
     return o.astype(q.dtype), lse
 
@@ -154,8 +160,6 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, g):
     perm = [((j + 1) % size, j) for j in range(size)]
     f32 = jnp.float32
     has_seg = seg is not None
-    b, _, lq, _ = q.shape
-    kseg0 = seg if has_seg else jnp.zeros((b, lq), jnp.int32)
 
     def _pair(kseg_cur):
         return (seg, kseg_cur) if has_seg else None
@@ -185,21 +189,28 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, g):
         return full(k_cur, v_cur, kseg_cur)
 
     def step(carry, i):
-        dq, k_cur, v_cur, kseg_cur, dk_acc, dv_acc = carry
+        if has_seg:
+            dq, k_cur, v_cur, kseg_cur, dk_acc, dv_acc = carry
+        else:
+            (dq, k_cur, v_cur, dk_acc, dv_acc), kseg_cur = carry, None
         dq_i, dk_i, dv_i = grads(k_cur, v_cur, kseg_cur, i)
         dq = dq + dq_i
-        k_cur, v_cur, kseg_cur, dk_acc, dv_acc = jax.lax.ppermute(
-            (k_cur, v_cur, kseg_cur, dk_acc + dk_i, dv_acc + dv_i),
-            axis_name, perm,
+        rot = (k_cur, v_cur) + ((kseg_cur,) if has_seg else ()) + (
+            dk_acc + dk_i, dv_acc + dv_i,
         )
-        return (dq, k_cur, v_cur, kseg_cur, dk_acc, dv_acc), None
+        rot = jax.lax.ppermute(rot, axis_name, perm)
+        return (dq,) + rot, None
 
-    (dq, k_last, v_last, kseg_last, dk_acc, dv_acc), _ = jax.lax.scan(
-        step,
-        (jnp.zeros(q.shape, f32), k, v, kseg0,
-         jnp.zeros(k.shape, f32), jnp.zeros(v.shape, f32)),
-        jnp.arange(size - 1),
+    carry0 = (
+        (jnp.zeros(q.shape, f32), k, v)
+        + ((seg,) if has_seg else ())
+        + (jnp.zeros(k.shape, f32), jnp.zeros(v.shape, f32))
     )
+    final, _ = jax.lax.scan(step, carry0, jnp.arange(size - 1))
+    if has_seg:
+        dq, k_last, v_last, kseg_last, dk_acc, dv_acc = final
+    else:
+        (dq, k_last, v_last, dk_acc, dv_acc), kseg_last = final, None
     # final shard: compute in place, then one last hop delivers the
     # accumulators home (kv shards themselves are done rotating)
     dq_i, dk_i, dv_i = grads(k_last, v_last, kseg_last, size - 1)

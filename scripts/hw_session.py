@@ -329,6 +329,9 @@ def main():
         # (dequant fused into the matmuls); vs the bf16 decode target
         ("decode_int8", {"EDL_BENCH_MODEL": "decode",
                          "EDL_BENCH_EXTRA_PARAMS": "quantize=1"}),
+        # KV-cached beam search: per-step cache gathers at width 4
+        ("decode_beam4", {"EDL_BENCH_MODEL": "decode",
+                          "EDL_BENCH_EXTRA_PARAMS": "beams=4"}),
         ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
         # sequence-packing overhead: same shapes, 4 segments per row
         # through the kernels' segment masks (vs the plain flagship)

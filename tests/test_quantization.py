@@ -43,7 +43,8 @@ def _trained_trainer(steps=250):
     state = trainer.init_state(_cycle_batch())
     for step in range(steps):
         state, loss = trainer.train_step(state, _cycle_batch(seed=step))
-    assert float(loss) < 0.15
+    if steps >= 200:  # short warmups are for structural tests
+        assert float(loss) < 0.15
     return trainer, state
 
 
@@ -95,3 +96,32 @@ def test_quantized_decode_all_strategies():
                                  num_beams=2, **kwargs)
         )
         np.testing.assert_array_equal(ref, got, err_msg=str(kwargs))
+
+
+def test_quantized_state_checkpoint_roundtrip(tmp_path):
+    """An int8-quantized serving state survives the sharded checkpoint
+    (the marker dicts are ordinary pytree nodes with array leaves), so
+    a serving artifact can be exported/restored without the float
+    originals."""
+    from elasticdl_tpu.checkpoint.saver import (
+        CheckpointSaver,
+        flatten_state,
+        load_checkpoint,
+    )
+
+    trainer, state = _trained_trainer(steps=5)
+    qstate = state.replace(params=quantize_params(state.params))
+    saver = CheckpointSaver(str(tmp_path), checkpoint_steps=1,
+                            num_shards=2)
+    saver.save(qstate, version=1)
+    flat, version = load_checkpoint(str(tmp_path))
+    assert version == 1
+    expect = flatten_state(qstate)
+    assert set(flat) == set(expect)
+    for key in expect:
+        np.testing.assert_array_equal(np.asarray(flat[key]),
+                                      np.asarray(expect[key]))
+    # int8 payloads persisted as int8 (not upcast)
+    int8_keys = [k for k in flat if "__w8__" in k]
+    assert int8_keys
+    assert all(flat[k].dtype == np.int8 for k in int8_keys)
