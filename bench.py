@@ -469,18 +469,26 @@ def run_decode_bench(on_tpu):
     new_tokens = int(params.pop("new_tokens", new_tokens))
     quantize = bool(params.pop("quantize", 0))
     beams = int(params.pop("beams", 0))  # 0 = greedy KV decode
-    if prompt + new_tokens > cfg["seq_len"]:
+    # speculative decode: gamma draft proposals per target verify.
+    # spec_draft_layers=0 uses the TARGET as its own draft — acceptance
+    # ~100%, measuring the mechanics ceiling; a shallow random draft
+    # measures the floor (near-zero acceptance on random logits).
+    spec_gamma = int(params.pop("spec_gamma", 0))
+    spec_draft_layers = int(params.pop("spec_draft_layers", 2))
+    # speculative verify chunks reach gamma-1 positions past the stream
+    margin = spec_gamma - 1 if spec_gamma else 0
+    if prompt + new_tokens + margin > cfg["seq_len"]:
         # scale to fit (the CPU fallback shrinks seq_len under the same
         # knobs; the rc=0 contract forbids dying on that) — the emitted
         # prompt_len/new_tokens fields report what actually ran
-        f = cfg["seq_len"] / (prompt + new_tokens)
+        room = cfg["seq_len"] - margin
+        f = room / (prompt + new_tokens)
         prompt = max(1, int(prompt * f))
-        new_tokens = max(1, min(cfg["seq_len"] - prompt,
-                                int(new_tokens * f)))
+        new_tokens = max(1, min(room - prompt, int(new_tokens * f)))
         sys.stderr.write(
-            "bench: prompt+new_tokens exceed seq_len %d; scaled to "
-            "prompt=%d new_tokens=%d\n"
-            % (cfg["seq_len"], prompt, new_tokens)
+            "bench: prompt+new_tokens exceed seq_len %d (margin %d); "
+            "scaled to prompt=%d new_tokens=%d\n"
+            % (cfg["seq_len"], margin, prompt, new_tokens)
         )
     spec = load_model_spec_from_module(zoo)
     mesh = mesh_lib.build_mesh()
@@ -501,7 +509,27 @@ def run_decode_bench(on_tpu):
 
         state = state.replace(params=quantize_params(state.params))
 
-    if beams:
+    if spec_gamma:
+        from elasticdl_tpu.api.generation import speculative_generate
+
+        if spec_draft_layers:
+            d_params = dict(params, num_layers=spec_draft_layers)
+            draft_trainer = Trainer(
+                spec, mesh=mesh,
+                model_params=format_params_str(d_params),
+            )
+            d_state = draft_trainer.init_state(
+                ({"tokens": tokens[:, :-1]}, tokens[:, 1:])
+            )
+        else:
+            draft_trainer, d_state = trainer, state
+
+        def decode():
+            return speculative_generate(
+                trainer, state, draft_trainer, d_state, prompt_ids,
+                new_tokens, gamma=spec_gamma,
+            )
+    elif beams:
         from elasticdl_tpu.api.generation import beam_search_generate
 
         def decode():

@@ -601,3 +601,186 @@ def _beam_kv_generate(trainer, state, prompt, max_new_tokens, num_beams):
     with trainer.mesh:
         out = fn(variables, buf, jnp.asarray(p, jnp.int32))
     return out[:, :total]
+
+
+def speculative_generate(trainer, state, draft_trainer, draft_state,
+                         prompt, max_new_tokens, gamma=4):
+    """Speculative greedy decoding: a small DRAFT model proposes gamma
+    tokens per iteration (cheap single-token KV steps) and the TARGET
+    model verifies them in ONE chunked decode step (the model's t>1
+    decode mode: one batched cache read for gamma queries). Accepted
+    prefix + the target's correction token advance the stream 1..gamma
+    positions per target invocation.
+
+    EXACTNESS: output tokens equal plain greedy decoding of the target
+    model (same argmax at every position — the draft only affects how
+    many target steps are needed, never what they produce; kernel
+    reduction-order ULPs aside). Greedy only — temperature sampling
+    would need the rejection-sampling correction.
+
+    Cache rollback is counter-only: entries past the rolled-back
+    counter are junk that the chunk mask hides and later writes
+    overwrite — the same safety argument as the prefill slab.
+
+    Both models follow the KV convention (decode + prefill modes) and
+    share the vocabulary; the draft's seq_len must also cover the
+    stream. Returns int32 [b, p + max_new_tokens].
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    model, draft = trainer.model, draft_trainer.model
+    for m in (model, draft):
+        _require_kv_convention(m)
+        if not getattr(m, "causal", True):
+            raise ValueError("speculative decode needs causal models")
+    if getattr(model, "vocab_size", None) != getattr(
+            draft, "vocab_size", None):
+        raise ValueError(
+            "target and draft must share a vocabulary, got %r vs %r"
+            % (getattr(model, "vocab_size", None),
+               getattr(draft, "vocab_size", None))
+        )
+    gamma = int(gamma)
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1, got %d" % gamma)
+    total = p + int(max_new_tokens)
+    seq_len = min(model.seq_len, draft.seq_len)
+    # the last verify chunk can reach position (total-2) + gamma
+    if max_new_tokens < 1 or p < 1 or total + gamma - 1 > seq_len:
+        raise ValueError(
+            "need prompt %d + new %d + gamma %d - 1 <= min seq_len %d "
+            "(the verify chunk must fit the cache)"
+            % (p, max_new_tokens, gamma, seq_len)
+        )
+    p_pad = _prefill_bucket(p, seq_len)
+
+    cache = _decode_cache(trainer)
+    from elasticdl_tpu.api.quantization import is_quantized
+
+    qz = is_quantized(state.params)
+    d_qz = is_quantized(draft_state.params)
+    # the compiled fn closes over the DRAFT module too — same target
+    # with a different draft must not reuse it
+    key = ("spec", b, total, gamma, p_pad, qz, d_qz,
+           id(draft_trainer))
+    fn = cache.get(key)
+    if fn is None:
+        kv_shapes = _kv_shapes_for(cache, model, b)
+        # draft cache shapes live under the draft trainer's own cache
+        d_cache = _decode_cache(draft_trainer)
+        d_kv_shapes = _kv_shapes_for(d_cache, draft, b)
+
+        def run(variables, d_variables, tokens, p_len):
+            variables = _maybe_dequantize(variables, qz)
+            d_variables = _maybe_dequantize(d_variables, d_qz)
+            # ---- prefill BOTH models; target's logits pick token at p
+            tkv, t_last = _run_prefill(
+                model, variables, kv_shapes, tokens, p_len, p_pad
+            )
+            dkv, _ = _run_prefill(
+                draft, d_variables, d_kv_shapes, tokens, p_len, p_pad
+            )
+            first = jnp.argmax(t_last, axis=-1).astype(jnp.int32)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, first[:, None], (0, p_len)
+            )
+
+            def cond(carry):
+                tokens, pos, tkv, dkv = carry
+                return pos < total
+
+            def body(carry):
+                tokens, pos, tkv, dkv = carry
+                # ---- draft: gamma single-token proposals from pos-1
+                def d_step(c, _):
+                    dkv, tok = c
+                    lg, upd = draft.apply(
+                        dict(d_variables, cache=dkv),
+                        {"tokens": tok},
+                        training=False, decode=True, mutable=["cache"],
+                    )
+                    nxt = jnp.argmax(
+                        lg[:, 0], axis=-1
+                    ).astype(jnp.int32)[:, None]
+                    return (upd["cache"], nxt), nxt
+
+                tok0 = jax.lax.dynamic_slice(
+                    tokens, (0, pos - 1), (b, 1)
+                )
+                # gamma-1 proposals: the verify chunk only ever reads
+                # d[0..gamma-2] (row j feeds position pos-1+j), and the
+                # gamma-th proposal could not change the commit count
+                # either — it would be pure dead work
+                (dkv, _), d_toks = jax.lax.scan(
+                    d_step, (dkv, tok0), None, length=gamma - 1
+                )
+                d_toks = jnp.moveaxis(
+                    d_toks[..., 0], 0, 1
+                )  # [b, gamma-1]
+                # stage proposals in the buffer so the verify chunk can
+                # read them contiguously: positions pos .. pos+gamma-2
+                tokens_staged = jax.lax.dynamic_update_slice(
+                    tokens, d_toks, (0, pos)
+                )
+                # ---- target: ONE gamma-wide chunk from position pos-1
+                chunk = jax.lax.dynamic_slice(
+                    tokens_staged, (0, pos - 1), (b, gamma)
+                )
+                t_logits, t_upd = model.apply(
+                    dict(variables, cache=tkv),
+                    {"tokens": chunk},
+                    training=False, decode=True, mutable=["cache"],
+                )
+                tkv = t_upd["cache"]
+                g_toks = jnp.argmax(
+                    t_logits, axis=-1
+                ).astype(jnp.int32)  # [b, gamma] targets for pos..pos+gamma-1
+                # ---- acceptance: longest common prefix over the
+                # gamma-1 proposals, batch-min so every row stays in
+                # lockstep (a row's extra accepted tokens are simply
+                # re-derived next iteration). Committing a+1 tokens is
+                # always valid: position pos+a takes the target's own
+                # g[a] (correction when d[a] mismatched, bonus when
+                # every proposal matched).
+                match = jnp.cumprod(
+                    (d_toks == g_toks[:, :gamma - 1]).astype(jnp.int32),
+                    axis=1,
+                )
+                a = jnp.min(match.sum(axis=1))  # scalar in [0, gamma-1]
+                c = a + 1                       # tokens to commit
+                # commit g[0..c-1] at positions pos..pos+c-1 (g == d on
+                # the accepted prefix; position pos+a takes the
+                # target's correction when a < gamma)
+                keep = jnp.arange(gamma)[None, :] < c
+                window = jax.lax.dynamic_slice(
+                    tokens, (0, pos), (b, gamma)
+                )
+                merged = jnp.where(keep, g_toks, window)
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, merged, (0, pos)
+                )
+                pos = pos + c
+                # ---- rollback: counters to consumed = pos - 1; cache
+                # rows past the counter are masked junk
+                tkv = dict(tkv, pos=jnp.asarray(pos - 1, jnp.int32))
+                dkv = dict(dkv, pos=jnp.asarray(pos - 1, jnp.int32))
+                return (tokens, pos, tkv, dkv)
+
+            tokens, _, _, _ = jax.lax.while_loop(
+                cond, body, (tokens, p_len + 1, tkv, dkv)
+            )
+            return tokens
+
+        fn = jax.jit(run)
+        cache[key] = fn
+
+    variables = {"params": state.params, **state.model_state}
+    d_variables = {
+        "params": draft_state.params, **draft_state.model_state
+    }
+    buf = jnp.zeros((b, seq_len), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    with trainer.mesh:
+        out = fn(variables, d_variables, buf,
+                 jnp.asarray(p, jnp.int32))
+    return out[:, :total]

@@ -216,21 +216,25 @@ class CausalSelfAttention(nn.Module):
         )(out)
 
     def _decode_step(self, q, k, v, e, decode_pos):
-        """Single-token decode against the KV cache: q is [b, h, 1, d],
-        k/v [b, hkv, 1, d]; cached keys/values live in the `cache`
-        collection in the GROUPED head count — the GQA memory win: cache
-        reads per token scale with hkv, not h. The absolute position
-        `decode_pos` comes from the model's single cache counter (one
-        source of truth — per-layer counters could only drift apart).
-        RoPE rotates q and the cached k at that position; causal masking
-        is `k_pos <= pos`, windowing `k_pos > pos - window`."""
+        """Chunked decode against the KV cache: q is [b, h, t, d],
+        k/v [b, hkv, t, d] for a chunk of t >= 1 tokens at absolute
+        positions [decode_pos, decode_pos + t) — t = 1 is the classic
+        per-token step; t > 1 is the speculative-verify / chunked-
+        prefill-continuation step (one batched read of the cache for t
+        queries instead of t reads). Cached keys/values live in the
+        `cache` collection in the GROUPED head count — the GQA memory
+        win: cache reads scale with hkv, not h. `decode_pos` comes from
+        the model's single cache counter (one source of truth —
+        per-layer counters could only drift apart). RoPE rotates q/k at
+        their absolute positions; row i of the chunk masks
+        `k_pos <= pos + i` (windowing `k_pos > pos + i - window`)."""
         if not self.causal:
             raise ValueError("decode mode requires a causal model")
         if self.cache_len < 1:
             raise ValueError("decode mode needs cache_len >= 1")
         if decode_pos is None:
             raise ValueError("decode mode needs decode_pos")
-        b, h, _, d = q.shape
+        b, h, t, d = q.shape
         hkv = k.shape[1]
         group = h // hkv
         dtype = q.dtype
@@ -242,7 +246,7 @@ class CausalSelfAttention(nn.Module):
         )
         idx = decode_pos
         if self.use_rope:
-            pos = jnp.full((1,), idx)
+            pos = idx + jnp.arange(t)
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
         ck.value = jax.lax.dynamic_update_slice(
@@ -252,20 +256,21 @@ class CausalSelfAttention(nn.Module):
             cv.value, v.astype(dtype), (0, 0, idx, 0)
         )
         scale = d ** -0.5
-        # group the q heads under their kv head: [b, hkv, group, d]
-        qg = (q * scale)[:, :, 0, :].reshape(b, hkv, group, d)
+        # group the q heads under their kv head: [b, hkv, group, t, d]
+        qg = (q * scale).reshape(b, hkv, group, t, d)
         s = jnp.einsum(
-            "bhgd,bhkd->bhgk", qg, ck.value
-        ).astype(jnp.float32)  # [b, hkv, group, L]
-        k_pos = jnp.arange(self.cache_len)
-        valid = k_pos <= idx
+            "bhgtd,bhkd->bhgtk", qg, ck.value
+        ).astype(jnp.float32)  # [b, hkv, group, t, L]
+        k_pos = jnp.arange(self.cache_len)[None, :]
+        row_pos = (idx + jnp.arange(t))[:, None]
+        valid = k_pos <= row_pos  # [t, L]
         if self.window:
-            valid = valid & (k_pos > idx - self.window)
-        s = jnp.where(valid[None, None, None, :], s, NEG_INF)
+            valid = valid & (k_pos > row_pos - self.window)
+        s = jnp.where(valid[None, None, None], s, NEG_INF)
         w = jax.nn.softmax(s, axis=-1).astype(dtype)
-        out = jnp.einsum("bhgk,bhkd->bhgd", w, cv.value)
+        out = jnp.einsum("bhgtk,bhkd->bhgtd", w, cv.value)
         # (hkv, group) flattens back to h in q's head order
-        out = out.reshape(b, 1, h * d)
+        out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h * d)
         return self._proj(out, e)
 
 
@@ -384,12 +389,13 @@ class TransformerLM(nn.Module):
         decode_pos = None
         if decode:
             # THE decode position counter: every layer's cache write,
-            # RoPE rotation and the wpe lookup read this one value
+            # RoPE rotation and the wpe lookup read this one value.
+            # Advances by the chunk width (tokens [b, t], t >= 1).
             pi = self.variable(
                 "cache", "pos", lambda: jnp.zeros((), jnp.int32)
             )
             decode_pos = pi.value
-            pi.value = decode_pos + 1
+            pi.value = decode_pos + tokens.shape[1]
         elif prefill:
             # Batched prefill: one causal forward fills the per-layer
             # caches for positions [0, prefill length); the counter is
@@ -407,7 +413,9 @@ class TransformerLM(nn.Module):
                 name="wpe",
             )
             if decode:
-                x = x + wpe(decode_pos[None, None])
+                x = x + wpe(
+                    (decode_pos + jnp.arange(tokens.shape[1]))[None, :]
+                )
             elif positions is not None:
                 x = x + wpe(positions)  # [b, l] packed offsets
             else:

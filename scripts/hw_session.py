@@ -332,6 +332,14 @@ def main():
         # KV-cached beam search: per-step cache gathers at width 4
         ("decode_beam4", {"EDL_BENCH_MODEL": "decode",
                           "EDL_BENCH_EXTRA_PARAMS": "beams=4"}),
+        # speculative decode mechanics: ceiling (target drafts itself,
+        # ~100% acceptance) and floor (random 2-layer draft)
+        ("decode_spec_ceiling",
+         {"EDL_BENCH_MODEL": "decode",
+          "EDL_BENCH_EXTRA_PARAMS": "spec_gamma=4; spec_draft_layers=0"}),
+        ("decode_spec_draft2",
+         {"EDL_BENCH_MODEL": "decode",
+          "EDL_BENCH_EXTRA_PARAMS": "spec_gamma=4"}),
         ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
         # sequence-packing overhead: same shapes, 4 segments per row
         # through the kernels' segment masks (vs the plain flagship)

@@ -736,3 +736,63 @@ def test_flash_rectangular_pair_gradients():
         assert np.isfinite(np.asarray(g_)).all()
     masked_dq = np.asarray(dq)[:, :, np.asarray(q_seg[0]) == 9]
     np.testing.assert_array_equal(masked_dq, 0.0)
+
+
+def test_flash_config_fuzz_vs_oracle():
+    """Seeded sweep across the kernel config lattice (causal x window x
+    GQA x segments x block sizes x rectangular shapes) in interpret
+    mode vs the naive oracle — forward always, gradients on a subset.
+    Catches interaction bugs no single-feature test exercises."""
+    rs = np.random.RandomState(123)
+    for trial in range(10):
+        causal = bool(rs.randint(2))
+        lq = int(rs.choice([16, 32, 48]))
+        rect = (not causal) and rs.randint(2)
+        lk = int(rs.choice([16, 32])) if rect else lq
+        h = int(rs.choice([2, 4]))
+        hkv = int(rs.choice([g for g in (1, 2, h) if h % g == 0]))
+        window = None
+        if not rect and rs.randint(2):
+            window = int(rs.choice([4, 8, lq]))
+        use_seg = bool(rs.randint(2)) and not rect
+        bq = int(rs.choice([8, 16, 32]))
+        bk = int(rs.choice([8, 16]))
+        q = jnp.asarray(rs.randn(2, h, lq, 128).astype(np.float32) * .3)
+        k = jnp.asarray(
+            rs.randn(2, hkv, lk, 128).astype(np.float32) * .3)
+        v = jnp.asarray(
+            rs.randn(2, hkv, lk, 128).astype(np.float32) * .3)
+        seg = None
+        if use_seg:
+            cuts = np.sort(rs.choice(np.arange(2, lq - 1), size=2,
+                                     replace=False))
+            s = np.zeros((2, lq), np.int32)
+            s[:, cuts[0]:cuts[1]] = 1
+            s[:, cuts[1]:] = 2
+            seg = jnp.asarray(s)
+        tag = ("trial=%d causal=%s lq=%d lk=%d hkv=%d window=%s "
+               "seg=%s bq=%d bk=%d"
+               % (trial, causal, lq, lk, hkv, window, use_seg, bq, bk))
+        ref = naive_attention(q, k, v, causal=causal, window=window,
+                              segments=seg)
+        out = flash_attention(q, k, v, causal=causal, window=window,
+                              block_q=bq, block_k=bk, segments=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5, err_msg=tag)
+        if trial % 3 == 0:
+            def lf(q, k, v):
+                return (flash_attention(
+                    q, k, v, causal=causal, window=window,
+                    block_q=bq, block_k=bk, segments=seg) ** 2).sum()
+
+            def lr(q, k, v):
+                return (naive_attention(
+                    q, k, v, causal=causal, window=window,
+                    segments=seg) ** 2).sum()
+
+            gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+            for a, b_ in zip(gf, gr):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b_), rtol=1e-3,
+                    atol=1e-4, err_msg=tag)

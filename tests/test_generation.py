@@ -379,3 +379,98 @@ def test_beam_search_kv_matches_full_forward():
                              num_beams=3, use_cache=True)
     )[0]
     np.testing.assert_array_equal(out, (3 + np.arange(12)) % 8)
+
+
+def test_speculative_matches_target_greedy():
+    """Speculative decoding must reproduce the TARGET model's greedy
+    tokens EXACTLY, independent of draft quality (an untrained draft
+    just accepts less) and of gamma."""
+    from elasticdl_tpu.api.generation import speculative_generate
+
+    target = _trainer()
+    t_state = target.init_state(_cycle_batch())
+    for step in range(200):
+        t_state, loss = target.train_step(t_state,
+                                          _cycle_batch(seed=step))
+    assert float(loss) < 0.2
+
+    # draft (a): untrained (worst case — rejects constantly)
+    draft_cold = Trainer(
+        load_model_spec_from_module(zoo),
+        mesh=mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        model_params=PARAMS,
+    )
+    d_cold = draft_cold.init_state(_cycle_batch())
+    # draft (b): trained (best case — accepts almost everything)
+    draft_hot = Trainer(
+        load_model_spec_from_module(zoo),
+        mesh=mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        model_params=PARAMS,
+    )
+    d_hot = draft_hot.init_state(_cycle_batch(seed=1))
+    for step in range(200):
+        d_hot, _ = draft_hot.train_step(d_hot,
+                                        _cycle_batch(seed=step + 7))
+
+    prompt = np.asarray([[1, 2, 3], [4, 5, 6]], np.int32)
+    ref = np.asarray(
+        autoregressive_generate(target, t_state, prompt, 6,
+                                use_cache=True)
+    )
+    for d_trainer, d_state, name in (
+        (draft_cold, d_cold, "cold"),
+        (draft_hot, d_hot, "hot"),
+    ):
+        for gamma in (1, 3, 5):
+            got = np.asarray(
+                speculative_generate(target, t_state, d_trainer,
+                                     d_state, prompt, 6, gamma=gamma)
+            )
+            np.testing.assert_array_equal(
+                ref, got, err_msg="%s gamma=%d" % (name, gamma)
+            )
+
+
+def test_speculative_validation():
+    from elasticdl_tpu.api.generation import speculative_generate
+
+    target = _trainer()
+    t_state = target.init_state(_cycle_batch())
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(target, t_state, target, t_state, prompt,
+                             4, gamma=0)
+    with pytest.raises(ValueError, match="verify chunk"):
+        # 3 + 12 + 8 - 1 > 16
+        speculative_generate(target, t_state, target, t_state, prompt,
+                             12, gamma=8)
+
+
+def test_speculative_draft_swap_not_cached_together():
+    """Two drafts with different architectures against one target must
+    not share a compiled fn (the executable closes over the draft
+    module); output stays exact for both."""
+    from elasticdl_tpu.api.generation import speculative_generate
+
+    target = _trainer()
+    t_state = target.init_state(_cycle_batch())
+    for step in range(200):
+        t_state, _ = target.train_step(t_state, _cycle_batch(seed=step))
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    ref = np.asarray(
+        autoregressive_generate(target, t_state, prompt, 5,
+                                use_cache=True)
+    )
+    for dp in (PARAMS, PARAMS.replace("num_layers=1", "num_layers=2")):
+        d_tr = Trainer(
+            load_model_spec_from_module(zoo),
+            mesh=mesh_lib.build_mesh({"dp": 1},
+                                     devices=jax.devices()[:1]),
+            model_params=dp,
+        )
+        d_st = d_tr.init_state(_cycle_batch())
+        got = np.asarray(
+            speculative_generate(target, t_state, d_tr, d_st, prompt,
+                                 5, gamma=3)
+        )
+        np.testing.assert_array_equal(ref, got, err_msg=dp)

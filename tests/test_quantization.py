@@ -125,3 +125,24 @@ def test_quantized_state_checkpoint_roundtrip(tmp_path):
     int8_keys = [k for k in flat if "__w8__" in k]
     assert int8_keys
     assert all(flat[k].dtype == np.int8 for k in int8_keys)
+
+
+def test_quantized_speculative_decode():
+    """Speculative decoding with an int8 target (and float draft) must
+    equal the float target's greedy output — the serving combo of the
+    two features."""
+    from elasticdl_tpu.api.generation import speculative_generate
+
+    target, t_state = _trained_trainer()
+    draft, d_state = _trained_trainer(steps=200)
+    prompt = np.asarray([[3, 4, 5]], np.int32)
+    ref = np.asarray(
+        autoregressive_generate(target, t_state, prompt, 6,
+                                use_cache=True)
+    )
+    qt = t_state.replace(params=quantize_params(t_state.params))
+    got = np.asarray(
+        speculative_generate(target, qt, draft, d_state, prompt, 6,
+                             gamma=3)
+    )
+    np.testing.assert_array_equal(ref, got)
