@@ -660,10 +660,16 @@ def speculative_generate(trainer, state, draft_trainer, draft_state,
     qz = is_quantized(state.params)
     d_qz = is_quantized(draft_state.params)
     # the compiled fn closes over the DRAFT module too — same target
-    # with a different draft must not reuse it
+    # with a different draft must not reuse it. The cache entry holds a
+    # STRONG reference to the draft trainer so its id cannot be
+    # recycled onto a new object while the entry lives (the LRU bounds
+    # the lifetime).
     key = ("spec", b, total, gamma, p_pad, qz, d_qz,
            id(draft_trainer))
-    fn = cache.get(key)
+    fn = None
+    entry = cache.get(key)
+    if entry is not None:
+        fn, _draft_ref = entry
     if fn is None:
         kv_shapes = _kv_shapes_for(cache, model, b)
         # draft cache shapes live under the draft trainer's own cache
@@ -772,7 +778,7 @@ def speculative_generate(trainer, state, draft_trainer, draft_state,
             return tokens
 
         fn = jax.jit(run)
-        cache[key] = fn
+        cache[key] = (fn, draft_trainer)
 
     variables = {"params": state.params, **state.model_state}
     d_variables = {

@@ -40,6 +40,7 @@ class LocalExecutor(object):
         keep_checkpoint_max=0,
         checkpoint_dir_for_init=None,
         grad_accum_steps=1,
+        trainable_pattern=None,
     ):
         self.spec = model_spec
         self.minibatch_size = minibatch_size
@@ -54,6 +55,7 @@ class LocalExecutor(object):
         self.trainer = Trainer(
             model_spec, mesh=mesh, model_params=model_params, seed=seed,
             grad_accum_steps=grad_accum_steps,
+            trainable_pattern=trainable_pattern,
         )
         from elasticdl_tpu.embedding.host_bridge import attach_from_spec
 

@@ -63,10 +63,13 @@ def _to_numpy(leaf):
     return np.asarray(leaf)
 
 
-def _unflatten_into(state, flat):
+def _unflatten_into(state, flat, strict=True):
     """Rebuild a pytree shaped like `state` from {keystr: ndarray}, keeping
     each leaf's dtype and the target's sharding (device_put against the
-    existing leaf's sharding when present)."""
+    existing leaf's sharding when present). strict=False keeps the
+    target's freshly-initialized leaf for missing keys — the warm-start
+    path (e.g. restoring a dense pretraining checkpoint into a model
+    with net-new LoRA adapter params)."""
     leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
     new_leaves = []
     missing = []
@@ -83,14 +86,20 @@ def _unflatten_into(state, flat):
         if isinstance(leaf, jax.Array):
             arr = jax.device_put(arr, leaf.sharding)
         new_leaves.append(arr)
-    if missing:
+    if missing and strict:
         raise ValueError(
             "Checkpoint is missing %d leaves, e.g. %s. A common cause is "
             "a changed optimizer-state layout — e.g. an embedding table "
             "crossing the sparse-grad threshold (embedding/sparse_update"
             ".py) between save and restore; pin sparse_grads on the layer "
-            "to restore older checkpoints."
+            "to restore older checkpoints. Pass strict=False to warm-"
+            "start: missing leaves keep their fresh initialization."
             % (len(missing), missing[:3])
+        )
+    if missing:
+        logger.info(
+            "warm start: %d leaves kept their fresh init (e.g. %s)",
+            len(missing), missing[:3],
         )
     return treedef.unflatten(new_leaves)
 
@@ -394,15 +403,19 @@ def load_checkpoint(checkpoint_dir, version=None):
     return flat, version
 
 
-def restore_state_from_flat(state, flat):
+def restore_state_from_flat(state, flat, strict=True):
     """Rebuild a TrainState-shaped pytree from an already-loaded flat
     checkpoint dict, re-sharded to `state`'s own shardings. Extra keys
-    (e.g. host-embedding engine state) are ignored here."""
-    return _unflatten_into(state, flat)
+    (e.g. host-embedding engine state) are ignored here. strict=False
+    warm-starts: leaves absent from the checkpoint keep their fresh
+    initialization (dense checkpoint -> LoRA model, new heads, ...)."""
+    return _unflatten_into(state, flat, strict=strict)
 
 
-def restore_state_from_checkpoint(state, checkpoint_dir, version=None):
+def restore_state_from_checkpoint(state, checkpoint_dir, version=None,
+                                  strict=True):
     """Rebuild a TrainState-shaped pytree from a checkpoint, re-sharded to
-    `state`'s own shardings. Returns (new_state, restored_version)."""
+    `state`'s own shardings. Returns (new_state, restored_version).
+    strict=False: see restore_state_from_flat (warm start)."""
     flat, version = load_checkpoint(checkpoint_dir, version)
-    return restore_state_from_flat(state, flat), version
+    return restore_state_from_flat(state, flat, strict=strict), version

@@ -75,7 +75,8 @@ class Trainer(object):
 
     def __init__(self, model_spec, mesh=None, model_params="", seed=0,
                  compute_dtype=None, callbacks=None,
-                 embedding_partition_threshold=None, grad_accum_steps=1):
+                 embedding_partition_threshold=None, grad_accum_steps=1,
+                 trainable_pattern=None):
         self.spec = model_spec
         self.model = model_spec.create_model(model_params)
         from elasticdl_tpu.embedding.sparse_optim import make_row_sparse
@@ -105,6 +106,14 @@ class Trainer(object):
         # reference likewise pushed embedding grads through the
         # OptimizerWrapper on every report).
         self.grad_accum_steps = max(1, int(grad_accum_steps))
+        # Fine-tuning: regex over '/'-joined param paths (e.g.
+        # "head|block_7" trains the LM head and the last block).
+        # Non-matching params are FROZEN via optax.set_to_zero inside
+        # the transform — not by zeroing gradients, which would still
+        # let decoupled weight decay (adamw) move frozen weights.
+        # Applies to the dense optimizer path; sparse-row/host-spill
+        # embedding engines keep their own update schedule.
+        self.trainable_pattern = trainable_pattern
         # Filled by init_state once the model structure is known:
         self._sparse_paths = {}
         self._train_tx = None
@@ -206,6 +215,33 @@ class Trainer(object):
         self._train_tx = sparse_update.split_dense_tx(
             self.tx, set(self._sparse_paths)
         )
+        if self.trainable_pattern:
+            # the freeze wraps the DENSE transform only; sparse-row and
+            # host-spill embedding tiers run their own update engines
+            # and would silently keep training — refuse instead of
+            # breaking the "non-matching params do not move" contract
+            import re as _re
+
+            _rex = _re.compile(self.trainable_pattern)
+            escaped = [
+                p for p in self._sparse_paths
+                if not _rex.search("/".join(str(k) for k in p))
+            ]
+            if escaped or self._host_manager is not None:
+                raise NotImplementedError(
+                    "trainable_pattern freezes the dense optimizer "
+                    "path only; %s run their own update engines. "
+                    "Match them in the pattern, or disable the tier "
+                    "(sparse_grads=False / no host_embeddings) for "
+                    "fine-tuning."
+                    % (
+                        "host-spill tables" if self._host_manager
+                        else "sparse-row tables %s" % (escaped,)
+                    )
+                )
+            self._train_tx = _freeze_except(
+                self._train_tx, self.trainable_pattern
+            )
         if self.grad_accum_steps > 1:
             # Every tier shares ONE schedule (k microbatches -> one
             # applied update): the dense tier through optax.MultiSteps
@@ -622,6 +658,44 @@ class Trainer(object):
             preds = trim(preds)
         labels = trim(labels) if labels is not None else None
         return preds, labels
+
+
+def _freeze_except(tx, pattern):
+    """Wrap `tx` so only params whose '/'-joined path matches the regex
+    train; everything else gets optax.set_to_zero() (true freezing —
+    no optimizer-side movement, including adamw's decoupled weight
+    decay). Labels are derived from the params pytree at init time, so
+    any model structure works."""
+    import re
+
+    import optax
+
+    rex = re.compile(pattern)
+
+    def labels(params):
+        def one(path, _):
+            name = "/".join(
+                str(getattr(k, "key", k)) for k in path
+            )
+            return "train" if rex.search(name) else "freeze"
+
+        out = jax.tree_util.tree_map_with_path(one, params)
+        flat = jax.tree_util.tree_leaves(out)
+        n_train = sum(1 for v in flat if v == "train")
+        logger.info(
+            "trainable_pattern %r: %d/%d param tensors train",
+            pattern, n_train, len(flat),
+        )
+        if n_train == 0:
+            logger.warning(
+                "trainable_pattern %r matches NOTHING — every "
+                "parameter is frozen and training is a no-op", pattern,
+            )
+        return out
+
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()}, labels
+    )
 
 
 def _apply_lr_scheduler(tx, callbacks):

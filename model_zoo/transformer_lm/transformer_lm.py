@@ -72,6 +72,30 @@ class CausalSelfAttention(nn.Module):
     # the qkv projection and the decode KV cache by num_heads/kv_heads;
     # the Pallas flash kernels consume the grouped layout natively.
     num_kv_heads: int = 0
+    # LoRA (attention-only): rank-r adapter branches on the qkv and
+    # output projections. The base Dense param paths are UNCHANGED, so
+    # a dense pretraining checkpoint warm-starts this model
+    # (restore strict=False); lora_b is zero-init, so the warm-started
+    # model's logits equal the dense model's exactly until the
+    # adapters train. Combine with trainable_pattern="lora" to train
+    # adapters only.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+
+    def _lora_branch(self, x, features, name):
+        """(x @ A @ B) * alpha/rank — A lecun-init, B zeros."""
+        a = self.param(
+            "%s_lora_a" % name, nn.initializers.lecun_normal(),
+            (x.shape[-1], self.lora_rank),
+        )
+        b = self.param(
+            "%s_lora_b" % name, nn.initializers.zeros,
+            (self.lora_rank, features),
+        )
+        dtype = self.dtype or x.dtype
+        return (
+            (x @ a.astype(dtype)) @ b.astype(dtype)
+        ) * (self.lora_alpha / self.lora_rank)
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
@@ -92,6 +116,8 @@ class CausalSelfAttention(nn.Module):
                 else nn.initializers.lecun_normal()
             ),
         )(x)
+        if self.lora_rank:
+            qkv = qkv + self._lora_branch(x, (h + 2 * hkv) * d, "qkv")
         q = qkv[..., : h * d].reshape(b, l, h, d).transpose(0, 2, 1, 3)
         k = (
             qkv[..., h * d:(h + hkv) * d]
@@ -207,13 +233,16 @@ class CausalSelfAttention(nn.Module):
         return self._proj(out, e)
 
     def _proj(self, out, e):
-        return nn.Dense(
+        y = nn.Dense(
             e, use_bias=False, dtype=self.dtype, name="proj",
             kernel_init=(
                 _tp_dense_init(0) if self.tp_shard
                 else nn.initializers.lecun_normal()
             ),
         )(out)
+        if self.lora_rank:
+            y = y + self._lora_branch(out, e, "proj")
+        return y
 
     def _decode_step(self, q, k, v, e, decode_pos):
         """Chunked decode against the KV cache: q is [b, h, t, d],
@@ -287,6 +316,8 @@ class Block(nn.Module):
     window: int = 0
     cache_len: int = 0
     num_kv_heads: int = 0  # grouped-query attention (0 = MHA)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
@@ -299,7 +330,9 @@ class Block(nn.Module):
             tp_shard=self.tp_shard, causal=self.causal,
             use_rope=self.use_rope, window=self.window,
             cache_len=self.cache_len,
-            num_kv_heads=self.num_kv_heads, name="attn",
+            num_kv_heads=self.num_kv_heads,
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+            name="attn",
         )(y, training, decode=decode, decode_pos=decode_pos,
           prefill=prefill, segments=segments, positions=positions)
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -362,6 +395,8 @@ class TransformerLM(nn.Module):
     tp_shard: bool = True  # annotate kernels over the tp mesh axis
     fused_head: bool = False  # stream the LM head inside the loss
     num_kv_heads: int = 0  # grouped-query attention (0 = MHA)
+    lora_rank: int = 0  # attention-LoRA adapters (0 = off)
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, features, training=False, decode=False,
@@ -434,7 +469,9 @@ class TransformerLM(nn.Module):
                 use_rope=self.pos_emb == "rope",
                 window=self.attn_window,
                 cache_len=self.seq_len,
-                num_kv_heads=self.num_kv_heads, name="block_%d" % i,
+                num_kv_heads=self.num_kv_heads,
+                lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                name="block_%d" % i,
             )(x, training, decode=decode, decode_pos=decode_pos,
               prefill=prefill, segments=segments, positions=positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)

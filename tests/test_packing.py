@@ -220,8 +220,6 @@ def test_packed_zoo_family_local_executor(tmp_path):
                                records_per_file=32, vocab_size=16,
                                cyclic=True, seed=9)
     spec = load_model_spec_from_module(packed_zoo)
-    spec.model_params = ("vocab_size=16; seq_len=128; embed_dim=64; "
-                         "num_heads=2; num_layers=1")
     executor = LocalExecutor(
         spec,
         training_data=train_dir,
@@ -229,6 +227,8 @@ def test_packed_zoo_family_local_executor(tmp_path):
         minibatch_size=4,
         num_epochs=4,
         records_per_task=48,
+        model_params=("vocab_size=16; seq_len=128; embed_dim=64; "
+                      "num_heads=2; num_layers=1"),
     )
     state, metrics = executor.run()
     losses = np.asarray(executor.losses)
