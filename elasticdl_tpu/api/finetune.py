@@ -1,0 +1,84 @@
+"""Fine-tuning utilities: LoRA adapter merging.
+
+The training-side pieces live elsewhere (Trainer ``trainable_pattern``
+for optimizer-level freezing; ``lora_rank`` on the transformer_lm
+family for the adapter branches; checkpoint ``strict=False`` for
+dense-checkpoint warm starts). This module closes the loop for
+serving: fold trained adapters back into the base kernels so the
+deployed model is a PLAIN dense model again — no extra matmuls per
+step, loadable by a ``lora_rank=0`` model, quantizable, exportable.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def merge_lora(params, model=None, lora_alpha=None):
+    """Fold ``*_lora_a`` / ``*_lora_b`` adapter pairs into their base
+    kernels: ``W += (A @ B) * alpha/rank``, then drop the adapter
+    params. The result matches a ``lora_rank=0`` model's param
+    structure, and its outputs equal the adapter model's to float
+    tolerance (``(x@A)@B*scale`` vs ``x@(A@B*scale)`` reassociation).
+
+    Pass ``model`` (the flax module, e.g. ``trainer.model``) so alpha
+    is read from its ``lora_alpha`` — a silently mismatched alpha
+    would halve/double every delta; ``lora_alpha`` overrides
+    explicitly. One of the two must be given.
+
+    Math runs in jnp, so sharded ``jax.Array`` leaves stay jax arrays
+    with their committed placement (under multi-host SPMD, call on
+    every host like any other computation). Returns a new pytree; the
+    input is not mutated. Raises if an adapter pair has no base kernel
+    sibling (``<name>/kernel``) to merge into.
+    """
+    if lora_alpha is None:
+        lora_alpha = getattr(model, "lora_alpha", None)
+        if lora_alpha is None:
+            raise ValueError(
+                "pass model= (to read its lora_alpha) or an explicit "
+                "lora_alpha — a mismatched alpha merges silently wrong"
+            )
+    if model is not None and lora_alpha != getattr(
+            model, "lora_alpha", lora_alpha):
+        raise ValueError(
+            "explicit lora_alpha %r contradicts model.lora_alpha %r"
+            % (lora_alpha, model.lora_alpha)
+        )
+
+    def visit(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        adapters = {}
+        for key, val in node.items():
+            if key.endswith("_lora_a") or key.endswith("_lora_b"):
+                base = key[: -len("_lora_a")]
+                adapters.setdefault(base, {})[key[-1]] = val
+            else:
+                out[key] = visit(val)
+        for base, ab in adapters.items():
+            if sorted(ab) != ["a", "b"]:
+                raise ValueError(
+                    "incomplete LoRA pair for %r: found only %s"
+                    % (base, sorted(ab))
+                )
+            target = out.get(base)
+            if not isinstance(target, dict) or "kernel" not in target:
+                raise ValueError(
+                    "no base kernel %s/kernel to merge adapters into"
+                    % base
+                )
+            a = jnp.asarray(ab["a"], jnp.float32)
+            b = jnp.asarray(ab["b"], jnp.float32)
+            rank = a.shape[-1]
+            kernel = target["kernel"]
+            delta = (a @ b) * (float(lora_alpha) / rank)
+            merged = (
+                jnp.asarray(kernel, jnp.float32) + delta
+            ).astype(kernel.dtype)
+            if isinstance(kernel, jax.Array):
+                merged = jax.device_put(merged, kernel.sharding)
+            out[base] = dict(target, kernel=merged)
+        return out
+
+    return visit(params)

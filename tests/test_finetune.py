@@ -87,6 +87,10 @@ def test_finetune_learns_with_frozen_backbone():
 
 
 def test_match_nothing_warns_and_freezes_all(caplog):
+    from elasticdl_tpu.common.log_utils import default_logger
+
+    # the project logger does not propagate to root; capture directly
+    default_logger.addHandler(caplog.handler)
     mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
     trainer = Trainer(
         load_model_spec_from_module(zoo), mesh=mesh,
@@ -97,7 +101,10 @@ def test_match_nothing_warns_and_freezes_all(caplog):
     for i in range(3):
         state, _ = trainer.train_step(state, _batch(seed=i))
     after = _flat(state.params)
+    default_logger.removeHandler(caplog.handler)
     assert all(np.array_equal(before[k], after[k]) for k in before)
+    assert any("matches NOTHING" in r.getMessage()
+               for r in caplog.records)
 
 
 def test_lora_warm_start_and_adapter_training(tmp_path):
@@ -206,3 +213,48 @@ def test_pattern_refuses_unfrozen_sparse_tier():
     state = trainer2.init_state(batch)
     state, loss = trainer2.train_step(state, batch)
     assert np.isfinite(float(loss))
+
+
+def test_merge_lora_matches_adapter_model():
+    """Folding trained adapters into the base kernels yields a PLAIN
+    dense model with the same outputs — the serving export."""
+    from elasticdl_tpu.api.finetune import merge_lora
+
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    lora = Trainer(
+        load_model_spec_from_module(zoo), mesh=mesh,
+        model_params=PARAMS + "; lora_rank=4",
+        trainable_pattern="lora",
+    )
+    state = lora.init_state(_batch())
+    for i in range(30):
+        state, _ = lora.train_step(state, _batch(seed=i))
+    merged = merge_lora(state.params, model=lora.model)
+    # structure now matches the dense model exactly
+    dense = Trainer(load_model_spec_from_module(zoo), mesh=mesh,
+                    model_params=PARAMS)
+    d_state = dense.init_state(_batch())
+    assert (
+        jax.tree_util.tree_structure(jax.tree.map(lambda x: 0, merged))
+        == jax.tree_util.tree_structure(
+            jax.tree.map(lambda x: 0, d_state.params))
+    )
+    feats, _ = _batch(seed=77)
+    out_lora = lora.model.apply({"params": state.params}, feats)
+    out_merged = dense.model.apply({"params": merged}, feats)
+    np.testing.assert_allclose(np.asarray(out_lora),
+                               np.asarray(out_merged),
+                               rtol=2e-5, atol=2e-6)
+    # incomplete pair / missing base validations
+    import pytest as _pytest
+    bad = {"attn": {"qkv_lora_a": np.zeros((4, 2), np.float32)}}
+    with _pytest.raises(ValueError, match="incomplete"):
+        merge_lora(bad, lora_alpha=16.0)
+    bad2 = {"qkv_lora_a": np.zeros((4, 2), np.float32),
+            "qkv_lora_b": np.zeros((2, 8), np.float32)}
+    with _pytest.raises(ValueError, match="base kernel"):
+        merge_lora(bad2, lora_alpha=16.0)
+    with _pytest.raises(ValueError, match="lora_alpha"):
+        merge_lora(state.params)
+    with _pytest.raises(ValueError, match="contradicts"):
+        merge_lora(state.params, model=lora.model, lora_alpha=32.0)
