@@ -9,6 +9,8 @@ deployed model is a PLAIN dense model again — no extra matmuls per
 step, loadable by a ``lora_rank=0`` model, quantizable, exportable.
 """
 
+from collections.abc import Mapping
+
 import jax
 import jax.numpy as jnp
 
@@ -46,7 +48,9 @@ def merge_lora(params, model=None, lora_alpha=None):
         )
 
     def visit(node):
-        if not isinstance(node, dict):
+        # Mapping covers flax FrozenDict too — a silent no-op on a
+        # frozen tree would ship unmerged weights; plain dicts out
+        if not isinstance(node, Mapping):
             return node
         out = {}
         adapters = {}

@@ -53,6 +53,8 @@ class BertEncoder(nn.Module):
     dtype: object = None
     attn_impl: str = "auto"
     tp_shard: bool = True
+    lora_rank: int = 0  # attention-LoRA adapters (0 = off)
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -80,7 +82,9 @@ class BertEncoder(nn.Module):
             x = Block(
                 self.num_heads, head_dim, dtype=self.dtype,
                 attn_impl=self.attn_impl, tp_shard=self.tp_shard,
-                causal=False, name="layer_%d" % i,
+                causal=False,
+                lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                name="layer_%d" % i,
             )(x, training, segments=segments, positions=positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         # MLM head: transform + vocab projection (BERT's cls/predictions)

@@ -91,17 +91,20 @@ def test_match_nothing_warns_and_freezes_all(caplog):
 
     # the project logger does not propagate to root; capture directly
     default_logger.addHandler(caplog.handler)
-    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
-    trainer = Trainer(
-        load_model_spec_from_module(zoo), mesh=mesh,
-        model_params=PARAMS, trainable_pattern="no_such_param",
-    )
-    state = trainer.init_state(_batch())
-    before = _flat(state.params)
-    for i in range(3):
-        state, _ = trainer.train_step(state, _batch(seed=i))
-    after = _flat(state.params)
-    default_logger.removeHandler(caplog.handler)
+    try:
+        mesh = mesh_lib.build_mesh({"dp": 1},
+                                   devices=jax.devices()[:1])
+        trainer = Trainer(
+            load_model_spec_from_module(zoo), mesh=mesh,
+            model_params=PARAMS, trainable_pattern="no_such_param",
+        )
+        state = trainer.init_state(_batch())
+        before = _flat(state.params)
+        for i in range(3):
+            state, _ = trainer.train_step(state, _batch(seed=i))
+        after = _flat(state.params)
+    finally:
+        default_logger.removeHandler(caplog.handler)
     assert all(np.array_equal(before[k], after[k]) for k in before)
     assert any("matches NOTHING" in r.getMessage()
                for r in caplog.records)
@@ -258,3 +261,58 @@ def test_merge_lora_matches_adapter_model():
         merge_lora(state.params)
     with _pytest.raises(ValueError, match="contradicts"):
         merge_lora(state.params, model=lora.model, lora_alpha=32.0)
+
+
+def test_bert_lora_adapters_train():
+    """The encoder family takes LoRA too (Block is shared): adapter
+    params exist, the zero-init merge reproduces the model, and under
+    trainable_pattern='lora' ONLY the adapters move in training."""
+    import optax
+
+    from elasticdl_tpu.api.finetune import merge_lora
+    from elasticdl_tpu.common.model_utils import ModelSpec
+    from model_zoo.bert.bert import BertEncoder, loss as bert_loss
+
+    bert_params = ("vocab_size=32; seq_len=16; embed_dim=32; "
+                   "num_heads=2; num_layers=1; tp_shard=False; "
+                   "lora_rank=4")
+    spec = ModelSpec(
+        model_fn=lambda **kw: BertEncoder(**kw),
+        dataset_fn=lambda ds, mode, meta: ds,
+        loss=bert_loss,
+        optimizer=lambda: optax.adamw(1e-3, weight_decay=0.01),
+        eval_metrics_fn=lambda: {},
+    )
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(spec, mesh=mesh, model_params=bert_params,
+                      trainable_pattern="lora")
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 32, size=(4, 16)).astype(np.int32)
+    labels = np.where(rs.rand(4, 16) < 0.3, toks, -1).astype(np.int32)
+    batch = ({"tokens": jnp.asarray(toks)}, jnp.asarray(labels))
+    state = trainer.init_state(batch)
+    flat0 = _flat(state.params)
+    assert sum("lora" in k for k in flat0) == 4  # qkv+proj a/b
+    # zero-init adapters: merged dense encoder == lora encoder
+    dense = BertEncoder(vocab_size=32, seq_len=16, embed_dim=32,
+                        num_heads=2, num_layers=1, tp_shard=False)
+    merged = merge_lora(state.params, model=trainer.model)
+    out = trainer.model.apply({"params": state.params},
+                              {"tokens": batch[0]["tokens"]})
+    out_merged = dense.apply({"params": merged},
+                             {"tokens": batch[0]["tokens"]})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_merged),
+                               rtol=2e-5, atol=2e-6)
+    # adapter-only training
+    for i in range(5):
+        state, loss_val = trainer.train_step(state, batch)
+    flat1 = _flat(state.params)
+    for k in flat0:
+        if "lora" not in k:
+            np.testing.assert_array_equal(flat0[k], flat1[k],
+                                          err_msg="%s moved" % k)
+    assert any(
+        "lora" in k and not np.array_equal(flat0[k], flat1[k])
+        for k in flat0
+    )
+    assert np.isfinite(float(loss_val))

@@ -474,3 +474,65 @@ def test_speculative_draft_swap_not_cached_together():
                                  5, gamma=3)
         )
         np.testing.assert_array_equal(ref, got, err_msg=dp)
+
+
+def test_chunked_decode_fuzz_vs_sequential():
+    """Seeded sweep: decoding a chunk of t tokens must equal t
+    sequential single-token steps — logits AND caches — across random
+    (t, start offset, pos_emb, GQA, window) configs."""
+    import jax.numpy as jnp
+
+    from model_zoo.transformer_lm.transformer_lm import TransformerLM
+
+    rs = np.random.RandomState(77)
+    for trial in range(6):
+        extra = {}
+        if rs.randint(2):
+            extra["pos_emb"] = "rope"
+        if rs.randint(2):
+            extra["num_kv_heads"] = 1
+        if rs.randint(2):
+            extra["attn_window"] = int(rs.choice([3, 5]))
+        model = TransformerLM(vocab_size=16, seq_len=24, embed_dim=32,
+                              num_heads=2, num_layers=1,
+                              tp_shard=False, **extra)
+        start = int(rs.randint(0, 6))
+        t = int(rs.randint(2, 7))
+        toks = jnp.asarray(rs.randint(0, 16, size=(2, start + t)),
+                           jnp.int32)
+        variables = model.init(jax.random.PRNGKey(trial),
+                               {"tokens": toks[:, :1]},
+                               training=False, decode=True)
+        params = variables["params"]
+        kv = jax.tree.map(jnp.zeros_like, variables["cache"])
+        # consume the first `start` tokens one at a time (both paths)
+        for i in range(start):
+            _, upd = model.apply({"params": params, "cache": kv},
+                                 {"tokens": toks[:, i:i+1]},
+                                 training=False, decode=True,
+                                 mutable=["cache"])
+            kv = upd["cache"]
+        kv_seq = kv
+        seq_logits = []
+        for i in range(start, start + t):
+            lg, upd = model.apply({"params": params, "cache": kv_seq},
+                                  {"tokens": toks[:, i:i+1]},
+                                  training=False, decode=True,
+                                  mutable=["cache"])
+            kv_seq = upd["cache"]
+            seq_logits.append(np.asarray(lg[:, 0]))
+        lg_chunk, upd_chunk = model.apply(
+            {"params": params, "cache": kv},
+            {"tokens": toks[:, start:]},
+            training=False, decode=True, mutable=["cache"],
+        )
+        tag = "trial=%d %r start=%d t=%d" % (trial, extra, start, t)
+        np.testing.assert_allclose(
+            np.asarray(lg_chunk), np.stack(seq_logits, axis=1),
+            rtol=2e-5, atol=2e-6, err_msg=tag,
+        )
+        for a, b in zip(jax.tree.leaves(upd_chunk["cache"]),
+                        jax.tree.leaves(kv_seq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-6,
+                                       err_msg=tag)
