@@ -75,7 +75,8 @@ def main():
         except Exception as e:  # noqa: BLE001
             print("%-34s FAILED: %r" % (tag, repr(e)[:90]))
 
-    for bq, bk in [(128, 128), (128, 256), (128, 512), (256, 256),
+    for bq, bk in [(64, 128), (64, 256), (64, 512),
+                   (128, 128), (128, 256), (128, 512), (256, 256),
                    (256, 512), (512, 512), (256, 1024), (512, 1024)]:
         if s % bq or s % bk:
             continue
