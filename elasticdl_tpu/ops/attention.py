@@ -228,7 +228,8 @@ def naive_attention(q, k, v, causal=False, scale=None, window=None,
 
 
 def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
-                        window=None, with_lse=False, segments=None):
+                        window=None, with_lse=False, segments=None,
+                        pos_offset=0):
     """Online-softmax attention via lax.scan over key blocks: O(L) memory,
     differentiable, pure jnp (the fallback when the flash kernel can't
     run). Matches naive_attention to float tolerance. With
@@ -258,7 +259,7 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
     k_blocks = k.reshape(b, h, n_blocks, block, d)
     v_blocks = v.reshape(b, h, n_blocks, block, d)
     q_scaled = q * scale
-    q_pos = jnp.arange(lq)
+    q_pos = jnp.arange(lq) + pos_offset
 
     def step(carry, inputs):
         o, l, m = carry
@@ -382,30 +383,34 @@ def _dims(contract_a, contract_b):
     return (((contract_a,), (contract_b,)), ((), ()))
 
 
-def _block_run(qi, ki, block_q, block_k, causal, window):
+def _block_run(qi, ki, block_q, block_k, causal, window, pos_offset=0):
     """Whether query block qi overlaps key block ki under the causal
     and/or sliding-window mask — the block-skip invariant shared by the
     forward and both backward kernels. Causal: some q position >= the
     block's first k position. Window: some k position inside the newest
-    window of some q position (last k pos > first q pos - window)."""
+    window of some q position (last k pos > first q pos - window).
+    `pos_offset` (static) shifts the q positions — ring attention's
+    off-diagonal rotations run the window band at offset r*shard_len."""
     run = True
+    q0 = qi * block_q + pos_offset
     if causal:
-        run = qi * block_q + block_q - 1 >= ki * block_k
+        run = q0 + block_q - 1 >= ki * block_k
     if window is not None:
         # newest k in block inside some q's lookback window
-        back = ki * block_k + block_k - 1 > qi * block_q - window
+        back = ki * block_k + block_k - 1 > q0 - window
         run = jnp.logical_and(run, back) if causal else back
         if not causal:
             # oldest k in block inside some q's lookahead window
-            fwd = qi * block_q + block_q - 1 > ki * block_k - window
+            fwd = q0 + block_q - 1 > ki * block_k - window
             run = jnp.logical_and(run, fwd)
     return run
 
 
-def _block_mask(s, qi, ki, block_q, block_k, causal, window):
+def _block_mask(s, qi, ki, block_q, block_k, causal, window,
+                pos_offset=0):
     if not causal and window is None:
         return s
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+    q_pos = qi * block_q + pos_offset + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -423,7 +428,8 @@ def _block_mask(s, qi, ki, block_q, block_k, causal, window):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
-                  block_q, block_k, n_k, has_segs=False):
+                  block_q, block_k, n_k, has_segs=False,
+                  pos_offset=0):
     if has_segs:
         qseg_ref, kseg_ref = rest[:2]
         rest = rest[2:]
@@ -438,7 +444,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # skip key blocks fully outside the causal/window mask
-    run = _block_run(qi, ki, block_q, block_k, causal, window)
+    run = _block_run(qi, ki, block_q, block_k, causal, window,
+                     pos_offset)
 
     @pl.when(run)
     def _():
@@ -447,7 +454,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
             q, k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
-        s = _block_mask(s, qi, ki, block_q, block_k, causal, window)
+        s = _block_mask(s, qi, ki, block_q, block_k, causal, window,
+                        pos_offset)
         if has_segs:
             # sequence packing: mask cross-segment pairs.
             # qseg (block_q, 1) == kseg (1, block_k) broadcasts to s
@@ -564,7 +572,8 @@ def _seg_specs(block_q, block_k, heads, dkv=False, n_q=1):
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
-                   window=None, with_residuals=False, segments=None):
+                   window=None, with_residuals=False, segments=None,
+                   pos_offset=0):
     b, h, lq, d = q.shape
     hkv = k.shape[1]
     lk = k.shape[2]
@@ -583,6 +592,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         block_k=block_k,
         n_k=n_k,
         has_segs=segments is not None,
+        pos_offset=pos_offset,
     )
     in_specs = [
         _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
@@ -626,7 +636,8 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          *rest, scale, causal, window,
-                         block_q, block_k, n_k, has_segs=False):
+                         block_q, block_k, n_k, has_segs=False,
+                         pos_offset=0):
     if has_segs:
         qseg_ref, kseg_ref = rest[:2]
         rest = rest[2:]
@@ -638,7 +649,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = _block_run(qi, ki, block_q, block_k, causal, window)
+    run = _block_run(qi, ki, block_q, block_k, causal, window,
+                     pos_offset)
 
     @pl.when(run)
     def _():
@@ -646,7 +658,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = _block_mask(s, qi, ki, block_q, block_k, causal, window)
+        s = _block_mask(s, qi, ki, block_q, block_k, causal, window,
+                        pos_offset)
         if has_segs:
             s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
@@ -674,7 +687,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                           delta_ref, *rest, scale, causal, window,
                           block_q, block_k, n_q, n_q_total,
-                          has_segs=False):
+                          has_segs=False, pos_offset=0):
     if has_segs:
         qseg_ref, kseg_ref = rest[:2]
         rest = rest[2:]
@@ -691,7 +704,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = _block_run(qb, ki, block_q, block_k, causal, window)
+    run = _block_run(qb, ki, block_q, block_k, causal, window,
+                     pos_offset)
 
     @pl.when(run)
     def _():
@@ -699,7 +713,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = _block_mask(s, qb, ki, block_q, block_k, causal, window)
+        s = _block_mask(s, qb, ki, block_q, block_k, causal, window,
+                        pos_offset)
         if has_segs:
             s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
@@ -730,7 +745,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
                     block_k, interpret, window=None, grad_dtype=None,
-                    segments=None):
+                    segments=None, pos_offset=0):
     """Two-pass flash backward: a dq kernel parallel over query blocks
     and a dk/dv kernel parallel over key blocks, both recomputing P from
     the saved logsumexp (the standard flash-attention backward; one
@@ -786,7 +801,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal,
             window=window, block_q=block_q, block_k=block_k, n_k=n_k,
-            has_segs=segments is not None,
+            has_segs=segments is not None, pos_offset=pos_offset,
         ),
         grid=(bh, n_q, n_k),
         in_specs=dq_in_specs,
@@ -815,7 +830,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
             window=window, block_q=block_q, block_k=block_k, n_q=n_q,
             n_q_total=group * n_q,
-            has_segs=segments is not None,
+            has_segs=segments is not None, pos_offset=pos_offset,
         ),
         grid=(b * hkv, n_k, group * n_q),
         in_specs=dkv_in_specs,
@@ -957,7 +972,7 @@ def _flash_tiles(lq, lk, block_q, block_k):
 
 def attention_forward_lse(q, k, v, causal=False, scale=None,
                           block_q=None, block_k=None, interpret=None,
-                          segments=None):
+                          segments=None, pos_offset=0, window=None):
     """Attention returning (out, logsumexp): out [b,h,lq,d] in q.dtype,
     lse float32 [b,h,lq]. Pallas flash kernel when available and the
     sequence tiles, else the blockwise scan. k/v may carry fewer heads
@@ -977,9 +992,10 @@ def attention_forward_lse(q, k, v, causal=False, scale=None,
         qp, kp, vp = _pad_lanes([q, k, v], d)
         out, lse = _flash_forward(qp, kp, vp, causal, scale, bq, bk,
                                   interpret, with_residuals=True,
-                                  segments=segments)
+                                  segments=segments,
+                                  pos_offset=pos_offset, window=window)
         out, lse = out[..., :d], lse[..., 0]
-        if segments is not None:
+        if segments is not None or pos_offset:
             # a fully-segment-masked row leaves the kernel with
             # lse = -1e30 + log(lk) (p = exp(0) accumulates l = lk);
             # snap every +/-1e30-class value to exact _NEG_INF so the
@@ -989,8 +1005,10 @@ def attention_forward_lse(q, k, v, causal=False, scale=None,
                             _NEG_INF, lse)
         return out, lse
     out, lse = blockwise_attention(q, k, v, causal=causal, scale=scale,
-                                   with_lse=True, segments=segments)
-    if segments is not None:
+                                   with_lse=True, segments=segments,
+                                   pos_offset=pos_offset,
+                                   window=window)
+    if segments is not None or pos_offset:
         # blockwise's empty-row lse is m+log(1e-30) ~ -1e30 already;
         # normalize exactly for deterministic merges
         lse = jnp.where(jnp.abs(lse) > -_NEG_INF * 0.5,
@@ -1000,7 +1018,8 @@ def attention_forward_lse(q, k, v, causal=False, scale=None,
 
 def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
                            block_q=None, block_k=None, interpret=None,
-                           grad_dtype=None, segments=None):
+                           grad_dtype=None, segments=None,
+                           pos_offset=0, window=None):
     """(dq, dk, dv) for attention given a saved logsumexp.
 
     `lse` may be the GLOBAL logsumexp of a ring while k/v are one shard:
@@ -1024,6 +1043,7 @@ def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
         dq, dk, dv = _flash_backward(
             qp, kp, vp, outp, lse[..., None], gp, causal, scale, bq, bk,
             interpret, grad_dtype=grad_dtype, segments=segments,
+            pos_offset=pos_offset, window=window,
         )
         return dq[..., :d], dk[..., :d], dv[..., :d]
     f32 = jnp.float32
@@ -1031,9 +1051,15 @@ def attention_backward_lse(q, k, v, out, lse, g, causal=False, scale=None,
     k = expand_kv(k, q.shape[1])
     v = expand_kv(v, q.shape[1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32), k.astype(f32)) * scale
+    q_pos_d = jnp.arange(lq)[:, None] + pos_offset
+    k_pos_d = jnp.arange(lk)[None, :]
     if causal:
-        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
+        s = jnp.where((q_pos_d >= k_pos_d)[None, None], s, _NEG_INF)
+    if window is not None:
+        in_w = (q_pos_d - k_pos_d) < window
+        if not causal:
+            in_w = in_w & ((k_pos_d - q_pos_d) < window)
+        s = jnp.where(in_w[None, None], s, _NEG_INF)
     if segments is not None:
         q_seg, k_seg = segments
         s = jnp.where(

@@ -41,6 +41,28 @@ from elasticdl_tpu.ops.attention import (
 )
 
 
+def _win_live(shard_len, window, size):
+    """Number of statically-reachable windowed-rotation branches:
+    offset r is live iff its closest pair (q=first row, k=last key)
+    is inside the window, r*shard_len - (shard_len-1) < window. All
+    inputs are static python ints at trace time."""
+    return min(size, (window + shard_len - 2) // shard_len + 1)
+
+
+def _win_case(src, my, shard_len, window, size):
+    """Switch index for a windowed rotation: shard offset r = my - src
+    selects branch r; r < 0 (strictly newer -> causal skip) and band-
+    empty offsets map to index _win_live(...) (the skip branch).
+    Shared by the forward and backward rings so the skip invariant
+    cannot desynchronize gradients from outputs (cf. _ring_case)."""
+    off = my - src
+    live = _win_live(shard_len, window, size)
+    return jnp.where(
+        (off < 0) | (off * shard_len - (shard_len - 1) >= window),
+        live, off,
+    ).astype(jnp.int32)
+
+
 def _ring_case(src, my):
     """Causal visibility of kv shard `src` from query shard `my` with
     equal shard lengths: 0 = fully visible (src strictly older), 1 =
@@ -54,7 +76,7 @@ def _ring_case(src, my):
 
 
 def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
-                   block_k):
+                   block_k, window):
     """Ring forward: per rotation, the LOCAL flash kernel produces a
     normalized partial (o_i, lse_i) for the currently-held kv shard,
     merged online via lse_merge; kv shards rotate with ppermute. The full
@@ -94,9 +116,35 @@ def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
         return (jnp.zeros(qq.shape, f32),
                 jnp.full((b, h, lq), _NEG_INF, f32))
 
+    # windowed (causal-only, validated upstream): one statically-
+    # compiled branch per shard offset r — the global window mask of a
+    # rotation IS the local window mask with q positions shifted by
+    # r*shard_len (causal auto-holds for r >= 1; the symmetric lower
+    # bound is auto-true at positive offsets). `size` is a static int
+    # (psum of a literal), so the branch list is a python list; only
+    # the selector is traced.
+    def _win_branch(r):
+        def br(qq, kk, vv, kseg_cur):
+            o, lse = attention_forward_lse(
+                qq, kk, vv, causal=(r == 0), scale=scale,
+                block_q=block_q, block_k=block_k,
+                segments=_pair(kseg_cur), pos_offset=r * lq,
+                window=window,
+            )
+            return o.astype(f32), lse
+
+        return br
+
     def merge(o, lse, k_cur, v_cur, kseg_cur, i):
         # after i rotations device `my` holds the shard born on my+i
-        if causal:
+        if window is not None:
+            o_i, lse_i = jax.lax.switch(
+                _win_case((my + i) % size, my, lq, window, size),
+                [_win_branch(r)
+                 for r in range(_win_live(lq, window, size))] + [skip],
+                q, k_cur, v_cur, kseg_cur,
+            )
+        elif causal:
             o_i, lse_i = jax.lax.switch(
                 _ring_case((my + i) % size, my), (full, diag, skip),
                 q, k_cur, v_cur, kseg_cur,
@@ -132,22 +180,23 @@ def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _ring_attention(q, k, v, seg, axis_name, causal, scale, block_q,
-                    block_k):
+                    block_k, window):
     o, _ = _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale,
-                          block_q, block_k)
+                          block_q, block_k, window)
     return o
 
 
 def _ring_vjp_fwd(q, k, v, seg, axis_name, causal, scale, block_q,
-                  block_k):
+                  block_k, window):
     o, lse = _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale,
-                            block_q, block_k)
+                            block_q, block_k, window)
     return o, (q, k, v, seg, o, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, g):
+def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, window,
+                  res, g):
     """Ring backward: a second ring pass. Each rotation recomputes this
     shard's slice of the global softmax from the saved global logsumexp
     (attention_backward_lse — the Pallas two-pass kernels on TPU), adds
@@ -180,7 +229,27 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, g):
         return (jnp.zeros(q.shape, f32), jnp.zeros(kk.shape, f32),
                 jnp.zeros(vv.shape, f32))
 
+    lq = q.shape[2]
+
+    def _win_branch(r):
+        def br(kk, vv, kseg_cur):
+            return attention_backward_lse(
+                q, kk, vv, o, lse, g, causal=(r == 0), scale=scale,
+                block_q=block_q, block_k=block_k, grad_dtype=f32,
+                segments=_pair(kseg_cur), pos_offset=r * lq,
+                window=window,
+            )
+
+        return br
+
     def grads(k_cur, v_cur, kseg_cur, i):
+        if window is not None:
+            return jax.lax.switch(
+                _win_case((my + i) % size, my, lq, window, size),
+                [_win_branch(r)
+                 for r in range(_win_live(lq, window, size))] + [skip],
+                k_cur, v_cur, kseg_cur,
+            )
         if causal:
             return jax.lax.switch(
                 _ring_case((my + i) % size, my), (full, diag, skip),
@@ -226,7 +295,8 @@ _ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
-                         block_q=None, block_k=None, segments=None):
+                         block_q=None, block_k=None, segments=None,
+                         window=None):
     """Per-device body: q/k/v are the local sequence shards
     [batch, heads, local_len, dim]. Call inside shard_map/pjit with a
     named `axis_name` axis; returns the local output shard. The local
@@ -247,14 +317,24 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
             "causal ring attention requires equal q/kv sequence lengths "
             "per shard, got lq=%d lk=%d" % (q.shape[2], k.shape[2])
         )
+    if window is not None:
+        if not causal:
+            raise NotImplementedError(
+                "windowed ring attention is causal-only (the per-"
+                "rotation offset trick needs one-sided bands)"
+            )
+        window = int(window)
+        if window < 1:
+            raise ValueError("window must be >= 1, got %r" % (window,))
     if segments is not None:
         segments = jnp.asarray(segments, jnp.int32)
     return _ring_attention(q, k, v, segments, axis_name, causal, scale,
-                           block_q, block_k)
+                           block_q, block_k, window)
 
 
 def ring_attention(q, k, v, mesh, causal=False, scale=None,
                    block_q=None, block_k=None, segments=None,
+                   window=None,
                    seq_axis=MeshAxis.SP, batch_axes=(MeshAxis.DP,
                                                      MeshAxis.FSDP)):
     """Global-view ring attention: q/k/v are [batch, heads, seq, dim]
@@ -276,6 +356,7 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
         scale=scale,
         block_q=block_q,
         block_k=block_k,
+        window=window,
     )
     if segments is None:
         fn = jax.shard_map(
@@ -302,7 +383,8 @@ _ULYSSES_LOCAL_ATTN = {
 
 
 def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
-                            attn_impl="auto", segments=None):
+                            attn_impl="auto", segments=None,
+                            window=None):
     """Per-device body: q/k/v are local sequence shards
     [batch, heads, local_len, dim]. One tiled all_to_all turns them into
     [batch, heads/sp, full_len, dim] (device i holds head block i), the
@@ -319,6 +401,10 @@ def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
 
     local_attn = _ULYSSES_LOCAL_ATTN[attn_impl]
     kwargs = {}
+    if window is not None:
+        # each device holds FULL-sequence heads after the all_to_all,
+        # so the plain single-shard window mask applies directly
+        kwargs["window"] = window
     if segments is not None:
         kwargs["segments"] = jax.lax.all_gather(
             jnp.asarray(segments, jnp.int32), axis_name, axis=1,
@@ -334,7 +420,7 @@ def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None,
 
 
 def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
-                      attn_impl="auto", segments=None,
+                      attn_impl="auto", segments=None, window=None,
                       seq_axis=MeshAxis.SP, batch_axes=(MeshAxis.DP,
                                                         MeshAxis.FSDP)):
     """Global-view Ulysses attention: q/k/v are [batch, heads, seq, dim];
@@ -355,6 +441,11 @@ def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
             "attn_impl='jax_flash' does not support packed-sequence "
             "masking; use attn_impl='auto' or 'xla'"
         )
+    if window is not None and attn_impl == "jax_flash":
+        raise ValueError(
+            "attn_impl='jax_flash' does not support sliding-window "
+            "attention; use attn_impl='auto' or 'xla'"
+        )
     sp = mesh.shape.get(seq_axis, 1)
     heads = q.shape[1]
     if heads % sp:
@@ -371,6 +462,7 @@ def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
         causal=causal,
         scale=scale,
         attn_impl=attn_impl,
+        window=window,
     )
     if segments is None:
         fn = jax.shard_map(

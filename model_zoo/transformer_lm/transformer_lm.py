@@ -178,11 +178,6 @@ class CausalSelfAttention(nn.Module):
         window = self.window or None
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:
-            if window is not None:
-                raise NotImplementedError(
-                    "sliding-window attention is single-shard only; "
-                    "drop the sp axis or the window"
-                )
             # ring merges partials per kv rotation and ulysses
             # all-to-alls the head axis over sp — both want the full
             # head count, so GQA kv expands here (the grouped layout
@@ -193,6 +188,7 @@ class CausalSelfAttention(nn.Module):
                 out = ulysses_attention(
                     q, k, v, mesh, causal=self.causal,
                     attn_impl=self.attn_impl, segments=segments,
+                    window=window,
                 )
             elif self.sp_impl == "ring":
                 if self.attn_impl == "jax_flash":
@@ -204,7 +200,7 @@ class CausalSelfAttention(nn.Module):
                         "sp_impl='ulysses' or attn_impl='auto'"
                     )
                 out = ring_attention(q, k, v, mesh, causal=self.causal,
-                                     segments=segments)
+                                     segments=segments, window=window)
             else:
                 raise ValueError(
                     "Unknown sp_impl %r (valid: 'ring', 'ulysses')"
