@@ -94,6 +94,30 @@ class TaskDataService(object):
             return True
         return False
 
+    def flush_record_accounting(self, err_msg=""):
+        """Report every still-pending task as complete.
+
+        Call ONLY when the task stream's dataset was consumed to normal
+        exhaustion: `_gen` advances to the next task only after fully
+        yielding the previous one, so at stream end every pending
+        task's records went through the pipeline even when the
+        per-batch counts undercounted. That happens with CARDINALITY-
+        CHANGING dataset_fns — e.g. the sequence packer emits fewer
+        rows than source records (model_zoo/transformer_lm_packed) —
+        where row-based report_record_done can never cover the task.
+        For 1:1 dataset_fns the counts already drained the queue and
+        this is a no-op. A crash mid-stream skips the flush, so the
+        master still recovers the in-flight tasks."""
+        with self._lock:
+            while self._pending_tasks:
+                task = self._pending_tasks.popleft()
+                self._do_report_task(task, err_msg)
+                # failure counters attach to the FIRST reported task
+                # only (mirrors report_record_done's per-report reset)
+                self._failed_record_count = 0
+            self._reported_record_count = 0
+            self._current_task = None
+
     def get_train_end_callback_task(self):
         return self._pending_train_end_callback_task
 

@@ -297,6 +297,7 @@ class Worker(object):
             )
             dataset = dataset.batch(self.minibatch_size).prefetch(1)
             self._timing.start_record_time("task_process")
+            stream_err = ""
             for batch in dataset:
                 if self.job_type == JobType.TRAINING_WITH_EVALUATION:
                     evaluation_task_executed = (
@@ -305,12 +306,20 @@ class Worker(object):
                 padded, n = pad_batch(batch, self.minibatch_size)
                 with self._timing.record("batch_process"):
                     err_msg = self._process_minibatch(padded, n)
-                if not err_msg:
+                if err_msg:
+                    stream_err = err_msg
+                else:
                     self.report_version(int(self.state.step))
                 if self._task_data_service.report_record_done(n, err_msg):
                     self._timing.end_record_time("task_process")
                     self._timing.report_timing(reset=True)
                     self._timing.start_record_time("task_process")
+            # stream exhausted normally: complete any tasks row-based
+            # counting could not cover (cardinality-changing
+            # dataset_fns, e.g. sequence packing); 1:1 families no-op.
+            # Any failure in the stream propagates so those tasks are
+            # retried, not silently marked successful.
+            self._task_data_service.flush_record_accounting(stream_err)
             if self.job_type == JobType.TRAINING_WITH_EVALUATION:
                 evaluation_task_executed = self._evaluate_only()
             self._process_train_end_callback_task_if_needed()
@@ -427,6 +436,14 @@ class Worker(object):
             if batch is not None:
                 return ("item", pad_batch(batch, self.minibatch_size))
             self._train_iter = None
+            # per-stream flush: every emitted row was already processed
+            # (the loop polls the next item only after the previous
+            # round ran), so tasks row-counting could not cover are
+            # complete — and MUST be reported before the WAIT resume,
+            # or get_dataset()'s pending-tasks guard would wedge the
+            # job. Step failures raise out of loop.run() instead, so
+            # success reporting is correct here.
+            self._task_data_service.flush_record_accounting()
             if self._task_data_service._pending_dataset:
                 return ("wait",)
             # stream ended for good: loop once more; get_dataset -> None
