@@ -16,7 +16,6 @@ native format, so a checkpoint written on one mesh restores onto any
 other (device_put against the template's shardings).
 """
 
-import jax
 import numpy as np
 
 from elasticdl_tpu.checkpoint.saver import (

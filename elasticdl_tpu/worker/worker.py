@@ -31,10 +31,9 @@ from elasticdl_tpu.common.model_utils import resolve_dataset_fn
 from elasticdl_tpu.common.tensor_utils import serialize_ndarray_dict
 from elasticdl_tpu.common.timing_utils import Timing
 from elasticdl_tpu.data.dataset import pad_batch
-from elasticdl_tpu.master.task_dispatcher import Task, TaskType
+from elasticdl_tpu.master.task_dispatcher import Task
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.proto.service import MasterStub, build_channel
-from elasticdl_tpu.training.metrics import MetricsAggregator
 from elasticdl_tpu.training.trainer import Trainer
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
