@@ -49,17 +49,38 @@ def _win_live(shard_len, window, size):
     return min(size, (window + shard_len - 2) // shard_len + 1)
 
 
-def _win_case(src, my, shard_len, window, size):
-    """Switch index for a windowed rotation: shard offset r = my - src
-    selects branch r; r < 0 (strictly newer -> causal skip) and band-
-    empty offsets map to index _win_live(...) (the skip branch).
-    Shared by the forward and backward rings so the skip invariant
-    cannot desynchronize gradients from outputs (cf. _ring_case)."""
+def _win_offsets(shard_len, window, size, causal):
+    """The static branch-offset list matching _win_case's indexing:
+    causal -> [0..live), non-causal -> [-(live-1)..live). The skip
+    branch goes LAST; fwd and bwd build their switches from this one
+    list so they cannot desynchronize."""
+    live = _win_live(shard_len, window, size)
+    if causal:
+        return list(range(live))
+    return list(range(-(live - 1), live))
+
+
+def _win_case(src, my, shard_len, window, size, causal):
+    """Switch index for a windowed rotation, shared by the forward and
+    backward rings so the skip invariant cannot desynchronize
+    gradients from outputs (cf. _ring_case).
+
+    Causal: shard offset r = my - src selects branch r; r < 0
+    (strictly newer) and band-empty offsets map to the skip branch at
+    index _win_live(...).
+    Non-causal: signed offsets in (-live, live) select branch
+    off + live - 1 (the two-sided band at |off| shards); |off| outside
+    the band maps to the skip branch at index 2*live - 1."""
     off = my - src
     live = _win_live(shard_len, window, size)
+    if causal:
+        return jnp.where(
+            (off < 0) | (off * shard_len - (shard_len - 1) >= window),
+            live, off,
+        ).astype(jnp.int32)
+    empty = jnp.abs(off) * shard_len - (shard_len - 1) >= window
     return jnp.where(
-        (off < 0) | (off * shard_len - (shard_len - 1) >= window),
-        live, off,
+        empty, 2 * live - 1, off + live - 1
     ).astype(jnp.int32)
 
 
@@ -116,17 +137,17 @@ def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
         return (jnp.zeros(qq.shape, f32),
                 jnp.full((b, h, lq), _NEG_INF, f32))
 
-    # windowed (causal-only, validated upstream): one statically-
-    # compiled branch per shard offset r — the global window mask of a
-    # rotation IS the local window mask with q positions shifted by
-    # r*shard_len (causal auto-holds for r >= 1; the symmetric lower
-    # bound is auto-true at positive offsets). `size` is a static int
-    # (psum of a literal), so the branch list is a python list; only
-    # the selector is traced.
+    # windowed: one statically-compiled branch per shard offset — the
+    # global window mask of a rotation IS the local window mask with q
+    # positions shifted by offset*shard_len (causal: offsets >= 0,
+    # causality auto-holds off-diagonal and the symmetric lower bound
+    # is auto-true; non-causal: signed offsets give the two-sided
+    # band). `size` is a static int (psum of a literal), so the branch
+    # list is a python list; only the selector is traced.
     def _win_branch(r):
         def br(qq, kk, vv, kseg_cur):
             o, lse = attention_forward_lse(
-                qq, kk, vv, causal=(r == 0), scale=scale,
+                qq, kk, vv, causal=(causal and r == 0), scale=scale,
                 block_q=block_q, block_k=block_k,
                 segments=_pair(kseg_cur), pos_offset=r * lq,
                 window=window,
@@ -135,13 +156,19 @@ def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
 
         return br
 
+    def _win_branches():
+        return [
+            _win_branch(off)
+            for off in _win_offsets(lq, window, size, causal)
+        ] + [skip]
+
     def merge(o, lse, k_cur, v_cur, kseg_cur, i):
         # after i rotations device `my` holds the shard born on my+i
         if window is not None:
             o_i, lse_i = jax.lax.switch(
-                _win_case((my + i) % size, my, lq, window, size),
-                [_win_branch(r)
-                 for r in range(_win_live(lq, window, size))] + [skip],
+                _win_case((my + i) % size, my, lq, window, size,
+                          causal),
+                _win_branches(),
                 q, k_cur, v_cur, kseg_cur,
             )
         elif causal:
@@ -234,7 +261,8 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, window,
     def _win_branch(r):
         def br(kk, vv, kseg_cur):
             return attention_backward_lse(
-                q, kk, vv, o, lse, g, causal=(r == 0), scale=scale,
+                q, kk, vv, o, lse, g, causal=(causal and r == 0),
+                scale=scale,
                 block_q=block_q, block_k=block_k, grad_dtype=f32,
                 segments=_pair(kseg_cur), pos_offset=r * lq,
                 window=window,
@@ -242,12 +270,18 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, window,
 
         return br
 
+    def _win_branches():
+        return [
+            _win_branch(off)
+            for off in _win_offsets(lq, window, size, causal)
+        ] + [skip]
+
     def grads(k_cur, v_cur, kseg_cur, i):
         if window is not None:
             return jax.lax.switch(
-                _win_case((my + i) % size, my, lq, window, size),
-                [_win_branch(r)
-                 for r in range(_win_live(lq, window, size))] + [skip],
+                _win_case((my + i) % size, my, lq, window, size,
+                          causal),
+                _win_branches(),
                 k_cur, v_cur, kseg_cur,
             )
         if causal:
@@ -318,11 +352,6 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
             "per shard, got lq=%d lk=%d" % (q.shape[2], k.shape[2])
         )
     if window is not None:
-        if not causal:
-            raise NotImplementedError(
-                "windowed ring attention is causal-only (the per-"
-                "rotation offset trick needs one-sided bands)"
-            )
         window = int(window)
         if window < 1:
             raise ValueError("window must be >= 1, got %r" % (window,))

@@ -55,6 +55,7 @@ class BertEncoder(nn.Module):
     tp_shard: bool = True
     lora_rank: int = 0  # attention-LoRA adapters (0 = off)
     lora_alpha: float = 16.0
+    attn_window: int = 0  # two-sided sliding window; 0 = full
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -82,7 +83,7 @@ class BertEncoder(nn.Module):
             x = Block(
                 self.num_heads, head_dim, dtype=self.dtype,
                 attn_impl=self.attn_impl, tp_shard=self.tp_shard,
-                causal=False,
+                causal=False, window=self.attn_window,
                 lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
                 name="layer_%d" % i,
             )(x, training, segments=segments, positions=positions)

@@ -844,13 +844,35 @@ def test_ring_attention_window_with_segments():
                                rtol=1e-4, atol=1e-5)
 
 
-def test_ring_attention_window_noncausal_rejected():
+@pytest.mark.parametrize("window", [4, 13, 30])
+def test_ring_attention_window_noncausal(window):
+    """Two-sided (encoder) windows through the ring: signed-offset
+    branches cover shards on BOTH sides of the diagonal; out-of-band
+    rotations skip."""
     mesh = mesh_lib.build_mesh({"sp": 8})
     q, k, v = _qkv(55)
-    with pytest.raises(Exception, match="causal-only"):
-        jax.block_until_ready(
-            ring_attention(q, k, v, mesh, causal=False, window=8)
-        )
+    ref = naive_attention(q, k, v, causal=False, window=window)
+    out = ring_attention(q, k, v, mesh, causal=False, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_window_noncausal_gradients():
+    mesh = mesh_lib.build_mesh({"sp": 8})
+    q, k, v = _qkv(57)
+
+    def loss_ring(q, k, v):
+        return ring_attention(q, k, v, mesh, causal=False,
+                              window=11).sum()
+
+    def loss_ref(q, k, v):
+        return naive_attention(q, k, v, causal=False, window=11).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr_, gn in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr_), np.asarray(gn),
+                                   rtol=1e-3, atol=1e-4)
 
 
 def test_ulysses_attention_window():
@@ -862,5 +884,19 @@ def test_ulysses_attention_window():
     q, k, v = mk(), mk(), mk()
     ref = naive_attention(q, k, v, causal=True, window=9)
     out = ulysses_attention(q, k, v, mesh, causal=True, window=9)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_window_noncausal_with_segments():
+    """Two-sided window AND packing compose through the non-causal
+    ring (the BertEncoder attn_window + packed path)."""
+    mesh = mesh_lib.build_mesh({"sp": 8})
+    q, k, v = _qkv(58)
+    seg = _packed_seg_for_ring(B, L, seed=59)
+    ref = naive_attention(q, k, v, causal=False, window=11,
+                          segments=seg)
+    out = ring_attention(q, k, v, mesh, causal=False, window=11,
+                         segments=seg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
