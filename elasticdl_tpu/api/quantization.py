@@ -20,19 +20,23 @@ import jax.numpy as jnp
 import numpy as np
 
 # marker key: a quantized leaf is the dict
-#   {_Q8_KEY: int8 [..., out], _SCALE_KEY: f32 [out]}
+#   {_Q8_KEY: int8 [..., out], _SCALE_KEY: f32 [out],
+#    _ITEMSIZE_KEY: python int (source dtype itemsize)}
 # Dicts are pytree-internal nodes, so jax.tree utilities, device_put
 # and jit tracing all traverse the structure naturally (every leaf is
-# an array). Dequantization returns the scale's dtype (float32); the
-# model's compute-dtype cast happens inside apply as usual.
+# an array; the itemsize int is a scalar leaf dequantize ignores).
+# Dequantization returns the scale's dtype (float32); the model's
+# compute-dtype cast happens inside apply as usual.
 _Q8_KEY = "__w8__"
 _SCALE_KEY = "__w8_scale__"
+_ITEMSIZE_KEY = "__w8_src_itemsize__"
 
 
 def _quantize_leaf(w):
     """Symmetric per-output-channel (last axis) int8: scale chosen so
     the channel's max-|w| maps to 127. Zero channels get scale 1 (all
     zeros stay zero)."""
+    src_itemsize = int(np.asarray(w).dtype.itemsize)
     w32 = np.asarray(w, np.float32)
     amax = np.max(np.abs(w32), axis=tuple(range(w32.ndim - 1)))
     scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
@@ -40,7 +44,8 @@ def _quantize_leaf(w):
     # device-side leaves: the upload happens ONCE here, not on every
     # jitted decode call (jit re-transfers numpy arguments per call,
     # which would turn the bandwidth win into a per-call H2D copy)
-    return {_Q8_KEY: jnp.asarray(q), _SCALE_KEY: jnp.asarray(scale)}
+    return {_Q8_KEY: jnp.asarray(q), _SCALE_KEY: jnp.asarray(scale),
+            _ITEMSIZE_KEY: src_itemsize}
 
 
 def quantize_params(params, min_size=4096):
@@ -56,8 +61,11 @@ def quantize_params(params, min_size=4096):
         if isinstance(node, dict):
             return {k: visit(v) for k, v in node.items()}
         arr = np.asarray(node)
+        # jnp.issubdtype, not np.issubdtype: the extension float dtypes
+        # (bfloat16 — the usual TPU param dtype) are not numpy floating
+        # subtypes, and the bandwidth win vs bf16 is the headline case
         if (arr.ndim >= 2 and arr.size >= min_size
-                and np.issubdtype(arr.dtype, np.floating)):
+                and jnp.issubdtype(arr.dtype, jnp.floating)):
             return _quantize_leaf(arr)
         return node
 
@@ -98,7 +106,9 @@ def dequantize_params(params):
 
 def quantized_bytes(params):
     """(quantized_bytes, original_bytes) for the weight payload — the
-    bandwidth-ratio the int8 form buys."""
+    bandwidth-ratio the int8 form buys. Original bytes use the source
+    dtype recorded at quantize time (trees quantized before the
+    itemsize key existed fall back to float32)."""
     q_total = [0]
     o_total = [0]
 
@@ -106,8 +116,9 @@ def quantized_bytes(params):
         if isinstance(node, dict):
             if _Q8_KEY in node:
                 q = node[_Q8_KEY]
+                src_itemsize = int(node.get(_ITEMSIZE_KEY, 4))
                 q_total[0] += q.size + node[_SCALE_KEY].size * 4
-                o_total[0] += q.size * 4  # params are stored float32
+                o_total[0] += q.size * src_itemsize
                 return
             for v in node.values():
                 visit(v)

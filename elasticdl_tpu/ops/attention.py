@@ -893,8 +893,15 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     (GQA/MQA): the kernels index kv blocks through the head-group map
     natively, no repeat is materialized. `segments` [b, l] int: sequence
     packing — attention confined to same-id runs in forward AND backward
-    (the id tiles ride into the kernels as column/row blocks)."""
+    (the id tiles ride into the kernels as column/row blocks). With the
+    single-array form every row sees at least itself; the rectangular
+    (q_seg, k_seg) pair form (one ring rotation's geometry) CAN fully
+    mask a row, and such rows return exactly 0 with zero gradient — the
+    Pallas and blockwise backends are post-masked identically, so the
+    two paths agree (ring itself merges unnormalized partials via
+    attention_forward_lse instead)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    pair_form = isinstance(segments, (tuple, list))
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
     group_size(q, k)  # validate GQA divisibility before kernel dispatch
     block_q = min(resolve_block(block_q, "q"), lq)
@@ -909,12 +916,40 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                 "does not tile into (%d, %d) blocks",
                 lq, lk, block_q, block_k,
             )
-        return blockwise_attention(q, k, v, causal=causal, scale=scale,
-                                   window=window, segments=segments)
-    q, k, v = _pad_lanes([q, k, v], d)
-    out = _flash(q, k, v, segments, causal, scale, block_q, block_k,
-                 interpret, window)
-    return out[..., :d]
+        out = blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                  window=window, segments=segments)
+    else:
+        qp, kp, vp = _pad_lanes([q, k, v], d)
+        out = _flash(qp, kp, vp, segments, causal, scale, block_q,
+                     block_k, interpret, window)[..., :d]
+    if pair_form:
+        # the fully-masked-row contract: both backends leave a
+        # degenerate value there (blockwise: mean(v); kernel: depends
+        # on block skipping), so mask to exactly 0 — jnp.where also
+        # zeroes the row's gradient, matching the backward kernels'
+        # zero-contribution handling. O(lq*lk) elementwise, fused.
+        q_seg, k_seg = segments
+        masked = _fully_masked_rows(q_seg, k_seg, causal, window, lq, lk)
+        out = jnp.where(masked[:, None, :, None], 0.0, out)
+    return out
+
+
+def _fully_masked_rows(q_seg, k_seg, causal, window, lq, lk):
+    """[b, lq] bool: True where a query row has NO visible key under the
+    segment/causal/window mask — semantics mirror _block_mask at
+    pos_offset 0 (pair-form flash_attention is the only caller; ring
+    rotations handle offsets through the lse sentinel instead)."""
+    q_pos = jnp.arange(lq)[:, None]
+    k_pos = jnp.arange(lk)[None, :]
+    keep = q_seg[:, :, None] == k_seg[:, None, :]
+    if causal:
+        keep = jnp.logical_and(keep, q_pos >= k_pos)
+    if window is not None:
+        in_w = q_pos - k_pos < window
+        keep = jnp.logical_and(keep, in_w)
+        if not causal:
+            keep = jnp.logical_and(keep, k_pos - q_pos < window)
+    return jnp.logical_not(keep.any(-1))
 
 
 def jax_flash_attention(q, k, v, causal=False, scale=None, window=None):

@@ -107,7 +107,15 @@ class TaskDataService(object):
         where row-based report_record_done can never cover the task.
         For 1:1 dataset_fns the counts already drained the queue and
         this is a no-op. A crash mid-stream skips the flush, so the
-        master still recovers the in-flight tasks."""
+        master still recovers the in-flight tasks.
+
+        Retry amplification caveat: when called with a non-empty
+        err_msg, EVERY still-pending task is reported failed with that
+        same message — packing blends records across task boundaries,
+        so one failed minibatch late in a packed stream cannot be
+        attributed to a single task, and all blended-in tasks get
+        retried wholesale. Deliberately conservative: at-least-once
+        processing over precise blame."""
         with self._lock:
             while self._pending_tasks:
                 task = self._pending_tasks.popleft()

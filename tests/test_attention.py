@@ -602,7 +602,13 @@ def test_loss_ignores_negative_labels():
 def test_flash_rectangular_segment_pair(causal):
     """The (q_seg, k_seg) pair form on rectangular shapes — one ring
     rotation's geometry — through the Pallas kernels, vs the oracle.
-    Rows with NO matching key in the k shard are exercised (ids 9)."""
+    Rows with NO matching key in the k shard (ids 9) must come back
+    EXACTLY 0 on both the Pallas and blockwise-fallback backends (the
+    public contract), and flagged with the lse sentinel on the
+    attention_forward_lse surface ring merges consume."""
+    from elasticdl_tpu.ops import attention as attn_mod
+    from elasticdl_tpu.ops.attention import attention_forward_lse
+
     rs = np.random.RandomState(21)
     q = jnp.asarray(rs.randn(2, 2, 32, 128).astype(np.float32) * 0.3)
     k = jnp.asarray(rs.randn(2, 2, 16, 128).astype(np.float32) * 0.3)
@@ -613,17 +619,34 @@ def test_flash_rectangular_segment_pair(causal):
     k_seg = jnp.asarray(
         np.concatenate([np.zeros((2, 8)), np.ones((2, 8))], axis=1),
         jnp.int32)
-    # non-causal only for the oracle comparison of fully-masked rows:
-    # softmax over all -inf is implementation-defined; compare only
-    # rows with at least one visible key
     ref = naive_attention(q, k, v, causal=causal,
                           segments=(q_seg, k_seg))
     out = flash_attention(q, k, v, causal=causal, block_q=16,
                           block_k=16, segments=(q_seg, k_seg))
+    # blockwise fallback backend (block sizes that do not tile)
+    out_bw = flash_attention(q, k, v, causal=causal, block_q=24,
+                             block_k=24, segments=(q_seg, k_seg))
     visible = np.asarray(q_seg[0]) != 9
+    for got in (out, out_bw):
+        np.testing.assert_allclose(
+            np.asarray(got)[:, :, visible],
+            np.asarray(ref)[:, :, visible],
+            rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got)[:, :, ~visible], 0.0
+        )
+    out_lse, lse = attention_forward_lse(
+        q, k, v, causal=causal, block_q=16, block_k=16,
+        segments=(q_seg, k_seg)
+    )
     np.testing.assert_allclose(
-        np.asarray(out)[:, :, visible], np.asarray(ref)[:, :, visible],
-        rtol=1e-4, atol=1e-5,
+        np.asarray(out_lse)[:, :, visible],
+        np.asarray(ref)[:, :, visible], rtol=1e-4, atol=1e-5,
+    )
+    masked_lse = np.asarray(lse)[:, :, ~visible]
+    np.testing.assert_array_equal(
+        masked_lse, np.float32(attn_mod._NEG_INF)
     )
 
 

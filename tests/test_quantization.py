@@ -73,6 +73,28 @@ def test_roundtrip_and_detection():
     assert qb < ob * 0.45
 
 
+def test_bfloat16_params_quantize_with_true_ratio():
+    """bf16 kernels (the usual TPU param dtype) must quantize — numpy's
+    issubdtype does not consider ml_dtypes.bfloat16 a floating type, so
+    the gate goes through jnp — and the bandwidth accounting must use
+    the recorded 2-byte source itemsize, not assume float32."""
+    rs = np.random.RandomState(0)
+    w = rs.randn(128, 64).astype(np.float32)
+    params = {"dense": {"kernel": jnp.asarray(w, jnp.bfloat16)}}
+    q = quantize_params(params, min_size=1024)
+    assert is_quantized(q)
+    deq = np.asarray(dequantize_params(q)["dense"]["kernel"])
+    amax = np.abs(w).max(axis=0)
+    # int8 grid over a bf16 source: half-step of the int8 scale plus
+    # the bf16 rounding already present in the input
+    assert (np.abs(deq - w) <= amax / 127.0 * 0.5 + np.abs(w) * 0.01
+            + 1e-6).all()
+    qb, ob = quantized_bytes(q)
+    assert ob == w.size * 2  # source itemsize recorded, not 4
+    # int8 + f32 scales vs bf16 original: just under 2x, not "4x"
+    assert ob * 0.5 <= qb < ob * 0.6
+
+
 def test_quantized_decode_all_strategies():
     """A trained cycle model decodes the cycle through int8 weights on
     every strategy; greedy tokens match the float path (decisive
