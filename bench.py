@@ -475,6 +475,11 @@ def run_decode_bench(on_tpu):
     # measures the floor (near-zero acceptance on random logits).
     spec_gamma = int(params.pop("spec_gamma", 0))
     spec_draft_layers = int(params.pop("spec_draft_layers", 2))
+    # >0 distills the draft against the target before timing
+    # (warm-start + KL on the target's own logits — api/distill.py):
+    # the decode_spec_trained A/B vs the random-draft floor and the
+    # self-draft (spec_draft_layers=0) ceiling
+    spec_draft_train_steps = int(params.pop("spec_draft_train_steps", 0))
     # speculative verify chunks reach gamma-1 positions past the stream
     margin = spec_gamma - 1 if spec_gamma else 0
     if prompt + new_tokens + margin > cfg["seq_len"]:
@@ -521,8 +526,36 @@ def run_decode_bench(on_tpu):
             d_state = draft_trainer.init_state(
                 ({"tokens": tokens[:, :-1]}, tokens[:, 1:])
             )
+            if spec_draft_train_steps:
+                from elasticdl_tpu.api.distill import (
+                    distill_draft,
+                    warm_start_draft,
+                )
+
+                d_state = warm_start_draft(state, d_state)
+                d_state, _ = distill_draft(
+                    trainer, state, draft_trainer, d_state,
+                    [
+                        rng.randint(
+                            0, cfg["vocab_size"],
+                            size=(batch, cfg["seq_len"]),
+                        ).astype(np.int32)
+                        for _ in range(spec_draft_train_steps)
+                    ],
+                )
         else:
             draft_trainer, d_state = trainer, state
+        # acceptance telemetry once (same executable — return_stats
+        # only gates Python-side post-processing), then the timed path
+        # runs without stats
+        _, spec_stats = speculative_generate(
+            trainer, state, draft_trainer, d_state, prompt_ids,
+            new_tokens, gamma=spec_gamma, return_stats=True,
+        )
+        extra["spec_acceptance_rate"] = round(
+            spec_stats["acceptance_rate"], 3
+        )
+        extra["spec_verify_calls"] = spec_stats["verify_calls"]
 
         def decode():
             return speculative_generate(

@@ -1,0 +1,140 @@
+"""Draft-model distillation for speculative decoding.
+
+Speculative decode's speedup is acceptance-bound: a draft that mimics
+the target's argmax at most positions advances the stream ~gamma
+tokens per target invocation; a random draft degenerates to slower-
+than-plain decode. The reference framework has no decoding stack at
+all (SURVEY.md §5) — this is net-new surface completing the
+speculative path (api/generation.py speculative_generate) with the
+piece that makes it actually fast: a cheaply TRAINED draft.
+
+Two steps, composable:
+
+  * warm_start_draft — copy every identically-shaped top-level param
+    subtree from the target into the draft (embeddings, final norm,
+    head, and the first N transformer blocks, since both come from the
+    same zoo family the names line up). A 2-layer draft of an L-layer
+    target starts as "the target minus its upper blocks" — already far
+    better than random.
+  * distill_draft — soft-label distillation: minimize
+    KL(target || draft) over the target's next-token distributions on
+    provided token batches. No labels needed; any token stream works
+    (including model-generated or random tokens — the draft learns the
+    TARGET's behavior, not the data's).
+
+Both are serving-side utilities: they never touch the target state.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+def _shapes_match(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return (
+        jax.tree_util.tree_structure(a) == jax.tree_util.tree_structure(b)
+        and len(la) == len(lb)
+        and all(
+            getattr(x, "shape", None) == getattr(y, "shape", None)
+            for x, y in zip(la, lb)
+        )
+    )
+
+
+def _dense_params(params):
+    """Int8-quantized targets (api/quantization.py) carry marker-dict
+    leaves the draft cannot copy or apply; dequantize to the float
+    view first (no-op for float trees)."""
+    from elasticdl_tpu.api.quantization import (
+        dequantize_params,
+        is_quantized,
+    )
+
+    return dequantize_params(params) if is_quantized(params) else params
+
+
+def warm_start_draft(target_state, draft_state):
+    """Return draft_state with every top-level param subtree whose name
+    AND shape-structure match the target's copied over (wte/wpe, ln_f,
+    head, block_0..block_{N-1} for an N-block draft). Mismatched
+    subtrees (none, for same-family models with fewer layers) keep the
+    draft's fresh init. Quantized targets are dequantized for the copy
+    (the draft warm-starts from the float view)."""
+    t_params = _dense_params(target_state.params)
+    new_params = {}
+    copied = []
+    for key, sub in draft_state.params.items():
+        src = t_params.get(key) if hasattr(t_params, "get") else None
+        if src is not None and _shapes_match(src, sub):
+            # land on the draft's shardings, not the target's
+            shardings = jax.tree.map(lambda x: x.sharding, sub)
+            new_params[key] = jax.device_put(
+                jax.tree.map(np.asarray, jax.device_get(src)), shardings
+            )
+            copied.append(key)
+        else:
+            new_params[key] = sub
+    logger.info("warm_start_draft copied subtrees: %s", copied)
+    return draft_state.replace(params=new_params)
+
+
+def distill_draft(trainer, state, draft_trainer, draft_state, batches,
+                  lr=1e-3, temperature=1.0):
+    """Soft-label distillation of the draft against the frozen target.
+
+    batches: iterable of int32 token arrays [b, l] (l <= both models'
+    seq_len). Minimizes mean KL(softmax(t/T) || softmax(d/T)) over all
+    positions with Adam. Returns (new_draft_state, losses). One jitted
+    step, re-used across batches; the target's logits are computed
+    inside the same program so nothing round-trips through HBM twice.
+    """
+    model, draft = trainer.model, draft_trainer.model
+    t_vars = {"params": _dense_params(state.params),
+              **state.model_state}
+    d_mstate = draft_state.model_state
+    tx = optax.adam(lr)
+    opt_state = tx.init(draft_state.params)
+    inv_t = 1.0 / float(temperature)
+
+    @jax.jit
+    def step(d_params, opt_state, tokens):
+        t_logits = model.apply(t_vars, {"tokens": tokens},
+                               training=False)
+        t_lp = jax.nn.log_softmax(
+            t_logits.astype(jnp.float32) * inv_t
+        )
+
+        def loss_fn(p):
+            d_logits = draft.apply(
+                {"params": p, **d_mstate}, {"tokens": tokens},
+                training=False,
+            )
+            d_lp = jax.nn.log_softmax(
+                d_logits.astype(jnp.float32) * inv_t
+            )
+            return jnp.mean(
+                jnp.sum(jnp.exp(t_lp) * (t_lp - d_lp), axis=-1)
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(d_params)
+        updates, opt_state = tx.update(grads, opt_state, d_params)
+        return optax.apply_updates(d_params, updates), opt_state, loss
+
+    params = draft_state.params
+    losses = []
+    for tokens in batches:
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(tokens, jnp.int32)
+        )
+        losses.append(float(loss))
+    if losses:
+        logger.info(
+            "distill_draft: %d steps, KL %.4f -> %.4f",
+            len(losses), losses[0], losses[-1],
+        )
+    return draft_state.replace(params=params), losses

@@ -604,7 +604,8 @@ def _beam_kv_generate(trainer, state, prompt, max_new_tokens, num_beams):
 
 
 def speculative_generate(trainer, state, draft_trainer, draft_state,
-                         prompt, max_new_tokens, gamma=4):
+                         prompt, max_new_tokens, gamma=4,
+                         return_stats=False):
     """Speculative greedy decoding: a small DRAFT model proposes gamma
     tokens per iteration (cheap single-token KV steps) and the TARGET
     model verifies them in ONE chunked decode step (the model's t>1
@@ -624,7 +625,11 @@ def speculative_generate(trainer, state, draft_trainer, draft_state,
 
     Both models follow the KV convention (decode + prefill modes) and
     share the vocabulary; the draft's seq_len must also cover the
-    stream. Returns int32 [b, p + max_new_tokens].
+    stream. Returns int32 [b, p + max_new_tokens]; with
+    return_stats=True, (tokens, stats) where stats reports
+    verify_calls (target invocations after prefill), committed_tokens,
+    and acceptance_rate (mean accepted proposals / (gamma-1)) — the
+    observability that tells a ceiling draft from a floor one.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p = prompt.shape
@@ -664,6 +669,9 @@ def speculative_generate(trainer, state, draft_trainer, draft_state,
     # STRONG reference to the draft trainer so its id cannot be
     # recycled onto a new object while the entry lives (the LRU bounds
     # the lifetime).
+    # return_stats is NOT part of the key: the compiled program always
+    # returns (tokens, n, acc); the flag only gates Python-side
+    # post-processing, so both call forms share one executable
     key = ("spec", b, total, gamma, p_pad, qz, d_qz,
            id(draft_trainer))
     fn = None
@@ -692,11 +700,11 @@ def speculative_generate(trainer, state, draft_trainer, draft_state,
             )
 
             def cond(carry):
-                tokens, pos, tkv, dkv = carry
+                tokens, pos, tkv, dkv, n, acc = carry
                 return pos < total
 
             def body(carry):
-                tokens, pos, tkv, dkv = carry
+                tokens, pos, tkv, dkv, n, acc = carry
                 # ---- draft: gamma single-token proposals from pos-1
                 def d_step(c, _):
                     dkv, tok = c
@@ -770,12 +778,13 @@ def speculative_generate(trainer, state, draft_trainer, draft_state,
                 # rows past the counter are masked junk
                 tkv = dict(tkv, pos=jnp.asarray(pos - 1, jnp.int32))
                 dkv = dict(dkv, pos=jnp.asarray(pos - 1, jnp.int32))
-                return (tokens, pos, tkv, dkv)
+                return (tokens, pos, tkv, dkv, n + 1, acc + a)
 
-            tokens, _, _, _ = jax.lax.while_loop(
-                cond, body, (tokens, p_len + 1, tkv, dkv)
+            zero = jnp.asarray(0, jnp.int32)
+            tokens, _, _, _, n, acc = jax.lax.while_loop(
+                cond, body, (tokens, p_len + 1, tkv, dkv, zero, zero)
             )
-            return tokens
+            return tokens, n, acc
 
         fn = jax.jit(run)
         cache[key] = (fn, draft_trainer)
@@ -787,6 +796,22 @@ def speculative_generate(trainer, state, draft_trainer, draft_state,
     buf = jnp.zeros((b, seq_len), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
     with trainer.mesh:
-        out = fn(variables, d_variables, buf,
-                 jnp.asarray(p, jnp.int32))
-    return out[:, :total]
+        out, n, acc = fn(variables, d_variables, buf,
+                         jnp.asarray(p, jnp.int32))
+    out = out[:, :total]
+    if not return_stats:
+        return out
+    verify_calls = int(n)
+    stats = {
+        "verify_calls": verify_calls,
+        "committed_tokens": int(max_new_tokens) - 1,  # first from prefill
+        # accepted proposals per verify, as a fraction of the gamma-1
+        # proposed — counted in-loop (batch-min per iteration, like the
+        # commit), so stream-end truncation of the last chunk doesn't
+        # read as rejection
+        "acceptance_rate": (
+            float(acc) / max(1, (gamma - 1) * verify_calls)
+            if gamma > 1 else 0.0
+        ),
+    }
+    return out, stats

@@ -42,6 +42,13 @@ class LocalExecutor(object):
         grad_accum_steps=1,
         trainable_pattern=None,
     ):
+        from elasticdl_tpu.common.platform_utils import (
+            honor_jax_platforms_env,
+        )
+
+        # before the first backend use (Trainer builds the mesh below):
+        # JAX_PLATFORMS=cpu must win over an ambient plugin's override
+        honor_jax_platforms_env()
         self.spec = model_spec
         self.minibatch_size = minibatch_size
         self.num_epochs = num_epochs

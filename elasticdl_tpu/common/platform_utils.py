@@ -1,0 +1,24 @@
+"""Make the JAX_PLATFORMS env var mean what users think it means.
+
+An ambient accelerator plugin (e.g. a tunneled PJRT plugin) can
+force-set `jax_platforms` at `import jax`, silently overriding the
+JAX_PLATFORMS environment variable — so `JAX_PLATFORMS=cpu
+elasticdl-tpu train ...` would still route compute at the (possibly
+unreachable) accelerator and hang. The config knob applied after
+import wins over the plugin's import-time override; every process
+entry point (client CLI, master, worker, LocalExecutor) calls this
+before its first device use."""
+
+import os
+
+
+def honor_jax_platforms_env():
+    """Re-apply JAX_PLATFORMS over any plugin's import-time override.
+    No-op when the variable is unset (the ambient default — usually
+    the accelerator — stays in charge). Safe to call repeatedly;
+    must run before the first backend use to take effect."""
+    platform = os.environ.get("JAX_PLATFORMS")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

@@ -243,6 +243,31 @@ class HostEmbeddingManager(object):
 
     # ------------------------------------------------------------- apply
 
+    def pending_row_count(self):
+        """Rows the NEXT apply()/stage() would update (unique pulled ids
+        across tables, from the last prepare) — the denominator the
+        Trainer's tier-health counters use when an apply fails and those
+        row updates are dropped."""
+        return sum(
+            t.last_unique.size
+            for t in self._tables.values()
+            if t.last_unique is not None
+        )
+
+    def staged_row_count(self):
+        """Row updates held in the accumulation buffer (all staged
+        microbatches, repeats included) — at risk if the macro-boundary
+        apply_staged fails. The Trainer snapshots this BEFORE
+        apply_staged (which drains the buffer up front) so the drop
+        counter covers the whole lost cycle; a failed stage() loses
+        only the current microbatch's pending rows, counted
+        separately."""
+        return sum(
+            ids.size
+            for pairs in self._staged.values()
+            for ids, _ in pairs
+        )
+
     def apply(self, host_grads, lr_scale=1.0):
         """Apply the step's row gradients ({rows_key: [cap, dim]}, the
         grads of the compiled step w.r.t. the pulled rows) through each

@@ -107,6 +107,11 @@ def create_instance_manager(args, task_d, master_port):
 
 
 def main(argv=None):
+    from elasticdl_tpu.common.platform_utils import (
+        honor_jax_platforms_env,
+    )
+
+    honor_jax_platforms_env()
     args = parse_master_args(argv)
     status_file = getattr(args, "job_status_file", "")
     job_status.write_job_status(status_file, job_status.PENDING)

@@ -116,6 +116,7 @@ class Master(object):
             minibatch_size,
             self.task_d,
             evaluation_service=self.evaluation_service,
+            tensorboard_service=tensorboard_service,
         )
         self.instance_manager = instance_manager
         self._port = port

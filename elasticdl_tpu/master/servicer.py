@@ -17,12 +17,14 @@ from elasticdl_tpu.proto.convert import TASK_TYPE_TO_PB as _TASK_TYPE_TO_PB
 
 
 class MasterServicer(object):
-    def __init__(self, minibatch_size, task_d, evaluation_service=None):
+    def __init__(self, minibatch_size, task_d, evaluation_service=None,
+                 tensorboard_service=None):
         self._task_d = task_d
         self._lock = threading.Lock()
         self._minibatch_size = minibatch_size
         self._version = 0
         self._evaluation_service = evaluation_service
+        self._tensorboard_service = tensorboard_service
         self._task_complete_times = {
             TaskType.TRAINING: [],
             TaskType.EVALUATION: [],
@@ -71,7 +73,7 @@ class MasterServicer(object):
             logger.warning(
                 "Worker reported error: %s", request.err_message
             )
-            self._task_d.report(
+            _, _, worker_id = self._task_d.report(
                 request.task_id, False,
                 exec_counters=dict(request.exec_counters),
             )
@@ -87,7 +89,33 @@ class MasterServicer(object):
                         self._task_complete_times[task.type].append(
                             complete_time
                         )
+        self._write_tier_gauges(dict(request.exec_counters), worker_id)
         return pb.Empty()
+
+    def _write_tier_gauges(self, exec_counters, worker_id):
+        """Workers piggyback cumulative tier-health counters (host-tier
+        dropped row updates / failed cycles) on task reports as tier/
+        keys; write them through the TensorBoard service as gauges at
+        the current model version (reference analogue: the PS exposed
+        parameters.debug_info — here the degradation signal rides the
+        existing report RPC instead of a debug endpoint). Tags are
+        per-worker (the counters are per-trainer cumulatives, so
+        different workers' values must not interleave on one scalar);
+        the dispatcher supplies the reporting worker's id. A report
+        whose task is unknown (late duplicate from a requeued
+        straggler) has no worker identity — dropped, since writing it
+        to a bare tag would recreate the interleaving."""
+        if not self._tensorboard_service or worker_id < 0:
+            return
+        suffix = "/worker-%d" % worker_id
+        gauges = {
+            k + suffix: v for k, v in exec_counters.items()
+            if k.startswith("tier/")
+        }
+        if gauges:
+            self._tensorboard_service.write_dict_to_summary(
+                gauges, version=self._version
+            )
 
     def report_evaluation_metrics(self, request, _context=None):
         with self._lock:

@@ -31,6 +31,14 @@ from elasticdl_tpu.ops.dispatch import (
 
 _NEG_INF = -1e30
 NEG_INF = _NEG_INF  # masking constant shared with context_parallel
+# The kernels run their online softmax in the exp2 domain: log2(e) is
+# folded into the (already present) q scale multiply, so every
+# per-element exp() in the inner loop becomes the VPU-native exp2()
+# without the implicit x*log2e multiply exp() performs. Outputs convert
+# back to natural-log units (lse) at the block epilogue, so nothing
+# outside the kernels sees base-2 values.
+_LOG2E = float(np.log2(np.e))
+_LN2 = float(np.log(2.0))
 
 # Tuned flash block defaults: hardware sweeps (scripts/bench_attention.py
 # via scripts/hw_session.py) persist their winner here so every call site
@@ -427,6 +435,20 @@ def _block_mask(s, qi, ki, block_q, block_k, causal, window,
     return jnp.where(keep, s, _NEG_INF)
 
 
+def _mxu_cast(p, operand_dtype):
+    """Cast an f32 probability/gradient matrix to the other matmul
+    operand's dtype when that operand is bf16: an f32 LHS forces the
+    MXU onto its (severalx slower) fp32 path, while bf16 x bf16 with an
+    f32 preferred_element_type runs at full rate with f32 accumulation.
+    p's values are softmax weights in [0, 1] (or ds of the same scale),
+    so the bf16 rounding is well inside the bf16 output tolerance of
+    the training paths that hit this; f32 inputs (tests, oracle
+    comparisons) are left untouched."""
+    if operand_dtype == jnp.bfloat16:
+        return p.astype(jnp.bfloat16)
+    return p
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
                   block_q, block_k, n_k, has_segs=False,
                   pos_offset=0):
@@ -449,11 +471,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
 
     @pl.when(run)
     def _():
-        q = q_ref[0] * scale
+        # exp2 domain: log2e rides the existing scale multiply
+        q = q_ref[0] * (scale * _LOG2E)
         s = jax.lax.dot_general(
             q, k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
+        )  # (block_q, block_k), in log2 units
         s = _block_mask(s, qi, ki, block_q, block_k, causal, window,
                         pos_offset)
         if has_segs:
@@ -462,11 +485,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
             s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
         l_scr[:] = l_scr[:] * corr + p.sum(-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v_ref[0], dimension_numbers=_dims(1, 0),
+            _mxu_cast(p, v_ref.dtype), v_ref[0],
+            dimension_numbers=_dims(1, 0),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = m_new
@@ -478,13 +502,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
             acc_scr[:] / jnp.maximum(l, 1e-30)
         ).astype(o_ref.dtype)
         # logsumexp residual for the backward kernels: exp(s - lse) == P.
-        # Defense in depth: a fully-skipped row (l == 0; unreachable for
-        # the square shapes _check_window enforces) gets a +inf-class
-        # sentinel so the backward's exp(-1e30 - lse) underflows to 0
-        # instead of exploding.
+        # m is in log2 units, so convert back to natural log here — no
+        # consumer ever sees base-2 values. Defense in depth: a
+        # fully-skipped row (l == 0; unreachable for the square shapes
+        # _check_window enforces) gets a +inf-class sentinel so the
+        # backward's exp(-1e30 - lse) underflows to 0 instead of
+        # exploding.
         lse_ref[0] = jnp.where(
             l > 0.0,
-            m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)),
+            (m_scr[:] + jnp.log2(jnp.maximum(l, 1e-30))) * _LN2,
             -_NEG_INF,
         )
 
@@ -497,40 +523,119 @@ def _outer_spec(block, d):
     )
 
 
-def _inner_spec(block, d):
+# --- streamed-block DMA clamping -------------------------------------
+# Mosaic's pipeline elides the HBM->VMEM copy when a block's index map
+# returns the same indices as the previous grid step. The compute for
+# blocks fully outside the causal/window mask is already skipped by
+# pl.when(_block_run), but their input DMAs would still run — for
+# causal attention that is ~half of all kv traffic fetched and thrown
+# away. These clamps pin the streamed index to the nearest in-mask
+# block, so out-of-mask steps revisit an already-resident block and the
+# pipeline skips the copy. The bounds are the same inequalities as
+# _block_run solved for the streamed index, so every step with
+# run=True reads its true block; out-of-mask steps read a (resident,
+# unused) one. Segments never relax the causal/window mask, so the
+# clamps stay valid with packing.
+
+
+def _kv_stream_clamp(causal, window, block_q, block_k, n_k, pos_offset):
+    """Clamp for the forward/dq kernels' streamed k/v index t, given
+    q-block index j."""
+    if not causal and window is None:
+        return None
+
+    def clamp(j, t):
+        q0 = j * block_q + pos_offset
+        lo = 0
+        hi = n_k - 1
+        if causal:
+            # run: q0 + block_q - 1 >= ki * block_k
+            hi = jnp.minimum(hi, (q0 + block_q - 1) // block_k)
+        if window is not None:
+            # back: ki*block_k + block_k - 1 > q0 - window
+            lo = jnp.maximum(
+                lo, (q0 - window - block_k + 1) // block_k + 1
+            )
+            if not causal:
+                # fwd: q0 + block_q - 1 > ki*block_k - window
+                hi = jnp.minimum(
+                    hi, (q0 + block_q + window - 2) // block_k
+                )
+        return jnp.maximum(jnp.minimum(t, hi), jnp.minimum(lo, n_k - 1))
+
+    return clamp
+
+
+def _q_stream_clamp(causal, window, block_q, block_k, n_q, pos_offset):
+    """Clamp for the dk/dv kernel's streamed q-block index qb, given
+    key-block index j — the _block_run inequalities solved for qb."""
+    if not causal and window is None:
+        return None
+
+    def clamp(j, qb):
+        lo = 0
+        hi = n_q - 1
+        if causal:
+            # run: qb*block_q + pos_offset + block_q - 1 >= j*block_k
+            lo = jnp.maximum(lo, (j * block_k - pos_offset) // block_q)
+        if window is not None:
+            # back: j*block_k + block_k - 1 > q0 - window
+            hi = jnp.minimum(
+                hi,
+                (j * block_k + block_k + window - 2 - pos_offset)
+                // block_q,
+            )
+            if not causal:
+                # fwd: q0 + block_q - 1 > j*block_k - window
+                lo = jnp.maximum(
+                    lo,
+                    (j * block_k - window - pos_offset - block_q + 1)
+                    // block_q + 1,
+                )
+        return jnp.maximum(jnp.minimum(qb, hi), jnp.minimum(lo, n_q - 1))
+
+    return clamp
+
+
+def _inner_spec(block, d, clamp=None):
     """Block indexed by grid dim 2 (the sequential/streamed dimension)."""
+    cl = clamp or (lambda j, t: t)
     return pl.BlockSpec(
-        (1, block, d), lambda i, j, t: (i, t, 0),
+        (1, block, d), lambda i, j, t: (i, cl(j, t), 0),
         memory_space=pltpu.VMEM,
     )
 
 
-def _kv_inner_spec(block, d, h, hkv):
+def _kv_inner_spec(block, d, h, hkv, clamp=None):
     """Streamed kv spec for the forward/dq kernels when k/v carry fewer
     heads than q (GQA): grid dim 0 indexes b*h q-rows; kv row = batch
     offset + q_head // group. Degenerates to _inner_spec at h == hkv."""
     if h == hkv:
-        return _inner_spec(block, d)
+        return _inner_spec(block, d, clamp)
     group = h // hkv
+    cl = clamp or (lambda j, t: t)
     return pl.BlockSpec(
         (1, block, d),
-        lambda i, j, t: ((i // h) * hkv + (i % h) // group, t, 0),
+        lambda i, j, t: ((i // h) * hkv + (i % h) // group, cl(j, t), 0),
         memory_space=pltpu.VMEM,
     )
 
 
-def _dkv_q_spec(block, d, h, hkv, n_q):
+def _dkv_q_spec(block, d, h, hkv, n_q, clamp=None):
     """Streamed q-side spec for the dk/dv kernel under GQA: grid dim 0
     indexes b*hkv kv-rows and grid dim 2 enumerates (group, q_block)
     pairs flattened as t = g * n_q + q_block, so each kv block
     accumulates over every q head in its group."""
     if h == hkv:
-        return _inner_spec(block, d)
+        # group == 1: row = i, t // n_q = 0, t % n_q = t
+        return _inner_spec(block, d, clamp)
     group = h // hkv
+    cl = clamp or (lambda j, qb: qb)
     return pl.BlockSpec(
         (1, block, d),
         lambda i, j, t: (
-            (i // hkv) * h + (i % hkv) * group + t // n_q, t % n_q, 0
+            (i // hkv) * h + (i % hkv) * group + t // n_q,
+            cl(j, t % n_q), 0,
         ),
         memory_space=pltpu.VMEM,
     )
@@ -546,24 +651,28 @@ def _mosaic_params():
     )
 
 
-def _seg_specs(block_q, block_k, heads, dkv=False, n_q=1):
+def _seg_specs(block_q, block_k, heads, dkv=False, n_q=1, clamp=None):
     """BlockSpec pair for the segment-id inputs: q-side ids ride as
     [b, lq, 1] column tiles, k-side as [b, 1, lk] row tiles so the
     in-kernel equality broadcasts to (block_q, block_k) without any
     reshape. `heads` is the grid-dim-0 head count (h, or hkv for the
-    dk/dv kernel whose streamed dim enumerates (group, q_block))."""
+    dk/dv kernel whose streamed dim enumerates (group, q_block)).
+    `clamp` applies to the STREAMED side (k ids for the forward/dq
+    kernels, q ids for dk/dv), matching the k/v (resp. q) tile the
+    kernel actually reads at each step."""
+    cl = clamp or (lambda j, t: t)
     if not dkv:
         return (
             pl.BlockSpec((1, block_q, 1),
                          lambda i, j, t: (i // heads, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_k),
-                         lambda i, j, t: (i // heads, 0, t),
+                         lambda i, j, t: (i // heads, 0, cl(j, t)),
                          memory_space=pltpu.VMEM),
         )
     return (
         pl.BlockSpec((1, block_q, 1),
-                     lambda i, j, t: (i // heads, t % n_q, 0),
+                     lambda i, j, t: (i // heads, cl(j, t % n_q), 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, 1, block_k),
                      lambda i, j, t: (i // heads, 0, j),
@@ -594,14 +703,18 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         has_segs=segments is not None,
         pos_offset=pos_offset,
     )
+    kv_clamp = _kv_stream_clamp(causal, window, block_q, block_k, n_k,
+                                pos_offset)
     in_specs = [
-        _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
-        _kv_inner_spec(block_k, d, h, hkv),
+        _outer_spec(block_q, d),
+        _kv_inner_spec(block_k, d, h, hkv, kv_clamp),
+        _kv_inner_spec(block_k, d, h, hkv, kv_clamp),
     ]
     inputs = [q3, k3, v3]
     if segments is not None:
         q_seg, k_seg = segments
-        in_specs += list(_seg_specs(block_q, block_k, h))
+        in_specs += list(_seg_specs(block_q, block_k, h,
+                                    clamp=kv_clamp))
         inputs += [
             q_seg.reshape(b, lq, 1),
             k_seg.reshape(b, 1, lk),
@@ -654,15 +767,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _():
+        # exp2 domain (see _flash_kernel): fold log2e into the scale
+        # and convert the saved natural-log lse on load
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
-        ) * scale
+        ) * (scale * _LOG2E)
         s = _block_mask(s, qi, ki, block_q, block_k, causal, window,
                         pos_offset)
         if has_segs:
             s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
+        p = jnp.exp2(s - lse_ref[0] * _LOG2E)  # (block_q, block_k)
         if has_segs:
             # a row fully masked by segments (possible only in the
             # rectangular pair form) carries an lse of the -1e30 class,
@@ -675,7 +790,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
         ds = p * (dp - delta_ref[0]) * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k_ref[0], dimension_numbers=_dims(1, 0),
+            _mxu_cast(ds, k_ref.dtype), k_ref[0],
+            dimension_numbers=_dims(1, 0),
             preferred_element_type=jnp.float32,
         )
 
@@ -709,22 +825,24 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(run)
     def _():
+        # exp2 domain (see _flash_kernel)
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0], dimension_numbers=_dims(1, 1),
             preferred_element_type=jnp.float32,
-        ) * scale
+        ) * (scale * _LOG2E)
         s = _block_mask(s, qb, ki, block_q, block_k, causal, window,
                         pos_offset)
         if has_segs:
             s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0])  # (block_q, block_k)
+        p = jnp.exp2(s - lse_ref[0] * _LOG2E)  # (block_q, block_k)
         if has_segs:
             # see _flash_bwd_dq_kernel: fully-segment-masked rows
             # (rectangular pair form) must contribute zero to dk/dv
             p = jnp.where(lse_ref[0] < 0.5 * _NEG_INF, 0.0, p)
         # dV_j += P^T dO ; dP = dO V^T ; dS = P*(dP - D) ; dK_j += dS^T Q
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do_ref[0], dimension_numbers=_dims(0, 0),
+            _mxu_cast(p, do_ref.dtype), do_ref[0],
+            dimension_numbers=_dims(0, 0),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
@@ -733,7 +851,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         )
         ds = p * (dp - delta_ref[0]) * scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q_ref[0], dimension_numbers=_dims(0, 0),
+            _mxu_cast(ds, q_ref.dtype), q_ref[0],
+            dimension_numbers=_dims(0, 0),
             preferred_element_type=jnp.float32,
         )
 
@@ -789,14 +908,19 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
             k_seg.reshape(b, 1, lk),
         ]
 
+    kv_clamp = _kv_stream_clamp(causal, window, block_q, block_k, n_k,
+                                pos_offset)
     col_q = _outer_spec(block_q, 1)
     dq_in_specs = [
-        _outer_spec(block_q, d), _kv_inner_spec(block_k, d, h, hkv),
-        _kv_inner_spec(block_k, d, h, hkv), _outer_spec(block_q, d),
+        _outer_spec(block_q, d),
+        _kv_inner_spec(block_k, d, h, hkv, kv_clamp),
+        _kv_inner_spec(block_k, d, h, hkv, kv_clamp),
+        _outer_spec(block_q, d),
         col_q, col_q,
     ]
     if segments is not None:
-        dq_in_specs += list(_seg_specs(block_q, block_k, h))
+        dq_in_specs += list(_seg_specs(block_q, block_k, h,
+                                       clamp=kv_clamp))
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal,
@@ -814,8 +938,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
 
     # key-block-parallel pass: q-side inputs stream over the inner dim
     # (all (group, q_block) pairs under GQA)
-    q_spec = _dkv_q_spec(block_q, d, h, hkv, n_q)
-    col_q_t = _dkv_q_spec(block_q, 1, h, hkv, n_q)
+    q_clamp = _q_stream_clamp(causal, window, block_q, block_k, n_q,
+                              pos_offset)
+    q_spec = _dkv_q_spec(block_q, d, h, hkv, n_q, q_clamp)
+    col_q_t = _dkv_q_spec(block_q, 1, h, hkv, n_q, q_clamp)
     dkv_in_specs = [
         q_spec, _outer_spec(block_k, d),
         _outer_spec(block_k, d), q_spec,
@@ -823,7 +949,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     ]
     if segments is not None:
         dkv_in_specs += list(
-            _seg_specs(block_q, block_k, hkv, dkv=True, n_q=n_q)
+            _seg_specs(block_q, block_k, hkv, dkv=True, n_q=n_q,
+                       clamp=q_clamp)
         )
     dk, dv = pl.pallas_call(
         functools.partial(

@@ -141,6 +141,16 @@ class Trainer(object):
         # Host-spill embedding bridge (embedding/host_bridge.py): pulls
         # rows before the compiled step, applies row grads after it.
         self._host_manager = None
+        # Tier-health counters: host-tier apply/stage failures degrade
+        # to "those rows miss one update" by design (see _host_apply);
+        # these make the degradation observable instead of grep-able.
+        # Cumulative for the Trainer's lifetime; the worker forwards
+        # them to the master as tier/ exec counters, which the master
+        # turns into TensorBoard gauges.
+        self.tier_health = {
+            "host_failed_cycles": 0,
+            "host_dropped_row_updates": 0,
+        }
 
     # ------------------------------------------------------- host bridge
 
@@ -501,16 +511,34 @@ class Trainer(object):
             if accum == 1:
                 self._host_apply(host_grads, scale)
             else:
+                # Separate accounting per op: a failed stage() loses
+                # only the CURRENT microbatch (the buffer is untouched
+                # and prior microbatches still apply at the boundary),
+                # while a failed apply_staged() loses everything it
+                # drained — snapshot staged_row_count BEFORE the drain.
                 try:
                     self._host_manager.stage(host_grads,
                                              weight=1.0 / accum)
-                    if boundary:
-                        self._host_manager.apply_staged(lr_scale=scale)
                 except Exception:
-                    logger.exception(
-                        "host-embedding stage/apply failed; affected "
-                        "rows miss this cycle (no retry: state donated)"
+                    self._count_dropped_host_rows(
+                        self._host_rows_at_risk(staged=False)
                     )
+                    logger.exception(
+                        "host-embedding stage failed; this "
+                        "microbatch's rows miss the cycle (no retry: "
+                        "state donated)"
+                    )
+                if boundary:
+                    at_risk = self._host_rows_at_risk(pending=False)
+                    try:
+                        self._host_manager.apply_staged(lr_scale=scale)
+                    except Exception:
+                        self._count_dropped_host_rows(at_risk)
+                        logger.exception(
+                            "host-embedding apply_staged failed; the "
+                            "staged cycle's rows miss this update (no "
+                            "retry: state donated)"
+                        )
         if self._defer_sparse:
             self._sparse_stage.append(
                 jax.tree.map(np.asarray, sparse_aux)
@@ -570,9 +598,11 @@ class Trainer(object):
         task-requeue-first, README.md:62-66)."""
         if not self._host_manager:
             return
+        at_risk = self._host_rows_at_risk(staged=False)
         try:
             self._host_manager.apply(host_grads, lr_scale=scale)
         except Exception:
+            self._count_dropped_host_rows(at_risk)
             # The log itself must not touch device values: with an
             # async device error poisoning this step's outputs,
             # int(state.step) would re-raise the very exception this
@@ -581,6 +611,27 @@ class Trainer(object):
                 "host-embedding apply failed; affected rows miss "
                 "this update (no retry: state is donated)"
             )
+
+    def _host_rows_at_risk(self, pending=True, staged=True):
+        """Row updates a tier failure would drop: the current
+        microbatch's pulled rows (`pending`) and/or the accumulation
+        buffer (`staged`) — callers pick the component the failing op
+        actually loses. Never raises (feeds exception handlers)."""
+        try:
+            rows = 0
+            if pending:
+                rows += self._host_manager.pending_row_count()
+            if staged:
+                rows += self._host_manager.staged_row_count()
+            return rows
+        except Exception:
+            return 0
+
+    def _count_dropped_host_rows(self, rows):
+        """Record one failed host-tier cycle in tier_health. Runs inside
+        the apply/stage exception handlers, so it must never raise."""
+        self.tier_health["host_failed_cycles"] += 1
+        self.tier_health["host_dropped_row_updates"] += int(rows)
 
     def train_step_assembled(self, state, features, labels, weights):
         """Run the compiled step on already-prepared (possibly global

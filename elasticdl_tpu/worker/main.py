@@ -68,16 +68,11 @@ def build_worker(args):
 
 
 def main(argv=None):
-    import os
+    from elasticdl_tpu.common.platform_utils import (
+        honor_jax_platforms_env,
+    )
 
-    # honor JAX_PLATFORMS even when an ambient TPU plugin overrides the
-    # env var at import time (it force-sets jax_platforms; the config
-    # knob after import wins — same workaround as tests/conftest.py)
-    platform = os.environ.get("JAX_PLATFORMS")
-    if platform:
-        import jax
-
-        jax.config.update("jax_platforms", platform)
+    honor_jax_platforms_env()
     args = parse_worker_args(argv)
     logger.info(
         "Worker %d starting, master=%s", args.worker_id, args.master_addr

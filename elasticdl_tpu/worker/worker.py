@@ -178,6 +178,13 @@ class Worker(object):
         if exec_counters:
             for k, v in exec_counters.items():
                 req.exec_counters[k] = int(v)
+        # piggyback the trainer's tier-health gauges (cumulative host-
+        # tier drop counters) on every task report — the master turns
+        # tier/-prefixed counters into TensorBoard scalars
+        tier = getattr(self.trainer, "tier_health", None)
+        if tier and any(tier.values()):
+            for k, v in tier.items():
+                req.exec_counters["tier/" + k] = int(v)
         try:
             return self._master.report_task_result(req)
         except Exception as e:

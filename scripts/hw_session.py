@@ -340,6 +340,15 @@ def main():
         ("decode_spec_draft2",
          {"EDL_BENCH_MODEL": "decode",
           "EDL_BENCH_EXTRA_PARAMS": "spec_gamma=4"}),
+        # trained draft (api/distill.py): warm-start + 200 KL steps on
+        # the target's logits; acceptance + tokens/sec land in
+        # extra_params — the real-speedup story between floor and
+        # ceiling
+        ("decode_spec_trained",
+         {"EDL_BENCH_MODEL": "decode",
+          "EDL_BENCH_EXTRA_PARAMS":
+          "spec_gamma=4; spec_draft_layers=1; "
+          "spec_draft_train_steps=200"}),
         ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
         # sequence-packing overhead: same shapes, 4 segments per row
         # through the kernels' segment masks (vs the plain flagship)

@@ -171,6 +171,37 @@ def test_tensorboard_service_writes_metrics(tmp_path):
     assert b"auc" in data and b"loss" in data
 
 
+def test_tier_health_counters_reach_tensorboard(tmp_path):
+    """Worker-reported tier/ exec counters (host-tier dropped-row
+    gauges) become TensorBoard scalars through the master servicer —
+    the observability contract for the by-design 'rows miss one
+    update' degradation of the host embedding tier."""
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+    task_d = TaskDispatcher(
+        {"shard": (0, 8)}, {}, {}, records_per_task=8, num_epochs=1
+    )
+    tb = TensorboardService(str(tmp_path))
+    servicer = MasterServicer(4, task_d, tensorboard_service=tb)
+    task = servicer.get_task(pb.GetTaskRequest(worker_id=0))
+    req = pb.ReportTaskResultRequest(task_id=task.task_id)
+    req.exec_counters["tier/host_dropped_row_updates"] = 37
+    req.exec_counters["tier/host_failed_cycles"] = 2
+    req.exec_counters["unrelated"] = 5
+    servicer.report_task_result(req)
+    tb.stop()
+    files = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert files
+    data = open(files[0], "rb").read()
+    # per-worker tags: cumulative counters from different workers must
+    # not interleave on one scalar
+    assert b"tier/host_dropped_row_updates/worker-0" in data
+    assert b"tier/host_failed_cycles/worker-0" in data
+    assert b"unrelated" not in data
+
+
 # ----------------------------------------------------------- collective
 
 

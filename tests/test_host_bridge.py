@@ -148,6 +148,80 @@ def test_gradients_only_touch_pulled_rows():
     assert np.abs(after[touched] - before[touched]).max() > 0
 
 
+def test_engine_failure_counts_dropped_rows():
+    """A host-engine apply failure is contained (the step still
+    completes — the state was donated, so there is no retry) AND
+    observable: tier_health counts the failed cycle and the row updates
+    that were dropped, and a recovered engine stops the counters."""
+    host, manager = _host_trainer()
+    batches = _batches(3)
+    state = host.init_state(batches[0])
+    state, _ = host.train_step(state, batches[0])
+    assert host.tier_health == {
+        "host_failed_cycles": 0, "host_dropped_row_updates": 0,
+    }
+
+    engine = manager.tables()["edl_embedding"].engine
+    real_apply = engine.apply_gradients
+
+    def broken(*a, **kw):
+        raise RuntimeError("injected engine failure")
+
+    engine.apply_gradients = broken
+    state, loss = host.train_step(state, batches[1])
+    assert np.isfinite(float(loss))  # contained, not propagated
+    assert host.tier_health["host_failed_cycles"] == 1
+    expect_rows = manager.pending_row_count()
+    assert expect_rows > 0
+    assert host.tier_health["host_dropped_row_updates"] == expect_rows
+
+    engine.apply_gradients = real_apply
+    state, _ = host.train_step(state, batches[2])
+    assert host.tier_health["host_failed_cycles"] == 1
+
+
+def test_engine_failure_in_accum_cycle_counts_all_staged_rows():
+    """With gradient accumulation, a macro-boundary apply_staged
+    failure drops EVERY staged microbatch's row updates — the counter
+    must cover the whole cycle, not just the last microbatch."""
+    from model_zoo.deepfm_host_embedding import deepfm_host_embedding as zoo
+
+    spec = load_model_spec_from_module(zoo)
+    host = Trainer(
+        spec,
+        mesh=mesh_lib.local_mesh(),
+        model_params=format_params_str(
+            dict(input_length=LENGTH, fc_unit=FC)
+        ),
+        grad_accum_steps=2,
+    )
+    manager = HostEmbeddingManager()
+    for name, dim in (("edl_embedding", DIM), ("edl_id_bias", 1)):
+        manager.register(
+            name, "feature",
+            HostSpillEmbeddingEngine(dim, optimizer="sgd", lr=0.1),
+        )
+    host.attach_host_embeddings(manager)
+    batches = _batches(2)
+    state = host.init_state(batches[0])
+
+    for t in manager.tables().values():
+        t.engine.apply_gradients = lambda *a, **kw: (_ for _ in ()).throw(
+            RuntimeError("injected")
+        )
+    state, _ = host.train_step(state, batches[0])  # microbatch 1: stages
+    rows_mb1 = manager.staged_row_count()
+    assert rows_mb1 > 0
+    assert host.tier_health["host_failed_cycles"] == 0  # no apply yet
+    state, _ = host.train_step(state, batches[1])  # boundary: fails
+    assert host.tier_health["host_failed_cycles"] == 1
+    # both microbatches' staged rows counted, not just the last pull
+    assert (host.tier_health["host_dropped_row_updates"]
+            > manager.pending_row_count())
+    assert (host.tier_health["host_dropped_row_updates"]
+            >= rows_mb1 + manager.pending_row_count())
+
+
 def test_zoo_e2e_local_executor(tmp_path):
     """The deepfm_host_embedding zoo family trains + evaluates through
     the LocalExecutor like every other family (test_model_zoo pattern)."""

@@ -149,6 +149,40 @@ def test_quantized_state_checkpoint_roundtrip(tmp_path):
     assert all(flat[k].dtype == np.int8 for k in int8_keys)
 
 
+def test_distill_from_quantized_target():
+    """warm_start_draft/distill_draft accept an int8-quantized target
+    (dequantized float view) — the serving combo of quantization +
+    trained-draft speculative decode."""
+    from elasticdl_tpu.api.distill import distill_draft, warm_start_draft
+
+    trainer, state = _trained_trainer(steps=5)
+    # low min_size so the tiny model's kernels actually quantize
+    qstate = state.replace(
+        params=quantize_params(state.params, min_size=64)
+    )
+    assert is_quantized(qstate.params)
+    draft = Trainer(
+        load_model_spec_from_module(zoo),
+        mesh=mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        model_params=PARAMS,
+    )
+    d_state = draft.init_state(_cycle_batch())
+    d_warm = warm_start_draft(qstate, d_state)
+    # the copy lands dense floats, dequantized from the int8 view
+    np.testing.assert_allclose(
+        np.asarray(d_warm.params["wte"]["embedding"]),
+        np.asarray(dequantize_params(qstate.params)["wte"]["embedding"]),
+    )
+    assert not is_quantized(d_warm.params)
+    rs = np.random.RandomState(0)
+    d_new, losses = distill_draft(
+        trainer, qstate, draft, d_warm,
+        [rs.randint(0, 8, size=(4, 16)).astype(np.int32)
+         for _ in range(3)],
+    )
+    assert len(losses) == 3 and np.isfinite(losses).all()
+
+
 def test_quantized_speculative_decode():
     """Speculative decoding with an int8 target (and float draft) must
     equal the float target's greedy output — the serving combo of the
