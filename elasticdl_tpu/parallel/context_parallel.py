@@ -188,9 +188,14 @@ def _ring_fwd_impl(q, k, v, seg, axis_name, causal, scale, block_q,
             o, lse, k_cur, v_cur, kseg_cur = carry
         else:
             (o, lse, k_cur, v_cur), kseg_cur = carry, None
-        o, lse = merge(o, lse, k_cur, v_cur, kseg_cur, i)
+        # rotation FIRST, local attention second: the ppermute depends
+        # only on the held shard, so issuing it before the compute lets
+        # XLA's latency-hiding scheduler run the ICI transfer UNDER the
+        # flash kernel instead of after it (comm/compute overlap — the
+        # point of ring attention)
         rot = (k_cur, v_cur, kseg_cur) if has_seg else (k_cur, v_cur)
         rot = jax.lax.ppermute(rot, axis_name, perm)
+        o, lse = merge(o, lse, k_cur, v_cur, kseg_cur, i)
         return (o, lse) + rot, None
 
     o0 = jnp.zeros(q.shape, f32)
@@ -296,13 +301,21 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, window,
             dq, k_cur, v_cur, kseg_cur, dk_acc, dv_acc = carry
         else:
             (dq, k_cur, v_cur, dk_acc, dv_acc), kseg_cur = carry, None
+        # two permutes instead of one: the kv shards don't depend on
+        # this step's gradients, so their (large) transfer is issued
+        # BEFORE the kernels and can ride ICI under the compute; only
+        # the dk/dv accumulators — which need this step's results — pay
+        # an exposed hop
+        kv_rot = jax.lax.ppermute(
+            (k_cur, v_cur) + ((kseg_cur,) if has_seg else ()),
+            axis_name, perm,
+        )
         dq_i, dk_i, dv_i = grads(k_cur, v_cur, kseg_cur, i)
         dq = dq + dq_i
-        rot = (k_cur, v_cur) + ((kseg_cur,) if has_seg else ()) + (
-            dk_acc + dk_i, dv_acc + dv_i,
+        acc_rot = jax.lax.ppermute(
+            (dk_acc + dk_i, dv_acc + dv_i), axis_name, perm
         )
-        rot = jax.lax.ppermute(rot, axis_name, perm)
-        return (dq,) + rot, None
+        return (dq,) + kv_rot + acc_rot, None
 
     carry0 = (
         (jnp.zeros(q.shape, f32), k, v)

@@ -393,6 +393,15 @@ class TransformerLM(nn.Module):
     num_kv_heads: int = 0  # grouped-query attention (0 = MHA)
     lora_rank: int = 0  # attention-LoRA adapters (0 = off)
     lora_alpha: float = 16.0
+    # Per-block rematerialization for the training forward: recompute
+    # activations in the backward instead of saving them, trading
+    # ~1 extra block forward of FLOPs for O(num_layers) less live
+    # memory — the knob that admits larger global batches (bigger MXU
+    # tiles) when HBM, not FLOPs, limits the step. "" = off;
+    # "full" = save only block boundaries; "dots" = additionally save
+    # matmul outputs (jax dots_with_no_batch_dims_saveable — cheaper
+    # backward, smaller memory win). Decode/prefill are untouched.
+    remat: str = ""
 
     @nn.compact
     def __call__(self, features, training=False, decode=False,
@@ -457,8 +466,32 @@ class TransformerLM(nn.Module):
                 % (self.pos_emb,)
             )
         head_dim = self.embed_dim // self.num_heads
+        if self.remat not in ("", "full", "dots"):
+            raise ValueError(
+                "Unknown remat %r (valid: '', 'full', 'dots')"
+                % (self.remat,)
+            )
+        # remat applies to the training/eval forward only: decode and
+        # prefill run no backward, so recompute would be pure waste
+        use_remat = bool(self.remat) and not decode and not prefill
+        if use_remat:
+            policy = (
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                if self.remat == "dots" else None
+            )
+
+            # training is closure-static; segments/positions are
+            # non-differentiable int arrays, safe to close over
+            def run_block(blk, xx):
+                return blk(xx, training, segments=segments,
+                           positions=positions)
+
+            # default prevent_cse=True: the layer loop is unrolled (not
+            # nn.scan), and CSE could merge the recomputed forward with
+            # the primal one, silently negating the memory savings
+            run_block = nn.remat(run_block, policy=policy)
         for i in range(self.num_layers):
-            x = Block(
+            blk = Block(
                 self.num_heads, head_dim, dtype=self.dtype,
                 attn_impl=self.attn_impl, sp_impl=self.sp_impl,
                 tp_shard=self.tp_shard,
@@ -468,8 +501,13 @@ class TransformerLM(nn.Module):
                 num_kv_heads=self.num_kv_heads,
                 lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
                 name="block_%d" % i,
-            )(x, training, decode=decode, decode_pos=decode_pos,
-              prefill=prefill, segments=segments, positions=positions)
+            )
+            if use_remat:
+                x = run_block(blk, x)
+            else:
+                x = blk(x, training, decode=decode,
+                        decode_pos=decode_pos, prefill=prefill,
+                        segments=segments, positions=positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(
             self.vocab_size, dtype=self.dtype, name="head",

@@ -350,6 +350,16 @@ def main():
           "spec_gamma=4; spec_draft_layers=1; "
           "spec_draft_train_steps=200"}),
         ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
+        # per-block remat frees activation HBM -> bigger global batch,
+        # bigger MXU tiles; 'dots' keeps matmul outputs (cheaper bwd).
+        # Compare tokens/sec against the plain flagship: remat wins
+        # exactly when the freed memory converts to throughput
+        ("remat_full_batch64", {"EDL_BENCH_EXTRA_PARAMS":
+                                "remat='full'",
+                                "EDL_BENCH_BATCH": "64"}),
+        ("remat_dots_batch64", {"EDL_BENCH_EXTRA_PARAMS":
+                                "remat='dots'",
+                                "EDL_BENCH_BATCH": "64"}),
         # sequence-packing overhead: same shapes, 4 segments per row
         # through the kernels' segment masks (vs the plain flagship)
         ("packed4_flagship", {"EDL_BENCH_EXTRA_PARAMS": "packed=4"}),

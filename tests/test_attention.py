@@ -650,6 +650,67 @@ def test_flash_rectangular_segment_pair(causal):
     )
 
 
+def test_stream_clamps_cover_every_running_block():
+    """Property: the DMA-clamp ranges (which pin out-of-mask streamed
+    blocks to a resident index) must contain EVERY block the kernels
+    actually compute on — a clamp that excludes a run=True step would
+    silently feed the wrong k/v (or q) tile. Brute-forced against
+    _block_run over causal x window x block sizes x ring offsets."""
+    from elasticdl_tpu.ops.attention import (
+        _block_run,
+        _kv_stream_clamp,
+        _q_stream_clamp,
+    )
+
+    cases = 0
+    for causal in (False, True):
+        for window in (None, 8, 24, 64):
+            for block_q, block_k in ((16, 16), (16, 32), (32, 16),
+                                     (8, 64)):
+                for lq, lk in ((64, 64), (128, 64), (64, 128)):
+                    # offsets include fully-masked geometries (ring
+                    # rotations where no block runs) on purpose: the
+                    # clamps must still emit valid indices there
+                    for pos_offset in (0, -64, 64, lk):
+                        n_q, n_k = lq // block_q, lk // block_k
+                        kv_cl = _kv_stream_clamp(
+                            causal, window, block_q, block_k, n_k,
+                            pos_offset,
+                        )
+                        q_cl = _q_stream_clamp(
+                            causal, window, block_q, block_k, n_q,
+                            pos_offset,
+                        )
+                        if kv_cl is None:
+                            assert not causal and window is None
+                            continue
+                        for qi in range(n_q):
+                            for ki in range(n_k):
+                                if not bool(_block_run(
+                                        qi, ki, block_q, block_k,
+                                        causal, window, pos_offset)):
+                                    continue
+                                # a computing step must read its TRUE
+                                # block on both streamed sides
+                                assert int(kv_cl(qi, ki)) == ki, (
+                                    causal, window, block_q, block_k,
+                                    lq, lk, pos_offset, qi, ki,
+                                )
+                                assert int(q_cl(ki, qi)) == qi, (
+                                    causal, window, block_q, block_k,
+                                    lq, lk, pos_offset, qi, ki,
+                                )
+                                cases += 1
+                        # and every clamped index is a valid block
+                        for qi in range(n_q):
+                            for t in range(n_k):
+                                assert 0 <= int(kv_cl(qi, t)) < n_k
+                        for ki in range(n_k):
+                            for t in range(n_q):
+                                assert 0 <= int(q_cl(ki, t)) < n_q
+    assert cases > 1000  # the sweep actually exercised running blocks
+
+
 def _packed_seg_for_ring(b, l, seed=31):
     """Packing whose segments CROSS shard boundaries on an 8-way ring
     (l=64 -> 8-token shards; cuts not at multiples of 8)."""
