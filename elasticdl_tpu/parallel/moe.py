@@ -134,6 +134,44 @@ def moe_mlp_apply(params, x, capacity_factor=1.25, activation=jax.nn.gelu,
     return y, aux_loss, stats
 
 
+def moe_mlp_infer(params, x, activation=jax.nn.gelu, router_top_k=1):
+    """Drop-free top-k MoE MLP for DECODE/PREFILL: every token reaches
+    all k chosen experts, no capacity queues, no [T, E, C] dispatch
+    tensor (whose drop-free form is O(T^2 E) memory — unusable for a
+    long-prompt prefill). Instead each expert runs densely over all T
+    tokens and the combine mask zeroes non-chosen pairs: E-times the
+    dense-MLP FLOPs, O(T*H) memory. The right trade exactly where this
+    is used — decode steps (T = batch, tiny) and the one-time prefill
+    pass — and the reason cached MoE decode is deterministic: a token's
+    routing can't depend on which other tokens share its pass.
+
+    Combine weights match topk_dispatch with no drops: the raw chosen
+    prob for k=1 (Switch), the chosen-set-normalized probs for k>1
+    (GShard g1/g2). Returns y [T, D]."""
+    probs = jax.nn.softmax(
+        (x @ params["router"]).astype(jnp.float32), axis=-1
+    )
+    e = probs.shape[-1]
+    top_v, top_i = jax.lax.top_k(probs, router_top_k)  # [T, k]
+    if router_top_k == 1:
+        gates = top_v
+    else:
+        gates = top_v / jnp.maximum(
+            top_v.sum(-1, keepdims=True), 1e-9
+        )
+    y = jnp.zeros_like(x)
+    for ei in range(e):  # static unroll; E is a model-size constant
+        h = activation(
+            x @ params["w_up"][ei] + params["b_up"][ei]
+        )
+        out = h @ params["w_down"][ei] + params["b_down"][ei]
+        w_e = jnp.sum(
+            jnp.where(top_i == ei, gates, 0.0), axis=-1
+        ).astype(x.dtype)
+        y = y + w_e[:, None] * out
+    return y
+
+
 def moe_reference(params, x, capacity_factor=1.25,
                   activation=jax.nn.gelu, router_top_k=1):
     """Oracle: loop over tokens/experts in plain numpy-style code (tests

@@ -377,6 +377,42 @@ class LMHead(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def setup_decode_positions(mdl, tokens, decode, prefill, prompt_len):
+    """THE KV-decode position convention, shared by every decoder
+    family (TransformerLM here, TransformerMoE via import) so
+    api/generation.py's prefill/decode contract lives in one place:
+
+      * decode: one cached scalar counter ("cache"/"pos") that every
+        layer's cache write and the position-embedding lookup read;
+        advances by the chunk width (tokens [b, t], t >= 1).
+      * prefill: the counter is SET to the true prompt length (may be
+        < the padded prefill width) so the next decode step writes
+        position prompt_len.
+
+    Returns (decode_pos, wpe_idx): the pre-advance counter (None unless
+    decode) and the [1, t] index array a learned position table should
+    look up for this call."""
+    t = tokens.shape[1]
+    decode_pos = None
+    if decode:
+        pi = mdl.variable(
+            "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+        )
+        decode_pos = pi.value
+        pi.value = decode_pos + t
+        idx = (decode_pos + jnp.arange(t))[None, :]
+    else:
+        if prefill:
+            if prompt_len is None:
+                raise ValueError("prefill needs prompt_len")
+            pi = mdl.variable(
+                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+            )
+            pi.value = jnp.asarray(prompt_len, jnp.int32)
+        idx = jnp.arange(t)[None, :]
+    return decode_pos, idx
+
+
 class TransformerLM(nn.Module):
     vocab_size: int = 256
     seq_len: int = 128
@@ -426,40 +462,21 @@ class TransformerLM(nn.Module):
         x = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype, name="wte"
         )(tokens)
-        decode_pos = None
-        if decode:
-            # THE decode position counter: every layer's cache write,
-            # RoPE rotation and the wpe lookup read this one value.
-            # Advances by the chunk width (tokens [b, t], t >= 1).
-            pi = self.variable(
-                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
-            )
-            decode_pos = pi.value
-            pi.value = decode_pos + tokens.shape[1]
-        elif prefill:
-            # Batched prefill: one causal forward fills the per-layer
-            # caches for positions [0, prefill length); the counter is
-            # set to the TRUE prompt length (may be < the padded prefill
-            # length) so the next decode step writes position prompt_len.
-            if prompt_len is None:
-                raise ValueError("prefill needs prompt_len")
-            pi = self.variable(
-                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
-            )
-            pi.value = jnp.asarray(prompt_len, jnp.int32)
+        # shared decode-counter convention (setup_decode_positions):
+        # the counter drives every layer's cache write, RoPE rotation
+        # and the wpe lookup
+        decode_pos, wpe_idx = setup_decode_positions(
+            self, tokens, decode, prefill, prompt_len
+        )
         if self.pos_emb == "learned":
             wpe = nn.Embed(
                 self.seq_len, self.embed_dim, dtype=self.dtype,
                 name="wpe",
             )
-            if decode:
-                x = x + wpe(
-                    (decode_pos + jnp.arange(tokens.shape[1]))[None, :]
-                )
-            elif positions is not None:
+            if positions is not None and not decode:
                 x = x + wpe(positions)  # [b, l] packed offsets
             else:
-                x = x + wpe(jnp.arange(tokens.shape[1])[None, :])
+                x = x + wpe(wpe_idx)
         elif self.pos_emb != "rope":
             raise ValueError(
                 "Unknown pos_emb %r (valid: 'learned', 'rope')"

@@ -7,6 +7,19 @@ Attention reuses transformer_lm's CausalSelfAttention (flash/ring/TP
 annotations in one place). Training-mode outputs are a dict
 {"logits", "aux_loss"}: loss() adds the Switch load-balancing aux term;
 inference returns bare logits (eval metrics see one array).
+
+The family speaks the KV-cache decode convention (decode/prefill
+modes), so every generation strategy — greedy/sampled, beam,
+speculative, int8 — works on MoE models. Decode and prefill route
+DROP-FREE through the dense per-expert formulation (moe_mlp_infer):
+no capacity queues, so a decoded token's routing never depends on
+which other tokens share its pass — cached decode is deterministic
+and chunk-width-invariant. Training AND eval keep the capacity-
+bounded dispatch (fixed per-expert compute); uncached full-forward
+generation therefore matches cached decode exactly whenever the
+configured capacity admits every routing choice
+(capacity_factor >= num_experts / router_top_k guarantees it), and
+the cached path is the canonical generation semantics otherwise.
 """
 
 import numpy as np
@@ -17,10 +30,11 @@ from flax import linen as nn
 
 from elasticdl_tpu.common.constants import MeshAxis, Mode
 from elasticdl_tpu.data.example_codec import decode_example
-from elasticdl_tpu.parallel.moe import moe_mlp_apply
+from elasticdl_tpu.parallel.moe import moe_mlp_apply, moe_mlp_infer
 from model_zoo.transformer_lm.transformer_lm import (
     CausalSelfAttention,
     resolve_dtype,
+    setup_decode_positions,
 )
 
 AUX_LOSS_WEIGHT = 0.01
@@ -50,15 +64,19 @@ class MoEBlock(nn.Module):
     dtype: object = None
     attn_impl: str = "auto"
     tp_shard: bool = True
+    cache_len: int = 0  # KV-cache capacity for decode/prefill
 
     @nn.compact
-    def __call__(self, x, training=False):
+    def __call__(self, x, training=False, decode=False, decode_pos=None,
+                 prefill=False):
         b, l, e = x.shape
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
             self.num_heads, self.head_dim, dtype=self.dtype,
-            attn_impl=self.attn_impl, tp_shard=self.tp_shard, name="attn",
-        )(y, training)
+            attn_impl=self.attn_impl, tp_shard=self.tp_shard,
+            cache_len=self.cache_len, name="attn",
+        )(y, training, decode=decode, decode_pos=decode_pos,
+          prefill=prefill)
         y = nn.LayerNorm(dtype=self.dtype)(x)
 
         h = self.mlp_ratio * e
@@ -99,6 +117,18 @@ class MoEBlock(nn.Module):
             ),
         }
         flat = y.reshape(b * l, e)
+        if decode or prefill:
+            # Generation routes DROP-FREE through the dense per-expert
+            # formulation (parallel/moe.py moe_mlp_infer): no capacity
+            # queues, so a decoded token's routing never depends on
+            # which other tokens share its pass — cached decode is
+            # deterministic and chunk-width-invariant. Training and
+            # eval keep the capacity-bounded dispatch (fixed compute;
+            # drops ride the residual).
+            out = moe_mlp_infer(
+                params, flat, router_top_k=self.router_top_k
+            )
+            return x + out.reshape(b, l, e), 0.0
         out, aux_loss, _ = moe_mlp_apply(
             params, flat, capacity_factor=self.capacity_factor,
             router_top_k=self.router_top_k,
@@ -120,15 +150,23 @@ class TransformerMoE(nn.Module):
     tp_shard: bool = True
 
     @nn.compact
-    def __call__(self, features, training=False):
+    def __call__(self, features, training=False, decode=False,
+                 prefill=False, prompt_len=None):
         tokens = features["tokens"]
+        if decode and prefill:
+            raise ValueError("decode and prefill are mutually exclusive")
         x = nn.Embed(
             self.vocab_size, self.embed_dim, dtype=self.dtype, name="wte"
         )(tokens)
-        pos = nn.Embed(
+        # shared decode-counter convention (transformer_lm
+        # setup_decode_positions — the one place the generation API's
+        # prefill/decode contract is implemented)
+        decode_pos, wpe_idx = setup_decode_positions(
+            self, tokens, decode, prefill, prompt_len
+        )
+        x = x + nn.Embed(
             self.seq_len, self.embed_dim, dtype=self.dtype, name="wpe"
-        )(jnp.arange(tokens.shape[1])[None, :])
-        x = x + pos
+        )(wpe_idx)
         head_dim = self.embed_dim // self.num_heads
         aux_total = 0.0
         for i in range(self.num_layers):
@@ -137,8 +175,10 @@ class TransformerMoE(nn.Module):
                 capacity_factor=self.capacity_factor,
                 router_top_k=self.router_top_k, dtype=self.dtype,
                 attn_impl=self.attn_impl, tp_shard=self.tp_shard,
+                cache_len=self.seq_len,
                 name="block_%d" % i,
-            )(x, training)
+            )(x, training, decode=decode, decode_pos=decode_pos,
+              prefill=prefill)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(

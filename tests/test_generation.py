@@ -83,20 +83,22 @@ def test_generate_contract():
     with pytest.raises(ValueError, match="causal"):
         autoregressive_generate(t_bert, b_state, prompt, 5)
     # a causal model without decode support must be refused for
-    # use_cache, not crash inside tracing
-    from model_zoo.transformer_moe import transformer_moe as moe_zoo
+    # use_cache, not crash inside tracing (the pipeline family has no
+    # decode/prefill modes; the MoE family gained them — see
+    # tests/test_moe.py for its decode parity)
+    from model_zoo.transformer_pp import transformer_pp as pp_zoo
 
-    t_moe = Trainer(
-        load_model_spec_from_module(moe_zoo),
+    t_pp = Trainer(
+        load_model_spec_from_module(pp_zoo),
         mesh=mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1]),
         model_params=(
             "vocab_size=8; seq_len=16; embed_dim=32; num_heads=2; "
-            "num_layers=1; num_experts=2; attn_impl='xla'"
+            "num_layers=1; num_microbatches=1"
         ),
     )
-    m_state = t_moe.init_state(_cycle_batch())
+    p_state = t_pp.init_state(_cycle_batch())
     with pytest.raises(ValueError, match="decode"):
-        autoregressive_generate(t_moe, m_state, prompt, 5,
+        autoregressive_generate(t_pp, p_state, prompt, 5,
                                 use_cache=True)
 
 

@@ -59,6 +59,29 @@ def test_top2_matches_token_loop_oracle():
     assert float(aux) > 0
 
 
+def test_infer_formulation_matches_dispatch_at_full_capacity():
+    """moe_mlp_infer (dense per-expert, drop-free — the decode/prefill
+    path) must equal moe_mlp_apply when the dispatch capacity admits
+    every choice (cf = E/k), for both Switch and GShard routing — the
+    two formulations are the same math with and without the [T, E, C]
+    queues."""
+    for k, seed in ((1, 5), (2, 6)):
+        params = _moe_params(seed=seed)
+        x = jnp.asarray(
+            np.random.default_rng(seed + 10).standard_normal((32, 8)),
+            jnp.float32,
+        )
+        y_infer = moe.moe_mlp_infer(params, x, router_top_k=k)
+        y_disp, _, stats = moe.moe_mlp_apply(
+            params, x, capacity_factor=4.0 / k, router_top_k=k
+        )
+        assert float(stats["dropped_fraction"]) == 0.0
+        np.testing.assert_allclose(
+            np.asarray(y_infer), np.asarray(y_disp),
+            atol=1e-5, rtol=1e-4,
+        )
+
+
 def test_top2_combine_weights_renormalized():
     """Every token kept in both choices must have combine weights that
     sum to exactly 1 (GShard g1/g2 normalization); with generous
@@ -184,6 +207,68 @@ def test_ep_mesh_matches_single_device():
         e_state, le = ep.train_step(e_state, batch)
         np.testing.assert_allclose(float(le), float(ls), rtol=1e-4,
                                    atol=1e-5)
+
+
+def test_moe_kv_decode_matches_full_forward():
+    """The MoE family speaks the KV-cache convention: cached decode
+    (batched prefill + per-token steps) must produce exactly the tokens
+    of the uncached full-forward decode. Decode/prefill route drop-free
+    (moe_mlp_infer); the uncached forward is capacity-bounded, so the
+    test sets capacity_factor = num_experts / top_k — the documented
+    threshold above which the two formulations provably agree."""
+    from model_zoo.transformer_moe import transformer_moe as moe_zoo
+
+    from elasticdl_tpu.api.generation import autoregressive_generate
+
+    trainer = Trainer(
+        load_model_spec_from_module(moe_zoo),
+        mesh=mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        model_params=format_params_str(
+            dict(vocab_size=16, seq_len=24, embed_dim=32, num_heads=2,
+                 num_layers=2, num_experts=4, router_top_k=2,
+                 capacity_factor=2.0,  # = E/k: uncached is drop-free too
+                 attn_impl="xla")
+        ),
+    )
+    rs = np.random.RandomState(3)
+    toks = rs.randint(0, 16, size=(4, 25)).astype(np.int32)
+    batch = ({"tokens": toks[:, :-1]}, toks[:, 1:])
+    state = trainer.init_state(batch)
+    for step in range(30):
+        rs2 = np.random.RandomState(step)
+        t2 = rs2.randint(0, 16, size=(4, 25)).astype(np.int32)
+        state, _ = trainer.train_step(
+            state, ({"tokens": t2[:, :-1]}, t2[:, 1:])
+        )
+    prompt = np.asarray([[1, 2, 3], [4, 5, 6], [7, 8, 9], [3, 1, 2]],
+                        np.int32)
+    full = np.asarray(
+        autoregressive_generate(trainer, state, prompt, 8)
+    )
+    kv = np.asarray(
+        autoregressive_generate(trainer, state, prompt, 8,
+                                use_cache=True)
+    )
+    np.testing.assert_array_equal(full, kv)
+
+    # the other strategies ride the same convention: beam(1) and
+    # self-draft speculative must reproduce the greedy stream
+    from elasticdl_tpu.api.generation import (
+        beam_search_generate,
+        speculative_generate,
+    )
+
+    beam = np.asarray(
+        beam_search_generate(trainer, state, prompt, 8, num_beams=2,
+                             use_cache=True)
+    )
+    assert beam.shape == full.shape  # beam>1 may beat greedy; shape+range
+    assert beam.min() >= 0 and beam.max() < 16
+    spec = np.asarray(
+        speculative_generate(trainer, state, trainer, state, prompt, 8,
+                             gamma=3)
+    )
+    np.testing.assert_array_equal(full, spec)
 
 
 def test_zoo_e2e_local_executor(tmp_path):
