@@ -26,6 +26,7 @@ from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.ops.dispatch import (
     interpret_mode,
     is_tpu_backend,
+    use_cond_mask,
     use_pallas,
 )
 
@@ -418,6 +419,49 @@ def _block_mask(s, qi, ki, block_q, block_k, causal, window,
                 pos_offset=0):
     if not causal and window is None:
         return s
+    if use_cond_mask():
+        # Interior blocks — fully inside the causal/window region — need
+        # no per-element mask: branch it out so only edge blocks pay the
+        # iota/compare/select VPU work (~half the running blocks are
+        # interior for plain causal). Opt-in (EDL_FLASH_COND_MASK=1)
+        # until the hardware A/B proves the branch beats the
+        # straight-line select under Mosaic's pipeliner.
+        interior = _block_interior(qi, ki, block_q, block_k, causal,
+                                   window, pos_offset)
+        return jax.lax.cond(
+            interior,
+            lambda ss: ss,
+            lambda ss: _block_mask_apply(
+                ss, qi, ki, block_q, block_k, causal, window,
+                pos_offset,
+            ),
+            s,
+        )
+    return _block_mask_apply(s, qi, ki, block_q, block_k, causal,
+                             window, pos_offset)
+
+
+def _block_interior(qi, ki, block_q, block_k, causal, window,
+                    pos_offset):
+    """Dynamic predicate: every (q, k) pair in the block is visible, so
+    the per-element mask is the identity. Causal: the newest key is at
+    or before the oldest query. Window: the extreme pair distances stay
+    inside the band."""
+    q0 = qi * block_q + pos_offset
+    inside = True
+    if causal:
+        inside = ki * block_k + block_k - 1 <= q0
+    if window is not None:
+        back = (q0 + block_q - 1) - ki * block_k < window
+        inside = jnp.logical_and(inside, back)
+        if not causal:
+            fwd = (ki * block_k + block_k - 1) - q0 < window
+            inside = jnp.logical_and(inside, fwd)
+    return inside
+
+
+def _block_mask_apply(s, qi, ki, block_q, block_k, causal, window,
+                      pos_offset):
     q_pos = qi * block_q + pos_offset + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )

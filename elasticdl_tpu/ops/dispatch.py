@@ -34,6 +34,14 @@ def use_pallas():
     return is_tpu_backend()
 
 
+def use_cond_mask():
+    """Opt-in (EDL_FLASH_COND_MASK=1): branch the flash kernels'
+    per-element causal/window mask out of interior (fully-visible)
+    blocks via lax.cond — an hw_session A/B candidate; default stays
+    the straight-line select until hardware proves the branch wins."""
+    return os.environ.get("EDL_FLASH_COND_MASK", "") == "1"
+
+
 def interpret_mode():
     """interpret= flag for pallas_call: compiled only on a real TPU.
 

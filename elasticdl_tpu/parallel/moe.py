@@ -159,16 +159,17 @@ def moe_mlp_infer(params, x, activation=jax.nn.gelu, router_top_k=1):
         gates = top_v / jnp.maximum(
             top_v.sum(-1, keepdims=True), 1e-9
         )
-    y = jnp.zeros_like(x)
+    # f32 gates and accumulator, like moe_mlp_apply's combine — the
+    # bit-parity of the two formulations (and so cached-vs-uncached
+    # decode equality) must hold for bf16-configured models too
+    y = jnp.zeros(x.shape, jnp.float32)
     for ei in range(e):  # static unroll; E is a model-size constant
         h = activation(
             x @ params["w_up"][ei] + params["b_up"][ei]
         )
         out = h @ params["w_down"][ei] + params["b_down"][ei]
-        w_e = jnp.sum(
-            jnp.where(top_i == ei, gates, 0.0), axis=-1
-        ).astype(x.dtype)
-        y = y + w_e[:, None] * out
+        w_e = jnp.sum(jnp.where(top_i == ei, gates, 0.0), axis=-1)
+        y = y + w_e[:, None] * out.astype(jnp.float32)
     return y
 
 

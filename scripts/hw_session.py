@@ -360,6 +360,11 @@ def main():
         ("remat_dots_batch64", {"EDL_BENCH_EXTRA_PARAMS":
                                 "remat='dots'",
                                 "EDL_BENCH_BATCH": "64"}),
+        # branch the per-element causal mask out of interior blocks
+        # (lax.cond in-kernel) — wins only if Mosaic pipelines across
+        # the branch; falls back to the default straight-line select
+        # if this step regresses or fails to lower
+        ("condmask_flagship", {"EDL_FLASH_COND_MASK": "1"}),
         # sequence-packing overhead: same shapes, 4 segments per row
         # through the kernels' segment masks (vs the plain flagship)
         ("packed4_flagship", {"EDL_BENCH_EXTRA_PARAMS": "packed=4"}),

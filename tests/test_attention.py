@@ -650,6 +650,39 @@ def test_flash_rectangular_segment_pair(causal):
     )
 
 
+@pytest.mark.parametrize("causal,window", [(True, None), (True, 16),
+                                           (False, 16)])
+def test_cond_mask_matches_default(monkeypatch, causal, window):
+    """EDL_FLASH_COND_MASK=1 branches the per-element mask out of
+    interior blocks; outputs and gradients must equal the default
+    straight-line-select path exactly."""
+    rs = np.random.RandomState(77)
+    q = jnp.asarray(rs.randn(2, 2, 64, 128).astype(np.float32) * 0.3)
+    k = jnp.asarray(rs.randn(2, 2, 64, 128).astype(np.float32) * 0.3)
+    v = jnp.asarray(rs.randn(2, 2, 64, 128).astype(np.float32) * 0.3)
+
+    def run():
+        def loss(q, k, v):
+            return (flash_attention(
+                q, k, v, causal=causal, window=window,
+                block_q=16, block_k=16,
+            ) ** 2).sum()
+
+        out = flash_attention(q, k, v, causal=causal, window=window,
+                              block_q=16, block_k=16)
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return out, grads
+
+    monkeypatch.delenv("EDL_FLASH_COND_MASK", raising=False)
+    out_ref, g_ref = run()
+    monkeypatch.setenv("EDL_FLASH_COND_MASK", "1")
+    out_cond, g_cond = run()
+    np.testing.assert_array_equal(np.asarray(out_ref),
+                                  np.asarray(out_cond))
+    for a, b in zip(g_ref, g_cond):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_stream_clamps_cover_every_running_block():
     """Property: the DMA-clamp ranges (which pin out-of-mask streamed
     blocks to a resident index) must contain EVERY block the kernels

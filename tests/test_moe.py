@@ -252,18 +252,24 @@ def test_moe_kv_decode_matches_full_forward():
     np.testing.assert_array_equal(full, kv)
 
     # the other strategies ride the same convention: beam(1) and
-    # self-draft speculative must reproduce the greedy stream
+    # self-draft speculative must reproduce the greedy stream exactly;
+    # beam(2) exercises the cache-row gathers for shape/range
     from elasticdl_tpu.api.generation import (
         beam_search_generate,
         speculative_generate,
     )
 
-    beam = np.asarray(
+    beam1 = np.asarray(
+        beam_search_generate(trainer, state, prompt, 8, num_beams=1,
+                             use_cache=True)
+    )
+    np.testing.assert_array_equal(full, beam1)
+    beam2 = np.asarray(
         beam_search_generate(trainer, state, prompt, 8, num_beams=2,
                              use_cache=True)
     )
-    assert beam.shape == full.shape  # beam>1 may beat greedy; shape+range
-    assert beam.min() >= 0 and beam.max() < 16
+    assert beam2.shape == full.shape
+    assert beam2.min() >= 0 and beam2.max() < 16
     spec = np.asarray(
         speculative_generate(trainer, state, trainer, state, prompt, 8,
                              gamma=3)
