@@ -51,6 +51,17 @@ def _tp_dense_init(split_axis):
     )
 
 
+def _kv_quantize_rows(rows):
+    """Symmetric per-row int8 for the KV cache: rows [b, hkv, t, d] ->
+    (int8 rows, f32 scales [b, hkv, t, 1]); a zero row keeps scale 1 so
+    it stays exactly zero."""
+    r32 = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(r32), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q8 = jnp.clip(jnp.round(r32 / scale), -127, 127).astype(jnp.int8)
+    return q8, scale
+
+
 class CausalSelfAttention(nn.Module):
     """Self-attention block shared by the decoder (causal=True) and the
     BERT-class encoder (causal=False, model_zoo/bert)."""
@@ -81,6 +92,79 @@ class CausalSelfAttention(nn.Module):
     # adapters only.
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # KV-cache storage format: "" = compute dtype; "int8" = symmetric
+    # per-row int8 with f32 scales. Decode is cache-bandwidth-bound
+    # (every generated token re-reads the whole cache), so int8 halves
+    # (vs bf16) the dominant HBM stream; the dequantize fuses into the
+    # attention reads. Write-side rounding costs one quantize per
+    # generated token — negligible next to the read stream.
+    kv_cache_dtype: str = ""
+
+    def _cache_vars(self, b, hkv, d, dtype):
+        """The cache buffers in the configured storage format. Returns
+        (ck, cv, k_scale, v_scale) — scale vars are None for the
+        plain-dtype format."""
+        if self.kv_cache_dtype not in ("", "int8"):
+            raise ValueError(
+                "Unknown kv_cache_dtype %r (valid: '', 'int8')"
+                % (self.kv_cache_dtype,)
+            )
+        if self.kv_cache_dtype == "int8":
+            shape = (b, hkv, self.cache_len, d)
+            sshape = (b, hkv, self.cache_len, 1)
+            return (
+                self.variable("cache", "k", jnp.zeros, shape, jnp.int8),
+                self.variable("cache", "v", jnp.zeros, shape, jnp.int8),
+                self.variable("cache", "k_scale", jnp.zeros, sshape,
+                              jnp.float32),
+                self.variable("cache", "v_scale", jnp.zeros, sshape,
+                              jnp.float32),
+            )
+        shape = (b, hkv, self.cache_len, d)
+        return (
+            self.variable("cache", "k", jnp.zeros, shape, dtype),
+            self.variable("cache", "v", jnp.zeros, shape, dtype),
+            None, None,
+        )
+
+    def _cache_write(self, cvars, k, v, idx):
+        """Store chunk rows [b, hkv, t, d] at position idx (k already
+        RoPE-rotated at its absolute positions)."""
+        ck, cv, ks, vs = cvars
+        if ks is None:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(ck.value.dtype), (0, 0, idx, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cv.value.dtype), (0, 0, idx, 0)
+            )
+            return
+        kq, ksc = _kv_quantize_rows(k)
+        vq, vsc = _kv_quantize_rows(v)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, kq, (0, 0, idx, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, vq, (0, 0, idx, 0)
+        )
+        ks.value = jax.lax.dynamic_update_slice(
+            ks.value, ksc, (0, 0, idx, 0)
+        )
+        vs.value = jax.lax.dynamic_update_slice(
+            vs.value, vsc, (0, 0, idx, 0)
+        )
+
+    def _cache_read(self, cvars, dtype):
+        """The full cache as compute-dtype floats; for int8 storage the
+        dequantize (q8 * scale) fuses into the consuming attention
+        einsums — the HBM stream stays int8."""
+        ck, cv, ks, vs = cvars
+        if ks is None:
+            return ck.value, cv.value
+        return (
+            (ck.value.astype(jnp.float32) * ks.value).astype(dtype),
+            (cv.value.astype(jnp.float32) * vs.value).astype(dtype),
+        )
 
     def _lora_branch(self, x, features, name):
         """(x @ A @ B) * alpha/rank — A lecun-init, B zeros."""
@@ -155,21 +239,8 @@ class CausalSelfAttention(nn.Module):
                     "prefill length %d exceeds cache_len %d"
                     % (l, self.cache_len)
                 )
-            dtype = q.dtype
-            ck = self.variable(
-                "cache", "k", jnp.zeros, (b, hkv, self.cache_len, d),
-                dtype,
-            )
-            cv = self.variable(
-                "cache", "v", jnp.zeros, (b, hkv, self.cache_len, d),
-                dtype,
-            )
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(dtype), (0, 0, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(dtype), (0, 0, 0, 0)
-            )
+            cvars = self._cache_vars(b, hkv, d, q.dtype)
+            self._cache_write(cvars, k, v, 0)
         if self.attn_impl not in ("auto", "xla", "jax_flash"):
             raise ValueError(
                 "Unknown attn_impl %r (valid: 'auto', 'xla', "
@@ -263,28 +334,19 @@ class CausalSelfAttention(nn.Module):
         hkv = k.shape[1]
         group = h // hkv
         dtype = q.dtype
-        ck = self.variable(
-            "cache", "k", jnp.zeros, (b, hkv, self.cache_len, d), dtype
-        )
-        cv = self.variable(
-            "cache", "v", jnp.zeros, (b, hkv, self.cache_len, d), dtype
-        )
+        cvars = self._cache_vars(b, hkv, d, dtype)
         idx = decode_pos
         if self.use_rope:
             pos = idx + jnp.arange(t)
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(dtype), (0, 0, idx, 0)
-        )
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(dtype), (0, 0, idx, 0)
-        )
+        self._cache_write(cvars, k, v, idx)
+        ckf, cvf = self._cache_read(cvars, dtype)
         scale = d ** -0.5
         # group the q heads under their kv head: [b, hkv, group, t, d]
         qg = (q * scale).reshape(b, hkv, group, t, d)
         s = jnp.einsum(
-            "bhgtd,bhkd->bhgtk", qg, ck.value
+            "bhgtd,bhkd->bhgtk", qg, ckf
         ).astype(jnp.float32)  # [b, hkv, group, t, L]
         k_pos = jnp.arange(self.cache_len)[None, :]
         row_pos = (idx + jnp.arange(t))[:, None]
@@ -293,7 +355,7 @@ class CausalSelfAttention(nn.Module):
             valid = valid & (k_pos > row_pos - self.window)
         s = jnp.where(valid[None, None, None], s, NEG_INF)
         w = jax.nn.softmax(s, axis=-1).astype(dtype)
-        out = jnp.einsum("bhgtk,bhkd->bhgtd", w, cv.value)
+        out = jnp.einsum("bhgtk,bhkd->bhgtd", w, cvf)
         # (hkv, group) flattens back to h in q's head order
         out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h * d)
         return self._proj(out, e)
@@ -314,6 +376,7 @@ class Block(nn.Module):
     num_kv_heads: int = 0  # grouped-query attention (0 = MHA)
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    kv_cache_dtype: str = ""  # "" | "int8" (see CausalSelfAttention)
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
@@ -328,6 +391,7 @@ class Block(nn.Module):
             cache_len=self.cache_len,
             num_kv_heads=self.num_kv_heads,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(y, training, decode=decode, decode_pos=decode_pos,
           prefill=prefill, segments=segments, positions=positions)
@@ -438,6 +502,9 @@ class TransformerLM(nn.Module):
     # matmul outputs (jax dots_with_no_batch_dims_saveable — cheaper
     # backward, smaller memory win). Decode/prefill are untouched.
     remat: str = ""
+    # KV-cache storage: "" = compute dtype; "int8" halves (vs bf16) the
+    # decode path's dominant HBM stream (see CausalSelfAttention)
+    kv_cache_dtype: str = ""
 
     @nn.compact
     def __call__(self, features, training=False, decode=False,
@@ -517,6 +584,7 @@ class TransformerLM(nn.Module):
                 cache_len=self.seq_len,
                 num_kv_heads=self.num_kv_heads,
                 lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                kv_cache_dtype=self.kv_cache_dtype,
                 name="block_%d" % i,
             )
             if use_remat:

@@ -329,6 +329,16 @@ def main():
         # (dequant fused into the matmuls); vs the bf16 decode target
         ("decode_int8", {"EDL_BENCH_MODEL": "decode",
                          "EDL_BENCH_EXTRA_PARAMS": "quantize=1"}),
+        # int8 KV cache: the decode path's dominant HBM stream (the
+        # per-token cache re-read) halves vs bf16; combines with
+        # weight int8 for the full bandwidth story
+        ("decode_kv_int8", {"EDL_BENCH_MODEL": "decode",
+                            "EDL_BENCH_EXTRA_PARAMS":
+                            "kv_cache_dtype='int8'"}),
+        ("decode_kv_plus_w_int8",
+         {"EDL_BENCH_MODEL": "decode",
+          "EDL_BENCH_EXTRA_PARAMS":
+          "kv_cache_dtype='int8'; quantize=1"}),
         # KV-cached beam search: per-step cache gathers at width 4
         ("decode_beam4", {"EDL_BENCH_MODEL": "decode",
                           "EDL_BENCH_EXTRA_PARAMS": "beams=4"}),

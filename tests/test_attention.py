@@ -855,13 +855,16 @@ def test_flash_rectangular_pair_gradients():
     np.testing.assert_array_equal(masked_dq, 0.0)
 
 
-def test_flash_config_fuzz_vs_oracle():
+def test_flash_config_fuzz_vs_oracle(monkeypatch):
     """Seeded sweep across the kernel config lattice (causal x window x
-    GQA x segments x block sizes x rectangular shapes) in interpret
-    mode vs the naive oracle — forward always, gradients on a subset.
-    Catches interaction bugs no single-feature test exercises."""
+    GQA x segments x block sizes x rectangular shapes x cond-mask) in
+    interpret mode vs the naive oracle — forward always, gradients on a
+    subset. Catches interaction bugs no single-feature test exercises."""
     rs = np.random.RandomState(123)
     for trial in range(10):
+        monkeypatch.setenv(
+            "EDL_FLASH_COND_MASK", "1" if rs.randint(2) else ""
+        )
         causal = bool(rs.randint(2))
         lq = int(rs.choice([16, 32, 48]))
         rect = (not causal) and rs.randint(2)

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from elasticdl_tpu.api.generation import autoregressive_generate
 from elasticdl_tpu.common.model_utils import load_model_spec_from_module
@@ -431,6 +432,74 @@ def test_speculative_matches_target_greedy():
             np.testing.assert_array_equal(
                 ref, got, err_msg="%s gamma=%d" % (name, gamma)
             )
+
+
+def test_int8_kv_cache_decode():
+    """kv_cache_dtype='int8' stores the decode cache as per-row int8
+    with f32 scales — the cache-bandwidth knob. On a trained cycle
+    model (decisive margins) the greedy tokens must equal the float-
+    cache decode for plain, RoPE, GQA, and beam/speculative paths, and
+    the cache leaves must actually be int8."""
+    from elasticdl_tpu.api.generation import (
+        beam_search_generate,
+        speculative_generate,
+    )
+
+    for extra in ("", "; pos_emb='rope'", "; num_kv_heads=1"):
+        mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+        t_f = Trainer(
+            load_model_spec_from_module(zoo), mesh=mesh,
+            model_params=PARAMS + extra,
+        )
+        t_q = Trainer(
+            load_model_spec_from_module(zoo), mesh=mesh,
+            model_params=PARAMS + extra + "; kv_cache_dtype='int8'",
+        )
+        state = t_f.init_state(_cycle_batch())
+        for step in range(200):
+            state, loss = t_f.train_step(state, _cycle_batch(seed=step))
+        assert float(loss) < 0.25
+        # same params serve both trainers (the knob changes only the
+        # cache buffers, not the param tree)
+        prompt = np.asarray([[2, 3, 4], [5, 6, 7]], np.int32)
+        ref = np.asarray(
+            autoregressive_generate(t_f, state, prompt, 6,
+                                    use_cache=True)
+        )
+        got = np.asarray(
+            autoregressive_generate(t_q, state, prompt, 6,
+                                    use_cache=True)
+        )
+        np.testing.assert_array_equal(ref, got, err_msg=extra)
+        if not extra:
+            # cache leaves really are int8 (+ f32 scales)
+            kv = jax.eval_shape(
+                lambda: t_q.model.init(
+                    jax.random.PRNGKey(0),
+                    {"tokens": jnp.zeros((2, 1), jnp.int32)},
+                    training=False, decode=True,
+                )
+            )["cache"]
+            leaves = {
+                jax.tree_util.keystr(p): leaf.dtype
+                for p, leaf in
+                jax.tree_util.tree_flatten_with_path(kv)[0]
+            }
+            assert any(d == jnp.int8 for d in leaves.values()), leaves
+            assert any(
+                d == jnp.float32 for k, d in leaves.items()
+                if "scale" in k
+            ), leaves
+            beam = np.asarray(
+                beam_search_generate(t_q, state, prompt, 6,
+                                     num_beams=2, use_cache=True)
+            )
+            np.testing.assert_array_equal(ref, beam)
+            spec = np.asarray(
+                speculative_generate(t_q, state, t_q, state, prompt, 6,
+                                     gamma=3)
+            )
+            np.testing.assert_array_equal(ref, spec)
 
 
 def test_distilled_draft_raises_acceptance():
