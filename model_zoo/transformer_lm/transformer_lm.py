@@ -246,6 +246,13 @@ class CausalSelfAttention(nn.Module):
                 "Unknown attn_impl %r (valid: 'auto', 'xla', "
                 "'jax_flash')" % (self.attn_impl,)
             )
+        if self.kv_cache_dtype not in ("", "int8"):
+            # eager: a typo must fail the first TRAINING forward, not
+            # hours later at the first cached generation
+            raise ValueError(
+                "Unknown kv_cache_dtype %r (valid: '', 'int8')"
+                % (self.kv_cache_dtype,)
+            )
         window = self.window or None
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(MeshAxis.SP, 1) > 1:

@@ -65,6 +65,7 @@ class MoEBlock(nn.Module):
     attn_impl: str = "auto"
     tp_shard: bool = True
     cache_len: int = 0  # KV-cache capacity for decode/prefill
+    kv_cache_dtype: str = ""  # "" | "int8" (see CausalSelfAttention)
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
@@ -74,7 +75,8 @@ class MoEBlock(nn.Module):
         x = x + CausalSelfAttention(
             self.num_heads, self.head_dim, dtype=self.dtype,
             attn_impl=self.attn_impl, tp_shard=self.tp_shard,
-            cache_len=self.cache_len, name="attn",
+            cache_len=self.cache_len,
+            kv_cache_dtype=self.kv_cache_dtype, name="attn",
         )(y, training, decode=decode, decode_pos=decode_pos,
           prefill=prefill)
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -148,6 +150,7 @@ class TransformerMoE(nn.Module):
     dtype: object = None
     attn_impl: str = "auto"
     tp_shard: bool = True
+    kv_cache_dtype: str = ""  # "" | "int8" (see CausalSelfAttention)
 
     @nn.compact
     def __call__(self, features, training=False, decode=False,
@@ -176,6 +179,7 @@ class TransformerMoE(nn.Module):
                 router_top_k=self.router_top_k, dtype=self.dtype,
                 attn_impl=self.attn_impl, tp_shard=self.tp_shard,
                 cache_len=self.seq_len,
+                kv_cache_dtype=self.kv_cache_dtype,
                 name="block_%d" % i,
             )(x, training, decode=decode, decode_pos=decode_pos,
               prefill=prefill)
