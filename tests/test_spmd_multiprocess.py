@@ -116,31 +116,59 @@ def test_two_process_host_embedding_parity(tmp_path):
             )
 
 
+class _DrillInfraError(AssertionError):
+    """Infra-class drill failure (timeout / dead subprocess) — the
+    load-sensitive mode the single retry is allowed to absorb. The
+    post-completion correctness assertions (step parity, dispatcher
+    drained, eval aggregated) are NOT this class and fail hard."""
+
+
 @pytest.mark.slow
 def test_two_process_spmd_train(tmp_path):
+    """Known load-sensitive drill (see .claude/skills/verify/SKILL.md):
+    the two jax subprocesses + master can outlast their gRPC deadlines
+    under heavily parallel pytest runs. One retry with a fresh master/
+    ports absorbs INFRA failures only (timeouts, dead subprocesses);
+    correctness assertions fail hard, and a real infra regression
+    fails both attempts."""
+    import warnings
+
+    try:
+        _two_process_spmd_drill(tmp_path / "a")
+    except _DrillInfraError as e:
+        warnings.warn(
+            "two-process SPMD drill retried after infra failure: %s"
+            % (str(e)[:500],)
+        )
+        _two_process_spmd_drill(tmp_path / "b")
+
+
+def _two_process_spmd_drill(tmp_path):
+    tmp_path.mkdir(parents=True, exist_ok=True)
     data_dir = str(tmp_path / "train")
     val_dir = str(tmp_path / "val")
     recordio_gen.gen_mnist_like(data_dir, num_files=2, records_per_file=64)
     recordio_gen.gen_mnist_like(val_dir, num_files=1, records_per_file=32,
                                 seed=3)
 
-    master = Master(
-        _spec(),
-        training_data=data_dir,
-        validation_data=val_dir,
-        minibatch_size=8,   # per-host; global batch = 16
-        records_per_task=32,
-        num_epochs=1,
-        evaluation_steps=4,
-        port=0,
-    )
-    master.prepare()
-    coord_port = _free_port()
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    master = None
     procs = []
     try:
+        master = Master(
+            _spec(),
+            training_data=data_dir,
+            validation_data=val_dir,
+            minibatch_size=8,   # per-host; global batch = 16
+            records_per_task=32,
+            num_epochs=1,
+            evaluation_steps=4,
+            port=0,
+        )
+        master.prepare()
+        coord_port = _free_port()
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
         for pid in range(2):
             procs.append(
                 subprocess.Popen(
@@ -158,11 +186,16 @@ def test_two_process_spmd_train(tmp_path):
             )
         outs = []
         for p in procs:
-            out, _ = p.communicate(timeout=300)
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired as e:
+                raise _DrillInfraError("subprocess timeout: %s" % (e,))
             outs.append(out)
         for i, (p, out) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, "proc %d failed:\n%s" % (i, out[-3000:])
-            assert "SPMD_PROC_DONE" in out
+            if p.returncode != 0 or "SPMD_PROC_DONE" not in out:
+                raise _DrillInfraError(
+                    "proc %d rc=%s:\n%s" % (i, p.returncode, out[-3000:])
+                )
         tail = "\n--- proc0 ---\n%s\n--- proc1 ---\n%s" % (
             outs[0][-1500:], outs[1][-1500:])
         assert master.task_d.finished(), (
@@ -185,4 +218,6 @@ def test_two_process_spmd_train(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-        master.stop()
+                p.wait()  # reap BEFORE any retry adds fresh load
+        if master is not None:
+            master.stop()
