@@ -12,7 +12,7 @@ Steps (each in its own bounded subprocess; a hang or crash moves on):
                          elasticdl_tpu/ops/flash_tuning.json (the
                          repo-wide tuned default) when it beats 128/128
   3. flagship bench    — python bench.py before/after the tuned blocks
-  4./5. secondary benches — EDL_BENCH_MODEL=resnet50|deepfm|decode|dlrm|bert
+  4./5. secondary benches — EDL_BENCH_MODEL=resnet50|deepfm|decode|dlrm|bert|moe
                          (BASELINE.md targets + decode throughput +
                          the 1B-embedding DLRM stress config)
   6. profile           — scripts/profile_step.py (attention share)
@@ -270,7 +270,8 @@ def main():
         maybe_update_baseline(prelim, note="prelim")
 
     # 4./5. secondary BASELINE.md targets + decode throughput
-    for model in ("resnet50", "deepfm", "decode", "dlrm", "bert"):
+    for model in ("resnet50", "deepfm", "decode", "dlrm", "bert",
+                  "moe"):
         step = runner([sys.executable, "bench.py"], timeout=1800,
                    env_extra={"EDL_BENCH_MODEL": model,
                               "EDL_BENCH_PROBE_TIMEOUT": "150"},
