@@ -199,8 +199,11 @@ def main():
         not refresh BENCH_BASELINE.json, or the tuned step-3 run would
         compute vs_baseline against this same session's prelim instead
         of the prior round's committed number."""
+        # bench.py's bare default is now the full family suite; every
+        # hw_session step pins exactly one family
         bench = runner([sys.executable, "bench.py"], timeout=1800,
-                       env_extra={"EDL_BENCH_PROBE_TIMEOUT": "150"},
+                       env_extra={"EDL_BENCH_MODEL": "transformer",
+                                  "EDL_BENCH_PROBE_TIMEOUT": "150"},
                        tag=tag)
         record(bench)
         flag = last_json_line(bench["stdout"])
@@ -381,6 +384,9 @@ def main():
         ("packed4_flagship", {"EDL_BENCH_EXTRA_PARAMS": "packed=4"}),
     ):
         extra["EDL_BENCH_PROBE_TIMEOUT"] = "150"
+        # bare default is the whole suite now — A/Bs without an
+        # explicit family run the flagship transformer
+        extra.setdefault("EDL_BENCH_MODEL", "transformer")
         step = runner([sys.executable, "bench.py"], timeout=1800,
                    env_extra=extra, tag=tag)
         record(step)
