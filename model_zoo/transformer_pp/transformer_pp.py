@@ -102,6 +102,14 @@ class TransformerPP(nn.Module):
     num_layers: int = 4
     mlp_ratio: int = 4
     num_microbatches: int = 2
+    # pipeline schedule knobs (parallel/pipeline.py): "interleaved"
+    # runs the circular schedule — the stacked blk_* rows are then in
+    # ring-ordered layout (fresh inits need no conversion; a
+    # gpipe-trained checkpoint converts via pipeline.interleave_layers
+    # on the blk_* leaves). pp_remat stages activations per microbatch.
+    pp_schedule: str = "gpipe"
+    pp_interleave: int = 2
+    pp_remat: bool = False
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -135,7 +143,10 @@ class TransformerPP(nn.Module):
                     % (self.num_layers, pp)
                 )
             x = pipeline_apply(
-                stage, blocks, x, mesh, self.num_microbatches
+                stage, blocks, x, mesh, self.num_microbatches,
+                schedule=self.pp_schedule,
+                interleave=self.pp_interleave,
+                remat=self.pp_remat,
             )
         else:
             x = sequential_apply(stage, blocks, x, 1)

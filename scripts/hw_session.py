@@ -292,6 +292,24 @@ def main():
                     "w") as f:
                 json.dump(parsed, f, indent=1)
 
+    # 5b. pipeline-schedule A/B (gpipe vs interleaved) — inherently
+    # multichip, so it runs on the 8-device VIRTUAL cpu mesh in a
+    # CPU-pinned child even during a TPU session (single-chip pp=1
+    # can't exercise the schedules; an oversubscribed virtual mesh's
+    # wall-clock tracks exactly the stage-work the bubble shrink saves)
+    pipe = run(
+        [sys.executable, "scripts/bench_pipeline.py"], timeout=1800,
+        tag="pipeline_schedules",
+        base_env={"PYTHONPATH": "", "JAX_PLATFORMS": "cpu",
+                  "XLA_FLAGS":
+                  "--xla_force_host_platform_device_count=8"},
+    )
+    record(pipe)
+    parsed = last_json_line(pipe["stdout"])
+    if parsed:
+        results["pipeline_schedules"] = parsed
+        save(results, args.out)
+
     # 6. step profile (attention share of step time)
     prof = runner([sys.executable, "scripts/profile_step.py"],
                timeout=1800, tag="profile_step")

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.parallel.pipeline import (
+    deinterleave_layers,
+    interleave_layers,
     pipeline_apply,
     sequential_apply,
 )
@@ -82,6 +84,103 @@ def test_gradients_match_sequential():
     for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("pp,m,v", [(4, 4, 2), (4, 8, 2), (2, 4, 4),
+                                    (2, 2, 1), (8, 8, 2)])
+def test_interleaved_matches_sequential(pp, m, v):
+    """Interleaved (circular) schedule == sequential oracle: the params
+    stack converts to ring-ordered layout, the pipeline streams vM+P-1
+    chunk ticks, and the banked outputs must equal running the semantic
+    layer order straight through."""
+    mesh = mesh_lib.build_mesh({"pp": pp, "dp": 8 // pp})
+    n_layers, dim, batch = 16, 16, 16
+    params = _params(n_layers, dim)
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((batch, dim)), jnp.float32
+    )
+    ring = interleave_layers(params, pp, v)
+    with mesh:
+        got = jax.jit(
+            lambda p, xv: pipeline_apply(
+                _stage_fn, p, xv, mesh, m,
+                schedule="interleaved", interleave=v,
+            )
+        )(ring, x)
+    want = sequential_apply(_stage_fn, params, x, pp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_interleave_layers_roundtrip():
+    params = _params(12, 4)
+    ring = interleave_layers(params, 2, 3)
+    back = deinterleave_layers(ring, 2, 3)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # device-major layout: device 0's first chunk is virtual stage 0 =
+    # semantic layers [0, 2), its second chunk virtual stage 2 = [4, 6)
+    np.testing.assert_array_equal(
+        np.asarray(ring["w"][:4]),
+        np.asarray(params["w"])[[0, 1, 4, 5]],
+    )
+
+
+@pytest.mark.parametrize("schedule,remat", [("interleaved", False),
+                                            ("gpipe", True),
+                                            ("interleaved", True)])
+def test_gradients_match_sequential_schedules(schedule, remat):
+    """AD through both schedules (and the remat/activation-staging
+    path) equals the sequential oracle's gradients."""
+    pp, m, v = 4, 4, 2
+    mesh = mesh_lib.build_mesh({"pp": pp, "dp": 2})
+    n_layers, dim, batch = 8, 8, 8
+    params = _params(n_layers, dim, seed=2)
+    x = jnp.asarray(
+        np.random.default_rng(3).standard_normal((batch, dim)), jnp.float32
+    )
+    if schedule == "interleaved":
+        run_p = interleave_layers(params, pp, v)
+    else:
+        run_p = params
+
+    def loss_pp(p):
+        with mesh:
+            y = pipeline_apply(_stage_fn, p, x, mesh, m,
+                               schedule=schedule, interleave=v,
+                               remat=remat)
+        return jnp.mean(y ** 2)
+
+    def loss_seq(p):
+        return jnp.mean(sequential_apply(_stage_fn, p, x, pp) ** 2)
+
+    with mesh:
+        g_pp = jax.jit(jax.grad(loss_pp))(run_p)
+    if schedule == "interleaved":
+        g_pp = deinterleave_layers(g_pp, pp, v)
+    g_seq = jax.grad(loss_seq)(params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_interleaved_rejects_bad_config():
+    mesh = mesh_lib.build_mesh({"pp": 4, "dp": 2})
+    x = jnp.zeros((8, 8))
+    with pytest.raises(ValueError, match="groups of"):
+        pipeline_apply(_stage_fn, _params(8, 8), x, mesh, 2,
+                       schedule="interleaved", interleave=2)
+    with pytest.raises(ValueError, match="interleave"):
+        pipeline_apply(_stage_fn, _params(4, 8), x, mesh, 4,
+                       schedule="interleaved", interleave=2)
+    with pytest.raises(ValueError, match="unknown schedule"):
+        pipeline_apply(_stage_fn, _params(8, 8), x, mesh, 4,
+                       schedule="zigzag")
+    # converters must refuse truncation, not silently drop layers
+    with pytest.raises(ValueError, match="not divisible"):
+        interleave_layers(_params(6, 4), 2, 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        deinterleave_layers(_params(6, 4), 2, 2)
 
 
 def test_rejects_bad_shapes():
