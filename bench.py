@@ -826,10 +826,14 @@ def _apply_vs_baseline(family, result):
     return result
 
 
-def _maybe_persist_baseline(family, result, expected_extra):
-    """Suite-mode baseline persistence: a TPU family run becomes the
-    committed record when there is no hardware record yet, or when the
-    same-config value improved (hw_session's update policy). Refuses
+def _maybe_persist_baseline(family, result, expected_extra=None):
+    """Baseline persistence, the ONE policy for BENCH_BASELINE*.json
+    (suite mode and hw_session both route here): a TPU family run
+    becomes the committed record when there is no hardware record yet,
+    when the existing record's identity (config/batch/chip/extras) no
+    longer matches this run's — a retuned config or a new chip
+    generation starts a fresh baseline rather than pinning vs_baseline
+    to 1.0 forever — or when the same-identity value improved. Refuses
     runs whose extra_params differ from the family's declared identity
     (ambient operator knobs must never become a committed record)."""
     if result.get("platform") == "cpu":
@@ -844,8 +848,8 @@ def _maybe_persist_baseline(family, result, expected_extra):
         old = {}
     better = (
         not old or old.get("platform") == "cpu"
-        or (_baseline_comparable(family, old, result)
-            and result.get("value", 0) > old.get("value", 0))
+        or not _baseline_comparable(family, old, result)
+        or result.get("value", 0) > old.get("value", 0)
     )
     if better:
         rec = {k: v for k, v in result.items()

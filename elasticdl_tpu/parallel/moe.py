@@ -17,10 +17,32 @@ GShard/Switch static-shape formulation, which is what XLA wants:
 * the load-balancing auxiliary loss is the standard fraction*prob dot
   (Switch Transformer eq. 4), returned to the caller to add to the task
   loss.
+
+Two dispatch implementations share those semantics:
+
+* :func:`moe_mlp_apply` — sharding-annotated einsums; GSPMD infers the
+  collectives (the default; single-chip and small meshes);
+* :func:`moe_mlp_apply_a2a` — EXPLICIT shard_map dispatch: tokens are
+  sharded into (dp, fsdp, ep) groups, each group routes locally into a
+  capacity-bounded [E, C, D] send buffer, one ``all_to_all`` over
+  ``ep`` delivers each expert its ep receive buffers, the expert FFNs
+  run on their [E/ep, ep*C, D] batch, and a reverse ``all_to_all``
+  brings outputs home for the combine. Capacity is per GROUP
+  (GShard's groups: round(k * T_group * cf / E)) rather than global-T,
+  so the a2a cost is bounded at 2 * E * C * D * itemsize bytes per
+  group regardless of routing skew. Drop-free configurations produce
+  exactly the einsum path's outputs (the aux loss is assembled from
+  pmean'd fraction/prob so it matches the global formula); under
+  saturation the paths differ only in WHICH over-capacity choices drop
+  (global queue vs per-group queues).
 """
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.common.constants import MeshAxis
+from elasticdl_tpu.parallel.pipeline import shard_map
 
 
 def top1_dispatch(router_logits, capacity):
@@ -132,6 +154,96 @@ def moe_mlp_apply(params, x, capacity_factor=1.25, activation=jax.nn.gelu,
     )
     y = jnp.einsum("tec,ecd->td", combine, expert_out)
     return y, aux_loss, stats
+
+
+def moe_mlp_apply_a2a(params, x, mesh, capacity_factor=1.25,
+                      activation=jax.nn.gelu, router_top_k=1):
+    """Explicit expert-parallel dispatch (module docstring): shard_map
+    over (dp, fsdp, ep) token groups with capacity-bounded all_to_all
+    send/recv buffers over ``ep``.
+
+    Same signature/result contract as :func:`moe_mlp_apply` plus the
+    mesh. x [T, D] may arrive with any sharding — the shard_map in_spec
+    reshards rows over (dp, fsdp, ep). Requires T % (dp*fsdp*ep) == 0
+    and E % ep == 0.
+    """
+    dp = mesh.shape[MeshAxis.DP]
+    fsdp = mesh.shape[MeshAxis.FSDP]
+    ep = mesh.shape[MeshAxis.EP]
+    shards = dp * fsdp * ep
+    t, d = x.shape
+    e = params["router"].shape[-1]
+    if t % shards:
+        raise ValueError(
+            "a2a dispatch: %d tokens not divisible by dp*fsdp*ep=%d"
+            % (t, shards)
+        )
+    if e % ep:
+        raise ValueError(
+            "a2a dispatch: %d experts not divisible by ep=%d" % (e, ep)
+        )
+    t_loc = t // shards
+    e_loc = e // ep
+    cap = expert_capacity(t_loc * router_top_k, e, capacity_factor)
+    token_spec = P((MeshAxis.DP, MeshAxis.FSDP, MeshAxis.EP))
+    param_specs = {
+        "router": P(None, None),
+        "w_up": P(MeshAxis.EP, None, None),
+        "b_up": P(MeshAxis.EP, None),
+        "w_down": P(MeshAxis.EP, None, None),
+        "b_down": P(MeshAxis.EP, None),
+    }
+    token_axes = (MeshAxis.DP, MeshAxis.FSDP, MeshAxis.EP)
+
+    def body(p, xl):
+        logits = xl @ p["router"]
+        dispatch, combine, _, stats = topk_dispatch(
+            logits, cap, k=router_top_k
+        )
+        # capacity-bounded send buffers: [E, C, D] -> [ep(dst), E/ep, C, D]
+        send = jnp.einsum("tec,td->ecd", dispatch, xl)
+        send = send.reshape(ep, e_loc, cap, d)
+        recv = jax.lax.all_to_all(
+            send, MeshAxis.EP, split_axis=0, concat_axis=0
+        )  # [ep(src), E/ep, C, D]
+        # each local expert's batch: its C-slot buffer from every peer
+        xin = recv.transpose(1, 0, 2, 3).reshape(e_loc, ep * cap, d)
+        h = activation(
+            jnp.einsum("egd,edh->egh", xin, p["w_up"])
+            + p["b_up"][:, None, :]
+        )
+        out = (
+            jnp.einsum("egh,ehd->egd", h, p["w_down"])
+            + p["b_down"][:, None, :]
+        )
+        out = out.reshape(e_loc, ep, cap, d).transpose(1, 0, 2, 3)
+        back = jax.lax.all_to_all(
+            out, MeshAxis.EP, split_axis=0, concat_axis=0
+        )  # [ep(expert group), E/ep, C, D] == local [E, C, D] order
+        y = jnp.einsum("tec,ecd->td", combine,
+                       back.reshape(e, cap, d))
+        # aux loss assembled GLOBALLY (equal-size groups: the mean of
+        # group means IS the global mean), so drop-free runs match the
+        # einsum path's aux bit-for-bit up to reduction order
+        probs = jax.nn.softmax(logits, axis=-1)
+        fraction = jax.lax.pmean(
+            stats["expert_fraction"], token_axes)
+        mean_prob = jax.lax.pmean(jnp.mean(probs, axis=0), token_axes)
+        aux = e * jnp.sum(fraction * mean_prob)
+        out_stats = {
+            "dropped_fraction": jax.lax.pmean(
+                stats["dropped_fraction"], token_axes),
+            "expert_fraction": fraction,
+        }
+        return y, aux, out_stats
+
+    return shard_map(
+        body,
+        mesh,
+        ({k: param_specs[k] for k in params}, token_spec),
+        (token_spec, P(), {"dropped_fraction": P(),
+                           "expert_fraction": P()}),
+    )(dict(params), x)
 
 
 def moe_mlp_infer(params, x, activation=jax.nn.gelu, router_top_k=1):

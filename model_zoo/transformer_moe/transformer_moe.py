@@ -30,7 +30,12 @@ from flax import linen as nn
 
 from elasticdl_tpu.common.constants import MeshAxis, Mode
 from elasticdl_tpu.data.example_codec import decode_example
-from elasticdl_tpu.parallel.moe import moe_mlp_apply, moe_mlp_infer
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.parallel.moe import (
+    moe_mlp_apply,
+    moe_mlp_apply_a2a,
+    moe_mlp_infer,
+)
 from model_zoo.transformer_lm.transformer_lm import (
     CausalSelfAttention,
     resolve_dtype,
@@ -66,6 +71,11 @@ class MoEBlock(nn.Module):
     tp_shard: bool = True
     cache_len: int = 0  # KV-cache capacity for decode/prefill
     kv_cache_dtype: str = ""  # "" | "int8" (see CausalSelfAttention)
+    # "auto" = sharding-annotated einsums (GSPMD infers collectives);
+    # "a2a" = explicit shard_map all-to-all dispatch over ep
+    # (parallel/moe.py moe_mlp_apply_a2a; falls back to einsum off-mesh
+    # or at ep=1, where there is nothing to exchange)
+    moe_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
@@ -131,10 +141,19 @@ class MoEBlock(nn.Module):
                 params, flat, router_top_k=self.router_top_k
             )
             return x + out.reshape(b, l, e), 0.0
-        out, aux_loss, _ = moe_mlp_apply(
-            params, flat, capacity_factor=self.capacity_factor,
-            router_top_k=self.router_top_k,
-        )
+        mesh = mesh_lib.current_mesh()
+        if (self.moe_impl == "a2a" and mesh is not None
+                and mesh.shape.get(MeshAxis.EP, 1) > 1):
+            out, aux_loss, _ = moe_mlp_apply_a2a(
+                params, flat, mesh,
+                capacity_factor=self.capacity_factor,
+                router_top_k=self.router_top_k,
+            )
+        else:
+            out, aux_loss, _ = moe_mlp_apply(
+                params, flat, capacity_factor=self.capacity_factor,
+                router_top_k=self.router_top_k,
+            )
         return x + out.reshape(b, l, e), aux_loss
 
 
@@ -151,6 +170,7 @@ class TransformerMoE(nn.Module):
     attn_impl: str = "auto"
     tp_shard: bool = True
     kv_cache_dtype: str = ""  # "" | "int8" (see CausalSelfAttention)
+    moe_impl: str = "auto"  # "auto" einsum/GSPMD | "a2a" explicit
 
     @nn.compact
     def __call__(self, features, training=False, decode=False,
@@ -180,6 +200,7 @@ class TransformerMoE(nn.Module):
                 attn_impl=self.attn_impl, tp_shard=self.tp_shard,
                 cache_len=self.seq_len,
                 kv_cache_dtype=self.kv_cache_dtype,
+                moe_impl=self.moe_impl,
                 name="block_%d" % i,
             )(x, training, decode=decode, decode_pos=decode_pos,
               prefill=prefill)

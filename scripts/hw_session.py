@@ -35,6 +35,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench as bench_mod  # noqa: E402  (the ONE baseline policy)
 
 
 def run(cmd, timeout, env_extra=None, tag="", base_env=None):
@@ -157,40 +160,30 @@ def main():
             return 1
         print("[hw_session] probe failed but --force: continuing (CPU)")
 
-    def maybe_update_baseline(cand, note=""):
-        """Refresh BENCH_BASELINE.json when `cand` is a default-knob TPU
-        run that is strictly better on the identical baseline identity.
-
-        Identity mirrors bench.py's vs_baseline check (config +
-        batch_size + device_kind, both sides non-cpu), and additionally
-        requires extra_params to be unset: an A/B run (or ambient
-        EDL_BENCH_BATCH / EDL_BENCH_EXTRA_PARAMS in the operator's
-        shell) must never become the committed baseline — bench.py's
-        default runs could then never match it and vs_baseline would
-        silently pin to 1.0."""
-        if not cand or cand.get("platform") in (None, "cpu"):
+    def maybe_update_baseline(cand, note="", family="transformer"):
+        """Refresh the family's BENCH_BASELINE*.json via bench.py's
+        _maybe_persist_baseline — ONE policy owns these files (update
+        when no comparable record / identity changed / same-identity
+        value improved; refuse A/B or ambient-knob runs, whose
+        extra_params differ from the family default None)."""
+        if not cand:
             return
-        if cand.get("extra_params"):
-            return
-        base_path = os.path.join(REPO, "BENCH_BASELINE.json")
+        path = bench_mod._baseline_path(family)
         try:
-            with open(base_path) as f:
-                old = json.load(f)
-        except (OSError, ValueError):
-            old = {}
-        better = (
-            old.get("platform") == "cpu" or not old
-            or (cand.get("config") == old.get("config")
-                and cand.get("batch_size") == old.get("batch_size")
-                # baseline identity includes the chip generation
-                and cand.get("device_kind") == old.get("device_kind")
-                and cand.get("value", 0) > old.get("value", 0))
-        )
-        if better:
-            with open(base_path, "w") as f:
-                json.dump(cand, f, indent=1)
-            print("[hw_session] BENCH_BASELINE.json updated%s"
-                  % (" (%s)" % note if note else ""))
+            with open(path) as f:
+                before = f.read()
+        except OSError:
+            before = None
+        bench_mod._maybe_persist_baseline(family, cand)
+        try:
+            with open(path) as f:
+                after = f.read()
+        except OSError:
+            after = before
+        if after != before:
+            print("[hw_session] %s updated%s"
+                  % (os.path.basename(path),
+                     " (%s)" % note if note else ""))
 
     def flagship_bench(tag, update_baseline):
         """Run the flagship bench and return the parsed JSON line.
@@ -284,13 +277,7 @@ def main():
         if parsed and parsed.get("platform") not in (None, "cpu"):
             results[model] = parsed
             save(results, args.out)
-            if parsed.get("extra_params"):
-                # non-default knobs must not become a committed record
-                continue
-            with open(os.path.join(
-                    REPO, "BENCH_BASELINE_%s.json" % model.upper()),
-                    "w") as f:
-                json.dump(parsed, f, indent=1)
+            maybe_update_baseline(parsed, family=model)
 
     # 5b. pipeline-schedule A/B (gpipe vs interleaved) — inherently
     # multichip, so it runs on the 8-device VIRTUAL cpu mesh in a

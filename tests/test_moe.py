@@ -323,3 +323,121 @@ def test_zoo_e2e_local_executor(tmp_path):
     assert int(state.step) == 4
     assert np.isfinite(executor.losses).all()
     assert 0.0 <= metrics["token_accuracy"] <= 1.0
+
+
+def _grouped_oracle(params, x, shards, capacity_factor, k):
+    """Per-group semantics of the a2a path: each contiguous token group
+    routes independently with its own capacity queues (GShard groups).
+    Stitches moe_mlp_apply over row-major groups — exactly how the
+    (dp, fsdp, ep) in_spec splits rows."""
+    groups = np.split(np.asarray(x), shards)
+    ys = [
+        np.asarray(moe.moe_mlp_apply(
+            params, jnp.asarray(g), capacity_factor=capacity_factor,
+            router_top_k=k,
+        )[0])
+        for g in groups
+    ]
+    return np.concatenate(ys)
+
+
+def test_a2a_dispatch_matches_grouped_oracle():
+    """Explicit all-to-all path == per-group einsum dispatch, including
+    capacity drops (cf small enough to saturate queues)."""
+    mesh = mesh_lib.build_mesh({"dp": 2, "ep": 4})
+    params = _moe_params(e=8, seed=5)
+    x = jnp.asarray(
+        np.random.default_rng(6).standard_normal((64, 8)), jnp.float32
+    )
+    for k, cf in ((1, 1.0), (2, 1.25)):
+        with mesh:
+            y, aux, stats = jax.jit(
+                lambda p, xv, k=k, cf=cf: moe.moe_mlp_apply_a2a(
+                    p, xv, mesh, capacity_factor=cf, router_top_k=k
+                )
+            )(params, x)
+        want = _grouped_oracle(params, x, 8, cf, k)
+        np.testing.assert_allclose(np.asarray(y), want,
+                                   atol=1e-5, rtol=1e-4)
+        assert float(aux) > 0
+        assert 0.0 <= float(stats["dropped_fraction"]) < 1.0
+
+
+def test_a2a_dispatch_matches_einsum_drop_free():
+    """With capacity that cannot saturate (cf = E), the a2a and global
+    einsum paths are the same math — outputs AND aux loss match."""
+    mesh = mesh_lib.build_mesh({"dp": 2, "ep": 4})
+    params = _moe_params(e=8, seed=7)
+    x = jnp.asarray(
+        np.random.default_rng(8).standard_normal((64, 8)), jnp.float32
+    )
+    with mesh:
+        y_a, aux_a, stats_a = jax.jit(
+            lambda p, xv: moe.moe_mlp_apply_a2a(
+                p, xv, mesh, capacity_factor=8.0, router_top_k=2
+            )
+        )(params, x)
+    y_e, aux_e, stats_e = moe.moe_mlp_apply(
+        params, x, capacity_factor=8.0, router_top_k=2
+    )
+    np.testing.assert_allclose(np.asarray(y_a), np.asarray(y_e),
+                               atol=1e-5, rtol=1e-4)
+    np.testing.assert_allclose(float(aux_a), float(aux_e), rtol=1e-5)
+    assert float(stats_a["dropped_fraction"]) == 0.0
+    assert float(stats_e["dropped_fraction"]) == 0.0
+
+
+def test_a2a_dispatch_gradients_flow():
+    """AD through the double all_to_all: expert-weight and router grads
+    match the grouped einsum formulation."""
+    mesh = mesh_lib.build_mesh({"dp": 2, "ep": 4})
+    params = _moe_params(e=8, seed=9)
+    x = jnp.asarray(
+        np.random.default_rng(10).standard_normal((32, 8)), jnp.float32
+    )
+
+    def loss_a2a(p):
+        with mesh:
+            y, aux, _ = moe.moe_mlp_apply_a2a(
+                p, x, mesh, capacity_factor=8.0, router_top_k=2
+            )
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    def loss_grouped(p):
+        ys = []
+        auxs = []
+        for g in jnp.split(x, 8):
+            y, aux, _ = moe.moe_mlp_apply(
+                p, g, capacity_factor=8.0, router_top_k=2
+            )
+            ys.append(y)
+            auxs.append(aux)
+        # drop-free: grouped aux means == global aux is NOT exact for
+        # the product formula, so compare value-side grads only where
+        # they agree — use the output loss plus the a2a's own aux via
+        # stop-gradient-free recomputation on the full batch
+        y_full, aux_full, _ = moe.moe_mlp_apply(
+            p, x, capacity_factor=8.0, router_top_k=2
+        )
+        return jnp.mean(jnp.concatenate(ys) ** 2) + 0.01 * aux_full
+
+    with mesh:
+        g_a = jax.jit(jax.grad(loss_a2a))(params)
+    g_e = jax.grad(loss_grouped)(params)
+    for key in params:
+        np.testing.assert_allclose(
+            np.asarray(g_a[key]), np.asarray(g_e[key]),
+            atol=1e-5, rtol=1e-3,
+        )
+
+
+def test_a2a_dispatch_rejects_bad_shapes():
+    import pytest
+
+    mesh = mesh_lib.build_mesh({"dp": 2, "ep": 4})
+    params = _moe_params(e=6)
+    x = jnp.zeros((64, 8))
+    with pytest.raises(ValueError, match="experts not divisible"):
+        moe.moe_mlp_apply_a2a(params, x, mesh)
+    with pytest.raises(ValueError, match="tokens not divisible"):
+        moe.moe_mlp_apply_a2a(_moe_params(e=8), jnp.zeros((63, 8)), mesh)
