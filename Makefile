@@ -1,0 +1,43 @@
+# CI entry points (reference analogue: scripts/travis/run_job.sh wired
+# into .travis.yml — here the same stages run locally or under any CI
+# runner via `make ci`, and .github/workflows/ci.yml calls these exact
+# targets).
+#
+# The suite is sharded by pytest markers (pytest.ini):
+#   default/fast  — everything NOT marked slow/integration (< 5 min,
+#                   the per-commit gate)
+#   drills        — the slow + integration shard: multi-process SPMD
+#                   parity, elastic e2e (SIGKILL mid-job), gRPC
+#                   master/worker, re-formation, elasticity bench
+#   drill         — one real local training job + status validation
+#   cluster-smoke — kind/minikube manifests smoke, env-gated
+#                   (EDL_CLUSTER_FULL=1 + a reachable cluster)
+
+PY ?= python
+MESH_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: native test-fast test-drills drill ci ci-fast cluster-smoke clean
+
+native:
+	$(MAKE) -C elasticdl_tpu/native
+
+test-fast: native
+	env -u PYTHONPATH $(MESH_ENV) $(PY) -m pytest tests/ -q \
+		-m "not slow and not integration"
+
+test-drills: native
+	env -u PYTHONPATH $(MESH_ENV) $(PY) -m pytest tests/ -q \
+		-m "slow or integration"
+
+drill:
+	bash scripts/run_local_job_drill.sh
+
+ci-fast: test-fast
+
+ci: test-fast test-drills drill
+
+cluster-smoke:
+	bash scripts/run_cluster_job_smoke.sh
+
+clean:
+	$(MAKE) -C elasticdl_tpu/native clean

@@ -32,6 +32,14 @@ class MasterServicer(object):
         self._worker_liveness_time = {}
         self._workers = {}
         self._cluster_version = 0
+        # per-worker tier-gauge step counters: gauges are written at a
+        # monotonically increasing per-worker report index, never at
+        # the model version — multiple reports between version bumps
+        # would otherwise emit duplicate TB points at one step
+        # (sawtooth/overwrite on some backends), and keeping only one
+        # report per version would drop the tail of the cumulative
+        # counters. Guarded by self._lock (gRPC thread pool).
+        self._tier_gauge_steps = {}
         if evaluation_service:
             evaluation_service.set_master_servicer(self)
 
@@ -96,7 +104,7 @@ class MasterServicer(object):
         """Workers piggyback cumulative tier-health counters (host-tier
         dropped row updates / failed cycles) on task reports as tier/
         keys; write them through the TensorBoard service as gauges at
-        the current model version (reference analogue: the PS exposed
+        a per-worker report index (reference analogue: the PS exposed
         parameters.debug_info — here the degradation signal rides the
         existing report RPC instead of a debug endpoint). Tags are
         per-worker (the counters are per-trainer cumulatives, so
@@ -113,8 +121,13 @@ class MasterServicer(object):
             if k.startswith("tier/")
         }
         if gauges:
+            # distinct step per report (see _tier_gauge_steps): every
+            # cumulative value lands, steps strictly increase per tag
+            with self._lock:
+                step = self._tier_gauge_steps.get(worker_id, 0)
+                self._tier_gauge_steps[worker_id] = step + 1
             self._tensorboard_service.write_dict_to_summary(
-                gauges, version=self._version
+                gauges, version=step
             )
 
     def report_evaluation_metrics(self, request, _context=None):

@@ -1105,22 +1105,51 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     return out
 
 
-def _fully_masked_rows(q_seg, k_seg, causal, window, lq, lk):
+def _fully_masked_rows(q_seg, k_seg, causal, window, lq, lk,
+                       chunk=2048):
     """[b, lq] bool: True where a query row has NO visible key under the
     segment/causal/window mask — semantics mirror _block_mask at
     pos_offset 0 (pair-form flash_attention is the only caller; ring
-    rotations handle offsets through the lse sentinel instead)."""
+    rotations handle offsets through the lse sentinel instead).
+
+    The visibility reduction runs over key CHUNKS (fori_loop), so peak
+    memory is O(b * lq * chunk) rather than materializing the full
+    [b, lq, lk] pair mask — shard lengths on the ring hot path can
+    grow without this check growing with them. One chunk (lk <= 2048)
+    is the single fused expression it always was."""
     q_pos = jnp.arange(lq)[:, None]
-    k_pos = jnp.arange(lk)[None, :]
-    keep = q_seg[:, :, None] == k_seg[:, None, :]
-    if causal:
-        keep = jnp.logical_and(keep, q_pos >= k_pos)
-    if window is not None:
-        in_w = q_pos - k_pos < window
-        keep = jnp.logical_and(keep, in_w)
-        if not causal:
-            keep = jnp.logical_and(keep, k_pos - q_pos < window)
-    return jnp.logical_not(keep.any(-1))
+
+    def visible(k_lo, k_seg_c, width):
+        k_pos = k_lo + jnp.arange(width)[None, :]
+        keep = q_seg[:, :, None] == k_seg_c[:, None, :]
+        if causal:
+            keep = jnp.logical_and(keep, q_pos >= k_pos)
+        if window is not None:
+            in_w = q_pos - k_pos < window
+            keep = jnp.logical_and(keep, in_w)
+            if not causal:
+                keep = jnp.logical_and(keep, k_pos - q_pos < window)
+        return keep.any(-1)
+
+    if lk <= chunk:
+        return jnp.logical_not(visible(0, k_seg, lk))
+
+    n_chunks = -(-lk // chunk)
+    pad = n_chunks * chunk - lk
+    # pad keys with a segment id no query can carry (ids are >= 0)
+    k_seg_p = jnp.pad(k_seg, ((0, 0), (0, pad)), constant_values=-1)
+
+    def body(c, acc):
+        k_lo = c * chunk
+        k_seg_c = jax.lax.dynamic_slice_in_dim(
+            k_seg_p, k_lo, chunk, axis=1)
+        return jnp.logical_or(acc, visible(k_lo, k_seg_c, chunk))
+
+    any_visible = jax.lax.fori_loop(
+        0, n_chunks, body,
+        jnp.zeros(q_seg.shape, bool),
+    )
+    return jnp.logical_not(any_visible)
 
 
 def jax_flash_attention(q, k, v, causal=False, scale=None, window=None):

@@ -129,6 +129,7 @@ def test_sliding_window_validation():
         blockwise_attention(q, k[:, :, :16], v[:, :, :16], window=4)
 
 
+@pytest.mark.slow
 def test_sliding_window_model_trains():
     """transformer_lm with attn_window trains and differs from full
     attention (the mask actually bites)."""
@@ -177,6 +178,7 @@ def test_flash_gradients():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_8dev(causal):
     mesh = mesh_lib.build_mesh({"sp": 8})
     q, k, v = _qkv(4)
@@ -186,6 +188,7 @@ def test_ring_attention_8dev(causal):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_dp_sp_mesh():
     mesh = mesh_lib.build_mesh({"dp": 2, "sp": 4})
     q, k, v = _qkv(5)
@@ -195,6 +198,7 @@ def test_ring_attention_dp_sp_mesh():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients():
     mesh = mesh_lib.build_mesh({"sp": 8})
     q, k, v = _qkv(6)
@@ -212,6 +216,7 @@ def test_ring_attention_gradients():
                                    rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_jit_compiles_once():
     mesh = mesh_lib.build_mesh({"sp": 8})
     q, k, v = _qkv(7)
@@ -222,6 +227,7 @@ def test_ring_attention_jit_compiles_once():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_jnp_fallback(causal, monkeypatch):
     """The non-Pallas ring path (blockwise forward + dense jnp backward
     recomputing P from the global lse) against the naive oracle."""
@@ -247,6 +253,7 @@ def test_ring_attention_jnp_fallback(causal, monkeypatch):
                                    rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_uses_flash_kernels(monkeypatch):
     """Proof the ring's local compute is the Pallas flash kernel, both
     directions: count _flash_forward / _flash_backward invocations while
@@ -550,6 +557,7 @@ def test_segment_validation():
 
 
 @pytest.mark.parametrize("pos_emb", ["learned", "rope"])
+@pytest.mark.slow
 def test_packed_rows_match_unpacked_model(pos_emb):
     """End-to-end packing contract on the LM: a row packing two
     sequences (segment_ids + restarting positions) must produce the
@@ -652,6 +660,7 @@ def test_flash_rectangular_segment_pair(causal):
 
 @pytest.mark.parametrize("causal,window", [(True, None), (True, 16),
                                            (False, 16)])
+@pytest.mark.slow
 def test_cond_mask_matches_default(monkeypatch, causal, window):
     """EDL_FLASH_COND_MASK=1 branches the per-element mask out of
     interior blocks; outputs and gradients must equal the default
@@ -760,6 +769,7 @@ def _packed_seg_for_ring(b, l, seed=31):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_segments(causal):
     """Packed long-context: ring attention with sequence-sharded
     segment ids (k-side ids rotate with their shard) vs the oracle."""
@@ -772,6 +782,7 @@ def test_ring_attention_segments(causal):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_segments_gradients():
     mesh = mesh_lib.build_mesh({"sp": 8})
     q, k, v = _qkv(25)
@@ -855,6 +866,7 @@ def test_flash_rectangular_pair_gradients():
     np.testing.assert_array_equal(masked_dq, 0.0)
 
 
+@pytest.mark.slow
 def test_flash_config_fuzz_vs_oracle(monkeypatch):
     """Seeded sweep across the kernel config lattice (causal x window x
     GQA x segments x block sizes x rectangular shapes x cond-mask) in
@@ -919,6 +931,7 @@ def test_flash_config_fuzz_vs_oracle(monkeypatch):
 
 
 @pytest.mark.parametrize("window", [4, 13, 24, 64])
+@pytest.mark.slow
 def test_ring_attention_window(window):
     """Causal sliding-window through the ring: rotation r applies the
     local window mask at static offset r*shard_len (causal auto-holds
@@ -933,6 +946,7 @@ def test_ring_attention_window(window):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_window_gradients():
     mesh = mesh_lib.build_mesh({"sp": 8})
     q, k, v = _qkv(52)
@@ -951,6 +965,7 @@ def test_ring_attention_window_gradients():
                                    rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_window_with_segments():
     """Window AND packing compose through the ring."""
     mesh = mesh_lib.build_mesh({"sp": 8})
@@ -965,6 +980,7 @@ def test_ring_attention_window_with_segments():
 
 
 @pytest.mark.parametrize("window", [4, 13, 30])
+@pytest.mark.slow
 def test_ring_attention_window_noncausal(window):
     """Two-sided (encoder) windows through the ring: signed-offset
     branches cover shards on BOTH sides of the diagonal; out-of-band
@@ -977,6 +993,7 @@ def test_ring_attention_window_noncausal(window):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_window_noncausal_gradients():
     mesh = mesh_lib.build_mesh({"sp": 8})
     q, k, v = _qkv(57)
@@ -1008,6 +1025,7 @@ def test_ulysses_attention_window():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_window_noncausal_with_segments():
     """Two-sided window AND packing compose through the non-causal
     ring (the BertEncoder attn_window + packed path)."""
@@ -1020,3 +1038,63 @@ def test_ring_attention_window_noncausal_with_segments():
                          segments=seg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_flash_bf16_matches_oracle_fwd_and_grads():
+    """bf16 inputs are the ONLY dtype where _mxu_cast changes numerics
+    (softmax weights / ds rounded to bf16 so p@V, ds@K, p@dO run at
+    MXU bf16 rate) — so the bf16 path gets its own fwd+grad oracle
+    check at bf16 tolerances (f32 tests are no-ops through the cast)."""
+    rs = np.random.RandomState(11)
+    mk = lambda: jnp.asarray(
+        rs.randn(2, 2, 64, 128).astype(np.float32) * 0.3
+    ).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    cot = jnp.asarray(
+        rs.randn(2, 2, 64, 128).astype(np.float32) * 0.5
+    )
+
+    def f32(t):
+        return t.astype(jnp.float32)
+
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    ref = naive_attention(f32(q), f32(k), f32(v), causal=True)
+    np.testing.assert_allclose(
+        np.asarray(f32(out)), np.asarray(ref), rtol=0.05, atol=0.02
+    )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(f32(flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16)) * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(naive_attention(f32(q), f32(k), f32(v),
+                                       causal=True) * cot)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(f32(gf)), np.asarray(f32(gr)),
+            rtol=0.1, atol=0.05,
+            err_msg="bf16 flash grad d%s diverges from oracle" % name,
+        )
+
+
+def test_fully_masked_rows_chunked_matches_one_shot():
+    """The chunked (fori_loop) visibility reduction must equal the
+    single fused expression for every mask flavor, including ragged
+    final chunks."""
+    from elasticdl_tpu.ops.attention import _fully_masked_rows
+
+    rs = np.random.RandomState(3)
+    q_seg = jnp.asarray(rs.randint(0, 4, (2, 45)))
+    k_seg = jnp.asarray(rs.randint(0, 4, (2, 83)))
+    for causal in (False, True):
+        for window in (None, 9):
+            one = _fully_masked_rows(q_seg, k_seg, causal, window,
+                                     45, 83)
+            chunked = _fully_masked_rows(q_seg, k_seg, causal, window,
+                                         45, 83, chunk=32)
+            np.testing.assert_array_equal(np.asarray(one),
+                                          np.asarray(chunked))

@@ -202,6 +202,42 @@ def test_tier_health_counters_reach_tensorboard(tmp_path):
     assert b"unrelated" not in data
 
 
+def test_tier_gauges_distinct_steps_no_data_loss(tmp_path):
+    """Every report's cumulative counters land at a strictly
+    increasing per-worker step: no duplicate points at one step (the
+    sawtooth/overwrite artifact some TB backends render), and the tail
+    of a cumulative counter is never dropped — the last report between
+    version bumps is the freshest value."""
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    class SpyTB(object):
+        def __init__(self):
+            self.writes = []
+
+        def write_dict_to_summary(self, gauges, version):
+            self.writes.append((dict(gauges), version))
+
+    task_d = TaskDispatcher(
+        {"shard": (0, 32)}, {}, {}, records_per_task=8, num_epochs=1
+    )
+    tb = SpyTB()
+    servicer = MasterServicer(4, task_d, tensorboard_service=tb)
+    for value in (1, 2, 6):  # cumulative counter grows within a version
+        servicer._write_tier_gauges(
+            {"tier/host_failed_cycles": value}, worker_id=0)
+    servicer._write_tier_gauges(
+        {"tier/host_failed_cycles": 9}, worker_id=1)
+    assert len(tb.writes) == 4  # nothing dropped
+    w0 = [(g, s) for g, s in tb.writes
+          if "tier/host_failed_cycles/worker-0" in g]
+    assert [s for _, s in w0] == [0, 1, 2]  # distinct increasing steps
+    assert w0[-1][0]["tier/host_failed_cycles/worker-0"] == 6
+    w1 = [(g, s) for g, s in tb.writes
+          if "tier/host_failed_cycles/worker-1" in g]
+    assert [s for _, s in w1] == [0]  # independent per-worker counter
+
+
 # ----------------------------------------------------------- collective
 
 

@@ -14,6 +14,9 @@ from elasticdl_tpu.common.model_utils import get_model_spec
 from elasticdl_tpu.data import recordio_gen
 from model_zoo.bert import bert
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 MODEL_ZOO = "model_zoo"
 
 

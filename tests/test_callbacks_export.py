@@ -25,6 +25,9 @@ from elasticdl_tpu.common.model_handler import (
 from elasticdl_tpu.common.model_utils import load_model_spec_from_module
 from elasticdl_tpu.master.task_dispatcher import Task, TaskDispatcher, TaskType
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def spec():

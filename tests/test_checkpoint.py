@@ -70,6 +70,7 @@ def test_save_load_roundtrip(tmp_path, trainer_and_state):
         np.testing.assert_array_equal(flat[k], expect[k])
 
 
+@pytest.mark.slow
 def test_restore_reshards_onto_state(tmp_path, trainer_and_state):
     trainer, state, batch = trainer_and_state
     # advance one step so restored != fresh
@@ -130,6 +131,7 @@ def test_no_checkpoint_raises(tmp_path):
     assert get_latest_checkpoint_version(str(tmp_path)) == -1
 
 
+@pytest.mark.slow
 def test_local_executor_checkpoint_and_resume(tmp_path):
     """Train with checkpointing, then resume from the checkpoint and verify
     the step counter and params carry over (reference: PS writes checkpoints
@@ -249,6 +251,7 @@ def test_async_save_failure_surfaces_and_retries(tmp_path,
     assert get_latest_checkpoint_version(str(tmp_path / "fail")) == 1
 
 
+@pytest.mark.slow
 def test_orbax_roundtrip_and_reshard(tmp_path, trainer_and_state):
     """Orbax interop: save on a (dp, fsdp=2) mesh, restore onto a
     single-device template; values identical, shardings follow the

@@ -24,6 +24,11 @@ from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.training.trainer import Trainer
 from model_zoo.mnist_functional_api import mnist_functional_api as zoo
 
+import pytest
+
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 
 def _batches(n, bsz=16, seed=0):
     """Fixed global-batch stream shared by every run (task order is held

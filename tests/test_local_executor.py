@@ -43,6 +43,7 @@ def test_get_model_spec_by_convention():
     assert "accuracy" in metrics
 
 
+@pytest.mark.slow
 def test_train_and_evaluate(mnist_data):
     train_dir, val_dir = mnist_data
     executor = LocalExecutor(
@@ -62,6 +63,7 @@ def test_train_and_evaluate(mnist_data):
     assert 0.0 <= metrics["accuracy"] <= 1.0
 
 
+@pytest.mark.slow
 def test_training_reduces_loss_on_learnable_data(tmp_path):
     # labels perfectly determined by the mean pixel bucket -> learnable
     from elasticdl_tpu.data.example_codec import encode_example
@@ -101,6 +103,7 @@ def test_predict(mnist_data):
     assert preds.shape == (128, 10)
 
 
+@pytest.mark.slow
 def test_max_steps_stops_early(mnist_data):
     train_dir, _ = mnist_data
     executor = LocalExecutor(

@@ -10,6 +10,9 @@ from elasticdl_tpu.api.local_executor import LocalExecutor
 from elasticdl_tpu.common.model_utils import get_model_spec
 from elasticdl_tpu.data import recordio_gen
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 MODEL_ZOO = "model_zoo"
 
 

@@ -16,6 +16,11 @@ from elasticdl_tpu.common.model_utils import (
 from elasticdl_tpu.parallel import mesh as mesh_lib, moe
 from elasticdl_tpu.training.trainer import Trainer
 
+import pytest
+
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 
 def _moe_params(d=8, h=16, e=4, seed=0):
     rng = np.random.default_rng(seed)

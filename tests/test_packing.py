@@ -21,6 +21,9 @@ from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.training.trainer import Trainer
 from model_zoo.transformer_lm import transformer_lm as zoo
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 
 def test_pack_layout_and_label_masking():
     seqs = [

@@ -11,6 +11,9 @@ from elasticdl_tpu.common.model_utils import load_model_spec_from_module
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.training.trainer import Trainer
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 
 def _spec():
     from model_zoo.mnist_functional_api import mnist_functional_api as zoo

@@ -18,6 +18,9 @@ from elasticdl_tpu.parallel.spmd import (
 )
 from elasticdl_tpu.worker.worker import JobType, Worker
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 
 def _spec():
     from model_zoo.mnist_functional_api import mnist_functional_api as zoo

@@ -15,6 +15,9 @@ from elasticdl_tpu.data.example_codec import decode_example
 from model_zoo.census_model_sqlflow import feature_configs as cfg
 from model_zoo.census_model_sqlflow import transform_ops as ops
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 MODEL_ZOO = "model_zoo"
 
 

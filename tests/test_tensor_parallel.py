@@ -16,6 +16,11 @@ from elasticdl_tpu.common.model_utils import (
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.training.trainer import Trainer
 
+import pytest
+
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 
 def _trainer(mesh, seq_len=32, extra=None):
     from model_zoo.transformer_lm import transformer_lm as zoo

@@ -11,6 +11,9 @@ from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.training.trainer import Trainer
 from model_zoo.transformer_lm import transformer_lm as zoo
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 PARAMS = (
     "vocab_size=32; seq_len=16; embed_dim=32; num_heads=2; num_layers=1"
 )

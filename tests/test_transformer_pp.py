@@ -15,6 +15,11 @@ from elasticdl_tpu.common.model_utils import (
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.training.trainer import Trainer
 
+import pytest
+
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 CFG = dict(vocab_size=64, seq_len=16, embed_dim=32, num_heads=4,
            num_layers=4, num_microbatches=2)
 

@@ -10,6 +10,11 @@ import re
 import subprocess
 import sys
 
+import pytest
+
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TUTORIAL = os.path.join(REPO, "docs", "tutorials",
                         "train_on_kubernetes.md")

@@ -13,6 +13,9 @@ from elasticdl_tpu.data import recordio_gen
 from elasticdl_tpu.master.master import Master
 from elasticdl_tpu.worker.worker import JobType, Worker
 
+# CI drills shard (make test-drills): the sub-5-min per-commit gate excludes this file.
+pytestmark = pytest.mark.integration
+
 
 def _spec():
     from model_zoo.mnist_functional_api import mnist_functional_api as zoo
