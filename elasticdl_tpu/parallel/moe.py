@@ -246,6 +246,22 @@ def moe_mlp_apply_a2a(params, x, mesh, capacity_factor=1.25,
     )(dict(params), x)
 
 
+def _router_gates(params, x, k):
+    """Shared drop-free routing for the inference formulations: f32
+    softmax router probs, top-k choice, and the combine-weight rule —
+    raw chosen prob for k=1 (Switch), chosen-set-normalized for k>1
+    (GShard g1/g2). Returns (gates [T, k] f32, top_i [T, k])."""
+    probs = jax.nn.softmax(
+        (x @ params["router"]).astype(jnp.float32), axis=-1
+    )
+    top_v, top_i = jax.lax.top_k(probs, k)
+    if k == 1:
+        gates = top_v
+    else:
+        gates = top_v / jnp.maximum(top_v.sum(-1, keepdims=True), 1e-9)
+    return gates, top_i
+
+
 def moe_mlp_infer(params, x, activation=jax.nn.gelu, router_top_k=1):
     """Drop-free top-k MoE MLP for DECODE/PREFILL: every token reaches
     all k chosen experts, no capacity queues, no [T, E, C] dispatch
@@ -257,20 +273,10 @@ def moe_mlp_infer(params, x, activation=jax.nn.gelu, router_top_k=1):
     pass — and the reason cached MoE decode is deterministic: a token's
     routing can't depend on which other tokens share its pass.
 
-    Combine weights match topk_dispatch with no drops: the raw chosen
-    prob for k=1 (Switch), the chosen-set-normalized probs for k>1
-    (GShard g1/g2). Returns y [T, D]."""
-    probs = jax.nn.softmax(
-        (x @ params["router"]).astype(jnp.float32), axis=-1
-    )
-    e = probs.shape[-1]
-    top_v, top_i = jax.lax.top_k(probs, router_top_k)  # [T, k]
-    if router_top_k == 1:
-        gates = top_v
-    else:
-        gates = top_v / jnp.maximum(
-            top_v.sum(-1, keepdims=True), 1e-9
-        )
+    Combine weights match topk_dispatch with no drops (shared
+    _router_gates). Returns y [T, D]."""
+    e = params["router"].shape[-1]
+    gates, top_i = _router_gates(params, x, router_top_k)
     # f32 gates and accumulator, like moe_mlp_apply's combine — the
     # bit-parity of the two formulations (and so cached-vs-uncached
     # decode equality) must hold for bf16-configured models too
@@ -283,6 +289,48 @@ def moe_mlp_infer(params, x, activation=jax.nn.gelu, router_top_k=1):
         w_e = jnp.sum(jnp.where(top_i == ei, gates, 0.0), axis=-1)
         y = y + w_e[:, None] * out.astype(jnp.float32)
     return y
+
+
+def moe_mlp_infer_gather(params, x, activation=jax.nn.gelu,
+                         router_top_k=1):
+    """Drop-free top-k MoE MLP via sort + ``jax.lax.ragged_dot``
+    (MegaBlocks-style dropless dispatch): the (token, choice) pairs are
+    sorted by expert, each expert multiplies exactly its own
+    contiguous row group against its weights, and outputs scatter-add
+    home weighted by the gates.
+
+    Same routing/combine semantics as :func:`moe_mlp_infer` (raw
+    chosen prob for k=1, chosen-set-normalized for k>1, f32
+    accumulator) at k/E of its FLOPs — moe_mlp_infer runs EVERY expert
+    densely over all T tokens (E x dense-MLP), this runs each token
+    through only its k experts: the right prefill path once expert
+    counts grow. Opt-in via the model knob ``moe_infer_impl='gather'``
+    (dense stays the default: for tiny decode batches the sort/gather
+    overhead outweighs the FLOP win, and the dense form is the
+    long-standing determinism baseline)."""
+    t, d = x.shape
+    e = params["router"].shape[-1]
+    k = router_top_k
+    gates, top_i = _router_gates(params, x, k)
+    flat_e = top_i.reshape(-1)                      # [T*k]
+    flat_t = jnp.repeat(jnp.arange(t), k)           # token of each pair
+    order = jnp.argsort(flat_e)                     # stable: ties keep
+    sorted_e = flat_e[order]                        # token order
+    sorted_t = flat_t[order]
+    xs = x[sorted_t]                                # [T*k, D]
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+    h = activation(
+        jax.lax.ragged_dot(xs, params["w_up"], group_sizes)
+        + params["b_up"][sorted_e]
+    )
+    out = (
+        jax.lax.ragged_dot(h, params["w_down"], group_sizes)
+        + params["b_down"][sorted_e]
+    )
+    gate_sorted = gates.reshape(-1)[order]
+    return jnp.zeros((t, d), jnp.float32).at[sorted_t].add(
+        gate_sorted[:, None] * out.astype(jnp.float32)
+    )
 
 
 def moe_reference(params, x, capacity_factor=1.25,

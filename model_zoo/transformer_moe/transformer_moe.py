@@ -35,6 +35,7 @@ from elasticdl_tpu.parallel.moe import (
     moe_mlp_apply,
     moe_mlp_apply_a2a,
     moe_mlp_infer,
+    moe_mlp_infer_gather,
 )
 from model_zoo.transformer_lm.transformer_lm import (
     CausalSelfAttention,
@@ -76,6 +77,11 @@ class MoEBlock(nn.Module):
     # (parallel/moe.py moe_mlp_apply_a2a; falls back to einsum off-mesh
     # or at ep=1, where there is nothing to exchange)
     moe_impl: str = "auto"
+    # decode/prefill formulation: "dense" = every expert over all T
+    # (E x FLOPs, the determinism baseline); "gather" = sorted
+    # ragged_dot dropless dispatch (k/E of the FLOPs — the prefill
+    # path once expert counts grow)
+    moe_infer_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
@@ -137,10 +143,23 @@ class MoEBlock(nn.Module):
             # deterministic and chunk-width-invariant. Training and
             # eval keep the capacity-bounded dispatch (fixed compute;
             # drops ride the residual).
-            out = moe_mlp_infer(
+            if self.moe_infer_impl not in ("dense", "gather"):
+                raise ValueError(
+                    "Unknown moe_infer_impl %r (valid: dense, gather)"
+                    % (self.moe_infer_impl,)
+                )
+            infer = (moe_mlp_infer_gather
+                     if self.moe_infer_impl == "gather"
+                     else moe_mlp_infer)
+            out = infer(
                 params, flat, router_top_k=self.router_top_k
             )
             return x + out.reshape(b, l, e), 0.0
+        if self.moe_impl not in ("auto", "a2a"):
+            raise ValueError(
+                "Unknown moe_impl %r (valid: auto, a2a)"
+                % (self.moe_impl,)
+            )
         mesh = mesh_lib.current_mesh()
         if (self.moe_impl == "a2a" and mesh is not None
                 and mesh.shape.get(MeshAxis.EP, 1) > 1):
@@ -171,6 +190,7 @@ class TransformerMoE(nn.Module):
     tp_shard: bool = True
     kv_cache_dtype: str = ""  # "" | "int8" (see CausalSelfAttention)
     moe_impl: str = "auto"  # "auto" einsum/GSPMD | "a2a" explicit
+    moe_infer_impl: str = "dense"  # "dense" | "gather" (ragged_dot)
 
     @nn.compact
     def __call__(self, features, training=False, decode=False,
@@ -201,6 +221,7 @@ class TransformerMoE(nn.Module):
                 cache_len=self.seq_len,
                 kv_cache_dtype=self.kv_cache_dtype,
                 moe_impl=self.moe_impl,
+                moe_infer_impl=self.moe_infer_impl,
                 name="block_%d" % i,
             )(x, training, decode=decode, decode_pos=decode_pos,
               prefill=prefill)

@@ -446,3 +446,62 @@ def test_a2a_dispatch_rejects_bad_shapes():
         moe.moe_mlp_apply_a2a(params, x, mesh)
     with pytest.raises(ValueError, match="tokens not divisible"):
         moe.moe_mlp_apply_a2a(_moe_params(e=8), jnp.zeros((63, 8)), mesh)
+
+
+def test_infer_gather_matches_dense_formulation():
+    """moe_mlp_infer_gather (sorted ragged_dot, k/E FLOPs) computes the
+    same drop-free function as the dense per-expert loop."""
+    for k, e, seed in ((1, 4, 11), (2, 4, 12), (2, 8, 13)):
+        params = _moe_params(e=e, seed=seed)
+        x = jnp.asarray(
+            np.random.default_rng(seed).standard_normal((48, 8)),
+            jnp.float32,
+        )
+        dense = moe.moe_mlp_infer(params, x, router_top_k=k)
+        gather = moe.moe_mlp_infer_gather(params, x, router_top_k=k)
+        np.testing.assert_allclose(
+            np.asarray(gather), np.asarray(dense),
+            atol=1e-5, rtol=1e-4,
+        )
+
+
+def test_moe_gather_kv_decode_matches_full_forward():
+    """The dropless gather prefill/decode path keeps the KV-cache
+    determinism contract: cached decode == uncached full forward."""
+    from model_zoo.transformer_moe import transformer_moe as moe_zoo
+
+    from elasticdl_tpu.api.generation import autoregressive_generate
+
+    trainer = Trainer(
+        load_model_spec_from_module(moe_zoo),
+        mesh=mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1]),
+        model_params=format_params_str(
+            dict(vocab_size=16, seq_len=24, embed_dim=32, num_heads=2,
+                 num_layers=2, num_experts=4, router_top_k=2,
+                 capacity_factor=2.0, attn_impl="xla",
+                 moe_infer_impl="gather")
+        ),
+    )
+
+    def cycle(seed):
+        rs = np.random.RandomState(seed)
+        starts = rs.randint(0, 16, size=(4, 1))
+        t = ((starts + np.arange(25)[None, :]) % 16).astype(np.int32)
+        return {"tokens": t[:, :-1]}, t[:, 1:]
+
+    state = trainer.init_state(cycle(0))
+    for step in range(200):
+        state, loss = trainer.train_step(state, cycle(step))
+    # decisive argmax margins: equality between the gather prefill path
+    # and the capacity-bounded uncached forward must not hinge on
+    # near-random logits (same guard as the dense twin test)
+    assert float(loss) < 0.4
+    prompt = np.asarray([[1, 2, 3], [4, 5, 6]], np.int32)
+    full = np.asarray(
+        autoregressive_generate(trainer, state, prompt, 8)
+    )
+    kv = np.asarray(
+        autoregressive_generate(trainer, state, prompt, 8,
+                                use_cache=True)
+    )
+    np.testing.assert_array_equal(full, kv)
