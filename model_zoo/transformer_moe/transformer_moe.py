@@ -86,6 +86,18 @@ class MoEBlock(nn.Module):
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
                  prefill=False):
+        # validate both dispatch knobs up front so a typo fails at
+        # trace time on EVERY path, not only when its branch first runs
+        if self.moe_infer_impl not in ("dense", "gather"):
+            raise ValueError(
+                "Unknown moe_infer_impl %r (valid: dense, gather)"
+                % (self.moe_infer_impl,)
+            )
+        if self.moe_impl not in ("auto", "a2a"):
+            raise ValueError(
+                "Unknown moe_impl %r (valid: auto, a2a)"
+                % (self.moe_impl,)
+            )
         b, l, e = x.shape
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
@@ -136,18 +148,15 @@ class MoEBlock(nn.Module):
         }
         flat = y.reshape(b * l, e)
         if decode or prefill:
-            # Generation routes DROP-FREE through the dense per-expert
-            # formulation (parallel/moe.py moe_mlp_infer): no capacity
-            # queues, so a decoded token's routing never depends on
-            # which other tokens share its pass — cached decode is
-            # deterministic and chunk-width-invariant. Training and
-            # eval keep the capacity-bounded dispatch (fixed compute;
-            # drops ride the residual).
-            if self.moe_infer_impl not in ("dense", "gather"):
-                raise ValueError(
-                    "Unknown moe_infer_impl %r (valid: dense, gather)"
-                    % (self.moe_infer_impl,)
-                )
+            # Generation routes DROP-FREE (moe_infer_impl: "dense" =
+            # every expert over all T via parallel/moe.py
+            # moe_mlp_infer; "gather" = sorted ragged_dot dispatch,
+            # moe_mlp_infer_gather): no capacity queues, so a decoded
+            # token's routing never depends on which other tokens
+            # share its pass — cached decode is deterministic and
+            # chunk-width-invariant. Training and eval keep the
+            # capacity-bounded dispatch (fixed compute; drops ride
+            # the residual).
             infer = (moe_mlp_infer_gather
                      if self.moe_infer_impl == "gather"
                      else moe_mlp_infer)
@@ -155,11 +164,6 @@ class MoEBlock(nn.Module):
                 params, flat, router_top_k=self.router_top_k
             )
             return x + out.reshape(b, l, e), 0.0
-        if self.moe_impl not in ("auto", "a2a"):
-            raise ValueError(
-                "Unknown moe_impl %r (valid: auto, a2a)"
-                % (self.moe_impl,)
-            )
         mesh = mesh_lib.current_mesh()
         if (self.moe_impl == "a2a" and mesh is not None
                 and mesh.shape.get(MeshAxis.EP, 1) > 1):
