@@ -77,7 +77,8 @@ def main():
 
     for bq, bk in [(64, 128), (64, 256), (64, 512),
                    (128, 128), (128, 256), (128, 512), (256, 256),
-                   (256, 512), (512, 512), (256, 1024), (512, 1024)]:
+                   (256, 512), (512, 512), (256, 1024), (512, 1024),
+                   (1024, 1024), (1024, 512), (128, 1024)]:
         if s % bq or s % bk:
             continue
         bench_pair(
