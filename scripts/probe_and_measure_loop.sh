@@ -18,11 +18,21 @@ while true; do
             2>&1 | tee hw_session_run.log
         RC=$?
         echo "[loop] hw_session rc=$RC"
-        if [ "$RC" -eq 0 ] && [ -s hw_session_results.json ]; then
-            echo "[loop] results saved; exiting"
+        # hw_session exits 0 even when every bench fell back to CPU
+        # (wedge right after the probe answered) — only a flagship
+        # measured ON THE CHIP counts as a completed window
+        if [ "$RC" -eq 0 ] && [ -s hw_session_results.json ] && \
+           python - <<'EOF'
+import json, sys
+d = json.load(open("hw_session_results.json"))
+flag = d.get("flagship") or d.get("flagship_prelim") or {}
+sys.exit(0 if flag.get("platform") not in (None, "cpu") else 1)
+EOF
+        then
+            echo "[loop] TPU flagship captured; exiting"
             exit 0
         fi
-        echo "[loop] hw_session incomplete — continuing to probe"
+        echo "[loop] no TPU flagship yet — continuing to probe"
     fi
     sleep "$INTERVAL"
 done
