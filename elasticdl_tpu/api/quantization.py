@@ -15,7 +15,6 @@ leaves. The reference has no quantization (or generation) story; this
 is net-new surface.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

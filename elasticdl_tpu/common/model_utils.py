@@ -18,7 +18,6 @@ import importlib
 import importlib.util
 import os
 
-from elasticdl_tpu.common.log_utils import default_logger as logger
 
 
 def load_module(module_file):

@@ -14,7 +14,6 @@ import threading
 import time
 from concurrent import futures
 
-from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.servicer import MasterServicer

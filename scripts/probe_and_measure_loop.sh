@@ -13,7 +13,12 @@ while true; do
     STATUS=$(bash scripts/probe_tpu.sh "$PROBE_T")
     if echo "$STATUS" | grep -q "^UP"; then
         echo "[loop] tunnel UP at $(date -u +%H:%M:%S) — running hw_session"
-        rm -f hw_session_results.json  # a stale file must not read as success
+        # a stale file must not read as success; keep the old window's
+        # partial measurements around instead of destroying them
+        if [ -s hw_session_results.json ]; then
+            mv hw_session_results.json \
+               "hw_session_results.$(date -u +%H%M%S).json"
+        fi
         python scripts/hw_session.py --out hw_session_results.json \
             2>&1 | tee hw_session_run.log
         RC=$?
@@ -25,8 +30,11 @@ while true; do
            python - <<'EOF'
 import json, sys
 d = json.load(open("hw_session_results.json"))
-flag = d.get("flagship") or d.get("flagship_prelim") or {}
-sys.exit(0 if flag.get("platform") not in (None, "cpu") else 1)
+ok = any(
+    (d.get(k) or {}).get("platform") not in (None, "cpu")
+    for k in ("flagship", "flagship_prelim")
+)
+sys.exit(0 if ok else 1)
 EOF
         then
             echo "[loop] TPU flagship captured; exiting"

@@ -3,7 +3,6 @@ verification of the TFRecord/Event encoding), TensorBoard service, and
 the collective communicator contract."""
 
 import glob
-import os
 import struct
 
 import numpy as np

@@ -6,7 +6,6 @@ compatibility."""
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from elasticdl_tpu.api.local_executor import LocalExecutor

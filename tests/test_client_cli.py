@@ -4,7 +4,6 @@ no-cluster end-to-end `train` path (reference elasticdl_client/tests +
 scripts/client_test.sh in spirit)."""
 
 import os
-import sys
 
 import pytest
 

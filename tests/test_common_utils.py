@@ -1,5 +1,4 @@
 import numpy as np
-import pytest
 
 from elasticdl_tpu.common import hash_utils
 from elasticdl_tpu.common.model_utils import get_dict_from_params_str

@@ -3,9 +3,7 @@ machine (relaunch policy, preemption, task recovery), watchdog wiring,
 args round-trip — the same boundaries the reference mocks
 (k8s_client_test.py, k8s_instance_manager_test.py)."""
 
-import threading
 
-import pytest
 
 from elasticdl_tpu.common.args import (
     MASTER_ONLY_ARGS,

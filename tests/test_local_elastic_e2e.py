@@ -6,7 +6,6 @@ TPU-native analogue of the reference's PS-restart fault test
 
 import os
 import subprocess
-import sys
 import time
 
 import pytest

@@ -1,7 +1,6 @@
 """End-to-end: LocalExecutor trains the mnist zoo model on synthetic TRec
 data (mirrors the reference's example_test.py in-process harness)."""
 
-import sys
 
 import numpy as np
 import pytest

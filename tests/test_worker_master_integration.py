@@ -5,7 +5,6 @@ process/k8s boundary, never in the math path)."""
 
 import threading
 
-import numpy as np
 import pytest
 
 from elasticdl_tpu.common.model_utils import load_model_spec_from_module
