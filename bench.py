@@ -173,6 +173,30 @@ def probe_accelerator(deadline_s, attempt_s=None, retry_pause_s=None):
                 time.sleep(retry_pause_s)
 
 
+def require_accelerator_or_exit(deadline_s=None):
+    """Shared guard for TPU-only measurement scripts (profile_step,
+    bench_collectives): fail FAST on a wedged tunnel via the bounded
+    subprocess probe instead of hanging until the caller's outer
+    timeout — a wedged hw_session step then costs the probe deadline
+    (EDL_BENCH_PROBE_TIMEOUT, default 300 s like the bench itself),
+    not its 30-min bound. A deliberate CPU-FIRST run (JAX_PLATFORMS
+    leading with "cpu", e.g. the virtual 8-device mesh) skips the
+    probe entirely; a fallback list like "axon,cpu" does not — its
+    jax init still hangs on the wedged tunnel, which is exactly what
+    the guard is for."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms.split(",")[0].strip() == "cpu":
+        return
+    if deadline_s is None:
+        deadline_s = _env_float(None, "EDL_BENCH_PROBE_TIMEOUT",
+                                300.0, 5.0)
+    backend, _ = probe_accelerator(deadline_s)
+    if backend is None:
+        sys.stderr.write("no accelerator within %.0fs; aborting "
+                         "(tunnel wedged?)\n" % deadline_s)
+        sys.exit(1)
+
+
 def _peak_flops(device_kind):
     kind = (device_kind or "").lower().replace("tpu", "").strip(" -_")
     for key, peak in _PEAK_FLOPS.items():

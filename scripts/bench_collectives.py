@@ -37,6 +37,9 @@ sys.path.insert(0, REPO)
 
 
 def main():
+    import bench as bench_mod
+
+    bench_mod.require_accelerator_or_exit()
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -17,7 +17,7 @@ while true; do
         # partial measurements around instead of destroying them
         if [ -s hw_session_results.json ]; then
             mv hw_session_results.json \
-               "hw_session_results.$(date -u +%H%M%S).json"
+               "hw_session_results.$(date -u +%Y%m%dT%H%M%S).json"
         fi
         python scripts/hw_session.py --out hw_session_results.json \
             2>&1 | tee hw_session_run.log
