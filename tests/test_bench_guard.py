@@ -1,0 +1,54 @@
+"""Unit coverage for bench.require_accelerator_or_exit — the fail-fast
+guard TPU-only measurement scripts (scripts/profile_step.py,
+scripts/bench_collectives.py) call before touching jax, so a wedged
+tunnel costs the probe deadline instead of the caller's 30-min bound."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+
+
+def test_cpu_first_platform_skips_probe(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(bench, "probe_accelerator",
+                        lambda *a, **k: pytest.fail("probe must not run"))
+    bench.require_accelerator_or_exit()  # returns, no exit
+
+
+def test_cpu_fallback_list_still_probes(monkeypatch):
+    """'axon,cpu' means jax init would still hang on the wedged axon
+    plugin — the guard must probe, not skip."""
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+    calls = []
+    monkeypatch.setattr(bench, "probe_accelerator",
+                        lambda d, *a, **k: calls.append(d) or ("tpu", "v5e"))
+    bench.require_accelerator_or_exit(deadline_s=7.0)
+    assert calls == [7.0]
+
+
+def test_no_accelerator_exits_nonzero(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(bench, "probe_accelerator",
+                        lambda *a, **k: (None, None))
+    with pytest.raises(SystemExit) as e:
+        bench.require_accelerator_or_exit(deadline_s=5.0)
+    assert e.value.code == 1
+
+
+def test_malformed_env_deadline_falls_back(monkeypatch, capsys):
+    """EDL_BENCH_PROBE_TIMEOUT=abc must warn and use the default, not
+    crash (bench's rc=0 contract)."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("EDL_BENCH_PROBE_TIMEOUT", "abc")
+    seen = []
+    monkeypatch.setattr(bench, "probe_accelerator",
+                        lambda d, *a, **k: seen.append(d) or ("tpu", "v5e"))
+    bench.require_accelerator_or_exit()
+    assert seen == [300.0]
+    assert "ignoring bad EDL_BENCH_PROBE_TIMEOUT" in \
+        capsys.readouterr().err
