@@ -477,6 +477,27 @@ def run_decode_bench(on_tpu):
     # prefill A/B — they are bench knobs, not model kwargs, so they are
     # popped out of the model params but stay in the reported extras)
     params, extra, batch = apply_extra_params(cfg, batch, on_tpu)
+    if int(params.pop("moe", 0)):
+        # decode the MoE family instead of the dense LM: the drop-free
+        # inference dispatch (moe_infer_impl='dense'|'gather', see
+        # parallel/moe.py moe_mlp_infer{,_gather}) only runs on
+        # decode/prefill paths, so this knob is the one bench surface
+        # that can A/B it on hardware:
+        #   EDL_BENCH_MODEL=decode \
+        #   EDL_BENCH_EXTRA_PARAMS="moe=1; moe_infer_impl='gather'"
+        from model_zoo.transformer_moe import (  # noqa: F811
+            transformer_moe as zoo,
+        )
+        params.setdefault("num_experts", 8 if on_tpu else 4)
+        params.setdefault("router_top_k", 2)
+        if on_tpu and "num_layers" not in extra:
+            # match the moe training bench's depth (expert FFNs double
+            # the layer cost vs the 8-layer dense decode config)
+            params["num_layers"] = 4
+        # the reported config must describe what actually ran
+        cfg.update(num_layers=params["num_layers"],
+                   num_experts=params["num_experts"],
+                   router_top_k=params["router_top_k"])
     prompt = int(params.pop("prompt", prompt))
     new_tokens = int(params.pop("new_tokens", new_tokens))
     quantize = bool(params.pop("quantize", 0))

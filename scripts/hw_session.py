@@ -418,6 +418,15 @@ def main():
         ("remat_full_batch64", {"EDL_BENCH_EXTRA_PARAMS":
                                 "remat='full'",
                                 "EDL_BENCH_BATCH": "64"}),
+        # MoE decode dispatch: dense runs EVERY expert over all tokens
+        # (determinism baseline), gather is the sorted ragged_dot
+        # drop-free path at k/E of the FLOPs — back-to-back so the
+        # pair shares a window
+        ("decode_moe_dense", {"EDL_BENCH_MODEL": "decode",
+                              "EDL_BENCH_EXTRA_PARAMS": "moe=1"}),
+        ("decode_moe_gather", {"EDL_BENCH_MODEL": "decode",
+                               "EDL_BENCH_EXTRA_PARAMS":
+                               "moe=1; moe_infer_impl='gather'"}),
         # sequence-packing overhead: same shapes, 4 segments per row
         # through the kernels' segment masks (vs the plain flagship)
         ("packed4_flagship", {"EDL_BENCH_EXTRA_PARAMS": "packed=4"}),
