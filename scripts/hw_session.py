@@ -78,6 +78,17 @@ def run(cmd, timeout, env_extra=None, tag="", base_env=None):
 
 
 def save(results, out_path):
+    # coverage summary the probe loop's exit gate reads: how many
+    # results landed on the chip vs how many the session could
+    # produce (prelim + flagship + 6 families + collectives +
+    # AB_QUEUE; profile/pipeline never emit TPU JSON). Owning the
+    # roster here keeps the loop's threshold from drifting when the
+    # queue changes.
+    results["tpu_measured"] = sum(
+        1 for v in results.values()
+        if isinstance(v, dict) and v.get("platform") not in (None, "cpu")
+    )
+    results["tpu_target"] = 9 + len(AB_QUEUE)
     with open(out_path, "w") as f:
         json.dump(results, f, indent=1)
 
@@ -108,6 +119,95 @@ def parse_sweep(stdout):
             rows.append((int(m.group(1)), int(m.group(2)),
                          float(m.group(3)), float(m.group(4))))
     return rows
+
+
+# Model-knob A/Bs. Ordered by headline impact: knobs that could
+# RAISE the flagship number run first (a short tunnel window should
+# die holding the most valuable unmeasured comparison), then the
+# decode family story, then comparison/diagnostic points.
+AB_QUEUE = (
+        # branch the per-element causal mask out of interior blocks
+        # (lax.cond in-kernel) — wins only if Mosaic pipelines across
+        # the branch; falls back to the default straight-line select
+        # if this step regresses or fails to lower
+        ("condmask_flagship", {"EDL_FLASH_COND_MASK": "1"}),
+        ("fused_head_flagship", {"EDL_BENCH_EXTRA_PARAMS":
+                                       "fused_head=True"}),
+        # per-block remat frees activation HBM -> bigger global batch,
+        # bigger MXU tiles; 'dots' keeps matmul outputs (cheaper bwd).
+        # Compare tokens/sec against the plain flagship: remat wins
+        # exactly when the freed memory converts to throughput
+        ("remat_dots_batch64", {"EDL_BENCH_EXTRA_PARAMS":
+                                      "remat='dots'",
+                                      "EDL_BENCH_BATCH": "64"}),
+        ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS":
+                                 "num_kv_heads=2"}),
+        ("jax_flash_flagship", {"EDL_BENCH_EXTRA_PARAMS":
+                                "attn_impl='jax_flash'"}),
+        ("baseline_seq2048", {"EDL_BENCH_EXTRA_PARAMS": "seq_len=2048",
+                              "EDL_BENCH_BATCH": "16"}),
+        ("fused_head_seq2048", {"EDL_BENCH_EXTRA_PARAMS":
+                                "fused_head=True; seq_len=2048",
+                                "EDL_BENCH_BATCH": "16"}),
+        # GQA decode A/B: 8 -> 2 kv heads = 4x smaller KV cache; decode
+        # is cache-bandwidth-bound, so this measures the GQA win
+        ("decode_gqa2", {"EDL_BENCH_MODEL": "decode",
+                         "EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
+        # batched-prefill regime: long prompt, short continuation — the
+        # prefill collapses 512 single-token steps into one causal pass
+        ("decode_longprompt", {"EDL_BENCH_MODEL": "decode",
+                               "EDL_BENCH_EXTRA_PARAMS":
+                               "prompt=512; new_tokens=128"}),
+        # weight-only int8 decode: weights travel HBM->VMEM as int8
+        # (dequant fused into the matmuls); vs the bf16 decode target
+        ("decode_int8", {"EDL_BENCH_MODEL": "decode",
+                         "EDL_BENCH_EXTRA_PARAMS": "quantize=1"}),
+        # int8 KV cache: the decode path's dominant HBM stream (the
+        # per-token cache re-read) halves vs bf16; combines with
+        # weight int8 for the full bandwidth story
+        ("decode_kv_int8", {"EDL_BENCH_MODEL": "decode",
+                            "EDL_BENCH_EXTRA_PARAMS":
+                            "kv_cache_dtype='int8'"}),
+        ("decode_kv_plus_w_int8",
+         {"EDL_BENCH_MODEL": "decode",
+          "EDL_BENCH_EXTRA_PARAMS":
+          "kv_cache_dtype='int8'; quantize=1"}),
+        # KV-cached beam search: per-step cache gathers at width 4
+        ("decode_beam4", {"EDL_BENCH_MODEL": "decode",
+                          "EDL_BENCH_EXTRA_PARAMS": "beams=4"}),
+        # speculative decode mechanics: ceiling (target drafts itself,
+        # ~100% acceptance) and floor (random 2-layer draft)
+        ("decode_spec_ceiling",
+         {"EDL_BENCH_MODEL": "decode",
+          "EDL_BENCH_EXTRA_PARAMS": "spec_gamma=4; spec_draft_layers=0"}),
+        ("decode_spec_draft2",
+         {"EDL_BENCH_MODEL": "decode",
+          "EDL_BENCH_EXTRA_PARAMS": "spec_gamma=4"}),
+        # trained draft (api/distill.py): warm-start + 200 KL steps on
+        # the target's logits; acceptance + tokens/sec land in
+        # extra_params — the real-speedup story between floor and
+        # ceiling
+        ("decode_spec_trained",
+         {"EDL_BENCH_MODEL": "decode",
+          "EDL_BENCH_EXTRA_PARAMS":
+          "spec_gamma=4; spec_draft_layers=1; "
+          "spec_draft_train_steps=200"}),
+        ("remat_full_batch64", {"EDL_BENCH_EXTRA_PARAMS":
+                                "remat='full'",
+                                "EDL_BENCH_BATCH": "64"}),
+        # MoE decode dispatch: dense runs EVERY expert over all tokens
+        # (determinism baseline), gather is the sorted ragged_dot
+        # drop-free path at k/E of the FLOPs — back-to-back so the
+        # pair shares a window
+        ("decode_moe_dense", {"EDL_BENCH_MODEL": "decode",
+                              "EDL_BENCH_EXTRA_PARAMS": "moe=1"}),
+        ("decode_moe_gather", {"EDL_BENCH_MODEL": "decode",
+                               "EDL_BENCH_EXTRA_PARAMS":
+                               "moe=1; moe_infer_impl='gather'"}),
+        # sequence-packing overhead: same shapes, 4 segments per row
+        # through the kernels' segment masks (vs the plain flagship)
+        ("packed4_flagship", {"EDL_BENCH_EXTRA_PARAMS": "packed=4"}),
+)
 
 
 def main():
@@ -344,93 +444,11 @@ def main():
         results["collectives"] = parsed
         save(results, args.out)
 
-    # 7. model-knob A/Bs. Ordered by headline impact: knobs that could
-    # RAISE the flagship number run first (a short tunnel window should
-    # die holding the most valuable unmeasured comparison), then the
-    # decode family story, then comparison/diagnostic points.
-    for tag, extra in (
-        # branch the per-element causal mask out of interior blocks
-        # (lax.cond in-kernel) — wins only if Mosaic pipelines across
-        # the branch; falls back to the default straight-line select
-        # if this step regresses or fails to lower
-        ("condmask_flagship", {"EDL_FLASH_COND_MASK": "1"}),
-        ("fused_head_flagship", {"EDL_BENCH_EXTRA_PARAMS":
-                                       "fused_head=True"}),
-        # per-block remat frees activation HBM -> bigger global batch,
-        # bigger MXU tiles; 'dots' keeps matmul outputs (cheaper bwd).
-        # Compare tokens/sec against the plain flagship: remat wins
-        # exactly when the freed memory converts to throughput
-        ("remat_dots_batch64", {"EDL_BENCH_EXTRA_PARAMS":
-                                      "remat='dots'",
-                                      "EDL_BENCH_BATCH": "64"}),
-        ("gqa2_flagship", {"EDL_BENCH_EXTRA_PARAMS":
-                                 "num_kv_heads=2"}),
-        ("jax_flash_flagship", {"EDL_BENCH_EXTRA_PARAMS":
-                                "attn_impl='jax_flash'"}),
-        ("baseline_seq2048", {"EDL_BENCH_EXTRA_PARAMS": "seq_len=2048",
-                              "EDL_BENCH_BATCH": "16"}),
-        ("fused_head_seq2048", {"EDL_BENCH_EXTRA_PARAMS":
-                                "fused_head=True; seq_len=2048",
-                                "EDL_BENCH_BATCH": "16"}),
-        # GQA decode A/B: 8 -> 2 kv heads = 4x smaller KV cache; decode
-        # is cache-bandwidth-bound, so this measures the GQA win
-        ("decode_gqa2", {"EDL_BENCH_MODEL": "decode",
-                         "EDL_BENCH_EXTRA_PARAMS": "num_kv_heads=2"}),
-        # batched-prefill regime: long prompt, short continuation — the
-        # prefill collapses 512 single-token steps into one causal pass
-        ("decode_longprompt", {"EDL_BENCH_MODEL": "decode",
-                               "EDL_BENCH_EXTRA_PARAMS":
-                               "prompt=512; new_tokens=128"}),
-        # weight-only int8 decode: weights travel HBM->VMEM as int8
-        # (dequant fused into the matmuls); vs the bf16 decode target
-        ("decode_int8", {"EDL_BENCH_MODEL": "decode",
-                         "EDL_BENCH_EXTRA_PARAMS": "quantize=1"}),
-        # int8 KV cache: the decode path's dominant HBM stream (the
-        # per-token cache re-read) halves vs bf16; combines with
-        # weight int8 for the full bandwidth story
-        ("decode_kv_int8", {"EDL_BENCH_MODEL": "decode",
-                            "EDL_BENCH_EXTRA_PARAMS":
-                            "kv_cache_dtype='int8'"}),
-        ("decode_kv_plus_w_int8",
-         {"EDL_BENCH_MODEL": "decode",
-          "EDL_BENCH_EXTRA_PARAMS":
-          "kv_cache_dtype='int8'; quantize=1"}),
-        # KV-cached beam search: per-step cache gathers at width 4
-        ("decode_beam4", {"EDL_BENCH_MODEL": "decode",
-                          "EDL_BENCH_EXTRA_PARAMS": "beams=4"}),
-        # speculative decode mechanics: ceiling (target drafts itself,
-        # ~100% acceptance) and floor (random 2-layer draft)
-        ("decode_spec_ceiling",
-         {"EDL_BENCH_MODEL": "decode",
-          "EDL_BENCH_EXTRA_PARAMS": "spec_gamma=4; spec_draft_layers=0"}),
-        ("decode_spec_draft2",
-         {"EDL_BENCH_MODEL": "decode",
-          "EDL_BENCH_EXTRA_PARAMS": "spec_gamma=4"}),
-        # trained draft (api/distill.py): warm-start + 200 KL steps on
-        # the target's logits; acceptance + tokens/sec land in
-        # extra_params — the real-speedup story between floor and
-        # ceiling
-        ("decode_spec_trained",
-         {"EDL_BENCH_MODEL": "decode",
-          "EDL_BENCH_EXTRA_PARAMS":
-          "spec_gamma=4; spec_draft_layers=1; "
-          "spec_draft_train_steps=200"}),
-        ("remat_full_batch64", {"EDL_BENCH_EXTRA_PARAMS":
-                                "remat='full'",
-                                "EDL_BENCH_BATCH": "64"}),
-        # MoE decode dispatch: dense runs EVERY expert over all tokens
-        # (determinism baseline), gather is the sorted ragged_dot
-        # drop-free path at k/E of the FLOPs — back-to-back so the
-        # pair shares a window
-        ("decode_moe_dense", {"EDL_BENCH_MODEL": "decode",
-                              "EDL_BENCH_EXTRA_PARAMS": "moe=1"}),
-        ("decode_moe_gather", {"EDL_BENCH_MODEL": "decode",
-                               "EDL_BENCH_EXTRA_PARAMS":
-                               "moe=1; moe_infer_impl='gather'"}),
-        # sequence-packing overhead: same shapes, 4 segments per row
-        # through the kernels' segment masks (vs the plain flagship)
-        ("packed4_flagship", {"EDL_BENCH_EXTRA_PARAMS": "packed=4"}),
-    ):
+    # 7. model-knob A/Bs (AB_QUEUE, module level: the coverage target
+    # in save() counts it)
+    for tag, extra in AB_QUEUE:
+        # copy: AB_QUEUE is module state shared across main() calls
+        extra = dict(extra)
         extra["EDL_BENCH_PROBE_TIMEOUT"] = "150"
         # bare default is the whole suite now — A/Bs without an
         # explicit family run the flagship transformer
