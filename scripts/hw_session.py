@@ -5,21 +5,30 @@ minutes-to-hours, then wedged — see BENCHNOTES.md).
 
     python scripts/hw_session.py [--out hw_session_results.json]
 
-Steps (each in its own bounded subprocess; a hang or crash moves on):
+Steps (each in its own bounded subprocess; a hang or crash moves on).
+Value-ordered for minutes-long tunnel windows — on a session where a
+prior sweep already persisted tuned blocks (flash_tuning.json), the
+prelim IS the tuned headline and the family benches run BEFORE the
+re-sweep:
   1. probe             — bounded accelerator init; abort if wedged
+  1b. flagship prelim  — python bench.py at current tuned defaults;
+                         on a tuned session this refreshes
+                         BENCH_BASELINE.json immediately
+  [tuned sessions only] family benches jump here (see 4./5.)
   2. attention sweep   — scripts/bench_attention.py block-size sweep;
                          the best (block_q, block_k) is persisted to
                          elasticdl_tpu/ops/flash_tuning.json (the
                          repo-wide tuned default) when it beats 128/128
-  3. flagship bench    — python bench.py before/after the tuned blocks
-  4./5. secondary benches — EDL_BENCH_MODEL=resnet50|deepfm|decode|dlrm|bert|moe
+  3. flagship bench    — re-run under the (re-)tuned blocks
+  4./5. family benches — EDL_BENCH_MODEL=resnet50|deepfm|decode|dlrm|bert|moe
                          (BASELINE.md targets + decode throughput +
                          the 1B-embedding DLRM stress config)
+  5b. pipeline A/B     — gpipe vs interleaved on the virtual CPU mesh
   6. profile           — scripts/profile_step.py (attention share)
   6b. collectives      — gradient-plane all-reduce bandwidth
-  7. model-knob A/Bs   — jax's bundled flash kernel; fused LM head at
-                         the flagship shape AND seq_len=2048 (the
-                         regime VERDICT asks to prove or prune)
+  7. model-knob A/Bs   — AB_QUEUE, headline-impact first (condmask,
+                         fused head, remat, GQA), then the decode
+                         family story, then diagnostics
 
 Everything lands in --out (JSON, appended after each step) plus the raw
 logs next to it; BENCH_BASELINE.json is updated ONLY when the flagship
