@@ -1,0 +1,128 @@
+"""Vision Transformer (image classification) — net-new zoo family; the
+reference zoo's vision ceiling is ResNet50
+(/root/reference/model_zoo/resnet50_subclass/resnet50_subclass.py), with
+no attention-based vision model. Same zoo spec surface as every family
+(custom_model/loss/optimizer/dataset_fn/eval_metrics_fn/feature_shapes),
+trained on the cifar10-shaped TRec records `gen_cifar10_like` emits.
+
+TPU-first choices:
+- Patch embedding is a reshape + one Dense (a single [B*N, p*p*C] ×
+  [p*p*C, D] matmul on the MXU) rather than a strided conv.
+- No CLS token: mean-pool over patch tokens. 32/4 -> 8x8 = 64 tokens,
+  which tiles cleanly into the flash kernel's blocks; a 65-token CLS
+  sequence would knock attention onto the non-tiling fallback path.
+- The encoder reuses transformer_lm's Block with causal=False, so
+  attention dispatch (flash/blockwise), Megatron TP annotations, the
+  bf16 knob, and LoRA adapters live in ONE place (same reuse as bert).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example_codec import decode_example
+from model_zoo.transformer_lm.transformer_lm import (
+    Block,
+    resolve_dtype,
+)
+
+
+class ViT(nn.Module):
+    image_size: int = 32
+    channels: int = 3
+    patch_size: int = 4
+    num_classes: int = 10
+    embed_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 4
+    dtype: object = None
+    attn_impl: str = "auto"
+    tp_shard: bool = True
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    dropout: float = 0.1
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                "image_size %d not divisible by patch_size %d"
+                % (self.image_size, self.patch_size)
+            )
+        x = features["image"]
+        b = x.shape[0]
+        s, p, c = self.image_size, self.patch_size, self.channels
+        x = x.reshape(b, s, s, c)
+        n = s // p
+        # [b, n, p, n, p, c] -> [b, n*n, p*p*c]: each row is one patch
+        x = x.reshape(b, n, p, n, p, c).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(b, n * n, p * p * c)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        x = nn.Dense(self.embed_dim, dtype=self.dtype,
+                     name="patch_embed")(x)
+        x = x + nn.Embed(n * n, self.embed_dim, dtype=self.dtype,
+                         name="wpe")(jnp.arange(n * n)[None, :])
+        x = nn.Dropout(self.dropout, deterministic=not training)(x)
+        head_dim = self.embed_dim // self.num_heads
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads, head_dim, dtype=self.dtype,
+                attn_impl=self.attn_impl, tp_shard=self.tp_shard,
+                causal=False,
+                lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                name="layer_%d" % i,
+            )(x, training)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = x.mean(axis=1)  # mean-pool patch tokens (no CLS; see above)
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, name="head"
+        )(x).astype(jnp.float32)
+
+
+def custom_model(**kwargs):
+    return ViT(**resolve_dtype(kwargs, "vit"))
+
+
+def loss(labels, predictions, sample_weights=None):
+    labels = jnp.asarray(labels).reshape(-1)
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels
+    )
+    if sample_weights is not None:
+        w = jnp.asarray(sample_weights).reshape(-1)
+        return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-8)
+    return jnp.mean(per)
+
+
+def optimizer(lr=3e-4):
+    return optax.adamw(lr, weight_decay=0.05)
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse(record):
+        ex = decode_example(record)
+        features = {"image": ex["image"].astype(np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, ex["label"].astype(np.int32)[0]
+
+    dataset = dataset.map(_parse)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: (
+            np.argmax(predictions, axis=1)
+            == np.asarray(labels).reshape(-1)
+        ).astype(np.float32)
+    }
+
+
+def feature_shapes():
+    return {"image": (32, 32, 3)}
