@@ -408,6 +408,63 @@ def run_resnet50_bench(on_tpu):
     }
 
 
+def run_vit_bench(on_tpu):
+    """ViT images/sec (train) — net-new family (the reference zoo's
+    vision ceiling is ResNet50). TPU config is ViT-Base-shaped at
+    224px/patch 14 -> 256 patch tokens (tiles into the flash blocks;
+    /16 would give 196, which falls back to blockwise)."""
+    import numpy as np
+
+    from elasticdl_tpu.common.model_utils import format_params_str
+    from model_zoo.vit import vit as zoo
+
+    if on_tpu:
+        cfg = dict(image_size=224, patch_size=14, num_classes=1000,
+                   embed_dim=768, num_heads=12, num_layers=12)
+        batch_size, iters, warmup = 64, 20, 3
+    else:
+        cfg = dict(image_size=32, patch_size=4, num_classes=10,
+                   embed_dim=64, num_heads=4, num_layers=2)
+        batch_size, iters, warmup = 4, 3, 1
+
+    params, extra, batch_size = apply_extra_params(cfg, batch_size,
+                                                   on_tpu)
+    rng = np.random.RandomState(0)
+    batch = (
+        {"image": rng.rand(
+            batch_size, cfg["image_size"], cfg["image_size"], 3
+        ).astype(np.float32)},
+        rng.randint(cfg["num_classes"],
+                    size=(batch_size, 1)).astype(np.int32),
+    )
+    step_time, n_chips, dev, platform, n_params = _run_zoo_bench(
+        zoo, batch, iters, warmup,
+        model_params=format_params_str(params),
+    )
+    # fwd+bwd ~= 3 * 2 * params * tokens FLOPs (dense transformer rule;
+    # attention at 256 tokens adds a few % — omitted, keeping the
+    # estimate conservative)
+    n_tokens = (cfg["image_size"] // cfg["patch_size"]) ** 2
+    flops = 6.0 * n_params * n_tokens * batch_size
+    mfu = None if platform == "cpu" else round(
+        flops / step_time / (_peak_flops(
+            getattr(dev, "device_kind", "")) * n_chips), 4)
+    return {
+        "metric": "vit_train_images_per_sec_per_chip",
+        "value": round(batch_size / step_time / n_chips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,  # filled by _apply_vs_baseline
+        "mfu": mfu,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "params_m": round(n_params / 1e6, 1),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "") or platform,
+        "config": cfg,
+        "extra_params": extra or None,
+        "batch_size": batch_size,
+    }
+
+
 def run_deepfm_bench(on_tpu):
     """BASELINE.md primary recsys target: DeepFM samples/sec (frappe
     schema; embedding + FM + DNN). MFU is not reported — the model is
@@ -802,6 +859,7 @@ def run_moe_bench(on_tpu):
 _BENCHES = {
     "transformer": run_transformer_bench,
     "resnet50": run_resnet50_bench,
+    "vit": run_vit_bench,
     "deepfm": run_deepfm_bench,
     "decode": run_decode_bench,
     "dlrm": run_dlrm_bench,

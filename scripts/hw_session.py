@@ -20,7 +20,7 @@ re-sweep:
                          elasticdl_tpu/ops/flash_tuning.json (the
                          repo-wide tuned default) when it beats 128/128
   3. flagship bench    — re-run under the (re-)tuned blocks
-  4./5. family benches — EDL_BENCH_MODEL=resnet50|deepfm|decode|dlrm|bert|moe
+  4./5. family benches — EDL_BENCH_MODEL=resnet50|vit|deepfm|decode|dlrm|bert|moe
                          (BASELINE.md targets + decode throughput +
                          the 1B-embedding DLRM stress config)
   5b. pipeline A/B     — gpipe vs interleaved on the virtual CPU mesh
@@ -89,7 +89,7 @@ def run(cmd, timeout, env_extra=None, tag="", base_env=None):
 def save(results, out_path):
     # coverage summary the probe loop's exit gate reads: how many
     # results landed on the chip vs how many the session could
-    # produce (prelim + flagship + 6 families + collectives +
+    # produce (prelim + flagship + 7 families + collectives +
     # AB_QUEUE; profile/pipeline never emit TPU JSON). Owning the
     # roster here keeps the loop's threshold from drifting when the
     # queue changes.
@@ -97,7 +97,7 @@ def save(results, out_path):
         1 for v in results.values()
         if isinstance(v, dict) and v.get("platform") not in (None, "cpu")
     )
-    results["tpu_target"] = 9 + len(AB_QUEUE)
+    results["tpu_target"] = 10 + len(AB_QUEUE)
     with open(out_path, "w") as f:
         json.dump(results, f, indent=1)
 
@@ -321,8 +321,8 @@ def main():
     def family_benches():
         # secondary BASELINE.md targets + decode throughput + the
         # 1B-embedding DLRM stress config
-        for model in ("resnet50", "deepfm", "decode", "dlrm", "bert",
-                      "moe"):
+        for model in ("resnet50", "vit", "deepfm", "decode", "dlrm",
+                      "bert", "moe"):
             step = runner([sys.executable, "bench.py"], timeout=1800,
                           env_extra={"EDL_BENCH_MODEL": model,
                                      "EDL_BENCH_PROBE_TIMEOUT": "150"},

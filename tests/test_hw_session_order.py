@@ -72,7 +72,8 @@ def test_family_benches_vs_sweep_order(monkeypatch, tmp_path,
         "attention_sweep")
     sweep = calls.index("attention_sweep")
     families = [calls.index("bench_%s" % m) for m in
-                ("resnet50", "deepfm", "decode", "dlrm", "bert", "moe")]
+                ("resnet50", "vit", "deepfm", "decode", "dlrm", "bert",
+                 "moe")]
     if tuned_exists:
         # tuned prelim already measured the headline: families beat
         # the re-sweep to the (short) window
@@ -98,7 +99,7 @@ def test_cpu_fallback_prelim_keeps_flagship_first(monkeypatch,
                                                   tmp_path):
     """A tuned session whose prelim fell back to CPU (tunnel wedged
     right after the probe) must NOT spend the next contact window on
-    six family benches before step-3's flagship re-try."""
+    seven family benches before step-3's flagship re-try."""
     calls = _run_session(monkeypatch, tmp_path, True,
                          prelim_platform="cpu")
     sweep = calls.index("attention_sweep")
