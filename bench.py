@@ -882,6 +882,8 @@ _SUITE = (
     ("decode_kv_int8", "decode",
      {"EDL_BENCH_EXTRA_PARAMS": "kv_cache_dtype='int8'"},
      {"kv_cache_dtype": "int8"}),
+    # tail entry: if the suite budget truncates, only this drops
+    ("vit", "vit", None, None),
 )
 
 
