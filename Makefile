@@ -9,7 +9,8 @@
 #   drills        — the slow + integration shard: multi-process SPMD
 #                   parity, elastic e2e (SIGKILL mid-job), gRPC
 #                   master/worker, re-formation, elasticity bench
-#   drill         — one real local training job + status validation
+#   drill         — one real local training job + status validation,
+#                   then the master SIGKILL/journal-recovery drill
 #   cluster-smoke — kind/minikube manifests smoke, env-gated
 #                   (EDL_CLUSTER_FULL=1 + a reachable cluster)
 
@@ -31,6 +32,7 @@ test-drills: native
 
 drill:
 	bash scripts/run_local_job_drill.sh
+	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_master_kill_drill.py
 
 ci-fast: test-fast
 

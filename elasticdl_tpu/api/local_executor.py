@@ -41,6 +41,8 @@ class LocalExecutor(object):
         checkpoint_dir_for_init=None,
         grad_accum_steps=1,
         trainable_pattern=None,
+        job_state_dir=None,
+        fault_injector=None,
     ):
         from elasticdl_tpu.common.platform_utils import (
             honor_jax_platforms_env,
@@ -69,6 +71,19 @@ class LocalExecutor(object):
         self._host_manager = attach_from_spec(self.trainer, model_spec)
         self.state = None
         self.losses = []
+        # same crash-recovery machinery as the distributed master: with
+        # a job_state_dir the in-process dispatcher journals task
+        # lifecycle, so a killed local run resumes from where it died
+        # instead of re-training completed ranges
+        self._job_state_dir = job_state_dir
+        # fault hooks (common/fault_injection.py): local_get_task /
+        # local_report rules let drill tests delay, drop, or SIGKILL the
+        # local run at the dispatch boundary
+        from elasticdl_tpu.common.fault_injection import FaultInjector
+
+        self._fault_injector = (
+            fault_injector or FaultInjector.from_env()
+        )
         self._checkpoint_dir_for_init = checkpoint_dir_for_init
         self._checkpoint_saver = None
         if checkpoint_dir and checkpoint_steps:
@@ -94,12 +109,19 @@ class LocalExecutor(object):
         def shards_of(data):
             return self._reader(data).create_shards() if data else {}
 
+        state_store = None
+        if self._job_state_dir:
+            from elasticdl_tpu.master.state_store import JobStateStore
+
+            state_store = JobStateStore(self._job_state_dir)
+
         return TaskDispatcher(
             shards_of(self.training_data),
             shards_of(self.validation_data),
             shards_of(self.prediction_data),
             self.records_per_task,
             self.num_epochs,
+            state_store=state_store,
         )
 
     def _task_dataset(self, reader, task, mode):
@@ -149,6 +171,8 @@ class LocalExecutor(object):
         )
         stop = False
         while not stop:
+            if self._fault_injector is not None:
+                self._fault_injector.intercept("local_get_task")
             task_id, task = dispatcher.get("local")
             if task is None:
                 break
@@ -173,6 +197,8 @@ class LocalExecutor(object):
                     dispatcher.stop_training = True
                     stop = True
                     break
+            if self._fault_injector is not None:
+                self._fault_injector.intercept("local_report")
             dispatcher.report(task_id, True)
         final_metrics = (
             self._evaluate_with_reader(eval_reader) if eval_reader else {}

@@ -22,6 +22,7 @@ MASTER_ONLY_ARGS = {
     "disable_relaunch", "task_timeout_check_interval", "cluster_spec",
     "image_pull_policy", "restart_policy", "volume", "need_tensorboard",
     "tensorboard_log_dir", "export_saved_model", "job_status_file",
+    "job_state_dir",
 }
 
 
@@ -185,6 +186,14 @@ def add_master_params(parser):
         help="Write the job phase (Pending/Running/Succeeded/Failed) to "
              "this JSON file — the local-master twin of the k8s master-"
              "pod status label, polled by scripts/validate_job_status.py",
+    )
+    parser.add_argument(
+        "--job_state_dir", default="",
+        help="Directory for the master's write-ahead journal + compacted "
+             "snapshot of dispatcher state (master/state_store.py). A "
+             "relaunched master pointed at the same directory restores "
+             "todo/doing/retry/epoch state exactly and resumes the job; "
+             "empty disables journaling (the reference behavior).",
     )
 
 

@@ -1,0 +1,254 @@
+"""Config/env-driven fault injection at the master's RPC boundary.
+
+Drill tests need to manufacture exactly the failures the fault-tolerance
+layer claims to survive — without patching internals. This module injects
+them at the servicer boundary (both the in-process servicer path unit
+tests use and the real gRPC server), and can SIGKILL the master process
+itself for crash-recovery drills in local mode.
+
+Spec grammar (EDL_FAULT_SPEC env var or the FaultInjector constructor),
+semicolon-separated rules:
+
+    <rpc>:<action>[:<count>[:<k>=<v>,...]]
+
+    rpc     RPC/hook name (get_task, report_task_result, worker_launch,
+            local_get_task, ...) or * for any
+    action  drop   reject BEFORE the handler runs (request lost)
+            error  run the handler, then reject (response lost — the
+                   duplicate-side-effect case, e.g. a task report that
+                   was applied but never acknowledged)
+            delay  sleep secs=... then proceed
+            kill   SIGKILL the current process (crash drill)
+    count   how many calls the rule fires on (default 1; * = forever)
+    kwargs  secs=<float> (delay), skip=<int> (let N calls through
+            first), code=<grpc status name> (default UNAVAILABLE)
+
+Examples:
+    get_task:drop:3                three lost get_task requests
+    report_task_result:error:1     one applied-but-unacked report
+    get_task:kill:1:skip=5         master dies on its 6th get_task
+    worker_launch:delay:*:secs=2   every worker launch takes +2 s
+"""
+
+import os
+import signal
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+FAULT_SPEC_ENV = "EDL_FAULT_SPEC"
+
+try:
+    import grpc as _grpc
+except Exception:  # pragma: no cover - grpc is in the image
+    _grpc = None
+
+
+if _grpc is not None:
+
+    class InjectedRpcError(_grpc.RpcError):
+        """Raised on the in-process servicer path; carries a status code
+        like a real transport error so common/retry.py classifies it
+        identically."""
+
+        def __init__(self, code, details):
+            super().__init__()
+            self._code = code
+            self._details = details
+
+        def code(self):
+            return self._code
+
+        def details(self):
+            return self._details
+
+        def __str__(self):
+            return "InjectedRpcError(%s, %r)" % (self._code, self._details)
+
+else:  # pragma: no cover
+
+    class InjectedRpcError(Exception):
+        def __init__(self, code, details):
+            super().__init__(details)
+            self._code = code
+
+        def code(self):
+            return self._code
+
+
+def _status_code(name):
+    if _grpc is None:  # pragma: no cover
+        return name
+    return getattr(_grpc.StatusCode, name, _grpc.StatusCode.UNAVAILABLE)
+
+
+class FaultRule(object):
+    def __init__(self, rpc, action, count=1, skip=0, secs=0.0,
+                 code="UNAVAILABLE"):
+        if action not in ("drop", "error", "delay", "kill"):
+            raise ValueError("unknown fault action %r" % action)
+        self.rpc = rpc
+        self.action = action
+        self.count = count  # None = forever
+        self.skip = skip
+        self.secs = secs
+        self.code = code
+        self._seen = 0
+        self._fired = 0
+
+    def matches(self, rpc_name):
+        return self.rpc in ("*", rpc_name)
+
+    def consume(self):
+        """One call against this rule; True if the fault fires."""
+        self._seen += 1
+        if self._seen <= self.skip:
+            return False
+        if self.count is not None and self._fired >= self.count:
+            return False
+        self._fired += 1
+        return True
+
+    @classmethod
+    def parse(cls, text):
+        parts = text.strip().split(":")
+        if len(parts) < 2:
+            raise ValueError("bad fault rule %r" % text)
+        rpc, action = parts[0], parts[1]
+        count = 1
+        kwargs = {}
+        if len(parts) > 2 and parts[2]:
+            count = None if parts[2] == "*" else int(parts[2])
+        if len(parts) > 3 and parts[3]:
+            for kv in parts[3].split(","):
+                k, _, v = kv.partition("=")
+                if k == "secs":
+                    kwargs["secs"] = float(v)
+                elif k == "skip":
+                    kwargs["skip"] = int(v)
+                elif k == "code":
+                    kwargs["code"] = v
+                else:
+                    raise ValueError("bad fault kwarg %r in %r" % (kv, text))
+        return cls(rpc, action, count=count, **kwargs)
+
+
+class FaultInjector(object):
+    """Holds the active rules; `intercept` is the single choke point.
+
+    Thread-safe: the gRPC thread pool calls intercept concurrently.
+    """
+
+    def __init__(self, spec="", rules=None, kill_fn=None):
+        self._lock = threading.Lock()
+        self.rules = list(rules or [])
+        if spec:
+            self.rules.extend(
+                FaultRule.parse(r) for r in spec.split(";") if r.strip()
+            )
+        self.injected = {}  # rpc_name -> fired-fault count
+        self._kill_fn = kill_fn or (
+            lambda: os.kill(os.getpid(), signal.SIGKILL)
+        )
+
+    @classmethod
+    def from_env(cls, env=None):
+        """Injector from EDL_FAULT_SPEC, or None when unset (the
+        zero-overhead production default)."""
+        spec = (env or os.environ).get(FAULT_SPEC_ENV, "")
+        return cls(spec=spec) if spec else None
+
+    def _fire(self, rpc_name, when):
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(rpc_name):
+                    continue
+                # drop rejects pre-handler, error rejects post-handler;
+                # delay/kill apply pre-handler
+                pre = rule.action in ("drop", "delay", "kill")
+                if (when == "before") != pre:
+                    continue
+                if rule.consume():
+                    self.injected[rpc_name] = (
+                        self.injected.get(rpc_name, 0) + 1
+                    )
+                    return rule
+        return None
+
+    def intercept(self, rpc_name, context=None, when="before"):
+        """Apply the first matching armed rule. Raises (or aborts the
+        gRPC context) for drop/error, sleeps for delay, SIGKILLs the
+        process for kill, no-ops when nothing matches."""
+        rule = self._fire(rpc_name, when)
+        if rule is None:
+            return
+        if rule.action == "delay":
+            logger.warning(
+                "[fault] delaying %s by %.2fs", rpc_name, rule.secs
+            )
+            time.sleep(rule.secs)
+            return
+        if rule.action == "kill":
+            logger.warning("[fault] SIGKILL self on %s", rpc_name)
+            self._kill_fn()
+            return
+        logger.warning(
+            "[fault] %s %s (%s)", rule.action, rpc_name, rule.code
+        )
+        code = _status_code(rule.code)
+        details = "injected fault: %s %s" % (rule.action, rpc_name)
+        if context is not None:
+            context.abort(code, details)
+        raise InjectedRpcError(code, details)
+
+
+# RPCs the servicer wrapper intercepts (mirrors proto/service.py's table).
+_SERVICER_RPCS = (
+    "get_task",
+    "report_task_result",
+    "report_evaluation_metrics",
+    "report_version",
+    "register_worker",
+)
+
+
+class FaultInjectingServicer(object):
+    """Transparent servicer wrapper: same RPC surface, with
+    injector.intercept applied before and after each handler. Non-RPC
+    attributes (get_model_version, watchdog helpers, ...) proxy through
+    so Master/EvaluationService wiring is unaffected."""
+
+    def __init__(self, servicer, injector):
+        self._servicer = servicer
+        self._injector = injector
+        for name in _SERVICER_RPCS:
+            setattr(self, name, self._wrap(name))
+
+    def _wrap(self, name):
+        handler = getattr(self._servicer, name)
+
+        def rpc(request, _context=None):
+            self._injector.intercept(name, context=_context, when="before")
+            response = handler(request, _context)
+            self._injector.intercept(name, context=_context, when="after")
+            return response
+
+        rpc.__name__ = name
+        return rpc
+
+    def __getattr__(self, name):
+        return getattr(self._servicer, name)
+
+
+def maybe_wrap_servicer(servicer, injector=None):
+    """Wrap when an injector is active (explicit or via EDL_FAULT_SPEC);
+    otherwise return the servicer untouched."""
+    injector = injector or FaultInjector.from_env()
+    if injector is None or not injector.rules:
+        return servicer
+    logger.warning(
+        "Fault injection ACTIVE on the master servicer: %s",
+        [(r.rpc, r.action, r.count) for r in injector.rules],
+    )
+    return FaultInjectingServicer(servicer, injector)

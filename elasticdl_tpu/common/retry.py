@@ -1,0 +1,114 @@
+"""Bounded-retry layer for worker->master control-plane RPCs.
+
+The reference had no master-outage story at all: worker/worker.py treated
+any UNAVAILABLE/CANCELLED from an ever-connected master as "end of job"
+and exited silently mid-epoch. This module replaces that heuristic with
+explicit policy: per-RPC deadlines, exponential backoff with full jitter
+(AWS-style: sleep = uniform(0, min(cap, base * 2**attempt))), and a
+bounded reconnect window after which the caller gets the real error back
+(so a genuinely dead master fails the worker loudly instead of hanging it
+forever).
+
+Transport-agnostic: `retry_call` retries any callable whose failures
+satisfy `is_retryable`; gRPC specifics (which status codes are transient)
+live in `is_transient_rpc_error` so the in-process servicer path and unit
+tests can inject plain exceptions.
+"""
+
+import random
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class RetryPolicy(object):
+    """Backoff/deadline knobs for one class of RPCs.
+
+    reconnect_window_secs bounds the TOTAL time spent retrying one logical
+    call: a master restart (pod reschedule + journal replay) fits well
+    inside the default 120 s; anything longer is treated as a real outage.
+    """
+
+    def __init__(
+        self,
+        rpc_timeout_secs=30.0,
+        base_delay_secs=0.1,
+        max_delay_secs=5.0,
+        reconnect_window_secs=120.0,
+    ):
+        self.rpc_timeout_secs = rpc_timeout_secs
+        self.base_delay_secs = base_delay_secs
+        self.max_delay_secs = max_delay_secs
+        self.reconnect_window_secs = reconnect_window_secs
+
+    def backoff(self, attempt):
+        """Full-jitter exponential backoff delay for `attempt` (0-based)."""
+        cap = min(
+            self.max_delay_secs, self.base_delay_secs * (2 ** attempt)
+        )
+        return random.uniform(0, cap)
+
+
+def is_transient_rpc_error(exc):
+    """True for gRPC statuses a master restart produces: the server socket
+    is gone (UNAVAILABLE), in-flight calls were torn down (CANCELLED), or
+    a call outlived its deadline while the master replayed its journal
+    (DEADLINE_EXCEEDED)."""
+    try:
+        import grpc
+
+        return isinstance(exc, grpc.RpcError) and exc.code() in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.CANCELLED,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    except Exception:
+        return False
+
+
+def retry_call(
+    fn,
+    policy=None,
+    is_retryable=is_transient_rpc_error,
+    on_retry=None,
+    sleep=time.sleep,
+    clock=time.monotonic,
+    what="rpc",
+):
+    """Call `fn()` with bounded retries.
+
+    Retries only failures `is_retryable` accepts, sleeping
+    `policy.backoff(attempt)` between attempts, until
+    `policy.reconnect_window_secs` has elapsed — then the last error
+    propagates. `on_retry(attempt, exc)` fires before each sleep (the
+    worker uses it to count rpc_retries and trigger re-registration).
+    Returns (result, attempts_used)."""
+    policy = policy or RetryPolicy()
+    deadline = clock() + policy.reconnect_window_secs
+    attempt = 0
+    while True:
+        try:
+            return fn(), attempt
+        except Exception as e:
+            if not is_retryable(e):
+                raise
+            now = clock()
+            if now >= deadline:
+                logger.error(
+                    "%s still failing after %d retries over %.0fs "
+                    "reconnect window; giving up",
+                    what, attempt, policy.reconnect_window_secs,
+                )
+                raise
+            delay = policy.backoff(attempt)
+            # never sleep past the window: the last attempt should land
+            # just inside it, not arbitrarily later
+            delay = min(delay, max(0.0, deadline - now))
+            if on_retry is not None:
+                on_retry(attempt, e)
+            logger.warning(
+                "%s failed (attempt %d, transient): retrying in %.2fs",
+                what, attempt + 1, delay,
+            )
+            sleep(delay)
+            attempt += 1

@@ -69,7 +69,10 @@ class InstanceManagerBase(object):
         num_workers,
         relaunch_on_worker_failure=3,
         disable_relaunch=False,
+        fault_injector=None,
     ):
+        from elasticdl_tpu.common.fault_injection import FaultInjector
+
         self._task_d = task_d
         self._num_workers = num_workers
         self._max_relaunch = (
@@ -79,6 +82,12 @@ class InstanceManagerBase(object):
         self._workers = {}  # worker_id -> _WorkerRecord
         self._next_worker_id = 0
         self._stopping = False
+        # chaos hooks for drill tests: EDL_FAULT_SPEC rules named
+        # worker_launch / worker_exit fire here (delay a relaunch, kill
+        # the master mid-launch, ...)
+        self._fault_injector = (
+            fault_injector or FaultInjector.from_env()
+        )
 
     # backend hooks ------------------------------------------------------
 
@@ -104,6 +113,8 @@ class InstanceManagerBase(object):
         logger.info(
             "Starting worker %d (slot %d)", worker_id, original_index
         )
+        if self._fault_injector is not None:
+            self._fault_injector.intercept("worker_launch")
         self._launch(worker_id, original_index)
         return worker_id
 
@@ -139,6 +150,8 @@ class InstanceManagerBase(object):
         deleted=False,
     ):
         """One dead worker: recover its tasks, decide on relaunch."""
+        if self._fault_injector is not None:
+            self._fault_injector.intercept("worker_exit")
         with self._lock:
             record = self._workers.get(worker_id)
             if self._stopping or record is None or record.phase in (

@@ -208,7 +208,14 @@ def _run_master(args, status_file=""):
         export_saved_model=args.export_saved_model,
         tensorboard_service=tensorboard_service,
         checkpoint_dir_for_init=args.checkpoint_dir_for_init,
+        job_state_dir=args.job_state_dir or None,
     )
+    if master.state_store and master.state_store.is_job_complete():
+        # a relaunched master over a finished job: report success and
+        # exit instead of re-serving an empty dispatcher
+        logger.info("Job already complete per %s; nothing to do",
+                    args.job_state_dir)
+        return 0
     # gRPC port is bound in prepare(); the instance manager needs the
     # final address, so wire it afterwards.
     master.prepare()

@@ -45,6 +45,9 @@ class Master(object):
         export_saved_model=False,
         tensorboard_service=None,
         checkpoint_dir_for_init=None,
+        job_state_dir=None,
+        fault_injector=None,
+        shutdown_linger_secs=2.0,
     ):
         from elasticdl_tpu.data.reader.data_reader_factory import (
             create_data_reader,
@@ -59,6 +62,20 @@ class Master(object):
                 return {}
             return create_fn(data, records_per_task).create_shards()
 
+        # crash recovery: with --job_state_dir the dispatcher journals
+        # every task transition and a relaunched master restores
+        # todo ∪ requeued-doing exactly (master/state_store.py)
+        self.state_store = None
+        if job_state_dir:
+            from elasticdl_tpu.master.state_store import JobStateStore
+
+            self.state_store = JobStateStore(job_state_dir)
+            if self.state_store.has_state():
+                logger.info(
+                    "Recovering master state from %s (restart #%d)",
+                    job_state_dir, self.state_store.restart_count,
+                )
+
         self.task_d = TaskDispatcher(
             shards_of(training_data),
             shards_of(validation_data),
@@ -66,7 +83,10 @@ class Master(object):
             records_per_task,
             num_epochs,
             callbacks_list=callbacks_list,
+            state_store=self.state_store,
         )
+        self._fault_injector = fault_injector
+        self._shutdown_linger_secs = shutdown_linger_secs
         if export_saved_model and training_data:
             self.task_d.add_deferred_callback_create_train_end_task()
         # wire master-side callbacks that act on the dispatcher
@@ -111,11 +131,18 @@ class Master(object):
             )
             self.task_d.set_evaluation_service(self.evaluation_service)
 
-        self.servicer = MasterServicer(
-            minibatch_size,
-            self.task_d,
-            evaluation_service=self.evaluation_service,
-            tensorboard_service=tensorboard_service,
+        from elasticdl_tpu.common.fault_injection import (
+            maybe_wrap_servicer,
+        )
+
+        self.servicer = maybe_wrap_servicer(
+            MasterServicer(
+                minibatch_size,
+                self.task_d,
+                evaluation_service=self.evaluation_service,
+                tensorboard_service=tensorboard_service,
+            ),
+            injector=fault_injector,
         )
         self.instance_manager = instance_manager
         self._port = port
@@ -142,6 +169,23 @@ class Master(object):
         if self.instance_manager:
             self.instance_manager.start_workers()
         self._start_watchdog()
+        self._write_recovery_gauges()
+
+    def _write_recovery_gauges(self):
+        """Export the crash-recovery counters through the existing
+        TensorBoard gauge path: master/restarts and the tasks requeued
+        from the pre-crash doing set."""
+        if not (self.tensorboard_service and self.state_store):
+            return
+        restarts = self.state_store.restart_count
+        self.tensorboard_service.write_dict_to_summary(
+            {
+                "master/restarts": restarts,
+                "master/recovery_requeued_tasks":
+                    self.task_d.requeued_on_recovery,
+            },
+            version=restarts,
+        )
 
     def run(self, poll_interval=1.0):
         """Block until all tasks finish (reference Master.run,
@@ -160,6 +204,15 @@ class Master(object):
                     if not self.task_d.invoke_deferred_callback():
                         break
                 time.sleep(poll_interval)
+            if self.state_store:
+                # durable completion marker: a relaunched master (or the
+                # drill supervisor) must not redo a finished job
+                self.state_store.mark_job_complete()
+            # linger so polling workers observe the explicit JOB_COMPLETE
+            # NONE task instead of racing the server teardown into their
+            # reconnect-retry path
+            if self._shutdown_linger_secs:
+                time.sleep(self._shutdown_linger_secs)
         finally:
             self.stop()
         return 0
@@ -176,6 +229,8 @@ class Master(object):
         if self._server:
             self._server.stop(grace=1.0)
             self._server = None
+        if self.state_store:
+            self.state_store.close()
 
     # ------------------------------------------------------------ watchdog
 

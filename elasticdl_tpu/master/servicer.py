@@ -22,7 +22,9 @@ class MasterServicer(object):
         self._task_d = task_d
         self._lock = threading.Lock()
         self._minibatch_size = minibatch_size
-        self._version = 0
+        # a restored dispatcher carries the pre-crash model version, so
+        # step-based eval triggers don't re-fire for old versions
+        self._version = getattr(task_d, "model_version", 0) or 0
         self._evaluation_service = evaluation_service
         self._tensorboard_service = tensorboard_service
         self._task_complete_times = {
@@ -72,6 +74,11 @@ class MasterServicer(object):
             self._task_d.invoke_deferred_callback()
         ):
             res.type = pb.WAIT
+        else:
+            # the EXPLICIT end-of-job signal: workers may only exit on
+            # this, never on a transport error (a transient master
+            # outage is indistinguishable from shutdown on the wire)
+            res.reason = pb.JOB_COMPLETE
         with self._lock:
             self._worker_liveness_time[request.worker_id] = time.time()
         return res
@@ -103,14 +110,15 @@ class MasterServicer(object):
     def _write_tier_gauges(self, exec_counters, worker_id):
         """Workers piggyback cumulative tier-health counters (host-tier
         dropped row updates / failed cycles) on task reports as tier/
-        keys; write them through the TensorBoard service as gauges at
-        a per-worker report index (reference analogue: the PS exposed
-        parameters.debug_info — here the degradation signal rides the
-        existing report RPC instead of a debug endpoint). Tags are
-        per-worker (the counters are per-trainer cumulatives, so
-        different workers' values must not interleave on one scalar);
-        the dispatcher supplies the reporting worker's id. A report
-        whose task is unknown (late duplicate from a requeued
+        keys, and RPC-resilience counters (rpc_retries, reconnects) as
+        fault/ keys; write them through the TensorBoard service as
+        gauges at a per-worker report index (reference analogue: the PS
+        exposed parameters.debug_info — here the degradation signal
+        rides the existing report RPC instead of a debug endpoint).
+        Tags are per-worker (the counters are per-trainer cumulatives,
+        so different workers' values must not interleave on one
+        scalar); the dispatcher supplies the reporting worker's id. A
+        report whose task is unknown (late duplicate from a requeued
         straggler) has no worker identity — dropped, since writing it
         to a bare tag would recreate the interleaving."""
         if not self._tensorboard_service or worker_id < 0:
@@ -118,7 +126,7 @@ class MasterServicer(object):
         suffix = "/worker-%d" % worker_id
         gauges = {
             k + suffix: v for k, v in exec_counters.items()
-            if k.startswith("tier/")
+            if k.startswith(("tier/", "fault/"))
         }
         if gauges:
             # distinct step per report (see _tier_gauge_steps): every
@@ -141,6 +149,8 @@ class MasterServicer(object):
 
     def report_version(self, request, _context=None):
         self._version = max(self._version, request.model_version)
+        if hasattr(self._task_d, "record_model_version"):
+            self._task_d.record_model_version(request.model_version)
         if self._evaluation_service:
             self._evaluation_service.add_evaluation_task_if_needed(
                 model_version=request.model_version

@@ -1,0 +1,171 @@
+"""Write-ahead journal + compacted snapshot for the master's dispatcher
+state.
+
+The reference keeps todo/doing queues, epoch counters, and retry counts
+purely in memory (master/task_dispatcher.py) — a master crash loses the
+job's progress accounting even though every worker is still healthy.
+This store makes the dispatcher's task-lifecycle state durable:
+
+* every transition (tasks created, dispatched, done, failed, epoch
+  rollover, retry count bump, model version) is appended to
+  ``journal.jsonl`` in ``--job_state_dir`` BEFORE the in-memory state
+  changes are observable (write-ahead),
+* a compacted ``snapshot.json`` is written atomically (tmp + rename)
+  every ``snapshot_every`` journal appends and the journal truncated,
+  bounding replay time,
+* a ``JOB_COMPLETE`` marker records that the job finished, so a
+  relaunched master (or supervisor) does not redo a completed job,
+* a ``restarts`` file counts recoveries — exported as the
+  master_restarts gauge.
+
+Crash model: SIGKILL of the master PROCESS (pod eviction, OOM-kill,
+drills). Appends are flushed to the OS on every write, which survives
+process death; pass fsync=True to also survive host power loss.
+
+The journal line format is owned by TaskDispatcher (snapshot()/
+restore()); this module only handles durability, atomicity, and replay
+tolerance (a torn final line from a crash mid-append is skipped).
+"""
+
+import json
+import os
+import tempfile
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+JOURNAL_FILE = "journal.jsonl"
+SNAPSHOT_FILE = "snapshot.json"
+COMPLETE_MARKER = "JOB_COMPLETE"
+RESTARTS_FILE = "restarts"
+
+
+class JobStateStore(object):
+    def __init__(self, job_state_dir, snapshot_every=200, fsync=False):
+        self._dir = job_state_dir
+        self.snapshot_every = max(1, int(
+            os.environ.get("EDL_STATE_SNAPSHOT_EVERY", snapshot_every)
+        ))
+        self._fsync = fsync
+        os.makedirs(job_state_dir, exist_ok=True)
+        self._journal_path = os.path.join(job_state_dir, JOURNAL_FILE)
+        self._snapshot_path = os.path.join(job_state_dir, SNAPSHOT_FILE)
+        self._had_state = (
+            os.path.exists(self._journal_path)
+            or os.path.exists(self._snapshot_path)
+        )
+        self._journal = None
+        self._appends_since_snapshot = 0
+        self.journal_appends = 0
+        self.compactions = 0
+        if self._had_state:
+            self._bump_restarts()
+
+    # ------------------------------------------------------------ loading
+
+    def has_state(self):
+        return self._had_state
+
+    def load(self):
+        """(snapshot dict or None, [journal events]). Tolerates a torn
+        final journal line — the one write a SIGKILL can interrupt."""
+        snapshot = None
+        if os.path.exists(self._snapshot_path):
+            with open(self._snapshot_path) as f:
+                snapshot = json.load(f)
+        events = []
+        if os.path.exists(self._journal_path):
+            with open(self._journal_path) as f:
+                lines = f.readlines()
+            for i, line in enumerate(lines):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    if i == len(lines) - 1:
+                        logger.warning(
+                            "Dropping torn final journal line (%d bytes)",
+                            len(line),
+                        )
+                    else:
+                        raise
+        return snapshot, events
+
+    # ------------------------------------------------------------ writing
+
+    def _open_journal(self):
+        if self._journal is None:
+            self._journal = open(self._journal_path, "a")
+        return self._journal
+
+    def append(self, event):
+        """Write-ahead one lifecycle event. Returns True when the caller
+        should compact (hand back a snapshot via write_snapshot)."""
+        f = self._open_journal()
+        f.write(json.dumps(event, separators=(",", ":")) + "\n")
+        f.flush()
+        if self._fsync:
+            os.fsync(f.fileno())
+        self.journal_appends += 1
+        self._appends_since_snapshot += 1
+        return self._appends_since_snapshot >= self.snapshot_every
+
+    def write_snapshot(self, state):
+        """Atomically persist the full state and truncate the journal —
+        snapshot first, truncate after, so a crash between the two
+        replays the journal against the NEW snapshot (events are
+        idempotent under replay: dispatch of an absent task and done of
+        an unknown id are no-ops)."""
+        fd, tmp = tempfile.mkstemp(
+            dir=self._dir, prefix=".snapshot."
+        )
+        with os.fdopen(fd, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self._snapshot_path)
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+        open(self._journal_path, "w").close()
+        self._appends_since_snapshot = 0
+        self.compactions += 1
+
+    def close(self):
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    # ------------------------------------------------- completion marker
+
+    def mark_job_complete(self):
+        path = os.path.join(self._dir, COMPLETE_MARKER)
+        with open(path, "w") as f:
+            f.write("complete\n")
+
+    def is_job_complete(self):
+        return os.path.exists(os.path.join(self._dir, COMPLETE_MARKER))
+
+    # ------------------------------------------------- restart counting
+
+    def _bump_restarts(self):
+        path = os.path.join(self._dir, RESTARTS_FILE)
+        try:
+            with open(path) as f:
+                n = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            n = 0
+        with open(path, "w") as f:
+            f.write("%d\n" % (n + 1))
+
+    @property
+    def restart_count(self):
+        """How many times a master has come up over existing state."""
+        path = os.path.join(self._dir, RESTARTS_FILE)
+        try:
+            with open(path) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0

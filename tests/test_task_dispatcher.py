@@ -128,3 +128,72 @@ def test_prediction_tasks():
         d.report(tid, True)
     assert types == {TaskType.PREDICTION}
     assert d.finished()
+
+
+def test_retry_count_evicted_on_success():
+    """A task that failed (but not fatally) and then succeeds must drop
+    its retry-count entry — otherwise later same-range failures (e.g.
+    the next epoch) inherit stale strikes toward the poison cap."""
+    d = make_dispatcher(train={"f": (0, 5)}, records_per_task=5)
+    tid, task = d.get("w0")
+    d.report(tid, False)
+    assert d._task_retry_count  # one strike recorded
+    tid, task = d.get("w0")
+    d.report(tid, True)
+    assert not d._task_retry_count  # evicted on success
+    assert d.finished()
+
+
+def test_max_retries_cap_permanently_fails_poisoned_task():
+    """A poisoned task is dropped after MAX_TASK_RETRIES total attempts,
+    never redispatched, and its bookkeeping entry is cleaned up."""
+    from elasticdl_tpu.common.constants import MAX_TASK_RETRIES
+
+    d = make_dispatcher(train={"f": (0, 5)}, records_per_task=5)
+    attempts = 0
+    while True:
+        tid, task = d.get("w0")
+        if task is None:
+            break
+        attempts += 1
+        d.report(tid, False)
+    assert attempts == MAX_TASK_RETRIES
+    assert d.finished()
+    assert not d._task_retry_count  # no leak for the dead task
+    # permanently failed: nothing left to dispatch
+    tid, task = d.get("w0")
+    assert task is None
+
+
+def test_epoch_rollover_under_concurrent_requeue_preserves_coverage():
+    """w0 holds an epoch-0 task across the rollover into epoch 1, then
+    fails it; the requeued copy must land in the mixed todo queue and
+    total successful completions must cover every range exactly
+    num_epochs times."""
+    from collections import Counter
+
+    d = make_dispatcher(train={"f": (0, 40)}, records_per_task=10,
+                        num_epochs=2)
+    held_tid, held_task = d.get("w0")  # epoch-0 task, stays in flight
+    completed = Counter()
+
+    def drain(worker, limit):
+        for _ in range(limit):
+            tid, task = d.get(worker)
+            if task is None:
+                return
+            d.report(tid, True)
+            completed[(task.start, task.end)] += 1
+
+    drain("w1", 3)  # rest of epoch 0
+    # next get rolls into epoch 1 while held_task is still doing
+    tid, task = d.get("w1")
+    assert d.epoch == 1
+    d.report(tid, True)
+    completed[(task.start, task.end)] += 1
+    # the held epoch-0 task fails AFTER the rollover: must requeue
+    d.report(held_tid, False)
+    drain("w1", 100)
+    assert d.finished()
+    expected = {(s, s + 10): 2 for s in range(0, 40, 10)}
+    assert dict(completed) == expected

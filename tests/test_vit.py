@@ -105,3 +105,18 @@ def test_vit_e2e_local_executor(tmp_path):
     )
     _, metrics = executor.run()
     assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_invalid_geometry_raises():
+    """Config validation fails fast: indivisible patch grid AND an
+    embed_dim that doesn't split across heads (which would otherwise
+    silently floor head_dim and shrink attention width)."""
+    img = {"image": np.zeros((1, 8, 8, 3), np.float32)}
+    bad_patch = vit.ViT(image_size=8, patch_size=3, embed_dim=16,
+                        num_heads=2, num_layers=1, dropout=0.0)
+    with pytest.raises(ValueError, match="patch_size"):
+        bad_patch.init(jax.random.PRNGKey(0), img)
+    bad_heads = vit.ViT(image_size=8, patch_size=4, embed_dim=15,
+                        num_heads=4, num_layers=1, dropout=0.0)
+    with pytest.raises(ValueError, match="num_heads"):
+        bad_heads.init(jax.random.PRNGKey(0), img)
