@@ -10,14 +10,18 @@
 #                   parity, elastic e2e (SIGKILL mid-job), gRPC
 #                   master/worker, re-formation, elasticity bench
 #   drill         — one real local training job + status validation,
-#                   then the master SIGKILL/journal-recovery drill
+#                   then the master SIGKILL/journal-recovery drill and
+#                   the serving SIGTERM/SIGKILL drill
+#   serve-smoke   — closed-loop load vs the generation server; emits
+#                   the BENCH_SERVING.json serving-throughput record
 #   cluster-smoke — kind/minikube manifests smoke, env-gated
 #                   (EDL_CLUSTER_FULL=1 + a reachable cluster)
 
 PY ?= python
 MESH_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: native test-fast test-drills drill ci ci-fast cluster-smoke clean
+.PHONY: native test-fast test-drills drill serve-smoke ci ci-fast \
+	cluster-smoke clean
 
 native:
 	$(MAKE) -C elasticdl_tpu/native
@@ -33,6 +37,13 @@ test-drills: native
 drill:
 	bash scripts/run_local_job_drill.sh
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_master_kill_drill.py
+	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_server_kill_drill.py
+
+# Serving smoke: closed-loop load against the real continuous-batching
+# server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput)
+serve-smoke:
+	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py \
+		--requests 16 --rate 32 --out BENCH_SERVING.json
 
 ci-fast: test-fast
 

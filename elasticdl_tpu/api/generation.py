@@ -111,6 +111,32 @@ def _next_token(step_logits, rng, position, temperature, top_k=0,
     return nxt.astype(jnp.int32)
 
 
+def serving_next_token(step_logits, seed, position, temperature,
+                       top_k=0, top_p=1.0):
+    """`_next_token` for the online serving scheduler: `temperature` and
+    `seed` ride as TRACED per-slot values (one compiled decode step
+    serves every sampling config in the batch), with `top_k`/`top_p`
+    static server-level knobs. Token-parity contract with the offline
+    sampler, which the serving tests lock: for any fixed temperature,
+    the selected token equals `_next_token(step_logits,
+    PRNGKey(seed), position, temperature, top_k, top_p)` — greedy is
+    the same argmax, and sampling applies the same scale -> filter ->
+    fold_in(rng, position) -> categorical pipeline. A request's tokens
+    therefore never depend on what else shares the serving batch.
+
+    step_logits: [V] (one slot's logits). Returns a scalar int32."""
+    greedy = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+    # the guard keeps the division finite when temperature == 0 (the
+    # sampled branch is discarded by the select in that case)
+    safe_t = jnp.maximum(temperature, 1e-6)
+    scaled = _filter_logits(step_logits / safe_t, top_k, top_p)
+    sub = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+    sampled = jax.random.categorical(sub, scaled, axis=-1).astype(
+        jnp.int32
+    )
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
 def autoregressive_generate(trainer, state, prompt, max_new_tokens,
                             temperature=0.0, seed=0, use_cache=False,
                             top_k=0, top_p=1.0):

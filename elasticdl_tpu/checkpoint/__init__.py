@@ -4,4 +4,5 @@ from elasticdl_tpu.checkpoint.saver import (  # noqa: F401
     get_latest_checkpoint_version,
     load_checkpoint,
     restore_state_from_checkpoint,
+    restore_state_from_flat,
 )

@@ -212,17 +212,28 @@ _SERVICER_RPCS = (
     "register_worker",
 )
 
+# The serving front-end's RPC surface (proto/service.py Serving table);
+# the generation server wraps its servicer with these names so overload
+# and kill drills target the same choke point the master drills use.
+SERVING_RPCS = (
+    "generate",
+    "generate_stream",
+    "server_status",
+)
+
 
 class FaultInjectingServicer(object):
     """Transparent servicer wrapper: same RPC surface, with
     injector.intercept applied before and after each handler. Non-RPC
     attributes (get_model_version, watchdog helpers, ...) proxy through
-    so Master/EvaluationService wiring is unaffected."""
+    so Master/EvaluationService wiring is unaffected. `rpcs` selects the
+    intercepted surface (default: the Master table; the serving server
+    passes SERVING_RPCS)."""
 
-    def __init__(self, servicer, injector):
+    def __init__(self, servicer, injector, rpcs=_SERVICER_RPCS):
         self._servicer = servicer
         self._injector = injector
-        for name in _SERVICER_RPCS:
+        for name in rpcs:
             setattr(self, name, self._wrap(name))
 
     def _wrap(self, name):
@@ -241,14 +252,15 @@ class FaultInjectingServicer(object):
         return getattr(self._servicer, name)
 
 
-def maybe_wrap_servicer(servicer, injector=None):
+def maybe_wrap_servicer(servicer, injector=None, rpcs=_SERVICER_RPCS):
     """Wrap when an injector is active (explicit or via EDL_FAULT_SPEC);
     otherwise return the servicer untouched."""
     injector = injector or FaultInjector.from_env()
     if injector is None or not injector.rules:
         return servicer
     logger.warning(
-        "Fault injection ACTIVE on the master servicer: %s",
+        "Fault injection ACTIVE on servicer %s: %s",
+        type(servicer).__name__,
         [(r.rpc, r.action, r.count) for r in injector.rules],
     )
-    return FaultInjectingServicer(servicer, injector)
+    return FaultInjectingServicer(servicer, injector, rpcs=rpcs)

@@ -13,6 +13,7 @@ from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 SERVICE_NAME = "elasticdl_tpu.Master"
+SERVING_SERVICE_NAME = "elasticdl_tpu.Serving"
 
 # method name -> (request class, response class)
 _METHODS = {
@@ -26,6 +27,17 @@ _METHODS = {
     "register_worker": (
         pb.RegisterWorkerRequest,
         pb.RegisterWorkerResponse,
+    ),
+}
+
+# method name -> (request class, response class, server-streaming?)
+_SERVING_METHODS = {
+    "generate": (pb.GenerateRequest, pb.GenerateResponse, False),
+    "generate_stream": (pb.GenerateRequest, pb.TokenChunk, True),
+    "server_status": (
+        pb.ServerStatusRequest,
+        pb.ServerStatusResponse,
+        False,
     ),
 }
 
@@ -43,6 +55,28 @@ def add_master_servicer_to_server(servicer, server):
     )
 
 
+def add_serving_servicer_to_server(servicer, server):
+    handlers = {}
+    for name, (req_cls, resp_cls, streaming) in _SERVING_METHODS.items():
+        make = (
+            grpc.unary_stream_rpc_method_handler
+            if streaming
+            else grpc.unary_unary_rpc_method_handler
+        )
+        handlers[name] = make(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                SERVING_SERVICE_NAME, handlers
+            ),
+        )
+    )
+
+
 class MasterStub(object):
     def __init__(self, channel):
         for name, (req_cls, resp_cls) in _METHODS.items():
@@ -51,6 +85,23 @@ class MasterStub(object):
                 name,
                 channel.unary_unary(
                     "/%s/%s" % (SERVICE_NAME, name),
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+class ServingStub(object):
+    def __init__(self, channel):
+        for name, (req_cls, resp_cls, streaming) in (
+            _SERVING_METHODS.items()
+        ):
+            make = channel.unary_stream if streaming else channel.unary_unary
+            setattr(
+                self,
+                name,
+                make(
+                    "/%s/%s" % (SERVING_SERVICE_NAME, name),
                     request_serializer=req_cls.SerializeToString,
                     response_deserializer=resp_cls.FromString,
                 ),

@@ -1,0 +1,30 @@
+"""Online serving subsystem: continuous-batching generation server.
+
+The training half of the north star is elastic training (master-owned
+task queue, workers pull work); this package is the first subsystem on
+the inference half — it turns the offline decode library
+(api/generation.py) into a standing server:
+
+* admission.py   bounded request queue with backpressure + deadlines
+* engine.py      continuous-batching decode scheduler over a fixed
+                 pool of KV-cache slots (one jit step, no recompiles
+                 on membership change)
+* server.py      gRPC front-end (Generate / GenerateStream /
+                 ServerStatus) + the scheduler thread
+* hot_reload.py  checkpoint-dir watcher that swaps params between
+                 decode steps without dropping in-flight requests
+* telemetry.py   serving gauges on the common/tb_events.py path
+
+See docs/designs/serving.md for the slot lifecycle and failure modes.
+"""
+
+from elasticdl_tpu.serving.admission import (  # noqa: F401
+    AdmissionError,
+    RequestQueue,
+    ServingRequest,
+)
+from elasticdl_tpu.serving.engine import ContinuousBatchingEngine  # noqa: F401
+from elasticdl_tpu.serving.server import (  # noqa: F401
+    GenerationServer,
+    ServingConfig,
+)

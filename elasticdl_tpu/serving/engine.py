@@ -1,0 +1,293 @@
+"""Continuous-batching decode engine over a fixed pool of KV slots.
+
+The offline decoder (api/generation.py) compiles one program per
+(batch, lengths, sampling) combination and runs each request cohort to
+completion — fine for batch PREDICTION, fatal for serving, where
+requests arrive continuously with mixed lengths and a static cohort
+leaves the pool idle while the longest member finishes. This engine
+instead runs ONE jit-compiled single-token decode step over a fixed
+pool of `num_slots` batch slots, every step, forever:
+
+* each slot owns a batch-1 KV-cache tree (the same per-layer caches the
+  model's decode mode builds — including its scalar position counter),
+  stacked leaf-wise into a pool with leading axis [S, ...];
+* the step `jax.vmap`s the model's decode over the slot axis, so every
+  slot advances at its OWN position — the per-slot cache counter drives
+  each layer's cache write, RoPE rotation and position-embedding lookup
+  exactly as in offline decode;
+* prompt insertion = one batched prefill (the offline `_run_prefill`,
+  bucketed to 64 like offline decode) + a `lax.dynamic_update_slice`
+  of the slot's cache rows at a TRACED slot index — membership changes
+  never recompile anything;
+* finished/expired slots are simply marked free host-side; their stale
+  cache rows are dead weight until the next insertion overwrites them
+  (free slots still ride through the vmapped step as masked work — the
+  static-shape price of zero recompiles).
+
+Token parity: a request's output depends only on (params, prompt, seed,
+temperature) — never on what else shares the pool. Greedy and sampled
+tokens equal the offline `autoregressive_generate(use_cache=True)` on a
+batch of one with the same knobs (serving_next_token's contract), which
+the serving tests lock against the offline path.
+
+Single-threaded by design: only the scheduler thread may call
+insert/step/set_params (jax computations stay serialized; the gRPC
+threads touch only the admission queue and event plumbing).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.api.generation import (
+    _kv_shapes_for,
+    _maybe_dequantize,
+    _prefill_bucket,
+    _require_kv_convention,
+    _run_prefill,
+    serving_next_token,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class _Slot(object):
+    __slots__ = ("request", "max_total")
+
+    def __init__(self, request, max_total):
+        self.request = request
+        self.max_total = max_total
+
+
+class ContinuousBatchingEngine(object):
+    """The decode pool. `top_k`/`top_p` are server-level static sampling
+    filters (part of the compiled step); temperature and seed ride per
+    request as traced values."""
+
+    def __init__(self, trainer, state, num_slots, top_k=0, top_p=1.0):
+        model = trainer.model
+        _require_kv_convention(model)
+        if not getattr(model, "causal", True):
+            raise ValueError("serving needs a causal sequence model")
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1], got %r" % (top_p,))
+        self.trainer = trainer
+        self.model = model
+        self.num_slots = int(num_slots)
+        self.seq_len = int(model.seq_len)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+
+        from elasticdl_tpu.api.quantization import is_quantized
+
+        self._qz = is_quantized(state.params)
+        self.set_params(state, version=getattr(state, "version", 0))
+
+        # batch-1 cache template -> pooled leaves [S, ...]; shares the
+        # trainer's compile cache so offline callers reuse the shapes
+        from elasticdl_tpu.api.generation import _decode_cache
+
+        self._kv_shapes = _kv_shapes_for(_decode_cache(trainer), model, 1)
+        self._pool = jax.tree.map(
+            lambda sh: jnp.zeros((self.num_slots,) + sh.shape, sh.dtype),
+            self._kv_shapes,
+        )
+        self._slots = [None] * self.num_slots  # _Slot or None
+        self._last_tokens = np.zeros(self.num_slots, np.int32)
+        self._seeds = np.zeros(self.num_slots, np.int32)
+        self._temps = np.zeros(self.num_slots, np.float32)
+        self._prefill_fns = {}  # bucket -> compiled prefill
+        self._step_fn = None
+        self._write_fn = None
+
+    # ------------------------------------------------------------ params
+
+    def set_params(self, state, version):
+        """Swap the serving params (hot reload). Runs BETWEEN decode
+        steps (scheduler thread), so in-flight sequences simply continue
+        on the new weights — their KV caches, positions and pending
+        tokens are untouched. Shapes/dtypes must match the compiled
+        executables; a changed architecture needs a new server."""
+        self.variables = {"params": state.params, **state.model_state}
+        from elasticdl_tpu.api.quantization import is_quantized
+
+        if is_quantized(state.params) != self._qz and hasattr(
+                self, "_pool"):
+            raise ValueError(
+                "hot reload cannot change quantization (compiled "
+                "executables bake the dequantize path)"
+            )
+        self.model_version = int(version)
+
+    # ------------------------------------------------------------- slots
+
+    def free_slots(self):
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def active_count(self):
+        return sum(1 for s in self._slots if s is not None)
+
+    def active_requests(self):
+        return [s.request for s in self._slots if s is not None]
+
+    def insert(self, request):
+        """Seat `request` in a free slot: one prefill forward fills the
+        slot's per-layer caches for the prompt and produces the FIRST
+        generated token (pushed by the caller — this is the TTFT
+        boundary). Returns (slot_idx, first_token, finished); raises
+        RuntimeError when no slot is free (callers check free_slots)."""
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slot")
+        slot = free[0]
+        p = len(request.prompt)
+        total = p + request.max_new_tokens
+        if total > self.seq_len:
+            raise ValueError(
+                "request needs %d positions > seq_len %d"
+                % (total, self.seq_len)
+            )
+        p_pad = _prefill_bucket(p, self.seq_len)
+        fn = self._prefill_fns.get(p_pad)
+        if fn is None:
+            fn = self._build_prefill(p_pad)
+            self._prefill_fns[p_pad] = fn
+        buf = np.zeros((1, self.seq_len), np.int32)
+        buf[0, :p] = request.prompt
+        with self.trainer.mesh:
+            kv, first = fn(
+                self.variables, jnp.asarray(buf),
+                jnp.asarray(p, jnp.int32),
+                jnp.asarray(request.seed, jnp.int32),
+                jnp.asarray(request.temperature, jnp.float32),
+            )
+            self._pool = self._write_slot(kv, slot)
+        first = int(first)
+        request.generated.append(first)
+        request.model_version = self.model_version
+        finished = request.max_new_tokens == 1
+        if not finished:
+            self._slots[slot] = _Slot(request, total)
+            self._last_tokens[slot] = first
+            self._seeds[slot] = request.seed
+            self._temps[slot] = request.temperature
+        return slot, first, finished
+
+    def evict(self, slot):
+        """Free a slot (completion or deadline eviction). The stale
+        cache rows stay until the next insert overwrites them."""
+        self._slots[slot] = None
+
+    def evict_expired(self, now):
+        """Evict every active request whose deadline has passed;
+        returns the evicted requests (the scheduler fails them with
+        DEADLINE_EXCEEDED — partial tokens already streamed stand)."""
+        out = []
+        for i, st in enumerate(self._slots):
+            if st is not None and st.request.expired(now):
+                self._slots[i] = None
+                out.append(st.request)
+        return out
+
+    def step(self):
+        """One vmapped decode step over the WHOLE pool. Every active
+        slot advances one token at its own position; free slots run the
+        same compute against stale caches and are ignored (static shape,
+        zero recompiles). Returns [(slot, request, token, finished)] for
+        slots that were active; finished slots are freed."""
+        active = [
+            (i, s) for i, s in enumerate(self._slots) if s is not None
+        ]
+        if not active:
+            return []
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        with self.trainer.mesh:
+            self._pool, nxt = self._step_fn(
+                self.variables, self._pool,
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(self._seeds),
+                jnp.asarray(self._temps),
+            )
+            nxt = np.asarray(nxt)
+        out = []
+        for slot, st in active:
+            token = int(nxt[slot])
+            st.request.generated.append(token)
+            st.request.model_version = self.model_version
+            self._last_tokens[slot] = token
+            finished = (
+                len(st.request.prompt) + len(st.request.generated)
+                >= st.max_total
+            )
+            if finished:
+                self.evict(slot)
+            out.append((slot, st.request, token, finished))
+        return out
+
+    # ------------------------------------------------------- compiled fns
+
+    def _build_prefill(self, p_pad):
+        model, kv_shapes = self.model, self._kv_shapes
+        top_k, top_p, qz = self.top_k, self.top_p, self._qz
+
+        def prefill(variables, buf, p_len, seed, temperature):
+            variables = _maybe_dequantize(variables, qz)
+            kv, last = _run_prefill(
+                model, variables, kv_shapes, buf, p_len, p_pad
+            )
+            first = serving_next_token(
+                last[0], seed, p_len, temperature, top_k, top_p
+            )
+            return kv, first
+
+        logger.info("serving: compiling prefill for bucket %d", p_pad)
+        return jax.jit(prefill)
+
+    def _build_step(self):
+        model = self.model
+        top_k, top_p, qz = self.top_k, self.top_p, self._qz
+
+        def step(variables, pool, last_tokens, seeds, temps):
+            variables = _maybe_dequantize(variables, qz)
+
+            def one(cache, tok, seed, temp):
+                # pre-advance counter: the model writes this token's
+                # k/v at `pos` and the sampled token lands at pos + 1
+                # (the offline loop's `_next_token(..., i + 1)`)
+                pos = cache["pos"]
+                logits, upd = model.apply(
+                    dict(variables, cache=cache),
+                    {"tokens": tok[None, None]},
+                    training=False, decode=True, mutable=["cache"],
+                )
+                nxt = serving_next_token(
+                    logits[0, 0], seed, pos + 1, temp, top_k, top_p
+                )
+                return upd["cache"], nxt
+
+            return jax.vmap(one)(pool, last_tokens, seeds, temps)
+
+        logger.info(
+            "serving: compiling decode step for %d slots", self.num_slots
+        )
+        return jax.jit(step)
+
+    def _write_slot(self, kv, slot):
+        """Insert a batch-1 cache tree into the pool at a TRACED slot
+        index (one compiled write serves every slot)."""
+        if self._write_fn is None:
+            def write(pool, kv, idx):
+                def upd(p, n):
+                    start = (idx,) + (0,) * n.ndim
+                    return jax.lax.dynamic_update_slice(
+                        p, n[None], start
+                    )
+
+                return jax.tree.map(upd, pool, kv)
+
+            self._write_fn = jax.jit(write)
+        return self._write_fn(
+            self._pool, kv, jnp.asarray(slot, jnp.int32)
+        )

@@ -1,0 +1,116 @@
+"""Serving process entrypoint.
+
+Builds the sequence-family model from the zoo spec, restores the newest
+checkpoint when one exists, and serves Generate/GenerateStream/
+ServerStatus until SIGTERM/SIGINT — which trigger the graceful path:
+admission closes (queued requests get RESOURCE_EXHAUSTED), in-flight
+slots drain to completion, then the transport stops. With
+--checkpoint_dir the server keeps following the directory and
+hot-reloads newer versions between decode steps.
+
+    python -m elasticdl_tpu.serving.main \\
+        --model_zoo model_zoo \\
+        --model_def transformer_lm.transformer_lm.custom_model \\
+        --model_params "vocab_size=256; seq_len=128" \\
+        --checkpoint_dir /ckpt --port 50051 --num_slots 8
+"""
+
+import argparse
+import signal
+import sys
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.model_utils import get_model_spec
+
+
+def parse_serving_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="elasticdl-tpu generation server"
+    )
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument("--model_def", required=True)
+    parser.add_argument("--model_params", default="")
+    parser.add_argument("--port", type=int, default=50051)
+    parser.add_argument("--num_slots", type=int, default=4)
+    parser.add_argument("--queue_capacity", type=int, default=64)
+    parser.add_argument("--top_k", type=int, default=0)
+    parser.add_argument("--top_p", type=float, default=1.0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--reload_poll_secs", type=float, default=2.0)
+    parser.add_argument("--tensorboard_log_dir", default="")
+    return parser.parse_args(args)
+
+
+def build_server(args):
+    # imports deferred so --help works without jax initialized
+    import jax
+
+    from elasticdl_tpu.checkpoint.saver import (
+        get_latest_checkpoint_version,
+        restore_state_from_checkpoint,
+    )
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+    from elasticdl_tpu.serving.server import (
+        GenerationServer,
+        ServingConfig,
+    )
+    from elasticdl_tpu.training.trainer import Trainer
+
+    spec = get_model_spec(args.model_zoo, args.model_def)
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(spec, mesh=mesh, model_params=args.model_params)
+    seq_len = int(trainer.model.seq_len)
+    dummy = np.zeros((1, seq_len), np.int32)
+    state = trainer.init_state(({"tokens": dummy}, dummy))
+    version = 0
+    if args.checkpoint_dir:
+        if get_latest_checkpoint_version(args.checkpoint_dir) >= 0:
+            state, version = restore_state_from_checkpoint(
+                state, args.checkpoint_dir, strict=False
+            )
+            logger.info("serving checkpoint version-%d", version)
+        else:
+            logger.warning(
+                "no checkpoint under %r yet; serving fresh params "
+                "until one lands", args.checkpoint_dir,
+            )
+    server = GenerationServer(
+        trainer, state,
+        ServingConfig(
+            num_slots=args.num_slots,
+            queue_capacity=args.queue_capacity,
+            top_k=args.top_k, top_p=args.top_p,
+            checkpoint_dir=args.checkpoint_dir,
+            reload_poll_secs=args.reload_poll_secs,
+            telemetry_dir=args.tensorboard_log_dir,
+            port=args.port,
+        ),
+    )
+    server.engine.model_version = version
+    if server.watcher is not None:
+        server.watcher.version = version
+    return server
+
+
+def main(argv=None):
+    args = parse_serving_args(argv)
+    server = build_server(args).start()
+    done = threading.Event()
+
+    def _graceful(_signum, _frame):
+        logger.info("signal received: draining and stopping")
+        done.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    print("SERVING_READY port=%d" % server.port, flush=True)
+    done.wait()
+    server.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

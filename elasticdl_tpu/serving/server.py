@@ -1,0 +1,379 @@
+"""The generation server: scheduler thread + gRPC front-end.
+
+Wiring (one process):
+
+    gRPC threads ──submit──> RequestQueue ──pop──┐
+         ^                                       v
+         └──events (tokens/done/error)── _Scheduler thread
+                                           │ engine.insert / engine.step
+                                           │ watcher.poll  (hot reload)
+                                           │ telemetry gauges
+                                           v
+                              ContinuousBatchingEngine (jit decode pool)
+
+All jax work happens on the single scheduler thread; gRPC handler
+threads only touch the admission queue and their request's event queue,
+and block with a LIVENESS BOUND: every wait re-checks the request's
+deadline and the scheduler's pulse, so a killed or wedged scheduler
+turns into a clean RESOURCE_EXHAUSTED/DEADLINE_EXCEEDED, never a hung
+client (the kill-drill's invariant).
+
+Fault injection: the servicer is wrapped at the same choke point the
+master uses (common/fault_injection.py, EDL_FAULT_SPEC) with the
+serving RPC names — overload and kill drills are spec-driven, e.g.
+``generate:error:3`` or ``generate:kill:1:skip=8``.
+"""
+
+import threading
+import time
+from concurrent import futures
+
+from elasticdl_tpu.common.fault_injection import (
+    SERVING_RPCS,
+    maybe_wrap_servicer,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.serving.admission import (
+    AdmissionError,
+    RequestQueue,
+    ServingRequest,
+)
+from elasticdl_tpu.serving.engine import ContinuousBatchingEngine
+from elasticdl_tpu.serving.hot_reload import CheckpointWatcher
+from elasticdl_tpu.serving.telemetry import ServingTelemetry
+
+
+class ServingConfig(object):
+    """Server knobs. num_slots sizes the decode pool (the compiled step);
+    queue_capacity bounds the admitted backlog (backpressure beyond it);
+    top_k/top_p are static server-level sampling filters (per-request
+    temperature/seed select greedy vs sampling)."""
+
+    def __init__(self, num_slots=4, queue_capacity=64, top_k=0,
+                 top_p=1.0, checkpoint_dir="", reload_poll_secs=2.0,
+                 telemetry_dir="", telemetry_flush_every=50,
+                 idle_wait_secs=0.05, handler_poll_secs=0.25,
+                 port=0, max_workers=64):
+        self.num_slots = int(num_slots)
+        self.queue_capacity = int(queue_capacity)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.checkpoint_dir = checkpoint_dir
+        self.reload_poll_secs = float(reload_poll_secs)
+        self.telemetry_dir = telemetry_dir
+        self.telemetry_flush_every = int(telemetry_flush_every)
+        self.idle_wait_secs = float(idle_wait_secs)
+        self.handler_poll_secs = float(handler_poll_secs)
+        self.port = int(port)
+        self.max_workers = int(max_workers)
+
+
+class _Scheduler(threading.Thread):
+    """The continuous-batching loop. Each iteration: reload params if a
+    newer checkpoint landed, evict expired sequences, seat queued
+    prompts into free slots (prefill), run ONE pooled decode step, push
+    the produced tokens. Idle (no active slots) it parks on the queue's
+    condition with a short timeout so reload polling stays live."""
+
+    def __init__(self, engine, queue, telemetry, watcher=None,
+                 idle_wait_secs=0.05, clock=time.monotonic):
+        super().__init__(daemon=True, name="serving-scheduler")
+        self.engine = engine
+        self.queue = queue
+        self.telemetry = telemetry
+        self.watcher = watcher
+        self.idle_wait_secs = idle_wait_secs
+        self._clock = clock
+        self._stop_requested = threading.Event()
+        self._drain = True
+        self.crashed = None
+
+    def run(self):
+        try:
+            while not self._stop_requested.is_set():
+                self._iterate()
+            self._shutdown()
+        except BaseException as e:  # noqa: BLE001 - surfaced to handlers
+            self.crashed = e
+            logger.error("serving scheduler crashed: %r", e)
+            self._abort_all("RESOURCE_EXHAUSTED",
+                            "scheduler crashed: %r" % (e,))
+
+    def _iterate(self):
+        if self.watcher is not None:
+            reloaded = self.watcher.poll()
+            if reloaded is not None:
+                state, version = reloaded
+                self.engine.set_params(state, version)
+                self.telemetry.count("reloads")
+        now = self._clock()
+        for req in self.engine.evict_expired(now):
+            self.telemetry.count("expired")
+            req.push(("error", "DEADLINE_EXCEEDED",
+                      "deadline expired mid-decode"))
+        self._fill_slots()
+        if self.engine.active_count():
+            t0 = self._clock()
+            results = self.engine.step()
+            dt = self._clock() - t0
+            for _slot, req, token, finished in results:
+                req.push(("tokens", [token], req.model_version))
+                if finished:
+                    self.telemetry.count("completed")
+                    req.push(("done", req.model_version))
+            self.telemetry.record_step(
+                len(self.queue), len(results), dt, len(results)
+            )
+        else:
+            self.queue.wait_for_work(self.idle_wait_secs)
+
+    def _fill_slots(self):
+        while self.engine.free_slots():
+            req, expired = self.queue.pop_ready()
+            for e in expired:
+                self.telemetry.count("expired")
+                e.push(("error", "DEADLINE_EXCEEDED",
+                        "deadline expired while queued"))
+            if req is None:
+                break
+            slot, first, finished = self.engine.insert(req)
+            self.telemetry.record_ttft(req)
+            # the prefill produced this token; step() only counts the
+            # decode-loop tokens
+            self.telemetry.count("tokens_generated")
+            req.push(("tokens", [first], req.model_version))
+            if finished:
+                self.telemetry.count("completed")
+                req.push(("done", req.model_version))
+
+    def _shutdown(self):
+        """Graceful stop: reject the queued backlog immediately; with
+        drain=True finish the in-flight slots first (they hold real
+        compute progress), else abort them too. Either way every request
+        terminates with done or a clean error — never silence."""
+        for req in self.queue.close():
+            self.telemetry.count("rejected")
+            req.push(("error", "RESOURCE_EXHAUSTED",
+                      "server shutting down"))
+        if not self._drain:
+            self._abort_all("RESOURCE_EXHAUSTED", "server shutting down")
+            return
+        while self.engine.active_count():
+            now = self._clock()
+            for req in self.engine.evict_expired(now):
+                self.telemetry.count("expired")
+                req.push(("error", "DEADLINE_EXCEEDED",
+                          "deadline expired mid-decode"))
+            if not self.engine.active_count():
+                break
+            for _slot, req, token, finished in self.engine.step():
+                req.push(("tokens", [token], req.model_version))
+                if finished:
+                    self.telemetry.count("completed")
+                    req.push(("done", req.model_version))
+
+    def _abort_all(self, code, message):
+        for req in self.engine.active_requests():
+            req.push(("error", code, message))
+        for req in self.queue.close():
+            req.push(("error", code, message))
+
+    def stop(self, drain=True):
+        self._drain = drain
+        self._stop_requested.set()
+        self.queue.wake()  # wake the idle wait so shutdown is prompt
+
+
+class ServingServicer(object):
+    """gRPC handlers (proto/service.py Serving table). Works both over
+    real gRPC (context aborts) and in-process (AdmissionError raised to
+    the caller) — the same duality the master servicer tests use."""
+
+    def __init__(self, queue, engine, telemetry, scheduler_alive,
+                 handler_poll_secs=0.25, clock=time.monotonic):
+        self._queue = queue
+        self._engine = engine
+        self._telemetry = telemetry
+        self._scheduler_alive = scheduler_alive
+        self._poll = handler_poll_secs
+        self._clock = clock
+
+    # ------------------------------------------------------------- RPCs
+
+    def generate(self, request, context=None):
+        req = self._admit(request, context)
+        for _chunk, _version in self._events(req, context):
+            pass  # unary: accumulate; req.generated holds the tokens
+        return pb.GenerateResponse(
+            tokens=req.prompt + req.generated,
+            model_version=req.model_version,
+        )
+
+    def generate_stream(self, request, context=None):
+        req = self._admit(request, context)
+
+        def stream():
+            for chunk, version in self._events(req, context):
+                yield pb.TokenChunk(
+                    tokens=chunk, done=False, model_version=version
+                )
+            yield pb.TokenChunk(
+                tokens=[], done=True, model_version=req.model_version
+            )
+
+        return stream()
+
+    def server_status(self, request, context=None):
+        snap = self._telemetry.snapshot()
+        return pb.ServerStatusResponse(
+            queue_depth=len(self._queue),
+            active_slots=self._engine.active_count(),
+            num_slots=self._engine.num_slots,
+            model_version=self._engine.model_version,
+            admitted=snap["admitted"],
+            rejected=snap["rejected"],
+            expired=snap["expired"],
+            completed=snap["completed"],
+            tokens_generated=snap["tokens_generated"],
+            reloads=snap["reloads"],
+            uptime_secs=snap["uptime_secs"],
+            max_active_slots=snap["max_active_slots"],
+        )
+
+    # --------------------------------------------------------- internals
+
+    def _admit(self, proto_req, context):
+        req = ServingRequest(
+            prompt=list(proto_req.prompt),
+            max_new_tokens=proto_req.max_new_tokens,
+            temperature=proto_req.temperature,
+            seed=proto_req.seed,
+            deadline_ms=proto_req.deadline_ms,
+        )
+        try:
+            self._queue.submit(req)
+        except AdmissionError as e:
+            self._telemetry.count(
+                "expired" if e.code == "DEADLINE_EXCEEDED" else "rejected"
+            )
+            self._fail(context, e.code, str(e))
+        self._telemetry.count("admitted")
+        return req
+
+    def _events(self, req, context):
+        """Yield ("tokens" chunks, version) until done; terminate with a
+        clean status on error/expiry/scheduler loss. The timeout'd wait
+        is the no-hang backstop: even if the scheduler vanishes without
+        pushing a terminal event, the handler notices within one poll."""
+        while True:
+            ev = req.next_event(timeout=self._poll)
+            if ev is None:
+                now = self._clock()
+                if req.expired(now):
+                    # backstop only: the scheduler normally evicts and
+                    # counts the expiry before this wait times out
+                    self._fail(context, "DEADLINE_EXCEEDED",
+                               "deadline expired")
+                if not self._scheduler_alive():
+                    self._fail(context, "RESOURCE_EXHAUSTED",
+                               "serving scheduler is not running")
+                continue
+            kind = ev[0]
+            if kind == "tokens":
+                yield ev[1], ev[2]
+            elif kind == "done":
+                return
+            else:  # ("error", code, message)
+                self._fail(context, ev[1], ev[2])
+
+    def _fail(self, context, code_name, message):
+        if context is not None:
+            import grpc
+
+            context.abort(
+                getattr(grpc.StatusCode, code_name,
+                        grpc.StatusCode.UNKNOWN),
+                message,
+            )
+        raise AdmissionError(code_name, message)
+
+
+class GenerationServer(object):
+    """Owns the engine, queue, scheduler thread and (optionally) the
+    gRPC server. start(grpc_server=False) runs everything in-process —
+    the servicer is callable directly, which is what the unit tests and
+    the in-process bench mode use."""
+
+    def __init__(self, trainer, state, config=None, injector=None):
+        self.config = config or ServingConfig()
+        cfg = self.config
+        self.engine = ContinuousBatchingEngine(
+            trainer, state, cfg.num_slots,
+            top_k=cfg.top_k, top_p=cfg.top_p,
+        )
+        self.queue = RequestQueue(cfg.queue_capacity, self.engine.seq_len)
+        self.telemetry = ServingTelemetry(
+            log_dir=cfg.telemetry_dir or None,
+            flush_every=cfg.telemetry_flush_every,
+        )
+        watcher = None
+        if cfg.checkpoint_dir:
+            watcher = CheckpointWatcher(
+                cfg.checkpoint_dir, state,
+                poll_secs=cfg.reload_poll_secs,
+                start_version=self.engine.model_version,
+            )
+        self.watcher = watcher
+        self.scheduler = _Scheduler(
+            self.engine, self.queue, self.telemetry, watcher=watcher,
+            idle_wait_secs=cfg.idle_wait_secs,
+        )
+        servicer = ServingServicer(
+            self.queue, self.engine, self.telemetry,
+            scheduler_alive=self.scheduler.is_alive,
+            handler_poll_secs=cfg.handler_poll_secs,
+        )
+        # EDL_FAULT_SPEC (or an explicit injector) arms drop/error/
+        # delay/kill at the RPC boundary, exactly like the master
+        self.servicer = maybe_wrap_servicer(
+            servicer, injector, rpcs=SERVING_RPCS
+        )
+        self._server = None
+        self.port = None
+
+    def start(self, grpc_server=True):
+        self.scheduler.start()
+        if grpc_server:
+            from elasticdl_tpu.proto.service import (
+                add_serving_servicer_to_server,
+                build_server,
+            )
+
+            server = build_server(
+                futures.ThreadPoolExecutor(
+                    max_workers=self.config.max_workers
+                )
+            )
+            add_serving_servicer_to_server(self.servicer, server)
+            self.port = server.add_insecure_port(
+                "[::]:%d" % self.config.port
+            )
+            server.start()
+            self._server = server
+            logger.info(
+                "Serving gRPC server started on port %d (slots=%d, "
+                "queue=%d)", self.port, self.config.num_slots,
+                self.config.queue_capacity,
+            )
+        return self
+
+    def stop(self, drain=True, grace=5.0):
+        """Graceful: stop admission, drain (or abort) in-flight work,
+        then stop the transport. Safe to call twice."""
+        self.scheduler.stop(drain=drain)
+        self.scheduler.join(timeout=60.0)
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+        self.telemetry.close()

@@ -1,0 +1,125 @@
+"""Serving telemetry on the dependency-free TensorBoard event path.
+
+Same substrate as the master's training gauges (common/tb_events.py —
+the recovery gauges ride it too), so one TensorBoard logdir shows the
+whole system. Gauges, stepped by decode-step index:
+
+    serving/queue_depth        queued backlog at the step
+    serving/active_slots       slots decoding at the step
+    serving/step_ms            wall time of the decode step
+    serving/tokens_per_sec     tokens committed / wall over the window
+    serving/ttft_ms            per-request time-to-first-token (written
+                               at each request's first token)
+    serving/admitted_total     monotone counters, one scalar per flush
+    serving/rejected_total
+    serving/expired_total
+    serving/completed_total
+    serving/reloads_total
+
+Counters also back the ServerStatus RPC via snapshot() — the RPC must
+work with telemetry disabled (no log_dir), so counters live here and
+the event writer is optional.
+
+Thread-safety: the scheduler thread writes step gauges; gRPC threads
+bump admission counters and read snapshots — everything under one lock
+(the writes are tiny appends; contention is negligible next to a decode
+step)."""
+
+import threading
+import time
+
+from elasticdl_tpu.common.tb_events import EventFileWriter
+
+
+class ServingTelemetry(object):
+    def __init__(self, log_dir=None, flush_every=50, clock=time.monotonic):
+        self._log_dir = log_dir
+        self._flush_every = max(1, int(flush_every))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._writer = None
+        self._started = clock()
+        self.counters = {
+            "admitted": 0,
+            "rejected": 0,
+            "expired": 0,
+            "completed": 0,
+            "tokens_generated": 0,
+            "reloads": 0,
+        }
+        self.max_active_slots = 0
+        self._step = 0
+        self._window_tokens = 0
+        self._window_t0 = clock()
+        self._last_gauges = {}
+
+    def _ensure_writer(self):
+        if self._writer is None and self._log_dir:
+            self._writer = EventFileWriter(
+                self._log_dir, filename_suffix=".serving"
+            )
+        return self._writer
+
+    def _scalar(self, tag, value, step):
+        writer = self._ensure_writer()
+        if writer is not None:
+            writer.add_scalar(tag, float(value), step)
+
+    # ------------------------------------------------------------ events
+
+    def count(self, name, n=1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def record_ttft(self, request):
+        """Time-to-first-token for one request, at its first token."""
+        ttft_ms = (self._clock() - request.submitted_at) * 1000.0
+        with self._lock:
+            self._scalar("serving/ttft_ms", ttft_ms, self._step)
+        return ttft_ms
+
+    def record_step(self, queue_depth, active_slots, step_secs,
+                    tokens_committed):
+        """Per-decode-step gauges; counters flush every flush_every
+        steps so the event file stays O(steps / flush_every)."""
+        with self._lock:
+            self._step += 1
+            self.max_active_slots = max(
+                self.max_active_slots, active_slots
+            )
+            self.counters["tokens_generated"] += tokens_committed
+            self._window_tokens += tokens_committed
+            self._scalar("serving/queue_depth", queue_depth, self._step)
+            self._scalar("serving/active_slots", active_slots, self._step)
+            self._scalar(
+                "serving/step_ms", step_secs * 1000.0, self._step
+            )
+            if self._step % self._flush_every == 0:
+                now = self._clock()
+                window = max(now - self._window_t0, 1e-9)
+                self._scalar(
+                    "serving/tokens_per_sec",
+                    self._window_tokens / window, self._step,
+                )
+                self._window_tokens = 0
+                self._window_t0 = now
+                for name, value in self.counters.items():
+                    self._scalar(
+                        "serving/%s_total" % name, value, self._step
+                    )
+
+    # ---------------------------------------------------------- snapshot
+
+    def snapshot(self):
+        with self._lock:
+            snap = dict(self.counters)
+            snap["max_active_slots"] = self.max_active_slots
+            snap["uptime_secs"] = self._clock() - self._started
+            snap["steps"] = self._step
+            return snap
+
+    def close(self):
+        with self._lock:
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Closed-loop serving load generator: the serving-throughput entry in
+the bench trajectory (BENCH_* record family).
+
+Runs the real stack in one process — GenerationServer (continuous-
+batching engine + gRPC transport) and a Poisson open-loop arrival
+process of streaming clients with mixed prompt/output lengths — and
+emits ONE JSON line:
+
+    {"metric": "serving_goodput_tokens_per_sec", "value": ...,
+     "ttft_ms": {"p50": ..., "p99": ...}, "latency_ms": {...},
+     "tokens_per_sec": ..., "goodput_rps": ..., "rejected": ...,
+     "expired": ..., ...}
+
+* TTFT is measured at the FIRST streamed chunk (prefill + queueing);
+* tokens_per_sec counts only tokens of COMPLETED requests over the
+  measurement wall; goodput_rps is completed requests per second —
+  rejected (backpressure) and expired (deadline) requests score zero,
+  which is what makes overload visible as a goodput plateau;
+* arrivals are open-loop Poisson (exponential gaps at --rate), so
+  backpressure actually engages instead of the clients self-throttling.
+
+Defaults are CPU-smoke sized (`make serve-smoke`); on hardware raise
+--requests/--rate and the model dims.
+
+Usage:
+    python scripts/bench_serving.py --requests 32 --rate 16 \
+        --num_slots 4 --out BENCH_SERVING.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=16.0,
+                   help="mean arrival rate, requests/sec (Poisson)")
+    p.add_argument("--num_slots", type=int, default=4)
+    p.add_argument("--queue_capacity", type=int, default=16)
+    p.add_argument("--prompt_len", default="2:6",
+                   help="min:max prompt tokens (uniform)")
+    p.add_argument("--out_len", default="4:12",
+                   help="min:max generated tokens (uniform)")
+    p.add_argument("--deadline_ms", type=int, default=0,
+                   help="per-request deadline budget; 0 = none")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--model_params", default=(
+        "vocab_size=32; seq_len=32; embed_dim=32; num_heads=2; "
+        "num_layers=1"
+    ))
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="",
+                   help="also write the JSON record to this path")
+    return p.parse_args(argv)
+
+
+def _span(text):
+    lo, _, hi = text.partition(":")
+    lo, hi = int(lo), int(hi or lo)
+    if not 1 <= lo <= hi:
+        raise ValueError("bad span %r" % text)
+    return lo, hi
+
+
+def percentile(values, q):
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, int(round(q / 100.0 * (len(vs) - 1))))
+    return vs[idx]
+
+
+def run_bench(args):
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.common.model_utils import (
+        load_model_spec_from_module,
+    )
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import ServingStub, build_channel
+    from elasticdl_tpu.serving import GenerationServer, ServingConfig
+    from elasticdl_tpu.training.trainer import Trainer
+    from model_zoo.transformer_lm import transformer_lm as zoo
+
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(
+        load_model_spec_from_module(zoo), mesh=mesh,
+        model_params=args.model_params,
+    )
+    seq_len = int(trainer.model.seq_len)
+    vocab = int(trainer.model.vocab_size)
+    dummy = np.zeros((1, seq_len), np.int32)
+    state = trainer.init_state(({"tokens": dummy}, dummy))
+    server = GenerationServer(
+        trainer, state,
+        ServingConfig(
+            num_slots=args.num_slots,
+            queue_capacity=args.queue_capacity,
+        ),
+    ).start()
+    stub = ServingStub(build_channel("localhost:%d" % server.port))
+
+    p_lo, p_hi = _span(args.prompt_len)
+    o_lo, o_hi = _span(args.out_len)
+    if p_hi + o_hi > seq_len:
+        raise SystemExit(
+            "prompt_len max %d + out_len max %d exceeds seq_len %d"
+            % (p_hi, o_hi, seq_len)
+        )
+    rs = np.random.RandomState(args.seed)
+    plan = [
+        {
+            "prompt": rs.randint(0, vocab,
+                                 size=rs.randint(p_lo, p_hi + 1)),
+            "new": int(rs.randint(o_lo, o_hi + 1)),
+            "gap": float(rs.exponential(1.0 / args.rate)),
+            "seed": int(i),
+        }
+        for i in range(args.requests)
+    ]
+
+    # one warmup request outside the measurement: pays the jit compiles
+    stub.generate(
+        pb.GenerateRequest(prompt=[1, 2], max_new_tokens=2), timeout=300
+    )
+
+    results = []
+    lock = threading.Lock()
+
+    def one(spec):
+        t0 = time.monotonic()
+        row = {"status": "OK", "tokens": 0, "ttft_ms": None}
+        try:
+            stream = stub.generate_stream(
+                pb.GenerateRequest(
+                    prompt=[int(t) for t in spec["prompt"]],
+                    max_new_tokens=spec["new"],
+                    temperature=args.temperature,
+                    seed=spec["seed"],
+                    deadline_ms=args.deadline_ms,
+                ),
+                timeout=300,
+            )
+            for chunk in stream:
+                if row["ttft_ms"] is None and chunk.tokens:
+                    row["ttft_ms"] = (time.monotonic() - t0) * 1000.0
+                row["tokens"] += len(chunk.tokens)
+        except Exception as e:  # noqa: BLE001 - status is the datum
+            code = getattr(e, "code", None)
+            row["status"] = (
+                code().name if callable(code) else type(e).__name__
+            )
+        row["latency_ms"] = (time.monotonic() - t0) * 1000.0
+        with lock:
+            results.append(row)
+
+    threads = []
+    bench_t0 = time.monotonic()
+    for spec in plan:
+        time.sleep(spec["gap"])
+        t = threading.Thread(target=one, args=(spec,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.monotonic() - bench_t0
+
+    status = stub.server_status(pb.ServerStatusRequest(), timeout=30)
+    server.stop()
+
+    ok = [r for r in results if r["status"] == "OK"]
+    ttfts = [r["ttft_ms"] for r in ok if r["ttft_ms"] is not None]
+    lats = [r["latency_ms"] for r in ok]
+    tokens_ok = sum(r["tokens"] for r in ok)
+    record = {
+        "metric": "serving_goodput_tokens_per_sec",
+        "value": round(tokens_ok / wall, 3) if wall else None,
+        "unit": "tokens/sec",
+        "platform": jax.default_backend(),
+        "requests": args.requests,
+        "rate_rps": args.rate,
+        "num_slots": args.num_slots,
+        "queue_capacity": args.queue_capacity,
+        "completed": len(ok),
+        "rejected": sum(
+            1 for r in results if r["status"] == "RESOURCE_EXHAUSTED"
+        ),
+        "expired": sum(
+            1 for r in results if r["status"] == "DEADLINE_EXCEEDED"
+        ),
+        "goodput_rps": round(len(ok) / wall, 3) if wall else None,
+        "tokens_per_sec": round(tokens_ok / wall, 3) if wall else None,
+        "ttft_ms": {
+            "p50": percentile(ttfts, 50), "p99": percentile(ttfts, 99),
+        },
+        "latency_ms": {
+            "p50": percentile(lats, 50), "p99": percentile(lats, 99),
+        },
+        "wall_secs": round(wall, 3),
+        "max_active_slots": status.max_active_slots,
+        "server_tokens_generated": status.tokens_generated,
+    }
+    return record
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    record = run_bench(args)
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    # a bench run that completed nothing is a failure, not a datum
+    return 0 if record["completed"] > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

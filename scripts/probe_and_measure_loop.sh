@@ -28,23 +28,39 @@ while true; do
         echo "[loop] hw_session rc=$RC"
         # hw_session exits 0 even when every bench fell back to CPU
         # (wedge right after the probe answered). A window only ends
-        # the loop when it measured BROADLY on the chip: the flagship
-        # AND most of the family/A-B queue — a short window that
-        # caught just the headline keeps the loop armed so the next
-        # window can convert the rest.
+        # the loop when the chip measurements are BROAD: the flagship
+        # AND most of the family/A-B queue. Coverage ACCUMULATES over
+        # the archived windows (the mv above): short tunnel windows
+        # each convert a few steps, and the loop exits once their
+        # UNION clears the bar — per-session-only counting could spin
+        # forever when no single window lasts long enough.
         if [ "$RC" -eq 0 ] && [ -s hw_session_results.json ] && \
            python - <<'EOF'
-import json, sys
-d = json.load(open("hw_session_results.json"))
-flag_ok = any(
-    (d.get(k) or {}).get("platform") not in (None, "cpu")
-    for k in ("flagship", "flagship_prelim")
+import glob, json, sys
+# current window first, then every archived partial window
+paths = ["hw_session_results.json"] + sorted(
+    glob.glob("hw_session_results.*.json")
 )
-# hw_session.py's save() writes the coverage summary — it owns the
-# step roster, so the threshold can't drift when the queue changes
-measured = d.get("tpu_measured", 0)
-target = d.get("tpu_target", 0)
-sys.exit(0 if flag_ok and target and measured >= 0.75 * target else 1)
+measured, flag_ok, target = set(), False, 0
+for path in paths:
+    try:
+        d = json.load(open(path))
+    except (ValueError, OSError):
+        continue
+    flag_ok = flag_ok or any(
+        (d.get(k) or {}).get("platform") not in (None, "cpu")
+        for k in ("flagship", "flagship_prelim")
+    )
+    # same per-step rule hw_session.py's save() counts with; the
+    # union over windows is what accumulates
+    measured.update(
+        k for k, v in d.items()
+        if isinstance(v, dict) and v.get("platform") not in (None, "cpu")
+    )
+    # hw_session.py's save() derives the target from the actual step
+    # roster; take the newest/largest so a grown queue raises the bar
+    target = max(target, int(d.get("tpu_target") or 0))
+sys.exit(0 if flag_ok and target and len(measured) >= 0.75 * target else 1)
 EOF
         then
             echo "[loop] TPU window fully converted; exiting"

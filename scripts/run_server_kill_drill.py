@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Replayable SERVING kill drill (the inference twin of
+scripts/run_master_kill_drill.py).
+
+Runs the REAL serving stack as a subprocess (`python -m
+elasticdl_tpu.serving.main`) and drills the two ways a serving process
+dies, asserting the client-visible invariant both times: every
+in-flight request either COMPLETES or terminates with a CLEAN status —
+never a hang.
+
+Phase 1 — graceful (SIGTERM mid-load): admission closes, queued
+  requests get RESOURCE_EXHAUSTED, seated requests drain to completion,
+  the process exits 0. Allowed outcomes: OK / RESOURCE_EXHAUSTED /
+  DEADLINE_EXCEEDED.
+
+Phase 2 — hard kill (EDL_FAULT_SPEC=generate:kill:1:skip=N, the same
+  spec grammar the master drills use): the process SIGKILLs itself
+  mid-load; surviving clients see the transport die as UNAVAILABLE /
+  CANCELLED within seconds. The point is the absence of hangs, not the
+  status: a SIGKILL'd server cannot promise more than a torn socket,
+  and common/retry.py classifies exactly these codes as transient for
+  the retry-elsewhere path.
+
+Usage: python scripts/run_server_kill_drill.py
+Exit 0 = both phases hold."""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MODEL_PARAMS = (
+    "vocab_size=16; seq_len=32; embed_dim=32; num_heads=2; num_layers=1"
+)
+CLIENT_TIMEOUT = 60.0  # backstop; the drill asserts we never get near it
+
+
+def start_server(extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "elasticdl_tpu.serving.main",
+            "--model_zoo", os.path.join(REPO, "model_zoo"),
+            "--model_def", "transformer_lm.transformer_lm.custom_model",
+            "--model_params", MODEL_PARAMS,
+            "--port", "0", "--num_slots", "1", "--queue_capacity", "4",
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    port = None
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "server died during startup (rc=%s)" % proc.returncode
+                )
+            continue
+        if line.startswith("SERVING_READY"):
+            port = int(line.strip().split("port=")[1])
+            break
+    if port is None:
+        proc.kill()
+        raise RuntimeError("server never became ready")
+    # drain the pipe so the child can't block on a full buffer
+    threading.Thread(
+        target=lambda: [None for _ in proc.stdout], daemon=True
+    ).start()
+    return proc, port
+
+
+def fire_requests(port, n, max_new=24):
+    """n concurrent unary requests; returns (outcomes, elapsed) where
+    outcomes[i] is 'OK' or a gRPC status name. Joins with a hard bound:
+    any thread still alive past the client timeout = a hang = failure."""
+    import grpc
+
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import ServingStub, build_channel
+
+    stub = ServingStub(build_channel("localhost:%d" % port))
+    outcomes = {}
+    lock = threading.Lock()
+
+    def call(i):
+        try:
+            stub.generate(
+                pb.GenerateRequest(
+                    prompt=[1 + i % 5, 2], max_new_tokens=max_new,
+                ),
+                timeout=CLIENT_TIMEOUT,
+            )
+            code = "OK"
+        except grpc.RpcError as e:
+            code = e.code().name
+        with lock:
+            outcomes[i] = code
+
+    threads = [
+        threading.Thread(target=call, args=(i,)) for i in range(n)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    return threads, outcomes, t0
+
+
+def join_all(threads, outcomes, t0, n):
+    for t in threads:
+        t.join(timeout=CLIENT_TIMEOUT + 30)
+    elapsed = time.monotonic() - t0
+    hung = [t for t in threads if t.is_alive()]
+    if hung:
+        raise AssertionError("%d client threads HUNG" % len(hung))
+    if len(outcomes) != n:
+        raise AssertionError(
+            "only %d/%d clients terminated" % (len(outcomes), n)
+        )
+    return elapsed
+
+
+def phase_graceful():
+    print("[drill] phase 1: SIGTERM mid-load (graceful drain)")
+    proc, port = start_server()
+    try:
+        threads, outcomes, t0 = fire_requests(port, 8)
+        time.sleep(0.4)  # let some seat, some queue
+        proc.send_signal(signal.SIGTERM)
+        elapsed = join_all(threads, outcomes, t0, 8)
+        rc = proc.wait(timeout=60)
+        codes = sorted(outcomes.values())
+        print("[drill]   outcomes=%s elapsed=%.1fs rc=%s"
+              % (codes, elapsed, rc))
+        allowed = {"OK", "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+        assert set(codes) <= allowed, codes
+        assert "OK" in codes, "drain completed nothing: %s" % codes
+        assert elapsed < CLIENT_TIMEOUT - 10, "clients rode the timeout"
+        assert rc == 0, "graceful exit must return 0, got %s" % rc
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    print("[drill] phase 1 OK")
+
+
+def phase_hard_kill():
+    print("[drill] phase 2: EDL_FAULT_SPEC self-SIGKILL mid-load")
+    proc, port = start_server(
+        extra_env={"EDL_FAULT_SPEC": "generate:kill:1:skip=3"}
+    )
+    try:
+        threads, outcomes, t0 = fire_requests(port, 8)
+        elapsed = join_all(threads, outcomes, t0, 8)
+        codes = sorted(outcomes.values())
+        print("[drill]   outcomes=%s elapsed=%.1fs" % (codes, elapsed))
+        # a SIGKILL'd transport yields UNAVAILABLE/CANCELLED for the
+        # survivors; requests completed before the kill are OK. The
+        # invariant is clean termination, fast.
+        allowed = {"OK", "UNAVAILABLE", "CANCELLED",
+                   "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+        assert set(codes) <= allowed, codes
+        assert any(c != "OK" for c in codes), (
+            "the kill never fired: %s" % codes
+        )
+        assert elapsed < CLIENT_TIMEOUT - 10, "clients rode the timeout"
+        proc.wait(timeout=30)
+        assert proc.returncode != 0  # SIGKILL, by design
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    print("[drill] phase 2 OK")
+
+
+def main():
+    phase_graceful()
+    phase_hard_kill()
+    print("[drill] serving kill drill PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

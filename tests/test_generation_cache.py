@@ -1,0 +1,116 @@
+"""Locks the two offline-decode properties the serving scheduler builds
+on (tier-1: the serving engine reuses the compile cache and the
+KV-cache decode path):
+
+* `_LRUCache` — the bounded compile cache: insertion bound, true LRU
+  eviction order, get() recency refresh, reinsert move-to-back;
+* cache-strategy parity — greedy decode with use_cache=True must
+  produce exactly the tokens of the full-recompute strategy."""
+
+import numpy as np
+
+import jax
+
+from elasticdl_tpu.api.generation import (
+    _LRUCache,
+    autoregressive_generate,
+)
+
+
+# ------------------------------------------------------------ _LRUCache
+
+
+def test_lru_bound_holds_under_overflow():
+    c = _LRUCache()
+    for i in range(3 * c.max_entries):
+        c[("k", i)] = i
+        assert len(c) <= c.max_entries
+    # the survivors are exactly the most recent max_entries inserts
+    lo = 3 * c.max_entries - c.max_entries
+    assert set(c) == {("k", i) for i in range(lo, 3 * c.max_entries)}
+
+
+def test_lru_evicts_least_recently_used_first():
+    c = _LRUCache()
+    c.max_entries = 3
+    c["a"], c["b"], c["c"] = 1, 2, 3
+    # touch "a": "b" becomes the LRU entry
+    assert c.get("a") == 1
+    c["d"] = 4
+    assert "b" not in c and set(c) == {"a", "c", "d"}
+    # untouched order: "c" is now LRU
+    c["e"] = 5
+    assert "c" not in c and set(c) == {"a", "d", "e"}
+
+
+def test_lru_get_miss_and_reinsert_refresh():
+    c = _LRUCache()
+    c.max_entries = 2
+    assert c.get("missing") is None
+    assert c.get("missing", 7) == 7
+    c["a"], c["b"] = 1, 2
+    # reinserting an existing key must refresh recency, not grow
+    c["a"] = 10
+    assert len(c) == 2 and c.get("a") == 10
+    c["c"] = 3  # evicts "b" (LRU after a's refresh)
+    assert "b" not in c and set(c) == {"a", "c"}
+
+
+def test_trainer_compile_cache_is_bounded(monkeypatch):
+    """A sweep over sampling configs must not grow the per-trainer
+    compile cache past the bound (each distinct temperature is one
+    compiled executable)."""
+    trainer, state = _tiny_rig()
+    monkeypatch.setattr(_LRUCache, "max_entries", 4)
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    for i in range(8):
+        autoregressive_generate(
+            trainer, state, prompt, 2, temperature=0.5 + 0.1 * i, seed=0
+        )
+    assert len(trainer._generate_cache) <= 4
+
+
+# ------------------------------------------------- cache-strategy parity
+
+
+def _tiny_rig():
+    from elasticdl_tpu.common.model_utils import (
+        load_model_spec_from_module,
+    )
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+    from elasticdl_tpu.training.trainer import Trainer
+    from model_zoo.transformer_lm import transformer_lm as zoo
+
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(
+        load_model_spec_from_module(zoo), mesh=mesh,
+        model_params=(
+            "vocab_size=8; seq_len=16; embed_dim=32; num_heads=2; "
+            "num_layers=1"
+        ),
+    )
+    toks = (np.arange(17)[None, :] % 8).astype(np.int32)
+    state = trainer.init_state(({"tokens": toks[:, :-1]}, toks[:, 1:]))
+    return trainer, state
+
+
+def test_greedy_cache_strategy_parity():
+    """use_cache=True (batched prefill + per-token KV steps) and the
+    full-recompute strategy must emit IDENTICAL greedy tokens for mixed
+    prompt lengths and continuation budgets."""
+    trainer, state = _tiny_rig()
+    for prompt, new in (
+        ([[1, 2, 3], [4, 5, 6]], 5),
+        ([[2]], 8),
+        ([[7, 0, 1, 2, 3, 4]], 3),
+    ):
+        p = np.asarray(prompt, np.int32)
+        full = np.asarray(
+            autoregressive_generate(trainer, state, p, new)
+        )
+        cached = np.asarray(
+            autoregressive_generate(
+                trainer, state, p, new, use_cache=True
+            )
+        )
+        np.testing.assert_array_equal(full, cached)

@@ -1,0 +1,234 @@
+"""Serving subsystem unit tests (tier-1: sub-second, no model compile).
+
+Admission queue policy, request event plumbing, telemetry counters and
+event-file output, serving proto round-trips/service table, and fault
+injection at the serving servicer boundary. The decode-pool e2e tests
+(compiled engine, gRPC server, hot reload) live in
+tests/test_serving_e2e.py on the drills shard."""
+
+import os
+
+import pytest
+
+from elasticdl_tpu.common.fault_injection import (
+    SERVING_RPCS,
+    FaultInjector,
+    InjectedRpcError,
+    maybe_wrap_servicer,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.serving.admission import (
+    AdmissionError,
+    RequestQueue,
+    ServingRequest,
+)
+from elasticdl_tpu.serving.telemetry import ServingTelemetry
+
+
+class FakeClock(object):
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _req(prompt=(1, 2), new=4, deadline_ms=0, clock=None):
+    kwargs = {} if clock is None else {"clock": clock}
+    return ServingRequest(list(prompt), new, deadline_ms=deadline_ms,
+                          **kwargs)
+
+
+# ------------------------------------------------------------ admission
+
+
+def test_queue_admits_and_pops_fifo():
+    q = RequestQueue(capacity=4, seq_len=16)
+    a, b = _req(), _req()
+    q.submit(a)
+    q.submit(b)
+    assert len(q) == 2
+    got, expired = q.pop_ready()
+    assert got is a and not expired
+    got, _ = q.pop_ready()
+    assert got is b
+    got, _ = q.pop_ready()
+    assert got is None
+
+
+def test_queue_full_rejects_resource_exhausted():
+    q = RequestQueue(capacity=2, seq_len=16)
+    q.submit(_req())
+    q.submit(_req())
+    with pytest.raises(AdmissionError) as e:
+        q.submit(_req())
+    assert e.value.code == "RESOURCE_EXHAUSTED"
+    # backpressure frees as the scheduler pops
+    q.pop_ready()
+    q.submit(_req())  # admitted again
+
+
+def test_queue_validates_budget_and_args():
+    q = RequestQueue(capacity=4, seq_len=16)
+    with pytest.raises(AdmissionError) as e:
+        q.submit(_req(prompt=[], new=4))
+    assert e.value.code == "INVALID_ARGUMENT"
+    with pytest.raises(AdmissionError) as e:
+        q.submit(_req(new=0))
+    assert e.value.code == "INVALID_ARGUMENT"
+    # prompt + new must fit the model's cache
+    with pytest.raises(AdmissionError) as e:
+        q.submit(_req(prompt=list(range(10)), new=7))
+    assert e.value.code == "INVALID_ARGUMENT"
+    q.submit(_req(prompt=list(range(10)), new=6))  # == seq_len fits
+
+
+def test_queue_deadline_expiry_at_admission_and_in_queue():
+    clock = FakeClock()
+    q = RequestQueue(capacity=4, seq_len=16, clock=clock)
+    # expired before admission -> DEADLINE_EXCEEDED, never queued
+    stale = _req(deadline_ms=50, clock=clock)
+    clock.t += 1.0
+    with pytest.raises(AdmissionError) as e:
+        q.submit(stale)
+    assert e.value.code == "DEADLINE_EXCEEDED"
+    assert len(q) == 0
+    # expires while queued -> surfaced by pop_ready as expired, the
+    # next live request is returned
+    doomed = _req(deadline_ms=100, clock=clock)
+    q.submit(doomed)
+    live = _req(deadline_ms=0, clock=clock)
+    q.submit(live)
+    clock.t += 10.0
+    got, expired = q.pop_ready()
+    assert got is live and expired == [doomed]
+
+
+def test_queue_close_rejects_backlog_and_new_submits():
+    q = RequestQueue(capacity=4, seq_len=16)
+    a = _req()
+    q.submit(a)
+    backlog = q.close()
+    assert backlog == [a] and len(q) == 0
+    with pytest.raises(AdmissionError) as e:
+        q.submit(_req())
+    assert e.value.code == "RESOURCE_EXHAUSTED"
+
+
+def test_request_event_plumbing():
+    r = _req()
+    assert r.next_event(timeout=0.01) is None  # timeout, no hang
+    r.push(("tokens", [5], 1))
+    r.push(("done", 1))
+    assert r.next_event() == ("tokens", [5], 1)
+    assert r.next_event() == ("done", 1)
+    # ids are unique across requests
+    assert _req().request_id != _req().request_id
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def test_telemetry_counters_and_snapshot():
+    clock = FakeClock()
+    t = ServingTelemetry(log_dir=None, flush_every=2, clock=clock)
+    t.count("admitted")
+    t.count("rejected", 2)
+    t.record_step(queue_depth=3, active_slots=2, step_secs=0.01,
+                  tokens_committed=2)
+    t.record_step(queue_depth=1, active_slots=4, step_secs=0.01,
+                  tokens_committed=4)
+    snap = t.snapshot()
+    assert snap["admitted"] == 1 and snap["rejected"] == 2
+    assert snap["tokens_generated"] == 6
+    assert snap["max_active_slots"] == 4
+    assert snap["steps"] == 2
+
+
+def test_telemetry_ttft_and_event_file(tmp_path):
+    clock = FakeClock()
+    t = ServingTelemetry(log_dir=str(tmp_path), flush_every=1,
+                         clock=clock)
+    r = _req(clock=clock)
+    clock.t += 0.25
+    ttft = t.record_ttft(r)
+    assert abs(ttft - 250.0) < 1e-6
+    t.record_step(queue_depth=0, active_slots=1, step_secs=0.002,
+                  tokens_committed=1)
+    t.close()
+    files = [f for f in os.listdir(str(tmp_path))
+             if f.startswith("events.out.tfevents")]
+    assert len(files) == 1
+    assert os.path.getsize(os.path.join(str(tmp_path), files[0])) > 0
+
+
+# ---------------------------------------------------------------- proto
+
+
+def test_serving_proto_round_trip():
+    req = pb.GenerateRequest(
+        prompt=[1, 2, 3], max_new_tokens=5, temperature=0.5, seed=9,
+        deadline_ms=2500,
+    )
+    req2 = pb.GenerateRequest.FromString(req.SerializeToString())
+    assert list(req2.prompt) == [1, 2, 3]
+    assert req2.max_new_tokens == 5 and req2.seed == 9
+    assert req2.deadline_ms == 2500
+    chunk = pb.TokenChunk(tokens=[7, 8], done=True, model_version=3)
+    chunk2 = pb.TokenChunk.FromString(chunk.SerializeToString())
+    assert list(chunk2.tokens) == [7, 8] and chunk2.done
+    st = pb.ServerStatusResponse(
+        queue_depth=1, active_slots=2, num_slots=4, admitted=10,
+        tokens_generated=123, uptime_secs=1.5, max_active_slots=3,
+    )
+    st2 = pb.ServerStatusResponse.FromString(st.SerializeToString())
+    assert st2.num_slots == 4 and st2.tokens_generated == 123
+    assert abs(st2.uptime_secs - 1.5) < 1e-9
+
+
+def test_serving_service_descriptor():
+    svc = pb.DESCRIPTOR.services_by_name["Serving"]
+    names = [m.name for m in svc.methods]
+    assert names == ["generate", "generate_stream", "server_status"]
+    assert svc.methods_by_name["generate_stream"].server_streaming
+    assert not svc.methods_by_name["generate"].server_streaming
+    # the hand-rolled binding table mirrors the descriptor
+    from elasticdl_tpu.proto.service import _SERVING_METHODS
+
+    assert set(_SERVING_METHODS) == set(names)
+    assert _SERVING_METHODS["generate_stream"][2] is True
+
+
+# ------------------------------------------------------ fault injection
+
+
+class _EchoServicer(object):
+    def generate(self, request, _context=None):
+        return pb.GenerateResponse(tokens=list(request.prompt))
+
+    def generate_stream(self, request, _context=None):
+        return iter([pb.TokenChunk(tokens=list(request.prompt))])
+
+    def server_status(self, request, _context=None):
+        return pb.ServerStatusResponse(num_slots=1)
+
+
+def test_fault_injection_wraps_serving_rpcs():
+    inj = FaultInjector(spec="generate:drop:1;server_status:error:1")
+    wrapped = maybe_wrap_servicer(_EchoServicer(), inj, rpcs=SERVING_RPCS)
+    req = pb.GenerateRequest(prompt=[1])
+    # first generate call is dropped (pre-handler)
+    with pytest.raises(InjectedRpcError):
+        wrapped.generate(req)
+    # second goes through
+    assert list(wrapped.generate(req).tokens) == [1]
+    # error fires AFTER the handler ran
+    with pytest.raises(InjectedRpcError):
+        wrapped.server_status(pb.ServerStatusRequest())
+    assert wrapped.server_status(pb.ServerStatusRequest()).num_slots == 1
+    assert inj.injected == {"generate": 1, "server_status": 1}
+
+
+def test_fault_injection_inactive_returns_servicer_unwrapped():
+    s = _EchoServicer()
+    assert maybe_wrap_servicer(s, None, rpcs=SERVING_RPCS) is s
