@@ -40,10 +40,13 @@ drill:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_server_kill_drill.py
 
 # Serving smoke: closed-loop load against the real continuous-batching
-# server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput)
+# server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput) —
+# dense pool A/B'd against the block-paged pool at EQUAL KV bytes
+# (kv_bytes/blocks + bytes-per-token recorded under "kv"/"paged")
 serve-smoke:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py \
-		--requests 16 --rate 32 --out BENCH_SERVING.json
+		--requests 16 --rate 32 --compare_paged --kv_block_size 4 \
+		--out BENCH_SERVING.json
 
 ci-fast: test-fast
 

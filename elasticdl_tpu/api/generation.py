@@ -270,6 +270,19 @@ def _kv_shapes_for(cache, model, b):
     return kv_shapes
 
 
+def kv_row_leaf(leaf, cache_len):
+    """THE batch-1 decode-cache leaf convention, in one place: True for
+    per-layer KV ROW buffers — `[1, kv_heads, cache_len, ...]` arrays
+    (k/v rows, and the int8 format's per-row scales) in a tree from
+    `_kv_shapes_for(cache, model, 1)`. These are the leaves the serving
+    paged pool (serving/kv_pool.py) re-shapes into block arenas; the
+    scalar position counter (and any other non-row state) is NOT a row
+    leaf and stays per-sequence."""
+    shape = getattr(leaf, "shape", None)
+    return (shape is not None and len(shape) == 4 and shape[0] == 1
+            and shape[2] == cache_len)
+
+
 def _run_prefill(model, variables, kv_shapes, tokens2d, p_len, p_pad):
     """Shared batched-prefill contract for the greedy-KV and beam-KV
     paths: zero caches, ONE prefill=True forward over the static

@@ -311,6 +311,87 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
     return out
 
 
+def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
+                           length, scale=None, window=None):
+    """Single-token decode attention over a BLOCK-PAGED KV pool.
+
+    The serving engine's paged pool (serving/kv_pool.py) stores every
+    sequence's cached keys/values as fixed-size blocks scattered through
+    one shared `[num_blocks, block_size, kv_heads, head_dim]` arena per
+    layer; a sequence's logical cache is its BLOCK TABLE — the ordered
+    block ids covering positions `[j*block_size, (j+1)*block_size)`.
+    This op attends a sequence's single new query over exactly that
+    table, streaming one block at a time through the same online-softmax
+    merge `blockwise_attention` scans with (softmax_merge /
+    softmax_finalize), so no contiguous `seq_len` stripe is ever
+    gathered or materialized: peak extra memory is ONE block per step.
+
+    q:      [b, h, d]      one query token per sequence
+    k_cur:  [b, hkv, d]    the query token's own key (attended at
+    v_cur:  [b, hkv, d]    position `length`; it is NOT in the pool yet
+                           — the engine scatters it after the step)
+    k_pool: [num_blocks, block_size, hkv, d]   shared arenas
+    v_pool: [num_blocks, block_size, hkv, d]
+    block_table: [b, m] int32, -1 padded past the allocated blocks
+    length: [b] int32  tokens already cached (positions [0, length)
+            are live; later rows of a partially-filled block are junk
+            and masked, exactly like the dense decode's `k_pos <= pos`)
+    window: sliding-window size (keys at `k_pos > length - window`).
+
+    Table entries are traced values: block churn and sequence growth
+    never recompile the consuming program. k/v may carry fewer heads
+    than q (GQA): q heads are grouped under their kv head like the
+    dense `_decode_step`, so pool reads scale with hkv. Returns
+    [b, h, d] in float32 (the dense decode path's softmax precision).
+    """
+    b, h, d = q.shape
+    hkv = k_cur.shape[1]
+    if h % hkv:
+        raise ValueError(
+            "paged decode needs num_heads %% num_kv_heads == 0, got "
+            "%d q heads / %d kv heads" % (h, hkv)
+        )
+    group = h // hkv
+    block_size = k_pool.shape[1]
+    m = block_table.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    f32 = jnp.float32
+    # group layout [b, hkv, group, d]: kv head j serves q heads
+    # [j*group, (j+1)*group) — the dense _decode_step's reshape
+    qg = (q * scale).reshape(b, hkv, group, d).astype(f32)
+    length = jnp.asarray(length, jnp.int32)
+
+    def step(carry, j):
+        o, l, mx = carry
+        bid = block_table[:, j]  # [b]; -1 = unallocated
+        safe = jnp.maximum(bid, 0)  # gather clamps; validity masks below
+        kb = k_pool[safe].astype(f32)  # [b, block_size, hkv, d]
+        vb = v_pool[safe].astype(f32)
+        # treat hkv as the head axis and the q-head group as the query
+        # axis, so softmax_merge's [b, h, q, k] contract applies as-is
+        s = jnp.einsum("bhgd,bkhd->bhgk", qg, kb)  # [b, hkv, group, bs]
+        k_pos = j * block_size + jnp.arange(block_size)[None, :]
+        valid = (k_pos < length[:, None]) & (bid >= 0)[:, None]
+        if window is not None:
+            valid = valid & (k_pos > (length - window)[:, None])
+        s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
+        return softmax_merge(o, l, mx, s, vb.transpose(0, 2, 1, 3)), None
+
+    o0 = jnp.zeros((b, hkv, group, d), f32)
+    l0 = jnp.zeros((b, hkv, group), f32)
+    m0 = jnp.full((b, hkv, group), _NEG_INF, f32)
+    (o, l, mx), _ = jax.lax.scan(step, (o0, l0, m0), jnp.arange(m))
+    # the current token attends to itself at position `length` (always
+    # inside any window >= 1) — merged as a one-key block
+    s_cur = jnp.einsum(
+        "bhgd,bhd->bhg", qg, k_cur.astype(f32)
+    )[..., None]  # [b, hkv, group, 1]
+    o, l, mx = softmax_merge(
+        o, l, mx, s_cur, v_cur.astype(f32)[:, :, None, :]
+    )
+    return softmax_finalize(o, l).reshape(b, h, d)
+
+
 def _check_window(window, lq, lk):
     """Sliding-window attention is defined for square self-attention
     only: with lq != lk a window can leave query rows with NO visible

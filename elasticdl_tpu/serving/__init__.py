@@ -8,7 +8,10 @@ the inference half — it turns the offline decode library
 * admission.py   bounded request queue with backpressure + deadlines
 * engine.py      continuous-batching decode scheduler over a fixed
                  pool of KV-cache slots (one jit step, no recompiles
-                 on membership change)
+                 on membership change); dense per-slot stripes or the
+                 block-paged pool (EDL_KV_PAGED / ServingConfig)
+* kv_pool.py     block-paged KV storage: free-list allocator, per-slot
+                 block tables, shared per-layer block arenas
 * server.py      gRPC front-end (Generate / GenerateStream /
                  ServerStatus) + the scheduler thread
 * hot_reload.py  checkpoint-dir watcher that swaps params between
@@ -23,7 +26,15 @@ from elasticdl_tpu.serving.admission import (  # noqa: F401
     RequestQueue,
     ServingRequest,
 )
-from elasticdl_tpu.serving.engine import ContinuousBatchingEngine  # noqa: F401
+from elasticdl_tpu.serving.engine import (  # noqa: F401
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
+from elasticdl_tpu.serving.kv_pool import (  # noqa: F401
+    BlockAllocator,
+    OutOfBlocks,
+    PagedKVPool,
+)
 from elasticdl_tpu.serving.server import (  # noqa: F401
     GenerationServer,
     ServingConfig,

@@ -100,13 +100,24 @@ class RequestQueue(object):
     queue when the scheduler seats them in a slot. total_budget(seq_len)
     validation happens at submit so a request that can never fit fails
     fast instead of poisoning a slot.
+
+    `max_cached_tokens` is the paged pool's never-fits bound (engine.
+    max_cached_tokens()): a request whose prompt + decode cache rows
+    exceed the WHOLE block budget is invalid at submit — it could queue
+    forever. Requests that fit the pool but not the blocks free right
+    now are a different thing entirely: they stay queued and seat when
+    completions release blocks (the `fit` predicate on pop_ready).
     """
 
-    def __init__(self, capacity, seq_len, clock=time.monotonic):
+    def __init__(self, capacity, seq_len, clock=time.monotonic,
+                 max_cached_tokens=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1, got %d" % capacity)
         self.capacity = int(capacity)
         self.seq_len = int(seq_len)
+        self.max_cached_tokens = (
+            int(max_cached_tokens) if max_cached_tokens else None
+        )
         self._clock = clock
         self._q = collections.deque()
         self._cv = threading.Condition()
@@ -151,22 +162,41 @@ class RequestQueue(object):
                 "prompt %d + max_new_tokens %d exceeds the model's "
                 "seq_len %d" % (p, request.max_new_tokens, self.seq_len),
             )
+        cached = p + request.max_new_tokens - 1
+        if (self.max_cached_tokens is not None
+                and request.max_new_tokens > 1
+                and cached > self.max_cached_tokens):
+            raise AdmissionError(
+                "INVALID_ARGUMENT",
+                "request needs %d KV rows > the pool's total budget of "
+                "%d tokens" % (cached, self.max_cached_tokens),
+            )
         if request.expired(self._clock()):
             raise AdmissionError(
                 "DEADLINE_EXCEEDED", "deadline expired before admission"
             )
 
-    def pop_ready(self):
+    def pop_ready(self, fit=None):
         """Next admissible request, expiring stale ones on the way out.
-        Returns (request, expired_list); request is None when empty."""
+        Returns (request, expired_list); request is None when empty.
+
+        `fit` (optional predicate): the engine's can_seat — when the
+        head-of-line request cannot seat RIGHT NOW (paged pool out of
+        blocks), it STAYS at the head and pop returns None. FIFO order
+        is preserved deliberately: skipping ahead to smaller requests
+        would starve long ones under sustained short-request load."""
         expired = []
         now = self._clock()
         with self._cv:
             while self._q:
-                req = self._q.popleft()
+                req = self._q[0]
                 if req.expired(now):
+                    self._q.popleft()
                     expired.append(req)
                     continue
+                if fit is not None and not fit(req):
+                    return None, expired
+                self._q.popleft()
                 return req, expired
         return None, expired
 

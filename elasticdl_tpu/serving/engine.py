@@ -33,7 +33,30 @@ the serving tests lock against the offline path.
 Single-threaded by design: only the scheduler thread may call
 insert/step/set_params (jax computations stay serialized; the gRPC
 threads touch only the admission queue and event plumbing).
+
+Two pool layouts share this scheduler surface:
+
+* ContinuousBatchingEngine — the DENSE pool: every slot owns a
+  contiguous `seq_len` KV stripe per layer. Simple, but decode HBM
+  scales as `num_slots x seq_len` no matter how short requests run.
+* PagedContinuousBatchingEngine — the BLOCK-PAGED pool
+  (serving/kv_pool.py): KV rows live in shared block arenas, slots
+  hold block tables, and admission works against a token/block budget
+  so short requests pack densely. Token streams are identical to the
+  dense engine (the parity the e2e tests lock); only the memory
+  geometry differs. Select with ServingConfig.kv_paged / EDL_KV_PAGED.
+
+Weight-only int8 params (api/quantization): by default the engine
+dequantizes ONCE per set_params (initial load and every hot reload)
+and serves the cached float weights — a single-token decode step that
+re-dequantized the full weight set every step dominated the step on
+the latency-bound path (the decode_kv_int8 bench regression).
+EDL_SERVING_FUSED_DEQUANT=1 restores in-jit dequantize (int8 weights
+stream HBM->VMEM per step — the right trade when weights dwarf VMEM
+and HBM bandwidth, not latency, bounds the step).
 """
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +71,17 @@ from elasticdl_tpu.api.generation import (
     serving_next_token,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+def kv_paged_default():
+    """EDL_KV_PAGED resolves the pool layout when the config leaves it
+    unset — the env toggle the drills/CI use to prove both modes."""
+    return os.environ.get("EDL_KV_PAGED", "") not in ("", "0")
+
+
+def _fused_dequant():
+    return os.environ.get(
+        "EDL_SERVING_FUSED_DEQUANT", "") not in ("", "0")
 
 
 class _Slot(object):
@@ -82,6 +116,10 @@ class ContinuousBatchingEngine(object):
         from elasticdl_tpu.api.quantization import is_quantized
 
         self._qz = is_quantized(state.params)
+        # in-jit dequantize is opt-in (see the module docstring); the
+        # default path serves float weights cached by set_params
+        self._exec_qz = self._qz and _fused_dequant()
+        self._dequant_fn = None
         self.set_params(state, version=getattr(state, "version", 0))
 
         # batch-1 cache template -> pooled leaves [S, ...]; shares the
@@ -89,10 +127,7 @@ class ContinuousBatchingEngine(object):
         from elasticdl_tpu.api.generation import _decode_cache
 
         self._kv_shapes = _kv_shapes_for(_decode_cache(trainer), model, 1)
-        self._pool = jax.tree.map(
-            lambda sh: jnp.zeros((self.num_slots,) + sh.shape, sh.dtype),
-            self._kv_shapes,
-        )
+        self._init_pool()
         self._slots = [None] * self.num_slots  # _Slot or None
         self._last_tokens = np.zeros(self.num_slots, np.int32)
         self._seeds = np.zeros(self.num_slots, np.int32)
@@ -101,6 +136,21 @@ class ContinuousBatchingEngine(object):
         self._step_fn = None
         self._write_fn = None
 
+    def _init_pool(self):
+        from elasticdl_tpu.api.generation import kv_row_leaf
+
+        self._pool = jax.tree.map(
+            lambda sh: jnp.zeros((self.num_slots,) + sh.shape, sh.dtype),
+            self._kv_shapes,
+        )
+        # KV ROW bytes only (the position counters are noise and would
+        # break the paged pool's equal-bytes comparison)
+        self._kv_bytes_total = self.num_slots * int(sum(
+            int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(self._kv_shapes)
+            if kv_row_leaf(leaf, self.seq_len)
+        ))
+
     # ------------------------------------------------------------ params
 
     def set_params(self, state, version):
@@ -108,7 +158,12 @@ class ContinuousBatchingEngine(object):
         steps (scheduler thread), so in-flight sequences simply continue
         on the new weights — their KV caches, positions and pending
         tokens are untouched. Shapes/dtypes must match the compiled
-        executables; a changed architecture needs a new server."""
+        executables; a changed architecture needs a new server.
+
+        With int8 params (and the default non-fused path) this is also
+        the ONE place the weights dequantize: the cached float tree in
+        `_exec_variables` serves every prefill/decode step until the
+        next reload invalidates it here."""
         self.variables = {"params": state.params, **state.model_state}
         from elasticdl_tpu.api.quantization import is_quantized
 
@@ -119,6 +174,21 @@ class ContinuousBatchingEngine(object):
                 "executables bake the dequantize path)"
             )
         self.model_version = int(version)
+        if self._qz and not self._exec_qz:
+            if self._dequant_fn is None:
+                from elasticdl_tpu.api.quantization import (
+                    dequantize_params,
+                )
+
+                self._dequant_fn = jax.jit(
+                    lambda v: dict(
+                        v, params=dequantize_params(v["params"])
+                    )
+                )
+            with self.trainer.mesh:
+                self._exec_variables = self._dequant_fn(self.variables)
+        else:
+            self._exec_variables = self.variables
 
     # ------------------------------------------------------------- slots
 
@@ -130,6 +200,33 @@ class ContinuousBatchingEngine(object):
 
     def active_requests(self):
         return [s.request for s in self._slots if s is not None]
+
+    def can_seat(self, request):
+        """Whether `request` can be seated RIGHT NOW beyond needing a
+        free slot (the scheduler checks slots separately). The dense
+        pool has no other resource; the paged pool answers from its
+        block budget."""
+        return True
+
+    def max_cached_tokens(self):
+        """Largest prompt+decode cache footprint a request may ever
+        need — the admission queue's never-fits bound."""
+        return self.seq_len
+
+    def kv_stats(self):
+        """KV memory accounting for telemetry / ServerStatus. The
+        dense pool's total is resident whether slots are active or
+        not — exactly the pressure the paged pool relieves; in_use
+        reports the stripes live requests actually pin."""
+        per_slot = self._kv_bytes_total // max(1, self.num_slots)
+        return {
+            "kv_paged": False,
+            "kv_block_size": 0,
+            "kv_blocks_total": 0,
+            "kv_blocks_free": 0,
+            "kv_bytes_total": self._kv_bytes_total,
+            "kv_bytes_in_use": self.active_count() * per_slot,
+        }
 
     def insert(self, request):
         """Seat `request` in a free slot: one prefill forward fills the
@@ -157,7 +254,7 @@ class ContinuousBatchingEngine(object):
         buf[0, :p] = request.prompt
         with self.trainer.mesh:
             kv, first = fn(
-                self.variables, jnp.asarray(buf),
+                self._exec_variables, jnp.asarray(buf),
                 jnp.asarray(p, jnp.int32),
                 jnp.asarray(request.seed, jnp.int32),
                 jnp.asarray(request.temperature, jnp.float32),
@@ -182,11 +279,12 @@ class ContinuousBatchingEngine(object):
     def evict_expired(self, now):
         """Evict every active request whose deadline has passed;
         returns the evicted requests (the scheduler fails them with
-        DEADLINE_EXCEEDED — partial tokens already streamed stand)."""
+        DEADLINE_EXCEEDED — partial tokens already streamed stand).
+        Routed through evict() so the paged pool reclaims blocks."""
         out = []
         for i, st in enumerate(self._slots):
             if st is not None and st.request.expired(now):
-                self._slots[i] = None
+                self.evict(i)
                 out.append(st.request)
         return out
 
@@ -205,7 +303,7 @@ class ContinuousBatchingEngine(object):
             self._step_fn = self._build_step()
         with self.trainer.mesh:
             self._pool, nxt = self._step_fn(
-                self.variables, self._pool,
+                self._exec_variables, self._pool,
                 jnp.asarray(self._last_tokens),
                 jnp.asarray(self._seeds),
                 jnp.asarray(self._temps),
@@ -230,7 +328,7 @@ class ContinuousBatchingEngine(object):
 
     def _build_prefill(self, p_pad):
         model, kv_shapes = self.model, self._kv_shapes
-        top_k, top_p, qz = self.top_k, self.top_p, self._qz
+        top_k, top_p, qz = self.top_k, self.top_p, self._exec_qz
 
         def prefill(variables, buf, p_len, seed, temperature):
             variables = _maybe_dequantize(variables, qz)
@@ -247,7 +345,7 @@ class ContinuousBatchingEngine(object):
 
     def _build_step(self):
         model = self.model
-        top_k, top_p, qz = self.top_k, self.top_p, self._qz
+        top_k, top_p, qz = self.top_k, self.top_p, self._exec_qz
 
         def step(variables, pool, last_tokens, seeds, temps):
             variables = _maybe_dequantize(variables, qz)
@@ -291,3 +389,244 @@ class ContinuousBatchingEngine(object):
         return self._write_fn(
             self._pool, kv, jnp.asarray(slot, jnp.int32)
         )
+
+
+class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
+    """The decode pool over BLOCK-PAGED KV storage (serving/kv_pool.py).
+
+    Same scheduler surface and token streams as the dense engine; the
+    differences are all memory geometry:
+
+    * per-layer KV rows live in shared `[num_blocks, block_size, hkv,
+      d]` arenas — total KV HBM is the BLOCK BUDGET, decoupled from
+      `num_slots x seq_len`, so more concurrent slots fit in the same
+      bytes when requests run short of `seq_len`;
+    * insert = the SAME batched prefill, then block-granular writes of
+      the prompt's blocks into blocks allocated from the free list
+      (never a whole-slot copy), with the request's full token budget
+      RESERVED so decode growth cannot strand mid-flight;
+    * the single jit-compiled vmapped step carries each slot's block
+      table and position as DEVICE arrays: churn, growth and table
+      contents never recompile. Attention streams the table
+      (ops.paged_decode_attention); the new token's k/v rows come back
+      sown through "kv_out" and scatter into the arenas — free lanes
+      carry an out-of-bounds block id and drop;
+    * evict returns the slot's blocks to the free list, O(1) per
+      block — copy-free slot churn.
+
+    can_seat() answers from the allocator, turning out-of-blocks into
+    admission-queue backpressure instead of a crash. Requires the
+    model's paged-decode convention (TransformerLM: `paged` kwarg +
+    "kv_out" sowing) and the plain-dtype KV format.
+    """
+
+    def __init__(self, trainer, state, num_slots, top_k=0, top_p=1.0,
+                 block_size=16, num_blocks=0):
+        import inspect
+
+        model = trainer.model
+        if "paged" not in inspect.signature(
+                type(model).__call__).parameters:
+            raise ValueError(
+                "model %r lacks the paged-decode convention (`paged` "
+                "kwarg); serve it with the dense engine"
+                % type(model).__name__
+            )
+        if getattr(model, "kv_cache_dtype", ""):
+            raise ValueError(
+                "paged KV supports the plain-dtype cache format only "
+                "(kv_cache_dtype=%r)"
+                % (getattr(model, "kv_cache_dtype", ""),)
+            )
+        self.block_size = int(block_size)
+        # 0 = dense-equivalent budget: the same KV bytes the dense
+        # pool would pin for this slot count
+        self.num_blocks = int(num_blocks) or (
+            int(num_slots) * -(-int(model.seq_len) // self.block_size)
+        )
+        super().__init__(trainer, state, num_slots, top_k=top_k,
+                         top_p=top_p)
+        self._positions = np.zeros(self.num_slots, np.int32)
+
+    def _init_pool(self):
+        from elasticdl_tpu.serving.kv_pool import PagedKVPool
+
+        self.kv = PagedKVPool(
+            self._kv_shapes, self.seq_len, self.num_slots,
+            self.num_blocks, self.block_size,
+        )
+        self._kv_bytes_total = self.kv.bytes_total
+
+    # ------------------------------------------------------------- slots
+
+    def can_seat(self, request):
+        if request.max_new_tokens <= 1:
+            return True  # prefill-only; never touches the pool
+        cached = len(request.prompt) + request.max_new_tokens - 1
+        return self.kv.allocator.can_fit(cached)
+
+    def max_cached_tokens(self):
+        # a request must fit BOTH one slot's table and the whole pool
+        return min(self.seq_len, self.num_blocks * self.block_size)
+
+    def kv_stats(self):
+        return self.kv.stats()
+
+    def insert(self, request):
+        """Dense-engine contract (prefill + first token), with the KV
+        landing in allocated blocks: the allocator reserves the FULL
+        cache budget (prompt + max_new_tokens - 1 rows) up front —
+        raising OutOfBlocks before any compute — so a seated request
+        can always extend to completion. A one-token request skips the
+        pool entirely (nothing will ever read its rows)."""
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slot")
+        slot = free[0]
+        p = len(request.prompt)
+        total = p + request.max_new_tokens
+        if total > self.seq_len:
+            raise ValueError(
+                "request needs %d positions > seq_len %d"
+                % (total, self.seq_len)
+            )
+        decoding = request.max_new_tokens > 1
+        if decoding:
+            # reserve-or-raise BEFORE the prefill runs; the scheduler
+            # checks can_seat first, so raising here is a bug guard
+            self.kv.seat(slot, p, p + request.max_new_tokens - 1)
+        p_pad = _prefill_bucket(p, self.seq_len)
+        fn = self._prefill_fns.get(p_pad)
+        if fn is None:
+            fn = self._build_prefill(p_pad)
+            self._prefill_fns[p_pad] = fn
+        buf = np.zeros((1, self.seq_len), np.int32)
+        buf[0, :p] = request.prompt
+        with self.trainer.mesh:
+            kv, first = fn(
+                self._exec_variables, jnp.asarray(buf),
+                jnp.asarray(p, jnp.int32),
+                jnp.asarray(request.seed, jnp.int32),
+                jnp.asarray(request.temperature, jnp.float32),
+            )
+            if decoding:
+                self.kv.write_prompt(kv, slot, p)
+        first = int(first)
+        request.generated.append(first)
+        request.model_version = self.model_version
+        if not decoding:
+            return slot, first, True
+        self._slots[slot] = _Slot(request, total)
+        self._positions[slot] = p
+        self._last_tokens[slot] = first
+        self._seeds[slot] = request.seed
+        self._temps[slot] = request.temperature
+        return slot, first, False
+
+    def evict(self, slot):
+        """Free the slot AND return its blocks to the free list; the
+        rows are dead the moment the table forgets them (copy-free
+        churn — nothing is zeroed or moved)."""
+        self._slots[slot] = None
+        self._positions[slot] = 0
+        self.kv.release(slot)
+
+    def step(self):
+        """One vmapped decode step over the whole pool, paged: block
+        tables and positions enter as device arrays, each active slot
+        attends over its own table and its row scatters into its own
+        block. Free lanes ride along masked (stale tokens, all-(-1)
+        tables, out-of-bounds scatter ids) — the dense engine's
+        static-shape contract, kept."""
+        active = [
+            (i, s) for i, s in enumerate(self._slots) if s is not None
+        ]
+        if not active:
+            return []
+        for i, _st in active:
+            # the block this step writes (position = the slot's pos);
+            # drawn from the slot's reservation, so it cannot fail
+            self.kv.ensure_block(i, int(self._positions[i]))
+        if self._step_fn is None:
+            self._step_fn = self._build_paged_step()
+        with self.trainer.mesh:
+            self.kv.pools, nxt = self._step_fn(
+                self._exec_variables, self.kv.pools,
+                jnp.asarray(self.kv.tables),
+                jnp.asarray(self._positions),
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(self._seeds),
+                jnp.asarray(self._temps),
+            )
+            nxt = np.asarray(nxt)
+        out = []
+        for slot, st in active:
+            self._positions[slot] += 1
+            token = int(nxt[slot])
+            st.request.generated.append(token)
+            st.request.model_version = self.model_version
+            self._last_tokens[slot] = token
+            finished = (
+                len(st.request.prompt) + len(st.request.generated)
+                >= st.max_total
+            )
+            if finished:
+                self.evict(slot)
+            out.append((slot, st.request, token, finished))
+        return out
+
+    # ------------------------------------------------------- compiled fns
+
+    def _build_paged_step(self):
+        from elasticdl_tpu.serving.kv_pool import scatter_rows
+
+        model = self.model
+        top_k, top_p, qz = self.top_k, self.top_p, self._exec_qz
+        block_size, num_blocks = self.block_size, self.num_blocks
+
+        def step(variables, pools, tables, positions, last_tokens,
+                 seeds, temps):
+            variables = _maybe_dequantize(variables, qz)
+
+            def one(table, pos, tok, seed, temp):
+                # pre-advance counter semantics match the dense step:
+                # this token's k/v rows belong at `pos`, the sampled
+                # token lands at pos + 1. The cache collection carries
+                # ONLY the counter — the rows live in the shared
+                # arenas, read through this slot's table and written
+                # back via the sown "kv_out" rows.
+                logits, aux = model.apply(
+                    dict(variables, cache={"pos": pos}),
+                    {"tokens": tok[None, None]},
+                    training=False, decode=True,
+                    mutable=["cache", "kv_out"],
+                    paged={"pools": pools, "table": table[None]},
+                )
+                nxt = serving_next_token(
+                    logits[0, 0], seed, pos + 1, temp, top_k, top_p
+                )
+                rows = jax.tree.map(
+                    lambda t: t[0][0, :, 0, :], aux["kv_out"],
+                    is_leaf=lambda x: isinstance(x, tuple),
+                )  # sown [1, hkv, 1, d] -> [hkv, d]
+                return nxt, rows
+
+            nxt, rows = jax.vmap(one)(
+                tables, positions, last_tokens, seeds, temps
+            )
+            bids = jnp.take_along_axis(
+                tables, (positions // block_size)[:, None], axis=1
+            )[:, 0]
+            # free lanes (table row -1): point past the arena so the
+            # scatter's mode="drop" discards them
+            bids = jnp.where(bids < 0, num_blocks, bids)
+            pools = scatter_rows(pools, rows, bids,
+                                 positions % block_size)
+            return pools, nxt
+
+        logger.info(
+            "serving: compiling paged decode step for %d slots over "
+            "%d x %d-token blocks", self.num_slots, self.num_blocks,
+            self.block_size,
+        )
+        return jax.jit(step)

@@ -1,0 +1,316 @@
+"""Block-paged KV storage for the serving engine.
+
+The dense decode pool (engine.py) gives every slot a contiguous
+`seq_len` stripe of cache per layer, so decode HBM scales as
+`num_slots x seq_len` even though most requests finish far short of
+`seq_len` — the padding is resident, bandwidth-neutral, and
+unsellable. This module converts that padding into admissible work:
+
+* KV rows live in per-layer block ARENAS shaped
+  `[num_blocks, block_size, kv_heads, head_dim]`, shared by every
+  sequence on the server;
+* a sequence's logical cache is its BLOCK TABLE — the ordered block
+  ids covering positions `[j*block_size, (j+1)*block_size)`;
+* `BlockAllocator` is the host-side free-list: alloc/extend/free are
+  O(1) per block, and a RESERVATION ledger guarantees that a seated
+  request can always extend to its full token budget — out-of-blocks
+  is an admission-time condition (backpressure), never a mid-decode
+  crash;
+* `PagedKVPool` owns the device arenas and the two write paths: the
+  block-granular prompt insertion (one `dynamic_update_slice` per
+  block, never a whole-slot copy) and the per-step decode-row scatter
+  (`.at[bids, offs].set`, one row per active slot, free lanes dropped
+  via an out-of-bounds sentinel).
+
+Block ids enter the compiled decode step as DEVICE arrays (the tables),
+so slot churn and sequence growth never recompile anything — the same
+zero-recompile contract the dense pool holds, at block granularity.
+The attention that consumes this layout is
+`ops.attention.paged_decode_attention`.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.api.generation import kv_row_leaf
+
+
+class OutOfBlocks(Exception):
+    """The pool cannot cover a request's block budget right now. The
+    scheduler treats this as backpressure: the request stays queued
+    until completions free blocks (admission rejects outright only
+    requests that could NEVER fit)."""
+
+
+def blocks_for(tokens, block_size):
+    """Blocks covering `tokens` cache rows (0 tokens -> 0 blocks)."""
+    return -(-int(tokens) // int(block_size))
+
+
+class BlockAllocator(object):
+    """Host-side block accounting: LIFO free list, per-slot block
+    tables, and a reservation ledger.
+
+    `alloc(slot, tokens, commit_tokens)` materializes the blocks for
+    `tokens` rows and RESERVES (without materializing) enough blocks
+    for `commit_tokens` total; `extend` then draws the growth blocks
+    from that reservation, so a request admitted under its full budget
+    can never strand mid-decode waiting for a block another request
+    holds. `available()` is what admission may promise to NEW work.
+    Every operation is O(blocks touched); steady-state slot churn is
+    O(1) per block."""
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 1:
+            raise ValueError(
+                "num_blocks must be >= 1, got %d" % num_blocks)
+        if block_size < 1:
+            raise ValueError(
+                "block_size must be >= 1, got %d" % block_size)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO: the most recently freed block is reused first (warm
+        # reuse; also what the reuse-order tests lock)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._tables = {}     # slot -> [block ids]
+        self._committed = {}  # slot -> total blocks promised
+        self._reserved = 0    # promised-but-unmaterialized, all slots
+
+    # ------------------------------------------------------------ queries
+
+    def num_free(self):
+        return len(self._free)
+
+    def blocks_in_use(self):
+        return self.num_blocks - len(self._free)
+
+    def available(self):
+        """Blocks admission may promise to NEW work: free minus the
+        reservations already promised to seated slots."""
+        return len(self._free) - self._reserved
+
+    def can_fit(self, tokens):
+        return blocks_for(tokens, self.block_size) <= self.available()
+
+    def table(self, slot):
+        return list(self._tables.get(slot, ()))
+
+    # ------------------------------------------------------------- churn
+
+    def alloc(self, slot, tokens, commit_tokens=None):
+        """Materialize blocks for `tokens` rows under `slot` and
+        reserve up to `commit_tokens` total; raises OutOfBlocks when
+        the full commitment is not coverable (nothing is taken then)."""
+        if slot in self._tables:
+            raise ValueError("slot %r already holds blocks" % (slot,))
+        now = blocks_for(tokens, self.block_size)
+        commit = max(
+            now, blocks_for(commit_tokens or tokens, self.block_size)
+        )
+        if commit > self.available():
+            raise OutOfBlocks(
+                "need %d blocks (%d now), %d available"
+                % (commit, now, self.available())
+            )
+        self._tables[slot] = [self._free.pop() for _ in range(now)]
+        self._committed[slot] = commit
+        self._reserved += commit - now
+        return self.table(slot)
+
+    def extend(self, slot, total_tokens):
+        """Grow `slot`'s table to cover `total_tokens` rows; growth
+        inside the slot's commitment draws reserved blocks (never
+        fails), growth beyond it competes with admission and can raise
+        OutOfBlocks. Returns the appended block ids."""
+        table = self._tables.get(slot)
+        if table is None:
+            raise ValueError("slot %r holds no blocks" % (slot,))
+        need = blocks_for(total_tokens, self.block_size) - len(table)
+        added = []
+        for _ in range(max(0, need)):
+            if len(table) < self._committed[slot]:
+                self._reserved -= 1  # drawing our own reservation
+            elif self.available() < 1:
+                raise OutOfBlocks(
+                    "slot %r grew past its commitment and no block is "
+                    "available" % (slot,)
+                )
+            else:
+                self._committed[slot] += 1
+            bid = self._free.pop()
+            table.append(bid)
+            added.append(bid)
+        return added
+
+    def free(self, slot):
+        """Release `slot`'s blocks and its remaining reservation;
+        returns how many blocks went back on the free list. Safe to
+        call for a slot that holds nothing (0)."""
+        table = self._tables.pop(slot, None)
+        if table is None:
+            return 0
+        self._reserved -= self._committed.pop(slot) - len(table)
+        # pushed in table order so the block allocated LAST sits on top
+        # of the stack and is reused first (LIFO through the whole
+        # alloc -> free -> alloc cycle)
+        self._free.extend(table)
+        return len(table)
+
+
+def build_pools(kv_shapes, cache_len, num_blocks, block_size):
+    """Device arenas from the model's batch-1 decode-cache template
+    (api/generation._kv_shapes_for): every KV row leaf
+    `[1, hkv, cache_len, d]` becomes `[num_blocks, block_size, hkv, d]`
+    zeros; non-row leaves (the position counter) stay as zero-d
+    placeholders so the pool tree keeps the cache tree's structure —
+    the model slices its own layer's arenas out of it by name."""
+    def arena(leaf):
+        if kv_row_leaf(leaf, cache_len):
+            _, hkv, _, d = leaf.shape
+            return jnp.zeros((num_blocks, block_size, hkv, d),
+                             leaf.dtype)
+        return jnp.zeros(leaf.shape, leaf.dtype)
+
+    return jax.tree.map(arena, kv_shapes)
+
+
+def write_prompt_block(pools, kv, j, bid, block_size):
+    """Insert block `j` of a freshly prefilled batch-1 cache tree into
+    the arenas at block id `bid` — ONE `dynamic_update_slice` per row
+    leaf at a TRACED (j, bid), so one compiled write serves every
+    (prompt bucket, block, slot) combination. Rows past the true
+    prompt length inside the last block are prefill junk; the paged
+    attention masks `k_pos < length` so they are never read before the
+    decode scatter overwrites them."""
+    def upd(pool, leaf):
+        if leaf.ndim != 4:  # the position counter placeholder
+            return pool
+        rows = jax.lax.dynamic_slice_in_dim(
+            leaf[0], j * block_size, block_size, axis=1
+        )  # [hkv, block_size, d]
+        rows = rows.transpose(1, 0, 2)  # [block_size, hkv, d]
+        return jax.lax.dynamic_update_slice(
+            pool, rows[None], (bid, 0, 0, 0)
+        )
+
+    return jax.tree.map(upd, pools, kv)
+
+
+def scatter_rows(pools, rows, bids, offs):
+    """Write one decode row per slot into the arenas: `rows` is a tree
+    whose structure is a SUBSET of `pools` (the model's "kv_out" sown
+    collection) with leaves `[S, hkv, d]`; `bids`/`offs` are `[S]`
+    block ids and in-block offsets. Free lanes carry an out-of-bounds
+    bid and are dropped by the scatter — they never touch a block a
+    live sequence owns. Distinct live slots own distinct blocks, so
+    the scatter indices never collide."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(pools)
+    rmap = {
+        jax.tree_util.keystr(p): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(rows)[0]
+    }
+    out = []
+    for path, pool in flat:
+        row = rmap.get(jax.tree_util.keystr(path))
+        if row is None:
+            out.append(pool)
+        else:
+            out.append(pool.at[bids, offs].set(row, mode="drop"))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class PagedKVPool(object):
+    """The device arenas + host tables for one serving engine.
+
+    Owns the BlockAllocator, the `[num_slots, seq_len/block_size]`
+    int32 table mirror the compiled step consumes (-1 = unallocated),
+    and the jitted block write. `cache_len % block_size == 0` is
+    required so prompt blocks slice cleanly out of the prefill cache."""
+
+    def __init__(self, kv_shapes, cache_len, num_slots, num_blocks,
+                 block_size):
+        cache_len = int(cache_len)
+        block_size = int(block_size)
+        if cache_len % block_size:
+            raise ValueError(
+                "seq_len %d must be a multiple of kv_block_size %d"
+                % (cache_len, block_size)
+            )
+        self.cache_len = cache_len
+        self.block_size = block_size
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_slot = cache_len // block_size
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.pools = build_pools(kv_shapes, cache_len, num_blocks,
+                                 block_size)
+        self.tables = np.full(
+            (int(num_slots), self.max_blocks_per_slot), -1, np.int32
+        )
+        row_bytes = [
+            leaf.nbytes for leaf in jax.tree.leaves(self.pools)
+            if leaf.ndim == 4
+        ]
+        self.bytes_total = int(sum(row_bytes))
+        self.block_bytes = self.bytes_total // max(1, self.num_blocks)
+        self._write_fn = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def seat(self, slot, prompt_tokens, commit_tokens):
+        """Reserve the request's full block budget and materialize the
+        prompt's blocks; raises OutOfBlocks with nothing taken."""
+        self.allocator.alloc(slot, prompt_tokens,
+                             commit_tokens=commit_tokens)
+        self._sync_row(slot)
+
+    def write_prompt(self, kv, slot, prompt_tokens):
+        """Scatter the prefilled cache's first ceil(p/bs) blocks into
+        the slot's allocated blocks — block-granular, no whole-slot
+        copy."""
+        if self._write_fn is None:
+            self._write_fn = jax.jit(
+                write_prompt_block, static_argnames=("block_size",)
+            )
+        table = self.allocator.table(slot)
+        for j in range(blocks_for(prompt_tokens, self.block_size)):
+            self.pools = self._write_fn(
+                self.pools, kv, jnp.asarray(j, jnp.int32),
+                jnp.asarray(table[j], jnp.int32),
+                block_size=self.block_size,
+            )
+
+    def ensure_block(self, slot, pos):
+        """Make sure the block covering cache position `pos` exists
+        (the decode step writes there this iteration); draws the
+        slot's reservation, so it cannot fail for a seated request."""
+        self.allocator.extend(slot, pos + 1)
+        self._sync_row(slot)
+
+    def release(self, slot):
+        """Reclaim a finished/evicted slot's blocks (O(1) per block);
+        the rows are dead the moment the table forgets them."""
+        freed = self.allocator.free(slot)
+        self.tables[slot, :] = -1
+        return freed
+
+    def _sync_row(self, slot):
+        table = self.allocator.table(slot)
+        row = np.full(self.max_blocks_per_slot, -1, np.int32)
+        row[: len(table)] = table
+        self.tables[slot] = row
+
+    # ------------------------------------------------------------- stats
+
+    def bytes_in_use(self):
+        return self.allocator.blocks_in_use() * self.block_bytes
+
+    def stats(self):
+        return {
+            "kv_paged": True,
+            "kv_block_size": self.block_size,
+            "kv_blocks_total": self.num_blocks,
+            "kv_blocks_free": self.allocator.num_free(),
+            "kv_bytes_total": self.bytes_total,
+            "kv_bytes_in_use": self.bytes_in_use(),
+        }

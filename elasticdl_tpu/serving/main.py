@@ -41,6 +41,13 @@ def parse_serving_args(args=None):
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--reload_poll_secs", type=float, default=2.0)
     parser.add_argument("--tensorboard_log_dir", default="")
+    # KV pool layout: -1 resolves from EDL_KV_PAGED (the drill/CI
+    # toggle); 1 = block-paged pool (serving/kv_pool.py), 0 = dense
+    parser.add_argument("--kv_paged", type=int, default=-1,
+                        choices=(-1, 0, 1))
+    parser.add_argument("--kv_block_size", type=int, default=16)
+    parser.add_argument("--kv_num_blocks", type=int, default=0,
+                        help="block budget; 0 = dense-equivalent bytes")
     return parser.parse_args(args)
 
 
@@ -87,6 +94,9 @@ def build_server(args):
             reload_poll_secs=args.reload_poll_secs,
             telemetry_dir=args.tensorboard_log_dir,
             port=args.port,
+            kv_paged=None if args.kv_paged < 0 else bool(args.kv_paged),
+            kv_block_size=args.kv_block_size,
+            kv_num_blocks=args.kv_num_blocks,
         ),
     )
     server.engine.model_version = version

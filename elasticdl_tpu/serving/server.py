@@ -39,7 +39,11 @@ from elasticdl_tpu.serving.admission import (
     RequestQueue,
     ServingRequest,
 )
-from elasticdl_tpu.serving.engine import ContinuousBatchingEngine
+from elasticdl_tpu.serving.engine import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+    kv_paged_default,
+)
 from elasticdl_tpu.serving.hot_reload import CheckpointWatcher
 from elasticdl_tpu.serving.telemetry import ServingTelemetry
 
@@ -48,13 +52,21 @@ class ServingConfig(object):
     """Server knobs. num_slots sizes the decode pool (the compiled step);
     queue_capacity bounds the admitted backlog (backpressure beyond it);
     top_k/top_p are static server-level sampling filters (per-request
-    temperature/seed select greedy vs sampling)."""
+    temperature/seed select greedy vs sampling).
+
+    KV layout: kv_paged=None resolves from EDL_KV_PAGED (the drills'
+    env toggle). Paged mode stores KV rows in kv_num_blocks blocks of
+    kv_block_size tokens (0 blocks = the dense-equivalent budget for
+    num_slots); with a fixed block budget, num_slots can then be raised
+    beyond what the same bytes would buy dense slots — short requests
+    pack densely instead of pinning `seq_len` stripes."""
 
     def __init__(self, num_slots=4, queue_capacity=64, top_k=0,
                  top_p=1.0, checkpoint_dir="", reload_poll_secs=2.0,
                  telemetry_dir="", telemetry_flush_every=50,
                  idle_wait_secs=0.05, handler_poll_secs=0.25,
-                 port=0, max_workers=64):
+                 port=0, max_workers=64, kv_paged=None,
+                 kv_block_size=16, kv_num_blocks=0):
         self.num_slots = int(num_slots)
         self.queue_capacity = int(queue_capacity)
         self.top_k = int(top_k)
@@ -67,6 +79,11 @@ class ServingConfig(object):
         self.handler_poll_secs = float(handler_poll_secs)
         self.port = int(port)
         self.max_workers = int(max_workers)
+        self.kv_paged = (
+            kv_paged_default() if kv_paged is None else bool(kv_paged)
+        )
+        self.kv_block_size = int(kv_block_size)
+        self.kv_num_blocks = int(kv_num_blocks)
 
 
 class _Scheduler(threading.Thread):
@@ -122,15 +139,22 @@ class _Scheduler(threading.Thread):
                 if finished:
                     self.telemetry.count("completed")
                     req.push(("done", req.model_version))
+            kv = self.engine.kv_stats()
             self.telemetry.record_step(
-                len(self.queue), len(results), dt, len(results)
+                len(self.queue), len(results), dt, len(results),
+                kv_bytes_in_use=kv["kv_bytes_in_use"],
+                kv_blocks_free=kv["kv_blocks_free"],
             )
         else:
             self.queue.wait_for_work(self.idle_wait_secs)
 
     def _fill_slots(self):
         while self.engine.free_slots():
-            req, expired = self.queue.pop_ready()
+            # the fit predicate is the paged pool's block budget: a
+            # head-of-line request that cannot seat yet stays queued
+            # (backpressure), and completions free the blocks it waits
+            # for — out-of-blocks is never an insert-time crash
+            req, expired = self.queue.pop_ready(fit=self.engine.can_seat)
             for e in expired:
                 self.telemetry.count("expired")
                 e.push(("error", "DEADLINE_EXCEEDED",
@@ -226,6 +250,7 @@ class ServingServicer(object):
 
     def server_status(self, request, context=None):
         snap = self._telemetry.snapshot()
+        kv = self._engine.kv_stats()
         return pb.ServerStatusResponse(
             queue_depth=len(self._queue),
             active_slots=self._engine.active_count(),
@@ -239,6 +264,14 @@ class ServingServicer(object):
             reloads=snap["reloads"],
             uptime_secs=snap["uptime_secs"],
             max_active_slots=snap["max_active_slots"],
+            kv_paged=kv["kv_paged"],
+            kv_block_size=kv["kv_block_size"],
+            kv_blocks_total=kv["kv_blocks_total"],
+            kv_blocks_free=kv["kv_blocks_free"],
+            kv_bytes_total=kv["kv_bytes_total"],
+            kv_bytes_in_use=kv["kv_bytes_in_use"],
+            kv_bytes_in_use_peak=snap["kv_bytes_in_use_peak"],
+            kv_bytes_per_token=snap["kv_bytes_per_token"],
         )
 
     # --------------------------------------------------------- internals
@@ -308,11 +341,22 @@ class GenerationServer(object):
     def __init__(self, trainer, state, config=None, injector=None):
         self.config = config or ServingConfig()
         cfg = self.config
-        self.engine = ContinuousBatchingEngine(
-            trainer, state, cfg.num_slots,
-            top_k=cfg.top_k, top_p=cfg.top_p,
+        if cfg.kv_paged:
+            self.engine = PagedContinuousBatchingEngine(
+                trainer, state, cfg.num_slots,
+                top_k=cfg.top_k, top_p=cfg.top_p,
+                block_size=cfg.kv_block_size,
+                num_blocks=cfg.kv_num_blocks,
+            )
+        else:
+            self.engine = ContinuousBatchingEngine(
+                trainer, state, cfg.num_slots,
+                top_k=cfg.top_k, top_p=cfg.top_p,
+            )
+        self.queue = RequestQueue(
+            cfg.queue_capacity, self.engine.seq_len,
+            max_cached_tokens=self.engine.max_cached_tokens(),
         )
-        self.queue = RequestQueue(cfg.queue_capacity, self.engine.seq_len)
         self.telemetry = ServingTelemetry(
             log_dir=cfg.telemetry_dir or None,
             flush_every=cfg.telemetry_flush_every,

@@ -10,11 +10,20 @@ whole system. Gauges, stepped by decode-step index:
     serving/tokens_per_sec     tokens committed / wall over the window
     serving/ttft_ms            per-request time-to-first-token (written
                                at each request's first token)
+    serving/kv_bytes_in_use    KV bytes live requests pin at the step
+    serving/kv_blocks_free     paged pool's free blocks at the step
     serving/admitted_total     monotone counters, one scalar per flush
     serving/rejected_total
     serving/expired_total
     serving/completed_total
     serving/reloads_total
+
+The snapshot derives the memory-efficiency headline
+`kv_bytes_per_token` = sum-over-steps(kv_bytes_in_use) /
+tokens_generated: the average KV bytes RESIDENT per generated token.
+The dense pool pins every seated slot's full `seq_len` stripe, the
+paged pool only the blocks written so far — this ratio is where the
+difference shows up as one number.
 
 Counters also back the ServerStatus RPC via snapshot() — the RPC must
 work with telemetry disabled (no log_dir), so counters live here and
@@ -48,6 +57,8 @@ class ServingTelemetry(object):
             "reloads": 0,
         }
         self.max_active_slots = 0
+        self.kv_bytes_in_use_peak = 0
+        self._kv_byte_steps = 0  # sum of kv_bytes_in_use over steps
         self._step = 0
         self._window_tokens = 0
         self._window_t0 = clock()
@@ -79,7 +90,8 @@ class ServingTelemetry(object):
         return ttft_ms
 
     def record_step(self, queue_depth, active_slots, step_secs,
-                    tokens_committed):
+                    tokens_committed, kv_bytes_in_use=None,
+                    kv_blocks_free=None):
         """Per-decode-step gauges; counters flush every flush_every
         steps so the event file stays O(steps / flush_every)."""
         with self._lock:
@@ -89,6 +101,16 @@ class ServingTelemetry(object):
             )
             self.counters["tokens_generated"] += tokens_committed
             self._window_tokens += tokens_committed
+            if kv_bytes_in_use is not None:
+                self.kv_bytes_in_use_peak = max(
+                    self.kv_bytes_in_use_peak, kv_bytes_in_use
+                )
+                self._kv_byte_steps += kv_bytes_in_use
+                self._scalar("serving/kv_bytes_in_use",
+                             kv_bytes_in_use, self._step)
+            if kv_blocks_free is not None:
+                self._scalar("serving/kv_blocks_free",
+                             kv_blocks_free, self._step)
             self._scalar("serving/queue_depth", queue_depth, self._step)
             self._scalar("serving/active_slots", active_slots, self._step)
             self._scalar(
@@ -116,6 +138,11 @@ class ServingTelemetry(object):
             snap["max_active_slots"] = self.max_active_slots
             snap["uptime_secs"] = self._clock() - self._started
             snap["steps"] = self._step
+            snap["kv_bytes_in_use_peak"] = self.kv_bytes_in_use_peak
+            snap["kv_bytes_per_token"] = (
+                self._kv_byte_steps
+                / max(1, self.counters["tokens_generated"])
+            )
             return snap
 
     def close(self):

@@ -28,6 +28,7 @@ from elasticdl_tpu.ops.attention import (
     flash_attention,
     jax_flash_attention,
     packed_positions,
+    paged_decode_attention,
 )
 from elasticdl_tpu.ops.losses import chunked_softmax_xent
 from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -183,7 +184,8 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
-                 prefill=False, segments=None, positions=None):
+                 prefill=False, segments=None, positions=None,
+                 paged=None):
         b, l, e = x.shape
         h, d = self.num_heads, self.head_dim
         hkv = self.num_kv_heads or h
@@ -212,7 +214,8 @@ class CausalSelfAttention(nn.Module):
             .reshape(b, l, hkv, d).transpose(0, 2, 1, 3)
         )  # q: [b, h, l, d]; k/v: [b, hkv, l, d]
         if decode:
-            return self._decode_step(q, k, v, e, decode_pos)
+            return self._decode_step(q, k, v, e, decode_pos,
+                                     paged=paged)
         if self.use_rope:
             pos = jnp.arange(l) if positions is None else positions
             q = apply_rope(q, pos)
@@ -318,7 +321,7 @@ class CausalSelfAttention(nn.Module):
             y = y + self._lora_branch(out, e, "proj")
         return y
 
-    def _decode_step(self, q, k, v, e, decode_pos):
+    def _decode_step(self, q, k, v, e, decode_pos, paged=None):
         """Chunked decode against the KV cache: q is [b, h, t, d],
         k/v [b, hkv, t, d] for a chunk of t >= 1 tokens at absolute
         positions [decode_pos, decode_pos + t) — t = 1 is the classic
@@ -330,7 +333,17 @@ class CausalSelfAttention(nn.Module):
         the model's single cache counter (one source of truth —
         per-layer counters could only drift apart). RoPE rotates q/k at
         their absolute positions; row i of the chunk masks
-        `k_pos <= pos + i` (windowing `k_pos > pos + i - window`)."""
+        `k_pos <= pos + i` (windowing `k_pos > pos + i - window`).
+
+        `paged` (serving only): {"k": pool, "v": pool, "table": [b, m]}
+        — this layer's slice of the block-paged serving KV pool
+        (serving/kv_pool.py). The cached rows then live in the SHARED
+        block arenas instead of per-sequence flax cache buffers:
+        attention streams the sequence's block table
+        (ops.paged_decode_attention) and the new token's k/v rows are
+        SOWN into the "kv_out" collection for the engine to scatter
+        into the pool — a module has no business writing an arena it
+        shares with every other sequence."""
         if not self.causal:
             raise ValueError("decode mode requires a causal model")
         if self.cache_len < 1:
@@ -341,28 +354,68 @@ class CausalSelfAttention(nn.Module):
         hkv = k.shape[1]
         group = h // hkv
         dtype = q.dtype
-        cvars = self._cache_vars(b, hkv, d, dtype)
         idx = decode_pos
         if self.use_rope:
             pos = idx + jnp.arange(t)
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
+        if paged is not None:
+            if self.kv_cache_dtype:
+                raise ValueError(
+                    "paged decode supports the plain-dtype KV format "
+                    "only (kv_cache_dtype=%r)" % (self.kv_cache_dtype,)
+                )
+            if t != 1:
+                raise ValueError(
+                    "paged decode is single-token (got a chunk of %d)"
+                    % t
+                )
+            self.sow("kv_out", "k", k)  # [b, hkv, 1, d] for the
+            self.sow("kv_out", "v", v)  # engine's pool scatter
+            out = paged_decode_attention(
+                q[:, :, 0, :], k[:, :, 0, :], v[:, :, 0, :],
+                paged["k"], paged["v"], paged["table"],
+                jnp.broadcast_to(idx, (b,)),
+                scale=d ** -0.5, window=self.window or None,
+            ).astype(dtype)
+            return self._proj(out.reshape(b, 1, h * d), e)
+        cvars = self._cache_vars(b, hkv, d, dtype)
         self._cache_write(cvars, k, v, idx)
-        ckf, cvf = self._cache_read(cvars, dtype)
         scale = d ** -0.5
         # group the q heads under their kv head: [b, hkv, group, t, d]
         qg = (q * scale).reshape(b, hkv, group, t, d)
-        s = jnp.einsum(
-            "bhgtd,bhkd->bhgtk", qg, ckf
-        ).astype(jnp.float32)  # [b, hkv, group, t, L]
+        ck, cv, ks, vs = cvars
+        if ks is None:
+            s = jnp.einsum(
+                "bhgtd,bhkd->bhgtk", qg, ck.value
+            ).astype(jnp.float32)  # [b, hkv, group, t, L]
+        else:
+            # int8 cache, DEFERRED dequantize: fold the per-row scales
+            # into the scores instead of materializing a float copy of
+            # the whole cache every step — the scale multiply runs on
+            # [*, L] scores, a head_dim-times smaller array than the
+            # [*, L, d] rows (the decode_kv_int8 bench regression)
+            s = jnp.einsum(
+                "bhgtd,bhkd->bhgtk", qg, ck.value.astype(dtype)
+            ).astype(jnp.float32) * ks.value[..., 0][:, :, None, None]
         k_pos = jnp.arange(self.cache_len)[None, :]
         row_pos = (idx + jnp.arange(t))[:, None]
         valid = k_pos <= row_pos  # [t, L]
         if self.window:
             valid = valid & (k_pos > row_pos - self.window)
         s = jnp.where(valid[None, None, None], s, NEG_INF)
-        w = jax.nn.softmax(s, axis=-1).astype(dtype)
-        out = jnp.einsum("bhgtk,bhkd->bhgtd", w, cvf)
+        w = jax.nn.softmax(s, axis=-1)
+        if vs is None:
+            out = jnp.einsum(
+                "bhgtk,bhkd->bhgtd", w.astype(dtype), cv.value
+            )
+        else:
+            # v-side deferral: scale the [*, L] weights, read int8 rows
+            out = jnp.einsum(
+                "bhgtk,bhkd->bhgtd",
+                (w * vs.value[..., 0][:, :, None, None]).astype(dtype),
+                cv.value.astype(dtype),
+            )
         # (hkv, group) flattens back to h in q's head order
         out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, h * d)
         return self._proj(out, e)
@@ -387,7 +440,8 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, training=False, decode=False, decode_pos=None,
-                 prefill=False, segments=None, positions=None):
+                 prefill=False, segments=None, positions=None,
+                 paged=None):
         e = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
@@ -401,7 +455,8 @@ class Block(nn.Module):
             kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(y, training, decode=decode, decode_pos=decode_pos,
-          prefill=prefill, segments=segments, positions=positions)
+          prefill=prefill, segments=segments, positions=positions,
+          paged=paged)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         up_init = (
             _tp_dense_init(1) if self.tp_shard
@@ -515,10 +570,17 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, features, training=False, decode=False,
-                 prefill=False, prompt_len=None):
+                 prefill=False, prompt_len=None, paged=None):
+        # `paged` (decode only): the serving engine's block-paged KV
+        # pool — {"pools": tree mirroring this model's cache collection
+        # with per-layer [num_blocks, block_size, hkv, d] arenas,
+        # "table": [b, m] int32 block table}. Each block slices out its
+        # own layer's arenas below; see serving/kv_pool.py.
         tokens = features["tokens"]  # [b, seq_len]; [b, 1] when decode
         if decode and prefill:
             raise ValueError("decode and prefill are mutually exclusive")
+        if paged is not None and not decode:
+            raise ValueError("paged KV applies to decode mode only")
         # sequence packing: [b, seq_len] int ids of contiguous same-id
         # runs. Attention is confined to each run and positions restart
         # at run boundaries (the packed rows behave exactly like the
@@ -594,12 +656,20 @@ class TransformerLM(nn.Module):
                 kv_cache_dtype=self.kv_cache_dtype,
                 name="block_%d" % i,
             )
+            blk_paged = None
+            if paged is not None:
+                arena = paged["pools"]["block_%d" % i]["attn"]
+                blk_paged = {
+                    "k": arena["k"], "v": arena["v"],
+                    "table": paged["table"],
+                }
             if use_remat:
                 x = run_block(blk, x)
             else:
                 x = blk(x, training, decode=decode,
                         decode_pos=decode_pos, prefill=prefill,
-                        segments=segments, positions=positions)
+                        segments=segments, positions=positions,
+                        paged=blk_paged)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         head = LMHead(
             self.vocab_size, dtype=self.dtype, name="head",

@@ -10,7 +10,7 @@ emits ONE JSON line:
     {"metric": "serving_goodput_tokens_per_sec", "value": ...,
      "ttft_ms": {"p50": ..., "p99": ...}, "latency_ms": {...},
      "tokens_per_sec": ..., "goodput_rps": ..., "rejected": ...,
-     "expired": ..., ...}
+     "expired": ..., "kv": {...}, ...}
 
 * TTFT is measured at the FIRST streamed chunk (prefill + queueing);
 * tokens_per_sec counts only tokens of COMPLETED requests over the
@@ -18,14 +18,23 @@ emits ONE JSON line:
   rejected (backpressure) and expired (deadline) requests score zero,
   which is what makes overload visible as a goodput plateau;
 * arrivals are open-loop Poisson (exponential gaps at --rate), so
-  backpressure actually engages instead of the clients self-throttling.
+  backpressure actually engages instead of the clients self-throttling;
+* the "kv" block records the memory-efficiency trajectory: bytes
+  resident in the pool at peak, average KV bytes per generated token,
+  block budget and admitted-vs-rejected under it.
 
-Defaults are CPU-smoke sized (`make serve-smoke`); on hardware raise
---requests/--rate and the model dims.
+--compare_paged runs the SAME arrival plan twice — the dense pool,
+then the block-paged pool (serving/kv_pool.py) holding the SAME total
+KV bytes spread over --paged_slots slots — and nests the paged record
+plus the headline ratios under "paged" / "paged_vs_dense". That A/B is
+the `make serve-smoke` shape: equal HBM, more admissible concurrency.
+
+Defaults are CPU-smoke sized; on hardware raise --requests/--rate and
+the model dims.
 
 Usage:
     python scripts/bench_serving.py --requests 32 --rate 16 \
-        --num_slots 4 --out BENCH_SERVING.json
+        --num_slots 4 --compare_paged --out BENCH_SERVING.json
 """
 
 import argparse
@@ -61,6 +70,19 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="",
                    help="also write the JSON record to this path")
+    # KV pool layout (serving/kv_pool.py)
+    p.add_argument("--kv_paged", type=int, default=0,
+                   help="1 = serve from the block-paged KV pool")
+    p.add_argument("--kv_block_size", type=int, default=4)
+    p.add_argument("--kv_num_blocks", type=int, default=0,
+                   help="block budget; 0 = dense-equivalent bytes for "
+                        "--num_slots")
+    p.add_argument("--paged_slots", type=int, default=0,
+                   help="slot count for the paged side of "
+                        "--compare_paged; 0 = 2x --num_slots")
+    p.add_argument("--compare_paged", action="store_true",
+                   help="A/B the dense pool vs the paged pool at EQUAL "
+                        "total KV bytes; nests the paged record")
     return p.parse_args(argv)
 
 
@@ -80,7 +102,9 @@ def percentile(values, q):
     return vs[idx]
 
 
-def run_bench(args):
+def build_rig(args):
+    """The trainer/state both A/B sides share (same params -> the
+    dense and paged runs serve identical token streams)."""
     import jax
     import numpy as np
 
@@ -88,9 +112,6 @@ def run_bench(args):
         load_model_spec_from_module,
     )
     from elasticdl_tpu.parallel import mesh as mesh_lib
-    from elasticdl_tpu.proto import elasticdl_pb2 as pb
-    from elasticdl_tpu.proto.service import ServingStub, build_channel
-    from elasticdl_tpu.serving import GenerationServer, ServingConfig
     from elasticdl_tpu.training.trainer import Trainer
     from model_zoo.transformer_lm import transformer_lm as zoo
 
@@ -100,17 +121,13 @@ def run_bench(args):
         model_params=args.model_params,
     )
     seq_len = int(trainer.model.seq_len)
-    vocab = int(trainer.model.vocab_size)
     dummy = np.zeros((1, seq_len), np.int32)
     state = trainer.init_state(({"tokens": dummy}, dummy))
-    server = GenerationServer(
-        trainer, state,
-        ServingConfig(
-            num_slots=args.num_slots,
-            queue_capacity=args.queue_capacity,
-        ),
-    ).start()
-    stub = ServingStub(build_channel("localhost:%d" % server.port))
+    return trainer, state
+
+
+def build_plan(args, seq_len, vocab):
+    import numpy as np
 
     p_lo, p_hi = _span(args.prompt_len)
     o_lo, o_hi = _span(args.out_len)
@@ -120,7 +137,7 @@ def run_bench(args):
             % (p_hi, o_hi, seq_len)
         )
     rs = np.random.RandomState(args.seed)
-    plan = [
+    return [
         {
             "prompt": rs.randint(0, vocab,
                                  size=rs.randint(p_lo, p_hi + 1)),
@@ -130,6 +147,27 @@ def run_bench(args):
         }
         for i in range(args.requests)
     ]
+
+
+def run_load(args, trainer, state, plan, num_slots, kv_paged,
+             kv_block_size, kv_num_blocks):
+    import jax
+
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import ServingStub, build_channel
+    from elasticdl_tpu.serving import GenerationServer, ServingConfig
+
+    server = GenerationServer(
+        trainer, state,
+        ServingConfig(
+            num_slots=num_slots,
+            queue_capacity=args.queue_capacity,
+            kv_paged=kv_paged,
+            kv_block_size=kv_block_size,
+            kv_num_blocks=kv_num_blocks,
+        ),
+    ).start()
+    stub = ServingStub(build_channel("localhost:%d" % server.port))
 
     # one warmup request outside the measurement: pays the jit compiles
     stub.generate(
@@ -184,14 +222,14 @@ def run_bench(args):
     ttfts = [r["ttft_ms"] for r in ok if r["ttft_ms"] is not None]
     lats = [r["latency_ms"] for r in ok]
     tokens_ok = sum(r["tokens"] for r in ok)
-    record = {
+    return {
         "metric": "serving_goodput_tokens_per_sec",
         "value": round(tokens_ok / wall, 3) if wall else None,
         "unit": "tokens/sec",
         "platform": jax.default_backend(),
         "requests": args.requests,
         "rate_rps": args.rate,
-        "num_slots": args.num_slots,
+        "num_slots": num_slots,
         "queue_capacity": args.queue_capacity,
         "completed": len(ok),
         "rejected": sum(
@@ -211,6 +249,67 @@ def run_bench(args):
         "wall_secs": round(wall, 3),
         "max_active_slots": status.max_active_slots,
         "server_tokens_generated": status.tokens_generated,
+        # memory-efficiency fields: the paged-vs-dense trajectory
+        "kv": {
+            "paged": bool(status.kv_paged),
+            "block_size": status.kv_block_size,
+            "blocks_total": status.kv_blocks_total,
+            "bytes_total": status.kv_bytes_total,
+            "bytes_in_use_peak": status.kv_bytes_in_use_peak,
+            "bytes_per_token": round(status.kv_bytes_per_token, 1),
+            "admitted": status.admitted,
+            "rejected": status.rejected,
+        },
+    }
+
+
+def run_bench(args):
+    trainer, state = build_rig(args)
+    seq_len = int(trainer.model.seq_len)
+    vocab = int(trainer.model.vocab_size)
+    plan = build_plan(args, seq_len, vocab)
+    if args.kv_block_size < 1 or seq_len % args.kv_block_size:
+        raise SystemExit(
+            "kv_block_size %d must divide seq_len %d"
+            % (args.kv_block_size, seq_len)
+        )
+    # dense-equivalent block budget: the SAME KV bytes the dense pool
+    # pins for --num_slots, expressed in blocks
+    dense_blocks = args.num_slots * (seq_len // args.kv_block_size)
+    num_blocks = args.kv_num_blocks or dense_blocks
+
+    record = run_load(
+        args, trainer, state, plan, args.num_slots,
+        kv_paged=bool(args.kv_paged),
+        kv_block_size=args.kv_block_size,
+        kv_num_blocks=num_blocks if args.kv_paged else 0,
+    )
+    if not args.compare_paged:
+        return record
+
+    # paged side of the A/B: equal KV bytes (the dense pool's budget),
+    # spread over more slots — the concurrency those bytes now admit
+    paged_slots = args.paged_slots or 2 * args.num_slots
+    paged = run_load(
+        args, trainer, state, plan, paged_slots,
+        kv_paged=True,
+        kv_block_size=args.kv_block_size,
+        kv_num_blocks=dense_blocks,
+    )
+    record["paged"] = paged
+    base_good = record["goodput_rps"] or 1e-9
+    base_tok = record["tokens_per_sec"] or 1e-9
+    record["paged_vs_dense"] = {
+        "equal_kv_bytes": paged["kv"]["bytes_total"]
+        == record["kv"]["bytes_total"],
+        "goodput_ratio": round((paged["goodput_rps"] or 0.0)
+                               / base_good, 3),
+        "tokens_per_sec_ratio": round((paged["tokens_per_sec"] or 0.0)
+                                      / base_tok, 3),
+        "max_active_slots": [record["max_active_slots"],
+                             paged["max_active_slots"]],
+        "bytes_per_token": [record["kv"]["bytes_per_token"],
+                            paged["kv"]["bytes_per_token"]],
     }
     return record
 

@@ -21,8 +21,12 @@ Phase 2 — hard kill (EDL_FAULT_SPEC=generate:kill:1:skip=N, the same
   and common/retry.py classifies exactly these codes as transient for
   the retry-elsewhere path.
 
+Both phases run TWICE: against the dense KV pool and against the
+block-paged pool (EDL_KV_PAGED=1, serving/kv_pool.py) — drain and
+SIGKILL semantics must hold regardless of where the cache rows live.
+
 Usage: python scripts/run_server_kill_drill.py
-Exit 0 = both phases hold."""
+Exit 0 = both phases hold in both modes."""
 
 import os
 import signal
@@ -130,9 +134,10 @@ def join_all(threads, outcomes, t0, n):
     return elapsed
 
 
-def phase_graceful():
-    print("[drill] phase 1: SIGTERM mid-load (graceful drain)")
-    proc, port = start_server()
+def phase_graceful(mode_env=None, mode="dense"):
+    print("[drill] phase 1 (%s): SIGTERM mid-load (graceful drain)"
+          % mode)
+    proc, port = start_server(extra_env=mode_env)
     try:
         threads, outcomes, t0 = fire_requests(port, 8)
         time.sleep(0.4)  # let some seat, some queue
@@ -150,14 +155,15 @@ def phase_graceful():
     finally:
         if proc.poll() is None:
             proc.kill()
-    print("[drill] phase 1 OK")
+    print("[drill] phase 1 (%s) OK" % mode)
 
 
-def phase_hard_kill():
-    print("[drill] phase 2: EDL_FAULT_SPEC self-SIGKILL mid-load")
-    proc, port = start_server(
-        extra_env={"EDL_FAULT_SPEC": "generate:kill:1:skip=3"}
-    )
+def phase_hard_kill(mode_env=None, mode="dense"):
+    print("[drill] phase 2 (%s): EDL_FAULT_SPEC self-SIGKILL mid-load"
+          % mode)
+    env = {"EDL_FAULT_SPEC": "generate:kill:1:skip=3"}
+    env.update(mode_env or {})
+    proc, port = start_server(extra_env=env)
     try:
         threads, outcomes, t0 = fire_requests(port, 8)
         elapsed = join_all(threads, outcomes, t0, 8)
@@ -178,13 +184,19 @@ def phase_hard_kill():
     finally:
         if proc.poll() is None:
             proc.kill()
-    print("[drill] phase 2 OK")
+    print("[drill] phase 2 (%s) OK" % mode)
 
 
 def main():
-    phase_graceful()
-    phase_hard_kill()
-    print("[drill] serving kill drill PASSED")
+    # dense pool, then the block-paged pool (kv_block_size must divide
+    # the drill model's seq_len=32; the default 16 does)
+    for mode, env in (
+        ("dense", {"EDL_KV_PAGED": "0"}),
+        ("paged", {"EDL_KV_PAGED": "1"}),
+    ):
+        phase_graceful(mode_env=env, mode=mode)
+        phase_hard_kill(mode_env=env, mode=mode)
+    print("[drill] serving kill drill PASSED (dense + paged)")
     return 0
 
 

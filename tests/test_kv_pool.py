@@ -1,0 +1,184 @@
+"""Block-paged KV pool unit tests (tier-1).
+
+The host-side block allocator (serving/kv_pool.py): alloc/extend/free
+reuse order, reservation-backed extends, fragmentation invariants under
+random request lengths, clean out-of-blocks signalling; plus the
+paged decode-attention op (ops/attention.py) against a dense oracle.
+Engine/server-level paged behavior (parity at concurrency, admission
+backpressure, reclamation on evict) lives in tests/test_serving_e2e.py
+on the drills shard."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.serving.kv_pool import (
+    BlockAllocator,
+    OutOfBlocks,
+    blocks_for,
+)
+
+
+def test_blocks_for():
+    assert blocks_for(0, 4) == 0
+    assert blocks_for(1, 4) == 1
+    assert blocks_for(4, 4) == 1
+    assert blocks_for(5, 4) == 2
+    assert blocks_for(17, 4) == 5
+
+
+def test_alloc_free_reuse_order_is_lifo():
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    t0 = a.alloc("r0", tokens=8)          # 2 blocks
+    t1 = a.alloc("r1", tokens=4)          # 1 block
+    assert len(t0) == 2 and len(t1) == 1
+    assert len(set(t0) | set(t1)) == 3    # disjoint
+    assert a.num_free() == 5
+    # free r0: its blocks come back and are reused FIRST, last-out
+    # first-in (warm reuse)
+    assert a.free("r0") == 2
+    t2 = a.alloc("r2", tokens=8)
+    assert t2 == list(reversed(t0))
+    # double free is a harmless no-op
+    assert a.free("r0") == 0
+
+
+def test_alloc_reserves_full_commitment():
+    a = BlockAllocator(num_blocks=4, block_size=4)
+    # 1 block materialized now, 3 promised in total
+    a.alloc("r0", tokens=4, commit_tokens=12)
+    assert a.num_free() == 3
+    assert a.available() == 1  # 3 free minus 2 reserved
+    assert a.can_fit(4) and not a.can_fit(8)
+    with pytest.raises(OutOfBlocks):
+        a.alloc("r1", tokens=8)
+    # the reservation makes the seated request's growth infallible
+    a.extend("r0", total_tokens=8)
+    a.extend("r0", total_tokens=12)
+    assert len(a.table("r0")) == 3
+    assert a.available() == 1  # reservation fully drawn down
+    # freeing returns blocks AND releases nothing extra (none left)
+    assert a.free("r0") == 3
+    assert a.num_free() == 4 and a.available() == 4
+
+
+def test_free_releases_undrawn_reservation():
+    a = BlockAllocator(num_blocks=4, block_size=4)
+    a.alloc("r0", tokens=4, commit_tokens=16)  # commit all 4
+    assert a.available() == 0
+    a.free("r0")  # only 1 block was materialized
+    assert a.num_free() == 4 and a.available() == 4
+
+
+def test_extend_beyond_commitment_competes_with_admission():
+    a = BlockAllocator(num_blocks=2, block_size=4)
+    a.alloc("r0", tokens=4, commit_tokens=4)
+    a.alloc("r1", tokens=4, commit_tokens=4)
+    with pytest.raises(OutOfBlocks):
+        a.extend("r0", total_tokens=8)  # past its commitment, pool dry
+    assert len(a.table("r0")) == 1  # untouched by the failed extend
+
+
+def test_alloc_failure_leaves_state_clean():
+    a = BlockAllocator(num_blocks=2, block_size=4)
+    a.alloc("r0", tokens=4)
+    free_before = a.num_free()
+    with pytest.raises(OutOfBlocks):
+        a.alloc("r1", tokens=4, commit_tokens=12)
+    assert a.num_free() == free_before
+    assert a.table("r1") == []
+    a.alloc("r1", tokens=4)  # a fitting request still seats
+
+
+def test_fragmentation_under_random_request_lengths():
+    """Random admit/complete churn with mixed lengths: the allocator's
+    invariants (conservation, disjoint ownership, non-negative
+    availability) must hold at every step, and a drained pool must be
+    whole again."""
+    rs = np.random.RandomState(7)
+    a = BlockAllocator(num_blocks=32, block_size=4)
+    live = {}
+    for i in range(300):
+        if live and (rs.rand() < 0.4 or not a.can_fit(24)):
+            slot = rs.choice(sorted(live))
+            a.free(slot)
+            del live[slot]
+        else:
+            tokens = int(rs.randint(1, 25))
+            total = tokens + int(rs.randint(0, 25))
+            slot = "r%d" % i
+            if a.can_fit(total):
+                a.alloc(slot, tokens, commit_tokens=total)
+                live[slot] = total
+                # grow a random live request inside its commitment
+                a.extend(slot, min(total, tokens + int(rs.randint(0, 8))))
+        # ---- invariants
+        used = sum(len(a.table(s)) for s in live)
+        assert used == a.blocks_in_use()
+        assert used + a.num_free() == 32
+        assert a.available() >= 0
+        owned = [b for s in live for b in a.table(s)]
+        assert len(owned) == len(set(owned))  # no block owned twice
+    for slot in list(live):
+        a.free(slot)
+    assert a.num_free() == 32 and a.available() == 32
+
+
+def test_paged_decode_attention_matches_dense_oracle():
+    """The op must equal plain softmax attention over the logically
+    contiguous cache (pool rows gathered in table order + the current
+    token), for MHA and GQA, with and without a sliding window."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops.attention import paged_decode_attention
+
+    rs = np.random.RandomState(0)
+    bs, nb = 4, 10
+    for hkv, h in ((2, 2), (1, 4)):
+        for window in (None, 5):
+            d = 8
+            b = 3
+            k_pool = rs.randn(nb, bs, hkv, d).astype(np.float32)
+            v_pool = rs.randn(nb, bs, hkv, d).astype(np.float32)
+            q = rs.randn(b, h, d).astype(np.float32)
+            k_cur = rs.randn(b, hkv, d).astype(np.float32)
+            v_cur = rs.randn(b, hkv, d).astype(np.float32)
+            # each row: different length + scattered table, -1 padded
+            lengths = np.asarray([0, 5, 11], np.int32)
+            table = np.full((b, 3), -1, np.int32)
+            table[1, :2] = [7, 2]
+            table[2, :3] = [4, 9, 1]
+            out = np.asarray(paged_decode_attention(
+                jnp.asarray(q), jnp.asarray(k_cur), jnp.asarray(v_cur),
+                jnp.asarray(k_pool), jnp.asarray(v_pool),
+                jnp.asarray(table), jnp.asarray(lengths),
+                window=window,
+            ))
+            group = h // hkv
+            for i in range(b):
+                ln = int(lengths[i])
+                rows_k = np.concatenate(
+                    [k_pool[bid] for bid in table[i] if bid >= 0]
+                    or [np.zeros((0, hkv, d), np.float32)]
+                )[:ln]
+                rows_v = np.concatenate(
+                    [v_pool[bid] for bid in table[i] if bid >= 0]
+                    or [np.zeros((0, hkv, d), np.float32)]
+                )[:ln]
+                keys = np.concatenate([rows_k, k_cur[i][None]])
+                vals = np.concatenate([rows_v, v_cur[i][None]])
+                if window is not None:
+                    # visible: k_pos in (ln - window, ln]
+                    k_pos = np.arange(ln + 1)
+                    keep = k_pos > ln - window
+                    keys, vals = keys[keep], vals[keep]
+                for j in range(h):
+                    kvh = j // group
+                    s = keys[:, kvh] @ q[i, j] * d ** -0.5
+                    w = np.exp(s - s.max())
+                    w = w / w.sum()
+                    ref = w @ vals[:, kvh]
+                    np.testing.assert_allclose(
+                        out[i, j], ref, rtol=2e-5, atol=2e-5,
+                        err_msg="row %d head %d hkv=%d window=%r"
+                                % (i, j, hkv, window),
+                    )

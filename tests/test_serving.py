@@ -104,6 +104,46 @@ def test_queue_deadline_expiry_at_admission_and_in_queue():
     assert got is live and expired == [doomed]
 
 
+def test_queue_token_budget_rejects_never_fits():
+    """A request whose KV footprint exceeds the paged pool's WHOLE
+    block budget can never seat — INVALID_ARGUMENT at submit, not an
+    eternal queue residence."""
+    q = RequestQueue(capacity=4, seq_len=16, max_cached_tokens=8)
+    # cached rows = prompt + new - 1 = 9 > 8
+    with pytest.raises(AdmissionError) as e:
+        q.submit(_req(prompt=list(range(4)), new=6))
+    assert e.value.code == "INVALID_ARGUMENT"
+    q.submit(_req(prompt=list(range(4)), new=5))  # 8 rows fits
+    # prefill-only requests never touch the pool: always admissible
+    q.submit(_req(prompt=list(range(15)), new=1))
+
+
+def test_queue_pop_ready_fit_predicate_preserves_fifo():
+    """pop_ready(fit=...) is the paged pool's backpressure point: an
+    unseatable head STAYS at the head (no skip-ahead starvation), and
+    seats once capacity frees."""
+    q = RequestQueue(capacity=4, seq_len=16)
+    big, small = _req(prompt=[1, 2, 3], new=8), _req(new=2)
+    q.submit(big)
+    q.submit(small)
+    got, expired = q.pop_ready(fit=lambda r: r is not big)
+    assert got is None and not expired and len(q) == 2
+    # capacity frees -> the SAME head pops first, FIFO intact
+    got, _ = q.pop_ready(fit=lambda r: True)
+    assert got is big
+    got, _ = q.pop_ready()
+    assert got is small
+    # expired requests still drain out even when the head doesn't fit
+    clock = FakeClock()
+    q2 = RequestQueue(capacity=4, seq_len=16, clock=clock)
+    doomed = _req(deadline_ms=100, clock=clock)
+    q2.submit(doomed)
+    q2.submit(_req(clock=clock))
+    clock.t += 10.0
+    got, expired = q2.pop_ready(fit=lambda r: False)
+    assert got is None and expired == [doomed] and len(q2) == 1
+
+
 def test_queue_close_rejects_backlog_and_new_submits():
     q = RequestQueue(capacity=4, seq_len=16)
     a = _req()
@@ -180,10 +220,18 @@ def test_serving_proto_round_trip():
     st = pb.ServerStatusResponse(
         queue_depth=1, active_slots=2, num_slots=4, admitted=10,
         tokens_generated=123, uptime_secs=1.5, max_active_slots=3,
+        kv_paged=True, kv_block_size=16, kv_blocks_total=32,
+        kv_blocks_free=7, kv_bytes_total=1 << 20,
+        kv_bytes_in_use=4096, kv_bytes_in_use_peak=8192,
+        kv_bytes_per_token=96.5,
     )
     st2 = pb.ServerStatusResponse.FromString(st.SerializeToString())
     assert st2.num_slots == 4 and st2.tokens_generated == 123
     assert abs(st2.uptime_secs - 1.5) < 1e-9
+    assert st2.kv_paged and st2.kv_blocks_free == 7
+    assert st2.kv_bytes_total == 1 << 20
+    assert st2.kv_bytes_in_use_peak == 8192
+    assert abs(st2.kv_bytes_per_token - 96.5) < 1e-9
 
 
 def test_serving_service_descriptor():
