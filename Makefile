@@ -10,8 +10,10 @@
 #                   parity, elastic e2e (SIGKILL mid-job), gRPC
 #                   master/worker, re-formation, elasticity bench
 #   drill         — one real local training job + status validation,
-#                   then the master SIGKILL/journal-recovery drill and
-#                   the serving SIGTERM/SIGKILL drill
+#                   then the master SIGKILL/journal-recovery drill, the
+#                   serving SIGTERM/SIGKILL drill, and the multi-replica
+#                   router chaos drill (SIGKILL + hot reload under live
+#                   load, zero accepted-request loss)
 #   serve-smoke   — closed-loop load vs the generation server; emits
 #                   the BENCH_SERVING.json serving-throughput record
 #   cluster-smoke — kind/minikube manifests smoke, env-gated
@@ -38,6 +40,7 @@ drill:
 	bash scripts/run_local_job_drill.sh
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_master_kill_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_server_kill_drill.py
+	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_router_chaos_drill.py
 
 # Serving smoke: closed-loop load against the real continuous-batching
 # server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput) —

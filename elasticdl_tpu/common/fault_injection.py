@@ -212,14 +212,27 @@ _SERVICER_RPCS = (
     "register_worker",
 )
 
-# The serving front-end's RPC surface (proto/service.py Serving table);
-# the generation server wraps its servicer with these names so overload
-# and kill drills target the same choke point the master drills use.
+# The routing tier's RPC surface (proto/service.py Router table). Names
+# are distinct from the replica surface, so a spec like
+# `router_generate:drop:1` fires at the router boundary and NEVER at a
+# replica servicer — and vice versa.
+ROUTER_RPCS = (
+    "router_generate",
+    "router_generate_stream",
+    "router_status",
+)
+
+# The serving front-end's RPC surface (proto/service.py Serving +
+# Router tables); serving processes wrap their servicers with this one
+# tuple so overload and kill drills target the same choke point the
+# master drills use. A servicer only exposes its own subset — the
+# wrapper skips names it doesn't have — so one spec grammar covers both
+# boundaries without cross-firing.
 SERVING_RPCS = (
     "generate",
     "generate_stream",
     "server_status",
-)
+) + ROUTER_RPCS
 
 
 class FaultInjectingServicer(object):
@@ -227,14 +240,16 @@ class FaultInjectingServicer(object):
     injector.intercept applied before and after each handler. Non-RPC
     attributes (get_model_version, watchdog helpers, ...) proxy through
     so Master/EvaluationService wiring is unaffected. `rpcs` selects the
-    intercepted surface (default: the Master table; the serving server
-    passes SERVING_RPCS)."""
+    intercepted surface (default: the Master table; serving processes
+    pass SERVING_RPCS); names the servicer doesn't implement are
+    skipped, so the replica server and the router share one tuple."""
 
     def __init__(self, servicer, injector, rpcs=_SERVICER_RPCS):
         self._servicer = servicer
         self._injector = injector
         for name in rpcs:
-            setattr(self, name, self._wrap(name))
+            if hasattr(servicer, name):
+                setattr(self, name, self._wrap(name))
 
     def _wrap(self, name):
         handler = getattr(self._servicer, name)

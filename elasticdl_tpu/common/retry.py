@@ -20,6 +20,14 @@ import time
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 
+# hoisted out of the classification hot path: is_transient_rpc_error
+# runs on EVERY failed call in a retry loop, and the router's dispatch
+# loop classifies per attempt — a per-call import is measurable there
+try:
+    import grpc as _grpc
+except Exception:  # pragma: no cover - grpc is in the image
+    _grpc = None
+
 
 class RetryPolicy(object):
     """Backoff/deadline knobs for one class of RPCs.
@@ -50,20 +58,47 @@ class RetryPolicy(object):
 
 
 def is_transient_rpc_error(exc):
-    """True for gRPC statuses a master restart produces: the server socket
-    is gone (UNAVAILABLE), in-flight calls were torn down (CANCELLED), or
-    a call outlived its deadline while the master replayed its journal
-    (DEADLINE_EXCEEDED)."""
+    """True for gRPC statuses a server restart produces: the socket is
+    gone (UNAVAILABLE), in-flight calls were torn down (CANCELLED), or
+    a call outlived its deadline while the server replayed its journal
+    (DEADLINE_EXCEEDED). Deliberately does NOT include
+    RESOURCE_EXHAUSTED — that is backpressure from a LIVE server
+    (`is_backpressure_rpc_error`): also retryable with backoff, but it
+    should steer the retry toward capacity elsewhere (the router
+    re-routes instead of counting it against the replica's breaker)."""
+    if _grpc is None:  # pragma: no cover
+        return False
     try:
-        import grpc
-
-        return isinstance(exc, grpc.RpcError) and exc.code() in (
-            grpc.StatusCode.UNAVAILABLE,
-            grpc.StatusCode.CANCELLED,
-            grpc.StatusCode.DEADLINE_EXCEEDED,
+        return isinstance(exc, _grpc.RpcError) and exc.code() in (
+            _grpc.StatusCode.UNAVAILABLE,
+            _grpc.StatusCode.CANCELLED,
+            _grpc.StatusCode.DEADLINE_EXCEEDED,
         )
     except Exception:
         return False
+
+
+def is_backpressure_rpc_error(exc):
+    """True for RESOURCE_EXHAUSTED: the server is alive but shedding
+    load (bounded admission queue full / shutdown drain). Retryable
+    with backoff, and the signal to try a DIFFERENT replica — the
+    server itself is healthy, its capacity is what's gone."""
+    if _grpc is None:  # pragma: no cover
+        return False
+    try:
+        return (
+            isinstance(exc, _grpc.RpcError)
+            and exc.code() == _grpc.StatusCode.RESOURCE_EXHAUSTED
+        )
+    except Exception:
+        return False
+
+
+def is_retryable_rpc_error(exc):
+    """Transient OR backpressure: the union a multi-replica dispatcher
+    retries (single-target callers keep is_transient_rpc_error — with
+    one server, retrying into a full queue is just more load)."""
+    return is_transient_rpc_error(exc) or is_backpressure_rpc_error(exc)
 
 
 def retry_call(

@@ -14,6 +14,7 @@ from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 SERVICE_NAME = "elasticdl_tpu.Master"
 SERVING_SERVICE_NAME = "elasticdl_tpu.Serving"
+ROUTER_SERVICE_NAME = "elasticdl_tpu.Router"
 
 # method name -> (request class, response class)
 _METHODS = {
@@ -41,6 +42,19 @@ _SERVING_METHODS = {
     ),
 }
 
+# the routing tier's surface (serving/router.py); names are distinct
+# from the replica surface so fault-injection specs can target one
+# boundary without the other
+_ROUTER_METHODS = {
+    "router_generate": (pb.GenerateRequest, pb.GenerateResponse, False),
+    "router_generate_stream": (pb.GenerateRequest, pb.TokenChunk, True),
+    "router_status": (
+        pb.RouterStatusRequest,
+        pb.RouterStatusResponse,
+        False,
+    ),
+}
+
 
 def add_master_servicer_to_server(servicer, server):
     handlers = {}
@@ -55,9 +69,9 @@ def add_master_servicer_to_server(servicer, server):
     )
 
 
-def add_serving_servicer_to_server(servicer, server):
+def _add_servicer(servicer, server, service_name, methods):
     handlers = {}
-    for name, (req_cls, resp_cls, streaming) in _SERVING_METHODS.items():
+    for name, (req_cls, resp_cls, streaming) in methods.items():
         make = (
             grpc.unary_stream_rpc_method_handler
             if streaming
@@ -69,12 +83,16 @@ def add_serving_servicer_to_server(servicer, server):
             response_serializer=resp_cls.SerializeToString,
         )
     server.add_generic_rpc_handlers(
-        (
-            grpc.method_handlers_generic_handler(
-                SERVING_SERVICE_NAME, handlers
-            ),
-        )
+        (grpc.method_handlers_generic_handler(service_name, handlers),)
     )
+
+
+def add_serving_servicer_to_server(servicer, server):
+    _add_servicer(servicer, server, SERVING_SERVICE_NAME, _SERVING_METHODS)
+
+
+def add_router_servicer_to_server(servicer, server):
+    _add_servicer(servicer, server, ROUTER_SERVICE_NAME, _ROUTER_METHODS)
 
 
 class MasterStub(object):
@@ -102,6 +120,23 @@ class ServingStub(object):
                 name,
                 make(
                     "/%s/%s" % (SERVING_SERVICE_NAME, name),
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+class RouterStub(object):
+    def __init__(self, channel):
+        for name, (req_cls, resp_cls, streaming) in (
+            _ROUTER_METHODS.items()
+        ):
+            make = channel.unary_stream if streaming else channel.unary_unary
+            setattr(
+                self,
+                name,
+                make(
+                    "/%s/%s" % (ROUTER_SERVICE_NAME, name),
                     request_serializer=req_cls.SerializeToString,
                     response_deserializer=resp_cls.FromString,
                 ),

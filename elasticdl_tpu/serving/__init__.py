@@ -14,6 +14,10 @@ the inference half — it turns the offline decode library
                  block tables, shared per-layer block arenas
 * server.py      gRPC front-end (Generate / GenerateStream /
                  ServerStatus) + the scheduler thread
+* router.py      health-checked multi-replica routing tier: heartbeat
+                 leases, least-loaded dispatch, per-replica circuit
+                 breakers, bounded re-dispatch + hedging, shed-load
+                 (entry: python -m elasticdl_tpu.serving.router_main)
 * hot_reload.py  checkpoint-dir watcher that swaps params between
                  decode steps without dropping in-flight requests
 * telemetry.py   serving gauges on the common/tb_events.py path
@@ -34,6 +38,13 @@ from elasticdl_tpu.serving.kv_pool import (  # noqa: F401
     BlockAllocator,
     OutOfBlocks,
     PagedKVPool,
+)
+from elasticdl_tpu.serving.router import (  # noqa: F401
+    CircuitBreaker,
+    Router,
+    RouterConfig,
+    RouterError,
+    RouterServicer,
 )
 from elasticdl_tpu.serving.server import (  # noqa: F401
     GenerationServer,

@@ -69,10 +69,21 @@ class ServingRequest(object):
         # scheduler-side state
         self.generated = []
         self.first_token_at = None
+        self.seated_at = None  # set when the scheduler seats a slot
         self.model_version = -1
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline
+
+    def queue_wait_secs(self, now=None):
+        """Time spent queued before seating (None until seated). The
+        router folds this — via the telemetry EWMA and the
+        ServerStatus queue_wait_ms field — into its load signal: two
+        replicas with equal queue DEPTH can hide very different queue
+        TIME when their requests differ in length."""
+        if self.seated_at is None:
+            return None
+        return self.seated_at - self.submitted_at
 
     # ---- event plumbing (scheduler -> handler thread)
 

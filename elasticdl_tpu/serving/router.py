@@ -1,0 +1,741 @@
+"""Health-checked multi-replica serving router.
+
+The routing tier in front of N GenerationServer replicas — the serving
+twin of the master's fault-tolerance story: the master relaunches pods
+and requeues tasks so a training job survives membership churn; the
+router re-dispatches requests so the SERVING fleet does. The invariant
+it sells is robustness, not speed: a request the router ACCEPTED is
+never silently lost. It completes, or it fails with an explicit status
+the client can act on — never a hang, never a dropped stream the client
+has to time out.
+
+    clients ──router_generate[_stream]──> Router ──generate──> replica 1
+                                            │  ^                replica 2
+                              heartbeat ────┘  └─ server_status replica 3
+
+Mechanisms, each its own small state machine:
+
+* **Leases** — a heartbeat loop polls every replica's `server_status`
+  each `poll_secs`; a successful poll renews the replica's lease for
+  `lease_secs` and refreshes its load signals (queue depth, active
+  slots, kv_blocks_free, queue_wait_ms EWMA) and drain flag. A replica
+  whose lease expires — crashed, wedged, partitioned — leaves the
+  rotation passively: nothing needs to detect the death, the lease
+  just stops being renewed.
+
+* **Least-loaded routing** — among in-rotation replicas (lease valid,
+  not draining, breaker not open) dispatch goes to the lowest load
+  score: queue_depth + active_slots + queue_wait_ms/50 (the wait EWMA
+  catches the case where two replicas have equal queue DEPTH but very
+  different queue TIME), ties broken toward more free KV blocks.
+
+* **Circuit breakers** — per replica, CLOSED -> OPEN after
+  `breaker_threshold` CONSECUTIVE transient dispatch failures; OPEN
+  rejects dispatch for `breaker_cooldown_secs`, then HALF_OPEN admits
+  exactly one probe request — success closes the breaker, failure
+  re-opens it and restarts the cooldown. RESOURCE_EXHAUSTED
+  (backpressure from a live replica) re-routes but does NOT count
+  against the breaker: the replica is healthy, its capacity is not.
+
+* **Bounded re-dispatch** — every dispatch failure is classified with
+  common/retry.py: transient (UNAVAILABLE/CANCELLED/timeout) and
+  backpressure (RESOURCE_EXHAUSTED) failures re-dispatch to another
+  replica with full-jitter backoff inside `redispatch_window_secs`;
+  anything else (INVALID_ARGUMENT, a client deadline genuinely spent)
+  propagates immediately. Unary generates are idempotent — token
+  streams depend only on (params, prompt, seed, temperature), never on
+  which replica ran them — so re-dispatch at ANY point is safe.
+  Streams re-dispatch only BEFORE the first chunk reaches the client;
+  after that the router fails the stream explicitly rather than
+  replaying tokens the client already has.
+
+* **Hedged dispatch** — with `hedge_delay_secs > 0`, a unary generate
+  that hasn't answered within the delay is duplicated to the next-best
+  replica and the first success wins (the same idempotency that makes
+  re-dispatch safe makes the duplicate free of semantic risk). Tail
+  latency insurance, off by default.
+
+* **Degradation ladder** — draining replicas leave the rotation for
+  NEW requests while their in-flight streams finish; when NO replica
+  is in rotation (all leases expired / breakers open / draining) the
+  router sheds load with an immediate RESOURCE_EXHAUSTED instead of
+  queueing into a black hole. Shed is the bottom rung, and it is loud:
+  the `shed` counter and `router/healthy_replicas` gauge mark it.
+
+Fault injection: the servicer wraps at the same choke point the master
+and replica servicers use (common/fault_injection.py) under the
+router-specific RPC names (`router_generate:drop:1`, ...), so chaos
+specs can target the router boundary without touching replicas.
+"""
+
+import threading
+import time
+from concurrent import futures
+
+try:
+    import queue as _queue
+except ImportError:  # pragma: no cover - py2 never happens here
+    import Queue as _queue
+
+from elasticdl_tpu.common.fault_injection import (
+    SERVING_RPCS,
+    maybe_wrap_servicer,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.retry import (
+    RetryPolicy,
+    is_backpressure_rpc_error,
+    is_transient_rpc_error,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.serving.admission import AdmissionError
+from elasticdl_tpu.serving.telemetry import RouterTelemetry
+
+
+class RouterError(AdmissionError):
+    """Terminal router-side failure; `code` is the gRPC status name the
+    servicer maps to (same duality as the replica's AdmissionError:
+    raised in-process, context.abort over real gRPC)."""
+
+
+class RouterConfig(object):
+    """Routing-tier knobs. lease_secs should cover a few poll periods
+    (a single dropped poll must not evict a healthy replica);
+    redispatch_window_secs bounds the TOTAL time one request may spend
+    being re-dispatched before its last error propagates."""
+
+    def __init__(self, poll_secs=0.5, poll_timeout_secs=2.0,
+                 lease_secs=2.5, breaker_threshold=3,
+                 breaker_cooldown_secs=2.0, hedge_delay_secs=0.0,
+                 dispatch_timeout_secs=120.0,
+                 redispatch_window_secs=30.0, base_delay_secs=0.05,
+                 max_delay_secs=1.0, port=0, max_workers=64,
+                 telemetry_dir="", telemetry_flush_every=20):
+        self.poll_secs = float(poll_secs)
+        self.poll_timeout_secs = float(poll_timeout_secs)
+        self.lease_secs = float(lease_secs)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_secs = float(breaker_cooldown_secs)
+        self.hedge_delay_secs = float(hedge_delay_secs)
+        self.dispatch_timeout_secs = float(dispatch_timeout_secs)
+        self.redispatch_window_secs = float(redispatch_window_secs)
+        self.base_delay_secs = float(base_delay_secs)
+        self.max_delay_secs = float(max_delay_secs)
+        self.port = int(port)
+        self.max_workers = int(max_workers)
+        self.telemetry_dir = telemetry_dir
+        self.telemetry_flush_every = int(telemetry_flush_every)
+
+
+class CircuitBreaker(object):
+    """Per-replica breaker: CLOSED -> OPEN on `threshold` CONSECUTIVE
+    transient failures; OPEN -> HALF_OPEN after `cooldown_secs`;
+    HALF_OPEN admits ONE in-flight probe — success closes, failure
+    re-opens and restarts the cooldown."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold=3, cooldown_secs=2.0):
+        self.threshold = int(threshold)
+        self.cooldown_secs = float(cooldown_secs)
+        self.state = self.CLOSED
+        self.failures = 0  # consecutive transient failures
+        self._opened_at = None
+        self._probe_inflight = False
+        self._lock = threading.Lock()
+
+    def eligible(self, now):
+        """Whether a dispatch COULD go here now (non-mutating: safe to
+        call while ranking candidates)."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                return (now - self._opened_at >= self.cooldown_secs
+                        and not self._probe_inflight)
+            return not self._probe_inflight  # HALF_OPEN
+
+    def acquire(self, now):
+        """Commit to dispatching here: transitions OPEN->HALF_OPEN when
+        the cooldown has elapsed and claims the single probe slot.
+        False if another thread raced the probe away."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if (self.state == self.OPEN
+                    and now - self._opened_at >= self.cooldown_secs):
+                self.state = self.HALF_OPEN
+            if self.state == self.HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            closed_now = self.state != self.CLOSED
+            self.state = self.CLOSED
+            self.failures = 0
+            self._probe_inflight = False
+            return closed_now
+
+    def record_failure(self, now):
+        """One transient dispatch failure; True when this TRIPS the
+        breaker (closed/half-open -> open)."""
+        with self._lock:
+            self.failures += 1
+            self._probe_inflight = False
+            if (self.state == self.HALF_OPEN
+                    or self.failures >= self.threshold):
+                tripped = self.state != self.OPEN
+                self.state = self.OPEN
+                self._opened_at = now
+                return tripped
+            return False
+
+
+class Replica(object):
+    """Registry entry: address, stub, lease, breaker, load signals."""
+
+    def __init__(self, address, stub, breaker, lease_until):
+        self.address = address
+        self.stub = stub
+        self.breaker = breaker
+        # registration grants one lease period of grace so routing
+        # works before the first poll lands; a dead replica burns the
+        # grace on its breaker instead
+        self.lease_expires_at = lease_until
+        self.draining = False
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.kv_blocks_free = 0
+        self.queue_wait_ms = 0.0
+        self.dispatched = 0
+        self.failures = 0
+        self.poll_failures = 0
+        # router-side in-flight dispatches: the polled signals freeze
+        # between heartbeats, so without this every tie inside a poll
+        # window breaks to the same replica and requests herd
+        self.inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def begin_dispatch(self):
+        with self._inflight_lock:
+            self.dispatched += 1
+            self.inflight += 1
+
+    def end_dispatch(self):
+        with self._inflight_lock:
+            self.inflight -= 1
+
+    def lease_ok(self, now):
+        return now < self.lease_expires_at
+
+    def in_rotation(self, now):
+        return (self.lease_ok(now) and not self.draining
+                and self.breaker.eligible(now))
+
+    def load_score(self):
+        """Lower = dispatch here. Queue wait (ms) is scaled so ~50 ms
+        of measured waiting weighs like one queued request; inflight is
+        the router's own live correction to the heartbeat-stale rest."""
+        return (self.queue_depth + self.active_slots + self.inflight
+                + self.queue_wait_ms / 50.0)
+
+    def observe(self, status, lease_until):
+        self.lease_expires_at = lease_until
+        self.draining = bool(status.draining)
+        self.queue_depth = status.queue_depth
+        self.active_slots = status.active_slots
+        self.kv_blocks_free = status.kv_blocks_free
+        self.queue_wait_ms = status.queue_wait_ms
+
+
+def _default_stub_factory(address):
+    from elasticdl_tpu.proto.service import ServingStub, build_channel
+
+    return ServingStub(build_channel(address))
+
+
+def _code_name(exc, default="UNAVAILABLE"):
+    code = getattr(exc, "code", None)
+    if callable(code):
+        try:
+            return code().name
+        except Exception:
+            return default
+    return default
+
+
+class Router(object):
+    """The registry + heartbeat + dispatch engine. Transport-agnostic:
+    `stub_factory(address)` must return an object with the ServingStub
+    surface (generate / generate_stream / server_status, each taking
+    `timeout=`) — real gRPC stubs in production, in-process fakes in
+    the unit tests."""
+
+    def __init__(self, replica_addrs, config=None, stub_factory=None,
+                 clock=time.monotonic, sleep=time.sleep, telemetry=None):
+        self.config = config or RouterConfig()
+        self._stub_factory = stub_factory or _default_stub_factory
+        self._clock = clock
+        self._sleep = sleep
+        self.telemetry = telemetry or RouterTelemetry(
+            log_dir=self.config.telemetry_dir or None,
+            flush_every=self.config.telemetry_flush_every,
+        )
+        self._policy = RetryPolicy(
+            base_delay_secs=self.config.base_delay_secs,
+            max_delay_secs=self.config.max_delay_secs,
+            reconnect_window_secs=self.config.redispatch_window_secs,
+        )
+        self._lock = threading.Lock()
+        self._replicas = {}
+        for addr in replica_addrs:
+            self.add_replica(addr)
+        self._stop = threading.Event()
+        self._heartbeat = None
+        self._server = None
+        self.servicer = None
+        self.port = None
+
+    # ------------------------------------------------------- membership
+
+    def add_replica(self, address):
+        with self._lock:
+            if address in self._replicas:
+                return self._replicas[address]
+            rep = Replica(
+                address, self._stub_factory(address),
+                CircuitBreaker(self.config.breaker_threshold,
+                               self.config.breaker_cooldown_secs),
+                lease_until=self._clock() + self.config.lease_secs,
+            )
+            self._replicas[address] = rep
+            return rep
+
+    def remove_replica(self, address):
+        with self._lock:
+            self._replicas.pop(address, None)
+
+    def replicas(self):
+        with self._lock:
+            return list(self._replicas.values())
+
+    # -------------------------------------------------------- heartbeat
+
+    def poll_once(self):
+        """One heartbeat sweep: renew leases + load signals from every
+        replica that answers server_status; silence lets the lease
+        decay. Returns the number of in-rotation replicas."""
+        for rep in self.replicas():
+            try:
+                status = rep.stub.server_status(
+                    pb.ServerStatusRequest(),
+                    timeout=self.config.poll_timeout_secs,
+                )
+                rep.observe(
+                    status, self._clock() + self.config.lease_secs
+                )
+            except Exception as e:  # noqa: BLE001 - silence = lease decay
+                rep.poll_failures += 1
+                logger.debug("router poll %s failed: %r", rep.address, e)
+        now = self._clock()
+        healthy = sum(1 for r in self.replicas() if r.in_rotation(now))
+        self.telemetry.record_poll(healthy, len(self.replicas()))
+        return healthy
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(self.config.poll_secs)
+
+    # -------------------------------------------------------- selection
+
+    def _acquire_replica(self, now, exclude=()):
+        """Best in-rotation replica (least-loaded, then most free KV
+        blocks), with its breaker probe slot acquired. None = shed."""
+        with self._lock:
+            candidates = [
+                r for r in self._replicas.values()
+                if r.address not in exclude and r.in_rotation(now)
+            ]
+        candidates.sort(
+            key=lambda r: (r.load_score(), -r.kv_blocks_free, r.address)
+        )
+        for rep in candidates:
+            if rep.breaker.acquire(now):
+                return rep
+        return None
+
+    # --------------------------------------------------------- dispatch
+
+    def _sub_request(self, request, remaining_ms):
+        return pb.GenerateRequest(
+            prompt=list(request.prompt),
+            max_new_tokens=request.max_new_tokens,
+            temperature=request.temperature,
+            seed=request.seed,
+            deadline_ms=remaining_ms,
+        )
+
+    def _budget(self, request, t0):
+        """(remaining_ms, call_timeout) for a dispatch starting now.
+        remaining_ms is the client's unspent deadline budget (0 = no
+        deadline); raises when the budget is already gone — the ONE
+        DEADLINE_EXCEEDED the router never retries, because it is the
+        client's own clock that ran out."""
+        timeout = self.config.dispatch_timeout_secs
+        if request.deadline_ms <= 0:
+            return 0, timeout
+        remaining = (
+            request.deadline_ms / 1000.0 - (self._clock() - t0)
+        )
+        if remaining <= 0:
+            raise RouterError(
+                "DEADLINE_EXCEEDED",
+                "deadline spent after %.0f ms of routing"
+                % (request.deadline_ms,),
+            )
+        return int(remaining * 1000.0), min(timeout, remaining)
+
+    def _on_success(self, rep):
+        rep.breaker.record_success()
+
+    def _on_failure(self, rep, exc):
+        rep.failures += 1
+        now = self._clock()
+        if is_transient_rpc_error(exc):
+            if rep.breaker.record_failure(now):
+                self.telemetry.count("breaker_trips")
+                logger.warning(
+                    "router breaker OPEN for %s after %d consecutive "
+                    "transient failures (%r)",
+                    rep.address, rep.breaker.failures, exc,
+                )
+        # backpressure: the replica is alive and explicitly shedding —
+        # re-route without charging its breaker
+
+    def _call_unary(self, rep, sub, timeout):
+        rep.begin_dispatch()
+        try:
+            resp = rep.stub.generate(sub, timeout=timeout)
+        except Exception as e:
+            self._on_failure(rep, e)
+            raise
+        finally:
+            rep.end_dispatch()
+        self._on_success(rep)
+        return resp
+
+    def _raise_terminal(self, exc):
+        self.telemetry.count("errors")
+        if isinstance(exc, RouterError):
+            raise exc  # already carries its status name
+        raise RouterError(_code_name(exc), str(exc))
+
+    def dispatch_generate(self, request):
+        """Unary generate with re-dispatch + optional hedging. The
+        response is atomic (nothing reaches the client until a replica
+        finishes), so re-dispatch is safe at ANY point of a failed
+        attempt — token parity guarantees replica-independence."""
+        self.telemetry.count("routed")
+        t0 = self._clock()
+        window_ends = t0 + self.config.redispatch_window_secs
+        attempt = 0
+        failed = set()  # addresses that failed THIS request
+        while True:
+            remaining_ms, timeout = self._budget(request, t0)
+            now = self._clock()
+            rep = self._acquire_replica(now, exclude=failed)
+            if rep is None and failed:
+                # every live replica failed this request once already;
+                # forgive and re-pick — the breaker/lease state decides
+                failed = set()
+                rep = self._acquire_replica(now)
+            if rep is None:
+                self.telemetry.count("shed")
+                raise RouterError(
+                    "RESOURCE_EXHAUSTED",
+                    "no healthy replicas in rotation (shed)",
+                )
+            sub = self._sub_request(request, remaining_ms)
+            try:
+                resp = self._dispatch_maybe_hedged(rep, sub, timeout,
+                                                   now, failed)
+                self.telemetry.count("completed")
+                return resp
+            except Exception as e:  # noqa: BLE001 - classified below
+                failed.add(rep.address)
+                retryable = (is_transient_rpc_error(e)
+                             or is_backpressure_rpc_error(e))
+                spent_deadline = (
+                    request.deadline_ms > 0
+                    and _code_name(e, "") == "DEADLINE_EXCEEDED"
+                )
+                if not retryable or spent_deadline:
+                    self._raise_terminal(e)
+                if self._clock() >= window_ends:
+                    logger.error(
+                        "router giving up on request after %d "
+                        "re-dispatches over %.0fs window",
+                        attempt, self.config.redispatch_window_secs,
+                    )
+                    self._raise_terminal(e)
+                self.telemetry.count("redispatched")
+                delay = min(self._policy.backoff(attempt),
+                            max(0.0, window_ends - self._clock()))
+                self._sleep(delay)
+                attempt += 1
+
+    def _dispatch_maybe_hedged(self, primary, sub, timeout, now, failed):
+        """One attempt. With hedging enabled and a second replica in
+        rotation, a primary that hasn't answered inside hedge_delay is
+        duplicated; first success wins (duplicates are harmless — both
+        would return the same tokens). Raises the primary's error when
+        every leg failed."""
+        if self.config.hedge_delay_secs <= 0:
+            return self._call_unary(primary, sub, timeout)
+        results = _queue.Queue()
+
+        def leg(rep):
+            try:
+                results.put(("ok", rep, self._call_unary(rep, sub,
+                                                         timeout)))
+            except Exception as e:  # noqa: BLE001 - the datum
+                results.put(("err", rep, e))
+
+        threading.Thread(target=leg, args=(primary,), daemon=True).start()
+        outstanding, hedged = 1, False
+        primary_err = None
+        while outstanding:
+            try:
+                wait = (self.config.hedge_delay_secs if not hedged
+                        else timeout + 5.0)
+                kind, rep, payload = results.get(timeout=wait)
+            except _queue.Empty:
+                if hedged:
+                    raise RouterError(
+                        "DEADLINE_EXCEEDED",
+                        "hedged dispatch timed out on every leg",
+                    )
+                hedged = True
+                hedge_rep = self._acquire_replica(
+                    self._clock(),
+                    exclude=set(failed) | {primary.address},
+                )
+                if hedge_rep is not None:
+                    self.telemetry.count("hedges")
+                    threading.Thread(
+                        target=leg, args=(hedge_rep,), daemon=True
+                    ).start()
+                    outstanding += 1
+                continue
+            outstanding -= 1
+            if kind == "ok":
+                if rep is not primary:
+                    self.telemetry.count("hedge_wins")
+                return payload
+            if rep is primary:
+                primary_err = payload
+        raise primary_err if primary_err is not None else payload
+
+    def dispatch_stream(self, request):
+        """Streaming generate. Re-dispatch is allowed only BEFORE the
+        first chunk reaches the client: after that, a replay would
+        duplicate delivered tokens, so a mid-stream replica loss fails
+        the stream EXPLICITLY (UNAVAILABLE + token count) instead —
+        never silently truncated, never hung."""
+        self.telemetry.count("routed")
+        t0 = self._clock()
+        window_ends = t0 + self.config.redispatch_window_secs
+        attempt = 0
+        failed = set()
+
+        def gen():
+            nonlocal attempt, failed
+            delivered = 0
+            while True:
+                remaining_ms, timeout = self._budget(request, t0)
+                now = self._clock()
+                rep = self._acquire_replica(now, exclude=failed)
+                if rep is None and failed:
+                    failed = set()
+                    rep = self._acquire_replica(now)
+                if rep is None:
+                    self.telemetry.count("shed")
+                    raise RouterError(
+                        "RESOURCE_EXHAUSTED",
+                        "no healthy replicas in rotation (shed)",
+                    )
+                rep.begin_dispatch()
+                try:
+                    stream = rep.stub.generate_stream(
+                        self._sub_request(request, remaining_ms),
+                        timeout=timeout,
+                    )
+                    for chunk in stream:
+                        delivered += len(chunk.tokens)
+                        yield chunk
+                    self._on_success(rep)
+                    self.telemetry.count("completed")
+                    return
+                except Exception as e:  # noqa: BLE001 - classified
+                    self._on_failure(rep, e)
+                    failed.add(rep.address)
+                    if delivered:
+                        self.telemetry.count("errors")
+                        raise RouterError(
+                            "UNAVAILABLE",
+                            "replica %s lost mid-stream after %d "
+                            "delivered tokens (%s)"
+                            % (rep.address, delivered, _code_name(e)),
+                        )
+                    retryable = (is_transient_rpc_error(e)
+                                 or is_backpressure_rpc_error(e))
+                    spent_deadline = (
+                        request.deadline_ms > 0
+                        and _code_name(e, "") == "DEADLINE_EXCEEDED"
+                    )
+                    if not retryable or spent_deadline:
+                        self._raise_terminal(e)
+                    if self._clock() >= window_ends:
+                        self._raise_terminal(e)
+                    self.telemetry.count("redispatched")
+                    delay = min(self._policy.backoff(attempt),
+                                max(0.0, window_ends - self._clock()))
+                    self._sleep(delay)
+                    attempt += 1
+                finally:
+                    # also covers a client abandoning the generator
+                    # (GeneratorExit is not an Exception)
+                    rep.end_dispatch()
+
+        return gen()
+
+    # ----------------------------------------------------------- status
+
+    def status_response(self):
+        now = self._clock()
+        snap = self.telemetry.snapshot()
+        reps = []
+        for rep in sorted(self.replicas(), key=lambda r: r.address):
+            reps.append(pb.ReplicaStatus(
+                address=rep.address,
+                healthy=rep.in_rotation(now),
+                draining=rep.draining,
+                breaker=rep.breaker.state,
+                lease_remaining_secs=max(
+                    0.0, rep.lease_expires_at - now
+                ),
+                queue_depth=rep.queue_depth,
+                active_slots=rep.active_slots,
+                kv_blocks_free=rep.kv_blocks_free,
+                queue_wait_ms=rep.queue_wait_ms,
+                dispatched=rep.dispatched,
+                failures=rep.failures,
+                inflight=rep.inflight,
+            ))
+        return pb.RouterStatusResponse(
+            replicas=len(reps),
+            healthy=sum(1 for r in reps if r.healthy),
+            replica=reps,
+            routed=snap["routed"],
+            completed=snap["completed"],
+            redispatched=snap["redispatched"],
+            hedges=snap["hedges"],
+            hedge_wins=snap["hedge_wins"],
+            shed=snap["shed"],
+            breaker_trips=snap["breaker_trips"],
+            uptime_secs=snap["uptime_secs"],
+        )
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self, grpc_server=True, injector=None):
+        self._heartbeat = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="router-heartbeat",
+        )
+        self._heartbeat.start()
+        servicer = RouterServicer(self)
+        # EDL_FAULT_SPEC arms drop/error/delay/kill at the router
+        # boundary under the router_* RPC names; replica-name rules
+        # never fire here (and vice versa)
+        self.servicer = maybe_wrap_servicer(
+            servicer, injector, rpcs=SERVING_RPCS
+        )
+        if grpc_server:
+            from elasticdl_tpu.proto.service import (
+                add_router_servicer_to_server,
+                build_server,
+            )
+
+            server = build_server(
+                futures.ThreadPoolExecutor(
+                    max_workers=self.config.max_workers
+                )
+            )
+            add_router_servicer_to_server(self.servicer, server)
+            self.port = server.add_insecure_port(
+                "[::]:%d" % self.config.port
+            )
+            server.start()
+            self._server = server
+            logger.info(
+                "Serving router started on port %d (%d replicas, "
+                "poll=%.2fs lease=%.2fs)", self.port,
+                len(self.replicas()), self.config.poll_secs,
+                self.config.lease_secs,
+            )
+        return self
+
+    def stop(self, grace=5.0):
+        self._stop.set()
+        if self._heartbeat is not None:
+            self._heartbeat.join(timeout=10.0)
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+        self.telemetry.close()
+
+
+class RouterServicer(object):
+    """gRPC handlers for the Router service (proto/service.py Router
+    table). Same in-process/real-transport duality as the replica
+    servicer: context=None raises RouterError to the caller, a real
+    context gets an abort with the mapped status code."""
+
+    def __init__(self, router):
+        self._router = router
+
+    def router_generate(self, request, context=None):
+        try:
+            return self._router.dispatch_generate(request)
+        except RouterError as e:
+            self._fail(context, e.code, str(e))
+
+    def router_generate_stream(self, request, context=None):
+        inner = self._router.dispatch_stream(request)
+
+        def stream():
+            try:
+                for chunk in inner:
+                    yield chunk
+            except RouterError as e:
+                self._fail(context, e.code, str(e))
+
+        return stream()
+
+    def router_status(self, request, context=None):
+        return self._router.status_response()
+
+    def _fail(self, context, code_name, message):
+        if context is not None:
+            import grpc
+
+            context.abort(
+                getattr(grpc.StatusCode, code_name,
+                        grpc.StatusCode.UNKNOWN),
+                message,
+            )
+        raise RouterError(code_name, message)

@@ -1,0 +1,90 @@
+"""Routing-tier process entrypoint.
+
+Stands the health-checked router (serving/router.py) in front of N
+generation-server replicas and serves RouterGenerate /
+RouterGenerateStream / RouterStatus until SIGTERM/SIGINT. Pure
+control-plane: no jax, no model — the process starts in milliseconds
+and can sit in front of replicas on any mix of hosts.
+
+    python -m elasticdl_tpu.serving.router_main \\
+        --replica localhost:50051 --replica localhost:50052 \\
+        --replica localhost:50053 --port 50050
+
+Fault injection at the router boundary uses the same EDL_FAULT_SPEC
+grammar as every other drill, under the router RPC names:
+EDL_FAULT_SPEC='router_generate:error:2' rejects two routed calls
+without touching any replica.
+"""
+
+import argparse
+import signal
+import sys
+import threading
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.serving.router import Router, RouterConfig
+
+
+def parse_router_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="elasticdl-tpu serving router"
+    )
+    parser.add_argument("--replica", action="append", default=[],
+                        help="replica address host:port (repeatable)")
+    parser.add_argument("--port", type=int, default=50050)
+    parser.add_argument("--poll_secs", type=float, default=0.5)
+    parser.add_argument("--poll_timeout_secs", type=float, default=2.0)
+    parser.add_argument("--lease_secs", type=float, default=2.5)
+    parser.add_argument("--breaker_threshold", type=int, default=3)
+    parser.add_argument("--breaker_cooldown_secs", type=float,
+                        default=2.0)
+    parser.add_argument("--hedge_delay_ms", type=float, default=0.0,
+                        help="0 disables hedged duplicate dispatch")
+    parser.add_argument("--dispatch_timeout_secs", type=float,
+                        default=120.0)
+    parser.add_argument("--redispatch_window_secs", type=float,
+                        default=30.0)
+    parser.add_argument("--tensorboard_log_dir", default="")
+    parsed = parser.parse_args(args)
+    if not parsed.replica:
+        parser.error("at least one --replica is required")
+    return parsed
+
+
+def build_router(args):
+    return Router(
+        args.replica,
+        RouterConfig(
+            poll_secs=args.poll_secs,
+            poll_timeout_secs=args.poll_timeout_secs,
+            lease_secs=args.lease_secs,
+            breaker_threshold=args.breaker_threshold,
+            breaker_cooldown_secs=args.breaker_cooldown_secs,
+            hedge_delay_secs=args.hedge_delay_ms / 1000.0,
+            dispatch_timeout_secs=args.dispatch_timeout_secs,
+            redispatch_window_secs=args.redispatch_window_secs,
+            port=args.port,
+            telemetry_dir=args.tensorboard_log_dir,
+        ),
+    )
+
+
+def main(argv=None):
+    args = parse_router_args(argv)
+    router = build_router(args).start()
+    done = threading.Event()
+
+    def _graceful(_signum, _frame):
+        logger.info("signal received: stopping router")
+        done.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    print("ROUTER_READY port=%d" % router.port, flush=True)
+    done.wait()
+    router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,6 +105,14 @@ class _Scheduler(threading.Thread):
         self._stop_requested = threading.Event()
         self._drain = True
         self.crashed = None
+        # drain advertisement (ServerStatus.draining): set for good on
+        # SIGTERM drain, and transiently around a hot-reload swap — a
+        # router takes a draining replica out of rotation for NEW
+        # requests while in-flight streams finish
+        self._draining = threading.Event()
+
+    def is_draining(self):
+        return self._draining.is_set()
 
     def run(self):
         try:
@@ -122,8 +130,17 @@ class _Scheduler(threading.Thread):
             reloaded = self.watcher.poll()
             if reloaded is not None:
                 state, version = reloaded
-                self.engine.set_params(state, version)
-                self.telemetry.count("reloads")
+                # advertise draining across the swap so routers route
+                # new work elsewhere while the reload applies (cleared
+                # unless a SIGTERM drain is also underway)
+                already = self._draining.is_set()
+                self._draining.set()
+                try:
+                    self.engine.set_params(state, version)
+                    self.telemetry.count("reloads")
+                finally:
+                    if not already:
+                        self._draining.clear()
         now = self._clock()
         for req in self.engine.evict_expired(now):
             self.telemetry.count("expired")
@@ -161,6 +178,8 @@ class _Scheduler(threading.Thread):
                         "deadline expired while queued"))
             if req is None:
                 break
+            req.seated_at = self._clock()
+            self.telemetry.record_queue_wait(req.queue_wait_secs())
             slot, first, finished = self.engine.insert(req)
             self.telemetry.record_ttft(req)
             # the prefill produced this token; step() only counts the
@@ -205,6 +224,7 @@ class _Scheduler(threading.Thread):
 
     def stop(self, drain=True):
         self._drain = drain
+        self._draining.set()  # advertise BEFORE admission closes
         self._stop_requested.set()
         self.queue.wake()  # wake the idle wait so shutdown is prompt
 
@@ -215,13 +235,15 @@ class ServingServicer(object):
     the caller) — the same duality the master servicer tests use."""
 
     def __init__(self, queue, engine, telemetry, scheduler_alive,
-                 handler_poll_secs=0.25, clock=time.monotonic):
+                 handler_poll_secs=0.25, clock=time.monotonic,
+                 draining=None):
         self._queue = queue
         self._engine = engine
         self._telemetry = telemetry
         self._scheduler_alive = scheduler_alive
         self._poll = handler_poll_secs
         self._clock = clock
+        self._draining = draining or (lambda: False)
 
     # ------------------------------------------------------------- RPCs
 
@@ -272,6 +294,8 @@ class ServingServicer(object):
             kv_bytes_in_use=kv["kv_bytes_in_use"],
             kv_bytes_in_use_peak=snap["kv_bytes_in_use_peak"],
             kv_bytes_per_token=snap["kv_bytes_per_token"],
+            draining=self._draining(),
+            queue_wait_ms=snap["queue_wait_ms"],
         )
 
     # --------------------------------------------------------- internals
@@ -377,6 +401,7 @@ class GenerationServer(object):
             self.queue, self.engine, self.telemetry,
             scheduler_alive=self.scheduler.is_alive,
             handler_poll_secs=cfg.handler_poll_secs,
+            draining=self.scheduler.is_draining,
         )
         # EDL_FAULT_SPEC (or an explicit injector) arms drop/error/
         # delay/kill at the RPC boundary, exactly like the master

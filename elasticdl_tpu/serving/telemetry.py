@@ -12,6 +12,8 @@ whole system. Gauges, stepped by decode-step index:
                                at each request's first token)
     serving/kv_bytes_in_use    KV bytes live requests pin at the step
     serving/kv_blocks_free     paged pool's free blocks at the step
+    serving/queue_wait_ms      EWMA of time-queued-before-seating (the
+                               router's load signal; ServerStatus field)
     serving/admitted_total     monotone counters, one scalar per flush
     serving/rejected_total
     serving/expired_total
@@ -59,6 +61,8 @@ class ServingTelemetry(object):
         self.max_active_slots = 0
         self.kv_bytes_in_use_peak = 0
         self._kv_byte_steps = 0  # sum of kv_bytes_in_use over steps
+        self._queue_wait_ewma_ms = 0.0
+        self._queue_waits_seen = 0
         self._step = 0
         self._window_tokens = 0
         self._window_t0 = clock()
@@ -88,6 +92,28 @@ class ServingTelemetry(object):
         with self._lock:
             self._scalar("serving/ttft_ms", ttft_ms, self._step)
         return ttft_ms
+
+    # EWMA, not a running mean: the router reads this as a LOAD signal,
+    # so it must track the current regime, not the lifetime average
+    QUEUE_WAIT_ALPHA = 0.3
+
+    def record_queue_wait(self, wait_secs):
+        """Time one request spent queued before seating. Feeds the
+        queue_wait_ms EWMA the router folds into least-loaded routing
+        (ServerStatus.queue_wait_ms)."""
+        wait_ms = wait_secs * 1000.0
+        with self._lock:
+            if self._queue_waits_seen == 0:
+                self._queue_wait_ewma_ms = wait_ms
+            else:
+                a = self.QUEUE_WAIT_ALPHA
+                self._queue_wait_ewma_ms = (
+                    a * wait_ms + (1.0 - a) * self._queue_wait_ewma_ms
+                )
+            self._queue_waits_seen += 1
+            self._scalar("serving/queue_wait_ms",
+                         self._queue_wait_ewma_ms, self._step)
+        return wait_ms
 
     def record_step(self, queue_depth, active_slots, step_secs,
                     tokens_committed, kv_bytes_in_use=None,
@@ -143,6 +169,82 @@ class ServingTelemetry(object):
                 self._kv_byte_steps
                 / max(1, self.counters["tokens_generated"])
             )
+            snap["queue_wait_ms"] = self._queue_wait_ewma_ms
+            return snap
+
+    def close(self):
+        with self._lock:
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+
+class RouterTelemetry(object):
+    """The routing tier's gauges/counters on the same event path.
+
+    Gauges, stepped by heartbeat-poll index (the router has no decode
+    steps — its clock is the lease-renewal loop):
+
+        router/healthy_replicas   replicas in rotation at the poll
+        router/replicas           registered replicas
+        router/routed_total       monotone counters, one scalar per
+        router/completed_total    flush (routed = accepted dispatches,
+        router/redispatched_total completed = returned OK, redispatched
+        router/hedges_total       = re-sent after a replica failure,
+        router/hedge_wins_total   shed = RESOURCE_EXHAUSTED with no
+        router/shed_total         healthy replica, breaker_trips =
+        router/breaker_trips_total  closed->open transitions)
+
+    Counters back the router_status RPC via snapshot() — like the
+    replica telemetry, the RPC must work with the writer disabled."""
+
+    COUNTERS = ("routed", "completed", "redispatched", "hedges",
+                "hedge_wins", "shed", "breaker_trips", "errors")
+
+    def __init__(self, log_dir=None, flush_every=20, clock=time.monotonic):
+        self._log_dir = log_dir
+        self._flush_every = max(1, int(flush_every))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._writer = None
+        self._started = clock()
+        self._poll = 0
+        self.counters = {name: 0 for name in self.COUNTERS}
+
+    def _ensure_writer(self):
+        if self._writer is None and self._log_dir:
+            self._writer = EventFileWriter(
+                self._log_dir, filename_suffix=".router"
+            )
+        return self._writer
+
+    def _scalar(self, tag, value, step):
+        writer = self._ensure_writer()
+        if writer is not None:
+            writer.add_scalar(tag, float(value), step)
+
+    def count(self, name, n=1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def record_poll(self, healthy, replicas):
+        """One heartbeat sweep: rotation-size gauges now, counters
+        every flush_every polls."""
+        with self._lock:
+            self._poll += 1
+            self._scalar("router/healthy_replicas", healthy, self._poll)
+            self._scalar("router/replicas", replicas, self._poll)
+            if self._poll % self._flush_every == 0:
+                for name, value in self.counters.items():
+                    self._scalar(
+                        "router/%s_total" % name, value, self._poll
+                    )
+
+    def snapshot(self):
+        with self._lock:
+            snap = dict(self.counters)
+            snap["uptime_secs"] = self._clock() - self._started
+            snap["polls"] = self._poll
             return snap
 
     def close(self):

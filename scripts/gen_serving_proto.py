@@ -26,7 +26,8 @@ PB2_PATH = os.path.join(REPO, "elasticdl_tpu", "proto", "elasticdl_pb2.py")
 
 T = descriptor_pb2.FieldDescriptorProto
 
-# message name -> [(field name, number, type, label)]
+# message name -> [(field name, number, type, label[, type_name])]
+# (type_name only for TYPE_MESSAGE fields, fully qualified)
 _OPT, _REP = T.LABEL_OPTIONAL, T.LABEL_REPEATED
 SERVING_MESSAGES = {
     "GenerateRequest": [
@@ -72,15 +73,65 @@ SERVING_MESSAGES = {
         # average KV bytes resident per generated token (sum-over-
         # steps of kv_bytes_in_use / tokens_generated)
         ("kv_bytes_per_token", 20, T.TYPE_DOUBLE, _OPT),
+        # drain advertisement: the replica is finishing in-flight work
+        # (SIGTERM drain / hot-reload swap) — routers take it out of
+        # rotation for NEW requests while existing streams complete
+        ("draining", 21, T.TYPE_BOOL, _OPT),
+        # recent average time requests spend queued before seating (ms,
+        # EWMA) — part of the router's least-loaded signal
+        ("queue_wait_ms", 22, T.TYPE_DOUBLE, _OPT),
+    ],
+    # ---- router tier (serving/router.py) ----
+    "RouterStatusRequest": [],
+    "ReplicaStatus": [
+        ("address", 1, T.TYPE_STRING, _OPT),
+        ("healthy", 2, T.TYPE_BOOL, _OPT),
+        ("draining", 3, T.TYPE_BOOL, _OPT),
+        # circuit breaker state: "closed" | "open" | "half_open"
+        ("breaker", 4, T.TYPE_STRING, _OPT),
+        ("lease_remaining_secs", 5, T.TYPE_DOUBLE, _OPT),
+        ("queue_depth", 6, T.TYPE_INT32, _OPT),
+        ("active_slots", 7, T.TYPE_INT32, _OPT),
+        ("kv_blocks_free", 8, T.TYPE_INT32, _OPT),
+        ("queue_wait_ms", 9, T.TYPE_DOUBLE, _OPT),
+        ("dispatched", 10, T.TYPE_INT64, _OPT),
+        ("failures", 11, T.TYPE_INT64, _OPT),
+        # router-side dispatches currently in flight on this replica
+        ("inflight", 12, T.TYPE_INT32, _OPT),
+    ],
+    "RouterStatusResponse": [
+        ("replicas", 1, T.TYPE_INT32, _OPT),
+        ("healthy", 2, T.TYPE_INT32, _OPT),
+        ("replica", 3, T.TYPE_MESSAGE, _REP, ".elasticdl_tpu.ReplicaStatus"),
+        ("routed", 4, T.TYPE_INT64, _OPT),
+        ("completed", 5, T.TYPE_INT64, _OPT),
+        ("redispatched", 6, T.TYPE_INT64, _OPT),
+        ("hedges", 7, T.TYPE_INT64, _OPT),
+        ("hedge_wins", 8, T.TYPE_INT64, _OPT),
+        ("shed", 9, T.TYPE_INT64, _OPT),
+        ("breaker_trips", 10, T.TYPE_INT64, _OPT),
+        ("uptime_secs", 11, T.TYPE_DOUBLE, _OPT),
     ],
 }
 
-# method name -> (request, response, server_streaming)
-SERVING_METHODS = [
-    ("generate", "GenerateRequest", "GenerateResponse", False),
-    ("generate_stream", "GenerateRequest", "TokenChunk", True),
-    ("server_status", "ServerStatusRequest", "ServerStatusResponse", False),
-]
+# service name -> [(method name, request, response, server_streaming)]
+SERVICES = {
+    "Serving": [
+        ("generate", "GenerateRequest", "GenerateResponse", False),
+        ("generate_stream", "GenerateRequest", "TokenChunk", True),
+        ("server_status", "ServerStatusRequest", "ServerStatusResponse",
+         False),
+    ],
+    # the multi-replica routing tier in front of N Serving replicas;
+    # method names are distinct from the replica surface so
+    # EDL_FAULT_SPEC rules can target one boundary without the other
+    "Router": [
+        ("router_generate", "GenerateRequest", "GenerateResponse", False),
+        ("router_generate_stream", "GenerateRequest", "TokenChunk", True),
+        ("router_status", "RouterStatusRequest", "RouterStatusResponse",
+         False),
+    ],
+}
 
 PB2_TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by the protocol buffer compiler.  DO NOT EDIT!
@@ -136,30 +187,34 @@ def build_descriptor(serialized):
     keep = [m for m in fdp.message_type if m.name not in SERVING_MESSAGES]
     del fdp.message_type[:]
     fdp.message_type.extend(keep)
-    keep_svc = [s for s in fdp.service if s.name != "Serving"]
+    keep_svc = [s for s in fdp.service if s.name not in SERVICES]
     del fdp.service[:]
     fdp.service.extend(keep_svc)
 
     for name, fields in SERVING_MESSAGES.items():
         msg = fdp.message_type.add()
         msg.name = name
-        for fname, num, ftype, label in fields:
+        for spec in fields:
+            fname, num, ftype, label = spec[:4]
             fld = msg.field.add()
             fld.name = fname
             fld.number = num
             fld.type = ftype
             fld.label = label
             fld.json_name = _json_name(fname)
+            if ftype == T.TYPE_MESSAGE:
+                fld.type_name = spec[4]
 
-    svc = fdp.service.add()
-    svc.name = "Serving"
-    for mname, req, resp, streaming in SERVING_METHODS:
-        meth = svc.method.add()
-        meth.name = mname
-        meth.input_type = ".elasticdl_tpu.%s" % req
-        meth.output_type = ".elasticdl_tpu.%s" % resp
-        if streaming:
-            meth.server_streaming = True
+    for sname, methods in SERVICES.items():
+        svc = fdp.service.add()
+        svc.name = sname
+        for mname, req, resp, streaming in methods:
+            meth = svc.method.add()
+            meth.name = mname
+            meth.input_type = ".elasticdl_tpu.%s" % req
+            meth.output_type = ".elasticdl_tpu.%s" % resp
+            if streaming:
+                meth.server_streaming = True
     return fdp.SerializeToString()
 
 

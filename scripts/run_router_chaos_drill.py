@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""Multi-replica ROUTER chaos drill: zero accepted-request loss under
+replica churn.
+
+Runs the real stack as subprocesses — three `elasticdl_tpu.serving.main`
+replicas behind one `elasticdl_tpu.serving.router_main` router — fires
+an open-loop Poisson stream of unary generates at the ROUTER, and while
+the load is live:
+
+  * SIGSTOPs one replica, bursts requests so the router provably has
+    dispatches in flight on it (the in-flight component of the load
+    score spreads a burst across all replicas), then SIGKILLs it — the
+    stalled dispatches die UNAVAILABLE and MUST be re-dispatched to a
+    surviving replica before anything reaches the client;
+  * drops a fresh checkpoint into a second replica's --checkpoint_dir
+    (the hot-reload path: the replica advertises `draining` across the
+    swap and keeps its streams).
+
+The asserted invariant is the router's contract: every request the
+router ACCEPTED terminates with OK or an EXPLICIT status
+(RESOURCE_EXHAUSTED shed / DEADLINE_EXCEEDED) — never a raw transport
+error (UNAVAILABLE/CANCELLED), never a hang. A majority must complete
+OK (two replicas survive), at least one request must have been
+RE-DISPATCHED (proof the chaos path actually ran), the SIGKILL'd
+replica must leave the rotation, and the reloaded replica must report
+the new version.
+
+Runs TWICE: dense KV pool and block-paged pool (EDL_KV_PAGED), like
+the single-replica kill drill.
+
+Usage: python scripts/run_router_chaos_drill.py
+Exit 0 = the invariant holds in both modes."""
+
+import os
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from run_server_kill_drill import MODEL_PARAMS, launch_ready  # noqa: E402
+
+NUM_REPLICAS = 3
+REQUESTS = 24
+RATE_RPS = 10.0
+MAX_NEW = 16
+CLIENT_TIMEOUT = 120.0  # backstop; the drill asserts we stay far under
+WARMUP_REQS = 6  # Poisson-paced requests before the chaos window
+BURST_REQS = 6  # back-to-back burst fired at the SIGSTOPped victim
+RELOAD_AFTER = 14  # save the hot-reload checkpoint after this many
+
+
+def start_replica(ckpt_dir=None, extra_env=None):
+    cmd = [
+        sys.executable, "-m", "elasticdl_tpu.serving.main",
+        "--model_zoo", os.path.join(REPO, "model_zoo"),
+        "--model_def", "transformer_lm.transformer_lm.custom_model",
+        "--model_params", MODEL_PARAMS,
+        "--port", "0", "--num_slots", "2", "--queue_capacity", "16",
+    ]
+    if ckpt_dir:
+        cmd += ["--checkpoint_dir", ckpt_dir,
+                "--reload_poll_secs", "0.3"]
+    return launch_ready(cmd, extra_env=extra_env)
+
+
+def start_router(replica_ports, extra_env=None):
+    cmd = [
+        sys.executable, "-m", "elasticdl_tpu.serving.router_main",
+        "--port", "0", "--poll_secs", "0.25", "--lease_secs", "1.5",
+        "--breaker_cooldown_secs", "1.0",
+        "--redispatch_window_secs", "60",
+    ]
+    for p in replica_ports:
+        cmd += ["--replica", "localhost:%d" % p]
+    return launch_ready(cmd, extra_env=extra_env,
+                        ready_marker="ROUTER_READY")
+
+
+def build_checkpoint_state():
+    """Trainer state matching the replicas' model — the hot-reload
+    payload. Built ONCE (jax import + init are the slow part); saving
+    it mid-drill is just serialization."""
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.common.model_utils import (
+        load_model_spec_from_module,
+    )
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+    from elasticdl_tpu.training.trainer import Trainer
+    from model_zoo.transformer_lm import transformer_lm as zoo
+
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(load_model_spec_from_module(zoo), mesh=mesh,
+                      model_params=MODEL_PARAMS)
+    seq_len = int(trainer.model.seq_len)
+    dummy = np.zeros((1, seq_len), np.int32)
+    return trainer.init_state(({"tokens": dummy}, dummy))
+
+
+def warm(port):
+    """One direct generate per replica outside the measurement: pays
+    the jit compile so the chaos window exercises routing, not XLA."""
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import ServingStub, build_channel
+
+    stub = ServingStub(build_channel("localhost:%d" % port))
+    stub.generate(
+        pb.GenerateRequest(prompt=[1, 2], max_new_tokens=2), timeout=300
+    )
+    return stub
+
+
+def run_mode(mode, mode_env, state, tmp_root):
+    import grpc
+    import numpy as np
+
+    from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import RouterStub, build_channel
+
+    print("[chaos:%s] starting %d replicas + router"
+          % (mode, NUM_REPLICAS))
+    reload_dir = os.path.join(tmp_root, "ckpt_%s" % mode)
+    os.makedirs(reload_dir, exist_ok=True)
+    replicas = []
+    try:
+        for i in range(NUM_REPLICAS):
+            proc, port = start_replica(
+                ckpt_dir=reload_dir if i == 1 else None,
+                extra_env=mode_env,
+            )
+            replicas.append([proc, port, None])
+        for rep in replicas:
+            rep[2] = warm(rep[1])
+        router_proc, router_port = start_router(
+            [r[1] for r in replicas], extra_env=mode_env
+        )
+        replicas.append([router_proc, router_port, None])  # for cleanup
+        stub = RouterStub(build_channel("localhost:%d" % router_port))
+        stub.router_status(pb.RouterStatusRequest(), timeout=10)
+
+        rs = np.random.RandomState(0)
+        outcomes = {}
+        lock = threading.Lock()
+
+        def call(i):
+            try:
+                stub.router_generate(
+                    pb.GenerateRequest(
+                        prompt=[1 + i % 5, 2],
+                        max_new_tokens=4 + i % (MAX_NEW - 3),
+                        seed=i,
+                    ),
+                    timeout=CLIENT_TIMEOUT,
+                )
+                code = "OK"
+            except grpc.RpcError as e:
+                code = e.code().name
+            with lock:
+                outcomes[i] = code
+
+        threads = []
+        t0 = time.monotonic()
+
+        def launch(i, gap):
+            if gap:
+                time.sleep(float(rs.exponential(1.0 / RATE_RPS)))
+            t = threading.Thread(target=call, args=(i,))
+            t.start()
+            threads.append(t)
+
+        i = 0
+        # phase A: Poisson-paced warmup through the router
+        for _ in range(WARMUP_REQS):
+            launch(i, gap=True)
+            i += 1
+        # chaos window. SIGSTOP freezes the victim: it stops answering
+        # (and polling its way back to a fresh lease) but its sockets
+        # stay open, so burst dispatches routed to it STALL in flight —
+        # the in-flight load component spreads the burst over all three
+        # replicas, so at least one request is provably stalled there.
+        # The SIGKILL then tears the sockets down mid-flight:
+        # UNAVAILABLE -> re-dispatch, never a client-visible loss.
+        print("[chaos:%s] SIGSTOP replica 0 (port %d), bursting %d "
+              "requests" % (mode, replicas[0][1], BURST_REQS))
+        replicas[0][0].send_signal(signal.SIGSTOP)
+        for _ in range(BURST_REQS):
+            launch(i, gap=False)
+            i += 1
+        time.sleep(0.5)  # let burst dispatches reach the stalled victim
+        print("[chaos:%s] SIGKILL replica 0 mid-flight" % mode)
+        replicas[0][0].kill()
+        # phase B: Poisson-paced tail over the two survivors
+        reloaded = False
+        while i < REQUESTS:
+            launch(i, gap=True)
+            i += 1
+            if i >= RELOAD_AFTER and not reloaded:
+                print("[chaos:%s] dropping checkpoint v1 -> replica 1 "
+                      "hot reload" % mode)
+                CheckpointSaver(reload_dir, checkpoint_steps=1).save(
+                    state, 1
+                )
+                reloaded = True
+
+        for t in threads:
+            t.join(timeout=CLIENT_TIMEOUT + 30)
+        elapsed = time.monotonic() - t0
+        hung = [t for t in threads if t.is_alive()]
+        if hung:
+            raise AssertionError(
+                "[chaos:%s] %d client threads HUNG" % (mode, len(hung))
+            )
+        codes = sorted(outcomes.values())
+        ok = codes.count("OK")
+        print("[chaos:%s] outcomes=%s elapsed=%.1fs" %
+              (mode, {c: codes.count(c) for c in set(codes)}, elapsed))
+
+        # THE invariant: zero accepted-request loss. Explicit statuses
+        # only — a raw transport code leaking through the router means
+        # a request was lost rather than re-dispatched or shed.
+        allowed = {"OK", "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+        leaked = set(codes) - allowed
+        assert not leaked, (
+            "accepted requests LOST (transport codes leaked through "
+            "the router): %s" % leaked
+        )
+        assert len(outcomes) == REQUESTS, (
+            "only %d/%d clients terminated" % (len(outcomes), REQUESTS)
+        )
+        assert ok >= REQUESTS // 2, (
+            "too few completions for a 2-survivor fleet: %d/%d OK"
+            % (ok, REQUESTS)
+        )
+        assert elapsed < CLIENT_TIMEOUT - 10, "clients rode the timeout"
+
+        # the SIGKILL'd replica must be OUT of rotation (lease decay)
+        deadline = time.time() + 10
+        status = None
+        while time.time() < deadline:
+            status = stub.router_status(
+                pb.RouterStatusRequest(), timeout=10
+            )
+            if status.healthy <= NUM_REPLICAS - 1:
+                break
+            time.sleep(0.3)
+        assert status.healthy <= NUM_REPLICAS - 1, (
+            "router still counts the SIGKILL'd replica healthy: %s"
+            % status
+        )
+        print("[chaos:%s] router: routed=%d completed=%d "
+              "redispatched=%d shed=%d breaker_trips=%d healthy=%d/%d"
+              % (mode, status.routed, status.completed,
+                 status.redispatched, status.shed,
+                 status.breaker_trips, status.healthy, status.replicas))
+        assert status.routed >= REQUESTS
+        # proof the chaos path ran: the SIGKILL caught stalled
+        # dispatches, and every one of them was re-dispatched (the OK
+        # outcomes above show none of it reached a client)
+        assert status.redispatched >= 1, (
+            "SIGKILL never caught an in-flight dispatch — the drill "
+            "exercised nothing"
+        )
+
+        # the hot-reloaded replica must be serving the new version
+        rep1 = replicas[1][2]
+        deadline = time.time() + 20
+        reloads = 0
+        while time.time() < deadline:
+            st = rep1.server_status(pb.ServerStatusRequest(), timeout=10)
+            reloads = st.reloads
+            if reloads >= 1:
+                break
+            time.sleep(0.3)
+        assert reloads >= 1, "replica 1 never hot-reloaded"
+        print("[chaos:%s] replica 1 hot-reloaded (reloads=%d) with "
+              "zero request loss" % (mode, reloads))
+
+        # graceful teardown: SIGTERM everything still alive; the
+        # survivors drain and exit 0
+        for proc, _port, _stub in replicas:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc, _port, _stub in replicas[1:]:
+            rc = proc.wait(timeout=60)
+            assert rc == 0, "graceful exit must return 0, got %s" % rc
+        assert replicas[0][0].wait(timeout=10) != 0  # SIGKILL, by design
+    finally:
+        for entry in replicas:
+            if entry[0].poll() is None:
+                entry[0].kill()
+    print("[chaos:%s] PASSED" % mode)
+
+
+def main():
+    import tempfile
+
+    state = build_checkpoint_state()
+    with tempfile.TemporaryDirectory(prefix="edl_chaos_") as tmp_root:
+        for mode, env in (
+            ("dense", {"EDL_KV_PAGED": "0"}),
+            ("paged", {"EDL_KV_PAGED": "1"}),
+        ):
+            run_mode(mode, env, state, tmp_root)
+    print("[chaos] router chaos drill PASSED (dense + paged): zero "
+          "accepted-request loss under SIGKILL + hot reload")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

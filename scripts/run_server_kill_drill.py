@@ -45,12 +45,45 @@ MODEL_PARAMS = (
 CLIENT_TIMEOUT = 60.0  # backstop; the drill asserts we never get near it
 
 
-def start_server(extra_env=None):
+def launch_ready(cmd, extra_env=None, ready_marker="SERVING_READY",
+                 startup_secs=180):
+    """Start a drill subprocess and wait for its `<marker> port=N`
+    readiness line; returns (proc, port) with the pipe drained in the
+    background so the child can't block on a full buffer. Shared by
+    this drill and scripts/run_router_chaos_drill.py."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PYTHONPATH", None)
     env.update(extra_env or {})
     proc = subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    port = None
+    deadline = time.time() + startup_secs
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "process died during startup (rc=%s)"
+                    % proc.returncode
+                )
+            continue
+        if line.startswith(ready_marker):
+            port = int(line.strip().split("port=")[1])
+            break
+    if port is None:
+        proc.kill()
+        raise RuntimeError("process never became ready: %r" % cmd)
+    threading.Thread(
+        target=lambda: [None for _ in proc.stdout], daemon=True
+    ).start()
+    return proc, port
+
+
+def start_server(extra_env=None):
+    return launch_ready(
         [
             sys.executable, "-m", "elasticdl_tpu.serving.main",
             "--model_zoo", os.path.join(REPO, "model_zoo"),
@@ -58,30 +91,8 @@ def start_server(extra_env=None):
             "--model_params", MODEL_PARAMS,
             "--port", "0", "--num_slots", "1", "--queue_capacity", "4",
         ],
-        cwd=REPO, env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        extra_env=extra_env,
     )
-    port = None
-    deadline = time.time() + 180
-    while time.time() < deadline:
-        line = proc.stdout.readline()
-        if not line:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    "server died during startup (rc=%s)" % proc.returncode
-                )
-            continue
-        if line.startswith("SERVING_READY"):
-            port = int(line.strip().split("port=")[1])
-            break
-    if port is None:
-        proc.kill()
-        raise RuntimeError("server never became ready")
-    # drain the pipe so the child can't block on a full buffer
-    threading.Thread(
-        target=lambda: [None for _ in proc.stdout], daemon=True
-    ).start()
-    return proc, port
 
 
 def fire_requests(port, n, max_new=24):

@@ -20,6 +20,8 @@ from elasticdl_tpu.common.fault_injection import (
 )
 from elasticdl_tpu.common.retry import (
     RetryPolicy,
+    is_backpressure_rpc_error,
+    is_retryable_rpc_error,
     is_transient_rpc_error,
     retry_call,
 )
@@ -107,6 +109,69 @@ def test_is_transient_rpc_error_classification():
     assert not is_transient_rpc_error(
         InjectedRpcError(grpc.StatusCode.INVALID_ARGUMENT, "x"))
     assert not is_transient_rpc_error(ValueError("x"))
+
+
+def test_backpressure_is_distinct_from_transient():
+    """RESOURCE_EXHAUSTED is backpressure from a LIVE server: retryable
+    (the router re-routes on it) but NOT transient (a single-target
+    retry loop into a full queue is just more load, and the router must
+    not charge it against a replica's circuit breaker)."""
+    full = InjectedRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED, "full")
+    assert is_backpressure_rpc_error(full)
+    assert not is_transient_rpc_error(full)
+    assert is_retryable_rpc_error(full)
+    down = InjectedRpcError(grpc.StatusCode.UNAVAILABLE, "down")
+    assert not is_backpressure_rpc_error(down)
+    assert is_retryable_rpc_error(down)
+    assert not is_backpressure_rpc_error(ValueError("x"))
+    assert not is_retryable_rpc_error(
+        InjectedRpcError(grpc.StatusCode.INVALID_ARGUMENT, "x"))
+
+
+def test_retry_call_window_edge_clamp_gives_one_final_attempt(
+        monkeypatch):
+    """Regression: a backoff delay clamped to the reconnect-window edge
+    must still buy exactly ONE final attempt — the clamp exists so the
+    last attempt lands just inside the window, not so the caller loses
+    it (or gets extras past the window)."""
+    # pin the jitter draw to the cap so the clamp is guaranteed to
+    # engage (full jitter would otherwise occasionally draw under the
+    # window and sneak in a third attempt)
+    import elasticdl_tpu.common.retry as retry_mod
+
+    monkeypatch.setattr(retry_mod.random, "uniform", lambda a, b: b)
+    clock = {"t": 0.0}
+    sleeps = []
+
+    def fake_clock():
+        return clock["t"]
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock["t"] += s
+
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise InjectedRpcError(grpc.StatusCode.UNAVAILABLE, "down")
+
+    # base delay far larger than the window: the very first backoff is
+    # clamped from 100s down to exactly the 1.0s window remainder
+    with pytest.raises(InjectedRpcError):
+        retry_call(
+            always_down,
+            policy=RetryPolicy(reconnect_window_secs=1.0,
+                               base_delay_secs=100.0,
+                               max_delay_secs=100.0),
+            sleep=fake_sleep,
+            clock=fake_clock,
+        )
+    # attempt 0 at t=0, one clamped sleep to the edge, final attempt at
+    # t=1.0 (now >= deadline -> raise). Exactly 2 calls, never 1 or 3.
+    assert calls["n"] == 2
+    assert len(sleeps) == 1 and sleeps[0] <= 1.0
+    assert clock["t"] == pytest.approx(1.0)
 
 
 def test_backoff_is_bounded():

@@ -1,0 +1,525 @@
+"""Router tier unit tests (tier-1: no jax, no sockets — in-process
+fake replica stubs drive serving/router.py).
+
+Locks the ISSUE's robustness semantics: lease expiry removes silent
+replicas, breaker trip/half-open/close, re-dispatch before first
+token (unary and stream), drain-aware rotation removal, all-breakers-
+open shed-load, backpressure rerouting without breaker damage, hedged
+dispatch, and fault injection at the router RPC boundary."""
+
+import threading
+
+import grpc
+import pytest
+
+from elasticdl_tpu.common.fault_injection import (
+    SERVING_RPCS,
+    FaultInjector,
+    InjectedRpcError,
+    maybe_wrap_servicer,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.serving.router import (
+    CircuitBreaker,
+    Router,
+    RouterConfig,
+    RouterError,
+    RouterServicer,
+)
+
+
+class FakeClock(object):
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _unavailable(msg="replica down"):
+    return InjectedRpcError(grpc.StatusCode.UNAVAILABLE, msg)
+
+
+def _exhausted(msg="queue full"):
+    return InjectedRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED, msg)
+
+
+def _invalid(msg="bad request"):
+    return InjectedRpcError(grpc.StatusCode.INVALID_ARGUMENT, msg)
+
+
+class FakeReplicaStub(object):
+    """ServingStub-shaped fake: scripted failures, scripted status."""
+
+    def __init__(self, token):
+        self.token = token  # marks which replica answered
+        self.poll_ok = True
+        self.draining = False
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.kv_blocks_free = 0
+        self.queue_wait_ms = 0.0
+        self.gen_errors = []  # exceptions raised by upcoming generates
+        self.stream_errors = []
+        self.stream_fail_after_chunks = None
+        self.calls = 0
+        self.block_until = None  # Event: generate blocks until set
+
+    def server_status(self, request, timeout=None):
+        if not self.poll_ok:
+            raise _unavailable("poll down")
+        return pb.ServerStatusResponse(
+            queue_depth=self.queue_depth,
+            active_slots=self.active_slots,
+            kv_blocks_free=self.kv_blocks_free,
+            queue_wait_ms=self.queue_wait_ms,
+            draining=self.draining,
+        )
+
+    def generate(self, request, timeout=None):
+        self.calls += 1
+        if self.block_until is not None:
+            assert self.block_until.wait(5.0)
+        if self.gen_errors:
+            raise self.gen_errors.pop(0)
+        return pb.GenerateResponse(
+            tokens=list(request.prompt) + [self.token], model_version=1
+        )
+
+    def generate_stream(self, request, timeout=None):
+        self.calls += 1
+        if self.stream_errors:
+            raise self.stream_errors.pop(0)
+
+        def chunks():
+            for i in range(request.max_new_tokens):
+                if self.stream_fail_after_chunks is not None \
+                        and i >= self.stream_fail_after_chunks:
+                    raise _unavailable("died mid-stream")
+                yield pb.TokenChunk(tokens=[self.token + i],
+                                    model_version=1)
+            yield pb.TokenChunk(tokens=[], done=True, model_version=1)
+
+        return chunks()
+
+
+def make_router(n=2, clock=None, advance_on_sleep=True, **cfg_kwargs):
+    """Router over n fake replicas with a fake clock; sleeps advance
+    the clock so backoff/window logic runs without real waiting."""
+    clock = clock or FakeClock()
+    stubs = {"rep%d" % i: FakeReplicaStub(token=100 * (i + 1))
+             for i in range(n)}
+    cfg = RouterConfig(
+        lease_secs=10.0, breaker_threshold=2,
+        breaker_cooldown_secs=5.0, redispatch_window_secs=8.0,
+        base_delay_secs=0.01, max_delay_secs=0.05, **cfg_kwargs
+    )
+    sleep = clock.advance if advance_on_sleep else (lambda s: None)
+    router = Router(
+        sorted(stubs), config=cfg, stub_factory=lambda a: stubs[a],
+        clock=clock, sleep=sleep,
+    )
+    return router, stubs, clock
+
+
+def _req(prompt=(1, 2), new=3, deadline_ms=0):
+    return pb.GenerateRequest(prompt=list(prompt), max_new_tokens=new,
+                              deadline_ms=deadline_ms)
+
+
+# ------------------------------------------------------- circuit breaker
+
+
+def test_breaker_trip_half_open_close_cycle():
+    b = CircuitBreaker(threshold=3, cooldown_secs=2.0)
+    now = 0.0
+    assert b.state == CircuitBreaker.CLOSED
+    assert not b.record_failure(now)
+    assert not b.record_failure(now)
+    assert b.record_failure(now)  # third consecutive -> trips
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.eligible(now + 1.0)  # cooldown running
+    assert b.eligible(now + 2.0)  # cooldown elapsed
+    # half-open admits exactly ONE probe
+    assert b.acquire(now + 2.0)
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert not b.eligible(now + 2.0)  # probe in flight
+    assert not b.acquire(now + 2.0)
+    assert b.record_success()  # probe wins -> CLOSED
+    assert b.state == CircuitBreaker.CLOSED and b.failures == 0
+
+
+def test_breaker_half_open_failure_reopens():
+    b = CircuitBreaker(threshold=1, cooldown_secs=2.0)
+    b.record_failure(0.0)
+    assert b.state == CircuitBreaker.OPEN
+    assert b.acquire(2.5)
+    assert b.state == CircuitBreaker.HALF_OPEN
+    # the probe fails -> straight back to OPEN with a fresh cooldown
+    assert b.record_failure(2.5)
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.eligible(4.0)  # cooldown restarted at 2.5
+    assert b.eligible(4.6)
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker(threshold=2, cooldown_secs=1.0)
+    b.record_failure(0.0)
+    b.record_success()
+    # the streak broke: one more failure must NOT trip
+    assert not b.record_failure(0.0)
+    assert b.state == CircuitBreaker.CLOSED
+
+
+# ---------------------------------------------------------------- routing
+
+
+def test_least_loaded_routing():
+    router, stubs, _ = make_router(2)
+    stubs["rep0"].queue_depth = 5
+    stubs["rep1"].queue_depth = 0
+    router.poll_once()
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 200]  # rep1 answered
+    assert stubs["rep1"].calls == 1 and stubs["rep0"].calls == 0
+
+
+def test_inflight_dispatches_spread_ties():
+    """Polled load freezes between heartbeats; the router's own
+    in-flight count must break ties or every request in a poll window
+    herds onto one replica."""
+    router, stubs, _ = make_router(2)
+    router.poll_once()
+    reps = {r.address: r for r in router.replicas()}
+    gate = threading.Event()
+    stubs["rep0"].block_until = gate
+    stubs["rep1"].block_until = gate
+    done = []
+    ts = [threading.Thread(
+        target=lambda: done.append(router.dispatch_generate(_req()))
+    ) for _ in range(2)]
+    for t in ts:
+        t.start()
+    import time as _time
+    t0 = _time.monotonic()
+    while _time.monotonic() - t0 < 2.0:
+        if reps["rep0"].inflight == 1 and reps["rep1"].inflight == 1:
+            break
+        _time.sleep(0.005)
+    spread = (reps["rep0"].inflight, reps["rep1"].inflight)
+    gate.set()
+    for t in ts:
+        t.join(timeout=5)
+    assert spread == (1, 1)  # one each, not two on the tie-winner
+    assert reps["rep0"].inflight == reps["rep1"].inflight == 0
+    assert len(done) == 2
+
+
+def test_queue_wait_signal_breaks_depth_ties():
+    router, stubs, _ = make_router(2)
+    # equal depth, but rep0's requests WAIT far longer before seating
+    stubs["rep0"].queue_depth = stubs["rep1"].queue_depth = 2
+    stubs["rep0"].queue_wait_ms = 500.0
+    router.poll_once()
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 200]
+
+
+def test_lease_expiry_removes_silent_replica():
+    router, stubs, clock = make_router(2)
+    router.poll_once()
+    # rep0 stops answering polls; its lease decays with no explicit
+    # death signal
+    stubs["rep0"].poll_ok = False
+    clock.advance(11.0)  # past lease_secs=10
+    router.poll_once()  # renews rep1 only
+    reps = {r.address: r for r in router.replicas()}
+    assert not reps["rep0"].lease_ok(clock())
+    assert reps["rep1"].lease_ok(clock())
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 200]
+    assert stubs["rep0"].calls == 0
+
+
+def test_all_leases_expired_sheds():
+    router, stubs, clock = make_router(2)
+    for s in stubs.values():
+        s.poll_ok = False
+    clock.advance(11.0)
+    router.poll_once()
+    with pytest.raises(RouterError) as e:
+        router.dispatch_generate(_req())
+    assert e.value.code == "RESOURCE_EXHAUSTED"
+    assert router.telemetry.snapshot()["shed"] == 1
+
+
+def test_redispatch_on_transient_failure_before_first_token():
+    """The headline invariant: an accepted request survives its first
+    replica dying — re-dispatched, the client sees a normal OK."""
+    router, stubs, _ = make_router(2)
+    router.poll_once()
+    # make rep0 the preferred target, then kill its dispatch
+    stubs["rep1"].queue_depth = 3
+    router.poll_once()
+    stubs["rep0"].gen_errors.append(_unavailable())
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 200]  # rep1 rescued it
+    snap = router.telemetry.snapshot()
+    assert snap["redispatched"] == 1 and snap["completed"] == 1
+
+
+def test_backpressure_reroutes_without_breaker_damage():
+    router, stubs, _ = make_router(2)
+    stubs["rep1"].queue_depth = 3
+    router.poll_once()
+    stubs["rep0"].gen_errors.append(_exhausted())
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 200]
+    # RESOURCE_EXHAUSTED is a live replica shedding — not a breaker hit
+    reps = {r.address: r for r in router.replicas()}
+    assert reps["rep0"].breaker.state == CircuitBreaker.CLOSED
+    assert reps["rep0"].breaker.failures == 0
+    assert router.telemetry.snapshot()["breaker_trips"] == 0
+
+
+def test_invalid_argument_propagates_without_redispatch():
+    router, stubs, _ = make_router(2)
+    stubs["rep1"].queue_depth = 3
+    router.poll_once()
+    stubs["rep0"].gen_errors.append(_invalid())
+    with pytest.raises(RouterError) as e:
+        router.dispatch_generate(_req())
+    assert e.value.code == "INVALID_ARGUMENT"
+    assert stubs["rep1"].calls == 0  # never re-dispatched
+    assert router.telemetry.snapshot()["redispatched"] == 0
+
+
+def test_breaker_trips_then_half_open_probe_closes():
+    router, stubs, clock = make_router(1)
+    router.poll_once()
+    rep = router.replicas()[0]
+    # threshold=2 consecutive transient failures trip the breaker; the
+    # dispatch loop itself retries until the window (8s) expires
+    stubs["rep0"].gen_errors = [_unavailable() for _ in range(50)]
+    with pytest.raises(RouterError):
+        router.dispatch_generate(_req())
+    assert rep.breaker.state == CircuitBreaker.OPEN
+    assert router.telemetry.snapshot()["breaker_trips"] == 1
+    # while OPEN inside the cooldown: immediate shed, no dispatch
+    stubs["rep0"].gen_errors = []
+    calls_before = stubs["rep0"].calls
+    router.poll_once()  # poll renews the lease; breaker stays open
+    with pytest.raises(RouterError) as e:
+        router.dispatch_generate(_req())
+    assert e.value.code == "RESOURCE_EXHAUSTED"
+    assert stubs["rep0"].calls == calls_before
+    # cooldown elapses -> HALF_OPEN probe goes through and CLOSES it
+    clock.advance(router.config.breaker_cooldown_secs + 0.1)
+    router.poll_once()
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 100]
+    assert rep.breaker.state == CircuitBreaker.CLOSED
+
+
+def test_all_breakers_open_sheds_immediately():
+    router, stubs, _ = make_router(2)
+    router.poll_once()
+    for s in stubs.values():
+        s.gen_errors = [_unavailable() for _ in range(50)]
+    with pytest.raises(RouterError) as e:
+        router.dispatch_generate(_req())
+    # both breakers tripped during the retry loop; the terminal error
+    # is either the shed (both open) or the exhausted window
+    assert e.value.code in ("RESOURCE_EXHAUSTED", "UNAVAILABLE")
+    for r in router.replicas():
+        assert r.breaker.state == CircuitBreaker.OPEN
+    for s in stubs.values():
+        s.gen_errors = []
+    with pytest.raises(RouterError) as e:
+        router.dispatch_generate(_req())
+    assert e.value.code == "RESOURCE_EXHAUSTED"
+    assert str(e.value).startswith("no healthy replicas")
+
+
+def test_drain_advertisement_removes_from_rotation():
+    router, stubs, _ = make_router(2)
+    stubs["rep0"].draining = True
+    router.poll_once()
+    for _ in range(3):
+        resp = router.dispatch_generate(_req())
+        assert list(resp.tokens) == [1, 2, 200]
+    assert stubs["rep0"].calls == 0
+    # drain completes (restart/reload done) -> back in rotation
+    stubs["rep0"].draining = False
+    stubs["rep1"].queue_depth = 5
+    router.poll_once()
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 100]
+
+
+def test_all_draining_sheds():
+    router, stubs, _ = make_router(2)
+    for s in stubs.values():
+        s.draining = True
+    router.poll_once()
+    with pytest.raises(RouterError) as e:
+        router.dispatch_generate(_req())
+    assert e.value.code == "RESOURCE_EXHAUSTED"
+
+
+# --------------------------------------------------------------- streams
+
+
+def test_stream_redispatch_before_first_token():
+    router, stubs, _ = make_router(2)
+    stubs["rep1"].queue_depth = 3
+    router.poll_once()
+    stubs["rep0"].stream_errors.append(_unavailable())
+    chunks = list(router.dispatch_stream(_req(new=3)))
+    tokens = [t for c in chunks for t in c.tokens]
+    assert tokens == [200, 201, 202]
+    assert chunks[-1].done
+    assert router.telemetry.snapshot()["redispatched"] == 1
+
+
+def test_stream_failure_after_first_token_is_explicit():
+    """Past the first delivered chunk a replay would duplicate tokens:
+    the stream must fail LOUDLY, not re-dispatch and not hang."""
+    router, stubs, _ = make_router(2)
+    stubs["rep1"].queue_depth = 3
+    router.poll_once()
+    stubs["rep0"].stream_fail_after_chunks = 2
+    got = []
+    with pytest.raises(RouterError) as e:
+        for chunk in router.dispatch_stream(_req(new=5)):
+            got.extend(chunk.tokens)
+    assert got == [100, 101]  # the delivered prefix stands
+    assert e.value.code == "UNAVAILABLE"
+    assert "mid-stream after 2" in str(e.value)
+    assert stubs["rep1"].calls == 0  # no replay to another replica
+
+
+# --------------------------------------------------------------- hedging
+
+
+def test_hedged_dispatch_second_replica_wins():
+    router, stubs, clock = make_router(
+        2, advance_on_sleep=False, hedge_delay_secs=0.05
+    )
+    router.poll_once()
+    stubs["rep1"].queue_depth = 3  # rep0 is primary
+    router.poll_once()
+    gate = threading.Event()
+    stubs["rep0"].block_until = gate  # primary stalls
+    try:
+        resp = router.dispatch_generate(_req())
+    finally:
+        gate.set()  # release the stalled primary thread
+    assert list(resp.tokens) == [1, 2, 200]  # the hedge answered
+    snap = router.telemetry.snapshot()
+    assert snap["hedges"] == 1 and snap["hedge_wins"] == 1
+
+
+def test_hedged_dispatch_primary_wins_without_hedge():
+    router, stubs, _ = make_router(
+        2, advance_on_sleep=False, hedge_delay_secs=5.0
+    )
+    router.poll_once()
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens)[-1] in (100, 200)
+    snap = router.telemetry.snapshot()
+    assert snap["hedges"] == 0 and snap["hedge_wins"] == 0
+
+
+# ------------------------------------------------------ servicer / proto
+
+
+def test_router_servicer_and_status_response():
+    router, stubs, _ = make_router(2)
+    stubs["rep0"].draining = True
+    router.poll_once()
+    servicer = RouterServicer(router)
+    resp = servicer.router_generate(_req())
+    assert list(resp.tokens) == [1, 2, 200]
+    chunks = list(servicer.router_generate_stream(_req(new=2)))
+    assert chunks[-1].done
+    st = servicer.router_status(pb.RouterStatusRequest())
+    assert st.replicas == 2 and st.healthy == 1
+    assert st.routed == 2 and st.completed == 2
+    by_addr = {r.address: r for r in st.replica}
+    assert by_addr["rep0"].draining and not by_addr["rep0"].healthy
+    assert by_addr["rep1"].healthy
+    assert by_addr["rep1"].breaker == "closed"
+    # round-trips through the wire format
+    st2 = pb.RouterStatusResponse.FromString(st.SerializeToString())
+    assert st2.replica[0].address in ("rep0", "rep1")
+
+
+def test_router_servicer_maps_shed_to_admission_error():
+    router, stubs, clock = make_router(1)
+    stubs["rep0"].poll_ok = False
+    clock.advance(11.0)
+    router.poll_once()
+    with pytest.raises(RouterError) as e:
+        RouterServicer(router).router_generate(_req())
+    assert e.value.code == "RESOURCE_EXHAUSTED"
+
+
+# ------------------------------------------------------- fault injection
+
+
+class _EchoReplicaServicer(object):
+    def generate(self, request, _context=None):
+        return pb.GenerateResponse(tokens=list(request.prompt))
+
+    def generate_stream(self, request, _context=None):
+        return iter([pb.TokenChunk(tokens=list(request.prompt))])
+
+    def server_status(self, request, _context=None):
+        return pb.ServerStatusResponse(num_slots=1)
+
+
+def test_fault_spec_targets_router_without_touching_replicas():
+    """A spec naming only router_* RPCs must fire at the router
+    boundary and leave replica servicers completely untouched — the
+    names are disjoint by design."""
+    spec = "router_generate:drop:1;router_status:error:1"
+    # replica servicer wrapped with the SAME tuple: no router_* attrs,
+    # so nothing intercepts and every replica RPC flows untouched
+    replica_inj = FaultInjector(spec=spec)
+    replica = maybe_wrap_servicer(
+        _EchoReplicaServicer(), replica_inj, rpcs=SERVING_RPCS
+    )
+    for _ in range(3):
+        assert list(replica.generate(_req(prompt=[7])).tokens) == [7]
+    assert replica.server_status(pb.ServerStatusRequest()).num_slots == 1
+    assert replica_inj.injected == {}
+    # the router servicer DOES get intercepted under the same spec
+    router, _stubs, _ = make_router(2)
+    router.poll_once()
+    router_inj = FaultInjector(spec=spec)
+    wrapped = maybe_wrap_servicer(
+        RouterServicer(router), router_inj, rpcs=SERVING_RPCS
+    )
+    with pytest.raises(InjectedRpcError):
+        wrapped.router_generate(_req())
+    assert list(wrapped.router_generate(_req()).tokens)[-1] in (100, 200)
+    with pytest.raises(InjectedRpcError):
+        wrapped.router_status(pb.RouterStatusRequest())
+    assert router_inj.injected == {
+        "router_generate": 1, "router_status": 1
+    }
+
+
+def test_router_start_stop_in_process():
+    router, _stubs, _ = make_router(2)
+    router.start(grpc_server=False)
+    try:
+        assert router.servicer is not None
+        resp = router.servicer.router_generate(_req())
+        assert len(resp.tokens) == 3
+    finally:
+        router.stop()

@@ -22,6 +22,7 @@ from elasticdl_tpu.serving.admission import (
     RequestQueue,
     ServingRequest,
 )
+from elasticdl_tpu.serving.server import ServingServicer, _Scheduler
 from elasticdl_tpu.serving.telemetry import ServingTelemetry
 
 
@@ -166,7 +167,156 @@ def test_request_event_plumbing():
     assert _req().request_id != _req().request_id
 
 
-# ------------------------------------------------------------ telemetry
+# ------------------------------------------- scheduler deadline semantics
+
+
+class FakeEngine(object):
+    """One-slot engine stand-in: enough surface for _Scheduler and
+    ServingServicer without jax or a compiled step."""
+
+    def __init__(self):
+        self.num_slots = 1
+        self.seq_len = 16
+        self.model_version = 0
+        self.reloaded = []
+        self._slot = None
+
+    def free_slots(self):
+        return [] if self._slot is not None else [0]
+
+    def can_seat(self, request):
+        return True
+
+    def insert(self, request):
+        self._slot = request
+        return 0, 11, False
+
+    def evict_expired(self, now):
+        if self._slot is not None and self._slot.expired(now):
+            req, self._slot = self._slot, None
+            return [req]
+        return []
+
+    def active_count(self):
+        return 0 if self._slot is None else 1
+
+    def active_requests(self):
+        return [] if self._slot is None else [self._slot]
+
+    def step(self):
+        if self._slot is None:
+            return []
+        return [(0, self._slot, 12, False)]
+
+    def set_params(self, state, version):
+        self.reloaded.append(version)
+        self.model_version = version
+
+    def max_cached_tokens(self):
+        return self.seq_len
+
+    def kv_stats(self):
+        return {"kv_paged": False, "kv_block_size": 0,
+                "kv_blocks_total": 0, "kv_blocks_free": 0,
+                "kv_bytes_total": 0, "kv_bytes_in_use": 0}
+
+
+def _rig(clock):
+    engine = FakeEngine()
+    queue = RequestQueue(capacity=4, seq_len=16, clock=clock)
+    telemetry = ServingTelemetry(log_dir=None, clock=clock)
+    sched = _Scheduler(engine, queue, telemetry, idle_wait_secs=0.001,
+                       clock=clock)
+    return engine, queue, telemetry, sched
+
+
+def test_deadline_expired_while_queued_gets_explicit_error():
+    """Expiry path 1: the request never seats — the scheduler must
+    push DEADLINE_EXCEEDED when it pops the corpse, so the handler
+    terminates with an explicit status."""
+    clock = FakeClock()
+    engine, queue, telemetry, sched = _rig(clock)
+    doomed = _req(deadline_ms=100, clock=clock)
+    queue.submit(doomed)
+    clock.t += 1.0  # expires in the queue, before any slot frees
+    sched._iterate()
+    ev = doomed.next_event(timeout=0)
+    assert ev == ("error", "DEADLINE_EXCEEDED",
+                  "deadline expired while queued")
+    assert telemetry.snapshot()["expired"] == 1
+    assert engine.active_count() == 0  # never seated
+
+
+def test_deadline_expired_while_executing_gets_explicit_error():
+    """Expiry path 2: the request seats, decodes, and expires
+    mid-flight — the scheduler evicts it between steps with
+    DEADLINE_EXCEEDED; delivered tokens stand."""
+    clock = FakeClock()
+    engine, queue, telemetry, sched = _rig(clock)
+    req = _req(deadline_ms=500, clock=clock)
+    queue.submit(req)
+    sched._iterate()  # seats + prefill token + one decode step
+    assert engine.active_count() == 1
+    assert req.next_event(timeout=0)[0] == "tokens"
+    clock.t += 1.0  # deadline passes mid-decode
+    sched._iterate()
+    assert engine.active_count() == 0  # slot freed for live work
+    events = []
+    while True:
+        ev = req.next_event(timeout=0)
+        if ev is None:
+            break
+        events.append(ev)
+    assert ("error", "DEADLINE_EXCEEDED",
+            "deadline expired mid-decode") in events
+    assert telemetry.snapshot()["expired"] == 1
+
+
+def test_scheduler_records_queue_wait_and_snapshot_surfaces_it():
+    clock = FakeClock()
+    engine, queue, telemetry, sched = _rig(clock)
+    req = _req(clock=clock)
+    queue.submit(req)
+    clock.t += 0.2  # 200 ms queued before the scheduler seats it
+    sched._iterate()
+    assert req.seated_at == clock.t
+    assert req.queue_wait_secs() == pytest.approx(0.2)
+    snap = telemetry.snapshot()
+    assert snap["queue_wait_ms"] == pytest.approx(200.0)
+    # the servicer surfaces the same number on the status RPC —
+    # the router's load signal
+    servicer = ServingServicer(queue, engine, telemetry,
+                               scheduler_alive=lambda: True,
+                               clock=clock,
+                               draining=sched.is_draining)
+    st = servicer.server_status(pb.ServerStatusRequest())
+    assert st.queue_wait_ms == pytest.approx(200.0)
+    assert not st.draining
+
+
+def test_scheduler_advertises_draining_on_stop_and_reload():
+    clock = FakeClock()
+    engine, queue, telemetry, sched = _rig(clock)
+
+    class OneShotWatcher(object):
+        def __init__(self):
+            self.pending = ("new-state", 7)
+
+        def poll(self):
+            out, self.pending = self.pending, None
+            return out
+
+    sched.watcher = OneShotWatcher()
+    seen = []
+    engine.set_params = lambda state, version: seen.append(
+        (version, sched.is_draining())
+    )
+    assert not sched.is_draining()
+    sched._iterate()  # reload applies WITH draining advertised
+    assert seen == [(7, True)]
+    assert not sched.is_draining()  # transient: cleared after the swap
+    sched.stop(drain=True)  # SIGTERM path: advertised for good
+    assert sched.is_draining()
 
 
 def test_telemetry_counters_and_snapshot():
