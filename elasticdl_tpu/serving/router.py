@@ -16,7 +16,9 @@ has to time out.
 Mechanisms, each its own small state machine:
 
 * **Leases** — a heartbeat loop polls every replica's `server_status`
-  each `poll_secs`; a successful poll renews the replica's lease for
+  each `poll_secs`, concurrently (a wedged replica never stalls the
+  others' renewals; the sweep is bounded regardless of replica
+  count); a successful poll renews the replica's lease for
   `lease_secs` and refreshes its load signals (queue depth, active
   slots, kv_blocks_free, queue_wait_ms EWMA) and drain flag. A replica
   whose lease expires — crashed, wedged, partitioned — leaves the
@@ -32,10 +34,13 @@ Mechanisms, each its own small state machine:
 * **Circuit breakers** — per replica, CLOSED -> OPEN after
   `breaker_threshold` CONSECUTIVE transient dispatch failures; OPEN
   rejects dispatch for `breaker_cooldown_secs`, then HALF_OPEN admits
-  exactly one probe request — success closes the breaker, failure
-  re-opens it and restarts the cooldown. RESOURCE_EXHAUSTED
-  (backpressure from a live replica) re-routes but does NOT count
-  against the breaker: the replica is healthy, its capacity is not.
+  exactly one probe request — success closes the breaker, a transient
+  failure re-opens it and restarts the cooldown, and any OTHER
+  outcome releases the probe slot (a leaked slot would evict the
+  replica forever). RESOURCE_EXHAUSTED (backpressure from a live
+  replica) re-routes but does NOT count against the breaker: the
+  replica answered, so it is healthy — its capacity is not — and on a
+  half-open probe that proof of life closes the breaker.
 
 * **Bounded re-dispatch** — every dispatch failure is classified with
   common/retry.py: transient (UNAVAILABLE/CANCELLED/timeout) and
@@ -100,9 +105,13 @@ class RouterError(AdmissionError):
 
 class RouterConfig(object):
     """Routing-tier knobs. lease_secs should cover a few poll periods
-    (a single dropped poll must not evict a healthy replica);
-    redispatch_window_secs bounds the TOTAL time one request may spend
-    being re-dispatched before its last error propagates."""
+    (a single dropped poll must not evict a healthy replica); the
+    heartbeat polls replicas concurrently and caps each sweep at
+    min(poll_timeout_secs, lease_secs / 2), so lease safety never
+    depends on replica count — keep lease_secs > poll_timeout_secs /
+    2 + poll_secs so one wedged-replica sweep cannot outlast a healthy
+    lease. redispatch_window_secs bounds the TOTAL time one request
+    may spend being re-dispatched before its last error propagates."""
 
     def __init__(self, poll_secs=0.5, poll_timeout_secs=2.0,
                  lease_secs=2.5, breaker_threshold=3,
@@ -130,8 +139,9 @@ class RouterConfig(object):
 class CircuitBreaker(object):
     """Per-replica breaker: CLOSED -> OPEN on `threshold` CONSECUTIVE
     transient failures; OPEN -> HALF_OPEN after `cooldown_secs`;
-    HALF_OPEN admits ONE in-flight probe — success closes, failure
-    re-opens and restarts the cooldown."""
+    HALF_OPEN admits ONE in-flight probe — success closes, transient
+    failure re-opens and restarts the cooldown, and release_probe
+    frees the slot for outcomes that judge neither way."""
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
@@ -178,6 +188,16 @@ class CircuitBreaker(object):
             self._probe_inflight = False
             return closed_now
 
+    def release_probe(self):
+        """Release a held probe slot WITHOUT judging the replica. Every
+        dispatch outcome must land in exactly one of record_success /
+        record_failure / release_probe: a HALF_OPEN probe that fails
+        for a reason that says nothing about transport health (e.g.
+        INVALID_ARGUMENT) would otherwise pin _probe_inflight forever
+        and evict the replica from rotation permanently."""
+        with self._lock:
+            self._probe_inflight = False
+
     def record_failure(self, now):
         """One transient dispatch failure; True when this TRIPS the
         breaker (closed/half-open -> open)."""
@@ -217,6 +237,20 @@ class Replica(object):
         # window breaks to the same replica and requests herd
         self.inflight = 0
         self._inflight_lock = threading.Lock()
+        # one status poll in flight at a time: a wedged replica must
+        # not accumulate a poll thread per sweep
+        self._poll_inflight = False
+
+    def begin_poll(self):
+        with self._inflight_lock:
+            if self._poll_inflight:
+                return False
+            self._poll_inflight = True
+            return True
+
+    def end_poll(self):
+        with self._inflight_lock:
+            self._poll_inflight = False
 
     def begin_dispatch(self):
         with self._inflight_lock:
@@ -323,22 +357,49 @@ class Router(object):
 
     # -------------------------------------------------------- heartbeat
 
+    def _poll_replica(self, rep):
+        try:
+            status = rep.stub.server_status(
+                pb.ServerStatusRequest(),
+                timeout=self.config.poll_timeout_secs,
+            )
+            rep.observe(
+                status, self._clock() + self.config.lease_secs
+            )
+        except Exception as e:  # noqa: BLE001 - silence = lease decay
+            rep.poll_failures += 1
+            logger.debug("router poll %s failed: %r", rep.address, e)
+        finally:
+            rep.end_poll()
+
     def poll_once(self):
         """One heartbeat sweep: renew leases + load signals from every
         replica that answers server_status; silence lets the lease
-        decay. Returns the number of in-rotation replicas."""
+        decay. Replicas are polled CONCURRENTLY (one thread each) — a
+        wedged replica must never stall the others' lease renewals;
+        polled sequentially, the sweep period would grow with
+        replica_count * poll_timeout and healthy replicas would be
+        spuriously evicted whenever two or more replicas hung. The
+        sweep itself waits at most min(poll_timeout, lease/2)
+        regardless of replica count; a straggler's renewal still lands
+        when its thread finally returns, and a replica whose previous
+        poll is STILL in flight is skipped rather than re-polled.
+        Returns the number of in-rotation replicas."""
+        spawned = []
         for rep in self.replicas():
-            try:
-                status = rep.stub.server_status(
-                    pb.ServerStatusRequest(),
-                    timeout=self.config.poll_timeout_secs,
-                )
-                rep.observe(
-                    status, self._clock() + self.config.lease_secs
-                )
-            except Exception as e:  # noqa: BLE001 - silence = lease decay
-                rep.poll_failures += 1
-                logger.debug("router poll %s failed: %r", rep.address, e)
+            if not rep.begin_poll():
+                continue  # previous poll still stuck on this replica
+            t = threading.Thread(
+                target=self._poll_replica, args=(rep,), daemon=True,
+                name="router-poll-%s" % rep.address,
+            )
+            t.start()
+            spawned.append(t)
+        deadline = time.monotonic() + min(
+            self.config.poll_timeout_secs, self.config.lease_secs / 2.0
+        )
+        for t in spawned:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         now = self._clock()
         healthy = sum(1 for r in self.replicas() if r.in_rotation(now))
         self.telemetry.record_poll(healthy, len(self.replicas()))
@@ -402,6 +463,10 @@ class Router(object):
         rep.breaker.record_success()
 
     def _on_failure(self, rep, exc):
+        """Breaker accounting for one failed dispatch. Every outcome
+        must settle the breaker — in particular a HALF_OPEN probe slot
+        is released on EVERY path, or the replica is silently evicted
+        from rotation forever."""
         rep.failures += 1
         now = self._clock()
         if is_transient_rpc_error(exc):
@@ -412,8 +477,19 @@ class Router(object):
                     "transient failures (%r)",
                     rep.address, rep.breaker.failures, exc,
                 )
-        # backpressure: the replica is alive and explicitly shedding —
-        # re-route without charging its breaker
+        elif is_backpressure_rpc_error(exc):
+            # backpressure: the replica answered — it is alive and
+            # explicitly shedding. A live answer is success as far as
+            # the TRANSPORT breaker is concerned: it closes a half-open
+            # probe and breaks the consecutive-transient streak; the
+            # dispatch loop re-routes toward capacity elsewhere.
+            rep.breaker.record_success()
+        else:
+            # non-transient application error (INVALID_ARGUMENT, a
+            # spent client deadline): says nothing about transport
+            # health, so leave the breaker state alone — but release a
+            # held probe slot so HALF_OPEN can probe again
+            rep.breaker.release_probe()
 
     def _call_unary(self, rep, sub, timeout):
         rep.begin_dispatch()
@@ -535,6 +611,10 @@ class Router(object):
                 if rep is not primary:
                     self.telemetry.count("hedge_wins")
                 return payload
+            # either leg failing marks its replica failed for THIS
+            # request, so a later re-dispatch skips a hedge replica
+            # already known bad instead of burning an attempt on it
+            failed.add(rep.address)
             if rep is primary:
                 primary_err = payload
         raise primary_err if primary_err is not None else payload

@@ -105,14 +105,18 @@ class _Scheduler(threading.Thread):
         self._stop_requested = threading.Event()
         self._drain = True
         self.crashed = None
-        # drain advertisement (ServerStatus.draining): set for good on
-        # SIGTERM drain, and transiently around a hot-reload swap — a
-        # router takes a draining replica out of rotation for NEW
-        # requests while in-flight streams finish
-        self._draining = threading.Event()
+        # drain advertisement (ServerStatus.draining): a router takes a
+        # draining replica out of rotation for NEW requests while
+        # in-flight streams finish. Two independent sources, tracked
+        # SEPARATELY so they cannot clobber each other: _stopping is
+        # set for good on SIGTERM drain, _reloading only spans a
+        # hot-reload swap — a reload finishing while stop() lands
+        # concurrently must not clear the permanent advertisement.
+        self._stopping = threading.Event()
+        self._reloading = threading.Event()
 
     def is_draining(self):
-        return self._draining.is_set()
+        return self._stopping.is_set() or self._reloading.is_set()
 
     def run(self):
         try:
@@ -131,16 +135,15 @@ class _Scheduler(threading.Thread):
             if reloaded is not None:
                 state, version = reloaded
                 # advertise draining across the swap so routers route
-                # new work elsewhere while the reload applies (cleared
-                # unless a SIGTERM drain is also underway)
-                already = self._draining.is_set()
-                self._draining.set()
+                # new work elsewhere while the reload applies; only the
+                # reload's OWN flag clears, so a SIGTERM drain that
+                # starts mid-swap stays advertised
+                self._reloading.set()
                 try:
                     self.engine.set_params(state, version)
                     self.telemetry.count("reloads")
                 finally:
-                    if not already:
-                        self._draining.clear()
+                    self._reloading.clear()
         now = self._clock()
         for req in self.engine.evict_expired(now):
             self.telemetry.count("expired")
@@ -224,7 +227,7 @@ class _Scheduler(threading.Thread):
 
     def stop(self, drain=True):
         self._drain = drain
-        self._draining.set()  # advertise BEFORE admission closes
+        self._stopping.set()  # advertise BEFORE admission closes
         self._stop_requested.set()
         self.queue.wake()  # wake the idle wait so shutdown is prompt
 

@@ -67,8 +67,13 @@ class FakeReplicaStub(object):
         self.stream_fail_after_chunks = None
         self.calls = 0
         self.block_until = None  # Event: generate blocks until set
+        self.status_calls = 0
+        self.status_block_until = None  # Event: status blocks until set
 
     def server_status(self, request, timeout=None):
+        self.status_calls += 1
+        if self.status_block_until is not None:
+            assert self.status_block_until.wait(5.0)
         if not self.poll_ok:
             raise _unavailable("poll down")
         return pb.ServerStatusResponse(
@@ -174,6 +179,22 @@ def test_breaker_success_resets_consecutive_count():
     assert b.state == CircuitBreaker.CLOSED
 
 
+def test_breaker_release_probe_frees_slot_without_judging():
+    b = CircuitBreaker(threshold=1, cooldown_secs=2.0)
+    b.record_failure(0.0)
+    assert b.acquire(2.5)
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert not b.eligible(2.5)  # the single probe slot is held
+    # the probe failed for a reason that says nothing about transport
+    # health: the slot frees, the state does not move
+    b.release_probe()
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert b.eligible(2.5)
+    assert b.acquire(2.5)  # the NEXT probe can run
+    assert b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+
+
 # ---------------------------------------------------------------- routing
 
 
@@ -256,6 +277,39 @@ def test_all_leases_expired_sheds():
     assert router.telemetry.snapshot()["shed"] == 1
 
 
+def test_wedged_replica_does_not_stall_sweep_or_pile_up_polls():
+    """Regression: polls ran sequentially, so each wedged (SIGSTOPped)
+    replica stalled the sweep by up to poll_timeout and healthy
+    replicas' leases could expire un-renewed. Polls are concurrent and
+    bounded now, and a replica whose previous poll is still in flight
+    is skipped rather than re-polled every sweep."""
+    router, stubs, clock = make_router(2, poll_timeout_secs=0.2)
+    gate = threading.Event()
+    stubs["rep0"].status_block_until = gate
+    try:
+        clock.advance(11.0)  # both registration leases decayed
+        import time as _time
+        t0 = _time.monotonic()
+        router.poll_once()
+        elapsed = _time.monotonic() - t0
+        # the sweep is bounded by poll_timeout, not by the wedged stub
+        assert elapsed < 2.0
+        reps = {r.address: r for r in router.replicas()}
+        # rep1 renewed concurrently despite rep0 hanging; rep0 decays
+        assert reps["rep1"].lease_ok(clock())
+        assert not reps["rep0"].lease_ok(clock())
+        resp = router.dispatch_generate(_req())
+        assert list(resp.tokens) == [1, 2, 200]
+        # later sweeps skip the still-stuck poll instead of stacking a
+        # fresh thread onto the wedged replica every period
+        router.poll_once()
+        router.poll_once()
+        assert stubs["rep0"].status_calls == 1
+        assert stubs["rep1"].status_calls == 3
+    finally:
+        gate.set()
+
+
 def test_redispatch_on_transient_failure_before_first_token():
     """The headline invariant: an accepted request survives its first
     replica dying — re-dispatched, the client sees a normal OK."""
@@ -319,6 +373,56 @@ def test_breaker_trips_then_half_open_probe_closes():
     # cooldown elapses -> HALF_OPEN probe goes through and CLOSES it
     clock.advance(router.config.breaker_cooldown_secs + 0.1)
     router.poll_once()
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 100]
+    assert rep.breaker.state == CircuitBreaker.CLOSED
+
+
+def test_half_open_probe_non_transient_failure_does_not_leak_slot():
+    """Regression: a HALF_OPEN probe failing with a NON-transient error
+    (INVALID_ARGUMENT) used to leave _probe_inflight set forever — the
+    replica was permanently evicted from rotation and every later
+    request shed despite a healthy backend."""
+    router, stubs, clock = make_router(1)
+    router.poll_once()
+    rep = router.replicas()[0]
+    stubs["rep0"].gen_errors = [_unavailable() for _ in range(50)]
+    with pytest.raises(RouterError):
+        router.dispatch_generate(_req())
+    assert rep.breaker.state == CircuitBreaker.OPEN
+    stubs["rep0"].gen_errors = []
+    clock.advance(router.config.breaker_cooldown_secs + 0.1)
+    router.poll_once()
+    # the probe fails with an application error: it propagates to the
+    # client (never re-dispatched), but the probe slot must release
+    stubs["rep0"].gen_errors = [_invalid()]
+    with pytest.raises(RouterError) as e:
+        router.dispatch_generate(_req())
+    assert e.value.code == "INVALID_ARGUMENT"
+    # the replica is still probe-able: the next dispatch reaches it and
+    # closes the breaker instead of shedding forever
+    calls_before = stubs["rep0"].calls
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 100]
+    assert stubs["rep0"].calls == calls_before + 1
+    assert rep.breaker.state == CircuitBreaker.CLOSED
+
+
+def test_half_open_probe_backpressure_recovers_replica():
+    """A HALF_OPEN probe answered with RESOURCE_EXHAUSTED proves the
+    replica ALIVE (it answered): the breaker closes and the dispatch
+    loop retries into the capacity as it frees — no probe-slot leak,
+    no permanent eviction."""
+    router, stubs, clock = make_router(1)
+    router.poll_once()
+    rep = router.replicas()[0]
+    stubs["rep0"].gen_errors = [_unavailable() for _ in range(50)]
+    with pytest.raises(RouterError):
+        router.dispatch_generate(_req())
+    assert rep.breaker.state == CircuitBreaker.OPEN
+    clock.advance(router.config.breaker_cooldown_secs + 0.1)
+    router.poll_once()
+    stubs["rep0"].gen_errors = [_exhausted()]
     resp = router.dispatch_generate(_req())
     assert list(resp.tokens) == [1, 2, 100]
     assert rep.breaker.state == CircuitBreaker.CLOSED
@@ -421,6 +525,32 @@ def test_hedged_dispatch_second_replica_wins():
     assert list(resp.tokens) == [1, 2, 200]  # the hedge answered
     snap = router.telemetry.snapshot()
     assert snap["hedges"] == 1 and snap["hedge_wins"] == 1
+
+
+def test_hedge_leg_failure_excluded_from_redispatch():
+    """A hedge replica that failed THIS request lands in the request's
+    failed set too: when both legs fail, the re-dispatch goes to a
+    THIRD replica instead of burning an attempt on the hedge replica
+    already known bad."""
+    router, stubs, _ = make_router(
+        3, advance_on_sleep=False, hedge_delay_secs=0.05
+    )
+    stubs["rep2"].queue_depth = 5  # least preferred
+    router.poll_once()
+    gate = threading.Event()
+    stubs["rep0"].block_until = gate  # primary stalls, then fails
+    stubs["rep0"].gen_errors.append(_unavailable())
+    stubs["rep1"].gen_errors.append(_unavailable())  # hedge leg fails
+    releaser = threading.Timer(0.3, gate.set)
+    releaser.start()
+    try:
+        resp = router.dispatch_generate(_req())
+    finally:
+        gate.set()
+        releaser.cancel()
+    assert list(resp.tokens) == [1, 2, 300]  # rep2 rescued it
+    assert stubs["rep1"].calls == 1  # the failed hedge is not re-picked
+    assert router.telemetry.snapshot()["hedges"] == 1
 
 
 def test_hedged_dispatch_primary_wins_without_hedge():

@@ -319,6 +319,37 @@ def test_scheduler_advertises_draining_on_stop_and_reload():
     assert sched.is_draining()
 
 
+def test_sigterm_drain_survives_concurrent_reload():
+    """Regression: stop() landing while a hot-reload swap is mid-flight
+    must not lose the permanent drain advertisement — the reload's
+    cleanup used to clear the shared flag, and routers would keep
+    routing new work to a terminating replica."""
+    clock = FakeClock()
+    engine, queue, telemetry, sched = _rig(clock)
+
+    class OneShotWatcher(object):
+        def __init__(self):
+            self.pending = ("new-state", 7)
+
+        def poll(self):
+            out, self.pending = self.pending, None
+            return out
+
+    sched.watcher = OneShotWatcher()
+    seen = []
+
+    def swap(state, version):
+        sched.stop(drain=True)  # SIGTERM arrives mid-swap
+        seen.append((version, sched.is_draining()))
+
+    engine.set_params = swap
+    sched._iterate()
+    assert seen == [(7, True)]
+    # the reload's cleanup cleared only its OWN transient flag: the
+    # SIGTERM advertisement stays up for good
+    assert sched.is_draining()
+
+
 def test_telemetry_counters_and_snapshot():
     clock = FakeClock()
     t = ServingTelemetry(log_dir=None, flush_every=2, clock=clock)
