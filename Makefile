@@ -4,6 +4,12 @@
 # targets).
 #
 # The suite is sharded by pytest markers (pytest.ini):
+#   lint          — static analysis, runs BEFORE the shards: edl-lint
+#                   (python -m elasticdl_tpu.analysis.lint — lock-
+#                   discipline races, jit hazards, blocking calls in
+#                   servicers, proto drift; baseline in
+#                   .edl-lint-baseline.json) + ruff (pinned in ci.yml;
+#                   skipped with a notice when absent locally)
 #   default/fast  — everything NOT marked slow/integration (< 5 min,
 #                   the per-commit gate)
 #   drills        — the slow + integration shard: multi-process SPMD
@@ -21,12 +27,25 @@
 
 PY ?= python
 MESH_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+# keep in sync with the `lint` job in .github/workflows/ci.yml
+RUFF_VERSION = 0.8.4
+LINT_PATHS = elasticdl_tpu scripts tests
 
-.PHONY: native test-fast test-drills drill serve-smoke ci ci-fast \
+.PHONY: native lint test-fast test-drills drill serve-smoke ci ci-fast \
 	cluster-smoke clean
 
 native:
 	$(MAKE) -C elasticdl_tpu/native
+
+lint:
+	env -u PYTHONPATH $(PY) -m elasticdl_tpu.analysis.lint $(LINT_PATHS)
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check $(LINT_PATHS); \
+	elif $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check $(LINT_PATHS); \
+	else \
+		echo "ruff not installed (CI pins ruff==$(RUFF_VERSION)); skipping generic lint"; \
+	fi
 
 test-fast: native
 	env -u PYTHONPATH $(MESH_ENV) $(PY) -m pytest tests/ -q \
@@ -51,9 +70,9 @@ serve-smoke:
 		--requests 16 --rate 32 --compare_paged --kv_block_size 4 \
 		--out BENCH_SERVING.json
 
-ci-fast: test-fast
+ci-fast: lint test-fast
 
-ci: test-fast test-drills drill
+ci: lint test-fast test-drills drill
 
 cluster-smoke:
 	bash scripts/run_cluster_job_smoke.sh

@@ -1,0 +1,32 @@
+"""edl-lint: domain-aware static analysis for this codebase.
+
+The repo mixes two failure-prone idioms — lock-guarded concurrent
+control planes (master dispatcher, instance manager, serving router/
+admission/telemetry) and jit-compiled JAX hot paths — and both fail
+SILENTLY: a race corrupts bookkeeping under load, a stray host sync
+serializes the decode loop. These checkers encode the project's
+conventions as AST rules so correctness scales with the code instead
+of with reviewer attention.
+
+Entry point: ``python -m elasticdl_tpu.analysis.lint`` (see `make
+lint` and the CI ``lint`` job). Rules live in small visitor classes
+behind the registry in core.py; adding one is ~50 LoC plus two
+fixtures (docs/designs/static_analysis.md has the recipe).
+"""
+
+from elasticdl_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    Rule,
+    all_rules,
+    register,
+    run_rules,
+)
+
+# importing the rule modules registers their rules
+from elasticdl_tpu.analysis import (  # noqa: F401,E402
+    blocking_rules,
+    jit_rules,
+    lock_rules,
+    proto_rules,
+)

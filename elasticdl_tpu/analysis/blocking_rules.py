@@ -1,0 +1,139 @@
+"""C3 — blocking-call checker (EDL201).
+
+Hot control-plane threads must never block unboundedly: a gRPC
+servicer method that sleeps or waits without a timeout pins one of the
+server's worker threads (the pool is finite — enough pinned handlers
+is a full outage that LOOKS like load), and the router's dispatch path
+is latency-budgeted end to end. This codebase's convention is that
+every wait carries a timeout and every pause is the injected
+``self._sleep`` (testable, bounded); raw blocking primitives are the
+bug.
+
+CONTEXTS checked (methods plus their nested functions):
+
+* every method of a class whose name ends in ``Servicer`` — the gRPC
+  handler surface;
+* dispatch-path methods (``dispatch*``/``_dispatch*``/``_call*``) of a
+  class whose name ends in ``Router``.
+
+FLAGGED inside a context:
+
+* ``time.sleep(...)`` — unconditionally (the injected ``self._sleep``
+  is the sanctioned form, precisely because tests can compress it);
+* ``<queue-ish>.get()`` / ``.get(block=True)`` with no ``timeout=`` —
+  an unbounded consumer wait (queue-ish: the receiver name mentions
+  ``queue``/``q``/``results``/``events``);
+* ``.wait()`` / ``.join()`` / ``.acquire()`` with neither a positional
+  timeout nor a ``timeout=`` kwarg — unbounded primitive wait;
+* a synchronous RPC via a stub (receiver path mentions ``stub``)
+  without a ``timeout=`` kwarg — an unbounded network wait that rides
+  on a peer's liveness.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+
+_QUEUEISH = ("queue", "_q", "results", "events")
+_WAITERS = {"wait", "join", "acquire"}
+_ROUTER_METHOD_PREFIXES = ("dispatch", "_dispatch", "_call")
+
+
+def _expr_text(node):
+    """Best-effort dotted spelling of an expression for name matching."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)).lower()
+
+
+def _has_timeout(call):
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+class _BlockingVisitor(ast.NodeVisitor):
+    def __init__(self, path, scope):
+        self.path = path
+        self.scope = scope
+        self.findings = []
+
+    def _emit(self, line, detail, message):
+        self.findings.append(
+            Finding("EDL201", self.path, line, self.scope, detail,
+                    message)
+        )
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv = _expr_text(fn.value)
+            if fn.attr == "sleep" and recv == "time":
+                self._emit(
+                    node.lineno, "time.sleep",
+                    "time.sleep in a servicer/dispatch path pins a "
+                    "handler thread; use the injected clock/sleep or "
+                    "a bounded wait",
+                )
+            elif (fn.attr == "get"
+                    and not _has_timeout(node)
+                    and not node.args
+                    and any(q in recv for q in _QUEUEISH)):
+                self._emit(
+                    node.lineno, "%s.get" % (recv or "queue"),
+                    "unbounded queue get() in a servicer/dispatch "
+                    "path can hang a handler forever; pass timeout=",
+                )
+            elif (fn.attr in _WAITERS
+                    and not node.args
+                    and not _has_timeout(node)):
+                self._emit(
+                    node.lineno, ".%s()" % fn.attr,
+                    "unbounded .%s() in a servicer/dispatch path; "
+                    "pass a timeout so a lost peer cannot pin the "
+                    "thread" % fn.attr,
+                )
+            elif "stub" in recv and not _has_timeout(node):
+                self._emit(
+                    node.lineno, "%s.%s" % (recv, fn.attr),
+                    "synchronous stub RPC without timeout= rides on "
+                    "the peer's liveness; every dispatch-path RPC "
+                    "must carry a deadline",
+                )
+        self.generic_visit(node)
+
+
+@register
+class BlockingCallRule(Rule):
+    """EDL201 — see module docstring."""
+
+    id = "EDL201"
+    name = "blocking-call"
+
+    def check_module(self, tree, lines, path):
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            servicer = node.name.endswith("Servicer")
+            router = node.name.endswith("Router")
+            if not (servicer or router):
+                continue
+            for fn in node.body:
+                if not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if router and not servicer and not fn.name.startswith(
+                    _ROUTER_METHOD_PREFIXES
+                ):
+                    continue
+                visitor = _BlockingVisitor(
+                    path, "%s.%s" % (node.name, fn.name)
+                )
+                for stmt in fn.body:
+                    visitor.visit(stmt)
+                findings.extend(visitor.findings)
+        return findings

@@ -1,0 +1,235 @@
+"""Rule registry, pragma suppression, baseline file, and the runner.
+
+A `Rule` inspects one module (AST + source) and yields `Finding`s. A
+finding is identified by a LINE-INDEPENDENT fingerprint
+``(rule, path, scope, detail)`` so unrelated edits never churn the
+baseline; `scope` is ``Class.method`` (or ``<module>``) and `detail`
+names the offending thing (an attribute, a call).
+
+Suppression, two tiers with different intent:
+
+* pragma — ``# edl-lint: disable=EDL002`` on the offending line (or
+  ``disable=all``): for code whose SAFETY ARGUMENT lives right there in
+  a comment. Prefer this when the justification is local.
+* baseline — a checked-in JSON file of vetted exceptions, each with a
+  mandatory one-line ``reason``: for findings whose justification is
+  architectural (e.g. "worker-side state is single-threaded by
+  construction"). STALE entries fail the run: every baseline line must
+  match a live finding, so the file can only shrink or be consciously
+  re-vetted — it cannot silently rot into a blanket waiver.
+"""
+
+import ast
+import json
+import os
+
+_PRAGMA = "# edl-lint:"
+
+
+class Finding(object):
+    def __init__(self, rule, path, line, scope, detail, message):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.scope = scope
+        self.detail = detail
+        self.message = message
+
+    @property
+    def fingerprint(self):
+        return (self.rule, self.path, self.scope, self.detail)
+
+    def format(self):
+        return "%s:%d: %s [%s] %s: %s" % (
+            self.path, self.line, self.rule, self.scope, self.detail,
+            self.message,
+        )
+
+
+class Rule(object):
+    """Base checker. Subclasses set `id` (EDLnnn), `name`, and a
+    docstring that doubles as the rule catalogue entry; implement
+    `check_module(tree, lines, path)` yielding Findings. Rules that
+    inspect something other than Python modules (the proto-drift gate)
+    override `check_repo(root)` instead and leave check_module empty."""
+
+    id = None
+    name = None
+
+    def check_module(self, tree, lines, path):
+        return ()
+
+    def check_repo(self, root):
+        return ()
+
+
+_REGISTRY = {}
+
+
+def register(rule_cls):
+    rule = rule_cls()
+    if rule.id in _REGISTRY:
+        raise ValueError("duplicate rule id %s" % rule.id)
+    _REGISTRY[rule.id] = rule
+    return rule_cls
+
+
+def all_rules():
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# ------------------------------------------------------------------ pragma
+
+
+def suppressed_by_pragma(finding, lines):
+    """True when the finding's source line (or the line directly above
+    it) carries ``# edl-lint: disable=<rule>`` naming this rule or
+    ``all``."""
+    for lineno in (finding.line, finding.line - 1):
+        if not 1 <= lineno <= len(lines):
+            continue
+        text = lines[lineno - 1]
+        idx = text.find(_PRAGMA)
+        if idx < 0:
+            continue
+        spec = text[idx + len(_PRAGMA):].strip()
+        spec = spec.split()[0] if spec else ""
+        if not spec.startswith("disable="):
+            continue
+        names = {n.strip() for n in spec[len("disable="):].split(",")}
+        if "all" in names or finding.rule in names:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------- baseline
+
+
+class BaselineError(Exception):
+    pass
+
+
+class Baseline(object):
+    """The checked-in vetted-exception list (.edl-lint-baseline.json).
+
+    Every entry carries a mandatory one-line justification; an entry
+    that no longer matches a live finding is itself an error."""
+
+    def __init__(self, entries=None, path=None):
+        self.path = path
+        self.entries = list(entries or [])
+        for e in self.entries:
+            for key in ("rule", "path", "scope", "detail", "reason"):
+                if not e.get(key):
+                    raise BaselineError(
+                        "baseline entry %r is missing %r (every vetted "
+                        "exception needs a one-line justification)"
+                        % (e, key)
+                    )
+
+    @classmethod
+    def load(cls, path):
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("entries", []), path=path)
+
+    @classmethod
+    def from_findings(cls, findings, reason, path=None):
+        entries = [
+            {
+                "rule": f.rule, "path": f.path, "scope": f.scope,
+                "detail": f.detail, "reason": reason,
+            }
+            for f in findings
+        ]
+        return cls(entries, path=path)
+
+    def save(self, path=None):
+        path = path or self.path
+        with open(path, "w") as f:
+            json.dump(
+                {"version": 1, "entries": self.entries}, f, indent=2,
+                sort_keys=True,
+            )
+            f.write("\n")
+
+    def _fingerprints(self):
+        return {
+            (e["rule"], e["path"], e["scope"], e["detail"]): e
+            for e in self.entries
+        }
+
+    def apply(self, findings):
+        """Split into (unsuppressed findings, stale entries)."""
+        fps = self._fingerprints()
+        live = set()
+        out = []
+        for f in findings:
+            if f.fingerprint in fps:
+                live.add(f.fingerprint)
+            else:
+                out.append(f)
+        stale = [e for fp, e in sorted(fps.items()) if fp not in live]
+        return out, stale
+
+
+# ------------------------------------------------------------------ runner
+
+#: path fragments never analyzed: generated code, the fixture battery
+#: (which exists to TRIGGER rules), and vendored/native sources
+DEFAULT_EXCLUDES = (
+    "proto/elasticdl_pb2.py",
+    "tests/lint_fixtures/",
+)
+
+
+def iter_python_files(paths, excludes=DEFAULT_EXCLUDES):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git")
+            )
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                norm = full.replace(os.sep, "/")
+                if any(ex in norm for ex in excludes):
+                    continue
+                yield full
+
+
+def run_rules(paths, rules=None, root=None, excludes=DEFAULT_EXCLUDES):
+    """Run `rules` over every Python file under `paths` plus each
+    rule's repo-level check. Returns (findings, errors): findings are
+    pragma-filtered but NOT baseline-filtered (the caller owns the
+    baseline so --write-baseline can see everything)."""
+    rules = rules if rules is not None else all_rules()
+    findings, errors = [], []
+    for path in iter_python_files(paths, excludes=excludes):
+        try:
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append("%s: unparseable: %s" % (path, e))
+            continue
+        lines = src.splitlines()
+        rel = os.path.relpath(path, root) if root else path
+        rel = rel.replace(os.sep, "/")
+        for rule in rules:
+            for finding in rule.check_module(tree, lines, rel):
+                if not suppressed_by_pragma(finding, lines):
+                    findings.append(finding)
+    if root:
+        for rule in rules:
+            findings.extend(rule.check_repo(root))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, errors

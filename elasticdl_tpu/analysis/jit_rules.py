@@ -1,0 +1,345 @@
+"""C2 — jit-hazard checker (EDL101 host sync / EDL102 tracer branch /
+EDL103 host side effects).
+
+A function is a JIT CONTEXT when it is decorated with ``@jax.jit`` /
+``@jit`` / ``@partial(jit, ...)``, or defined locally and later passed
+to ``jit`` / ``pjit`` / ``vmap`` / ``pmap`` / ``shard_map`` in the same
+scope (the repo's dominant idiom: ``step_fn = jax.jit(step)``). Nested
+``def``s inside a jit context are traced with it and inherit the
+context.
+
+Inside a jit context:
+
+* EDL101 — host-sync forcers: ``.item()``, ``.block_until_ready()``,
+  ``jax.device_get``, ``np.asarray``/``np.array`` of a traced value,
+  and ``float()``/``int()``/``bool()`` applied to a TAINTED expression.
+  Each forces the accelerator pipeline to drain mid-trace (or fails
+  under tracing); either way the hot loop dies.
+* EDL102 — Python ``if``/``while`` on a tainted expression: control
+  flow on a tracer raises ConcretizationTypeError at trace time, or —
+  worse — silently bakes one branch in when the value is accidentally
+  concrete. Use ``lax.cond``/``jnp.where``.
+* EDL103 — ``time.*()`` and ``print()``: traced exactly once at
+  compile time, so they LIE at runtime (a timestamp becomes a
+  constant). Use ``jax.debug.print`` / time outside the jit boundary.
+
+TAINT is a deliberate approximation of "derived from a traced value":
+the jit'd function's parameters seed the set, and single-assignment
+propagation (``y = f(x)`` with ``x`` tainted taints ``y``) extends it
+in statement order. Closure variables are NOT tainted — static Python
+config captured from the enclosing scope (``if self.causal:``) is the
+normal, correct idiom. Arguments declared static via
+``static_argnums``/``static_argnames`` are untainted when the
+declaration is a literal; a computed declaration falls back to
+all-params-tainted (pragma the call if that over-approximates).
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+
+_JIT_WRAPPERS = {"jit", "pjit", "vmap", "pmap", "shard_map"}
+_NP_NAMES = {"np", "numpy", "onp"}
+_CASTS = {"float", "int", "bool"}
+_TIME_FUNCS = {
+    "time", "monotonic", "perf_counter", "sleep", "process_time",
+    "thread_time",
+}
+
+
+def _dotted_tail(fn):
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _jit_call_static_names(call, fndef):
+    """Parameter names declared static on a jit(...) call/decorator,
+    or None when they cannot be decided statically."""
+    args = [a.arg for a in fndef.args.args]
+    static = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = kw.value
+            if isinstance(names, ast.Constant) and isinstance(
+                names.value, str
+            ):
+                static.add(names.value)
+            elif isinstance(names, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) for e in names.elts
+            ):
+                static.update(e.value for e in names.elts)
+            else:
+                return None
+        elif kw.arg == "static_argnums":
+            nums = kw.value
+            if isinstance(nums, ast.Constant) and isinstance(
+                nums.value, int
+            ):
+                idxs = [nums.value]
+            elif isinstance(nums, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) for e in nums.elts
+            ):
+                idxs = [e.value for e in nums.elts]
+            else:
+                return None
+            for i in idxs:
+                if 0 <= i < len(args):
+                    static.add(args[i])
+    return static
+
+
+def _collect_jit_contexts(tree):
+    """(fndef, static_names) for every function that is a jit context."""
+    contexts = {}
+
+    def walk_scope_level(body):
+        """ast.walk pruned at nested function/class boundaries: a call
+        inside a nested def resolves names against THAT def's scope,
+        not this one (recursion handles it with its own defs map)."""
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scope: recursion owns its body
+            stack.extend(ast.iter_child_nodes(node))
+
+    def scan_scope(body, local_defs):
+        """One lexical scope: map name -> FunctionDef for local defs,
+        then find jit/vmap wraps referencing them."""
+        defs = dict(local_defs)
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        for call in walk_scope_level(body):
+            if not isinstance(call, ast.Call):
+                continue
+            tail = _dotted_tail(call.func)
+            if tail not in _JIT_WRAPPERS:
+                continue
+            for arg in call.args[:1]:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    fndef = defs[arg.id]
+                    static = _jit_call_static_names(call, fndef)
+                    contexts[fndef] = static
+        # recurse into nested scopes
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                scan_scope(node.body, defs)
+
+    scan_scope(tree.body, {})
+
+    # decorator form
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if _dotted_tail(dec) in _JIT_WRAPPERS:
+                contexts[node] = set()
+            elif isinstance(dec, ast.Call):
+                tail = _dotted_tail(dec.func)
+                if tail in _JIT_WRAPPERS:
+                    contexts[node] = _jit_call_static_names(dec, node)
+                elif tail == "partial" and dec.args and _dotted_tail(
+                    dec.args[0]
+                ) in _JIT_WRAPPERS:
+                    contexts[node] = _jit_call_static_names(dec, node)
+    return contexts
+
+
+#: attribute reads that yield STATIC metadata even on a tracer — an
+#: expression only reaching a tainted name through one of these is not
+#: value-dependent (x.shape[0] is concrete at trace time)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+
+class _Taint(object):
+    """Statement-order single-pass taint over local names."""
+
+    def __init__(self, seeds):
+        self.names = set(seeds)
+
+    def mentions_tainted(self, expr):
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Name) and node.id in self.names:
+                return True
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in _STATIC_ATTRS):
+                continue  # x.shape / .dtype / .ndim are trace-static
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+    def assign(self, stmt):
+        if isinstance(stmt, ast.Assign):
+            tainted = self.mentions_tainted(stmt.value)
+            for tgt in stmt.targets:
+                for node in ast.walk(tgt):
+                    if isinstance(node, ast.Name):
+                        if tainted:
+                            self.names.add(node.id)
+                        else:
+                            self.names.discard(node.id)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name) and self.mentions_tainted(
+                stmt.value
+            ):
+                self.names.add(stmt.target.id)
+
+
+class _JitBodyChecker(ast.NodeVisitor):
+    def __init__(self, rule_path, scope, taint):
+        self.path = rule_path
+        self.scope = scope
+        self.taint = taint
+        self.findings = []
+
+    def _emit(self, rule, line, detail, message):
+        self.findings.append(
+            Finding(rule, self.path, line, self.scope, detail, message)
+        )
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        self.taint.assign(node)
+
+    def visit_AugAssign(self, node):
+        self.generic_visit(node)
+        self.taint.assign(node)
+
+    def visit_For(self, node):
+        # loop targets over tainted iterables are tainted
+        if self.taint.mentions_tainted(node.iter):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    self.taint.names.add(n.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "item" and not node.args:
+                self._emit(
+                    "EDL101", node.lineno, ".item()",
+                    ".item() forces a device->host sync inside a jit "
+                    "context (fails on tracers; drains the pipeline "
+                    "otherwise)",
+                )
+            elif fn.attr == "block_until_ready":
+                self._emit(
+                    "EDL101", node.lineno, ".block_until_ready()",
+                    "block_until_ready() inside a jit context drains "
+                    "the accelerator pipeline",
+                )
+            elif (fn.attr in ("asarray", "array")
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in _NP_NAMES
+                    and node.args
+                    and self.taint.mentions_tainted(node.args[0])):
+                self._emit(
+                    "EDL101", node.lineno,
+                    "np.%s" % fn.attr,
+                    "numpy materialization of a traced value forces a "
+                    "host sync; use jnp inside jit",
+                )
+            elif fn.attr == "device_get":
+                self._emit(
+                    "EDL101", node.lineno, "device_get",
+                    "jax.device_get inside a jit context forces a host "
+                    "sync",
+                )
+            elif (fn.attr in _TIME_FUNCS
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "time"):
+                self._emit(
+                    "EDL103", node.lineno, "time.%s" % fn.attr,
+                    "time.%s() is traced ONCE at compile time — inside "
+                    "jit it returns a baked-in constant (time outside "
+                    "the jit boundary)" % fn.attr,
+                )
+        elif isinstance(fn, ast.Name):
+            if fn.id in _CASTS and node.args and self.taint.mentions_tainted(
+                node.args[0]
+            ):
+                self._emit(
+                    "EDL101", node.lineno, "%s()" % fn.id,
+                    "%s() on a traced value forces concretization "
+                    "(host sync / ConcretizationTypeError); use jnp "
+                    "ops or mark the argument static" % fn.id,
+                )
+            elif fn.id == "print":
+                self._emit(
+                    "EDL103", node.lineno, "print",
+                    "print() runs at trace time only — use "
+                    "jax.debug.print for runtime values",
+                )
+        self.generic_visit(node)
+
+    def visit_If(self, node):
+        if self.taint.mentions_tainted(node.test):
+            self._emit(
+                "EDL102", node.lineno, "if",
+                "Python `if` on a tracer-derived value: raises at "
+                "trace time or silently bakes one branch in — use "
+                "lax.cond / jnp.where",
+            )
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.taint.mentions_tainted(node.test):
+            self._emit(
+                "EDL102", node.lineno, "while",
+                "Python `while` on a tracer-derived value cannot be "
+                "traced — use lax.while_loop",
+            )
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested def: traced with the enclosing jit context; its params
+        # are tainted too (scan/cond body carries tracers)
+        inner = _Taint(self.taint.names)
+        inner.names.update(a.arg for a in node.args.args)
+        saved, self.taint = self.taint, inner
+        for stmt in node.body:
+            self.visit(stmt)
+        self.taint = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@register
+class JitHazardRule(Rule):
+    """EDL101/EDL102/EDL103 — see module docstring."""
+
+    id = "EDL101"
+    name = "jit-hazard"
+
+    def check_module(self, tree, lines, path):
+        findings = []
+        for fndef, static in _collect_jit_contexts(tree).items():
+            params = {a.arg for a in fndef.args.args}
+            params.update(a.arg for a in fndef.args.kwonlyargs)
+            if fndef.args.vararg:
+                params.add(fndef.args.vararg.arg)
+            if static:  # None = undecidable -> keep everything tainted
+                params -= static
+            # `self`-methods wrapped in jit: self is static in practice
+            params.discard("self")
+            taint = _Taint(params)
+            checker = _JitBodyChecker(
+                path, self._scope_name(fndef), taint
+            )
+            for stmt in fndef.body:
+                checker.visit(stmt)
+            findings.extend(checker.findings)
+        return findings
+
+    @staticmethod
+    def _scope_name(fndef):
+        return fndef.name

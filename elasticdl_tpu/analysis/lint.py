@@ -1,0 +1,137 @@
+"""edl-lint CLI: ``python -m elasticdl_tpu.analysis.lint [paths...]``.
+
+Exit status: 0 = clean (after pragma + baseline filtering and zero
+stale baseline entries), 1 = findings or stale baseline entries, 2 =
+usage/internal error. `make lint` runs this over ``elasticdl_tpu/``,
+``scripts/`` and ``tests/`` plus ruff; the CI ``lint`` job gates on
+it before the test shards.
+
+Options:
+  --baseline PATH    vetted-exception file (default:
+                     <repo>/.edl-lint-baseline.json)
+  --write-baseline   rewrite the baseline to cover every current
+                     finding (each new entry gets a TODO reason you
+                     must edit into a real justification — the runner
+                     rejects empty reasons)
+  --select IDS       comma-separated rule ids to run (default: all);
+                     EDL001 selects EDL002 too (one checker), EDL101
+                     selects EDL102/EDL103
+  --list-rules       print the rule catalogue and exit
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+#: rule ids emitted by each registered checker (a checker is selected
+#: when ANY of its ids is selected)
+RULE_FAMILIES = {
+    "EDL001": ("EDL001", "EDL002"),
+    "EDL101": ("EDL101", "EDL102", "EDL103"),
+    "EDL201": ("EDL201",),
+    "EDL301": ("EDL301",),
+}
+
+DEFAULT_PATHS = ("elasticdl_tpu", "scripts", "tests")
+
+
+def _selected_rules(select):
+    from elasticdl_tpu.analysis import all_rules
+
+    rules = all_rules()
+    if not select:
+        return rules
+    wanted = {s.strip() for s in select.split(",") if s.strip()}
+    picked = [
+        r for r in rules
+        if wanted & set(RULE_FAMILIES.get(r.id, (r.id,)))
+    ]
+    if not picked:
+        raise SystemExit("--select matched no rules: %s" % select)
+    return picked
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="edl-lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("paths", nargs="*", help="files/dirs to lint")
+    parser.add_argument("--baseline", default=None)
+    parser.add_argument("--write-baseline", action="store_true")
+    parser.add_argument("--select", default="")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+
+    from elasticdl_tpu.analysis import Baseline, run_rules
+
+    rules = _selected_rules(args.select)
+    if args.list_rules:
+        for rule in rules:
+            doc = (sys.modules[rule.__module__].__doc__ or "")
+            title = doc.strip().splitlines()[0] if doc else rule.name
+            print("%s  %s\n    %s" % (rule.id, rule.name, title))
+        return 0
+
+    root = os.path.abspath(args.root)
+    paths = [
+        p if os.path.isabs(p) else os.path.join(root, p)
+        for p in (args.paths or DEFAULT_PATHS)
+    ]
+    paths = [p for p in paths if os.path.exists(p)]
+    if root not in sys.path:
+        sys.path.insert(0, root)  # for scripts.gen_serving_proto
+
+    findings, errors = run_rules(paths, rules=rules, root=root)
+    for err in errors:
+        print("edl-lint: ERROR %s" % err, file=sys.stderr)
+
+    baseline_path = args.baseline or os.path.join(
+        root, ".edl-lint-baseline.json"
+    )
+    if args.write_baseline:
+        baseline = Baseline.from_findings(
+            findings,
+            reason="TODO: justify or fix (edl-lint --write-baseline)",
+            path=baseline_path,
+        )
+        baseline.save()
+        print("edl-lint: wrote %d entries to %s"
+              % (len(baseline.entries), baseline_path))
+        return 0
+
+    baseline = Baseline.load(baseline_path)
+    findings, stale = baseline.apply(findings)
+
+    for f in findings:
+        print(f.format())
+    for e in stale:
+        print(
+            "edl-lint: STALE baseline entry %s %s [%s] %s — the "
+            "finding it vetted is gone; delete the entry"
+            % (e["rule"], e["path"], e["scope"], e["detail"]),
+            file=sys.stderr,
+        )
+    n_base = len(baseline.entries) - len(stale)
+    if findings or stale or errors:
+        print(
+            "edl-lint: %d finding(s), %d stale baseline entr(ies), "
+            "%d error(s)" % (len(findings), len(stale), len(errors)),
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        "edl-lint: clean (%d rule checker(s), %d baselined "
+        "exception(s))" % (len(rules), n_base)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,327 @@
+"""C1 — lock-discipline race detector (EDL001 write / EDL002 read).
+
+For every class that creates a ``threading.Lock``/``RLock``/
+``Condition`` instance attribute, infer the set of ``self._x``
+attributes the class considers lock-guarded — the attributes WRITTEN
+inside any ``with self._lock:`` block — and then report accesses of
+those attributes outside the lock:
+
+* EDL001: a write (assignment, augmented assignment, subscript store,
+  or a mutating method call like ``.append``/``.pop``) outside the
+  lock — the canonical lost-update race.
+* EDL002: a read outside the lock — usually torn/stale state; often
+  benign for a monotonic scalar, which is what the pragma and the
+  baseline are for.
+
+The inference is methodwise with a LIGHT call-graph fixpoint over
+intra-class ``self.method()`` calls, because this codebase's idiom is
+"public method takes the lock, private helper assumes it":
+
+* ``__init__`` and other ctor-only helpers are single-threaded by
+  construction — exempt;
+* a method named ``*_locked`` declares "caller holds the lock" —
+  treated as locked (the convention is self-documenting; the checker
+  just honors it);
+* a method whose every non-ctor intra-class call site sits inside a
+  lock region is treated as locked (e.g. telemetry's ``_scalar``);
+  one unlocked call site makes it open, and its body is checked.
+
+Deliberately NOT modeled (keep the rule predictable): cross-object
+accesses (``other.attr``), class-level locks, lock identity when a
+class holds several locks (any held lock counts — flagging
+wrong-lock-held would need alias analysis and drown signal in noise).
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+#: method calls that mutate their receiver
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "clear", "pop", "popleft", "popitem",
+    "update", "setdefault", "sort", "reverse",
+}
+
+# method contexts, ordered as a lattice: EXEMPT < LOCKED < OPEN
+_EXEMPT, _LOCKED, _OPEN = 0, 1, 2
+
+
+def _self_attr(node):
+    """'x' for an ast.Attribute spelling ``self.x``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lock_ctor(value):
+    """True for ``threading.Lock()`` / ``Lock()`` / ``RLock()`` /
+    ``Condition(...)`` call expressions."""
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _LOCK_CTORS
+    if isinstance(fn, ast.Name):
+        return fn.id in _LOCK_CTORS
+    return False
+
+
+class _Access(object):
+    __slots__ = ("attr", "line", "is_write", "locked")
+
+    def __init__(self, attr, line, is_write, locked):
+        self.attr = attr
+        self.line = line
+        self.is_write = is_write
+        self.locked = locked
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method body: records every self.<attr> access
+    with its locked-ness, every lock-attr assignment, and every
+    intra-class ``self.m()`` call site."""
+
+    def __init__(self, lock_attrs):
+        self.lock_attrs = lock_attrs
+        self.depth = 0  # with-lock nesting
+        self.accesses = []
+        self.lock_defs = set()
+        self.call_sites = []  # (callee_name, locked)
+
+    # -- lock regions
+
+    def visit_With(self, node):
+        holds = 0
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is None and isinstance(item.context_expr, ast.Call):
+                # with self._lock: vs with self._cv: — Conditions are
+                # entered directly; .acquire()-style calls are not
+                # with-items in this codebase, but cover self._x()
+                attr = _self_attr(item.context_expr.func)
+            if attr in self.lock_attrs:
+                holds += 1
+        self.depth += holds
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth -= holds
+
+    # -- accesses
+
+    def _record(self, attr, line, is_write):
+        if attr in self.lock_attrs:
+            return
+        self.accesses.append(
+            _Access(attr, line, is_write, self.depth > 0)
+        )
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            self._visit_store_target(tgt)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        attr = _self_attr(node.target)
+        if attr is not None:
+            self._record(attr, node.lineno, True)
+        else:
+            self._visit_store_target(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.target is not None:
+            self._visit_store_target(node.target)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_Delete(self, node):
+        for tgt in node.targets:
+            self._visit_store_target(tgt)
+
+    def _visit_store_target(self, tgt):
+        attr = _self_attr(tgt)
+        if attr is not None:
+            self._record(attr, tgt.lineno, True)
+            return
+        if isinstance(tgt, ast.Subscript):
+            # self.x[k] = v mutates x
+            attr = _self_attr(tgt.value)
+            if attr is not None:
+                self._record(attr, tgt.lineno, True)
+            else:
+                self.visit(tgt.value)
+            self.visit(tgt.slice)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._visit_store_target(elt)
+            return
+        self.visit(tgt)
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv_attr = _self_attr(fn.value)
+            if recv_attr is None and isinstance(fn.value, ast.Subscript):
+                # self.x[k].append(...) mutates the structure x guards
+                recv_attr = _self_attr(fn.value.value)
+            if recv_attr is not None and fn.attr in _MUTATORS:
+                # self.x.append(...) — a write to x
+                self._record(recv_attr, node.lineno, True)
+                for arg in node.args:
+                    self.visit(arg)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+            callee = _self_attr(fn)
+            if callee is not None:
+                # self.m(...) — intra-class call site
+                self.call_sites.append((callee, self.depth > 0))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._record(attr, node.lineno, False)
+        self.generic_visit(node)
+
+    # nested defs execute later but still touch shared state from this
+    # class's threads — scan them in place (their own with-locks count)
+    def visit_FunctionDef(self, node):
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self.visit(node.body)
+
+
+def _find_lock_attrs(classdef):
+    locks = set()
+    for node in ast.walk(classdef):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    locks.add(attr)
+    return locks
+
+
+@register
+class LockDisciplineRule(Rule):
+    """EDL001/EDL002 — see module docstring. One registered Rule emits
+    both ids so the lock inference runs once per class."""
+
+    id = "EDL001"
+    name = "lock-discipline"
+
+    def check_module(self, tree, lines, path):
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(node, path))
+        return findings
+
+    def _check_class(self, classdef, path):
+        lock_attrs = _find_lock_attrs(classdef)
+        if not lock_attrs:
+            return
+        methods = {
+            n.name: n for n in classdef.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        scans = {}
+        for name, fn in methods.items():
+            scan = _MethodScan(lock_attrs)
+            for stmt in fn.body:
+                scan.visit(stmt)
+            scans[name] = scan
+
+        # guarded set: attributes written under any held lock
+        guarded = set()
+        for scan in scans.values():
+            for acc in scan.accesses:
+                if acc.is_write and acc.locked:
+                    guarded.add(acc.attr)
+        if not guarded:
+            return
+
+        ctx = self._method_contexts(methods, scans)
+
+        for name, scan in scans.items():
+            if ctx[name] != _OPEN:
+                continue
+            scope = "%s.%s" % (classdef.name, name)
+            for acc in scan.accesses:
+                if acc.locked or acc.attr not in guarded:
+                    continue
+                if acc.is_write:
+                    yield Finding(
+                        "EDL001", path, acc.line, scope, acc.attr,
+                        "write of lock-guarded attribute %r outside "
+                        "the lock (guarded by with-blocks on %s)"
+                        % (acc.attr, "/".join(sorted(lock_attrs))),
+                    )
+                else:
+                    yield Finding(
+                        "EDL002", path, acc.line, scope, acc.attr,
+                        "read of lock-guarded attribute %r outside "
+                        "the lock; may observe torn/stale state"
+                        % (acc.attr,),
+                    )
+
+    @staticmethod
+    def _method_contexts(methods, scans):
+        """Fixpoint over the lattice EXEMPT < LOCKED < OPEN. A method
+        starts at bottom; ``__init__`` and ``*_locked`` are pinned;
+        a method with no intra-class callers is OPEN (public API);
+        otherwise it joins its call sites' contexts, where a site in a
+        lock region contributes LOCKED and any other site contributes
+        the CALLER's context."""
+        pinned = {}
+        for name in methods:
+            if name == "__init__":
+                pinned[name] = _EXEMPT
+            elif name.endswith("_locked"):
+                pinned[name] = _LOCKED
+        callers = {name: [] for name in methods}
+        for caller, scan in scans.items():
+            for callee, locked in scan.call_sites:
+                if callee in callers:
+                    callers[callee].append((caller, locked))
+            # a bare `self.m` READ is a reference that will be invoked
+            # later (deferred-callback idiom); the reference's context
+            # is the best available approximation of the call's
+            for acc in scan.accesses:
+                if not acc.is_write and acc.attr in callers:
+                    callers[acc.attr].append((caller, acc.locked))
+        ctx = {
+            name: pinned.get(
+                name, _EXEMPT if callers[name] else _OPEN
+            )
+            for name in methods
+        }
+        changed = True
+        while changed:
+            changed = False
+            for name in methods:
+                if name in pinned or not callers[name]:
+                    continue
+                joined = _EXEMPT
+                for caller, locked in callers[name]:
+                    site = _LOCKED if locked else ctx[caller]
+                    joined = max(joined, site)
+                if joined > ctx[name]:
+                    ctx[name] = joined
+                    changed = True
+        return ctx

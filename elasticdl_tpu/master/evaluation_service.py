@@ -122,9 +122,13 @@ class EvaluationService(object):
         self._master_servicer = master_servicer
 
     def init_eval_only_job(self, num_task):
-        self._eval_job = EvaluationJob(
-            self._eval_metrics_fn(), -1, num_task
-        )
+        # the trigger thread may already be running when the dispatcher
+        # wires the eval-only job in — _eval_job is lock-guarded state
+        # everywhere else (edl-lint EDL001)
+        with self._lock:
+            self._eval_job = EvaluationJob(
+                self._eval_metrics_fn(), -1, num_task
+            )
 
     def add_evaluation_task(
         self, is_time_based_eval, model_version=None

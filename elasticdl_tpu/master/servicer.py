@@ -166,12 +166,18 @@ class MasterServicer(object):
             }
             self._cluster_version += 1
             self._worker_liveness_time[request.worker_id] = time.time()
+            # capture the version this registration produced while
+            # still under the lock: a concurrent registration bumping
+            # the counter between release and response would hand two
+            # workers the same (newer) version and break the
+            # version-change detection re-registration relies on
+            cluster_version = self._cluster_version
         logger.info(
             "Worker %d registered from %s (%d devices)",
             request.worker_id, request.address, request.num_devices,
         )
         return pb.RegisterWorkerResponse(
-            cluster_version=self._cluster_version
+            cluster_version=cluster_version
         )
 
     # --------------------------------------------------- watchdog helpers
@@ -181,7 +187,13 @@ class MasterServicer(object):
         (fixes the reference's servicer.py:119-127, which compared the dict
         length — always 2 — against 20 and so never left the default)."""
         out = {}
-        for task_type, times in self._task_complete_times.items():
+        # snapshot under the lock: report_task_result appends from gRPC
+        # threads while the watchdog thread averages (edl-lint EDL002)
+        with self._lock:
+            complete_times = {
+                t: list(v) for t, v in self._task_complete_times.items()
+            }
+        for task_type, times in complete_times.items():
             if len(times) < 20:
                 out[task_type] = 300.0
             else:
@@ -189,4 +201,5 @@ class MasterServicer(object):
         return out
 
     def get_worker_liveness_time(self, worker_id):
-        return self._worker_liveness_time.get(worker_id)
+        with self._lock:
+            return self._worker_liveness_time.get(worker_id)

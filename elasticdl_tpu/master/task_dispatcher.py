@@ -164,6 +164,14 @@ class TaskDispatcher(object):
         self._job_counters[task_type] = JobCounter()
 
     def create_tasks(self, task_type, model_version=-1):
+        """Public entry: callers outside the dispatcher (the evaluation
+        service's trigger threads) do NOT hold the lock, but they race
+        workers popping the queues — take it here. Internal callers
+        already under the lock use _create_tasks_locked directly."""
+        with self._lock:
+            return self._create_tasks_locked(task_type, model_version)
+
+    def _create_tasks_locked(self, task_type, model_version=-1):
         logger.info(
             "Creating a new set of %s tasks for model version %d",
             task_type.lower(),
@@ -221,7 +229,7 @@ class TaskDispatcher(object):
             self._doing[self._task_id] = (worker_id, task, time.time())
             return self._task_id, task
 
-    def _create_train_end_callback_task(self):
+    def _create_train_end_callback_task_locked(self):
         """Append one TRAIN_END_CALLBACK task carrying the first shard's
         first task-range of data (reference :219-250)."""
         if not self._training_shards:
@@ -245,17 +253,22 @@ class TaskDispatcher(object):
         self._todo.append(task)
 
     def add_deferred_callback_create_train_end_task(self):
-        # after a restore the deferred callback (or the train-end task it
-        # creates) is already part of the recovered state — re-adding it
-        # would run the train-end export twice
-        if self._restored and (
-            self._tasks_done_deferred_callbacks or self._train_end_handled
-        ):
-            return
-        self._journal({"ev": "deferred_add"})
-        self._tasks_done_deferred_callbacks.append(
-            self._create_train_end_callback_task
-        )
+        # runs on the master wait-loop thread while worker RPCs mutate
+        # the same state — and after a restore the deferred callback (or
+        # the train-end task it creates) is already part of the
+        # recovered state, so re-adding it would run the train-end
+        # export twice; both the check and the append belong under the
+        # lock (the unlocked append was edl-lint EDL001's first catch)
+        with self._lock:
+            if self._restored and (
+                self._tasks_done_deferred_callbacks
+                or self._train_end_handled
+            ):
+                return
+            self._journal({"ev": "deferred_add"})
+            self._tasks_done_deferred_callbacks.append(
+                self._create_train_end_callback_task_locked
+            )
 
     def invoke_deferred_callback(self):
         with self._lock:
@@ -276,7 +289,7 @@ class TaskDispatcher(object):
                 and self._epoch < self._num_epochs - 1
             ):
                 self._epoch += 1
-                self.create_tasks(TaskType.TRAINING)
+                self._create_tasks_locked(TaskType.TRAINING)
                 logger.info("Starting epoch %d", self._epoch)
 
             if not self._todo:
@@ -297,6 +310,7 @@ class TaskDispatcher(object):
 
         Returns (elapsed_time, task, worker_id)."""
         evaluation_task_completed = False
+        eval_service = None
         with self._lock:
             worker_id, task, start_time = self._doing.pop(
                 task_id, (-1, None, -1)
@@ -334,6 +348,7 @@ class TaskDispatcher(object):
                     "ev": "done", "id": task_id, "task": _payload(task),
                 })
                 evaluation_task_completed = True
+                eval_service = self._evaluation_service
             else:
                 self._journal({
                     "ev": "done", "id": task_id, "task": _payload(task),
@@ -344,8 +359,6 @@ class TaskDispatcher(object):
                     task_id,
                     len(self._todo) + len(self._doing),
                 )
-            if evaluation_task_completed:
-                self._evaluation_service.complete_task()
 
             if success:
                 if task:
@@ -355,6 +368,14 @@ class TaskDispatcher(object):
                 if self.stop_training and self._todo:
                     self._journal({"ev": "stop"})
                     self._todo = []
+
+        # OUTSIDE the lock: complete_task re-enters the dispatcher
+        # (try_to_create_new_job -> create_tasks takes self._lock), and
+        # calling another object's methods while holding our own lock
+        # is the AB/BA deadlock shape the router/master interplay must
+        # never grow
+        if evaluation_task_completed:
+            eval_service.complete_task()
 
         return (time.time() - start_time), task, worker_id
 
@@ -421,7 +442,17 @@ class TaskDispatcher(object):
                 self._journal({"ev": "version", "v": int(version)})
 
     def finished(self):
-        return not self._todo and not self._eval_todo and not self._doing
+        """Job-complete test, read by servicer threads while dispatch/
+        report mutate the queues — an unlocked read can see `_todo`
+        empty and `_doing` already popped mid-report and tell a worker
+        JOB_COMPLETE while the report is about to requeue a failed
+        task (edl-lint EDL002)."""
+        with self._lock:
+            return (
+                not self._todo
+                and not self._eval_todo
+                and not self._doing
+            )
 
     def recover_tasks(self, worker_id):
         """Re-queue all doing tasks of a dead worker (reference :365-377)."""
@@ -437,8 +468,15 @@ class TaskDispatcher(object):
     def set_evaluation_service(self, evaluation_service):
         with self._lock:
             self._evaluation_service = evaluation_service
-            if self._evaluation_shards and not self._training_shards:
-                evaluation_service.init_eval_only_job(len(self._eval_todo))
+            eval_only = (
+                bool(self._evaluation_shards)
+                and not self._training_shards
+            )
+            n_eval = len(self._eval_todo)
+        # init takes the eval service's own lock; never nest it under
+        # ours (see report() for the lock-ordering rule)
+        if eval_only:
+            evaluation_service.init_eval_only_job(n_eval)
 
     def _call_on_task_end(self, task):
         if self._callbacks_list:
@@ -590,7 +628,7 @@ class TaskDispatcher(object):
         self.model_version = model_version
         self._train_end_handled = train_end_handled
         self._tasks_done_deferred_callbacks = [
-            self._create_train_end_callback_task
+            self._create_train_end_callback_task_locked
         ] * max(0, deferred)
         # job counters: totals are derivable from the shard dict; failed
         # counts are best-effort observability and reset on restart

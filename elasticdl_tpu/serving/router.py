@@ -271,8 +271,14 @@ class Replica(object):
     def load_score(self):
         """Lower = dispatch here. Queue wait (ms) is scaled so ~50 ms
         of measured waiting weighs like one queued request; inflight is
-        the router's own live correction to the heartbeat-stale rest."""
-        return (self.queue_depth + self.active_slots + self.inflight
+        the router's own live correction to the heartbeat-stale rest —
+        and the one live-updated term, so it is read under its lock
+        (edl-lint EDL002: dispatch threads bump it concurrently; the
+        polled signals freeze between heartbeats and may be stale by
+        design)."""
+        with self._inflight_lock:
+            inflight = self.inflight
+        return (self.queue_depth + self.active_slots + inflight
                 + self.queue_wait_ms / 50.0)
 
     def observe(self, status, lease_until):

@@ -22,7 +22,6 @@ Design notes (TPU-first):
 """
 
 import inspect
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -362,7 +361,9 @@ class Trainer(object):
                 mutable = [k for k in state.model_state if k != "params"]
                 if sparse_paths:
                     mutable = mutable + [ids_coll]
-                if mutable:
+                # `mutable` is collection NAMES from the state pytree —
+                # static structure, not traced values
+                if mutable:  # edl-lint: disable=EDL102
                     preds, new_mut = self.model.apply(
                         variables,
                         features,

@@ -49,14 +49,15 @@ class TaskDataService(object):
         self._current_task = None
         self._pending_tasks = deque()
 
-    def _reset(self):
+    def _reset_locked(self):
         self._reported_record_count = 0
         self._failed_record_count = 0
         self._pending_tasks = deque()
         self._current_task = None
 
     def get_current_task(self):
-        return self._current_task
+        with self._lock:
+            return self._current_task
 
     def _do_report_task(self, task, err_msg=""):
         exec_counters = None
@@ -70,29 +71,35 @@ class TaskDataService(object):
 
     def report_record_done(self, count, err_msg=""):
         """Account `count` consumed records against the pending task queue;
-        report and pop every task fully covered (reference :94-129)."""
-        self._reported_record_count += count
-        if err_msg:
-            self._failed_record_count += count
-        if not self._pending_tasks:
-            return False
-        task = self._pending_tasks[0]
-        if self._reported_record_count >= task.end - task.start:
-            with self._lock:
-                while self._pending_tasks and (
-                    self._reported_record_count
-                    >= self._pending_tasks[0].end
-                    - self._pending_tasks[0].start
-                ):
-                    task = self._pending_tasks[0]
-                    self._reported_record_count -= task.end - task.start
-                    self._pending_tasks.popleft()
-                    self._do_report_task(task, err_msg)
-                    self._failed_record_count = 0
-                if self._pending_tasks:
-                    self._current_task = self._pending_tasks[0]
+        report and pop every task fully covered (reference :94-129).
+
+        The whole method runs under the lock: the counters and the
+        pending deque are one consistent unit — the old unlocked
+        read-modify-write of the counters raced `_gen`'s appends
+        (edl-lint EDL001), and a torn `_reported_record_count` either
+        double-reports a task or strands it pending forever."""
+        with self._lock:
+            self._reported_record_count += count
+            if err_msg:
+                self._failed_record_count += count
+            if not self._pending_tasks:
+                return False
+            task = self._pending_tasks[0]
+            if self._reported_record_count < task.end - task.start:
+                return False
+            while self._pending_tasks and (
+                self._reported_record_count
+                >= self._pending_tasks[0].end
+                - self._pending_tasks[0].start
+            ):
+                task = self._pending_tasks[0]
+                self._reported_record_count -= task.end - task.start
+                self._pending_tasks.popleft()
+                self._do_report_task(task, err_msg)
+                self._failed_record_count = 0
+            if self._pending_tasks:
+                self._current_task = self._pending_tasks[0]
             return True
-        return False
 
     def flush_record_accounting(self, err_msg=""):
         """Report every still-pending task as complete.
@@ -127,23 +134,26 @@ class TaskDataService(object):
             self._current_task = None
 
     def get_train_end_callback_task(self):
-        return self._pending_train_end_callback_task
+        with self._lock:
+            return self._pending_train_end_callback_task
 
     def clear_train_end_callback_task(self):
-        self._pending_train_end_callback_task = None
+        with self._lock:
+            self._pending_train_end_callback_task = None
 
     def get_dataset(self):
         """A fresh Dataset streaming records of dispatched tasks, or None
         when the job has no more training work (reference :163-203)."""
-        if not self._pending_dataset:
-            return None
-        if self._pending_tasks:
-            logger.error(
-                "Cannot get a new dataset with pending tasks"
-            )
-            return None
-        self._reset()
-        self._pending_dataset = False
+        with self._lock:
+            if not self._pending_dataset:
+                return None
+            if self._pending_tasks:
+                logger.error(
+                    "Cannot get a new dataset with pending tasks"
+                )
+                return None
+            self._reset_locked()
+            self._pending_dataset = False
         return Dataset.from_generator(self._gen)
 
     def _gen(self):
@@ -151,7 +161,8 @@ class TaskDataService(object):
             task = self._worker.get_task()
             if not task.shard_name:
                 if task.type == pb.WAIT:
-                    self._pending_dataset = True
+                    with self._lock:
+                        self._pending_dataset = True
                     logger.info("No tasks for now, maybe more later")
                     time.sleep(self._wait_sleep_secs)
                 else:

@@ -10,9 +10,20 @@ entries are replaced, so the script can be rerun after editing the
 tables below. Keep proto/elasticdl.proto (the human-readable source of
 truth) in sync by hand.
 
-Usage: python scripts/gen_serving_proto.py
+BYTE-DETERMINISTIC: serving message types and services are appended
+sorted by name and fields sorted by field number, so the output bytes
+depend only on the CONTENT of the tables below — never on their
+ordering, dict ordering, or how often the script has run. The edl-lint
+proto-drift gate (EDL301, elasticdl_tpu/analysis/proto_rules.py) and
+the regen-twice test in tests/test_lint.py rely on this: a flaky byte
+diff would turn the CI gate into noise.
+
+Usage: python scripts/gen_serving_proto.py [--check] [--out PATH]
+  --check  regenerate in memory and exit 1 on drift, writing nothing
+  --out    write somewhere other than the checked-in pb2 (drills)
 """
 
+import argparse
 import os
 import re
 import sys
@@ -167,12 +178,13 @@ if _descriptor._USE_C_DESCRIPTORS == False:
 '''
 
 
-def current_serialized_pb():
+def current_serialized_pb(src=None):
     """Extract the serialized descriptor from the committed pb2 module
     without importing it (imports would register it in the default pool
     and block re-registration elsewhere in the same process)."""
-    with open(PB2_PATH) as f:
-        src = f.read()
+    if src is None:
+        with open(PB2_PATH) as f:
+            src = f.read()
     m = re.search(r"AddSerializedFile\((b'(?:[^'\\]|\\.)*')\)", src)
     if not m:
         raise RuntimeError("cannot find AddSerializedFile in %s" % PB2_PATH)
@@ -191,10 +203,13 @@ def build_descriptor(serialized):
     del fdp.service[:]
     fdp.service.extend(keep_svc)
 
-    for name, fields in SERVING_MESSAGES.items():
+    # stable ordering: names sort the tables, numbers sort the fields —
+    # the serialized bytes cannot depend on dict/tuple declaration order
+    for name in sorted(SERVING_MESSAGES):
+        fields = SERVING_MESSAGES[name]
         msg = fdp.message_type.add()
         msg.name = name
-        for spec in fields:
+        for spec in sorted(fields, key=lambda s: s[1]):
             fname, num, ftype, label = spec[:4]
             fld = msg.field.add()
             fld.name = fname
@@ -205,7 +220,8 @@ def build_descriptor(serialized):
             if ftype == T.TYPE_MESSAGE:
                 fld.type_name = spec[4]
 
-    for sname, methods in SERVICES.items():
+    for sname in sorted(SERVICES):
+        methods = SERVICES[sname]
         svc = fdp.service.add()
         svc.name = sname
         for mname, req, resp, streaming in methods:
@@ -223,12 +239,36 @@ def _json_name(snake):
     return parts[0] + "".join(p.capitalize() for p in parts[1:])
 
 
-def main():
-    serialized = build_descriptor(current_serialized_pb())
-    with open(PB2_PATH, "w") as f:
-        f.write(PB2_TEMPLATE.format(serialized=serialized))
-    print("wrote %s (%d descriptor bytes)" % (PB2_PATH, len(serialized)))
+def generate_text(src=None):
+    """The full pb2 file text, regenerated from `src` (the current pb2
+    source text; None reads the checked-in file). Pure function of the
+    tables above + the non-serving part of the existing descriptor —
+    the hermetic entry point the EDL301 drift gate and the regen-twice
+    determinism test call."""
+    serialized = build_descriptor(current_serialized_pb(src))
+    return PB2_TEMPLATE.format(serialized=serialized)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=PB2_PATH)
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 on drift; write nothing")
+    args = parser.parse_args(argv)
+    text = generate_text()
+    if args.check:
+        with open(PB2_PATH) as f:
+            if f.read() != text:
+                print("gen_serving_proto: %s has DRIFTED from the "
+                      "generator tables" % PB2_PATH, file=sys.stderr)
+                return 1
+        print("gen_serving_proto: %s is up to date" % PB2_PATH)
+        return 0
+    with open(args.out, "w") as f:
+        f.write(text)
+    print("wrote %s (%d chars)" % (args.out, len(text)))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

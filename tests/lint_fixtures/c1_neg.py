@@ -1,0 +1,39 @@
+"""NEGATIVE fixture for EDL001/EDL002: every guarded access is under
+the lock, via the `*_locked` convention, via a helper whose only call
+sites are locked (the call-graph-light fixpoint), or in __init__ /
+ctor-only helpers. Expected findings: none."""
+
+import threading
+
+
+class Counter(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._items = []
+        self._seed_initial()  # ctor-only helper: exempt
+
+    def _seed_initial(self):
+        self._items.append(0)
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+            self._record()
+
+    def _record(self):
+        # only called from bump's locked region -> treated as locked
+        self._items.append(self._count)
+
+    def _drain_locked(self):
+        # the *_locked suffix declares "caller holds the lock"
+        self._items.clear()
+        self._count = 0
+
+    def reset(self):
+        with self._lock:
+            self._drain_locked()
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items), self._count

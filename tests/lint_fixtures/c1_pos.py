@@ -1,0 +1,27 @@
+"""POSITIVE fixture for EDL001/EDL002: a lock-owning class that
+mutates and reads its guarded attributes outside the lock. Expected
+findings: EDL001 at bump_unlocked/append_unlocked, EDL002 at
+peek_unlocked."""
+
+import threading
+
+
+class Counter(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._items = []
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+            self._items.append(self._count)
+
+    def bump_unlocked(self):
+        self._count += 1  # EDL001
+
+    def append_unlocked(self, x):
+        self._items.append(x)  # EDL001
+
+    def peek_unlocked(self):
+        return self._count  # EDL002

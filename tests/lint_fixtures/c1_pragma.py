@@ -1,0 +1,23 @@
+"""PRAGMA fixture: the same violation as c1_pos, suppressed in place.
+Expected findings: none (both pragma placements: same line and the
+line above)."""
+
+import threading
+
+
+class Gauge(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def read_fast(self):
+        # monotonic int; GIL-atomic single read
+        return self._value  # edl-lint: disable=EDL002
+
+    def read_fast_too(self):
+        # edl-lint: disable=EDL002 — same justification, line above
+        return self._value

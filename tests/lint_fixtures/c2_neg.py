@@ -1,0 +1,41 @@
+"""NEGATIVE fixture for EDL101/EDL102/EDL103: the sanctioned idioms —
+jnp ops on tracers, branches on static config (closures, shapes,
+static_argnames), host syncs OUTSIDE jit. Expected findings: none."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_decode(cfg):
+    causal = cfg["causal"]
+
+    def decode(x, length):
+        if causal:  # closure config: static, fine
+            x = jnp.tril(x)
+        if x.shape[0] > 8:  # shapes are trace-static, fine
+            x = x[:8]
+        y = jnp.where(x > 0, x, 0.0)  # traced branch, the right way
+        return y * length
+
+    return jax.jit(decode)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def unrolled(x, n_steps):
+    for _ in range(int(n_steps)):  # static arg: int() is fine
+        x = x + 1.0
+    return x
+
+
+def host_side_driver(step_fn, state):
+    # NOT a jit context: host syncs and timing are the point here
+    t0 = time.time()
+    state = step_fn(state)
+    state.block_until_ready()
+    loss = float(np.asarray(state).mean())
+    print("step took", time.time() - t0, loss)
+    return state

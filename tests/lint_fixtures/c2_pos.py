@@ -1,0 +1,39 @@
+"""POSITIVE fixture for EDL101/EDL102/EDL103: host syncs, tracer
+branches, and trace-time side effects inside jit contexts, in both the
+decorator and the wrap idiom. Expected findings: EDL101 x4 (.item(),
+float(), np.asarray, block_until_ready), EDL102 x2 (if, while),
+EDL103 x2 (time.time, print)."""
+
+import time
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_hazards(x):
+    v = x.sum()
+    host = v.item()  # EDL101
+    if v > 0:  # EDL102
+        v = v + 1.0
+    print(v)  # EDL103
+    return host
+
+
+@partial(jax.jit, static_argnames=("n",))
+def partial_decorated(x, n):
+    t0 = time.time()  # EDL103
+    y = float(x[0])  # EDL101 (x is traced; n is static)
+    while y > 0:  # EDL102
+        y = y - n
+    return y + t0
+
+
+def build_step():
+    def step(state, tokens):
+        arr = np.asarray(tokens)  # EDL101
+        state.block_until_ready()  # EDL101
+        return state, arr
+
+    return jax.jit(step)

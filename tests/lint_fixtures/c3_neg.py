@@ -1,0 +1,44 @@
+"""NEGATIVE fixture for EDL201: the sanctioned forms — every wait
+bounded, every RPC deadlined, the injected sleep, and blocking calls
+in classes outside the servicer/dispatch surface. Expected findings:
+none."""
+
+import queue
+import time
+
+
+class PromptServicer(object):
+    def __init__(self, stub, done_event, sleep=None):
+        self._stub = stub
+        self._done = done_event
+        self._results = queue.Queue()
+        self._sleep = sleep or (lambda s: None)
+
+    def generate(self, request, context=None):
+        self._sleep(0.01)  # injected sleep: testable and bounded
+        try:
+            return self._results.get(timeout=1.0)
+        except queue.Empty:
+            return None
+
+    def forward(self, request, context=None):
+        return self._stub.generate(request, timeout=5.0)
+
+    def flush(self, request, context=None):
+        self._done.wait(2.0)
+        return None
+
+
+class BatchWorker(object):
+    """Not a servicer, not a router: a background consumer thread MAY
+    block forever on its feed queue."""
+
+    def __init__(self):
+        self._q = queue.Queue()
+
+    def run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            time.sleep(0.0)

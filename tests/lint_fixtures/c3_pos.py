@@ -1,0 +1,37 @@
+"""POSITIVE fixture for EDL201: unbounded blocking inside gRPC
+servicer methods and router dispatch paths. Expected findings:
+EDL201 x5 (time.sleep, queue.get, stub call w/o timeout, .wait(),
+dispatch-path queue.get)."""
+
+import queue
+import time
+
+
+class SlowServicer(object):
+    def __init__(self, stub, done_event):
+        self._stub = stub
+        self._done = done_event
+        self._results = queue.Queue()
+
+    def generate(self, request, context=None):
+        time.sleep(0.5)  # EDL201
+        return self._results.get()  # EDL201
+
+    def forward(self, request, context=None):
+        return self._stub.generate(request)  # EDL201: no timeout=
+
+    def flush(self, request, context=None):
+        self._done.wait()  # EDL201
+        return None
+
+
+class EdgeRouter(object):
+    def __init__(self):
+        self._results = queue.Queue()
+
+    def dispatch_generate(self, request):
+        return self._results.get()  # EDL201
+
+    def housekeeping(self):
+        # NOT a dispatch-path method: unbounded wait tolerated here
+        return self._results.get()

@@ -35,8 +35,6 @@ initialize_distributed(
     platform="cpu",
 )
 
-import jax
-
 from elasticdl_tpu.common.model_utils import load_model_spec_from_module
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.worker.worker import JobType, Worker

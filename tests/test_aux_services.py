@@ -266,7 +266,6 @@ def test_collective_rejects_bad_op():
 def test_profile_trace_writes_trace(tmp_path):
     import glob as _glob
 
-    import jax
     import jax.numpy as jnp
 
     from elasticdl_tpu.common.profiler import (

@@ -14,7 +14,7 @@ from elasticdl_tpu.common.model_handler import (
 )
 from elasticdl_tpu.embedding.host_bridge import HostEmbeddingManager
 from elasticdl_tpu.embedding.host_spill import HostSpillEmbeddingEngine
-from tests.test_host_bridge import VOCAB, _batches, _host_trainer
+from tests.test_host_bridge import _batches, _host_trainer
 
 
 def _fresh_manager():

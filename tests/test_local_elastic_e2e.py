@@ -5,7 +5,6 @@ TPU-native analogue of the reference's PS-restart fault test
 (scripts/travis/run_job.sh), run without a cluster."""
 
 import os
-import subprocess
 import time
 
 import pytest

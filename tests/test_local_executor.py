@@ -6,10 +6,7 @@ import numpy as np
 import pytest
 
 from elasticdl_tpu.api.local_executor import LocalExecutor
-from elasticdl_tpu.common.model_utils import (
-    get_model_spec,
-    load_model_spec_from_module,
-)
+from elasticdl_tpu.common.model_utils import get_model_spec
 from elasticdl_tpu.data import recordio_gen
 
 MODEL_ZOO = "model_zoo"

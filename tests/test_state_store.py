@@ -2,7 +2,6 @@
 round-trip, compaction, torn-line tolerance, exact todo ∪ requeued-doing
 reconstruction, retry-count carryover, and late-report reconciliation."""
 
-import json
 import os
 
 import pytest
