@@ -29,4 +29,5 @@ from elasticdl_tpu.analysis import (  # noqa: F401,E402
     jit_rules,
     lock_rules,
     proto_rules,
+    telemetry_rules,
 )

@@ -34,6 +34,7 @@ RULE_FAMILIES = {
     "EDL101": ("EDL101", "EDL102", "EDL103"),
     "EDL201": ("EDL201",),
     "EDL301": ("EDL301",),
+    "EDL401": ("EDL401",),
 }
 
 DEFAULT_PATHS = ("elasticdl_tpu", "scripts", "tests")

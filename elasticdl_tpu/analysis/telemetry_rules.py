@@ -1,0 +1,111 @@
+"""C5 — telemetry counter-name checker (EDL401).
+
+The telemetry counter sets are CLOSED (ServingTelemetry.COUNTERS /
+RouterTelemetry.COUNTERS in serving/telemetry.py): `count()` raises at
+runtime on an undeclared name, because a typo like ``count("admittd")``
+used to silently fork a brand-new counter and under-report the real
+one forever — a observability bug that corrupts dashboards without
+ever failing a test that doesn't read the exact counter back.
+
+This rule is the STATIC twin of that runtime raise: it flags every
+``<telemetry-ish receiver>.count("<literal>")`` call site whose string
+literal is not in the declared union of both counter sets, so the typo
+fails `make lint` before any drill has to hit the code path.
+
+FLAGGED: attribute calls ``X.count("name")`` where the receiver's
+dotted spelling mentions ``telemetry`` (``self.telemetry.count``,
+``self._telemetry.count``, ``router.telemetry.count`` ...) and the
+first argument is a string literal not in the declared set.
+
+NOT flagged: non-literal names (the runtime raise owns those),
+receivers that don't spell ``telemetry`` (list.count etc.), and call
+sites with no arguments.
+
+The declared set is read from elasticdl_tpu.serving.telemetry at rule
+run time (stdlib-only import), so declaring a new counter there is
+the single source of truth — no second list to update here.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+
+
+def _receiver_text(node):
+    """Dotted spelling of an attribute chain, lowercased."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)).lower()
+
+
+def declared_counters():
+    """The closed counter-name union (single source of truth:
+    serving/telemetry.py class attributes)."""
+    from elasticdl_tpu.serving.telemetry import (
+        RouterTelemetry,
+        ServingTelemetry,
+    )
+
+    return frozenset(ServingTelemetry.COUNTERS) | frozenset(
+        RouterTelemetry.COUNTERS
+    )
+
+
+class _CounterVisitor(ast.NodeVisitor):
+    def __init__(self, path, allowed):
+        self.path = path
+        self.allowed = allowed
+        self.scope_stack = []
+        self.findings = []
+
+    @property
+    def scope(self):
+        return ".".join(self.scope_stack) or "<module>"
+
+    def visit_ClassDef(self, node):
+        self.scope_stack.append(node.name)
+        self.generic_visit(node)
+        self.scope_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.scope_stack.append(node.name)
+        self.generic_visit(node)
+        self.scope_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "count"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and "telemetry" in _receiver_text(fn.value)):
+            name = node.args[0].value
+            if name not in self.allowed:
+                self.findings.append(Finding(
+                    "EDL401", self.path, node.lineno, self.scope,
+                    name,
+                    "unknown telemetry counter %r — not in the "
+                    "declared ServingTelemetry/RouterTelemetry "
+                    "COUNTERS (a typo here silently forks a new "
+                    "counter; fix the name or declare it)" % name,
+                ))
+        self.generic_visit(node)
+
+
+@register
+class TelemetryCounterRule(Rule):
+    """EDL401 — see module docstring."""
+
+    id = "EDL401"
+    name = "telemetry-counter-name"
+
+    def check_module(self, tree, lines, path):
+        visitor = _CounterVisitor(path, declared_counters())
+        visitor.visit(tree)
+        return visitor.findings

@@ -176,13 +176,35 @@ class FaultInjector(object):
                     return rule
         return None
 
-    def intercept(self, rpc_name, context=None, when="before"):
+    def _trace_fault(self, rpc_name, rule, trace_id):
+        """Mark the injected fault in the distributed trace: an
+        instantaneous `fault_injected` span on the request's OWN trace
+        when the RPC carried context (chaos drills then show the
+        injected drop/delay as a causal node inside the request tree),
+        on a fresh trace otherwise. Best-effort by design."""
+        try:
+            from elasticdl_tpu.observability.tracing import recorder
+
+            recorder().start_span(
+                "fault_injected", trace_id=trace_id or None,
+                rpc=rpc_name, action=rule.action,
+            ).finish("injected")
+            if rule.action == "kill":
+                # last chance to get the ring to disk: SIGKILL skips
+                # every atexit/stop path
+                recorder().flush()
+        except Exception:  # pragma: no cover - never block the fault
+            pass
+
+    def intercept(self, rpc_name, context=None, when="before",
+                  trace_id=""):
         """Apply the first matching armed rule. Raises (or aborts the
         gRPC context) for drop/error, sleeps for delay, SIGKILLs the
         process for kill, no-ops when nothing matches."""
         rule = self._fire(rpc_name, when)
         if rule is None:
             return
+        self._trace_fault(rpc_name, rule, trace_id)
         if rule.action == "delay":
             logger.warning(
                 "[fault] delaying %s by %.2fs", rpc_name, rule.secs
@@ -255,9 +277,14 @@ class FaultInjectingServicer(object):
         handler = getattr(self._servicer, name)
 
         def rpc(request, _context=None):
-            self._injector.intercept(name, context=_context, when="before")
+            # requests carrying trace context get their injected
+            # faults annotated INSIDE their own span tree
+            trace_id = getattr(request, "trace_id", "")
+            self._injector.intercept(name, context=_context,
+                                     when="before", trace_id=trace_id)
             response = handler(request, _context)
-            self._injector.intercept(name, context=_context, when="after")
+            self._injector.intercept(name, context=_context,
+                                     when="after", trace_id=trace_id)
             return response
 
         rpc.__name__ = name

@@ -228,6 +228,11 @@ def _run_master(args, status_file=""):
     if tensorboard_service is not None and args.worker_image:
         _expose_tensorboard(instance_manager)
     logger.info("Master ready on port %d", master.port)
+    # name this process's span recorder; dispatch spans export to
+    # $EDL_TRACE_DIR on exit (atexit) when tracing is armed
+    from elasticdl_tpu.observability.tracing import configure
+
+    configure(service="master:%d" % master.port)
     job_status.write_job_status(status_file, job_status.RUNNING)
     return master.run()
 

@@ -12,6 +12,7 @@ import time
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.master.task_dispatcher import TaskType
+from elasticdl_tpu.observability.tracing import recorder
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.proto.convert import TASK_TYPE_TO_PB as _TASK_TYPE_TO_PB
 
@@ -42,6 +43,13 @@ class MasterServicer(object):
         # report per version would drop the tail of the cumulative
         # counters. Guarded by self._lock (gRPC thread pool).
         self._tier_gauge_steps = {}
+        # training-plane tracing: one `task_dispatch` span per
+        # outstanding dispatched task, opened at get_task and sealed
+        # at report_task_result — the Task proto carries (trace_id,
+        # span_id) so the worker's task span parents under it and the
+        # whole dispatch->fetch->report hop merges into one tree keyed
+        # by task id. Guarded by self._lock (gRPC thread pool).
+        self._task_spans = {}
         if evaluation_service:
             evaluation_service.set_master_servicer(self)
 
@@ -70,6 +78,21 @@ class MasterServicer(object):
             if task.type == TaskType.EVALUATION:
                 # eval tasks pin the model version they evaluate
                 res.model_version = task.model_version
+            span = recorder().start_span(
+                "task_dispatch", task_id=task_id,
+                worker_id=request.worker_id, type=str(task.type),
+            )
+            res.trace_id = span.trace_id
+            res.span_id = span.span_id
+            with self._lock:
+                # the same task re-dispatched (worker died, task
+                # requeued) seals the previous span so every dispatch
+                # attempt stays visible as its own span
+                old = self._task_spans.pop(task_id, None)
+                self._task_spans[task_id] = span
+            if old is not None:
+                old.event("redispatched", worker_id=request.worker_id)
+                old.finish("redispatched")
         elif (not self._task_d.finished()) or (
             self._task_d.invoke_deferred_callback()
         ):
@@ -84,6 +107,8 @@ class MasterServicer(object):
         return res
 
     def report_task_result(self, request, _context=None):
+        self._finish_task_span(request.task_id,
+                               ok=not request.err_message)
         if request.err_message:
             logger.warning(
                 "Worker reported error: %s", request.err_message
@@ -106,6 +131,16 @@ class MasterServicer(object):
                         )
         self._write_tier_gauges(dict(request.exec_counters), worker_id)
         return pb.Empty()
+
+    def _finish_task_span(self, task_id, ok):
+        """Seal the dispatch span a report closes. A late duplicate
+        report (requeued straggler) finds no span — its re-dispatch
+        already sealed the old one — and is simply untraced."""
+        with self._lock:
+            span = self._task_spans.pop(task_id, None)
+        if span is not None:
+            span.event("reported", ok=ok)
+            span.finish("ok" if ok else "error")
 
     def _write_tier_gauges(self, exec_counters, worker_id):
         """Workers piggyback cumulative tier-health counters (host-tier

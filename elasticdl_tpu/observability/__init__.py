@@ -1,0 +1,34 @@
+"""Distributed tracing + latency histograms, dependency-free.
+
+The observability substrate for the whole system: request-scoped span
+trees that survive process hops (client -> router -> replica -> decode
+step, master -> worker -> report), a bounded per-process span recorder
+exporting Chrome-trace/Perfetto JSON, and fixed-bucket log-linear
+histograms (HDR-style: O(1) record, mergeable across processes) that
+back every latency percentile the status RPCs and the serving bench
+report — one definition of p99, everywhere.
+
+Modules:
+
+* tracing    — trace/span ids, `Span`, the ring-buffer `SpanRecorder`,
+               the process-global recorder, Chrome-trace conversion
+* histogram  — `LogLinearHistogram` + the shared `percentiles()` entry
+* dump       — CLI merging per-process span exports into one trace
+               (``python -m elasticdl_tpu.observability.dump``)
+
+Design doc: docs/designs/observability.md.
+"""
+
+from elasticdl_tpu.observability.histogram import (  # noqa: F401
+    LogLinearHistogram,
+    percentiles,
+)
+from elasticdl_tpu.observability.tracing import (  # noqa: F401
+    Span,
+    SpanRecorder,
+    chrome_trace,
+    configure,
+    new_span_id,
+    new_trace_id,
+    recorder,
+)

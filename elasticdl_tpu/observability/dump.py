@@ -1,0 +1,86 @@
+"""Merge per-process span exports into one Chrome-trace JSON.
+
+Every traced process (router, replicas, master, workers) writes its
+ring buffer to ``$EDL_TRACE_DIR/spans-<service>-<pid>.json`` on clean
+shutdown (tracing.SpanRecorder.flush). This tool stitches those files
+into a single timeline — spans keep their trace/span/parent ids, so
+one request dispatched through the router shows up as ONE tree with
+the router's dispatch spans parenting each replica's serve span.
+
+    python -m elasticdl_tpu.observability.dump \\
+        --dir /tmp/edl-traces --out trace.json
+
+Open ``trace.json`` at ui.perfetto.dev (or chrome://tracing). The
+chaos drill calls `merge_dir` directly and asserts the causal
+structure of what it finds (scripts/run_router_chaos_drill.py).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from elasticdl_tpu.observability.tracing import (
+    TRACE_DIR_ENV,
+    chrome_trace,
+    group_by_trace,
+)
+
+
+def merge_dir(trace_dir):
+    """(span dicts, per-process meta) from every spans-*.json export
+    under `trace_dir`. Unreadable files are reported in meta, not
+    fatal: a SIGKILLed process's missing/partial export must never
+    block merging the survivors."""
+    spans, meta = [], []
+    for path in sorted(glob.glob(
+            os.path.join(trace_dir, "spans-*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            meta.append({"path": path, "error": str(e)})
+            continue
+        meta.append({
+            "path": path,
+            "service": doc.get("service", "?"),
+            "pid": doc.get("pid", 0),
+            "spans": len(doc.get("spans", ())),
+            "dropped": doc.get("dropped", 0),
+        })
+        spans.extend(doc.get("spans", ()))
+    return spans, meta
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--dir", default=os.environ.get(TRACE_DIR_ENV, ""),
+        help="directory of spans-*.json exports (default: "
+             "$EDL_TRACE_DIR)",
+    )
+    parser.add_argument("--out", default="trace.json",
+                        help="merged Chrome-trace JSON output path")
+    args = parser.parse_args(argv)
+    if not args.dir:
+        print("dump: no --dir and no $%s set" % TRACE_DIR_ENV,
+              file=sys.stderr)
+        return 2
+    spans, meta = merge_dir(args.dir)
+    with open(args.out, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    dropped = sum(m.get("dropped", 0) for m in meta)
+    errors = [m for m in meta if "error" in m]
+    print(
+        "dump: merged %d spans across %d traces from %d exports -> %s"
+        " (%d dropped ring entries%s)"
+        % (len(spans), len(group_by_trace(spans)),
+           len(meta) - len(errors), args.out, dropped,
+           "; %d unreadable exports" % len(errors) if errors else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,176 @@
+"""Fixed-bucket log-linear latency histograms (HDR-style).
+
+One bucket scheme for the WHOLE system, frozen at import time, so any
+two histograms — a replica's TTFT recorder, the router's merge of
+three replicas, the serving bench's client-side samples — are
+mergeable by elementwise bucket-count addition and comparable without
+unit negotiation. Replacing point-gauges/EWMAs with these is what lets
+`ServerStatus`/`router_status` answer "what is p99 right now" and lets
+`bench_serving.py` and the live telemetry compute percentiles from the
+SAME code path (definitionally identical numbers).
+
+Scheme (values are non-negative floats; the system records
+milliseconds): the value is scaled by ``1/RESOLUTION`` to an integer
+``n``; the first ``SUBBUCKETS`` buckets are linear (width =
+RESOLUTION), above that each power-of-two "decade" is split into
+``SUBBUCKETS/2`` linear subbuckets — so relative error is bounded by
+``2/SUBBUCKETS`` (~3.1% at 64) at EVERY magnitude, from a 10 us queue
+pop to an hours-long stall, with ``NUM_BUCKETS`` (= 832) total
+buckets. Record cost is O(1): one divide + ``int.bit_length`` + two
+shifts — cheap enough for the decode loop.
+
+Thread-safety: none here, by design — every histogram in the system
+lives behind its owner's telemetry lock (serving/telemetry.py), and
+the bench records from a single aggregation thread. Keeping the lock
+out of the hot `record` keeps the overhead bound honest.
+"""
+
+import math
+
+#: smallest distinguishable value (0.01 => 10 us when recording ms)
+RESOLUTION = 0.01
+#: linear subbuckets per power-of-two decade (power of two)
+SUBBUCKETS = 64
+_SUB_BITS = SUBBUCKETS.bit_length() - 1  # log2(SUBBUCKETS)
+_HALF = SUBBUCKETS // 2
+#: decades above the linear range (covers ~2.8 hours in ms)
+_DECADES = 24
+NUM_BUCKETS = SUBBUCKETS + _DECADES * _HALF
+
+
+def bucket_index(value):
+    """O(1) bucket index for a non-negative value."""
+    try:
+        n = int(value / RESOLUTION)
+    except (OverflowError, ValueError):  # inf: clamp to the top
+        return NUM_BUCKETS - 1
+    if n < SUBBUCKETS:
+        return n if n >= 0 else 0
+    e = n.bit_length() - _SUB_BITS  # >= 1
+    if e > _DECADES:  # beyond the top decade: clamp
+        return NUM_BUCKETS - 1
+    m = n >> e  # in [SUBBUCKETS/2, SUBBUCKETS)
+    return SUBBUCKETS + (e - 1) * _HALF + (m - _HALF)
+
+
+def bucket_bounds(idx):
+    """(lower, upper) value bounds of bucket `idx` (upper exclusive)."""
+    if idx < SUBBUCKETS:
+        return idx * RESOLUTION, (idx + 1) * RESOLUTION
+    k = idx - SUBBUCKETS
+    e = k // _HALF + 1
+    m = _HALF + k % _HALF
+    return (m << e) * RESOLUTION, ((m + 1) << e) * RESOLUTION
+
+
+class LogLinearHistogram(object):
+    """Mergeable fixed-bucket histogram with exact count/sum/min/max.
+
+    ``counts`` is a dense list of ``NUM_BUCKETS`` ints; `to_counts()`
+    trims trailing zeros for wire transport (the `repeated int64`
+    histogram fields on the status protos) and `from_counts()`
+    rebuilds — merge is elementwise addition, so per-replica
+    histograms aggregate at the router without losing percentile
+    fidelity (percentiles of merged counts, never averages of
+    percentiles)."""
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self):
+        self.counts = [0] * NUM_BUCKETS
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    def record(self, value):
+        value = float(value)
+        if not 0.0 <= value < math.inf:  # negative/NaN/inf: refuse
+            return
+        self.counts[bucket_index(value)] += 1
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def merge(self, other):
+        """Fold `other` in (elementwise bucket addition)."""
+        for i, c in enumerate(other.counts):
+            if c:
+                self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        if other.count:
+            self.min = min(self.min, other.min)
+            self.max = max(self.max, other.max)
+        return self
+
+    def percentile(self, q):
+        """Value at percentile `q` (0..100): the midpoint of the
+        bucket where the cumulative count crosses rank ceil(q% * n),
+        clamped into the exact [min, max] envelope (so a one-sample
+        histogram answers that sample's bucket, not a bucket edge).
+        0.0 when empty — proto-friendly: absent percentile == 0."""
+        if not self.count:
+            return 0.0
+        rank = max(1, int(math.ceil(q / 100.0 * self.count)))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank:
+                lo, hi = bucket_bounds(i)
+                mid = (lo + hi) / 2.0
+                return min(max(mid, self.min), self.max)
+        return self.max  # unreachable unless counts were tampered
+
+    def snapshot(self, qs=(50, 90, 99)):
+        """{"p50": ..., "p90": ..., "p99": ..., "count": n} — the
+        status-RPC shape."""
+        out = {"p%d" % q: self.percentile(q) for q in qs}
+        out["count"] = self.count
+        return out
+
+    def to_counts(self):
+        """Dense counts with trailing zeros trimmed (wire form)."""
+        last = 0
+        for i, c in enumerate(self.counts):
+            if c:
+                last = i + 1
+        return self.counts[:last]
+
+    @classmethod
+    def from_counts(cls, counts):
+        """Rebuild from wire-form counts. min/max/sum degrade to
+        bucket-midpoint estimates (bounded by the scheme's relative
+        error) — good enough for percentile math, which only needs
+        the counts."""
+        h = cls()
+        for i, c in enumerate(counts):
+            c = int(c)
+            if c <= 0 or i >= NUM_BUCKETS:
+                continue
+            h.counts[i] = c
+            h.count += c
+            lo, hi = bucket_bounds(i)
+            mid = (lo + hi) / 2.0
+            h.sum += mid * c
+            h.min = min(h.min, mid)
+            h.max = max(h.max, mid)
+        return h
+
+
+def percentiles(values, qs=(50, 90, 99)):
+    """Percentiles of `values` through the shared histogram — THE
+    entry point bench_serving.py and the tests use, so offline bench
+    numbers and live status-RPC numbers come from one definition.
+    {"p50": ...} with None entries when `values` is empty (a bench
+    with no completions has no percentile, unlike a live histogram
+    where 0 means "no data yet")."""
+    if not values:
+        return {"p%d" % q: None for q in qs}
+    h = LogLinearHistogram()
+    for v in values:
+        h.record(v)
+    return {"p%d" % q: round(h.percentile(q), 3) for q in qs}

@@ -1,0 +1,325 @@
+"""Request-scoped distributed tracing: spans, the ring-buffer
+recorder, and Chrome-trace/Perfetto export.
+
+The context model is deliberately tiny — three ids, all hex strings:
+
+* ``trace_id``        one per REQUEST (or per training task), minted
+                      at admission wherever the request first enters
+                      the system (router, direct client, or the
+                      master handing out a task);
+* ``span_id``         one per span;
+* ``parent_span_id``  the causal edge. Crossing a process boundary
+                      means copying ``(trace_id, span_id)`` into the
+                      RPC's trace fields; the receiver starts its span
+                      with ``parent_span_id = <sender's span_id>``.
+
+That is enough to reassemble ONE tree per request across any number
+of processes and retries: a hedge or a re-dispatch creates SIBLING
+spans under the same parent, a mid-stream replica loss shows as a
+failed child next to the replacement — causality survives exactly the
+hops the router/master elasticity story creates.
+
+Standard span events (attach with ``span.event(name, **attrs)``):
+``queued``, ``seated``, ``prefill``, ``first_token``, ``completed``,
+``expired``, ``rejected``, ``redispatched``, ``hedged``,
+``hedge_win``, ``breaker_trip``, ``shed``, ``fault_injected``,
+``fetched``, ``reported``. Nothing enforces the vocabulary — but the
+chaos drill's structural assertions and the dump tool's summary key
+on these names, so stick to them.
+
+Recording is ALWAYS on and bounded: finished spans land in a
+lock-guarded ring buffer (drop-OLDEST on overflow, with a ``dropped``
+counter — a traced process can never grow without bound, and the drop
+is visible). Export to disk happens only when ``EDL_TRACE_DIR`` is
+set: each process writes ``spans-<service>-<pid>.json`` there
+(explicitly via ``flush()`` on clean shutdown, plus an atexit
+backstop), and ``python -m elasticdl_tpu.observability.dump`` merges
+every per-process export into one Chrome-trace JSON that loads in
+Perfetto (ui.perfetto.dev) or chrome://tracing.
+
+Timestamps are ``time.time()`` (wall clock): spans from different
+processes must land on one timeline, which monotonic clocks cannot
+give across processes. Good enough for the single-host drills this
+serves; cross-host skew shifts whole processes, never re-orders one
+process's spans.
+"""
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+
+TRACE_DIR_ENV = "EDL_TRACE_DIR"
+
+_DEFAULT_CAPACITY = 4096
+
+
+def new_trace_id():
+    return os.urandom(8).hex()
+
+
+def new_span_id():
+    return os.urandom(8).hex()
+
+
+class Span(object):
+    """One timed operation. Created by ``SpanRecorder.start_span``;
+    call ``finish()`` (or use as a context manager) to seal it into
+    the recorder's ring. Unfinished spans are never exported.
+
+    Cross-thread use is the NORM here (a serving request's span is
+    touched by the gRPC handler thread and the scheduler thread):
+    ``event``/``set`` are plain appends/updates — atomic under the
+    GIL — and ``finish`` is idempotent under the recorder's lock, so
+    a terminal race records the span exactly once."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_span_id",
+                 "service", "start", "end", "status", "attrs",
+                 "events", "_recorder")
+
+    def __init__(self, recorder, name, trace_id, parent_span_id,
+                 attrs, start):
+        self._recorder = recorder
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = new_span_id()
+        self.parent_span_id = parent_span_id or ""
+        self.service = recorder.service
+        self.start = start
+        self.end = None
+        self.status = None
+        self.attrs = dict(attrs)
+        self.events = []
+
+    def event(self, name, **attrs):
+        """Timestamped point annotation inside the span."""
+        self.events.append((self._recorder.clock(), name, attrs))
+        return self
+
+    def set(self, **attrs):
+        self.attrs.update(attrs)
+        return self
+
+    def finish(self, status="ok"):
+        """Seal the span into the recorder's ring (idempotent: the
+        first finish wins; later calls are no-ops)."""
+        self._recorder._finish(self, status)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, _tb):
+        self.finish("ok" if exc_type is None else "error")
+        return False
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "service": self.service,
+            "start": self.start,
+            "end": self.end,
+            "status": self.status,
+            "attrs": self.attrs,
+            "events": [
+                {"ts": ts, "name": name, "attrs": attrs}
+                for ts, name, attrs in list(self.events)
+            ],
+        }
+
+
+class SpanRecorder(object):
+    """Per-process bounded store of FINISHED spans.
+
+    Memory is bounded by construction: `capacity` spans, drop-oldest
+    with a monotone ``dropped`` counter (never drop-newest — the most
+    recent spans are the ones a post-incident export wants). All
+    mutation under one lock; `start_span` allocates outside it (span
+    construction is lock-free), so tracing adds one short critical
+    section per REQUEST, not per token."""
+
+    def __init__(self, service="proc", capacity=_DEFAULT_CAPACITY,
+                 clock=time.time):
+        self.service = service
+        self.capacity = int(capacity)
+        self.clock = clock
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._spans = deque()
+
+    def start_span(self, name, trace_id=None, parent_span_id="",
+                   **attrs):
+        """New span; mints a fresh trace when `trace_id` is falsy
+        (this IS admission: the point a request first gets traced)."""
+        return Span(self, name, trace_id or new_trace_id(),
+                    parent_span_id, attrs, self.clock())
+
+    def _finish(self, span, status):
+        with self._lock:
+            if span.end is not None:  # idempotent terminal
+                return
+            span.end = self.clock()
+            span.status = status
+            self._spans.append(span)
+            while len(self._spans) > self.capacity:
+                self._spans.popleft()
+                self.dropped += 1
+
+    def __len__(self):
+        with self._lock:
+            return len(self._spans)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def export(self):
+        """The on-disk per-process document the dump tool merges."""
+        with self._lock:
+            spans = list(self._spans)
+            dropped = self.dropped
+        return {
+            "service": self.service,
+            "pid": os.getpid(),
+            "dropped": dropped,
+            "spans": [s.to_dict() for s in spans],
+        }
+
+    def write(self, path):
+        """Atomic JSON write (tmp + rename): a process dying mid-write
+        can never leave a torn file for the merger to choke on."""
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(self.export(), f)
+        os.replace(tmp, path)
+        return path
+
+    def flush(self, trace_dir=None):
+        """Write this process's spans into the trace directory
+        (EDL_TRACE_DIR unless given). No-op returning None when no
+        directory is configured — the zero-config production default
+        keeps spans in memory only."""
+        trace_dir = trace_dir or os.environ.get(TRACE_DIR_ENV, "")
+        if not trace_dir:
+            return None
+        os.makedirs(trace_dir, exist_ok=True)
+        safe = "".join(
+            c if c.isalnum() or c in "-_." else "-"
+            for c in self.service
+        )
+        return self.write(os.path.join(
+            trace_dir, "spans-%s-%d.json" % (safe, os.getpid())
+        ))
+
+
+# ------------------------------------------------- process-global recorder
+
+_RECORDER = SpanRecorder()
+_ATEXIT_ARMED = False
+
+
+def recorder():
+    """The process-global recorder every subsystem records into (one
+    file per process at export time). Tests may swap service/capacity
+    via configure() or construct private SpanRecorders."""
+    return _RECORDER
+
+
+def configure(service=None, capacity=None):
+    """Name this process's recorder (e.g. ``replica:50051``,
+    ``router``, ``master``) and arm the atexit flush backstop. Called
+    by the process entrypoints; safe to call repeatedly."""
+    global _ATEXIT_ARMED
+    if service:
+        _RECORDER.service = service
+    if capacity:
+        _RECORDER.capacity = int(capacity)
+    if not _ATEXIT_ARMED:
+        _ATEXIT_ARMED = True
+        atexit.register(lambda: _RECORDER.flush())
+    return _RECORDER
+
+
+# ------------------------------------------------------ chrome conversion
+
+
+def group_by_trace(span_dicts):
+    """{trace_id: [span dicts]} — the structural-assertion entry the
+    tests and the chaos drill use."""
+    by_trace = {}
+    for s in span_dicts:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    return by_trace
+
+
+def trace_roots(span_dicts):
+    """Spans with no parent IN the set (cross-process parents that
+    were never exported — e.g. a SIGKILLed process — leave their
+    children as roots rather than hiding them)."""
+    ids = {s["span_id"] for s in span_dicts}
+    return [s for s in span_dicts
+            if not s["parent_span_id"] or s["parent_span_id"] not in ids]
+
+
+def children_of(span_dicts, parent_span_id):
+    return [s for s in span_dicts
+            if s["parent_span_id"] == parent_span_id]
+
+
+def chrome_trace(span_dicts):
+    """Convert merged span dicts into Chrome-trace JSON (the "JSON
+    Array Format" both chrome://tracing and Perfetto ingest).
+
+    Layout: one Chrome "process" per service (process_name metadata),
+    one "thread" per trace within it — so opening the file shows each
+    request's spans stacked on one row, per tier. Every slice carries
+    trace_id/span_id/parent_span_id (plus the span attrs and status)
+    in ``args``; span events become instant events on the same row."""
+    services = sorted({s["service"] for s in span_dicts})
+    pid_of = {svc: i + 1 for i, svc in enumerate(services)}
+    tid_of = {}
+    events = []
+    for svc, pid in pid_of.items():
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": svc},
+        })
+    for s in sorted(span_dicts, key=lambda d: (d["start"], d["name"])):
+        pid = pid_of[s["service"]]
+        tid = tid_of.setdefault((pid, s["trace_id"]),
+                                len(tid_of) + 1)
+        end = s["end"] if s["end"] is not None else s["start"]
+        args = dict(s["attrs"])
+        args.update({
+            "trace_id": s["trace_id"],
+            "span_id": s["span_id"],
+            "parent_span_id": s["parent_span_id"],
+            "status": s["status"],
+        })
+        events.append({
+            "name": s["name"], "cat": s["service"], "ph": "X",
+            "pid": pid, "tid": tid,
+            "ts": s["start"] * 1e6,
+            "dur": max(0.0, (end - s["start"])) * 1e6,
+            "args": args,
+        })
+        for ev in s["events"]:
+            events.append({
+                "name": ev["name"], "cat": s["service"], "ph": "i",
+                "s": "t", "pid": pid, "tid": tid,
+                "ts": ev["ts"] * 1e6,
+                "args": dict(ev["attrs"],
+                             trace_id=s["trace_id"],
+                             span_id=s["span_id"]),
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -46,13 +46,20 @@ class ServingRequest(object):
                                           marks TTFT)
         ("done", model_version)           completed; all tokens emitted
         ("error", code, message)          terminal failure
-    """
+
+    `span` (observability/tracing.py) is the request's serve span: the
+    servicer opens it at admission (parenting under the router's
+    dispatch span when the RPC carried trace context) and the
+    scheduler/engine annotate the lifecycle through `trace_event` —
+    both guard on span being None so direct/off-path construction
+    (tests, benches) costs nothing."""
 
     _ids = iter(range(1, 2 ** 62))
     _ids_lock = threading.Lock()
 
     def __init__(self, prompt, max_new_tokens, temperature=0.0, seed=0,
-                 deadline_ms=0, clock=time.monotonic):
+                 deadline_ms=0, clock=time.monotonic, trace_id="",
+                 parent_span_id=""):
         with ServingRequest._ids_lock:
             self.request_id = next(ServingRequest._ids)
         self.prompt = [int(t) for t in prompt]
@@ -66,11 +73,25 @@ class ServingRequest(object):
         )
         self.events = collections.deque()
         self._event_cv = threading.Condition()
+        # tracing context (empty = untraced caller; the servicer mints)
+        self.trace_id = trace_id or ""
+        self.parent_span_id = parent_span_id or ""
+        self.span = None
         # scheduler-side state
         self.generated = []
         self.first_token_at = None
         self.seated_at = None  # set when the scheduler seats a slot
         self.model_version = -1
+
+    # ---- tracing (no-ops until the servicer attaches a span)
+
+    def trace_event(self, name, **attrs):
+        if self.span is not None:
+            self.span.event(name, **attrs)
+
+    def finish_span(self, status="ok"):
+        if self.span is not None:
+            self.span.finish(status)
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline

@@ -261,6 +261,10 @@ class ContinuousBatchingEngine(object):
             )
             self._pool = self._write_slot(kv, slot)
         first = int(first)
+        # lifecycle annotation on the request's serve span (no-op for
+        # untraced requests): which prefill bucket this paid for
+        if hasattr(request, "trace_event"):
+            request.trace_event("prefill", bucket=p_pad, slot=slot)
         request.generated.append(first)
         request.model_version = self.model_version
         finished = request.max_new_tokens == 1
@@ -512,6 +516,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             if decoding:
                 self.kv.write_prompt(kv, slot, p)
         first = int(first)
+        if hasattr(request, "trace_event"):
+            request.trace_event("prefill", bucket=p_pad, slot=slot,
+                                paged=True)
         request.generated.append(first)
         request.model_version = self.model_version
         if not decoding:

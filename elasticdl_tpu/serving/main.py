@@ -108,6 +108,11 @@ def build_server(args):
 def main(argv=None):
     args = parse_serving_args(argv)
     server = build_server(args).start()
+    # name this process's span recorder after the bound port; spans
+    # export to $EDL_TRACE_DIR on stop (plus an atexit backstop)
+    from elasticdl_tpu.observability.tracing import configure
+
+    configure(service="replica:%d" % server.port)
     done = threading.Event()
 
     def _graceful(_signum, _frame):

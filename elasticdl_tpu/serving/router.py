@@ -71,6 +71,17 @@ Fault injection: the servicer wraps at the same choke point the master
 and replica servicers use (common/fault_injection.py) under the
 router-specific RPC names (`router_generate:drop:1`, ...), so chaos
 specs can target the router boundary without touching replicas.
+
+Observability (elasticdl_tpu/observability/): every routed request is
+one SPAN TREE — a `router_generate[_stream]` root opened here (or
+adopted from the client's trace context), one `dispatch` child per
+leg, so hedges and re-dispatches land as sibling spans and the
+replica's `serve` span parents under the leg that carried it. The
+router also records its end-to-end dispatch latency into the shared
+log-linear histogram (router_status e2e_p50/90/99_ms) and merges the
+replicas' TTFT/queue-wait histogram BUCKETS from their heartbeat
+status into fleet-wide percentiles — bucket addition, never
+percentile averaging.
 """
 
 import threading
@@ -92,6 +103,8 @@ from elasticdl_tpu.common.retry import (
     is_backpressure_rpc_error,
     is_transient_rpc_error,
 )
+from elasticdl_tpu.observability.histogram import LogLinearHistogram
+from elasticdl_tpu.observability.tracing import recorder
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.serving.admission import AdmissionError
 from elasticdl_tpu.serving.telemetry import RouterTelemetry
@@ -229,6 +242,8 @@ class Replica(object):
         self.active_slots = 0
         self.kv_blocks_free = 0
         self.queue_wait_ms = 0.0
+        self.ttft_hist = []
+        self.queue_wait_hist = []
         self.dispatched = 0
         self.failures = 0
         self.poll_failures = 0
@@ -288,6 +303,10 @@ class Replica(object):
         self.active_slots = status.active_slots
         self.kv_blocks_free = status.kv_blocks_free
         self.queue_wait_ms = status.queue_wait_ms
+        # raw histogram buckets (mergeable by addition): the router
+        # sums these across replicas for fleet-wide percentiles
+        self.ttft_hist = list(status.ttft_hist)
+        self.queue_wait_hist = list(status.queue_wait_hist)
 
 
 def _default_stub_factory(address):
@@ -436,13 +455,31 @@ class Router(object):
 
     # --------------------------------------------------------- dispatch
 
-    def _sub_request(self, request, remaining_ms):
+    def _sub_request(self, request, remaining_ms, trace_id="",
+                     parent_span_id=""):
         return pb.GenerateRequest(
             prompt=list(request.prompt),
             max_new_tokens=request.max_new_tokens,
             temperature=request.temperature,
             seed=request.seed,
             deadline_ms=remaining_ms,
+            # context propagation: the replica parents its serve span
+            # under THIS dispatch leg's span, so hedge legs and
+            # re-dispatches land as siblings in one request tree
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
+        )
+
+    def _root_span(self, name, request):
+        """The request's root span on the router: adopts the client's
+        trace when the inbound RPC carried one, mints otherwise (the
+        router IS admission for routed requests)."""
+        return recorder().start_span(
+            name,
+            trace_id=getattr(request, "trace_id", "") or None,
+            parent_span_id=getattr(request, "parent_span_id", ""),
+            prompt_len=len(request.prompt),
+            max_new_tokens=request.max_new_tokens,
         )
 
     def _budget(self, request, t0):
@@ -468,7 +505,7 @@ class Router(object):
     def _on_success(self, rep):
         rep.breaker.record_success()
 
-    def _on_failure(self, rep, exc):
+    def _on_failure(self, rep, exc, span=None):
         """Breaker accounting for one failed dispatch. Every outcome
         must settle the breaker — in particular a HALF_OPEN probe slot
         is released on EVERY path, or the replica is silently evicted
@@ -478,6 +515,8 @@ class Router(object):
         if is_transient_rpc_error(exc):
             if rep.breaker.record_failure(now):
                 self.telemetry.count("breaker_trips")
+                if span is not None:
+                    span.event("breaker_trip", replica=rep.address)
                 logger.warning(
                     "router breaker OPEN for %s after %d consecutive "
                     "transient failures (%r)",
@@ -497,23 +536,46 @@ class Router(object):
             # held probe slot so HALF_OPEN can probe again
             rep.breaker.release_probe()
 
-    def _call_unary(self, rep, sub, timeout):
+    def _call_unary(self, rep, request, remaining_ms, timeout, root,
+                    hedge=False, attempt=0):
+        """One dispatch leg, traced: its own `dispatch` span under the
+        request's root — a hedge or a re-dispatch adds a SIBLING span,
+        which is exactly the causal shape the trace must keep."""
+        span = recorder().start_span(
+            "dispatch", trace_id=root.trace_id,
+            parent_span_id=root.span_id, replica=rep.address,
+            hedge=hedge, attempt=attempt,
+        )
+        sub = self._sub_request(request, remaining_ms,
+                                trace_id=root.trace_id,
+                                parent_span_id=span.span_id)
         rep.begin_dispatch()
         try:
             resp = rep.stub.generate(sub, timeout=timeout)
         except Exception as e:
-            self._on_failure(rep, e)
+            self._on_failure(rep, e, span=span)
+            span.set(error=_code_name(e))
+            span.finish("error")
             raise
         finally:
             rep.end_dispatch()
         self._on_success(rep)
+        span.finish("ok")
         return resp
 
-    def _raise_terminal(self, exc):
+    def _raise_terminal(self, exc, root=None):
         self.telemetry.count("errors")
         if isinstance(exc, RouterError):
+            if root is not None:
+                root.finish(exc.code)
             raise exc  # already carries its status name
+        if root is not None:
+            root.finish(_code_name(exc))
         raise RouterError(_code_name(exc), str(exc))
+
+    def _finish_e2e(self, root, t0, status="ok"):
+        self.telemetry.record_e2e((self._clock() - t0) * 1000.0)
+        root.finish(status)
 
     def dispatch_generate(self, request):
         """Unary generate with re-dispatch + optional hedging. The
@@ -521,12 +583,16 @@ class Router(object):
         finishes), so re-dispatch is safe at ANY point of a failed
         attempt — token parity guarantees replica-independence."""
         self.telemetry.count("routed")
+        root = self._root_span("router_generate", request)
         t0 = self._clock()
         window_ends = t0 + self.config.redispatch_window_secs
         attempt = 0
         failed = set()  # addresses that failed THIS request
         while True:
-            remaining_ms, timeout = self._budget(request, t0)
+            try:
+                remaining_ms, timeout = self._budget(request, t0)
+            except RouterError as e:
+                self._raise_terminal(e, root=root)
             now = self._clock()
             rep = self._acquire_replica(now, exclude=failed)
             if rep is None and failed:
@@ -536,15 +602,19 @@ class Router(object):
                 rep = self._acquire_replica(now)
             if rep is None:
                 self.telemetry.count("shed")
+                root.event("shed")
+                root.finish("RESOURCE_EXHAUSTED")
                 raise RouterError(
                     "RESOURCE_EXHAUSTED",
                     "no healthy replicas in rotation (shed)",
                 )
-            sub = self._sub_request(request, remaining_ms)
             try:
-                resp = self._dispatch_maybe_hedged(rep, sub, timeout,
-                                                   now, failed)
+                resp = self._dispatch_maybe_hedged(
+                    rep, request, remaining_ms, timeout, failed,
+                    root, attempt,
+                )
                 self.telemetry.count("completed")
+                self._finish_e2e(root, t0)
                 return resp
             except Exception as e:  # noqa: BLE001 - classified below
                 failed.add(rep.address)
@@ -555,38 +625,49 @@ class Router(object):
                     and _code_name(e, "") == "DEADLINE_EXCEEDED"
                 )
                 if not retryable or spent_deadline:
-                    self._raise_terminal(e)
+                    self._raise_terminal(e, root=root)
                 if self._clock() >= window_ends:
                     logger.error(
                         "router giving up on request after %d "
                         "re-dispatches over %.0fs window",
                         attempt, self.config.redispatch_window_secs,
                     )
-                    self._raise_terminal(e)
+                    self._raise_terminal(e, root=root)
                 self.telemetry.count("redispatched")
+                root.event("redispatched", attempt=attempt,
+                           failed_replica=rep.address,
+                           error=_code_name(e))
                 delay = min(self._policy.backoff(attempt),
                             max(0.0, window_ends - self._clock()))
                 self._sleep(delay)
                 attempt += 1
 
-    def _dispatch_maybe_hedged(self, primary, sub, timeout, now, failed):
+    def _dispatch_maybe_hedged(self, primary, request, remaining_ms,
+                               timeout, failed, root, attempt):
         """One attempt. With hedging enabled and a second replica in
         rotation, a primary that hasn't answered inside hedge_delay is
         duplicated; first success wins (duplicates are harmless — both
         would return the same tokens). Raises the primary's error when
-        every leg failed."""
+        every leg failed. Each leg runs as its own `dispatch` span
+        under `root` — hedge legs are SIBLINGS, distinguishable by the
+        `hedge` attr."""
         if self.config.hedge_delay_secs <= 0:
-            return self._call_unary(primary, sub, timeout)
+            return self._call_unary(primary, request, remaining_ms,
+                                    timeout, root, attempt=attempt)
         results = _queue.Queue()
 
-        def leg(rep):
+        def leg(rep, hedge):
             try:
-                results.put(("ok", rep, self._call_unary(rep, sub,
-                                                         timeout)))
+                results.put(("ok", rep, self._call_unary(
+                    rep, request, remaining_ms, timeout, root,
+                    hedge=hedge, attempt=attempt,
+                )))
             except Exception as e:  # noqa: BLE001 - the datum
                 results.put(("err", rep, e))
 
-        threading.Thread(target=leg, args=(primary,), daemon=True).start()
+        threading.Thread(
+            target=leg, args=(primary, False), daemon=True
+        ).start()
         outstanding, hedged = 1, False
         primary_err = None
         while outstanding:
@@ -607,8 +688,9 @@ class Router(object):
                 )
                 if hedge_rep is not None:
                     self.telemetry.count("hedges")
+                    root.event("hedged", replica=hedge_rep.address)
                     threading.Thread(
-                        target=leg, args=(hedge_rep,), daemon=True
+                        target=leg, args=(hedge_rep, True), daemon=True
                     ).start()
                     outstanding += 1
                 continue
@@ -616,6 +698,7 @@ class Router(object):
             if kind == "ok":
                 if rep is not primary:
                     self.telemetry.count("hedge_wins")
+                    root.event("hedge_win", replica=rep.address)
                 return payload
             # either leg failing marks its replica failed for THIS
             # request, so a later re-dispatch skips a hedge replica
@@ -632,6 +715,7 @@ class Router(object):
         the stream EXPLICITLY (UNAVAILABLE + token count) instead —
         never silently truncated, never hung."""
         self.telemetry.count("routed")
+        root = self._root_span("router_generate_stream", request)
         t0 = self._clock()
         window_ends = t0 + self.config.redispatch_window_secs
         attempt = 0
@@ -641,7 +725,10 @@ class Router(object):
             nonlocal attempt, failed
             delivered = 0
             while True:
-                remaining_ms, timeout = self._budget(request, t0)
+                try:
+                    remaining_ms, timeout = self._budget(request, t0)
+                except RouterError as e:
+                    self._raise_terminal(e, root=root)
                 now = self._clock()
                 rep = self._acquire_replica(now, exclude=failed)
                 if rep is None and failed:
@@ -649,27 +736,44 @@ class Router(object):
                     rep = self._acquire_replica(now)
                 if rep is None:
                     self.telemetry.count("shed")
+                    root.event("shed")
+                    root.finish("RESOURCE_EXHAUSTED")
                     raise RouterError(
                         "RESOURCE_EXHAUSTED",
                         "no healthy replicas in rotation (shed)",
                     )
+                span = recorder().start_span(
+                    "dispatch", trace_id=root.trace_id,
+                    parent_span_id=root.span_id, replica=rep.address,
+                    attempt=attempt, stream=True,
+                )
                 rep.begin_dispatch()
                 try:
                     stream = rep.stub.generate_stream(
-                        self._sub_request(request, remaining_ms),
+                        self._sub_request(
+                            request, remaining_ms,
+                            trace_id=root.trace_id,
+                            parent_span_id=span.span_id,
+                        ),
                         timeout=timeout,
                     )
                     for chunk in stream:
                         delivered += len(chunk.tokens)
                         yield chunk
                     self._on_success(rep)
+                    span.finish("ok")
                     self.telemetry.count("completed")
+                    self._finish_e2e(root, t0)
                     return
                 except Exception as e:  # noqa: BLE001 - classified
-                    self._on_failure(rep, e)
+                    self._on_failure(rep, e, span=span)
+                    span.set(error=_code_name(e),
+                             delivered=delivered)
+                    span.finish("error")
                     failed.add(rep.address)
                     if delivered:
                         self.telemetry.count("errors")
+                        root.finish("UNAVAILABLE")
                         raise RouterError(
                             "UNAVAILABLE",
                             "replica %s lost mid-stream after %d "
@@ -683,10 +787,13 @@ class Router(object):
                         and _code_name(e, "") == "DEADLINE_EXCEEDED"
                     )
                     if not retryable or spent_deadline:
-                        self._raise_terminal(e)
+                        self._raise_terminal(e, root=root)
                     if self._clock() >= window_ends:
-                        self._raise_terminal(e)
+                        self._raise_terminal(e, root=root)
                     self.telemetry.count("redispatched")
+                    root.event("redispatched", attempt=attempt,
+                               failed_replica=rep.address,
+                               error=_code_name(e))
                     delay = min(self._policy.backoff(attempt),
                                 max(0.0, window_ends - self._clock()))
                     self._sleep(delay)
@@ -703,6 +810,20 @@ class Router(object):
     def status_response(self):
         now = self._clock()
         snap = self.telemetry.snapshot()
+        # fleet-wide latency: the replicas' histogram BUCKETS merge by
+        # addition (percentiles of the merged counts — never averages
+        # of per-replica percentiles, which would be meaningless)
+        fleet_ttft = LogLinearHistogram()
+        fleet_wait = LogLinearHistogram()
+        for rep in self.replicas():
+            if rep.ttft_hist:
+                fleet_ttft.merge(
+                    LogLinearHistogram.from_counts(rep.ttft_hist)
+                )
+            if rep.queue_wait_hist:
+                fleet_wait.merge(
+                    LogLinearHistogram.from_counts(rep.queue_wait_hist)
+                )
         reps = []
         for rep in sorted(self.replicas(), key=lambda r: r.address):
             reps.append(pb.ReplicaStatus(
@@ -733,6 +854,15 @@ class Router(object):
             shed=snap["shed"],
             breaker_trips=snap["breaker_trips"],
             uptime_secs=snap["uptime_secs"],
+            e2e_p50_ms=snap["e2e_p50_ms"],
+            e2e_p90_ms=snap["e2e_p90_ms"],
+            e2e_p99_ms=snap["e2e_p99_ms"],
+            ttft_p50_ms=fleet_ttft.percentile(50),
+            ttft_p90_ms=fleet_ttft.percentile(90),
+            ttft_p99_ms=fleet_ttft.percentile(99),
+            queue_wait_p50_ms=fleet_wait.percentile(50),
+            queue_wait_p90_ms=fleet_wait.percentile(90),
+            queue_wait_p99_ms=fleet_wait.percentile(99),
         )
 
     # -------------------------------------------------------- lifecycle
@@ -783,6 +913,9 @@ class Router(object):
             self._server.stop(grace).wait()
             self._server = None
         self.telemetry.close()
+        # export the span ring when EDL_TRACE_DIR is set (no-op
+        # otherwise); the dump tool merges per-process files
+        recorder().flush()
 
 
 class RouterServicer(object):

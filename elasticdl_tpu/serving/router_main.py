@@ -72,6 +72,11 @@ def build_router(args):
 def main(argv=None):
     args = parse_router_args(argv)
     router = build_router(args).start()
+    # name this process's span recorder; spans export to
+    # $EDL_TRACE_DIR on stop (plus an atexit backstop)
+    from elasticdl_tpu.observability.tracing import configure
+
+    configure(service="router:%d" % router.port)
     done = threading.Event()
 
     def _graceful(_signum, _frame):

@@ -33,6 +33,7 @@ from elasticdl_tpu.common.fault_injection import (
     maybe_wrap_servicer,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.observability.tracing import recorder
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.serving.admission import (
     AdmissionError,
@@ -147,6 +148,8 @@ class _Scheduler(threading.Thread):
         now = self._clock()
         for req in self.engine.evict_expired(now):
             self.telemetry.count("expired")
+            req.trace_event("expired", where="mid-decode")
+            req.finish_span("DEADLINE_EXCEEDED")
             req.push(("error", "DEADLINE_EXCEEDED",
                       "deadline expired mid-decode"))
         self._fill_slots()
@@ -157,8 +160,7 @@ class _Scheduler(threading.Thread):
             for _slot, req, token, finished in results:
                 req.push(("tokens", [token], req.model_version))
                 if finished:
-                    self.telemetry.count("completed")
-                    req.push(("done", req.model_version))
+                    self._complete(req)
             kv = self.engine.kv_stats()
             self.telemetry.record_step(
                 len(self.queue), len(results), dt, len(results),
@@ -167,6 +169,18 @@ class _Scheduler(threading.Thread):
             )
         else:
             self.queue.wait_for_work(self.idle_wait_secs)
+
+    def _complete(self, req):
+        """Terminal success bookkeeping: completion counter, e2e
+        histogram, span seal, done event — one definition for the
+        decode loop, the prefill-only fast path and the drain loop."""
+        self.telemetry.count("completed")
+        self.telemetry.record_e2e(
+            (self._clock() - req.submitted_at) * 1000.0
+        )
+        req.trace_event("completed", tokens=len(req.generated))
+        req.finish_span("ok")
+        req.push(("done", req.model_version))
 
     def _fill_slots(self):
         while self.engine.free_slots():
@@ -177,21 +191,27 @@ class _Scheduler(threading.Thread):
             req, expired = self.queue.pop_ready(fit=self.engine.can_seat)
             for e in expired:
                 self.telemetry.count("expired")
+                e.trace_event("expired", where="queued")
+                e.finish_span("DEADLINE_EXCEEDED")
                 e.push(("error", "DEADLINE_EXCEEDED",
                         "deadline expired while queued"))
             if req is None:
                 break
             req.seated_at = self._clock()
-            self.telemetry.record_queue_wait(req.queue_wait_secs())
+            wait_ms = self.telemetry.record_queue_wait(
+                req.queue_wait_secs()
+            )
+            req.trace_event("seated", queue_wait_ms=round(wait_ms, 3))
             slot, first, finished = self.engine.insert(req)
-            self.telemetry.record_ttft(req)
+            ttft_ms = self.telemetry.record_ttft(req)
+            req.trace_event("first_token", slot=slot,
+                            ttft_ms=round(ttft_ms, 3))
             # the prefill produced this token; step() only counts the
             # decode-loop tokens
             self.telemetry.count("tokens_generated")
             req.push(("tokens", [first], req.model_version))
             if finished:
-                self.telemetry.count("completed")
-                req.push(("done", req.model_version))
+                self._complete(req)
 
     def _shutdown(self):
         """Graceful stop: reject the queued backlog immediately; with
@@ -200,6 +220,8 @@ class _Scheduler(threading.Thread):
         terminates with done or a clean error — never silence."""
         for req in self.queue.close():
             self.telemetry.count("rejected")
+            req.trace_event("rejected", why="shutdown")
+            req.finish_span("RESOURCE_EXHAUSTED")
             req.push(("error", "RESOURCE_EXHAUSTED",
                       "server shutting down"))
         if not self._drain:
@@ -209,6 +231,8 @@ class _Scheduler(threading.Thread):
             now = self._clock()
             for req in self.engine.evict_expired(now):
                 self.telemetry.count("expired")
+                req.trace_event("expired", where="mid-decode")
+                req.finish_span("DEADLINE_EXCEEDED")
                 req.push(("error", "DEADLINE_EXCEEDED",
                           "deadline expired mid-decode"))
             if not self.engine.active_count():
@@ -216,13 +240,14 @@ class _Scheduler(threading.Thread):
             for _slot, req, token, finished in self.engine.step():
                 req.push(("tokens", [token], req.model_version))
                 if finished:
-                    self.telemetry.count("completed")
-                    req.push(("done", req.model_version))
+                    self._complete(req)
 
     def _abort_all(self, code, message):
         for req in self.engine.active_requests():
+            req.finish_span(code)
             req.push(("error", code, message))
         for req in self.queue.close():
+            req.finish_span(code)
             req.push(("error", code, message))
 
     def stop(self, drain=True):
@@ -299,6 +324,16 @@ class ServingServicer(object):
             kv_bytes_per_token=snap["kv_bytes_per_token"],
             draining=self._draining(),
             queue_wait_ms=snap["queue_wait_ms"],
+            # percentiles + raw mergeable buckets from the shared
+            # log-linear histograms (observability/histogram.py)
+            ttft_p50_ms=snap["ttft_p50_ms"],
+            ttft_p90_ms=snap["ttft_p90_ms"],
+            ttft_p99_ms=snap["ttft_p99_ms"],
+            queue_wait_p50_ms=snap["queue_wait_p50_ms"],
+            queue_wait_p90_ms=snap["queue_wait_p90_ms"],
+            queue_wait_p99_ms=snap["queue_wait_p99_ms"],
+            ttft_hist=snap["ttft_hist"],
+            queue_wait_hist=snap["queue_wait_hist"],
         )
 
     # --------------------------------------------------------- internals
@@ -310,14 +345,35 @@ class ServingServicer(object):
             temperature=proto_req.temperature,
             seed=proto_req.seed,
             deadline_ms=proto_req.deadline_ms,
+            trace_id=getattr(proto_req, "trace_id", ""),
+            parent_span_id=getattr(proto_req, "parent_span_id", ""),
         )
+        # the serve span: parented under the caller's dispatch span
+        # when the RPC carried trace context (router/traced client),
+        # a fresh root trace otherwise — either way THIS is where a
+        # request's causal record on the replica begins
+        req.span = recorder().start_span(
+            "serve",
+            trace_id=req.trace_id or None,
+            parent_span_id=req.parent_span_id,
+            request_id=req.request_id,
+            prompt_len=len(req.prompt),
+            max_new_tokens=req.max_new_tokens,
+        )
+        req.trace_id = req.span.trace_id
         try:
             self._queue.submit(req)
         except AdmissionError as e:
             self._telemetry.count(
                 "expired" if e.code == "DEADLINE_EXCEEDED" else "rejected"
             )
+            req.trace_event(
+                "expired" if e.code == "DEADLINE_EXCEEDED"
+                else "rejected", why=str(e),
+            )
+            req.finish_span(e.code)
             self._fail(context, e.code, str(e))
+        req.trace_event("queued", queue_depth=len(self._queue))
         self._telemetry.count("admitted")
         return req
 
@@ -449,3 +505,6 @@ class GenerationServer(object):
             self._server.stop(grace).wait()
             self._server = None
         self.telemetry.close()
+        # export this process's span ring when EDL_TRACE_DIR is set
+        # (no-op otherwise) — the dump tool merges per-process files
+        recorder().flush()

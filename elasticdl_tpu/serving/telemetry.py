@@ -14,11 +14,21 @@ whole system. Gauges, stepped by decode-step index:
     serving/kv_blocks_free     paged pool's free blocks at the step
     serving/queue_wait_ms      EWMA of time-queued-before-seating (the
                                router's load signal; ServerStatus field)
+    serving/ttft_p99_ms        histogram percentiles, one scalar per
+    serving/e2e_p99_ms         flush window (see below)
     serving/admitted_total     monotone counters, one scalar per flush
     serving/rejected_total
     serving/expired_total
     serving/completed_total
     serving/reloads_total
+
+Latency distributions live in fixed-bucket log-linear histograms
+(observability/histogram.py) — TTFT, queue wait, step time and
+end-to-end latency — NOT in point-gauges: the status RPCs report
+p50/p90/p99 from them, the router merges the raw bucket counts across
+replicas, and bench_serving.py computes its percentiles with the same
+histogram code, so bench numbers and live numbers are definitionally
+identical.
 
 The snapshot derives the memory-efficiency headline
 `kv_bytes_per_token` = sum-over-steps(kv_bytes_in_use) /
@@ -29,7 +39,11 @@ difference shows up as one number.
 
 Counters also back the ServerStatus RPC via snapshot() — the RPC must
 work with telemetry disabled (no log_dir), so counters live here and
-the event writer is optional.
+the event writer is optional. The counter NAME SET is closed
+(`COUNTERS`): count() raises on anything undeclared, because a typo'd
+name would silently fork a fresh counter and under-report the real
+one forever (edl-lint EDL401 flags literal call sites statically; the
+raise catches dynamic names).
 
 Thread-safety: the scheduler thread writes step gauges; gRPC threads
 bump admission counters and read snapshots — everything under one lock
@@ -40,9 +54,16 @@ import threading
 import time
 
 from elasticdl_tpu.common.tb_events import EventFileWriter
+from elasticdl_tpu.observability.histogram import LogLinearHistogram
 
 
 class ServingTelemetry(object):
+    #: the closed counter set — count() REJECTS anything else
+    COUNTERS = ("admitted", "rejected", "expired", "completed",
+                "tokens_generated", "reloads")
+    #: latency histograms (ms), all on the shared bucket scheme
+    HISTOGRAMS = ("ttft_ms", "queue_wait_ms", "step_ms", "e2e_ms")
+
     def __init__(self, log_dir=None, flush_every=50, clock=time.monotonic):
         self._log_dir = log_dir
         self._flush_every = max(1, int(flush_every))
@@ -50,14 +71,9 @@ class ServingTelemetry(object):
         self._lock = threading.Lock()
         self._writer = None
         self._started = clock()
-        self.counters = {
-            "admitted": 0,
-            "rejected": 0,
-            "expired": 0,
-            "completed": 0,
-            "tokens_generated": 0,
-            "reloads": 0,
-        }
+        self.counters = {name: 0 for name in self.COUNTERS}
+        self.hists = {name: LogLinearHistogram()
+                      for name in self.HISTOGRAMS}
         self.max_active_slots = 0
         self.kv_bytes_in_use_peak = 0
         self._kv_byte_steps = 0  # sum of kv_bytes_in_use over steps
@@ -66,7 +82,8 @@ class ServingTelemetry(object):
         self._step = 0
         self._window_tokens = 0
         self._window_t0 = clock()
-        self._last_gauges = {}
+        self._counters_flushed_at = 0  # step of the last counter flush
+        self._dirty = False  # anything recorded since the last flush
 
     def _ensure_writer(self):
         if self._writer is None and self._log_dir:
@@ -84,14 +101,32 @@ class ServingTelemetry(object):
 
     def count(self, name, n=1):
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + n
+            if name not in self.counters:
+                raise ValueError(
+                    "unknown serving counter %r (declared: %s) — a "
+                    "typo here would silently fork a new counter"
+                    % (name, ", ".join(self.COUNTERS))
+                )
+            self.counters[name] += n
+            self._dirty = True
 
     def record_ttft(self, request):
         """Time-to-first-token for one request, at its first token."""
         ttft_ms = (self._clock() - request.submitted_at) * 1000.0
         with self._lock:
+            self._dirty = True
+            self.hists["ttft_ms"].record(ttft_ms)
             self._scalar("serving/ttft_ms", ttft_ms, self._step)
         return ttft_ms
+
+    def record_e2e(self, latency_ms):
+        """End-to-end latency of one COMPLETED request (admission ->
+        final token). Expired/rejected requests don't land here — the
+        histogram answers "how long does a successful request take",
+        the counters answer how many weren't."""
+        with self._lock:
+            self._dirty = True
+            self.hists["e2e_ms"].record(latency_ms)
 
     # EWMA, not a running mean: the router reads this as a LOAD signal,
     # so it must track the current regime, not the lifetime average
@@ -100,7 +135,8 @@ class ServingTelemetry(object):
     def record_queue_wait(self, wait_secs):
         """Time one request spent queued before seating. Feeds the
         queue_wait_ms EWMA the router folds into least-loaded routing
-        (ServerStatus.queue_wait_ms)."""
+        (ServerStatus.queue_wait_ms) and the queue-wait histogram
+        behind the percentile fields."""
         wait_ms = wait_secs * 1000.0
         with self._lock:
             if self._queue_waits_seen == 0:
@@ -111,6 +147,7 @@ class ServingTelemetry(object):
                     a * wait_ms + (1.0 - a) * self._queue_wait_ewma_ms
                 )
             self._queue_waits_seen += 1
+            self.hists["queue_wait_ms"].record(wait_ms)
             self._scalar("serving/queue_wait_ms",
                          self._queue_wait_ewma_ms, self._step)
         return wait_ms
@@ -121,12 +158,14 @@ class ServingTelemetry(object):
         """Per-decode-step gauges; counters flush every flush_every
         steps so the event file stays O(steps / flush_every)."""
         with self._lock:
+            self._dirty = True
             self._step += 1
             self.max_active_slots = max(
                 self.max_active_slots, active_slots
             )
             self.counters["tokens_generated"] += tokens_committed
             self._window_tokens += tokens_committed
+            self.hists["step_ms"].record(step_secs * 1000.0)
             if kv_bytes_in_use is not None:
                 self.kv_bytes_in_use_peak = max(
                     self.kv_bytes_in_use_peak, kv_bytes_in_use
@@ -143,18 +182,32 @@ class ServingTelemetry(object):
                 "serving/step_ms", step_secs * 1000.0, self._step
             )
             if self._step % self._flush_every == 0:
-                now = self._clock()
-                window = max(now - self._window_t0, 1e-9)
+                self._flush_window_locked()
+
+    def _flush_window_locked(self):
+        """Close the tokens/sec window and write the counter totals +
+        headline percentiles. Caller holds the lock."""
+        now = self._clock()
+        window = max(now - self._window_t0, 1e-9)
+        self._scalar(
+            "serving/tokens_per_sec",
+            self._window_tokens / window, self._step,
+        )
+        self._window_tokens = 0
+        self._window_t0 = now
+        for name, value in self.counters.items():
+            self._scalar(
+                "serving/%s_total" % name, value, self._step
+            )
+        for hist_name in ("ttft_ms", "e2e_ms"):
+            hist = self.hists[hist_name]
+            if hist.count:
                 self._scalar(
-                    "serving/tokens_per_sec",
-                    self._window_tokens / window, self._step,
+                    "serving/%s_p99" % hist_name.replace("_ms", ""),
+                    hist.percentile(99), self._step,
                 )
-                self._window_tokens = 0
-                self._window_t0 = now
-                for name, value in self.counters.items():
-                    self._scalar(
-                        "serving/%s_total" % name, value, self._step
-                    )
+        self._counters_flushed_at = self._step
+        self._dirty = False
 
     # ---------------------------------------------------------- snapshot
 
@@ -170,10 +223,27 @@ class ServingTelemetry(object):
                 / max(1, self.counters["tokens_generated"])
             )
             snap["queue_wait_ms"] = self._queue_wait_ewma_ms
+            for prefix in ("ttft", "queue_wait", "e2e", "step"):
+                hist = self.hists[prefix + "_ms"]
+                for q in (50, 90, 99):
+                    snap["%s_p%d_ms" % (prefix, q)] = hist.percentile(q)
+            snap["ttft_hist"] = self.hists["ttft_ms"].to_counts()
+            snap["queue_wait_hist"] = (
+                self.hists["queue_wait_ms"].to_counts()
+            )
             return snap
 
     def close(self):
+        """Flush the tail, then close the writer. Without this a
+        server stopped mid-window under-reported in TensorBoard: the
+        partial tokens/sec window and every counter bump since the
+        last flush_every boundary never reached the event file."""
         with self._lock:
+            if self._log_dir and self._dirty:
+                # _flush_window_locked creates the writer on demand, so
+                # even a server that never reached a flush boundary
+                # leaves its final counters on disk
+                self._flush_window_locked()
             if self._writer is not None:
                 self._writer.close()
                 self._writer = None
@@ -196,7 +266,14 @@ class RouterTelemetry(object):
         router/breaker_trips_total  closed->open transitions)
 
     Counters back the router_status RPC via snapshot() — like the
-    replica telemetry, the RPC must work with the writer disabled."""
+    replica telemetry, the RPC must work with the writer disabled.
+    The counter name set is closed (count() raises on unknowns;
+    edl-lint EDL401 is the static twin). The router's end-to-end
+    dispatch latency (accept -> terminal outcome, re-dispatches and
+    hedges included) rides the shared log-linear histogram behind the
+    e2e_p* router_status fields, and snapshot() carries the
+    last-observed rotation gauges so operators aren't left scraping
+    the event file for fleet size."""
 
     COUNTERS = ("routed", "completed", "redispatched", "hedges",
                 "hedge_wins", "shed", "breaker_trips", "errors")
@@ -210,6 +287,11 @@ class RouterTelemetry(object):
         self._started = clock()
         self._poll = 0
         self.counters = {name: 0 for name in self.COUNTERS}
+        self.hists = {"e2e_ms": LogLinearHistogram()}
+        # last-observed rotation gauges (record_poll), surfaced by
+        # snapshot()/router_status
+        self._healthy_replicas = 0
+        self._replicas = 0
 
     def _ensure_writer(self):
         if self._writer is None and self._log_dir:
@@ -225,13 +307,27 @@ class RouterTelemetry(object):
 
     def count(self, name, n=1):
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + n
+            if name not in self.counters:
+                raise ValueError(
+                    "unknown router counter %r (declared: %s)"
+                    % (name, ", ".join(self.COUNTERS))
+                )
+            self.counters[name] += n
+            self._dirty = True
+
+    def record_e2e(self, latency_ms):
+        """Router-observed end-to-end latency of one dispatch that
+        reached a terminal outcome."""
+        with self._lock:
+            self.hists["e2e_ms"].record(latency_ms)
 
     def record_poll(self, healthy, replicas):
         """One heartbeat sweep: rotation-size gauges now, counters
         every flush_every polls."""
         with self._lock:
             self._poll += 1
+            self._healthy_replicas = healthy
+            self._replicas = replicas
             self._scalar("router/healthy_replicas", healthy, self._poll)
             self._scalar("router/replicas", replicas, self._poll)
             if self._poll % self._flush_every == 0:
@@ -245,10 +341,20 @@ class RouterTelemetry(object):
             snap = dict(self.counters)
             snap["uptime_secs"] = self._clock() - self._started
             snap["polls"] = self._poll
+            snap["healthy_replicas"] = self._healthy_replicas
+            snap["replicas"] = self._replicas
+            for q in (50, 90, 99):
+                snap["e2e_p%d_ms" % q] = (
+                    self.hists["e2e_ms"].percentile(q)
+                )
             return snap
 
     def close(self):
         with self._lock:
             if self._writer is not None:
+                for name, value in self.counters.items():
+                    self._scalar(
+                        "router/%s_total" % name, value, self._poll
+                    )
                 self._writer.close()
                 self._writer = None

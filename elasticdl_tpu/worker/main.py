@@ -77,6 +77,11 @@ def main(argv=None):
     logger.info(
         "Worker %d starting, master=%s", args.worker_id, args.master_addr
     )
+    # name this process's span recorder; task spans export to
+    # $EDL_TRACE_DIR on exit (atexit) when tracing is armed
+    from elasticdl_tpu.observability.tracing import configure
+
+    configure(service="worker:%d" % args.worker_id)
     worker = build_worker(args)
     worker.run()
     return 0

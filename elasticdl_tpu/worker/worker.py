@@ -128,6 +128,11 @@ class Worker(object):
         self.job_complete = False
         self.rpc_retry_count = 0
         self.reconnect_count = 0
+        # training-plane tracing: task_id -> the worker's `worker_task`
+        # span (fetch -> report), parented under the master's
+        # task_dispatch span via the Task proto's trace fields. The
+        # worker's task loop is single-threaded; no lock needed.
+        self._task_spans = {}
         self.losses = []
         # The reference's PS owns checkpointing (ps/servicer.py:255-270);
         # with the PS gone the worker that owns the jit state does, on the
@@ -255,6 +260,20 @@ class Worker(object):
             if not self.job_complete:
                 logger.info("Master signaled JOB_COMPLETE")
             self.job_complete = True
+        if task.task_id and task.trace_id:
+            # open this task's span under the master's dispatch span;
+            # report_task_result seals it, so the span's duration IS
+            # the fetch->report task execution time
+            from elasticdl_tpu.observability.tracing import recorder
+
+            span = recorder().start_span(
+                "worker_task", trace_id=task.trace_id,
+                parent_span_id=task.span_id, task_id=task.task_id,
+                worker_id=self.worker_id,
+            )
+            span.event("fetched", shard=task.shard_name,
+                       start=task.start, end=task.end)
+            self._task_spans[task.task_id] = span
         return task
 
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
@@ -277,9 +296,17 @@ class Worker(object):
             req.exec_counters["fault/rpc_retries"] = self.rpc_retry_count
         if self.reconnect_count:
             req.exec_counters["fault/reconnects"] = self.reconnect_count
-        return self._call_master(
-            "report_task_result", req, default_after_complete=pb.Empty()
-        )
+        span = self._task_spans.pop(task_id, None)
+        if span is not None:
+            span.event("reported", ok=not err_msg)
+        try:
+            return self._call_master(
+                "report_task_result", req,
+                default_after_complete=pb.Empty(),
+            )
+        finally:
+            if span is not None:
+                span.finish("ok" if not err_msg else "error")
 
     def report_version(self, version):
         self._call_master(

@@ -13,6 +13,11 @@ emits ONE JSON line:
      "expired": ..., "kv": {...}, ...}
 
 * TTFT is measured at the FIRST streamed chunk (prefill + queueing);
+  all percentiles run through the shared log-linear histogram code
+  (elasticdl_tpu/observability/histogram.py) — the same definition
+  the live ServerStatus/router_status percentile fields report, whose
+  server-side view of the run is echoed under "server_ttft_ms" /
+  "server_queue_wait_ms";
 * tokens_per_sec counts only tokens of COMPLETED requests over the
   measurement wall; goodput_rps is completed requests per second —
   rejected (backpressure) and expired (deadline) requests score zero,
@@ -94,12 +99,11 @@ def _span(text):
     return lo, hi
 
 
-def percentile(values, q):
-    if not values:
-        return None
-    vs = sorted(values)
-    idx = min(len(vs) - 1, int(round(q / 100.0 * (len(vs) - 1))))
-    return vs[idx]
+# percentiles go through the SAME log-linear histogram code the live
+# telemetry and the status RPCs use (observability/histogram.py), so a
+# bench p99 and a ServerStatus p99 are definitionally the same number
+# — not a sorted-list math that drifts from the serving-side buckets
+from elasticdl_tpu.observability.histogram import percentiles  # noqa: E402
 
 
 def build_rig(args):
@@ -240,11 +244,20 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
         ),
         "goodput_rps": round(len(ok) / wall, 3) if wall else None,
         "tokens_per_sec": round(tokens_ok / wall, 3) if wall else None,
-        "ttft_ms": {
-            "p50": percentile(ttfts, 50), "p99": percentile(ttfts, 99),
+        "ttft_ms": percentiles(ttfts, (50, 90, 99)),
+        "latency_ms": percentiles(lats, (50, 90, 99)),
+        # the server's own histogram view of the same run (ServerStatus
+        # percentile fields) — same bucket scheme as the client-side
+        # numbers above
+        "server_ttft_ms": {
+            "p50": round(status.ttft_p50_ms, 3),
+            "p90": round(status.ttft_p90_ms, 3),
+            "p99": round(status.ttft_p99_ms, 3),
         },
-        "latency_ms": {
-            "p50": percentile(lats, 50), "p99": percentile(lats, 99),
+        "server_queue_wait_ms": {
+            "p50": round(status.queue_wait_p50_ms, 3),
+            "p90": round(status.queue_wait_p90_ms, 3),
+            "p99": round(status.queue_wait_p99_ms, 3),
         },
         "wall_secs": round(wall, 3),
         "max_active_slots": status.max_active_slots,

@@ -48,6 +48,13 @@ SERVING_MESSAGES = {
         ("seed", 4, T.TYPE_INT32, _OPT),
         # relative deadline budget; 0 = no deadline
         ("deadline_ms", 5, T.TYPE_INT64, _OPT),
+        # distributed-tracing context (observability/tracing.py): the
+        # sender's trace and span ids — a replica parents its serve
+        # span under the router's dispatch span, so one request is ONE
+        # span tree across processes, hedges and re-dispatches.
+        # Empty = untraced sender; the receiver mints a fresh trace.
+        ("trace_id", 6, T.TYPE_STRING, _OPT),
+        ("parent_span_id", 7, T.TYPE_STRING, _OPT),
     ],
     "GenerateResponse": [
         ("tokens", 1, T.TYPE_INT32, _REP),
@@ -91,6 +98,21 @@ SERVING_MESSAGES = {
         # recent average time requests spend queued before seating (ms,
         # EWMA) — part of the router's least-loaded signal
         ("queue_wait_ms", 22, T.TYPE_DOUBLE, _OPT),
+        # latency percentiles from the shared log-linear histograms
+        # (observability/histogram.py) — the same code path
+        # bench_serving.py computes its percentiles with
+        ("ttft_p50_ms", 23, T.TYPE_DOUBLE, _OPT),
+        ("ttft_p90_ms", 24, T.TYPE_DOUBLE, _OPT),
+        ("ttft_p99_ms", 25, T.TYPE_DOUBLE, _OPT),
+        ("queue_wait_p50_ms", 26, T.TYPE_DOUBLE, _OPT),
+        ("queue_wait_p90_ms", 27, T.TYPE_DOUBLE, _OPT),
+        ("queue_wait_p99_ms", 28, T.TYPE_DOUBLE, _OPT),
+        # raw histogram bucket counts (fixed shared bucket scheme,
+        # trailing zeros trimmed): mergeable by addition, so the
+        # router aggregates its replicas' histograms and reports
+        # fleet-wide percentiles without percentile-averaging errors
+        ("ttft_hist", 29, T.TYPE_INT64, _REP),
+        ("queue_wait_hist", 30, T.TYPE_INT64, _REP),
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],
@@ -122,6 +144,33 @@ SERVING_MESSAGES = {
         ("shed", 9, T.TYPE_INT64, _OPT),
         ("breaker_trips", 10, T.TYPE_INT64, _OPT),
         ("uptime_secs", 11, T.TYPE_DOUBLE, _OPT),
+        # router-observed end-to-end dispatch latency (accept ->
+        # terminal outcome, re-dispatches and hedges included)
+        ("e2e_p50_ms", 12, T.TYPE_DOUBLE, _OPT),
+        ("e2e_p90_ms", 13, T.TYPE_DOUBLE, _OPT),
+        ("e2e_p99_ms", 14, T.TYPE_DOUBLE, _OPT),
+        # fleet-wide percentiles: the replicas' ttft/queue-wait
+        # histogram buckets merged by addition at the router
+        ("ttft_p50_ms", 15, T.TYPE_DOUBLE, _OPT),
+        ("ttft_p90_ms", 16, T.TYPE_DOUBLE, _OPT),
+        ("ttft_p99_ms", 17, T.TYPE_DOUBLE, _OPT),
+        ("queue_wait_p50_ms", 18, T.TYPE_DOUBLE, _OPT),
+        ("queue_wait_p90_ms", 19, T.TYPE_DOUBLE, _OPT),
+        ("queue_wait_p99_ms", 20, T.TYPE_DOUBLE, _OPT),
+    ],
+}
+
+# Fields appended to messages that live in the BASE descriptor (the
+# original elasticdl.proto surface, not the serving tables above).
+# Same determinism rules: idempotent replace-by-name, appended sorted
+# by field number. Used for the training-plane trace context: the
+# master mints a trace per task and hands (trace_id, span_id) to the
+# worker on the Task it dispatches, so task dispatch -> worker fetch ->
+# report_task_result reassembles as one span tree keyed by task id.
+EXTRA_MESSAGE_FIELDS = {
+    "Task": [
+        ("trace_id", 10, T.TYPE_STRING, _OPT),
+        ("span_id", 11, T.TYPE_STRING, _OPT),
     ],
 }
 
@@ -202,6 +251,28 @@ def build_descriptor(serialized):
     keep_svc = [s for s in fdp.service if s.name not in SERVICES]
     del fdp.service[:]
     fdp.service.extend(keep_svc)
+
+    # append the extra fields to base-descriptor messages, idempotently
+    # (replace-by-name) and in field-number order — same determinism
+    # contract as the serving tables
+    for msg in fdp.message_type:
+        extras = EXTRA_MESSAGE_FIELDS.get(msg.name)
+        if not extras:
+            continue
+        names = {spec[0] for spec in extras}
+        keep_fields = [f for f in msg.field if f.name not in names]
+        del msg.field[:]
+        msg.field.extend(keep_fields)
+        for spec in sorted(extras, key=lambda s: s[1]):
+            fname, num, ftype, label = spec[:4]
+            fld = msg.field.add()
+            fld.name = fname
+            fld.number = num
+            fld.type = ftype
+            fld.label = label
+            fld.json_name = _json_name(fname)
+            if ftype == T.TYPE_MESSAGE:
+                fld.type_name = spec[4]
 
     # stable ordering: names sort the tables, numbers sort the fields —
     # the serialized bytes cannot depend on dict/tuple declaration order

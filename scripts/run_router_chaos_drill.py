@@ -25,6 +25,18 @@ RE-DISPATCHED (proof the chaos path actually ran), the SIGKILL'd
 replica must leave the rotation, and the reloaded replica must report
 the new version.
 
+The drill also runs TRACED (EDL_TRACE_DIR): after the graceful
+teardown it merges every process's span export
+(observability/dump.merge_dir) and asserts the CAUSAL story
+structurally, not just by counters — every accepted request's trace
+reaches a terminal root span with an explicit status; at least one
+trace contains a failed dispatch span targeting the killed replica
+with a successful SIBLING dispatch next to it (the re-dispatch, as
+causality, not as a counter); and at least one replica `serve` span
+parents under a router dispatch span (the cross-process merge
+actually merged). The merged Chrome-trace JSON is archived at
+ROUTER_CHAOS_TRACE.json (repo root) — open it at ui.perfetto.dev.
+
 Runs TWICE: dense KV pool and block-paged pool (EDL_KV_PAGED), like
 the single-replica kill drill.
 
@@ -115,6 +127,87 @@ def warm(port):
     return stub
 
 
+def verify_traces(mode, trace_dir, killed_addr, outcomes):
+    """Structural assertions over the merged trace: the drill's story
+    must be READABLE from causality alone. Returns the merged spans
+    for archiving."""
+    from elasticdl_tpu.observability.dump import merge_dir
+    from elasticdl_tpu.observability.tracing import group_by_trace
+
+    spans, meta = merge_dir(trace_dir)
+    by_trace = group_by_trace(spans)
+    roots = [s for s in spans if s["name"] == "router_generate"]
+
+    # 1. every accepted request's trace reaches a terminal root span
+    # (only FINISHED spans export, so presence == termination), and
+    # every terminal status is explicit — the trace-level twin of the
+    # no-transport-codes client assertion
+    assert len(roots) == len(outcomes), (
+        "[chaos:%s] %d router_generate roots for %d accepted "
+        "requests — some request left no terminal span"
+        % (mode, len(roots), len(outcomes))
+    )
+    allowed = {"ok", "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+    statuses = {r["status"] for r in roots}
+    assert statuses <= allowed, (
+        "[chaos:%s] non-explicit terminal span statuses: %s"
+        % (mode, statuses - allowed)
+    )
+    ok_roots = [r for r in roots if r["status"] == "ok"]
+    n_ok = list(outcomes.values()).count("OK")
+    assert len(ok_roots) == n_ok, (
+        "[chaos:%s] %d ok roots != %d OK client outcomes"
+        % (mode, len(ok_roots), n_ok)
+    )
+
+    # 2./3. causal re-dispatch + cross-process merge
+    redispatch_trees = 0
+    merged_trees = 0
+    for root in ok_roots:
+        tspans = by_trace[root["trace_id"]]
+        dispatches = [
+            s for s in tspans
+            if s["name"] == "dispatch"
+            and s["parent_span_id"] == root["span_id"]
+        ]
+        assert dispatches, (
+            "[chaos:%s] OK root without dispatch children" % mode
+        )
+        oks = [d for d in dispatches if d["status"] == "ok"]
+        assert oks, (
+            "[chaos:%s] OK root whose dispatch legs all failed" % mode
+        )
+        killed_legs = [
+            d for d in dispatches
+            if d["status"] == "error"
+            and d["attrs"].get("replica") == killed_addr
+        ]
+        if killed_legs and any(
+                e["name"] == "redispatched" for e in root["events"]):
+            redispatch_trees += 1
+        ok_leg_ids = {d["span_id"] for d in oks}
+        if any(s["name"] == "serve"
+               and s["parent_span_id"] in ok_leg_ids
+               for s in tspans):
+            merged_trees += 1
+    assert redispatch_trees >= 1, (
+        "[chaos:%s] no trace shows a failed dispatch to the killed "
+        "replica (%s) with a successful sibling — the re-dispatch "
+        "causality is missing from the trace" % (mode, killed_addr)
+    )
+    assert merged_trees >= 1, (
+        "[chaos:%s] no replica serve span parented under a router "
+        "dispatch span — the cross-process merge merged nothing"
+        % mode
+    )
+    print("[chaos:%s] traces: %d spans / %d trees from %d exports; "
+          "%d trees carry the killed-replica re-dispatch story, "
+          "%d merged across processes"
+          % (mode, len(spans), len(by_trace), len(meta),
+             redispatch_trees, merged_trees))
+    return spans
+
+
 def run_mode(mode, mode_env, state, tmp_root):
     import grpc
     import numpy as np
@@ -127,6 +220,12 @@ def run_mode(mode, mode_env, state, tmp_root):
           % (mode, NUM_REPLICAS))
     reload_dir = os.path.join(tmp_root, "ckpt_%s" % mode)
     os.makedirs(reload_dir, exist_ok=True)
+    # every process exports its span ring here on graceful shutdown;
+    # the SIGKILL'd replica's export is LOST by design — its requests'
+    # causality lives in the router's dispatch spans
+    trace_dir = os.path.join(tmp_root, "traces_%s" % mode)
+    os.makedirs(trace_dir, exist_ok=True)
+    mode_env = dict(mode_env, EDL_TRACE_DIR=trace_dir)
     replicas = []
     try:
         for i in range(NUM_REPLICAS):
@@ -290,6 +389,13 @@ def run_mode(mode, mode_env, state, tmp_root):
             rc = proc.wait(timeout=60)
             assert rc == 0, "graceful exit must return 0, got %s" % rc
         assert replicas[0][0].wait(timeout=10) != 0  # SIGKILL, by design
+
+        # trace forensics: the drill's causal story must be readable
+        # from the merged span exports (survivors flushed on SIGTERM)
+        spans = verify_traces(
+            mode, trace_dir, "localhost:%d" % replicas[0][1], outcomes
+        )
+        return spans
     finally:
         for entry in replicas:
             if entry[0].poll() is None:
@@ -298,7 +404,10 @@ def run_mode(mode, mode_env, state, tmp_root):
 
 
 def main():
+    import json
     import tempfile
+
+    from elasticdl_tpu.observability.tracing import chrome_trace
 
     state = build_checkpoint_state()
     with tempfile.TemporaryDirectory(prefix="edl_chaos_") as tmp_root:
@@ -306,9 +415,16 @@ def main():
             ("dense", {"EDL_KV_PAGED": "0"}),
             ("paged", {"EDL_KV_PAGED": "1"}),
         ):
-            run_mode(mode, env, state, tmp_root)
+            spans = run_mode(mode, env, state, tmp_root)
+    # archive the last mode's merged trace as the CI artifact — one
+    # real chaos run, loadable at ui.perfetto.dev / chrome://tracing
+    out = os.path.join(REPO, "ROUTER_CHAOS_TRACE.json")
+    with open(out, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    print("[chaos] merged trace archived -> %s" % out)
     print("[chaos] router chaos drill PASSED (dense + paged): zero "
-          "accepted-request loss under SIGKILL + hot reload")
+          "accepted-request loss under SIGKILL + hot reload, causal "
+          "trace story verified structurally")
     return 0
 
 

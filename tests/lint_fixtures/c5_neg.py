@@ -1,0 +1,17 @@
+"""EDL401 clean fixture: declared names, non-telemetry receivers,
+and dynamic names are all out of scope."""
+
+
+class Frontend(object):
+    def __init__(self, telemetry):
+        self.telemetry = telemetry
+
+    def admit(self):
+        self.telemetry.count("admitted")  # declared: clean
+
+    def complete(self, name):
+        self.telemetry.count(name)  # dynamic: the runtime raise owns it
+
+    def tally(self, items):
+        # list.count — receiver doesn't spell telemetry
+        return items.count("admittd")

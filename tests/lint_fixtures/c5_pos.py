@@ -1,0 +1,18 @@
+"""EDL401 triggering fixture: telemetry counter-name typos."""
+
+
+class Frontend(object):
+    def __init__(self, telemetry):
+        self.telemetry = telemetry
+        self._telemetry = telemetry
+
+    def admit(self):
+        # typo'd counter: forks a new counter silently -> EDL401
+        self.telemetry.count("admittd")
+
+    def reject(self):
+        self._telemetry.count("rejectd", 2)  # EDL401 (underscored attr)
+
+
+def module_level(router_telemetry):
+    router_telemetry.count("breaker_tripz")  # EDL401 (bare receiver)

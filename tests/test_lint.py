@@ -91,6 +91,38 @@ def test_c3_negative():
     assert lint_file("c3_neg.py") == []
 
 
+# ----------------------------------------------------------- C5 fixtures
+
+
+def test_c5_positive():
+    findings = lint_file("c5_pos.py")
+    assert rule_ids(findings) == ["EDL401"] * 3, findings
+    details = {f.detail for f in findings}
+    assert details == {"admittd", "rejectd", "breaker_tripz"}
+    scopes = {f.scope for f in findings}
+    assert "Frontend.admit" in scopes and "module_level" in scopes
+
+
+def test_c5_negative():
+    assert lint_file("c5_neg.py") == []
+
+
+def test_c5_allowed_set_tracks_telemetry_declarations():
+    """The rule reads the declared sets from serving/telemetry.py —
+    one source of truth, no drift-prone second list."""
+    from elasticdl_tpu.analysis.telemetry_rules import declared_counters
+    from elasticdl_tpu.serving.telemetry import (
+        RouterTelemetry,
+        ServingTelemetry,
+    )
+
+    assert declared_counters() == (
+        frozenset(ServingTelemetry.COUNTERS)
+        | frozenset(RouterTelemetry.COUNTERS)
+    )
+    assert "admitted" in declared_counters()
+
+
 # --------------------------------------------------- every-rule coverage
 
 
@@ -98,7 +130,7 @@ def test_every_rule_has_fixture_coverage():
     """Meta-test: the fixture battery above exercises every registered
     rule id positively, and every checker has a clean fixture."""
     emitted = set()
-    for name in ("c1_pos.py", "c2_pos.py", "c3_pos.py"):
+    for name in ("c1_pos.py", "c2_pos.py", "c3_pos.py", "c5_pos.py"):
         emitted.update(f.rule for f in lint_file(name))
     ast_rule_ids = set()
     for rule in all_rules():
