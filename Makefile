@@ -6,10 +6,24 @@
 # The suite is sharded by pytest markers (pytest.ini):
 #   lint          — static analysis, runs BEFORE the shards: edl-lint
 #                   (python -m elasticdl_tpu.analysis.lint — lock-
-#                   discipline races, jit hazards, blocking calls in
-#                   servicers, proto drift; baseline in
-#                   .edl-lint-baseline.json) + ruff (pinned in ci.yml;
-#                   skipped with a notice when absent locally)
+#                   discipline races, lock-order deadlock cycles,
+#                   wrong-lock-held bindings, jit hazards, donated-
+#                   buffer aliasing, blocking calls + deadline
+#                   propagation in servicers/dispatch paths, must-
+#                   release resource tracking, proto drift; baseline
+#                   in .edl-lint-baseline.json) + ruff (pinned in
+#                   ci.yml; skipped with a notice when absent locally).
+#                   Useful flags (pass via LINT_FLAGS): --jobs N fans
+#                   per-file analysis over N processes (0 = one per
+#                   CPU; output byte-identical to serial — worth it on
+#                   multi-core runners), --format github emits GitHub
+#                   Actions ::error annotations (CI uses this so
+#                   findings render inline on PRs). `make lint-changed`
+#                   = --changed-only: lint only files changed vs the
+#                   git merge base plus untracked ones — the
+#                   pre-commit hook mode, sub-second on typical diffs
+#                   (stale-baseline enforcement is skipped there; only
+#                   full runs police baseline rot).
 #   default/fast  — everything NOT marked slow/integration (< 5 min,
 #                   the per-commit gate)
 #   drills        — the slow + integration shard: multi-process SPMD
@@ -31,14 +45,14 @@ MESH_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 RUFF_VERSION = 0.8.4
 LINT_PATHS = elasticdl_tpu scripts tests
 
-.PHONY: native lint test-fast test-drills drill serve-smoke ci ci-fast \
-	cluster-smoke clean
+.PHONY: native lint lint-changed test-fast test-drills drill serve-smoke \
+	ci ci-fast cluster-smoke clean
 
 native:
 	$(MAKE) -C elasticdl_tpu/native
 
 lint:
-	env -u PYTHONPATH $(PY) -m elasticdl_tpu.analysis.lint $(LINT_PATHS)
+	env -u PYTHONPATH $(PY) -m elasticdl_tpu.analysis.lint $(LINT_FLAGS) $(LINT_PATHS)
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check $(LINT_PATHS); \
 	elif $(PY) -m ruff --version >/dev/null 2>&1; then \
@@ -46,6 +60,10 @@ lint:
 	else \
 		echo "ruff not installed (CI pins ruff==$(RUFF_VERSION)); skipping generic lint"; \
 	fi
+
+lint-changed:
+	env -u PYTHONPATH $(PY) -m elasticdl_tpu.analysis.lint \
+		--changed-only $(LINT_FLAGS) $(LINT_PATHS)
 
 test-fast: native
 	env -u PYTHONPATH $(MESH_ENV) $(PY) -m pytest tests/ -q \

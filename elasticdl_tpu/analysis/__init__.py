@@ -8,10 +8,22 @@ serializes the decode loop. These checkers encode the project's
 conventions as AST rules so correctness scales with the code instead
 of with reviewer attention.
 
+Two tiers of machinery:
+
+* syntactic visitor rules (lock_rules, jit_rules, blocking_rules,
+  telemetry_rules) — one AST pass, local judgments;
+* CFG/dataflow rules (lockgraph_rules, resource_rules,
+  deadline_rules, donate_rules) on the engine in cfg.py (per-function
+  control-flow graphs) and dataflow.py (worklist may/must analyses +
+  the project-wide class/lock/binding index) — path-sensitive and
+  interprocedural judgments: lock-order deadlock cycles, wrong-lock
+  bindings, must-release obligations, deadline propagation, donated-
+  buffer liveness.
+
 Entry point: ``python -m elasticdl_tpu.analysis.lint`` (see `make
-lint` and the CI ``lint`` job). Rules live in small visitor classes
-behind the registry in core.py; adding one is ~50 LoC plus two
-fixtures (docs/designs/static_analysis.md has the recipe).
+lint` and the CI ``lint`` job). Rules live behind the registry in
+core.py; adding one is ~50-150 LoC plus two fixtures
+(docs/designs/static_analysis.md has the recipe for both tiers).
 """
 
 from elasticdl_tpu.analysis.core import (  # noqa: F401
@@ -26,8 +38,12 @@ from elasticdl_tpu.analysis.core import (  # noqa: F401
 # importing the rule modules registers their rules
 from elasticdl_tpu.analysis import (  # noqa: F401,E402
     blocking_rules,
+    deadline_rules,
+    donate_rules,
     jit_rules,
     lock_rules,
+    lockgraph_rules,
     proto_rules,
+    resource_rules,
     telemetry_rules,
 )

@@ -27,7 +27,12 @@ FLAGGED inside a context:
   timeout nor a ``timeout=`` kwarg — unbounded primitive wait;
 * a synchronous RPC via a stub (receiver path mentions ``stub``)
   without a ``timeout=`` kwarg — an unbounded network wait that rides
-  on a peer's liveness.
+  on a peer's liveness;
+* ``concurrent.futures`` waits without a bound: ``<f>.result()`` with
+  no timeout (a wedged worker pins the handler exactly like a lost
+  peer — the PR 4 concurrent-heartbeat shape), and ``wait(fs)`` /
+  ``as_completed(fs)`` (bare or ``futures.``-qualified) without
+  ``timeout=``.
 """
 
 import ast
@@ -36,6 +41,7 @@ from elasticdl_tpu.analysis.core import Finding, Rule, register
 
 _QUEUEISH = ("queue", "_q", "results", "events")
 _WAITERS = {"wait", "join", "acquire"}
+_FUTURES_WAITS = {"wait", "as_completed"}
 _ROUTER_METHOD_PREFIXES = ("dispatch", "_dispatch", "_call")
 
 
@@ -95,6 +101,24 @@ class _BlockingVisitor(ast.NodeVisitor):
                     "pass a timeout so a lost peer cannot pin the "
                     "thread" % fn.attr,
                 )
+            elif (fn.attr == "result"
+                    and not node.args
+                    and not _has_timeout(node)):
+                self._emit(
+                    node.lineno, ".result()",
+                    "untimed Future.result() in a servicer/dispatch "
+                    "path: a wedged worker pins the handler thread; "
+                    "pass timeout= and handle TimeoutError",
+                )
+            elif (fn.attr in _FUTURES_WAITS
+                    and "futures" in recv
+                    and not _has_timeout(node)):
+                self._emit(
+                    node.lineno, "futures.%s" % fn.attr,
+                    "untimed futures.%s() in a servicer/dispatch "
+                    "path waits on every future's liveness; pass "
+                    "timeout=" % fn.attr,
+                )
             elif "stub" in recv and not _has_timeout(node):
                 self._emit(
                     node.lineno, "%s.%s" % (recv, fn.attr),
@@ -102,6 +126,15 @@ class _BlockingVisitor(ast.NodeVisitor):
                     "the peer's liveness; every dispatch-path RPC "
                     "must carry a deadline",
                 )
+        elif (isinstance(fn, ast.Name)
+                and fn.id in _FUTURES_WAITS
+                and node.args
+                and not _has_timeout(node)):
+            self._emit(
+                node.lineno, fn.id,
+                "untimed %s() in a servicer/dispatch path waits on "
+                "every future's liveness; pass timeout=" % fn.id,
+            )
         self.generic_visit(node)
 
 

@@ -1,0 +1,351 @@
+"""Per-function control-flow graph over the AST.
+
+The engine the CFG-based rules (EDL003/004/104/202/203/501) run on.
+One `Node` per *simple* statement plus synthetic junctions; control
+statements (``if``/``while``/``for``/``try``/``with``) contribute a
+node for the expression they evaluate (test, iterable, with-items)
+and structure for their bodies. Every function gets three
+distinguished nodes: ``entry``, ``exit`` (normal return /
+fall-off-the-end) and ``raise_exit`` (an exception propagates out).
+
+Exception edges are deliberately SELECTIVE, not sound: a statement
+gets an exceptional successor only when it is lexically inside a
+``try`` (to that try's dispatch junction) or when it is an explicit
+``raise``. Modeling "any statement may raise" would make every
+``acquire(); use(); release()`` sequence a leak path and drown the
+resource rules in noise; the bug shapes that matter here — a handler
+branch that forgets the release, an early return, a re-raise — all
+flow through explicit try/raise structure, which IS modeled:
+
+* ``except:`` / ``except BaseException`` / ``except Exception`` is
+  treated as catch-all (the body's uncaught-propagation edge is
+  dropped); a typed handler (``except ValueError``) keeps it, because
+  an exception of another type flies past.
+* handler and ``orelse`` bodies run OUTSIDE the handler-catching
+  scope but INSIDE the finally scope: an EXPLICIT ``raise`` there
+  (including a bare re-``raise``) runs the finally and continues
+  outward — it can never loop back into a sibling handler. Ordinary
+  handler statements get no implicit raise edge (a predicate call in
+  ``if self._transient(e):`` is not treated as a potential raiser) —
+  same noise-control reasoning as above.
+* ``finally`` bodies are COPIED per crossing kind (normal completion,
+  propagation, early return/break/continue) rather than shared, so no
+  spurious cross-path merges arise; rules de-duplicate identical
+  findings from the copies by fingerprint.
+* ``with`` bodies are ordinary straight-line structure (the
+  context-manager release-on-exit is the RULES' knowledge, not the
+  graph's).
+
+Nested ``def``/``lambda``/``class`` statements are single nodes: the
+definition executes here, the body does not (analyses recurse into
+nested functions explicitly when their semantics call for it, via
+`walk_shallow`, which prunes nested scopes).
+"""
+
+import ast
+
+#: node kinds — synthetic junctions carry no AST payload
+STMT = "stmt"          # a simple statement (payload = the stmt)
+TEST = "test"          # if/while test expression (payload = the stmt)
+ITER = "iter"          # for-loop iterable (payload = the stmt)
+WITH = "with"          # with-items evaluation (payload = the With)
+ENTRY = "entry"
+EXIT = "exit"
+RAISE_EXIT = "raise"
+JUNCTION = "junction"  # dispatch/merge points
+
+
+class Node(object):
+    __slots__ = ("idx", "kind", "payload", "succ", "esucc")
+
+    def __init__(self, idx, kind, payload=None):
+        self.idx = idx
+        self.kind = kind
+        self.payload = payload
+        self.succ = []   # normal control flow
+        self.esucc = []  # exceptional control flow (to a dispatch)
+
+    @property
+    def out(self):
+        return self.succ + self.esucc
+
+    @property
+    def line(self):
+        return getattr(self.payload, "lineno", 0)
+
+    def scan_roots(self):
+        """AST subtrees whose evaluation happens at this node (what an
+        event scanner should walk — with `walk_shallow`, so nested
+        function bodies are excluded)."""
+        p = self.payload
+        if p is None:
+            return ()
+        if self.kind == STMT:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                return ()  # definition executes; body does not
+            return (p,)
+        if self.kind == TEST:
+            return (p.test,)
+        if self.kind == ITER:
+            return (p.iter,)
+        if self.kind == WITH:
+            return tuple(item.context_expr for item in p.items)
+        return ()
+
+    def __repr__(self):
+        return "<Node %d %s L%d>" % (self.idx, self.kind, self.line)
+
+
+def walk_shallow(node):
+    """ast.walk pruned at nested-scope boundaries: never descends into
+    a nested FunctionDef/AsyncFunctionDef/Lambda/ClassDef body (their
+    code runs later, in another frame). The root itself is always
+    yielded and entered (callers scan bodies they own)."""
+    stack = [node]
+    first = True
+    while stack:
+        n = stack.pop()
+        yield n
+        if not first and isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+        ):
+            continue
+        first = False
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class CFG(object):
+    def __init__(self, fndef):
+        self.fndef = fndef
+        self.nodes = []
+        self.entry = self._new(ENTRY)
+        self.exit = self._new(EXIT)
+        self.raise_exit = self._new(RAISE_EXIT)
+
+    def _new(self, kind, payload=None):
+        node = Node(len(self.nodes), kind, payload)
+        self.nodes.append(node)
+        return node
+
+    def link(self, frm, to, exc=False):
+        edges = frm.esucc if exc else frm.succ
+        if to not in edges:
+            edges.append(to)
+
+
+class _Frame(object):
+    """One enclosing try scope during construction. `dispatch` is the
+    junction exceptions raised in the scope route to; `fin_stmts` is
+    the finalbody any path LEAVING the scope must cross. `catches` is
+    True for a try BODY (its handlers/finally react to any raise
+    there) and False for the handler/orelse escape scope, where only
+    EXPLICIT ``raise`` statements propagate — treating every handler
+    expression as a potential raiser is exactly the "any statement may
+    raise" noise this graph avoids."""
+
+    __slots__ = ("dispatch", "fin_stmts", "catches")
+
+    def __init__(self, dispatch, fin_stmts, catches=True):
+        self.dispatch = dispatch
+        self.fin_stmts = fin_stmts
+        self.catches = catches
+
+
+class _Loop(object):
+    __slots__ = ("header", "breaks", "depth")
+
+    def __init__(self, header, depth):
+        self.header = header
+        self.breaks = []
+        self.depth = depth  # len(try stack) at loop entry
+
+
+_CATCH_ALL = ("Exception", "BaseException")
+
+
+def _is_catch_all(handler):
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Attribute):
+        return t.attr in _CATCH_ALL
+    if isinstance(t, ast.Name):
+        return t.id in _CATCH_ALL
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _CATCH_ALL
+            or isinstance(e, ast.Attribute) and e.attr in _CATCH_ALL
+            for e in t.elts
+        )
+    return False
+
+
+class _Builder(object):
+    def __init__(self, fndef):
+        self.cfg = CFG(fndef)
+        self.tries = []   # _Frame stack (innermost last)
+        self.loops = []   # _Loop stack
+
+    # ------------------------------------------------------------ wiring
+
+    def build(self):
+        out = self._seq(self.cfg.fndef.body, [self.cfg.entry])
+        self._connect(out, self.cfg.exit)
+        return self.cfg
+
+    def _connect(self, preds, target):
+        for p in preds:
+            self.cfg.link(p, target)
+
+    def _exc_target(self):
+        if self.tries:
+            return self.tries[-1].dispatch
+        return self.cfg.raise_exit
+
+    def _finally_copy(self, fin_stmts, preds):
+        """Build ONE fresh copy of a finalbody (under the CURRENT try
+        stack — the finally runs outside its own try) fed by `preds`;
+        returns its dangling exits."""
+        if not fin_stmts:
+            return list(preds)
+        j = self.cfg._new(JUNCTION)
+        self._connect(preds, j)
+        return self._seq(fin_stmts, [j])
+
+    def _route(self, preds, to_depth, target):
+        """Route an abrupt jump (return / break / continue /
+        propagation) through every finally between the current try
+        depth and `to_depth`, innermost first, then to `target`."""
+        saved = self.tries
+        for i in range(len(saved) - 1, to_depth - 1, -1):
+            frame = saved[i]
+            if frame.fin_stmts:
+                self.tries = saved[:i]
+                preds = self._finally_copy(frame.fin_stmts, preds)
+        self.tries = saved
+        self._connect(preds, target)
+
+    # ------------------------------------------------------- statements
+
+    def _seq(self, stmts, preds):
+        for stmt in stmts:
+            preds = self._stmt(stmt, preds)
+        return preds
+
+    def _node(self, kind, stmt, preds, may_raise=None):
+        node = self.cfg._new(kind, stmt)
+        self._connect(preds, node)
+        if may_raise is None:
+            # implicit raising is modeled only inside a try BODY;
+            # handler/orelse code raises only via explicit `raise`
+            may_raise = any(f.catches for f in self.tries)
+        if may_raise:
+            self.cfg.link(node, self._exc_target(), exc=True)
+        return node
+
+    def _stmt(self, stmt, preds):
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, preds)
+        if isinstance(stmt, ast.While):
+            return self._loop(stmt, preds, TEST)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._loop(stmt, preds, ITER)
+        if isinstance(stmt, ast.Try) or type(stmt).__name__ == "TryStar":
+            return self._try(stmt, preds)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            node = self._node(WITH, stmt, preds)
+            return self._seq(stmt.body, [node])
+        if isinstance(stmt, ast.Return):
+            node = self._node(STMT, stmt, preds)
+            self._route([node], 0, self.cfg.exit)
+            return []
+        if isinstance(stmt, ast.Raise):
+            node = self._node(STMT, stmt, preds, may_raise=False)
+            if self.tries:
+                self.cfg.link(node, self.tries[-1].dispatch)
+            else:
+                self._route([node], 0, self.cfg.raise_exit)
+            return []
+        if isinstance(stmt, ast.Break):
+            node = self._node(STMT, stmt, preds, may_raise=False)
+            if self.loops:
+                loop = self.loops[-1]
+                j = self.cfg._new(JUNCTION)
+                loop.breaks.append(j)
+                self._route([node], loop.depth, j)
+            return []
+        if isinstance(stmt, ast.Continue):
+            node = self._node(STMT, stmt, preds, may_raise=False)
+            if self.loops:
+                loop = self.loops[-1]
+                self._route([node], loop.depth, loop.header)
+            return []
+        return [self._node(STMT, stmt, preds)]
+
+    def _if(self, stmt, preds):
+        test = self._node(TEST, stmt, preds)
+        out = list(self._seq(stmt.body, [test]))
+        if stmt.orelse:
+            out.extend(self._seq(stmt.orelse, [test]))
+        else:
+            out.append(test)
+        return out
+
+    def _loop(self, stmt, preds, kind):
+        header = self._node(kind, stmt, preds)
+        self.loops.append(_Loop(header, len(self.tries)))
+        body_out = self._seq(stmt.body, [header])
+        self._connect(body_out, header)
+        loop = self.loops.pop()
+        out = list(loop.breaks)
+        if stmt.orelse:
+            out.extend(self._seq(stmt.orelse, [header]))
+        else:
+            out.append(header)
+        return out
+
+    def _try(self, stmt, preds):
+        dispatch = self.cfg._new(JUNCTION)
+        self.tries.append(_Frame(dispatch, stmt.finalbody))
+        body_out = self._seq(stmt.body, preds)
+        self.tries.pop()
+
+        # handler/orelse scope: exceptions there (incl. re-raise) run
+        # the finally and continue OUTWARD — never back into dispatch
+        esc = self.cfg._new(JUNCTION)
+        fin_scope = _Frame(esc, stmt.finalbody, catches=False)
+        self.tries.append(fin_scope)
+        if stmt.orelse:
+            body_out = self._seq(stmt.orelse, body_out)
+        handler_out = []
+        caught_all = not stmt.handlers
+        for handler in stmt.handlers:
+            h_entry = self.cfg._new(JUNCTION)
+            self.cfg.link(dispatch, h_entry)
+            handler_out.extend(self._seq(handler.body, [h_entry]))
+            caught_all = caught_all or _is_catch_all(handler)
+        self.tries.pop()
+
+        outer_exc = self._exc_target()
+        # exceptions escaping a handler/orelse: finally, then outward
+        self._connect(
+            self._finally_copy(stmt.finalbody, [esc]), outer_exc
+        )
+        # uncaught propagation out of the body (typed handlers may not
+        # match; a handler-less try/finally never catches)
+        if not caught_all or not stmt.handlers:
+            prop = self.cfg._new(JUNCTION)
+            self.cfg.link(dispatch, prop)
+            self._connect(
+                self._finally_copy(stmt.finalbody, [prop]), outer_exc
+            )
+        # normal completion crosses the finally once
+        return self._finally_copy(stmt.finalbody,
+                                  list(body_out) + handler_out)
+
+
+def build_cfg(fndef):
+    """CFG for one FunctionDef/AsyncFunctionDef."""
+    return _Builder(fndef).build()

@@ -188,7 +188,10 @@ DEFAULT_EXCLUDES = (
 def iter_python_files(paths, excludes=DEFAULT_EXCLUDES):
     for path in paths:
         if os.path.isfile(path):
-            if path.endswith(".py"):
+            norm = path.replace(os.sep, "/")
+            if path.endswith(".py") and not any(
+                ex in norm for ex in excludes
+            ):
                 yield path
             continue
         for dirpath, dirnames, filenames in os.walk(path):
@@ -206,30 +209,74 @@ def iter_python_files(paths, excludes=DEFAULT_EXCLUDES):
                 yield full
 
 
-def run_rules(paths, rules=None, root=None, excludes=DEFAULT_EXCLUDES):
+def _check_one_file(args):
+    """Module-rule pass over ONE file — the process-pool work unit
+    (top-level so it pickles; rules are reconstructed from ids in the
+    child, where the registry import already ran)."""
+    path, rel, rule_ids = args
+    import elasticdl_tpu.analysis  # noqa: F401 - loads the registry
+
+    rules = [r for r in all_rules() if r.id in rule_ids]
+    findings, errors = [], []
+    try:
+        with open(path) as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+    except (SyntaxError, UnicodeDecodeError) as e:
+        return findings, ["%s: unparseable: %s" % (path, e)]
+    lines = src.splitlines()
+    for rule in rules:
+        for finding in rule.check_module(tree, lines, rel):
+            if not suppressed_by_pragma(finding, lines):
+                findings.append(finding)
+    return findings, errors
+
+
+def run_rules(paths, rules=None, root=None, excludes=DEFAULT_EXCLUDES,
+              jobs=1):
     """Run `rules` over every Python file under `paths` plus each
     rule's repo-level check. Returns (findings, errors): findings are
     pragma-filtered but NOT baseline-filtered (the caller owns the
-    baseline so --write-baseline can see everything)."""
+    baseline so --write-baseline can see everything).
+
+    `jobs` > 1 fans the per-file module passes out over a process
+    pool (findings and errors merge deterministically: results are
+    re-sorted, so parallel output is byte-identical to serial);
+    repo-level checks always run in this process."""
     rules = rules if rules is not None else all_rules()
-    findings, errors = [], []
+    rule_ids = frozenset(r.id for r in rules)
+    work = []
     for path in iter_python_files(paths, excludes=excludes):
-        try:
-            with open(path) as f:
-                src = f.read()
-            tree = ast.parse(src, filename=path)
-        except (SyntaxError, UnicodeDecodeError) as e:
-            errors.append("%s: unparseable: %s" % (path, e))
-            continue
-        lines = src.splitlines()
         rel = os.path.relpath(path, root) if root else path
-        rel = rel.replace(os.sep, "/")
-        for rule in rules:
-            for finding in rule.check_module(tree, lines, rel):
-                if not suppressed_by_pragma(finding, lines):
-                    findings.append(finding)
+        work.append((path, rel.replace(os.sep, "/"), rule_ids))
+
+    findings, errors = [], []
+    if jobs > 1 and len(work) > 1:
+        import multiprocessing
+
+        with multiprocessing.Pool(min(jobs, len(work))) as pool:
+            results = pool.map(_check_one_file, work,
+                               chunksize=max(1, len(work) // (4 * jobs)))
+        for fs, es in results:
+            findings.extend(fs)
+            errors.extend(es)
+    else:
+        for item in work:
+            fs, es = _check_one_file(item)
+            findings.extend(fs)
+            errors.extend(es)
+
     if root:
         for rule in rules:
             findings.extend(rule.check_repo(root))
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings, errors
+    # CFG finally-copies and the module+repo lock-graph overlap can
+    # produce byte-identical findings; report each once
+    seen, unique = set(), []
+    for f in sorted(findings,
+                    key=lambda f: (f.path, f.line, f.rule, f.detail)):
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    errors.sort()
+    return unique, errors

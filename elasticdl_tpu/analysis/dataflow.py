@@ -1,0 +1,419 @@
+"""Dataflow over the CFG + the project-wide index the
+interprocedural rules resolve calls against.
+
+Three layers, each usable alone:
+
+* `forward` — the generic worklist fixpoint: per-node transfer
+  functions over join-semilattice states (sets here; may-analysis is
+  union-join, must-analysis intersection-join).
+* `tainted_names` / `leak_paths` — the two concrete analyses the rule
+  families share: forward MAY-taint of local names from seed values
+  (deadline propagation, jit-style derivation questions), and the
+  path search "can this acquisition reach a function exit without
+  passing a settle event" (must-release).
+* `ModuleIndex` / `ProjectIndex` — classes, their lock attributes
+  (instance ``self._x = threading.Lock()`` AND class-level
+  ``_x = Lock()``), their methods, and an attribute→class binding map
+  so ``self._evaluation_service.complete_task()`` resolves to a
+  method of a concrete class. Bindings come from three sources, in
+  decreasing confidence: direct construction (``self.x =
+  ClassName(...)``), constructor/setter argument propagation (a
+  parameter's type inferred from what every resolvable call site
+  passes — ``EvaluationService(..., task_d=self.task_d, ...)`` types
+  the ``task_d`` param, so ``self._task_d = task_d`` binds), and the
+  camel-case naming convention (``self._router = router`` binds to a
+  known class ``Router``). Heuristic by design: an unresolvable
+  receiver contributes NOTHING (rules stay quiet rather than guess).
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.cfg import walk_shallow
+
+# --------------------------------------------------------------- fixpoint
+
+
+def forward(cfg, transfer, entry_state=frozenset(), join=None):
+    """Worklist forward fixpoint. `transfer(node, in_state)` returns
+    the node's out-state; `join` merges predecessor out-states
+    (default: union — a MAY analysis). Returns {node: in_state}."""
+    if join is None:
+        def join(a, b):
+            return a | b
+
+    preds = {n: [] for n in cfg.nodes}
+    for n in cfg.nodes:
+        for s in n.out:
+            preds[s].append(n)
+
+    in_states = {cfg.entry: entry_state}
+    out_states = {}
+    work = [cfg.entry]
+    while work:
+        node = work.pop()
+        in_s = in_states.get(node, None)
+        if in_s is None:
+            continue
+        out_s = transfer(node, in_s)
+        if out_states.get(node) == out_s and node in out_states:
+            continue
+        out_states[node] = out_s
+        for succ in node.out:
+            merged = out_s
+            for p in preds[succ]:
+                if p is not node and p in out_states:
+                    merged = join(merged, out_states[p])
+            if in_states.get(succ) != merged:
+                in_states[succ] = merged
+                work.append(succ)
+    return in_states
+
+
+# ------------------------------------------------------------ name taint
+
+
+def _target_names(tgt):
+    out = []
+    for n in ast.walk(tgt):
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+    return out
+
+
+def mentions(expr, names):
+    """True when `expr` reads any Name in `names` (nested scopes
+    included: a closure capturing a tainted name carries the taint)."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in names:
+            return True
+    return False
+
+
+def tainted_names(cfg, seeds, is_source=None):
+    """Forward MAY-taint: which local names may, at each node, hold a
+    value derived from the seed names (or from any expression
+    `is_source` accepts — e.g. an attribute read like
+    ``request.deadline_ms``). Assignments propagate: a target becomes
+    tainted when its value mentions a tainted name or a source;
+    otherwise a plain Name target is (per-path) untainted.
+    Returns {node: frozenset(names)} of the state ENTERING the node."""
+    seeds = frozenset(seeds)
+
+    def expr_tainted(expr, state):
+        if mentions(expr, state):
+            return True
+        if is_source is not None:
+            for n in ast.walk(expr):
+                if is_source(n):
+                    return True
+        return False
+
+    def transfer(node, state):
+        if node.kind != "stmt":
+            # tests/iters only read; for-targets handled on the ITER
+            p = node.payload
+            if node.kind == "iter" and p is not None:
+                if expr_tainted(p.iter, state):
+                    state = state | frozenset(_target_names(p.target))
+            return state
+        stmt = node.payload
+        if isinstance(stmt, ast.Assign):
+            tainted = expr_tainted(stmt.value, state)
+            names = []
+            for tgt in stmt.targets:
+                names.extend(_target_names(tgt))
+            if tainted:
+                state = state | frozenset(names)
+            else:
+                state = state - frozenset(
+                    n for tgt in stmt.targets
+                    if isinstance(tgt, ast.Name)
+                    for n in (tgt.id,)
+                )
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name) and expr_tainted(
+                stmt.value, state
+            ):
+                state = state | frozenset([stmt.target.id])
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            if isinstance(stmt.target, ast.Name):
+                if expr_tainted(stmt.value, state):
+                    state = state | frozenset([stmt.target.id])
+                else:
+                    state = state - frozenset([stmt.target.id])
+        return state
+
+    return forward(cfg, transfer, entry_state=seeds)
+
+
+# ------------------------------------------------------------ leak paths
+
+
+def leak_paths(start_nodes, is_settle, is_leak_exit):
+    """DFS over CFG successors from `start_nodes`: does some path
+    reach a node satisfying `is_leak_exit` without first passing a
+    node whose entry satisfies `is_settle`? Returns the first
+    leak-exit node found, else None.
+
+    `is_settle` may return "full" (the whole node settles — release
+    call, reassign, store: stop the path) or "exit" (the settle
+    happens AT function exit — ``return handle`` / ``raise handle``:
+    the normal continuation is settled, but the node's EXCEPTIONAL
+    successors stay live, because if evaluating the statement raises,
+    the handle never escaped)."""
+    seen = set()
+    stack = list(start_nodes)
+    while stack:
+        node = stack.pop()
+        if node.idx in seen:
+            continue
+        seen.add(node.idx)
+        settle = is_settle(node)
+        if settle == "exit":
+            stack.extend(node.esucc)
+            continue
+        if settle:
+            continue
+        if is_leak_exit(node):
+            return node
+        stack.extend(node.out)
+    return None
+
+
+# ---------------------------------------------------------- module index
+
+_LOCK_KINDS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond"}
+
+
+def _call_ctor_kind(value):
+    """'lock'/'rlock'/'cond' for a threading-primitive constructor
+    call expression, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    return _LOCK_KINDS.get(name)
+
+
+def _called_class_name(value, classes):
+    """'ClassName' when `value` is a Call of a known class (bare name
+    or dotted tail), else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    return name if name in classes else None
+
+
+def camel(name):
+    """``_evaluation_service`` -> ``EvaluationService``."""
+    return "".join(p.capitalize() for p in name.strip("_").split("_"))
+
+
+class ClassInfo(object):
+    __slots__ = ("name", "path", "node", "lock_attrs", "methods",
+                 "attr_types")
+
+    def __init__(self, name, path, node):
+        self.name = name
+        self.path = path
+        self.node = node
+        self.lock_attrs = {}   # attr -> 'lock' | 'rlock' | 'cond'
+        self.methods = {}      # name -> FunctionDef
+        self.attr_types = {}   # attr -> class name
+
+    def single_lock(self):
+        if len(self.lock_attrs) == 1:
+            return next(iter(self.lock_attrs))
+        return None
+
+
+def _self_attr(node):
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class ModuleIndex(object):
+    def __init__(self, tree, path):
+        self.tree = tree
+        self.path = path
+        self.classes = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = self._index_class(node)
+
+    def _index_class(self, classdef):
+        info = ClassInfo(classdef.name, self.path, classdef)
+        for stmt in classdef.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign):
+                # class-level lock: `_ids_lock = threading.Lock()`
+                kind = _call_ctor_kind(stmt.value)
+                if kind:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            info.lock_attrs[tgt.id] = kind
+        for node in ast.walk(classdef):
+            if isinstance(node, ast.Assign):
+                kind = _call_ctor_kind(node.value)
+                if kind:
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            info.lock_attrs[attr] = kind
+        return info
+
+
+class ProjectIndex(object):
+    """Classes across every module, with attribute→class bindings
+    resolved by a small fixpoint (see module docstring). Class names
+    appearing in more than one module are kept FIRST-wins; in this
+    codebase class names are unique, and a collision would only make
+    the rules quieter, never wrong-er."""
+
+    def __init__(self, module_indexes):
+        self.modules = list(module_indexes)
+        self.classes = {}
+        for mod in self.modules:
+            for name, info in mod.classes.items():
+                self.classes.setdefault(name, info)
+        self._bind_attr_types()
+
+    # -------------------------------------------------------- bindings
+
+    def _bind_attr_types(self):
+        # pass 1: direct construction + camel-case convention
+        assigns = []  # (ClassInfo, attr, value expr, enclosing method)
+        for info in self.classes.values():
+            for mname, fn in info.methods.items():
+                for node in walk_shallow(fn):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            assigns.append((info, attr, node.value,
+                                            mname))
+        for info, attr, value, _m in assigns:
+            cname = _called_class_name(value, self.classes)
+            if cname:
+                info.attr_types[attr] = cname
+        for info, attr, value, _m in assigns:
+            if attr in info.attr_types:
+                continue
+            if isinstance(value, ast.Name):
+                guess = camel(value.id)
+                if guess in self.classes:
+                    info.attr_types[attr] = guess
+                else:
+                    guess = camel(attr)
+                    if guess in self.classes:
+                        info.attr_types[attr] = guess
+
+        # pass 2: constructor/setter argument propagation — what type
+        # does each (class, method, param) receive at resolvable call
+        # sites? Two rounds so a binding discovered in round one can
+        # type a call argument in round two.
+        for _round in range(2):
+            param_types = self._collect_param_types()
+            for info, attr, value, mname in assigns:
+                if attr in info.attr_types:
+                    continue
+                if isinstance(value, ast.Name):
+                    t = param_types.get((info.name, mname, value.id))
+                    if t:
+                        info.attr_types[attr] = t
+
+    def _collect_param_types(self):
+        param_types = {}
+        for info in self.classes.values():
+            for fn in info.methods.values():
+                for node in walk_shallow(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = self._callee_of(info, node)
+                    if callee is None:
+                        continue
+                    cls_name, method_name = callee
+                    target = self.classes.get(cls_name)
+                    if target is None:
+                        continue
+                    mdef = target.methods.get(method_name)
+                    if mdef is None:
+                        continue
+                    params = [a.arg for a in mdef.args.args]
+                    if params and params[0] == "self":
+                        params = params[1:]
+                    for i, arg in enumerate(node.args):
+                        if i < len(params):
+                            t = self._arg_type(info, arg)
+                            if t:
+                                param_types[
+                                    (cls_name, method_name, params[i])
+                                ] = t
+                    for kw in node.keywords:
+                        if kw.arg:
+                            t = self._arg_type(info, kw.value)
+                            if t:
+                                param_types[
+                                    (cls_name, method_name, kw.arg)
+                                ] = t
+        return param_types
+
+    def _callee_of(self, info, call):
+        """(class_name, method_name) for ClassName(...) -> __init__,
+        self.m(...), or self.attr.m(...); None unresolved."""
+        fn = call.func
+        cname = _called_class_name(call, self.classes)
+        if cname:
+            return (cname, "__init__")
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                return (info.name, fn.attr)
+            attr = _self_attr(recv)
+            if attr is not None and attr in info.attr_types:
+                return (info.attr_types[attr], fn.attr)
+        return None
+
+    def _arg_type(self, info, arg):
+        if isinstance(arg, ast.Name) and arg.id == "self":
+            return info.name
+        attr = _self_attr(arg)
+        if attr is not None:
+            return info.attr_types.get(attr)
+        return _called_class_name(arg, self.classes)
+
+    # ------------------------------------------------------ resolution
+
+    def resolve_receiver(self, info, recv, local_aliases=None):
+        """ClassInfo for a call receiver expression inside a method of
+        `info`: ``self`` -> info, ``self.attr`` -> bound class, a
+        local alias of either, else None."""
+        if isinstance(recv, ast.Name):
+            if recv.id == "self":
+                return info
+            if local_aliases and recv.id in local_aliases:
+                kind, val = local_aliases[recv.id]
+                if kind == "selfattr":
+                    cname = info.attr_types.get(val)
+                    return self.classes.get(cname) if cname else None
+            return None
+        attr = _self_attr(recv)
+        if attr is not None:
+            cname = info.attr_types.get(attr)
+            return self.classes.get(cname) if cname else None
+        return None
+
+
+def build_project_index(parsed_modules):
+    """`parsed_modules`: iterable of (tree, path)."""
+    return ProjectIndex(ModuleIndex(t, p) for t, p in parsed_modules)

@@ -1,0 +1,262 @@
+"""C9 — RPC-deadline propagation (EDL202 dropped / EDL203 replaced).
+
+An inbound deadline must FLOW. A servicer or router dispatch entry
+that receives a deadline — a ``request`` whose proto carries
+``deadline_ms``, or an explicit timeout/deadline parameter — must
+thread that budget (possibly decremented) into every downstream stub
+RPC it causes, directly or through helpers. The two failure modes:
+
+* **EDL202 — deadline dropped.** A helper reachable from a
+  deadline-carrying dispatch entry makes a stub call with NO
+  ``timeout=`` at all. (Inside any servicer/router-dispatch method —
+  EDL201's syntactic surface — the bare missing-timeout case stays
+  EDL201's; EDL202 covers the call chain EDL201 cannot see: helper
+  classes the dispatch path flows through.)
+* **EDL203 — deadline replaced by an unbounded default.** The stub
+  call HAS a ``timeout=``, but the value does not derive from the
+  inbound budget — a config constant, a literal — so a client with
+  200 ms left waits the server's 120 s default, pinning a handler
+  thread long after the client gave up. A helper that never RECEIVES
+  the budget (no deadline-ish parameter threads in) cannot derive a
+  correct timeout from it, so its static timeouts are EDL203 too.
+
+Derivation is decided by forward MAY-taint over the function's CFG
+(dataflow.tainted_names): seeds are the request-ish and timeout-ish
+parameters (for nested ``def``s, the enclosing function's seeds are
+closure-visible and carry over), plus any ``<x>.deadline_ms`` read;
+anything assigned from an expression mentioning a tainted name is
+tainted — so ``remaining_ms, timeout = self._budget(request, t0)``
+taints both, and ``min(timeout, remaining)`` stays tainted.
+
+Reachability uses the module call graph (``self.m()`` and
+``self.attr.m()`` with the attribute's class resolved by the project
+index). Heartbeat/poll paths are not dispatch-reachable and keep
+their static poll timeouts without complaint.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.cfg import build_cfg, walk_shallow
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+from elasticdl_tpu.analysis.dataflow import (
+    ModuleIndex,
+    ProjectIndex,
+    mentions,
+    tainted_names,
+)
+
+_ROUTER_METHOD_PREFIXES = ("dispatch", "_dispatch", "_call")
+
+#: parameter names that carry the inbound request / budget
+_REQUESTISH = frozenset(["request", "req", "proto_req"])
+_TIMEOUTISH = frozenset([
+    "timeout", "timeout_secs", "timeout_ms", "deadline", "deadline_ms",
+    "deadline_secs", "remaining", "remaining_ms", "remaining_secs",
+    "budget", "budget_ms",
+])
+
+_DEADLINE_ATTRS = ("deadline_ms", "deadline")
+
+
+def _is_deadline_read(node):
+    return (isinstance(node, ast.Attribute)
+            and node.attr in _DEADLINE_ATTRS)
+
+
+def _param_names(fndef):
+    names = [a.arg for a in fndef.args.args]
+    names.extend(a.arg for a in fndef.args.kwonlyargs)
+    return [n for n in names if n != "self"]
+
+
+def _budget_params(fndef):
+    return frozenset(
+        n for n in _param_names(fndef)
+        if n in _REQUESTISH or n in _TIMEOUTISH
+    )
+
+
+def _reads_deadline(fndef):
+    for node in walk_shallow(fndef):
+        if node is not fndef and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        if _is_deadline_read(node):
+            return True
+    return False
+
+
+def _recv_text(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)).lower()
+
+
+def _entry_methods(index):
+    """[(ClassInfo, fndef, is_edl201_context)] dispatch entries."""
+    out = []
+    for info in index.classes.values():
+        servicer = info.name.endswith("Servicer")
+        router = info.name.endswith("Router")
+        if not (servicer or router):
+            continue
+        for name, fn in info.methods.items():
+            if name == "__init__":
+                continue
+            if router and not servicer and not name.startswith(
+                _ROUTER_METHOD_PREFIXES
+            ):
+                continue
+            out.append((info, fn))
+    return out
+
+
+def _callees(index, info, fndef):
+    """(class_name, method_name) pairs this method may call."""
+    out = []
+    for node in walk_shallow(fndef):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        target = index.resolve_receiver(info, fn.value)
+        if target is not None and fn.attr in target.methods:
+            out.append((target.name, fn.attr))
+    # nested defs are analyzed with their enclosing function; their
+    # calls count as the enclosing function's
+    return out
+
+
+@register
+class DeadlinePropagationRule(Rule):
+    """EDL202/EDL203 — see module docstring. One checker, both ids."""
+
+    id = "EDL202"
+    name = "deadline-propagation"
+
+    def check_module(self, tree, lines, path):
+        index = ProjectIndex([ModuleIndex(tree, path)])
+        entries = _entry_methods(index)
+        if not entries:
+            return []
+
+        # dispatch-reachable closure, seeded by deadline-carrying
+        # entries (an entry with no budget in scope imposes nothing).
+        # EDL201's syntactic surface is EVERY servicer/router-dispatch
+        # method, so the bare missing-timeout case stays EDL201's
+        # there, whether or not the method is a seed.
+        surface = {(info.name, fn.name) for info, fn in entries}
+        reachable = set()
+        work = []
+        for info, fn in entries:
+            if _budget_params(fn) or _reads_deadline(fn):
+                key = (info.name, fn.name)
+                reachable.add(key)
+                work.append(key)
+        while work:
+            cls_name, m_name = work.pop()
+            info = index.classes[cls_name]
+            fn = info.methods[m_name]
+            for callee in _callees(index, info, fn):
+                if callee not in reachable:
+                    reachable.add(callee)
+                    work.append(callee)
+
+        findings = []
+        for key in sorted(reachable):
+            cls_name, m_name = key
+            info = index.classes[cls_name]
+            fn = info.methods[m_name]
+            findings.extend(self._check_function(
+                path, "%s.%s" % (cls_name, m_name), fn,
+                is_entry_context=key in surface,
+                closure_seeds=frozenset(),
+            ))
+        return findings
+
+    def _check_function(self, path, scope, fndef, is_entry_context,
+                        closure_seeds):
+        seeds = _budget_params(fndef) | closure_seeds
+        has_budget = bool(seeds) or _reads_deadline(fndef)
+        cfg = build_cfg(fndef)
+        taint = tainted_names(cfg, seeds, is_source=_is_deadline_read)
+        findings = []
+        nested = [
+            n for n in walk_shallow(fndef)
+            if n is not fndef
+            and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for node in cfg.nodes:
+            for root in node.scan_roots():
+                state = taint.get(node, seeds)
+                for n in walk_shallow(root):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    if not isinstance(n.func, ast.Attribute):
+                        continue
+                    recv = _recv_text(n.func.value)
+                    if "stub" not in recv:
+                        continue
+                    findings.extend(self._check_stub_call(
+                        path, scope, n, recv, state, has_budget,
+                        is_entry_context,
+                    ))
+        # nested defs (the stream-generator idiom): the closure sees
+        # the enclosing seeds PLUS whatever locals are budget-tainted
+        # where the def executes (``budget = request.deadline_ms;
+        # def gen(): ... timeout=budget`` is a correct propagation)
+        for sub in {id(n): n for n in nested}.values():
+            at_def = seeds
+            for node in cfg.nodes:
+                if node.kind == "stmt" and node.payload is sub:
+                    at_def = taint.get(node, seeds) | seeds
+                    break
+            findings.extend(self._check_function(
+                path, "%s.%s" % (scope, sub.name), sub,
+                # lexically inside the parent: EDL201's surface too
+                is_entry_context=is_entry_context,
+                closure_seeds=at_def,
+            ))
+        return findings
+
+    def _check_stub_call(self, path, scope, call, recv, state,
+                         has_budget, is_entry_context):
+        timeout_kw = None
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                timeout_kw = kw
+        detail = "%s.%s" % (recv, call.func.attr)
+        if timeout_kw is None:
+            if is_entry_context:
+                return  # EDL201 owns the bare case in entry contexts
+            yield Finding(
+                "EDL202", path, call.lineno, scope, detail,
+                "stub RPC drops the inbound deadline: no timeout= on "
+                "a dispatch-reachable call — the remaining client "
+                "budget must flow into every downstream RPC",
+            )
+            return
+        value = timeout_kw.value
+        derived = (
+            mentions(value, state)
+            or any(_is_deadline_read(n) for n in ast.walk(value))
+        )
+        if derived:
+            return
+        if has_budget:
+            msg = ("stub RPC replaces the inbound deadline with an "
+                   "unbounded/static default: timeout= does not "
+                   "derive from the request's remaining budget "
+                   "(decrement and forward it instead)")
+        else:
+            msg = ("stub RPC in a dispatch-reachable helper uses a "
+                   "static timeout, but the inbound deadline is never "
+                   "threaded into this helper — pass the remaining "
+                   "budget through and derive timeout= from it")
+        yield Finding("EDL203", path, call.lineno, scope, detail, msg)

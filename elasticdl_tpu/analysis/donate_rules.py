@@ -1,0 +1,289 @@
+"""C10 — donated-buffer aliasing (EDL104).
+
+``jax.jit(f, donate_argnums=(0,))`` tells XLA it may DESTROY the
+argument's buffer and reuse its memory for the output — the whole
+point of donating the optimizer state (no copy per step). The
+contract: the caller must never touch the donated value again. A read
+after the call either crashes ("array has been deleted") or — under
+a backend that copies instead — silently un-does the optimization.
+Correct idiom: rebind the name (``state = step(state, batch)``).
+
+The rule resolves donated wrappers LEXICALLY, matching the codebase's
+two idioms:
+
+* ``step = jax.jit(train_step, donate_argnums=(0,))`` — a wrapper
+  bound to a local/module name (also ``self._fn = jax.jit(...)``,
+  matched by receiver spelling within the same function);
+* ``@partial(jax.jit, donate_argnums=(0,))`` / ``@jax.jit(...)``
+  decorators — calls to the decorated name.
+
+At each call of a donated wrapper, an argument in a donated position
+(``donate_argnums`` index or ``donate_argnames`` keyword) that is a
+plain Name is DEAD after the call: any read of that name reachable in
+the CFG without an intervening rebind is flagged. ``x = f(x)`` is
+clean (the rebind happens at the call); cross-function flows (a
+wrapper built in one method, called in another) are out of scope —
+resolving them would need return-type tracking, and a wrong guess
+here means noise on every training step.
+
+Computed declarations (``donate_argnums=ns``) fall back to "nothing
+donated" rather than "everything donated": this rule's findings read
+as "this line crashes under donation", so precision beats recall.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.cfg import build_cfg, walk_shallow
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+
+_JIT_TAILS = {"jit", "pjit"}
+
+
+def _tail(fn):
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _const_seq(node):
+    """Literal int/str or tuple/list of literals, else None."""
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+        isinstance(e, ast.Constant) for e in node.elts
+    ):
+        return [e.value for e in node.elts]
+    return None
+
+
+class _DonateSpec(object):
+    __slots__ = ("argnums", "argnames", "line")
+
+    def __init__(self, argnums, argnames, line):
+        self.argnums = argnums
+        self.argnames = argnames
+        self.line = line
+
+
+def _donate_spec(call):
+    """_DonateSpec for a jit(...) call carrying donate declarations,
+    None otherwise (including undecidable computed declarations)."""
+    if _tail(call.func) not in _JIT_TAILS:
+        return None
+    argnums, argnames = [], []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            vals = _const_seq(kw.value)
+            if vals is None:
+                return None
+            argnums.extend(int(v) for v in vals)
+        elif kw.arg == "donate_argnames":
+            vals = _const_seq(kw.value)
+            if vals is None:
+                return None
+            argnames.extend(str(v) for v in vals)
+    if not argnums and not argnames:
+        return None
+    return _DonateSpec(tuple(argnums), tuple(argnames), call.lineno)
+
+
+def _target_text(tgt):
+    """'name' or 'self.attr' spelling for wrapper-binding targets."""
+    if isinstance(tgt, ast.Name):
+        return tgt.id
+    parts = []
+    node = tgt
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _callee_text(fn):
+    return _target_text(fn)
+
+
+def _walk_scope(stmts):
+    """Walk statements of ONE scope: compound statements (if/try/for/
+    with) are entered, nested function/class bodies are not — a
+    wrapper bound inside them is not visible at this level. The
+    def/class node itself IS yielded (its decorators belong here)."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _collect_wrappers(scope_stmts):
+    """{spelling: _DonateSpec} for donated wrappers bound in these
+    statements (assignment form) plus decorated functions."""
+    wrappers = {}
+    for stmt in scope_stmts:
+        for node in _walk_scope([stmt]):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                spec = _donate_spec(node.value)
+                if spec is None:
+                    continue
+                for tgt in node.targets:
+                    text = _target_text(tgt)
+                    if text:
+                        wrappers[text] = spec
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    spec = _donate_spec(dec)
+                    if spec is None and dec.args and _tail(
+                        dec.func
+                    ) == "partial":
+                        inner = ast.Call(
+                            func=dec.args[0], args=[],
+                            keywords=dec.keywords,
+                        )
+                        inner.lineno = dec.lineno
+                        spec = _donate_spec(inner)
+                    if spec is not None:
+                        wrappers[node.name] = spec
+    return wrappers
+
+
+def _donated_args(call, spec):
+    """Names passed at donated positions of this call."""
+    out = []
+    for i in spec.argnums:
+        if 0 <= i < len(call.args) and isinstance(
+            call.args[i], ast.Name
+        ):
+            out.append(call.args[i].id)
+    for kw in call.keywords:
+        if kw.arg in spec.argnames and isinstance(kw.value, ast.Name):
+            out.append(kw.value.id)
+    return out
+
+
+def _rebinds(node, name):
+    """Does this CFG node rebind `name` (killing the dead value)?"""
+    for root in node.scan_roots():
+        stmt = root
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name) and n.id == name:
+                        return True
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            pass  # ITER nodes pass the stmt; handled via kind below
+    if node.kind == "iter":
+        for n in ast.walk(node.payload.target):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+    return False
+
+
+def _reads(node, name, skip_call):
+    """Line of a read of `name` at this node (ignoring `skip_call`,
+    the donating call itself), else None."""
+    for root in node.scan_roots():
+        for n in walk_shallow(root):
+            if n is skip_call:
+                continue
+            if (isinstance(n, ast.Name) and n.id == name
+                    and isinstance(n.ctx, ast.Load)):
+                return n.lineno
+    return None
+
+
+@register
+class DonateAliasRule(Rule):
+    """EDL104 — see module docstring."""
+
+    id = "EDL104"
+    name = "donated-buffer-aliasing"
+
+    def check_module(self, tree, lines, path):
+        findings = []
+        module_wrappers = _collect_wrappers(tree.body)
+        for fndef in self._functions(tree):
+            wrappers = dict(module_wrappers)
+            wrappers.update(_collect_wrappers(fndef.body))
+            if wrappers:
+                findings.extend(
+                    self._check_function(fndef, wrappers, path)
+                )
+        return findings
+
+    @staticmethod
+    def _functions(tree):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield node
+
+    def _check_function(self, fndef, wrappers, path):
+        cfg = build_cfg(fndef)
+        for node in cfg.nodes:
+            for root in node.scan_roots():
+                for n in walk_shallow(root):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    spelling = _callee_text(n.func)
+                    spec = wrappers.get(spelling)
+                    if spec is None:
+                        continue
+                    for name in _donated_args(n, spec):
+                        if self._immediately_rebound(root, n, name):
+                            continue
+                        line = self._read_after(cfg, node, n, name)
+                        if line is not None:
+                            yield Finding(
+                                "EDL104", path, line, fndef.name,
+                                name,
+                                "%r was donated to %s (donate_arg"
+                                "nums/argnames) at line %d — its "
+                                "buffer may already be deleted; "
+                                "rebind the result to the same name "
+                                "or stop donating" % (
+                                    name, spelling, n.lineno,
+                                ),
+                            )
+
+    @staticmethod
+    def _immediately_rebound(stmt, call, name):
+        """``x = f(x)`` / ``x, y = f(x)``: the donating statement
+        itself rebinds the name."""
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            for tgt in stmt.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name) and n.id == name:
+                        return True
+        return False
+
+    @staticmethod
+    def _read_after(cfg, call_node, call, name):
+        """First read of `name` CFG-reachable from the donating call
+        without an intervening rebind; None if no path reads it."""
+        seen = set()
+        stack = list(call_node.succ)
+        while stack:
+            node = stack.pop()
+            if node.idx in seen:
+                continue
+            seen.add(node.idx)
+            line = _reads(node, name, call)
+            if line is not None:
+                return line
+            if _rebinds(node, name):
+                continue
+            stack.extend(node.out)
+        return None

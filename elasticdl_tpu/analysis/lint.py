@@ -14,14 +14,25 @@ Options:
                      must edit into a real justification — the runner
                      rejects empty reasons)
   --select IDS       comma-separated rule ids to run (default: all);
-                     EDL001 selects EDL002 too (one checker), EDL101
-                     selects EDL102/EDL103
+                     selecting any id of a checker selects the whole
+                     checker (EDL001 -> EDL002, EDL202 -> EDL203, ...)
+  --jobs N           fan per-file analysis over N processes (0 = one
+                     per CPU); repo-level rules stay in-process and
+                     output is byte-identical to serial
+  --changed-only     lint only files changed vs the git merge base
+                     (plus untracked files) — the pre-commit mode.
+                     Stale-baseline enforcement is skipped: a subset
+                     scan cannot see every vetted finding
+  --format FMT       `human` (default) or `github` (GitHub Actions
+                     ::error annotations, rendered inline on PRs)
   --list-rules       print the rule catalogue and exit
 """
 
 import argparse
 import os
+import subprocess
 import sys
+import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)
@@ -31,10 +42,15 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 #: when ANY of its ids is selected)
 RULE_FAMILIES = {
     "EDL001": ("EDL001", "EDL002"),
+    "EDL003": ("EDL003",),
+    "EDL004": ("EDL004",),
     "EDL101": ("EDL101", "EDL102", "EDL103"),
+    "EDL104": ("EDL104",),
     "EDL201": ("EDL201",),
+    "EDL202": ("EDL202", "EDL203"),
     "EDL301": ("EDL301",),
     "EDL401": ("EDL401",),
+    "EDL501": ("EDL501",),
 }
 
 DEFAULT_PATHS = ("elasticdl_tpu", "scripts", "tests")
@@ -56,6 +72,59 @@ def _selected_rules(select):
     return picked
 
 
+def changed_files(root, base=None):
+    """Python files changed vs the merge base with `base` (tries
+    origin/main, main, then HEAD~1) plus untracked ones — the
+    pre-commit / fast-CI file set. Paths are absolute. Returns None
+    when git is unavailable (caller falls back to a full run)."""
+
+    def git(*args):
+        out = subprocess.run(
+            ("git", "-C", root) + args,
+            capture_output=True, text=True, timeout=30,
+        )
+        if out.returncode != 0:
+            return None
+        return out.stdout
+
+    merge_base = None
+    for ref in ([base] if base else ["origin/main", "main", "HEAD~1"]):
+        mb = git("merge-base", "HEAD", ref)
+        if mb:
+            merge_base = mb.strip()
+            break
+    names = []
+    if merge_base:
+        diff = git("diff", "--name-only", merge_base, "--", "*.py")
+        if diff is None:
+            return None
+        names.extend(diff.splitlines())
+    else:
+        diff = git("diff", "--name-only", "HEAD", "--", "*.py")
+        if diff is None:
+            return None
+        names.extend(diff.splitlines())
+    untracked = git("ls-files", "--others", "--exclude-standard",
+                    "--", "*.py")
+    if untracked:
+        names.extend(untracked.splitlines())
+    return sorted({
+        os.path.join(root, n) for n in names
+        if n.strip() and os.path.exists(os.path.join(root, n))
+    })
+
+
+def _print_finding(finding, fmt):
+    if fmt == "github":
+        # GitHub Actions annotation: renders inline on the PR diff
+        print("::error file=%s,line=%d,title=%s::%s [%s] %s" % (
+            finding.path, finding.line, finding.rule, finding.rule,
+            finding.scope, finding.message.replace("\n", " "),
+        ))
+    else:
+        print(finding.format())
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="edl-lint", description=__doc__,
@@ -65,6 +134,14 @@ def main(argv=None):
     parser.add_argument("--baseline", default=None)
     parser.add_argument("--write-baseline", action="store_true")
     parser.add_argument("--select", default="")
+    parser.add_argument("--jobs", type=int, default=1,
+                        help="processes for per-file analysis "
+                             "(0 = cpu count)")
+    parser.add_argument("--changed-only", action="store_true")
+    parser.add_argument("--base", default=None,
+                        help="merge-base ref for --changed-only")
+    parser.add_argument("--format", dest="fmt", default="human",
+                        choices=("human", "github"))
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument("--root", default=REPO_ROOT,
                         help=argparse.SUPPRESS)
@@ -89,7 +166,30 @@ def main(argv=None):
     if root not in sys.path:
         sys.path.insert(0, root)  # for scripts.gen_serving_proto
 
-    findings, errors = run_rules(paths, rules=rules, root=root)
+    subset_scan = False
+    if args.changed_only:
+        changed = changed_files(root, base=args.base)
+        if changed is None:
+            print("edl-lint: --changed-only needs git; running the "
+                  "full set", file=sys.stderr)
+        else:
+            wanted = tuple(os.path.abspath(p) for p in paths)
+            paths = [
+                f for f in changed
+                if any(f == w or f.startswith(w + os.sep)
+                       for w in wanted)
+            ]
+            subset_scan = True
+            if not paths:
+                print("edl-lint: no changed python files under the "
+                      "linted paths")
+                return 0
+
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
+    t0 = time.monotonic()
+    findings, errors = run_rules(paths, rules=rules, root=root,
+                                 jobs=jobs)
+    elapsed = time.monotonic() - t0
     for err in errors:
         print("edl-lint: ERROR %s" % err, file=sys.stderr)
 
@@ -109,27 +209,35 @@ def main(argv=None):
 
     baseline = Baseline.load(baseline_path)
     findings, stale = baseline.apply(findings)
+    if subset_scan:
+        # a subset scan cannot distinguish "fixed" from "not scanned"
+        stale = []
 
     for f in findings:
-        print(f.format())
+        _print_finding(f, args.fmt)
     for e in stale:
-        print(
-            "edl-lint: STALE baseline entry %s %s [%s] %s — the "
-            "finding it vetted is gone; delete the entry"
-            % (e["rule"], e["path"], e["scope"], e["detail"]),
-            file=sys.stderr,
-        )
+        msg = ("STALE baseline entry %s %s [%s] %s — the finding it "
+               "vetted is gone; delete the entry"
+               % (e["rule"], e["path"], e["scope"], e["detail"]))
+        if args.fmt == "github":
+            print("::error file=%s,title=stale-baseline::%s"
+                  % (e["path"], msg))
+        else:
+            print("edl-lint: %s" % msg, file=sys.stderr)
     n_base = len(baseline.entries) - len(stale)
     if findings or stale or errors:
         print(
             "edl-lint: %d finding(s), %d stale baseline entr(ies), "
-            "%d error(s)" % (len(findings), len(stale), len(errors)),
+            "%d error(s) in %.1fs"
+            % (len(findings), len(stale), len(errors), elapsed),
             file=sys.stderr,
         )
         return 1
     print(
         "edl-lint: clean (%d rule checker(s), %d baselined "
-        "exception(s))" % (len(rules), n_base)
+        "exception(s), %.1fs%s)"
+        % (len(rules), n_base, elapsed,
+           ", %d jobs" % jobs if jobs > 1 else "")
     )
     return 0
 

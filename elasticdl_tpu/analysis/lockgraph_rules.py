@@ -1,0 +1,439 @@
+"""C6/C7 — lock-graph rules: EDL003 lock-order deadlock detection and
+EDL004 wrong-lock-held.
+
+**EDL003** builds the global lock-acquisition graph: a node per lock
+attribute ``(Class, _lock)``, an edge A→B whenever B is acquired while
+A is held — directly (nested ``with`` blocks) or through method calls
+(``self.m()``, ``self.attr.m()`` with the attribute's class resolved
+by the project index, local aliases of either, and ``ClassName(...)``
+construction), with each callee's TRANSITIVELY acquired locks computed
+by a fixpoint over the call graph. Any cycle is a potential deadlock:
+
+* a self-edge on a non-reentrant ``Lock`` is the re-entry deadlock —
+  the PR 5 shape where ``report`` held the dispatcher lock while
+  ``complete_task`` → ``try_to_create_new_job`` → ``create_tasks``
+  re-acquired it;
+* a multi-node cycle is the classic AB/BA ordering deadlock across
+  objects (dispatcher→evaluation-service edges meeting
+  evaluation-service→dispatcher edges).
+
+``RLock``/``Condition`` (reentrant by default) self-edges are fine and
+never reported. The rule runs per-module (everything resolvable inside
+one file, which is what the fixtures exercise) AND repo-wide
+(`check_repo`, where cross-module bindings let dispatcher↔eval-service
+chains resolve); repo-level reporting skips cycles wholly inside one
+module to avoid duplicating the per-module findings.
+
+**EDL004** — for a class holding TWO OR MORE locks, infer each
+guarded attribute's lock BINDING: the lock(s) held by every locked
+write, or — when the writes disagree, which is precisely the buggy
+case — the strict-majority lock (a single wrong-lock write must not
+dissolve the binding that convicts it; with no majority the binding
+is ambiguous and the rule stays quiet). An access (read or write)
+holding a non-empty lock set DISJOINT from the binding is guarded by
+the wrong lock — invisible to EDL001/002, which treat any held lock
+as safe.
+Unlocked accesses stay EDL001/002's business; ``*_locked`` methods are
+skipped (the convention does not say WHICH lock the caller holds) and
+``__init__`` is single-threaded by construction.
+
+Deliberately not modeled: lock acquisitions inside nested ``def``s
+(they run later, usually on another thread — their nesting context is
+not this function's), ``acquire()``-method locking (the codebase idiom
+is ``with``), and receivers that do not resolve through the project
+index (unresolvable = silent, never a guess).
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.cfg import walk_shallow
+from elasticdl_tpu.analysis.core import (
+    Finding,
+    Rule,
+    iter_python_files,
+    register,
+)
+from elasticdl_tpu.analysis.dataflow import (
+    ModuleIndex,
+    ProjectIndex,
+    _self_attr,
+)
+from elasticdl_tpu.analysis.lock_rules import _MUTATORS
+
+
+def _lock_in_item(expr, info, classes):
+    """Lock key (class_name, attr) for a with-item context expression:
+    ``self._x`` or ``ClassName._x``."""
+    attr = _self_attr(expr)
+    if attr is not None and attr in info.lock_attrs:
+        return (info.name, attr)
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)):
+        cls = classes.get(expr.value.id)
+        if cls is not None and expr.attr in cls.lock_attrs:
+            return (cls.name, expr.attr)
+    return None
+
+
+class _MethodLockScan(object):
+    """One pass over a method: every lock acquisition and every call
+    site, each with the set of locks HELD at that point."""
+
+    def __init__(self, index, info, fn):
+        self.index = index
+        self.info = info
+        self.fn = fn
+        self.aliases = {}     # local name -> ("selfattr", attr)
+        self.acquires = []    # (lockkey, heldset frozenset, line)
+        self.calls = []       # ((class, method), heldset, line)
+        self.accesses = []    # (attr, line, is_write, heldset)
+        entry = frozenset()
+        if fn.name.endswith("_locked"):
+            single = info.single_lock()
+            if single:
+                entry = frozenset([(info.name, single)])
+        self._scan_alias_prepass()
+        self._body(fn.body, entry)
+
+    def _scan_alias_prepass(self):
+        for stmt in self.fn.body:
+            self._alias_stmt(stmt)
+
+    def _alias_stmt(self, stmt):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                attr = _self_attr(node.value)
+                if isinstance(tgt, ast.Name) and attr is not None:
+                    self.aliases[tgt.id] = ("selfattr", attr)
+
+    # ------------------------------------------------------------ walk
+
+    def _body(self, stmts, held):
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt, held):
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in stmt.items:
+                self._expr(item.context_expr, held)
+                key = _lock_in_item(item.context_expr, self.info,
+                                    self.index.classes)
+                if key is not None:
+                    self.acquires.append((key, held, stmt.lineno))
+                    acquired.append(key)
+            self._body(stmt.body, held | frozenset(acquired))
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scope: runs later, context unknown
+        for child_stmts, child_exprs in _stmt_parts(stmt):
+            for e in child_exprs:
+                self._expr(e, held)
+            self._body(child_stmts, held)
+
+    def _expr(self, expr, held):
+        if expr is None:
+            return
+        for node in walk_shallow(expr):
+            if isinstance(node, ast.Call):
+                self._call(node, held)
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+                    recv = fn.value
+                    if isinstance(recv, ast.Subscript):
+                        recv = recv.value  # self.x[k].append(...)
+                    attr = _self_attr(recv)
+                    if (attr is not None
+                            and attr not in self.info.lock_attrs):
+                        self.accesses.append(
+                            (attr, node.lineno, True, held)
+                        )
+            elif isinstance(node, ast.Subscript):
+                if not isinstance(node.ctx, ast.Load):
+                    attr = _self_attr(node.value)  # self.x[k] = v
+                    if (attr is not None
+                            and attr not in self.info.lock_attrs):
+                        self.accesses.append(
+                            (attr, node.lineno, True, held)
+                        )
+            elif isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is not None and attr not in self.info.lock_attrs:
+                    self.accesses.append((
+                        attr, node.lineno,
+                        not isinstance(node.ctx, ast.Load), held,
+                    ))
+
+    def _call(self, call, held):
+        fn = call.func
+        callee = None
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            target = self.index.resolve_receiver(
+                self.info, recv, local_aliases=self.aliases
+            )
+            if target is not None and fn.attr in target.methods:
+                callee = (target.name, fn.attr)
+        else:
+            cname = None
+            if isinstance(fn, ast.Name) and fn.id in self.index.classes:
+                cname = fn.id
+            if cname:
+                callee = (cname, "__init__")
+        if callee is not None:
+            self.calls.append((callee, held, call.lineno))
+
+
+def _stmt_parts(stmt):
+    """((nested statement lists), (evaluated expressions)) of one
+    statement — enough structure to keep held-sets correct without a
+    full CFG (lock nesting is lexical in this codebase)."""
+    if isinstance(stmt, ast.If):
+        return [(stmt.body, (stmt.test,)), (stmt.orelse, ())]
+    if isinstance(stmt, ast.While):
+        return [(stmt.body, (stmt.test,)), (stmt.orelse, ())]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [(stmt.body, (stmt.iter,)), (stmt.orelse, ())]
+    if isinstance(stmt, ast.Try) or type(stmt).__name__ == "TryStar":
+        parts = [(stmt.body, ()), (stmt.orelse, ()),
+                 (stmt.finalbody, ())]
+        for h in stmt.handlers:
+            parts.append((h.body, ()))
+        return parts
+    exprs = []
+    for node in ast.iter_child_nodes(stmt):
+        if isinstance(node, ast.expr):
+            exprs.append(node)
+    return [((), tuple(exprs))]
+
+
+# ------------------------------------------------------------ lock graph
+
+
+class LockGraph(object):
+    def __init__(self, index):
+        self.index = index
+        self.kind = {}        # lockkey -> 'lock' | 'rlock' | 'cond'
+        self.edges = {}       # lockkey -> {lockkey}
+        self.evidence = {}    # (a, b) -> (path, line, text)
+        self.scans = {}       # (class, method) -> scan
+        self._build()
+
+    def _build(self):
+        for info in self.index.classes.values():
+            for attr, kind in info.lock_attrs.items():
+                self.kind[(info.name, attr)] = kind
+            for name, fn in info.methods.items():
+                self.scans[(info.name, name)] = _MethodLockScan(
+                    self.index, info, fn
+                )
+        # transitive acquisitions per method
+        acquired = {
+            key: {lk for lk, _h, _l in scan.acquires}
+            for key, scan in self.scans.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for key, scan in self.scans.items():
+                for callee, _held, _line in scan.calls:
+                    extra = acquired.get(callee, ())
+                    before = len(acquired[key])
+                    acquired[key] |= set(extra)
+                    changed = changed or len(acquired[key]) != before
+
+        for key, scan in self.scans.items():
+            info = self.index.classes[key[0]]
+            path = info.path
+            for lk, held, line in scan.acquires:
+                for h in held:
+                    self._edge(h, lk, path, line,
+                               "%s.%s acquires %s under %s"
+                               % (key[0], key[1], _fmt(lk), _fmt(h)))
+            for callee, held, line in scan.calls:
+                if not held:
+                    continue
+                for lk in acquired.get(callee, ()):
+                    for h in held:
+                        self._edge(
+                            h, lk, path, line,
+                            "%s.%s calls %s.%s (which acquires %s) "
+                            "under %s" % (key[0], key[1], callee[0],
+                                          callee[1], _fmt(lk), _fmt(h)),
+                        )
+
+    def _edge(self, a, b, path, line, text):
+        if a == b and self.kind.get(a) in ("rlock", "cond"):
+            return  # reentrant self-acquisition is legal
+        self.edges.setdefault(a, set()).add(b)
+        self.evidence.setdefault((a, b), (path, line, text))
+
+    def cycles(self):
+        """Minimal reportable cycles: self-edges plus one shortest
+        cycle through each edge that closes back (deduplicated by the
+        canonical rotation of the lock sequence)."""
+        out = {}
+        for a, succs in sorted(self.edges.items()):
+            if a in succs:
+                out.setdefault((a,), [a, a])
+        for a in sorted(self.edges):
+            path = self._find_cycle(a)
+            if path:
+                nodes = tuple(path[:-1])
+                start = nodes.index(min(nodes))
+                canon = nodes[start:] + nodes[:start]
+                if len(nodes) > 1:
+                    out.setdefault(canon, path)
+        return out
+
+    def _find_cycle(self, start):
+        # BFS back to start
+        frontier = [(start, [start])]
+        seen = set()
+        while frontier:
+            node, path = frontier.pop(0)
+            for succ in sorted(self.edges.get(node, ())):
+                if succ == start and len(path) > 1:
+                    return path + [start]
+                if succ not in seen and succ != start:
+                    seen.add(succ)
+                    frontier.append((succ, path + [succ]))
+        return None
+
+
+def _fmt(lockkey):
+    return "%s.%s" % lockkey
+
+
+def _cycle_findings(graph, index, skip_single_module=False):
+    findings = []
+    for canon, path in sorted(graph.cycles().items()):
+        classes = {index.classes[c] for c, _a in canon
+                   if c in index.classes}
+        paths = {c.path for c in classes}
+        if skip_single_module and len(paths) <= 1:
+            continue  # check_module already reported it
+        detail = "->".join(_fmt(k) for k in list(canon) + [canon[0]])
+        hops = []
+        line = 0
+        first_path = sorted(paths)[0] if paths else "<unknown>"
+        for i in range(len(path) - 1):
+            ev = graph.evidence.get((path[i], path[i + 1]))
+            if ev:
+                hops.append("%s (%s:%d)" % (ev[2], ev[0], ev[1]))
+                if not line:
+                    line = ev[1]
+                    first_path = ev[0]
+        if len(canon) == 1:
+            msg = ("re-entry deadlock: non-reentrant %s is acquired "
+                   "while already held — %s"
+                   % (_fmt(canon[0]), "; ".join(hops)))
+        else:
+            msg = ("lock-order cycle (potential AB/BA deadlock): %s — %s"
+                   % (detail, "; ".join(hops)))
+        findings.append(Finding(
+            "EDL003", first_path, line, "lock-graph", detail, msg,
+        ))
+    return findings
+
+
+@register
+class LockOrderRule(Rule):
+    """EDL003 — see module docstring."""
+
+    id = "EDL003"
+    name = "lock-order-deadlock"
+
+    def check_module(self, tree, lines, path):
+        index = ProjectIndex([ModuleIndex(tree, path)])
+        if not any(c.lock_attrs for c in index.classes.values()):
+            return []
+        return _cycle_findings(LockGraph(index), index)
+
+    def check_repo(self, root, paths=None):
+        import os
+
+        modules = []
+        for fp in iter_python_files(paths or [root]):
+            try:
+                with open(fp) as f:
+                    tree = ast.parse(f.read(), filename=fp)
+            except (SyntaxError, UnicodeDecodeError, OSError):
+                continue
+            rel = os.path.relpath(fp, root).replace(os.sep, "/")
+            modules.append(ModuleIndex(tree, rel))
+        index = ProjectIndex(modules)
+        if not any(c.lock_attrs for c in index.classes.values()):
+            return []
+        return _cycle_findings(LockGraph(index), index,
+                               skip_single_module=True)
+
+
+@register
+class WrongLockRule(Rule):
+    """EDL004 — see module docstring."""
+
+    id = "EDL004"
+    name = "wrong-lock-held"
+
+    def check_module(self, tree, lines, path):
+        index = ProjectIndex([ModuleIndex(tree, path)])
+        findings = []
+        for info in index.classes.values():
+            if len(info.lock_attrs) < 2:
+                continue
+            findings.extend(self._check_class(index, info, path))
+        return findings
+
+    def _check_class(self, index, info, path):
+        scans = {}
+        for name, fn in info.methods.items():
+            scans[name] = _MethodLockScan(index, info, fn)
+
+        # binding: the lock(s) every locked write holds — or, when the
+        # writes DISAGREE (which is precisely the buggy case: one
+        # writer under the wrong lock), the strict-majority lock, so a
+        # single offending write cannot dissolve the binding that
+        # convicts it. No majority = ambiguous = no binding.
+        write_sets = {}
+        for name, scan in scans.items():
+            if name == "__init__":
+                continue
+            for attr, _line, is_write, held in scan.accesses:
+                if is_write and held:
+                    write_sets.setdefault(attr, []).append(held)
+        binding = {}
+        for attr, sets in write_sets.items():
+            inter = frozenset.intersection(*sets)
+            if inter:
+                binding[attr] = set(inter)
+                continue
+            counts = {}
+            for held in sets:
+                for key in held:
+                    counts[key] = counts.get(key, 0) + 1
+            top = max(sorted(counts), key=lambda k: counts[k])
+            if counts[top] * 2 > len(sets):
+                binding[attr] = {top}
+        for name, scan in scans.items():
+            if name == "__init__" or name.endswith("_locked"):
+                continue
+            scope = "%s.%s" % (info.name, name)
+            for attr, line, is_write, held in scan.accesses:
+                bound = binding.get(attr)
+                if not bound or not held:
+                    continue  # unbound, or EDL001/002's territory
+                if held & bound:
+                    continue
+                yield Finding(
+                    "EDL004", path, line, scope, attr,
+                    "%s of %r under %s, but every locked write binds "
+                    "it to %s — wrong lock held (torn state both "
+                    "sides)" % (
+                        "write" if is_write else "read", attr,
+                        "/".join(sorted(_fmt(k) for k in held)),
+                        "/".join(sorted(_fmt(k) for k in bound)),
+                    ),
+                )

@@ -1,0 +1,331 @@
+"""C8 — must-release resource tracking (EDL501).
+
+Registered acquire/release pairs, checked PATH-SENSITIVELY on the
+function's CFG: from the acquisition point, every path to the
+function's exit — normal return, fall-off-the-end, or an exception
+propagating out — must pass a release (or transfer ownership). The
+PR 4 circuit-breaker probe leak as a lint rule: a HALF_OPEN probe slot
+acquired and then lost on the non-transient-failure branch silently
+evicted a replica from rotation forever.
+
+Two resource shapes:
+
+* **value-bound** — ``x = <something>.start_span(...)``: the HANDLE
+  carries the obligation. Tracked only when assigned to a plain local
+  name (an attribute/subscript target is an immediate ownership
+  transfer). Settled by ``x.<release>()``, by reassigning ``x``, or by
+  ESCAPE: returning/yielding x, passing x as a call argument, storing
+  x anywhere (``self.y = x``, ``d[k] = x``, ``lst = x``), or raising
+  with it — whoever receives the handle owns the release. A
+  return/raise escape settles only the path on which the statement
+  COMPLETES: if its evaluation raises inside a try, the handler paths
+  still carry the obligation (``return f.read()`` does not excuse an
+  ``except`` branch that drops ``f``).
+  Registered: ``start_span``→``finish``, ``open``→``close`` (when not
+  in a ``with``), ``build_channel``→``close``.
+
+* **receiver-bound** — ``rep.begin_dispatch()``: the RECEIVER owns a
+  slot until a paired method releases it. Settled by
+  ``<same receiver>.<release>()`` or by the receiver's BASE name
+  escaping (returned/passed/stored — e.g. ``_acquire_replica`` returns
+  the replica whose breaker probe it holds; the caller inherits the
+  obligation, which is a cross-function contract this rule does not
+  police). ``self.<attr>`` receivers are skipped entirely: their
+  lifecycle is cross-method by design (an allocator owned by the
+  engine seats in ``insert`` and frees on completion).
+  Registered: ``breaker.acquire``→``record_success``/
+  ``record_failure``/``release_probe`` (the three-way settle from
+  PR 4's fix), ``begin_dispatch``→``end_dispatch``,
+  ``begin_poll``→``end_poll``, ``<alloc>.alloc``→``free``.
+
+Guarded acquisition idioms are recognized so the common "probe or
+bail" shape does not false-positive:
+
+    if not rep.breaker.acquire(now):   # acquired ONLY on fall-through
+        return None
+    if rep.breaker.acquire(now):       # acquired ONLY in the body
+        ...
+
+The exception model is cfg.py's selective one: leak paths come from
+explicit control flow (branches, early returns, handlers, re-raises),
+not from "any statement may raise" — that keeps
+``f = open(p); f.read(); f.close()`` quiet while still catching every
+handler branch that forgets to settle.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.cfg import (
+    EXIT,
+    RAISE_EXIT,
+    TEST,
+    build_cfg,
+    walk_shallow,
+)
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+from elasticdl_tpu.analysis.dataflow import leak_paths
+
+#: receiver-bound pairs: acquire attr -> (releases, receiver hint —
+#: a substring the receiver spelling must contain, or None for any)
+RECEIVER_PAIRS = {
+    "acquire": (
+        frozenset(["record_success", "record_failure",
+                   "release_probe"]),
+        "breaker",
+    ),
+    "begin_dispatch": (frozenset(["end_dispatch"]), None),
+    "begin_poll": (frozenset(["end_poll"]), None),
+    "alloc": (frozenset(["free"]), "alloc"),
+}
+
+#: value-bound acquires: callable tail -> release method names
+VALUE_ACQUIRES = {
+    "start_span": frozenset(["finish"]),
+    "open": frozenset(["close"]),
+    "build_channel": frozenset(["close"]),
+}
+
+
+def _recv_text(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        return None  # recorder().x — no stable receiver identity
+    else:
+        return None
+    return ".".join(reversed(parts))
+
+
+def _call_tail(call):
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+class _Obligation(object):
+    __slots__ = ("kind", "name", "recv", "releases", "line", "detail")
+
+    def __init__(self, kind, name, recv, releases, line, detail):
+        self.kind = kind          # "value" | "recv"
+        self.name = name          # local name (value) / base name (recv)
+        self.recv = recv          # receiver spelling (recv kind)
+        self.releases = releases
+        self.line = line
+        self.detail = detail
+
+
+def _value_acquire(stmt):
+    """_Obligation for ``x = <acq>(...)`` statements, else None."""
+    if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+        return None
+    tgt = stmt.targets[0]
+    if not isinstance(tgt, ast.Name):
+        return None  # attribute/subscript target = ownership transfer
+    value = stmt.value
+    if not isinstance(value, ast.Call):
+        return None
+    tail = _call_tail(value)
+    if tail not in VALUE_ACQUIRES:
+        return None
+    if tail == "open" and not isinstance(value.func, ast.Name):
+        return None  # only builtin open(), not x.open()
+    return _Obligation(
+        "value", tgt.id, None, VALUE_ACQUIRES[tail], stmt.lineno,
+        "%s=%s" % (tgt.id, tail),
+    )
+
+
+def _recv_acquires(root):
+    """(call node, _Obligation) for receiver-pair acquires inside an
+    AST subtree (self-receivers and unresolvable receivers skipped)."""
+    out = []
+    for node in walk_shallow(root):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        pair = RECEIVER_PAIRS.get(fn.attr)
+        if pair is None:
+            continue
+        releases, hint = pair
+        recv = _recv_text(fn.value)
+        if not recv or recv == "self" or recv.startswith("self."):
+            continue
+        if hint is not None and hint not in recv:
+            continue
+        base = recv.split(".", 1)[0]
+        out.append((node, _Obligation(
+            "recv", base, recv, releases, node.lineno,
+            "%s.%s" % (recv, fn.attr),
+        )))
+    return out
+
+
+def _settles(node, ob):
+    """How entering `node` settles the obligation: "full" (release
+    call, reassign, store/pass escape — the path ends here), "exit"
+    (``return``/``raise``/``yield`` of the handle — settled only if
+    the statement completes, so exceptional successors stay live), or
+    None."""
+    exit_escape = False
+    for root in node.scan_roots():
+        for n in walk_shallow(root):
+            if isinstance(n, ast.Call):
+                fn = n.func
+                if isinstance(fn, ast.Attribute):
+                    if fn.attr in ob.releases:
+                        if ob.kind == "value":
+                            # also matches a method chain rooted at
+                            # the handle: span.event(...).finish()
+                            if _mentions_name(fn.value, ob.name):
+                                return "full"
+                        else:
+                            if _recv_text(fn.value) == ob.recv:
+                                return "full"
+                # escape: the tracked name reaches a callee through
+                # ANY argument shape (bare, tuple — the
+                # Thread(args=(rep,)) handoff — starred, keyword);
+                # whoever received it owns the release now
+                for arg in list(n.args) + [
+                    kw.value for kw in n.keywords
+                ]:
+                    if _mentions_name(arg, ob.name):
+                        return "full"
+            elif isinstance(n, (ast.Return, ast.Raise)):
+                v = n.value if isinstance(n, ast.Return) else n.exc
+                if v is not None and _mentions_name(v, ob.name):
+                    exit_escape = True
+            elif isinstance(n, (ast.Yield, ast.YieldFrom)):
+                if n.value is not None and _mentions_name(
+                    n.value, ob.name
+                ):
+                    exit_escape = True
+            elif isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name):
+                        if tgt.id == ob.name:
+                            return "full"  # reassigned: obligation gone
+                    elif _mentions_name(n.value, ob.name):
+                        return "full"  # stored somewhere: escaped
+                if ob.kind == "value" and _mentions_name(
+                    n.value, ob.name
+                ) and not (
+                    len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and n.targets[0].id == ob.name
+                ):
+                    return "full"  # aliased into another local
+    return "exit" if exit_escape else None
+
+
+def _mentions_name(expr, name):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id == name:
+            return True
+    return False
+
+
+def _is_exit(node):
+    return node.kind in (EXIT, RAISE_EXIT)
+
+
+def _iter_functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scope_of(tree, fndef):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and fndef in node.body:
+            return "%s.%s" % (node.name, fndef.name)
+    return fndef.name
+
+
+@register
+class MustReleaseRule(Rule):
+    """EDL501 — see module docstring."""
+
+    id = "EDL501"
+    name = "must-release"
+
+    def check_module(self, tree, lines, path):
+        findings = []
+        for fndef in _iter_functions(tree):
+            findings.extend(self._check_function(tree, fndef, path))
+        # findings from duplicated finally copies collapse by line
+        seen = set()
+        out = []
+        for f in findings:
+            key = (f.fingerprint, f.line)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out
+
+    def _check_function(self, tree, fndef, path):
+        cfg = build_cfg(fndef)
+        scope = _scope_of(tree, fndef)
+        obligations = []  # (start nodes, obligation)
+        for node in cfg.nodes:
+            roots = node.scan_roots()
+            if not roots:
+                continue
+            if node.kind == "stmt":
+                ob = _value_acquire(node.payload)
+                if ob is not None:
+                    obligations.append((list(node.succ), ob))
+            for root in roots:
+                for call, ob in _recv_acquires(root):
+                    starts = self._guarded_starts(node, call)
+                    obligations.append(
+                        (starts if starts is not None
+                         else list(node.succ), ob)
+                    )
+        for starts, ob in obligations:
+            leak = leak_paths(
+                starts, lambda n, ob=ob: _settles(n, ob), _is_exit
+            )
+            if leak is not None:
+                how = ("an exception propagates out"
+                       if leak.kind == RAISE_EXIT else
+                       "the function returns")
+                yield Finding(
+                    "EDL501", path, ob.line, scope, ob.detail,
+                    "resource acquired here can reach a path where %s "
+                    "without %s — every acquisition must settle on "
+                    "ALL paths (the PR 4 probe-leak shape); release "
+                    "in a finally or transfer ownership explicitly"
+                    % (how, "/".join(sorted(ob.releases))),
+                )
+
+    @staticmethod
+    def _guarded_starts(node, call):
+        """For ``if [not] <acquire>(...):`` tests, the successors on
+        which the acquisition actually holds; None when the acquire is
+        not a guard (effective on every successor)."""
+        if node.kind != TEST:
+            return None
+        stmt = node.payload
+        test = stmt.test
+        negated = False
+        if isinstance(test, ast.UnaryOp) and isinstance(
+            test.op, ast.Not
+        ):
+            test = test.operand
+            negated = True
+        if test is not call:
+            return None
+        body_first = stmt.body[0] if stmt.body else None
+        true_succs = [s for s in node.succ
+                      if s.payload is body_first]
+        false_succs = [s for s in node.succ if s not in true_succs]
+        return false_succs if negated else true_succs

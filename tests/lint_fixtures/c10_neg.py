@@ -1,0 +1,44 @@
+"""C10 negative fixture — the sanctioned donation idioms: rebinding
+the result to the donated name at the call itself, branch-local
+rebinds before any read, and computed donate declarations (which the
+rule deliberately treats as "nothing donated" — precision over
+recall)."""
+
+from functools import partial
+
+import jax
+
+
+def train_step(state, batch):
+    return state
+
+
+@partial(jax.jit, donate_argnames=("opt_state",))
+def update(params, opt_state, grads):
+    return params, opt_state
+
+
+def train_loop(state0, batches):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    state = state0
+    for batch in batches:
+        state = step(state, batch)  # rebind at the call: clean
+    return state
+
+
+def apply_updates(params, opt_state, grads):
+    params, opt_state = update(params, opt_state=opt_state, grads=grads)
+    return params, opt_state
+
+
+def computed_declaration(fn, ns, state, batch):
+    step = jax.jit(fn, donate_argnums=ns)  # computed: not tracked
+    out = step(state, batch)
+    return out, state
+
+
+def rebind_before_read(state, batch):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    out = step(state, batch)
+    state = out  # rebind kills the dead value before any read
+    return state.loss

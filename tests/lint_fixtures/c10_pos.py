@@ -1,0 +1,41 @@
+"""C10 positive fixture — EDL104 donated-buffer aliasing.
+
+Both wrapper idioms, each followed by a read of the donated value on
+a path with no intervening rebind:
+
+* assignment wrapper (``step = jax.jit(fn, donate_argnums=(0,))``)
+  called in a loop, with the OLD state read after the call;
+* ``@partial(jax.jit, donate_argnames=...)`` decorator, with the
+  donated keyword argument read after the call returns.
+
+Under donation the read either crashes ("array has been deleted") or
+silently forces a copy that un-does the optimization.
+"""
+
+from functools import partial
+
+import jax
+
+
+def train_step(state, batch):
+    return state
+
+
+@partial(jax.jit, donate_argnames=("opt_state",))
+def update(params, opt_state, grads):
+    return params, opt_state
+
+
+def train_loop(state0, batches):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    state = state0
+    for batch in batches:
+        new_state = step(state, batch)
+        loss = new_state.loss + state.loss  # EDL104: state was donated
+        state = new_state
+    return state, loss
+
+
+def apply_updates(params, opt_state, grads):
+    new_params, new_opt = update(params, opt_state=opt_state, grads=grads)
+    return new_params, new_opt, opt_state.step  # EDL104: donated

@@ -1,10 +1,12 @@
 """NEGATIVE fixture for EDL201: the sanctioned forms — every wait
-bounded, every RPC deadlined, the injected sleep, and blocking calls
-in classes outside the servicer/dispatch surface. Expected findings:
-none."""
+bounded, every RPC deadlined, the injected sleep, bounded
+concurrent.futures waits, and blocking calls in classes outside the
+servicer/dispatch surface. Expected findings: none."""
 
 import queue
 import time
+from concurrent import futures
+from concurrent.futures import as_completed
 
 
 class PromptServicer(object):
@@ -22,11 +24,21 @@ class PromptServicer(object):
             return None
 
     def forward(self, request, context=None):
-        return self._stub.generate(request, timeout=5.0)
+        # bounded AND derived from the inbound budget (C9-clean too)
+        return self._stub.generate(
+            request, timeout=request.deadline_ms / 1000.0
+        )
 
     def flush(self, request, context=None):
         self._done.wait(2.0)
         return None
+
+    def gather(self, request, context=None):
+        futs = [self._pool.submit(item) for item in request.items]
+        done, _ = futures.wait(futs, timeout=5.0)
+        for fut in as_completed(futs, timeout=5.0):
+            fut.result(timeout=1.0)
+        return done
 
 
 class BatchWorker(object):
@@ -42,3 +54,8 @@ class BatchWorker(object):
             if item is None:
                 return
             time.sleep(0.0)
+
+    def drain(self, futs):
+        # outside the servicer/dispatch surface: an untimed result()
+        # on a worker thread is the owner's choice
+        return [f.result() for f in futs]

@@ -1,10 +1,13 @@
 """POSITIVE fixture for EDL201: unbounded blocking inside gRPC
 servicer methods and router dispatch paths. Expected findings:
-EDL201 x5 (time.sleep, queue.get, stub call w/o timeout, .wait(),
-dispatch-path queue.get)."""
+EDL201 x8 (time.sleep, queue.get, stub call w/o timeout, .wait(),
+dispatch-path queue.get, untimed Future.result(), untimed
+futures.wait(), untimed as_completed())."""
 
 import queue
 import time
+from concurrent import futures
+from concurrent.futures import as_completed
 
 
 class SlowServicer(object):
@@ -23,6 +26,13 @@ class SlowServicer(object):
     def flush(self, request, context=None):
         self._done.wait()  # EDL201
         return None
+
+    def gather(self, request, context=None):
+        futs = [self._pool.submit(item) for item in request.items]
+        done = futures.wait(futs)  # EDL201: untimed futures.wait
+        for fut in as_completed(futs):  # EDL201: untimed as_completed
+            fut.result()  # EDL201: untimed Future.result
+        return done
 
 
 class EdgeRouter(object):

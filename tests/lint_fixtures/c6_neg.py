@@ -1,0 +1,53 @@
+"""C6 negative fixture — the FIXED shapes of c6_pos: cross-object
+calls happen OUTSIDE the held lock (the PR 5 fix), reentrant RLock
+self-nesting is legal, and the ``*_locked`` convention composes with
+a public locking wrapper without creating a cycle."""
+
+import threading
+
+
+class EvalSvc(object):
+    def __init__(self, disp):
+        # reentrant BY CHOICE: complete_task -> _maybe_start both lock
+        self._lock = threading.RLock()
+        self._disp = disp
+        self._jobs = []
+
+    def complete_task(self):
+        done = False
+        with self._lock:
+            self._jobs.append("done")
+            done = not self._jobs or True
+            self._maybe_start()  # RLock re-entry: legal
+        if done:
+            # cross-object call OUTSIDE the lock: no edge
+            self._disp.create_tasks("EVALUATION")
+
+    def _maybe_start(self):
+        with self._lock:
+            return len(self._jobs)
+
+
+class Dispatcher(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._todo = []
+        self._svc = EvalSvc(self)
+
+    def create_tasks(self, kind):
+        with self._lock:
+            return self._create_tasks_locked(kind)
+
+    def _create_tasks_locked(self, kind):
+        # caller holds the lock (the *_locked convention): no
+        # re-acquisition happens here
+        self._todo.append(kind)
+        return len(self._todo)
+
+    def report(self, task_id):
+        svc = None
+        with self._lock:
+            self._todo.append(task_id)
+            svc = self._svc
+        # the PR 5 fix: re-entrant chain runs lock-free
+        svc.complete_task()

@@ -1,0 +1,75 @@
+"""C6 positive fixture — EDL003 lock-order deadlock cycles.
+
+Two distinct deadlock shapes the rule must flag:
+
+1. the PR 5 re-entry chain: Dispatcher.report holds the dispatcher's
+   NON-reentrant lock while calling EvalSvc.complete_task, which calls
+   back into Dispatcher.create_tasks — re-acquiring the held lock.
+   (threading.Lock is not reentrant: this deadlocks the reporting
+   thread against itself.)
+2. a classic AB/BA ordering cycle between two sibling locks.
+"""
+
+import threading
+
+
+class EvalSvc(object):
+    def __init__(self, disp):
+        self._lock = threading.RLock()
+        self._disp = disp
+        self._jobs = []
+
+    def complete_task(self):
+        with self._lock:
+            self._jobs.append("done")
+            # EvalSvc._lock -> Dispatcher._lock edge
+            self._disp.create_tasks("EVALUATION")
+
+
+class Dispatcher(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._todo = []
+        self._svc = EvalSvc(self)
+
+    def create_tasks(self, kind):
+        with self._lock:
+            self._todo.append(kind)
+
+    def report(self, task_id):
+        with self._lock:
+            self._todo.append(task_id)
+            # Dispatcher._lock -> (EvalSvc._lock -> Dispatcher._lock):
+            # the re-entry deadlock, reachable interprocedurally
+            self._svc.complete_task()
+
+
+class PairA(object):
+    def __init__(self, pair_b):
+        self._a_lock = threading.Lock()
+        self._pair_b = pair_b  # binds by the camel-case convention
+        self._items = []
+
+    def push(self, x):
+        with self._a_lock:
+            self._items.append(x)
+            self._pair_b.push(x)  # A held, then B acquired
+
+
+class PairB(object):
+    def __init__(self):
+        self._b_lock = threading.Lock()
+        self._items = []
+        self._pair_a = None
+
+    def attach(self, pair_a):
+        self._pair_a = pair_a
+
+    def push(self, x):
+        with self._b_lock:
+            self._items.append(x)
+
+    def drain(self):
+        with self._b_lock:
+            # B held, then A acquired: closes the AB/BA cycle
+            self._pair_a.push("flush")

@@ -1,0 +1,34 @@
+"""C7 negative fixture — correct per-attribute lock binding in a
+two-lock class: each attribute is only ever touched under ITS lock,
+and taking both (ordered) for a consistent snapshot is fine because
+the binding lock is among the held set."""
+
+import threading
+
+
+class Registry(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight_lock = threading.Lock()
+        self._entries = {}
+        self._inflight = 0
+
+    def add(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+
+    def begin(self):
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def end(self):
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def snapshot(self):
+        with self._lock:
+            entries = dict(self._entries)
+            with self._inflight_lock:
+                # both held: the binding lock is in the held set
+                inflight = self._inflight
+        return entries, inflight

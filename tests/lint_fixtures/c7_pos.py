@@ -1,0 +1,39 @@
+"""C7 positive fixture — EDL004 wrong-lock-held.
+
+A two-lock class (the router Replica shape: a registry lock plus a
+fast inflight counter lock). Every locked write binds `_inflight` to
+`_inflight_lock`; `snapshot`/`reset` touch it under `_lock` instead —
+mutual exclusion holds against NEITHER writer, so both sides can tear.
+"""
+
+import threading
+
+
+class Registry(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight_lock = threading.Lock()
+        self._entries = {}
+        self._inflight = 0
+
+    def add(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+
+    def begin(self):
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def end(self):
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def snapshot(self):
+        with self._lock:
+            # wrong lock: _inflight is bound to _inflight_lock
+            return dict(self._entries), self._inflight
+
+    def reset(self):
+        with self._lock:
+            self._entries.clear()
+            self._inflight = 0  # wrong lock: write side

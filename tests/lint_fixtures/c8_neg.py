@@ -1,0 +1,82 @@
+"""C8 negative fixture — every acquisition settles on all paths:
+three-way breaker settle (the PR 4 fix), finally-guarded release,
+guarded acquire with a bail-out branch, and the ownership-transfer
+escapes (return / container store / thread handoff)."""
+
+import threading
+
+
+class ProbeDispatcher(object):
+    def __init__(self, clock):
+        self._clock = clock
+
+    def _transient(self, exc):
+        return isinstance(exc, TimeoutError)
+
+    def _backpressure(self, exc):
+        return isinstance(exc, BlockingIOError)
+
+    def probe_dispatch(self, rep, req):
+        now = self._clock()
+        if not rep.breaker.acquire(now):
+            return None  # never acquired on this path
+        try:
+            resp = rep.stub.generate(req, timeout=1.0)
+        except Exception as e:
+            if self._transient(e):
+                rep.breaker.record_failure(now)
+            elif self._backpressure(e):
+                rep.breaker.record_success()
+            else:
+                rep.breaker.release_probe()  # the PR 4 fix
+            raise
+        rep.breaker.record_success()
+        return resp
+
+
+class SpanScoped(object):
+    def __init__(self, recorder):
+        self._recorder = recorder
+        self._open = {}
+
+    def trace_step(self, item):
+        span = self._recorder.start_span("step", item=item)
+        try:
+            if not item:
+                return 0
+            span.event("ran")
+            return 1
+        finally:
+            span.finish("ok")
+
+    def trace_deferred(self, key):
+        span = self._recorder.start_span("deferred", key=key)
+        self._open[key] = span  # ownership transferred to the map
+        return key
+
+    def trace_handoff(self, rep):
+        rep.begin_dispatch()
+        t = threading.Thread(target=self._finish, args=(rep,))
+        t.start()  # the poll_once shape: the thread owns end_dispatch
+
+    def _finish(self, rep):
+        rep.end_dispatch()
+
+    def pick(self, reps, now):
+        for rep in reps:
+            if rep.breaker.acquire(now):
+                return rep  # caller inherits the probe obligation
+        return None
+
+
+def read_header(path):
+    with open(path) as f:  # context manager releases
+        return f.read(16)
+
+
+def read_header_manual(path):
+    f = open(path)
+    try:
+        return f.read(16)
+    finally:
+        f.close()

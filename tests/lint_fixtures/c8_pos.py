@@ -1,0 +1,52 @@
+"""C8 positive fixture — EDL501 must-release leaks.
+
+1. the PR 4 circuit-breaker probe leak, verbatim shape: the HALF_OPEN
+   probe slot is acquired, and the NON-transient failure branch
+   re-raises without settling — the replica is evicted forever;
+2. a span handle that escapes on no path and is never finished when
+   the early-return path triggers;
+3. a file opened outside ``with`` that a handler branch abandons.
+"""
+
+
+class ProbeDispatcher(object):
+    def __init__(self, clock):
+        self._clock = clock
+
+    def _transient(self, exc):
+        return isinstance(exc, TimeoutError)
+
+    def probe_dispatch(self, rep, req):
+        now = self._clock()
+        if not rep.breaker.acquire(now):
+            return None
+        try:
+            return rep.stub.generate(req, timeout=1.0)
+        except Exception as e:
+            if self._transient(e):
+                rep.breaker.record_failure(now)
+                raise
+            raise  # leak: probe slot never released on this branch
+
+
+class SpanLeaker(object):
+    def __init__(self, recorder):
+        self._recorder = recorder
+
+    def trace_step(self, item):
+        span = self._recorder.start_span("step", item=item)
+        if not item:
+            return 0  # leak: early return skips finish
+        span.event("ran")
+        span.finish("ok")
+        return 1
+
+
+def read_header(path):
+    f = open(path)
+    try:
+        return f.read(16)
+    except OSError:
+        return b""  # leak: handler returns without close
+    finally:
+        pass

@@ -1,0 +1,44 @@
+"""C9 negative fixture — deadlines that FLOW. The entry derives a
+remaining budget from the request, decrements it into helpers and
+nested stream generators, and every downstream stub call's timeout=
+traces back to it. Heartbeat/poll paths are not dispatch-reachable
+and keep their static poll timeouts without complaint."""
+
+
+class FrontendServicer(object):
+    def __init__(self, stub):
+        self._stub = stub
+
+    def generate(self, request, context=None):
+        remaining = request.deadline_ms / 1000.0
+        resp = self._stub.generate(request, timeout=remaining)
+        return resp or self._relay(request, remaining * 0.5)
+
+    def _relay(self, request, budget):
+        # the budget is threaded in and the timeout derives from it
+        return self._stub.generate(request, timeout=min(budget, 10.0))
+
+    def generate_stream(self, request, context=None):
+        budget = request.deadline_ms / 1000.0
+
+        def gen():
+            # closure over a budget-derived local: still derived
+            yield self._stub.generate(request, timeout=budget)
+
+        return gen()
+
+    def heartbeat_poll(self):
+        # no inbound deadline exists here; a static poll bound is the
+        # correct design (lease renewal must not inherit a request's)
+        return self._stub.server_status(None, timeout=2.0)
+
+
+class EdgeRouter(object):
+    def __init__(self, stub):
+        self._stub = stub
+
+    def dispatch(self, request, deadline_ms):
+        spent = 0.25
+        return self._stub.generate(
+            request, timeout=deadline_ms / 1000.0 - spent
+        )

@@ -1,0 +1,49 @@
+"""C9 positive fixture — EDL202/EDL203 deadline propagation.
+
+A servicer entry that RECEIVES a budget (``request.deadline_ms``)
+and then loses it, plus a router dispatch path that replaces its
+explicit budget parameter:
+
+* entry stub call with a static 120 s default while the remaining
+  budget sits in scope (EDL203, "replaced");
+* a helper CLASS the dispatch path flows through — outside EDL201's
+  servicer/router syntactic surface — whose stub call drops the
+  deadline entirely (EDL202) or pins a static one the budget can
+  never reach (EDL203, "never threaded in").
+"""
+
+
+class BackendClient(object):
+    def __init__(self, stub):
+        self._stub = stub
+
+    def call_backend(self, payload):
+        # EDL202: dispatch-reachable helper drops the deadline
+        return self._stub.generate(payload)
+
+    def call_backend_static(self, payload):
+        # EDL203: static timeout; the budget is never threaded in
+        return self._stub.generate(payload, timeout=60.0)
+
+
+class FrontendServicer(object):
+    def __init__(self, stub):
+        self._stub = stub
+        self._client = BackendClient(stub)
+
+    def generate(self, request, context=None):
+        remaining = request.deadline_ms / 1000.0
+        # EDL203: budget in scope, replaced by a static default
+        first = self._stub.generate(request, timeout=120.0)
+        second = self._client.call_backend(request.payload)
+        third = self._client.call_backend_static(request.payload)
+        return first or second or third or remaining
+
+
+class EdgeRouter(object):
+    def __init__(self, stub):
+        self._stub = stub
+
+    def dispatch(self, request, deadline_ms):
+        # EDL203: the caller handed us a deadline; we wait 5 s anyway
+        return self._stub.generate(request, timeout=5.0)

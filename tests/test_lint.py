@@ -81,10 +81,14 @@ def test_c2_negative():
 
 def test_c3_positive():
     findings = lint_file("c3_pos.py")
-    assert rule_ids(findings) == ["EDL201"] * 5, findings
+    assert rule_ids(findings) == ["EDL201"] * 8, findings
     scopes = {f.scope for f in findings}
     assert "EdgeRouter.dispatch_generate" in scopes
     assert "EdgeRouter.housekeeping" not in scopes
+    # the concurrent.futures coverage gap: untimed result()/wait()/
+    # as_completed() in dispatch paths (the PR 4 heartbeat-poll shape)
+    details = {f.detail for f in findings}
+    assert {".result()", "futures.wait", "as_completed"} <= details
 
 
 def test_c3_negative():
@@ -123,6 +127,120 @@ def test_c5_allowed_set_tracks_telemetry_declarations():
     assert "admitted" in declared_counters()
 
 
+# ------------------------------------------ C6: EDL003 lock-order cycles
+
+
+def test_c6_positive_flags_deadlock_cycles():
+    """The synthetic PR 5 deadlock chain: report holds the dispatcher
+    lock while complete_task calls back into create_tasks (a
+    non-reentrant re-entry), plus a classic AB/BA cycle, plus the
+    transitive self-deadlock the AB/BA chain implies."""
+    findings = lint_file("c6_pos.py")
+    assert rule_ids(findings) == ["EDL003"] * 4, findings
+    details = {f.detail for f in findings}
+    assert "Dispatcher._lock->Dispatcher._lock" in details
+    assert "Dispatcher._lock->EvalSvc._lock->Dispatcher._lock" in details
+    assert "PairA._a_lock->PairB._b_lock->PairA._a_lock" in details
+
+
+def test_c6_negative_fixed_shapes_are_clean():
+    """The PR 5 fix shape (cross-object call outside the lock),
+    reentrant RLock self-nesting, and the *_locked convention."""
+    assert lint_file("c6_neg.py") == []
+
+
+# ------------------------------------------- C7: EDL004 wrong-lock-held
+
+
+def test_c7_positive_flags_wrong_lock():
+    findings = lint_file("c7_pos.py")
+    assert rule_ids(findings) == ["EDL004"] * 2, findings
+    assert {(f.scope, f.detail) for f in findings} == {
+        ("Registry.snapshot", "_inflight"),
+        ("Registry.reset", "_inflight"),
+    }
+
+
+def test_c7_negative_bound_accesses_are_clean():
+    assert lint_file("c7_neg.py") == []
+
+
+# ------------------------------------------- C8: EDL501 must-release
+
+
+def test_c8_positive_flags_leaks():
+    """The synthetic PR 4 probe leak (breaker slot lost on the
+    non-transient re-raise), a span lost to an early return, and a
+    file handle dropped by a handler branch."""
+    findings = lint_file("c8_pos.py")
+    assert rule_ids(findings) == ["EDL501"] * 3, findings
+    details = {f.detail for f in findings}
+    assert "rep.breaker.acquire" in details
+    assert "span=start_span" in details
+    assert "f=open" in details
+
+
+def test_c8_negative_settled_paths_are_clean():
+    """The PR 4 fix (three-way settle on every outcome), finally-
+    guarded release, and the ownership-transfer escapes."""
+    assert lint_file("c8_neg.py") == []
+
+
+# ------------------------------ C9: EDL202/EDL203 deadline propagation
+
+
+def test_c9_positive_flags_dropped_and_replaced_deadlines():
+    findings = lint_file("c9_pos.py")
+    assert rule_ids(findings) == ["EDL202", "EDL203", "EDL203",
+                                  "EDL203"], findings
+    by_scope = {f.scope: f.rule for f in findings}
+    assert by_scope["BackendClient.call_backend"] == "EDL202"
+    assert by_scope["BackendClient.call_backend_static"] == "EDL203"
+    assert by_scope["FrontendServicer.generate"] == "EDL203"
+    assert by_scope["EdgeRouter.dispatch"] == "EDL203"
+
+
+def test_c9_negative_derived_timeouts_are_clean():
+    """Decremented budgets, closure-over-budget stream generators, and
+    non-dispatch heartbeat polls with static bounds: all sanctioned."""
+    assert lint_file("c9_neg.py") == []
+
+
+# -------------------------------- C10: EDL104 donated-buffer aliasing
+
+
+def test_c10_positive_flags_read_after_donation():
+    findings = lint_file("c10_pos.py")
+    assert rule_ids(findings) == ["EDL104"] * 2, findings
+    assert {(f.scope, f.detail) for f in findings} == {
+        ("train_loop", "state"),
+        ("apply_updates", "opt_state"),
+    }
+
+
+def test_c10_negative_rebind_idioms_are_clean():
+    assert lint_file("c10_neg.py") == []
+
+
+def test_new_rules_pragma_suppression(tmp_path):
+    """The pragma layer applies to CFG-based rules like any other."""
+    src = os.path.join(FIXTURES, "c7_pos.py")
+    with open(src) as f:
+        text = f.read()
+    text = text.replace(
+        "return dict(self._entries), self._inflight",
+        "return dict(self._entries), self._inflight"
+        "  # edl-lint: disable=EDL004",
+    )
+    mod = tmp_path / "pragma_mod.py"
+    mod.write_text(text)
+    findings, errors = run_rules([str(mod)], root=None, excludes=())
+    assert not errors
+    assert {(f.scope, f.detail) for f in findings} == {
+        ("Registry.reset", "_inflight"),
+    }
+
+
 # --------------------------------------------------- every-rule coverage
 
 
@@ -130,7 +248,9 @@ def test_every_rule_has_fixture_coverage():
     """Meta-test: the fixture battery above exercises every registered
     rule id positively, and every checker has a clean fixture."""
     emitted = set()
-    for name in ("c1_pos.py", "c2_pos.py", "c3_pos.py", "c5_pos.py"):
+    for name in ("c1_pos.py", "c2_pos.py", "c3_pos.py", "c5_pos.py",
+                 "c6_pos.py", "c7_pos.py", "c8_pos.py", "c9_pos.py",
+                 "c10_pos.py"):
         emitted.update(f.rule for f in lint_file(name))
     ast_rule_ids = set()
     for rule in all_rules():
@@ -249,6 +369,88 @@ def test_select_limits_rules(tmp_path):
         "--select", "EDL101",
     ])
     assert rc == 0
+
+
+# ------------------------------------------------ driver modes (v2 CLI)
+
+
+def test_parallel_jobs_output_parity():
+    """--jobs fans per-file analysis over a process pool; findings
+    must be byte-identical to the serial run (same order, same
+    fingerprints) so CI can use either."""
+    paths = [os.path.join(FIXTURES, n)
+             for n in ("c1_pos.py", "c6_pos.py", "c8_pos.py",
+                       "c9_pos.py", "c10_pos.py")]
+    serial, es = run_rules(paths, root=None, excludes=(), jobs=1)
+    fanned, ep = run_rules(paths, root=None, excludes=(), jobs=2)
+    assert not es and not ep
+    assert [f.format() for f in serial] == [f.format() for f in fanned]
+    assert serial, "parity test needs a non-empty finding set"
+
+
+def test_github_format_annotations(tmp_path, capsys):
+    srcdir = tmp_path / "pkg"
+    srcdir.mkdir()
+    shutil.copy(
+        os.path.join(FIXTURES, "c7_pos.py"),
+        str(srcdir / "injected_module.py"),
+    )
+    rc = lint_main([
+        str(srcdir),
+        "--baseline", str(tmp_path / "absent.json"),
+        "--select", "EDL004", "--format", "github",
+    ])
+    assert rc == 1
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.startswith("::error ")]
+    assert len(lines) == 2
+    assert "file=" in lines[0] and "line=" in lines[0]
+    assert "title=EDL004" in lines[0]
+
+
+def test_explicit_file_paths_respect_excludes(tmp_path):
+    """--changed-only hands individual FILES to the runner; excluded
+    paths (fixtures, generated pb2) must stay excluded even when
+    named explicitly, or a fixture edit would fail the gate."""
+    fixture = os.path.join(FIXTURES, "c1_pos.py")
+    findings, errors = run_rules([fixture], root=None)  # default excludes
+    assert findings == [] and errors == []
+
+
+def test_changed_only_merge_base_diff(tmp_path):
+    """changed_files returns tracked-modified plus untracked .py files
+    vs the merge base, as absolute paths."""
+    import subprocess
+
+    from elasticdl_tpu.analysis.lint import changed_files
+
+    repo = str(tmp_path / "repo")
+    os.makedirs(repo)
+
+    def git(*args):
+        subprocess.run(
+            ("git", "-C", repo) + args, check=True,
+            capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-b", "main")
+    with open(os.path.join(repo, "a.py"), "w") as f:
+        f.write("A = 1\n")
+    with open(os.path.join(repo, "b.py"), "w") as f:
+        f.write("B = 1\n")
+    git("add", "-A")
+    git("commit", "-m", "seed")
+    with open(os.path.join(repo, "a.py"), "w") as f:
+        f.write("A = 2\n")          # tracked, modified
+    with open(os.path.join(repo, "c.py"), "w") as f:
+        f.write("C = 1\n")          # untracked
+    changed = changed_files(repo, base="main")
+    assert changed == [
+        os.path.join(repo, "a.py"), os.path.join(repo, "c.py"),
+    ]
 
 
 # ------------------------------------------------- C4: proto drift gate

@@ -222,6 +222,7 @@ def test_replica_load_score_reads_inflight_under_lock():
     score = rep.load_score()
     assert lock.acquisitions == before + 1
     assert score == 2 + 1 + 1 + 100.0 / 50.0
+    rep.end_dispatch()
 
 
 # ------------------------------------------------------ TaskDataService

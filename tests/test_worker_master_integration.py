@@ -182,10 +182,12 @@ def test_worker_failure_task_recovery(mnist_dirs):
         from elasticdl_tpu.proto.service import MasterStub, build_channel
 
         chan = build_channel("localhost:%d" % master.port)
-        stub = MasterStub(chan)
-        task = stub.get_task(pb.GetTaskRequest(worker_id=0))
-        assert task.shard_name
-        chan.close()
+        try:
+            stub = MasterStub(chan)
+            task = stub.get_task(pb.GetTaskRequest(worker_id=0))
+            assert task.shard_name
+        finally:
+            chan.close()
         # master notices the death (simulating the instance-manager event)
         master.task_d.recover_tasks(0)
 
