@@ -80,12 +80,18 @@ drill:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_router_chaos_drill.py
 
 # Serving smoke: closed-loop load against the real continuous-batching
-# server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput) —
-# dense pool A/B'd against the block-paged pool at EQUAL KV bytes
-# (kv_bytes/blocks + bytes-per-token recorded under "kv"/"paged")
+# server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput).
+# The shared-prefix workload (a pool of common system prompts + random
+# suffixes) runs FOUR ways at EQUAL KV bytes: dense, block-paged
+# (private), paged + refcounted prefix sharing, and paged + sharing +
+# speculative decode (draft_k) — bytes-per-token, prefix-hit tokens,
+# CoW copies and the draft accept rate recorded under
+# "kv"/"paged"/"paged_shared"/"paged_shared_spec"
 serve-smoke:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py \
 		--requests 16 --rate 32 --compare_paged --kv_block_size 4 \
+		--shared_prefix --prefix_len 16 --suffix_len 1:4 \
+		--out_len 4:12 --draft_k 2 \
 		--out BENCH_SERVING.json
 
 ci-fast: lint test-fast

@@ -36,7 +36,10 @@ Two resource shapes:
   Registered: ``breaker.acquire``→``record_success``/
   ``record_failure``/``release_probe`` (the three-way settle from
   PR 4's fix), ``begin_dispatch``→``end_dispatch``,
-  ``begin_poll``→``end_poll``, ``<alloc>.alloc``→``free``.
+  ``begin_poll``→``end_poll``, ``<alloc>.alloc``→``free``, and the
+  prefix-shared pool's refcount pairs ``<alloc>.incref``/``share``/
+  ``cow``→``decref``/``free`` (a leaked block reference pins arena
+  rows forever; the CoW draw owns its copy like any table block).
 
 Guarded acquisition idioms are recognized so the common "probe or
 bail" shape does not false-positive:
@@ -76,6 +79,17 @@ RECEIVER_PAIRS = {
     "begin_dispatch": (frozenset(["end_dispatch"]), None),
     "begin_poll": (frozenset(["end_poll"]), None),
     "alloc": (frozenset(["free"]), "alloc"),
+    # the prefix-shared paged KV pool's refcount discipline
+    # (serving/kv_pool.py): a block reference taken by incref (or a
+    # whole shared chain seated by share/seat) must drop via decref or
+    # the slot-level free on EVERY path — a leaked refcount pins the
+    # block (and its arena rows) forever
+    "incref": (frozenset(["decref", "free"]), "alloc"),
+    "share": (frozenset(["decref", "free"]), "alloc"),
+    # a CoW fault draws a block from the slot's reservation; the copy
+    # is owned like any other table block and must settle through the
+    # same decref/free discipline
+    "cow": (frozenset(["decref", "free"]), "alloc"),
 }
 
 #: value-bound acquires: callable tail -> release method names

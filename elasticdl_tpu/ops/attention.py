@@ -313,38 +313,55 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
 
 def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
                            length, scale=None, window=None):
-    """Single-token decode attention over a BLOCK-PAGED KV pool.
+    """Decode attention over a BLOCK-PAGED KV pool for a tile of
+    1 <= t new query tokens per sequence.
 
     The serving engine's paged pool (serving/kv_pool.py) stores every
     sequence's cached keys/values as fixed-size blocks scattered through
     one shared `[num_blocks, block_size, kv_heads, head_dim]` arena per
     layer; a sequence's logical cache is its BLOCK TABLE — the ordered
     block ids covering positions `[j*block_size, (j+1)*block_size)`.
-    This op attends a sequence's single new query over exactly that
-    table, streaming one block at a time through the same online-softmax
+    This op attends a sequence's query TILE over exactly that table,
+    streaming one block at a time through the same online-softmax
     merge `blockwise_attention` scans with (softmax_merge /
     softmax_finalize), so no contiguous `seq_len` stripe is ever
     gathered or materialized: peak extra memory is ONE block per step.
 
-    q:      [b, h, d]      one query token per sequence
-    k_cur:  [b, hkv, d]    the query token's own key (attended at
-    v_cur:  [b, hkv, d]    position `length`; it is NOT in the pool yet
-                           — the engine scatters it after the step)
+    t = 1 is the classic per-token decode step. t > 1 is the
+    VERIFY-k tile (speculative decode: the target checks k drafted
+    tokens in one step) and the shared-prefix SUFFIX prefill (the
+    unshared tail of a prompt decodes as one tile over the resident
+    prefix blocks) — tile row j sits at absolute position
+    `length + j`, sees every pool row `k_pos < length`, and sees tile
+    keys `j' <= j` (causal within the tile).
+
+    q:      [b, h, t, d]   the tile ([b, h, d] accepted for the t = 1
+                           legacy shape; the result then drops t too)
+    k_cur:  [b, hkv, t, d] the tile's own keys/values (at positions
+    v_cur:  [b, hkv, t, d] `length + j`; NOT in the pool yet — the
+                           engine scatters the committed rows after
+                           the step)
     k_pool: [num_blocks, block_size, hkv, d]   shared arenas
     v_pool: [num_blocks, block_size, hkv, d]
     block_table: [b, m] int32, -1 padded past the allocated blocks
     length: [b] int32  tokens already cached (positions [0, length)
             are live; later rows of a partially-filled block are junk
             and masked, exactly like the dense decode's `k_pos <= pos`)
-    window: sliding-window size (keys at `k_pos > length - window`).
+    window: sliding-window size (row j sees keys at
+            `k_pos > length + j - window`).
 
     Table entries are traced values: block churn and sequence growth
     never recompile the consuming program. k/v may carry fewer heads
     than q (GQA): q heads are grouped under their kv head like the
     dense `_decode_step`, so pool reads scale with hkv. Returns
-    [b, h, d] in float32 (the dense decode path's softmax precision).
-    """
-    b, h, d = q.shape
+    [b, h, t, d] in float32 (the dense decode path's softmax
+    precision)."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, :, None, :]
+        k_cur = k_cur[:, :, None, :]
+        v_cur = v_cur[:, :, None, :]
+    b, h, t, d = q.shape
     hkv = k_cur.shape[1]
     if h % hkv:
         raise ValueError(
@@ -356,10 +373,14 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
     m = block_table.shape[1]
     scale = scale if scale is not None else d ** -0.5
     f32 = jnp.float32
-    # group layout [b, hkv, group, d]: kv head j serves q heads
-    # [j*group, (j+1)*group) — the dense _decode_step's reshape
-    qg = (q * scale).reshape(b, hkv, group, d).astype(f32)
+    # group layout [b, hkv, group, t, d] flattened to a (group*t) query
+    # axis: kv head j serves q heads [j*group, (j+1)*group) — the dense
+    # _decode_step's reshape — and softmax_merge's [b, h, q, k]
+    # contract applies as-is with hkv as the head axis
+    qg = (q * scale).reshape(b, hkv, group, t, d).astype(f32)
+    qf = qg.reshape(b, hkv, group * t, d)
     length = jnp.asarray(length, jnp.int32)
+    row_pos = length[:, None] + jnp.arange(t)[None, :]  # [b, t]
 
     def step(carry, j):
         o, l, mx = carry
@@ -367,29 +388,45 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
         safe = jnp.maximum(bid, 0)  # gather clamps; validity masks below
         kb = k_pool[safe].astype(f32)  # [b, block_size, hkv, d]
         vb = v_pool[safe].astype(f32)
-        # treat hkv as the head axis and the q-head group as the query
-        # axis, so softmax_merge's [b, h, q, k] contract applies as-is
-        s = jnp.einsum("bhgd,bkhd->bhgk", qg, kb)  # [b, hkv, group, bs]
+        s = jnp.einsum("bhqd,bkhd->bhqk", qf, kb)  # [b, hkv, g*t, bs]
         k_pos = j * block_size + jnp.arange(block_size)[None, :]
-        valid = (k_pos < length[:, None]) & (bid >= 0)[:, None]
+        valid = (k_pos < length[:, None]) & (bid >= 0)[:, None]  # [b,bs]
+        valid = jnp.broadcast_to(valid[:, None, :], (b, t, block_size))
         if window is not None:
-            valid = valid & (k_pos > (length - window)[:, None])
-        s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
+            valid = valid & (
+                k_pos[:, None, :] > (row_pos - window)[..., None]
+            )
+        # [b, t, bs] -> [b, 1, group, t, bs] -> flatten the query axis
+        vt = jnp.broadcast_to(
+            valid[:, None, None], (b, hkv, group, t, block_size)
+        ).reshape(b, hkv, group * t, block_size)
+        s = jnp.where(vt, s, _NEG_INF)
         return softmax_merge(o, l, mx, s, vb.transpose(0, 2, 1, 3)), None
 
-    o0 = jnp.zeros((b, hkv, group, d), f32)
-    l0 = jnp.zeros((b, hkv, group), f32)
-    m0 = jnp.full((b, hkv, group), _NEG_INF, f32)
+    o0 = jnp.zeros((b, hkv, group * t, d), f32)
+    l0 = jnp.zeros((b, hkv, group * t), f32)
+    m0 = jnp.full((b, hkv, group * t), _NEG_INF, f32)
     (o, l, mx), _ = jax.lax.scan(step, (o0, l0, m0), jnp.arange(m))
-    # the current token attends to itself at position `length` (always
-    # inside any window >= 1) — merged as a one-key block
+    # the tile attends to itself causally: key j' (position
+    # length + j') is visible to row j iff j' <= j (the diagonal is
+    # always inside any window >= 1) — merged as one t-key block
     s_cur = jnp.einsum(
-        "bhgd,bhd->bhg", qg, k_cur.astype(f32)
-    )[..., None]  # [b, hkv, group, 1]
+        "bhqd,bhkd->bhqk", qf, k_cur.astype(f32)
+    )  # [b, hkv, g*t, t]
+    tile = jnp.arange(t)
+    tri = tile[:, None] >= tile[None, :]  # [t_q, t_k] causal
+    if window is not None:
+        tri = tri & (tile[:, None] - tile[None, :] < window)
+    trif = jnp.broadcast_to(
+        tri[None, :, :], (group, t, t)
+    ).reshape(group * t, t)
+    s_cur = jnp.where(trif[None, None], s_cur, _NEG_INF)
     o, l, mx = softmax_merge(
-        o, l, mx, s_cur, v_cur.astype(f32)[:, :, None, :]
+        o, l, mx, s_cur, v_cur.astype(f32)  # already [b, hkv, t, d]
     )
-    return softmax_finalize(o, l).reshape(b, h, d)
+    out = softmax_finalize(o, l).reshape(b, hkv, group, t, d)
+    out = out.reshape(b, h, t, d)
+    return out[:, :, 0, :] if squeeze else out
 
 
 def _check_window(window, lq, lk):

@@ -79,6 +79,14 @@ def kv_paged_default():
     return os.environ.get("EDL_KV_PAGED", "") not in ("", "0")
 
 
+def kv_shared_default():
+    """EDL_KV_SHARED resolves prefix sharing when the config leaves it
+    unset. Default ON (sharing is strictly a capacity win under the
+    same token-parity contract); EDL_KV_SHARED=0 forces the private
+    paged pool — the A/B leg the bench and drills exercise."""
+    return os.environ.get("EDL_KV_SHARED", "1") not in ("", "0")
+
+
 def _fused_dequant():
     return os.environ.get(
         "EDL_SERVING_FUSED_DEQUANT", "") not in ("", "0")
@@ -112,6 +120,13 @@ class ContinuousBatchingEngine(object):
         self.seq_len = int(model.seq_len)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
+        # optional ServingTelemetry hook (GenerationServer wires it):
+        # the engine reports prefix-share / CoW / draft-accept events
+        # it alone can see; None costs nothing (tests, benches)
+        self.telemetry = None
+        self.draft_k = 0        # speculative decode off (paged engine
+        self.draft_proposed = 0  # overrides when a draft is seated)
+        self.draft_accepted = 0
 
         from elasticdl_tpu.api.quantization import is_quantized
 
@@ -221,11 +236,16 @@ class ContinuousBatchingEngine(object):
         per_slot = self._kv_bytes_total // max(1, self.num_slots)
         return {
             "kv_paged": False,
+            "kv_shared": False,
             "kv_block_size": 0,
             "kv_blocks_total": 0,
             "kv_blocks_free": 0,
+            "kv_blocks_cached": 0,
+            "kv_blocks_shared": 0,
             "kv_bytes_total": self._kv_bytes_total,
             "kv_bytes_in_use": self.active_count() * per_slot,
+            "prefix_hit_tokens": 0,
+            "cow_copies": 0,
         }
 
     def insert(self, request):
@@ -296,8 +316,10 @@ class ContinuousBatchingEngine(object):
         """One vmapped decode step over the WHOLE pool. Every active
         slot advances one token at its own position; free slots run the
         same compute against stale caches and are ignored (static shape,
-        zero recompiles). Returns [(slot, request, token, finished)] for
-        slots that were active; finished slots are freed."""
+        zero recompiles). Returns [(slot, request, tokens, finished)]
+        for slots that were active — `tokens` is the LIST of tokens the
+        step committed for that slot (one here; the speculative paged
+        step can commit several). Finished slots are freed."""
         active = [
             (i, s) for i, s in enumerate(self._slots) if s is not None
         ]
@@ -325,7 +347,7 @@ class ContinuousBatchingEngine(object):
             )
             if finished:
                 self.evict(slot)
-            out.append((slot, st.request, token, finished))
+            out.append((slot, st.request, [token], finished))
         return out
 
     # ------------------------------------------------------- compiled fns
@@ -418,14 +440,37 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     * evict returns the slot's blocks to the free list, O(1) per
       block — copy-free slot churn.
 
-    can_seat() answers from the allocator, turning out-of-blocks into
-    admission-queue backpressure instead of a crash. Requires the
-    model's paged-decode convention (TransformerLM: `paged` kwarg +
-    "kv_out" sowing) and the plain-dtype KV format.
+    PREFIX SHARING (share_prefix=True): the pool keeps a
+    content-addressed index of resident full prompt blocks
+    (serving/kv_pool.py). A request whose prompt prefix matches seats
+    by INCREF — the shared blocks are never re-prefilled; only the
+    unshared suffix runs, as ONE decode tile over the resident prefix
+    (paged_decode_attention's verify-k shape). A full-prompt match
+    re-runs just the last token for its logits; that row's re-write
+    into the shared tail block is the planned COPY-ON-WRITE fault,
+    drawing the CoW credit the seat reserved.
+
+    SPECULATIVE DECODE (draft=(trainer, state), draft_k=k): a small
+    draft model holds a dense per-slot cache pool beside the paged
+    target pool. Each scheduler tick drafts k greedy tokens per slot
+    (k vmapped single-token draft steps) and verifies them in ONE
+    vmapped target step over a (k+1)-token tile; greedy-exact
+    accept/rollback commits 1..k+1 tokens — rolled-back rows are
+    simply never scattered into the block table, and the draft's
+    rollback is counter-only. Sampled (temperature > 0) slots accept
+    nothing and commit exactly the token the plain step would have
+    sampled, so token parity holds for every request either way.
+
+    can_seat() answers from the allocator (prefix matches shrink what
+    a request needs), turning out-of-blocks into admission-queue
+    backpressure instead of a crash. Requires the model's paged-decode
+    convention (TransformerLM: `paged` kwarg + "kv_out" sowing) and
+    the plain-dtype KV format.
     """
 
     def __init__(self, trainer, state, num_slots, top_k=0, top_p=1.0,
-                 block_size=16, num_blocks=0):
+                 block_size=16, num_blocks=0, share_prefix=True,
+                 draft=None, draft_k=0):
         import inspect
 
         model = trainer.model
@@ -448,9 +493,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.num_blocks = int(num_blocks) or (
             int(num_slots) * -(-int(model.seq_len) // self.block_size)
         )
+        self._share = bool(share_prefix)
         super().__init__(trainer, state, num_slots, top_k=top_k,
                          top_p=top_p)
         self._positions = np.zeros(self.num_slots, np.int32)
+        self._suffix_fns = {}  # suffix bucket -> compiled tile prefill
+        self._spec_fn = None
+        self._init_draft(draft, draft_k)
 
     def _init_pool(self):
         from elasticdl_tpu.serving.kv_pool import PagedKVPool
@@ -458,8 +507,71 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.kv = PagedKVPool(
             self._kv_shapes, self.seq_len, self.num_slots,
             self.num_blocks, self.block_size,
+            share_prefix=self._share,
         )
         self._kv_bytes_total = self.kv.bytes_total
+
+    def _init_draft(self, draft, draft_k):
+        """Seat the draft model for speculative decode: its own dense
+        per-slot cache pool (the draft is small — that is the point)
+        beside the paged target pool the reclaimed blocks feed."""
+        self._draft = None
+        if draft is None or int(draft_k) < 1:
+            return
+        d_trainer, d_state = draft
+        d_model = d_trainer.model
+        _require_kv_convention(d_model)
+        if not getattr(d_model, "causal", True):
+            raise ValueError("speculative decode needs a causal draft")
+        if getattr(d_model, "vocab_size", None) != getattr(
+                self.model, "vocab_size", None):
+            raise ValueError(
+                "draft and target must share a vocabulary, got %r vs %r"
+                % (getattr(d_model, "vocab_size", None),
+                   getattr(self.model, "vocab_size", None))
+            )
+        if int(d_model.seq_len) < self.seq_len:
+            raise ValueError(
+                "draft seq_len %d must cover the target's %d"
+                % (d_model.seq_len, self.seq_len)
+            )
+        from elasticdl_tpu.api.quantization import is_quantized
+
+        if is_quantized(d_state.params):
+            raise ValueError(
+                "speculative decode needs float draft params (the "
+                "draft is small; quantizing it buys nothing)"
+            )
+        from elasticdl_tpu.api.generation import _decode_cache
+
+        self.draft_k = int(draft_k)
+        self._draft = d_trainer
+        self._d_model = d_model
+        self._d_variables = {
+            "params": d_state.params, **d_state.model_state
+        }
+        self._d_kv_shapes = _kv_shapes_for(
+            _decode_cache(d_trainer), d_model, 1
+        )
+        self._d_pool = jax.tree.map(
+            lambda sh: jnp.zeros((self.num_slots,) + sh.shape,
+                                 sh.dtype),
+            self._d_kv_shapes,
+        )
+        self._d_prefill_fns = {}
+        self._d_write_fn = None
+
+    # ------------------------------------------------------------ params
+
+    def set_params(self, state, version):
+        """Hot reload, plus the sharing-specific obligation: cached
+        prefix rows were computed under the superseded params, so the
+        prefix index flushes — a NEW request must never seat on stale
+        rows (in-flight sequences keep their caches and continue on
+        the new weights, the same contract as the dense engine)."""
+        super().set_params(state, version)
+        if hasattr(self, "kv"):
+            self.kv.flush_prefix_cache()
 
     # ------------------------------------------------------------- slots
 
@@ -467,7 +579,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         if request.max_new_tokens <= 1:
             return True  # prefill-only; never touches the pool
         cached = len(request.prompt) + request.max_new_tokens - 1
-        return self.kv.allocator.can_fit(cached)
+        return self.kv.can_seat(request.prompt, len(request.prompt),
+                                cached)
 
     def max_cached_tokens(self):
         # a request must fit BOTH one slot's table and the whole pool
@@ -481,8 +594,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         landing in allocated blocks: the allocator reserves the FULL
         cache budget (prompt + max_new_tokens - 1 rows) up front —
         raising OutOfBlocks before any compute — so a seated request
-        can always extend to completion. A one-token request skips the
-        pool entirely (nothing will ever read its rows)."""
+        can always extend to completion. A prompt whose prefix matches
+        the resident index seats the shared blocks by incref and runs
+        ONLY the unshared suffix. A one-token request skips the pool
+        entirely (nothing will ever read its rows)."""
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slot")
@@ -495,30 +610,41 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 % (total, self.seq_len)
             )
         decoding = request.max_new_tokens > 1
+        shared = 0
         if decoding:
-            # reserve-or-raise BEFORE the prefill runs; the scheduler
+            # reserve-or-raise BEFORE any compute; the scheduler
             # checks can_seat first, so raising here is a bug guard
-            self.kv.seat(slot, p, p + request.max_new_tokens - 1)
-        p_pad = _prefill_bucket(p, self.seq_len)
-        fn = self._prefill_fns.get(p_pad)
-        if fn is None:
-            fn = self._build_prefill(p_pad)
-            self._prefill_fns[p_pad] = fn
-        buf = np.zeros((1, self.seq_len), np.int32)
-        buf[0, :p] = request.prompt
-        with self.trainer.mesh:
-            kv, first = fn(
-                self._exec_variables, jnp.asarray(buf),
-                jnp.asarray(p, jnp.int32),
-                jnp.asarray(request.seed, jnp.int32),
-                jnp.asarray(request.temperature, jnp.float32),
-            )
-            if decoding:
-                self.kv.write_prompt(kv, slot, p)
-        first = int(first)
-        if hasattr(request, "trace_event"):
-            request.trace_event("prefill", bucket=p_pad, slot=slot,
-                                paged=True)
+            shared = self.kv.seat(slot, request.prompt,
+                                  p + request.max_new_tokens - 1)
+        if decoding and shared:
+            first = self._insert_shared(slot, request, shared)
+        else:
+            p_pad = _prefill_bucket(p, self.seq_len)
+            fn = self._prefill_fns.get(p_pad)
+            if fn is None:
+                fn = self._build_prefill(p_pad)
+                self._prefill_fns[p_pad] = fn
+            buf = np.zeros((1, self.seq_len), np.int32)
+            buf[0, :p] = request.prompt
+            with self.trainer.mesh:
+                kv, first = fn(
+                    self._exec_variables, jnp.asarray(buf),
+                    jnp.asarray(p, jnp.int32),
+                    jnp.asarray(request.seed, jnp.int32),
+                    jnp.asarray(request.temperature, jnp.float32),
+                )
+                if decoding:
+                    self.kv.write_prompt(kv, slot, p)
+            first = int(first)
+            if hasattr(request, "trace_event"):
+                request.trace_event("prefill", bucket=p_pad, slot=slot,
+                                    paged=True)
+        if decoding:
+            # make this prompt's full blocks matchable (the shared
+            # ones are already indexed; walking is idempotent)
+            self.kv.register_prefix(slot, request.prompt)
+            if self.draft_k:
+                self._prefill_draft(slot, request)
         request.generated.append(first)
         request.model_version = self.model_version
         if not decoding:
@@ -530,10 +656,74 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._temps[slot] = request.temperature
         return slot, first, False
 
+    def _insert_shared(self, slot, request, shared):
+        """Seat on a prefix match: the shared blocks are resident, so
+        only the suffix `prompt[start:]` runs — ONE decode tile over
+        the prefix through the slot's table, its rows scattered into
+        the slot's fresh blocks, its last logits sampling the first
+        token. A full-prompt match re-runs just the last token; that
+        row's write into the shared tail block is the planned CoW
+        fault (the seat reserved the credit)."""
+        p = len(request.prompt)
+        if shared >= p:
+            if (self.kv.cow_for_write(slot, p - 1) is not None
+                    and self.telemetry is not None):
+                self.telemetry.count("cow_copies")
+            start = p - 1
+        else:
+            start = shared
+        t = p - start
+        t_pad = self._suffix_bucket(t)
+        fn = self._suffix_fns.get(t_pad)
+        if fn is None:
+            fn = self._build_suffix_prefill(t_pad)
+            self._suffix_fns[t_pad] = fn
+        chunk = np.zeros((1, t_pad), np.int32)
+        chunk[0, :t] = request.prompt[start:]
+        with self.trainer.mesh:
+            self.kv.pools, first = fn(
+                self._exec_variables, self.kv.pools,
+                jnp.asarray(self.kv.tables[slot]),
+                jnp.asarray(chunk),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(t, jnp.int32),
+                jnp.asarray(request.seed, jnp.int32),
+                jnp.asarray(request.temperature, jnp.float32),
+            )
+        if self.telemetry is not None:
+            self.telemetry.count("prefix_hit_tokens", start)
+        if hasattr(request, "trace_event"):
+            request.trace_event("prefix_hit", slot=slot,
+                                shared_tokens=start, suffix_tokens=t)
+        return int(first)
+
+    def _prefill_draft(self, slot, request):
+        """Fill the draft's dense cache for this prompt (the draft has
+        no paged pool, so it always prefills the full prompt — it is
+        small enough that this is noise next to the target)."""
+        p = len(request.prompt)
+        p_pad = _prefill_bucket(p, self.seq_len)
+        fn = self._d_prefill_fns.get(p_pad)
+        if fn is None:
+            fn = self._build_draft_prefill(p_pad)
+            self._d_prefill_fns[p_pad] = fn
+        buf = np.zeros((1, self.seq_len), np.int32)
+        buf[0, :p] = request.prompt
+        with self.trainer.mesh:
+            d_kv = fn(self._d_variables, jnp.asarray(buf),
+                      jnp.asarray(p, jnp.int32))
+            self._write_draft_slot(d_kv, slot)
+
+    def _suffix_bucket(self, t):
+        """Static tile widths for the suffix prefill, in steps of 8 so
+        nearby suffix lengths share one executable."""
+        return min(self.seq_len, -(-int(t) // 8) * 8)
+
     def evict(self, slot):
-        """Free the slot AND return its blocks to the free list; the
-        rows are dead the moment the table forgets them (copy-free
-        churn — nothing is zeroed or moved)."""
+        """Free the slot AND drop its block references; private rows
+        are dead the moment the table forgets them, shared rows live
+        on under their other owners (copy-free churn — nothing is
+        zeroed or moved)."""
         self._slots[slot] = None
         self._positions[slot] = 0
         self.kv.release(slot)
@@ -544,22 +734,26 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         attends over its own table and its row scatters into its own
         block. Free lanes ride along masked (stale tokens, all-(-1)
         tables, out-of-bounds scatter ids) — the dense engine's
-        static-shape contract, kept."""
+        static-shape contract, kept. With a draft seated the step is
+        the speculative draft-verify tick instead, committing 1..k+1
+        tokens per slot. Returns [(slot, request, tokens, finished)]."""
         active = [
             (i, s) for i, s in enumerate(self._slots) if s is not None
         ]
         if not active:
             return []
+        if self.draft_k:
+            return self._spec_step(active)
         for i, _st in active:
             # the block this step writes (position = the slot's pos);
             # drawn from the slot's reservation, so it cannot fail
-            self.kv.ensure_block(i, int(self._positions[i]))
+            self.kv.ensure_blocks(i, int(self._positions[i]))
         if self._step_fn is None:
             self._step_fn = self._build_paged_step()
         with self.trainer.mesh:
             self.kv.pools, nxt = self._step_fn(
                 self._exec_variables, self.kv.pools,
-                jnp.asarray(self.kv.tables),
+                self.kv.tables_device(),
                 jnp.asarray(self._positions),
                 jnp.asarray(self._last_tokens),
                 jnp.asarray(self._seeds),
@@ -579,7 +773,64 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             )
             if finished:
                 self.evict(slot)
-            out.append((slot, st.request, token, finished))
+            out.append((slot, st.request, [token], finished))
+        return out
+
+    def _spec_step(self, active):
+        """One speculative tick: k drafted tokens per slot, verified
+        in ONE vmapped target step, greedy-exact accept/rollback.
+        Rolled-back rows are never committed to the block table
+        (their scatter ids are masked out-of-bounds inside the step);
+        the draft's rollback is counter-only."""
+        k = self.draft_k
+        budgets = np.ones(self.num_slots, np.int32)
+        for i, st in active:
+            pos = int(self._positions[i])
+            # materialize every block this tick MIGHT write (rows
+            # pos..pos+k, capped at the slot's last needed row) —
+            # reservation-backed, cannot fail for a seated request
+            self.kv.ensure_blocks(i, min(pos + k, st.max_total - 2))
+            budgets[i] = st.max_total - (
+                len(st.request.prompt) + len(st.request.generated)
+            )
+        if self._spec_fn is None:
+            self._spec_fn = self._build_spec_step()
+        with self.trainer.mesh:
+            self.kv.pools, self._d_pool, toks, counts = self._spec_fn(
+                self._exec_variables, self._d_variables,
+                self.kv.pools, self._d_pool,
+                self.kv.tables_device(),
+                jnp.asarray(self._positions),
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(self._seeds),
+                jnp.asarray(self._temps),
+                jnp.asarray(budgets),
+            )
+            toks = np.asarray(toks)
+            counts = np.asarray(counts)
+        out = []
+        accepted = 0
+        for slot, st in active:
+            c = int(counts[slot])
+            committed = [int(x) for x in toks[slot, :c]]
+            st.request.generated.extend(committed)
+            st.request.model_version = self.model_version
+            self._positions[slot] += c
+            self._last_tokens[slot] = committed[-1]
+            accepted += c - 1
+            finished = (
+                len(st.request.prompt) + len(st.request.generated)
+                >= st.max_total
+            )
+            if finished:
+                self.evict(slot)
+            out.append((slot, st.request, committed, finished))
+        self.draft_proposed += k * len(active)
+        self.draft_accepted += accepted
+        if self.telemetry is not None:
+            self.telemetry.count("draft_proposed", k * len(active))
+            if accepted:
+                self.telemetry.count("draft_accepted", accepted)
         return out
 
     # ------------------------------------------------------- compiled fns
@@ -635,5 +886,186 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "serving: compiling paged decode step for %d slots over "
             "%d x %d-token blocks", self.num_slots, self.num_blocks,
             self.block_size,
+        )
+        return jax.jit(step)
+
+    def _build_suffix_prefill(self, t_pad):
+        """Compiled shared-prefix suffix prefill: decode a tile of up
+        to `t_pad` prompt tokens at positions [start, start + t) over
+        the resident prefix blocks, scatter the tile's rows into the
+        slot's blocks (pad rows dropped via out-of-bounds ids), and
+        sample the first generated token from the last REAL row's
+        logits. One executable per tile bucket."""
+        from elasticdl_tpu.serving.kv_pool import scatter_rows
+
+        model = self.model
+        top_k, top_p, qz = self.top_k, self.top_p, self._exec_qz
+        block_size, num_blocks = self.block_size, self.num_blocks
+        max_blocks = self.kv.max_blocks_per_slot
+
+        def fn(variables, pools, table, chunk, start, t_real, seed,
+               temp):
+            variables = _maybe_dequantize(variables, qz)
+            logits, aux = model.apply(
+                dict(variables, cache={"pos": start}),
+                {"tokens": chunk},
+                training=False, decode=True,
+                mutable=["cache", "kv_out"],
+                paged={"pools": pools, "table": table[None]},
+            )  # logits [1, t_pad, V]
+            rows = jax.tree.map(
+                lambda s: s[0][0].transpose(1, 0, 2), aux["kv_out"],
+                is_leaf=lambda x: isinstance(x, tuple),
+            )  # sown [1, hkv, t_pad, d] -> [t_pad, hkv, d]
+            pos = start + jnp.arange(t_pad)
+            bids = jnp.take(
+                table, jnp.minimum(pos // block_size, max_blocks - 1)
+            )
+            keep = (jnp.arange(t_pad) < t_real) & (bids >= 0)
+            bids = jnp.where(keep, bids, num_blocks)
+            pools = scatter_rows(pools, rows, bids, pos % block_size)
+            step_logits = jnp.take(logits[0], t_real - 1, axis=0)
+            first = serving_next_token(
+                step_logits, seed, start + t_real, temp, top_k, top_p
+            )
+            return pools, first
+
+        logger.info(
+            "serving: compiling shared-prefix suffix prefill for "
+            "tile %d", t_pad,
+        )
+        return jax.jit(fn)
+
+    def _build_draft_prefill(self, p_pad):
+        d_model, d_kv_shapes = self._d_model, self._d_kv_shapes
+
+        def prefill(d_variables, buf, p_len):
+            kv, _last = _run_prefill(
+                d_model, d_variables, d_kv_shapes, buf, p_len, p_pad
+            )
+            return kv
+
+        logger.info(
+            "serving: compiling draft prefill for bucket %d", p_pad
+        )
+        return jax.jit(prefill)
+
+    def _write_draft_slot(self, kv, slot):
+        if self._d_write_fn is None:
+            def write(pool, kv, idx):
+                def upd(p, n):
+                    start = (idx,) + (0,) * n.ndim
+                    return jax.lax.dynamic_update_slice(
+                        p, n[None], start
+                    )
+
+                return jax.tree.map(upd, pool, kv)
+
+            self._d_write_fn = jax.jit(write)
+        self._d_pool = self._d_write_fn(
+            self._d_pool, kv, jnp.asarray(slot, jnp.int32)
+        )
+
+    def _build_spec_step(self):
+        """The speculative tick as ONE compiled program: k vmapped
+        draft steps (a lax.scan of single-token greedy proposals),
+        then the target verifying the whole [last, d_1..d_k] tile in
+        one vmapped (k+1)-wide paged decode. Acceptance is the longest
+        greedy-matching proposal prefix (0 for sampled slots, whose
+        committed token is exactly the plain step's sample); commit
+        c = min(accepted + 1, remaining budget) tokens — row scatters
+        for j >= c are masked to out-of-bounds ids, so rolled-back
+        rows never reach the block table, and the draft rolls back by
+        counter only (its pos is forced from `positions` each tick)."""
+        from elasticdl_tpu.serving.kv_pool import scatter_rows
+
+        model, d_model = self.model, self._d_model
+        top_k, top_p, qz = self.top_k, self.top_p, self._exec_qz
+        block_size, num_blocks = self.block_size, self.num_blocks
+        max_blocks = self.kv.max_blocks_per_slot
+        k = self.draft_k
+
+        def step(variables, d_variables, pools, d_pool, tables,
+                 positions, last_tokens, seeds, temps, budgets):
+            variables = _maybe_dequantize(variables, qz)
+            # force the draft counters to the committed truth — the
+            # rollback contract: rows past the counter are masked junk
+            d_pool_f = dict(d_pool, pos=positions)
+
+            def d_one(cache, tok):
+                lg, upd = d_model.apply(
+                    dict(d_variables, cache=cache),
+                    {"tokens": tok[None, None]},
+                    training=False, decode=True, mutable=["cache"],
+                )
+                nxt = jnp.argmax(lg[0, 0], axis=-1).astype(jnp.int32)
+                return upd["cache"], nxt
+
+            def d_scan(carry, _):
+                cache, tok = carry
+                cache, nxt = jax.vmap(d_one)(cache, tok)
+                return (cache, nxt), nxt
+
+            (d_pool_out, _), d_seq = jax.lax.scan(
+                d_scan, (d_pool_f, last_tokens), None, length=k
+            )
+            d_toks = jnp.moveaxis(d_seq, 0, 1)  # [S, k]
+            chunk = jnp.concatenate(
+                [last_tokens[:, None], d_toks], axis=1
+            )  # [S, k+1]; row j = the token at stream position pos+j
+
+            def v_one(table, pos, toks):
+                logits, aux = model.apply(
+                    dict(variables, cache={"pos": pos}),
+                    {"tokens": toks[None]},
+                    training=False, decode=True,
+                    mutable=["cache", "kv_out"],
+                    paged={"pools": pools, "table": table[None]},
+                )  # logits [1, k+1, V]: row j predicts pos + j + 1
+                g = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+                rows = jax.tree.map(
+                    lambda s: s[0][0].transpose(1, 0, 2),
+                    aux["kv_out"],
+                    is_leaf=lambda x: isinstance(x, tuple),
+                )  # [k+1, hkv, d]
+                return logits[0], g, rows
+
+            logits, g, rows = jax.vmap(v_one)(tables, positions, chunk)
+            # longest greedy-matching proposal prefix, per slot;
+            # sampled slots accept nothing (their committed token is
+            # the sampled one below — exactly the plain step's)
+            match = jnp.cumprod(
+                (d_toks == g[:, :k]).astype(jnp.int32), axis=1
+            )
+            a = jnp.where(temps > 0.0, 0, match.sum(axis=1))  # [S]
+            c = jnp.minimum(a + 1, jnp.maximum(budgets, 1))
+            # committed token j < a: the greedy target (== proposal);
+            # j == a: the correction/bonus, sampled exactly like the
+            # plain step at position pos + 1 + a
+            def pick(lg, aa, seed, pos, temp):
+                return serving_next_token(
+                    lg[aa], seed, pos + 1 + aa, temp, top_k, top_p
+                )
+
+            bonus = jax.vmap(pick)(logits, a, seeds, positions, temps)
+            out_toks = jnp.where(
+                jnp.arange(k + 1)[None, :] == a[:, None],
+                bonus[:, None], g,
+            )  # [S, k+1]; entries past c-1 are dead
+            # scatter ONLY the committed rows j < c (free lanes carry
+            # -1 tables; both mask to the out-of-bounds drop id)
+            wpos = positions[:, None] + jnp.arange(k + 1)[None, :]
+            bids = jnp.take_along_axis(
+                tables, jnp.minimum(wpos // block_size, max_blocks - 1),
+                axis=1,
+            )
+            keep = (jnp.arange(k + 1)[None, :] < c[:, None]) & (bids >= 0)
+            bids = jnp.where(keep, bids, num_blocks)
+            pools = scatter_rows(pools, rows, bids, wpos % block_size)
+            return pools, d_pool_out, out_toks, c
+
+        logger.info(
+            "serving: compiling speculative draft-verify step "
+            "(k=%d) for %d slots", k, self.num_slots,
         )
         return jax.jit(step)

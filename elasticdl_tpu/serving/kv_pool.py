@@ -11,23 +11,55 @@ unsellable. This module converts that padding into admissible work:
   sequence on the server;
 * a sequence's logical cache is its BLOCK TABLE — the ordered block
   ids covering positions `[j*block_size, (j+1)*block_size)`;
-* `BlockAllocator` is the host-side free-list: alloc/extend/free are
+* `BlockAllocator` is the host-side accounting: alloc/extend/free are
   O(1) per block, and a RESERVATION ledger guarantees that a seated
   request can always extend to its full token budget — out-of-blocks
   is an admission-time condition (backpressure), never a mid-decode
   crash;
-* `PagedKVPool` owns the device arenas and the two write paths: the
+* `PagedKVPool` owns the device arenas and the write paths: the
   block-granular prompt insertion (one `dynamic_update_slice` per
   block, never a whole-slot copy) and the per-step decode-row scatter
-  (`.at[bids, offs].set`, one row per active slot, free lanes dropped
-  via an out-of-bounds sentinel).
+  (`.at[bids, offs].set`, free lanes dropped via an out-of-bounds
+  sentinel).
+
+PREFIX SHARING (share_prefix=True): blocks are REFCOUNTED and full
+prompt blocks are indexed in a content-addressed prefix trie keyed
+`(parent block id, block token tuple)` — collision-free by
+construction. A request whose prompt prefix matches a resident chain
+seats by INCREMENTING refcounts instead of allocating + re-prefilling;
+the engine then prefills only the unshared suffix. Invariants:
+
+* only FULL blocks enter the index — every row of an indexed block is
+  real prompt content, and its owner never writes it again (decode
+  writes land at positions >= the prompt length, i.e. in later
+  blocks);
+* a block is freed (returned to the free list) only at refcount 0.
+  Refcount-0 blocks that are still indexed become RECLAIMABLE: they
+  sit in an LRU cache, revivable by a future prefix match at zero
+  cost, and are evicted (leaf-first — a live block's ancestors are
+  always live, so every reclaimable subtree has reclaimable leaves)
+  when the free list runs dry. `available()` therefore counts
+  free + reclaimable - reserved;
+* COPY-ON-WRITE: a slot's write into a block with refcount > 1 first
+  copies the block into a fresh one and repoints the slot's table
+  (`cow`). The only planned CoW is the full-prompt-match seat (the
+  last token must re-run for logits, re-writing its row into the
+  shared tail block), and `alloc` RESERVES one block of CoW credit
+  for it up front — the CoW fault draws from the slot's existing
+  reservation, never from thin air, keeping out-of-blocks an
+  admission-time condition.
 
 Block ids enter the compiled decode step as DEVICE arrays (the tables),
 so slot churn and sequence growth never recompile anything — the same
 zero-recompile contract the dense pool holds, at block granularity.
-The attention that consumes this layout is
+The device table upload is CACHED and refreshed only when some table
+actually changed (one device put per mutating step, not per slot —
+mid-decode steps where no block boundary is crossed reuse the resident
+array). The attention that consumes this layout is
 `ops.attention.paged_decode_attention`.
 """
+
+import collections
 
 import jax
 import jax.numpy as jnp
@@ -49,19 +81,22 @@ def blocks_for(tokens, block_size):
 
 
 class BlockAllocator(object):
-    """Host-side block accounting: LIFO free list, per-slot block
-    tables, and a reservation ledger.
+    """Host-side block accounting: free list, refcounts, per-slot block
+    tables, the reservation ledger, and (share_prefix=True) the
+    content-addressed prefix index with its reclaimable LRU.
 
     `alloc(slot, tokens, commit_tokens)` materializes the blocks for
     `tokens` rows and RESERVES (without materializing) enough blocks
     for `commit_tokens` total; `extend` then draws the growth blocks
     from that reservation, so a request admitted under its full budget
     can never strand mid-decode waiting for a block another request
-    holds. `available()` is what admission may promise to NEW work.
-    Every operation is O(blocks touched); steady-state slot churn is
-    O(1) per block."""
+    holds. With `prompt=` token ids, the prompt's full blocks are first
+    matched against the prefix index and seated by incref — only the
+    unmatched remainder draws fresh blocks. `available()` is what
+    admission may promise to NEW work. Every operation is O(blocks
+    touched); steady-state slot churn is O(1) per block."""
 
-    def __init__(self, num_blocks, block_size):
+    def __init__(self, num_blocks, block_size, share_prefix=False):
         if num_blocks < 1:
             raise ValueError(
                 "num_blocks must be >= 1, got %d" % num_blocks)
@@ -70,25 +105,49 @@ class BlockAllocator(object):
                 "block_size must be >= 1, got %d" % block_size)
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.share_prefix = bool(share_prefix)
         # LIFO: the most recently freed block is reused first (warm
         # reuse; also what the reuse-order tests lock)
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._tables = {}     # slot -> [block ids]
         self._committed = {}  # slot -> total blocks promised
+        self._cow_credit = {}  # slot -> reserved CoW copies (0 or 1)
         self._reserved = 0    # promised-but-unmaterialized, all slots
+        self._refcount = {}   # bid -> live references (allocated only)
+        # prefix index: (parent bid, block token tuple) -> bid; -1 is
+        # the root parent. Collision-free: the key IS the content path.
+        self._index = {}
+        self._index_key = {}  # bid -> its index key (reverse map)
+        self._children = {}   # bid -> set of indexed child bids
+        # refcount-0 blocks still indexed, oldest-first (LRU eviction)
+        self._cached = collections.OrderedDict()
+        self.cow_copies = 0        # monotone: CoW faults served
+        self.prefix_hits = 0       # monotone: seats that matched
+        self.prefix_hit_tokens = 0  # monotone: tokens seated by incref
 
     # ------------------------------------------------------------ queries
 
     def num_free(self):
         return len(self._free)
 
+    def num_cached(self):
+        """Reclaimable blocks: refcount 0 but still in the prefix
+        index — revivable by a match, evictable under pressure."""
+        return len(self._cached)
+
     def blocks_in_use(self):
-        return self.num_blocks - len(self._free)
+        """Blocks pinned by LIVE references (refcount > 0)."""
+        return self.num_blocks - len(self._free) - len(self._cached)
+
+    def shared_blocks(self):
+        """Blocks currently referenced by more than one table."""
+        return sum(1 for c in self._refcount.values() if c > 1)
 
     def available(self):
-        """Blocks admission may promise to NEW work: free minus the
-        reservations already promised to seated slots."""
-        return len(self._free) - self._reserved
+        """Blocks admission may promise to NEW work: free plus
+        reclaimable, minus the reservations already promised to
+        seated slots."""
+        return len(self._free) + len(self._cached) - self._reserved
 
     def can_fit(self, tokens):
         return blocks_for(tokens, self.block_size) <= self.available()
@@ -96,27 +155,182 @@ class BlockAllocator(object):
     def table(self, slot):
         return list(self._tables.get(slot, ()))
 
+    # ----------------------------------------------------- prefix index
+
+    def _full_block_tuples(self, prompt):
+        bs = self.block_size
+        n = len(prompt) // bs
+        return [tuple(int(t) for t in prompt[j * bs:(j + 1) * bs])
+                for j in range(n)]
+
+    def match_prefix(self, prompt):
+        """Longest resident chain of full blocks covering a prefix of
+        `prompt`: the block ids, root-first. Read-only (no refcount
+        change) — `alloc(prompt=...)` seats on the result."""
+        if not self.share_prefix:
+            return []
+        chain = []
+        parent = -1
+        for toks in self._full_block_tuples(prompt):
+            bid = self._index.get((parent, toks))
+            if bid is None:
+                break
+            chain.append(bid)
+            parent = bid
+        return chain
+
+    def plan(self, prompt, tokens, commit_tokens=None):
+        """(chain, needed) for seating `prompt` with `tokens` rows now
+        and `commit_tokens` promised: the matched shared chain and how
+        many blocks the seat would draw from `available()` (fresh
+        blocks + the CoW credit for a full-prompt match). The
+        admission-time answer `can_seat` and the seat itself (`alloc`)
+        both run through this, so they cannot disagree."""
+        now = blocks_for(tokens, self.block_size)
+        commit = max(
+            now, blocks_for(commit_tokens or tokens, self.block_size)
+        )
+        chain = self.match_prefix(prompt) if prompt is not None else []
+        chain = chain[:now]
+        # full-prompt match: the engine must re-run the last prompt
+        # token for its logits, which re-writes that token's row into
+        # the shared tail block -> one planned CoW copy, reserved here
+        cow = 1 if (chain and len(chain) * self.block_size
+                    >= int(tokens)) else 0
+        return chain, commit - len(chain) + cow
+
+    def can_seat(self, prompt, tokens, commit_tokens=None):
+        _chain, needed = self.plan(prompt, tokens, commit_tokens)
+        return needed <= self.available()
+
+    def register_prefix(self, slot, prompt):
+        """Index `slot`'s FULL prompt blocks so later prompts can seat
+        on them. Walks the index: levels already present (this seat's
+        own shared chain, or a concurrent duplicate) keep the existing
+        block — chains may interleave blocks owned by different slots,
+        which is sound because the key path pins the exact content."""
+        if not self.share_prefix:
+            return
+        table = self._tables.get(slot)
+        if table is None:
+            return
+        parent = -1
+        for j, toks in enumerate(self._full_block_tuples(prompt)):
+            if j >= len(table):
+                break
+            key = (parent, toks)
+            bid = self._index.get(key)
+            if bid is None:
+                bid = table[j]
+                if bid in self._index_key:
+                    # already indexed under another path (shouldn't
+                    # happen for fresh private blocks) — don't re-key
+                    break
+                self._index[key] = bid
+                self._index_key[bid] = key
+                self._children.setdefault(parent, set()).add(bid)
+            parent = bid
+
+    def flush_index(self):
+        """Drop the whole prefix index (hot reload: cached rows were
+        computed under superseded params — new requests must never
+        seat on them). Reclaimable blocks return to the free list;
+        live blocks just lose their index entry and free normally at
+        refcount 0."""
+        for bid in list(self._cached):
+            self._free.append(bid)
+            self._refcount.pop(bid, None)
+        self._cached.clear()
+        self._index.clear()
+        self._index_key.clear()
+        self._children.clear()
+
+    # -------------------------------------------------------- refcounts
+
+    def incref(self, bid):
+        """Add a live reference to `bid`, reviving it from the
+        reclaimable cache when its refcount was 0. Every incref must
+        be settled by a decref/free (edl-lint EDL501 tracks the
+        pair)."""
+        self._refcount[bid] = self._refcount.get(bid, 0) + 1
+        self._cached.pop(bid, None)
+
+    def decref(self, bid):
+        """Drop a live reference; at refcount 0 the block becomes
+        reclaimable (still indexed) or free (not indexed). A block is
+        never on the free list while any table references it."""
+        rc = self._refcount.get(bid, 0) - 1
+        if rc > 0:
+            self._refcount[bid] = rc
+            return
+        self._refcount.pop(bid, None)
+        if bid in self._index_key:
+            self._cached[bid] = None  # newest at the LRU tail
+        else:
+            self._free.append(bid)
+
+    def _evict_cached(self):
+        """Reclaim the oldest LEAF in the reclaimable LRU (a live
+        block's ancestors are live, so every reclaimable subtree has a
+        reclaimable leaf — progress is guaranteed)."""
+        for bid in self._cached:
+            if not self._children.get(bid):
+                key = self._index_key.pop(bid)
+                del self._index[key]
+                kids = self._children.get(key[0])
+                if kids is not None:
+                    kids.discard(bid)
+                    if not kids:
+                        del self._children[key[0]]
+                self._children.pop(bid, None)
+                del self._cached[bid]
+                return bid
+        raise OutOfBlocks(
+            "no evictable cached block (allocator invariant broken)"
+        )
+
+    def _pop_block(self):
+        if self._free:
+            return self._free.pop()
+        return self._evict_cached()
+
     # ------------------------------------------------------------- churn
 
-    def alloc(self, slot, tokens, commit_tokens=None):
+    def alloc(self, slot, tokens, commit_tokens=None, prompt=None):
         """Materialize blocks for `tokens` rows under `slot` and
         reserve up to `commit_tokens` total; raises OutOfBlocks when
-        the full commitment is not coverable (nothing is taken then)."""
+        the full commitment is not coverable (nothing is taken then).
+        With `prompt` (token ids) and share_prefix, the prompt's full
+        blocks seat on the prefix index by incref where resident.
+        Returns the number of SHARED tokens (0 without a match)."""
         if slot in self._tables:
             raise ValueError("slot %r already holds blocks" % (slot,))
         now = blocks_for(tokens, self.block_size)
         commit = max(
             now, blocks_for(commit_tokens or tokens, self.block_size)
         )
-        if commit > self.available():
+        chain, needed = self.plan(prompt, tokens, commit_tokens)
+        if needed > self.available():
             raise OutOfBlocks(
-                "need %d blocks (%d now), %d available"
-                % (commit, now, self.available())
+                "need %d new blocks (%d now, %d shared), %d available"
+                % (needed, now, len(chain), self.available())
             )
-        self._tables[slot] = [self._free.pop() for _ in range(now)]
+        cow = needed - (commit - len(chain))  # 1 on a full-prompt match
+        for bid in chain:
+            self.incref(bid)
+        fresh = []
+        for _ in range(now - len(chain)):
+            bid = self._pop_block()
+            self.incref(bid)
+            fresh.append(bid)
+        self._tables[slot] = list(chain) + fresh
         self._committed[slot] = commit
-        self._reserved += commit - now
-        return self.table(slot)
+        self._cow_credit[slot] = cow
+        self._reserved += (commit - now) + cow
+        if chain:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += len(chain) * self.block_size
+        return len(chain) * self.block_size
 
     def extend(self, slot, total_tokens):
         """Grow `slot`'s table to cover `total_tokens` rows; growth
@@ -138,23 +352,60 @@ class BlockAllocator(object):
                 )
             else:
                 self._committed[slot] += 1
-            bid = self._free.pop()
+            bid = self._pop_block()
+            self.incref(bid)
             table.append(bid)
             added.append(bid)
         return added
 
+    def cow(self, slot, block_index):
+        """Copy-on-write fault: `slot` is about to write into its
+        table[block_index]. If that block is shared (refcount > 1), a
+        fresh block replaces it in the table — drawing the slot's CoW
+        credit reserved at seat time (falling back to free capacity
+        for an UNPLANNED divergence) — and the shared original is
+        decref'd, never freed out from under its other owners.
+        Returns (old bid, new bid) when a copy happened, None when the
+        block was private (write is safe in place)."""
+        table = self._tables.get(slot)
+        if table is None:
+            raise ValueError("slot %r holds no blocks" % (slot,))
+        old = table[block_index]
+        if self._refcount.get(old, 0) <= 1:
+            return None
+        if self._cow_credit.get(slot, 0) > 0:
+            self._cow_credit[slot] -= 1
+            self._reserved -= 1  # the credit was reserved at seat
+        elif self.available() < 1:
+            raise OutOfBlocks(
+                "CoW fault on slot %r with no block available (no "
+                "credit reserved and the pool is dry)" % (slot,)
+            )
+        new = self._pop_block()
+        self.incref(new)
+        table[block_index] = new
+        self.decref(old)
+        self.cow_copies += 1
+        return old, new
+
     def free(self, slot):
-        """Release `slot`'s blocks and its remaining reservation;
-        returns how many blocks went back on the free list. Safe to
-        call for a slot that holds nothing (0)."""
+        """Release `slot`'s references and its remaining reservation;
+        returns how many table entries were dropped. Shared blocks
+        survive (decref only) — a block returns to the free list or
+        the reclaimable cache strictly at refcount 0. Safe to call for
+        a slot that holds nothing (0)."""
         table = self._tables.pop(slot, None)
         if table is None:
             return 0
-        self._reserved -= self._committed.pop(slot) - len(table)
-        # pushed in table order so the block allocated LAST sits on top
-        # of the stack and is reused first (LIFO through the whole
-        # alloc -> free -> alloc cycle)
-        self._free.extend(table)
+        self._reserved -= (
+            self._committed.pop(slot) - len(table)
+            + self._cow_credit.pop(slot, 0)
+        )
+        # decref'd in table order so a fully-private table lands on the
+        # free list with the block allocated LAST on top of the stack
+        # (LIFO through the whole alloc -> free -> alloc cycle)
+        for bid in table:
+            self.decref(bid)
         return len(table)
 
 
@@ -197,14 +448,33 @@ def write_prompt_block(pools, kv, j, bid, block_size):
     return jax.tree.map(upd, pools, kv)
 
 
+def copy_block(pools, src, dst):
+    """Device-side CoW: duplicate arena block `src` into `dst` on
+    every row leaf (one gather + dynamic_update_slice per leaf, traced
+    indices — one compiled copy serves every fault)."""
+    def upd(pool):
+        if pool.ndim != 4:
+            return pool
+        return jax.lax.dynamic_update_slice(
+            pool,
+            jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=0),
+            (dst, 0, 0, 0),
+        )
+
+    return jax.tree.map(upd, pools)
+
+
 def scatter_rows(pools, rows, bids, offs):
-    """Write one decode row per slot into the arenas: `rows` is a tree
-    whose structure is a SUBSET of `pools` (the model's "kv_out" sown
-    collection) with leaves `[S, hkv, d]`; `bids`/`offs` are `[S]`
-    block ids and in-block offsets. Free lanes carry an out-of-bounds
-    bid and are dropped by the scatter — they never touch a block a
-    live sequence owns. Distinct live slots own distinct blocks, so
-    the scatter indices never collide."""
+    """Write decode rows into the arenas: `rows` is a tree whose
+    structure is a SUBSET of `pools` (the model's "kv_out" sown
+    collection) with leaves `[..., hkv, d]` — one row per leading
+    index; `bids`/`offs` carry matching leading shape (`[S]` for the
+    per-slot step, `[S, t]` for the speculative verify tile, `[t]` for
+    a suffix prefill). Rows to drop (free lanes, rolled-back draft
+    rows, pad rows) carry an out-of-bounds bid and are discarded by the
+    scatter — they never touch a block a live sequence owns. Distinct
+    live rows target distinct (block, offset) pairs, so the scatter
+    indices never collide."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(pools)
     rmap = {
         jax.tree_util.keystr(p): leaf
@@ -225,11 +495,17 @@ class PagedKVPool(object):
 
     Owns the BlockAllocator, the `[num_slots, seq_len/block_size]`
     int32 table mirror the compiled step consumes (-1 = unallocated),
-    and the jitted block write. `cache_len % block_size == 0` is
-    required so prompt blocks slice cleanly out of the prefill cache."""
+    and the jitted block write/copy. `cache_len % block_size == 0` is
+    required so prompt blocks slice cleanly out of the prefill cache.
+
+    The device copy of the table mirror is cached: `tables_device()`
+    re-uploads only after a mutation (alloc/extend/CoW/release), so a
+    decode step that crosses no block boundary costs zero host->device
+    table traffic — the per-step assembly is one cached handle, not
+    per-slot work."""
 
     def __init__(self, kv_shapes, cache_len, num_slots, num_blocks,
-                 block_size):
+                 block_size, share_prefix=False):
         cache_len = int(cache_len)
         block_size = int(block_size)
         if cache_len % block_size:
@@ -241,12 +517,14 @@ class PagedKVPool(object):
         self.block_size = block_size
         self.num_blocks = int(num_blocks)
         self.max_blocks_per_slot = cache_len // block_size
-        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.allocator = BlockAllocator(num_blocks, block_size,
+                                        share_prefix=share_prefix)
         self.pools = build_pools(kv_shapes, cache_len, num_blocks,
                                  block_size)
         self.tables = np.full(
             (int(num_slots), self.max_blocks_per_slot), -1, np.int32
         )
+        self._tables_dev = None  # cached device upload of `tables`
         row_bytes = [
             leaf.nbytes for leaf in jax.tree.leaves(self.pools)
             if leaf.ndim == 4
@@ -254,51 +532,105 @@ class PagedKVPool(object):
         self.bytes_total = int(sum(row_bytes))
         self.block_bytes = self.bytes_total // max(1, self.num_blocks)
         self._write_fn = None
+        self._copy_fn = None
 
     # ----------------------------------------------------------- lifecycle
 
-    def seat(self, slot, prompt_tokens, commit_tokens):
-        """Reserve the request's full block budget and materialize the
-        prompt's blocks; raises OutOfBlocks with nothing taken."""
-        self.allocator.alloc(slot, prompt_tokens,
-                             commit_tokens=commit_tokens)
-        self._sync_row(slot)
+    def can_seat(self, prompt, prompt_tokens, commit_tokens):
+        return self.allocator.can_seat(prompt, prompt_tokens,
+                                       commit_tokens)
 
-    def write_prompt(self, kv, slot, prompt_tokens):
-        """Scatter the prefilled cache's first ceil(p/bs) blocks into
-        the slot's allocated blocks — block-granular, no whole-slot
-        copy."""
+    def seat(self, slot, prompt, commit_tokens):
+        """Reserve the request's full block budget and materialize the
+        prompt's blocks — shared-prefix blocks by incref, the rest
+        fresh; raises OutOfBlocks with nothing taken. Returns the
+        shared token count (0 without a match)."""
+        shared = self.allocator.alloc(
+            slot, len(prompt), commit_tokens=commit_tokens,
+            prompt=prompt,
+        )
+        self._sync_row(slot)
+        return shared
+
+    def register_prefix(self, slot, prompt):
+        """Index the slot's full prompt blocks for future sharing
+        (call after their rows are actually resident)."""
+        self.allocator.register_prefix(slot, prompt)
+
+    def write_prompt(self, kv, slot, prompt_tokens, start_block=0):
+        """Scatter the prefilled cache's blocks [start_block, ...)
+        into the slot's allocated blocks — block-granular, no
+        whole-slot copy (shared blocks below start_block are already
+        resident and must not be re-written)."""
         if self._write_fn is None:
             self._write_fn = jax.jit(
                 write_prompt_block, static_argnames=("block_size",)
             )
         table = self.allocator.table(slot)
-        for j in range(blocks_for(prompt_tokens, self.block_size)):
+        for j in range(start_block,
+                       blocks_for(prompt_tokens, self.block_size)):
             self.pools = self._write_fn(
                 self.pools, kv, jnp.asarray(j, jnp.int32),
                 jnp.asarray(table[j], jnp.int32),
                 block_size=self.block_size,
             )
 
-    def ensure_block(self, slot, pos):
+    def ensure_blocks(self, slot, pos):
         """Make sure the block covering cache position `pos` exists
-        (the decode step writes there this iteration); draws the
+        (the decode step writes up to there this iteration); draws the
         slot's reservation, so it cannot fail for a seated request."""
-        self.allocator.extend(slot, pos + 1)
+        if self.allocator.extend(slot, pos + 1):
+            self._sync_row(slot)
+
+    # back-compat spelling (single position)
+    ensure_block = ensure_blocks
+
+    def cow_for_write(self, slot, pos):
+        """Copy-on-write guard before `slot` writes cache position
+        `pos`: if the covering block is shared, copy it (device) and
+        repoint the table. Returns the (old, new) ids or None."""
+        moved = self.allocator.cow(slot, pos // self.block_size)
+        if moved is None:
+            return None
+        old, new = moved
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(copy_block)
+        self.pools = self._copy_fn(
+            self.pools, jnp.asarray(old, jnp.int32),
+            jnp.asarray(new, jnp.int32),
+        )
         self._sync_row(slot)
+        return moved
 
     def release(self, slot):
-        """Reclaim a finished/evicted slot's blocks (O(1) per block);
-        the rows are dead the moment the table forgets them."""
+        """Reclaim a finished/evicted slot's references (O(1) per
+        block); private rows are dead the moment the table forgets
+        them, shared rows live on under their other owners."""
         freed = self.allocator.free(slot)
-        self.tables[slot, :] = -1
+        if freed:
+            self.tables[slot, :] = -1
+            self._tables_dev = None
         return freed
+
+    def flush_prefix_cache(self):
+        """Hot reload hook: stale-params rows must never seat a new
+        request (see BlockAllocator.flush_index)."""
+        self.allocator.flush_index()
 
     def _sync_row(self, slot):
         table = self.allocator.table(slot)
         row = np.full(self.max_blocks_per_slot, -1, np.int32)
         row[: len(table)] = table
         self.tables[slot] = row
+        self._tables_dev = None  # mutation: next step re-uploads once
+
+    def tables_device(self):
+        """The block tables as ONE cached device array — re-uploaded
+        only after a mutation, so steady-state decode steps pay no
+        host->device table transfer."""
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self.tables)
+        return self._tables_dev
 
     # ------------------------------------------------------------- stats
 
@@ -308,9 +640,17 @@ class PagedKVPool(object):
     def stats(self):
         return {
             "kv_paged": True,
+            "kv_shared": self.allocator.share_prefix,
             "kv_block_size": self.block_size,
             "kv_blocks_total": self.num_blocks,
-            "kv_blocks_free": self.allocator.num_free(),
+            # capacity available to new work: free + reclaimable —
+            # cached prefixes are not "in use", they are a warm cache
+            "kv_blocks_free": (self.allocator.num_free()
+                               + self.allocator.num_cached()),
+            "kv_blocks_cached": self.allocator.num_cached(),
+            "kv_blocks_shared": self.allocator.shared_blocks(),
             "kv_bytes_total": self.bytes_total,
             "kv_bytes_in_use": self.bytes_in_use(),
+            "prefix_hit_tokens": self.allocator.prefix_hit_tokens,
+            "cow_copies": self.allocator.cow_copies,
         }

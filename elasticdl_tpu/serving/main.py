@@ -48,6 +48,18 @@ def parse_serving_args(args=None):
     parser.add_argument("--kv_block_size", type=int, default=16)
     parser.add_argument("--kv_num_blocks", type=int, default=0,
                         help="block budget; 0 = dense-equivalent bytes")
+    # prefix sharing (paged only): -1 resolves from EDL_KV_SHARED
+    # (default on) — refcounted dedupe of matching prompt prefixes
+    parser.add_argument("--kv_shared", type=int, default=-1,
+                        choices=(-1, 0, 1))
+    # speculative decode: a small DRAFT model proposes draft_k tokens
+    # per tick, verified in one target step (paged pool only; token-
+    # exact with plain decode)
+    parser.add_argument("--draft_k", type=int, default=0)
+    parser.add_argument("--draft_model_def", default="",
+                        help="zoo model_def for the draft; empty = "
+                             "speculative decode off")
+    parser.add_argument("--draft_model_params", default="")
     return parser.parse_args(args)
 
 
@@ -84,6 +96,18 @@ def build_server(args):
                 "no checkpoint under %r yet; serving fresh params "
                 "until one lands", args.checkpoint_dir,
             )
+    draft = None
+    draft_k = int(args.draft_k)
+    if args.draft_model_def and draft_k > 0:
+        d_spec = get_model_spec(args.model_zoo, args.draft_model_def)
+        d_trainer = Trainer(d_spec, mesh=mesh,
+                            model_params=args.draft_model_params)
+        d_len = int(d_trainer.model.seq_len)
+        d_state = d_trainer.init_state(
+            ({"tokens": np.zeros((1, d_len), np.int32)},
+             np.zeros((1, d_len), np.int32))
+        )
+        draft = (d_trainer, d_state)
     server = GenerationServer(
         trainer, state,
         ServingConfig(
@@ -97,7 +121,11 @@ def build_server(args):
             kv_paged=None if args.kv_paged < 0 else bool(args.kv_paged),
             kv_block_size=args.kv_block_size,
             kv_num_blocks=args.kv_num_blocks,
+            kv_shared=(None if args.kv_shared < 0
+                       else bool(args.kv_shared)),
+            draft_k=draft_k if draft is not None else 0,
         ),
+        draft=draft,
     )
     server.engine.model_version = version
     if server.watcher is not None:

@@ -44,6 +44,7 @@ from elasticdl_tpu.serving.engine import (
     ContinuousBatchingEngine,
     PagedContinuousBatchingEngine,
     kv_paged_default,
+    kv_shared_default,
 )
 from elasticdl_tpu.serving.hot_reload import CheckpointWatcher
 from elasticdl_tpu.serving.telemetry import ServingTelemetry
@@ -60,14 +61,23 @@ class ServingConfig(object):
     kv_block_size tokens (0 blocks = the dense-equivalent budget for
     num_slots); with a fixed block budget, num_slots can then be raised
     beyond what the same bytes would buy dense slots — short requests
-    pack densely instead of pinning `seq_len` stripes."""
+    pack densely instead of pinning `seq_len` stripes.
+
+    kv_shared (paged only; None resolves from EDL_KV_SHARED, default
+    on) refcounts blocks and dedupes matching prompt prefixes to one
+    resident chain (copy-on-write on divergence) — N requests with the
+    same system prompt pay for its cache once. draft_k > 0 (with a
+    draft model handed to GenerationServer) turns each scheduler tick
+    into a speculative draft-verify step committing up to draft_k + 1
+    tokens, token-exact with plain decode."""
 
     def __init__(self, num_slots=4, queue_capacity=64, top_k=0,
                  top_p=1.0, checkpoint_dir="", reload_poll_secs=2.0,
                  telemetry_dir="", telemetry_flush_every=50,
                  idle_wait_secs=0.05, handler_poll_secs=0.25,
                  port=0, max_workers=64, kv_paged=None,
-                 kv_block_size=16, kv_num_blocks=0):
+                 kv_block_size=16, kv_num_blocks=0, kv_shared=None,
+                 draft_k=0):
         self.num_slots = int(num_slots)
         self.queue_capacity = int(queue_capacity)
         self.top_k = int(top_k)
@@ -85,6 +95,11 @@ class ServingConfig(object):
         )
         self.kv_block_size = int(kv_block_size)
         self.kv_num_blocks = int(kv_num_blocks)
+        self.kv_shared = (
+            kv_shared_default() if kv_shared is None
+            else bool(kv_shared)
+        )
+        self.draft_k = int(draft_k)
 
 
 class _Scheduler(threading.Thread):
@@ -157,13 +172,15 @@ class _Scheduler(threading.Thread):
             t0 = self._clock()
             results = self.engine.step()
             dt = self._clock() - t0
-            for _slot, req, token, finished in results:
-                req.push(("tokens", [token], req.model_version))
+            committed = 0
+            for _slot, req, tokens, finished in results:
+                req.push(("tokens", list(tokens), req.model_version))
+                committed += len(tokens)
                 if finished:
                     self._complete(req)
             kv = self.engine.kv_stats()
             self.telemetry.record_step(
-                len(self.queue), len(results), dt, len(results),
+                len(self.queue), len(results), dt, committed,
                 kv_bytes_in_use=kv["kv_bytes_in_use"],
                 kv_blocks_free=kv["kv_blocks_free"],
             )
@@ -237,8 +254,8 @@ class _Scheduler(threading.Thread):
                           "deadline expired mid-decode"))
             if not self.engine.active_count():
                 break
-            for _slot, req, token, finished in self.engine.step():
-                req.push(("tokens", [token], req.model_version))
+            for _slot, req, tokens, finished in self.engine.step():
+                req.push(("tokens", list(tokens), req.model_version))
                 if finished:
                     self._complete(req)
 
@@ -315,13 +332,21 @@ class ServingServicer(object):
             uptime_secs=snap["uptime_secs"],
             max_active_slots=snap["max_active_slots"],
             kv_paged=kv["kv_paged"],
+            kv_shared=kv["kv_shared"],
             kv_block_size=kv["kv_block_size"],
             kv_blocks_total=kv["kv_blocks_total"],
             kv_blocks_free=kv["kv_blocks_free"],
+            kv_blocks_cached=kv["kv_blocks_cached"],
+            kv_blocks_shared=kv["kv_blocks_shared"],
             kv_bytes_total=kv["kv_bytes_total"],
             kv_bytes_in_use=kv["kv_bytes_in_use"],
             kv_bytes_in_use_peak=snap["kv_bytes_in_use_peak"],
             kv_bytes_per_token=snap["kv_bytes_per_token"],
+            prefix_hit_tokens=kv["prefix_hit_tokens"],
+            cow_copies=kv["cow_copies"],
+            draft_k=self._engine.draft_k,
+            draft_proposed=self._engine.draft_proposed,
+            draft_accepted=self._engine.draft_accepted,
             draining=self._draining(),
             queue_wait_ms=snap["queue_wait_ms"],
             # percentiles + raw mergeable buckets from the shared
@@ -421,7 +446,8 @@ class GenerationServer(object):
     the servicer is callable directly, which is what the unit tests and
     the in-process bench mode use."""
 
-    def __init__(self, trainer, state, config=None, injector=None):
+    def __init__(self, trainer, state, config=None, injector=None,
+                 draft=None):
         self.config = config or ServingConfig()
         cfg = self.config
         if cfg.kv_paged:
@@ -430,8 +456,16 @@ class GenerationServer(object):
                 top_k=cfg.top_k, top_p=cfg.top_p,
                 block_size=cfg.kv_block_size,
                 num_blocks=cfg.kv_num_blocks,
+                share_prefix=cfg.kv_shared,
+                draft=draft, draft_k=cfg.draft_k,
             )
         else:
+            if draft is not None and cfg.draft_k:
+                raise ValueError(
+                    "speculative decode needs the paged pool "
+                    "(kv_paged=True) — the reclaimed blocks are what "
+                    "seat the draft"
+                )
             self.engine = ContinuousBatchingEngine(
                 trainer, state, cfg.num_slots,
                 top_k=cfg.top_k, top_p=cfg.top_p,
@@ -444,6 +478,9 @@ class GenerationServer(object):
             log_dir=cfg.telemetry_dir or None,
             flush_every=cfg.telemetry_flush_every,
         )
+        # the engine reports the events only it can see (prefix hits,
+        # CoW faults, draft accepts) through the same closed counters
+        self.engine.telemetry = self.telemetry
         watcher = None
         if cfg.checkpoint_dir:
             watcher = CheckpointWatcher(

@@ -58,9 +58,14 @@ from elasticdl_tpu.observability.histogram import LogLinearHistogram
 
 
 class ServingTelemetry(object):
-    #: the closed counter set — count() REJECTS anything else
+    #: the closed counter set — count() REJECTS anything else.
+    #: prefix_hit_tokens counts prompt tokens seated by shared-prefix
+    #: incref (never re-prefilled), cow_copies the copy-on-write
+    #: faults, draft_proposed/draft_accepted the speculative-decode
+    #: proposal economy (accept rate = accepted / proposed).
     COUNTERS = ("admitted", "rejected", "expired", "completed",
-                "tokens_generated", "reloads")
+                "tokens_generated", "reloads", "prefix_hit_tokens",
+                "cow_copies", "draft_proposed", "draft_accepted")
     #: latency histograms (ms), all on the shared bucket scheme
     HISTOGRAMS = ("ttft_ms", "queue_wait_ms", "step_ms", "e2e_ms")
 

@@ -365,20 +365,21 @@ class CausalSelfAttention(nn.Module):
                     "paged decode supports the plain-dtype KV format "
                     "only (kv_cache_dtype=%r)" % (self.kv_cache_dtype,)
                 )
-            if t != 1:
-                raise ValueError(
-                    "paged decode is single-token (got a chunk of %d)"
-                    % t
-                )
-            self.sow("kv_out", "k", k)  # [b, hkv, 1, d] for the
+            # t = 1: the classic per-token step. t > 1: a query TILE —
+            # the speculative verify-k step and the shared-prefix
+            # suffix prefill both decode t tokens at positions
+            # [idx, idx + t) in ONE batched read of the pool, causal
+            # within the tile (ops.paged_decode_attention).
+            self.sow("kv_out", "k", k)  # [b, hkv, t, d] for the
             self.sow("kv_out", "v", v)  # engine's pool scatter
             out = paged_decode_attention(
-                q[:, :, 0, :], k[:, :, 0, :], v[:, :, 0, :],
+                q, k, v,
                 paged["k"], paged["v"], paged["table"],
                 jnp.broadcast_to(idx, (b,)),
                 scale=d ** -0.5, window=self.window or None,
             ).astype(dtype)
-            return self._proj(out.reshape(b, 1, h * d), e)
+            out = out.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+            return self._proj(out, e)
         cvars = self._cache_vars(b, hkv, d, dtype)
         self._cache_write(cvars, k, v, idx)
         scale = d ** -0.5
@@ -526,7 +527,19 @@ def setup_decode_positions(mdl, tokens, decode, prefill, prompt_len):
         )
         decode_pos = pi.value
         pi.value = decode_pos + t
-        idx = (decode_pos + jnp.arange(t))[None, :]
+        idx = decode_pos + jnp.arange(t)
+        # Decode TILES (speculative verify, shared-prefix suffix
+        # prefill) may carry PAD rows whose positions run past
+        # seq_len. An out-of-bounds wpe gather fills NaN under jit,
+        # and a NaN k/v row poisons the whole tile through the
+        # attention value sum (0 weight x NaN = NaN) — clamp to the
+        # table. Real rows are always in bounds (the engine admits
+        # nothing past seq_len), so this only sanitizes pad rows,
+        # whose outputs are never read.
+        cap = getattr(mdl, "seq_len", None)
+        if cap is not None:
+            idx = jnp.minimum(idx, cap - 1)
+        idx = idx[None, :]
     else:
         if prefill:
             if prompt_len is None:

@@ -28,11 +28,21 @@ emits ONE JSON line:
   resident in the pool at peak, average KV bytes per generated token,
   block budget and admitted-vs-rejected under it.
 
---compare_paged runs the SAME arrival plan twice — the dense pool,
-then the block-paged pool (serving/kv_pool.py) holding the SAME total
-KV bytes spread over --paged_slots slots — and nests the paged record
-plus the headline ratios under "paged" / "paged_vs_dense". That A/B is
-the `make serve-smoke` shape: equal HBM, more admissible concurrency.
+--compare_paged runs the SAME arrival plan THREE ways — the dense
+pool, the block-paged pool (serving/kv_pool.py) with prefix sharing
+OFF, and the paged pool with prefix sharing ON (plus speculative
+decode when --draft_k > 0) — all holding the SAME total KV bytes —
+and nests the records plus headline ratios under "paged" /
+"paged_shared" / "paged_vs_dense" / "shared_vs_paged". That A/B is
+the `make serve-smoke` shape: equal HBM, more admissible concurrency,
+and (shared) deduped prefixes converting into admitted slots.
+
+--shared_prefix switches the workload to the system-prompt shape the
+sharing is FOR: every prompt = one of --prefix_pool common prefixes of
+--prefix_len tokens + a random --suffix_len suffix. --draft_k k seats
+a draft model (--draft_params; default = the target's params, i.e.
+self-draft — the acceptance ceiling) and verifies k drafted tokens
+per tick.
 
 Defaults are CPU-smoke sized; on hardware raise --requests/--rate and
 the model dims.
@@ -86,8 +96,30 @@ def parse_args(argv=None):
                    help="slot count for the paged side of "
                         "--compare_paged; 0 = 2x --num_slots")
     p.add_argument("--compare_paged", action="store_true",
-                   help="A/B the dense pool vs the paged pool at EQUAL "
-                        "total KV bytes; nests the paged record")
+                   help="A/B the dense pool vs the paged pool (shared "
+                        "off AND on) at EQUAL total KV bytes; nests "
+                        "the paged/paged_shared records")
+    p.add_argument("--kv_shared", type=int, default=1,
+                   help="1 = refcounted prefix sharing in the paged "
+                        "pool (single-run mode; --compare_paged runs "
+                        "both)")
+    # shared-prefix workload: common system prompts + random suffixes
+    p.add_argument("--shared_prefix", action="store_true",
+                   help="draw prompts as <common prefix> + <random "
+                        "suffix> instead of fully random")
+    p.add_argument("--prefix_len", type=int, default=16,
+                   help="tokens in each common system prompt")
+    p.add_argument("--prefix_pool", type=int, default=2,
+                   help="distinct system prompts in the pool")
+    p.add_argument("--suffix_len", default="1:4",
+                   help="min:max per-request suffix tokens (uniform)")
+    # speculative decode (paged+shared leg / single paged run)
+    p.add_argument("--draft_k", type=int, default=0,
+                   help="draft tokens per tick; 0 = speculative "
+                        "decode off")
+    p.add_argument("--draft_params", default="",
+                   help="draft model_params; empty = the target's "
+                        "(self-draft: the acceptance ceiling)")
     return p.parse_args(argv)
 
 
@@ -107,8 +139,9 @@ from elasticdl_tpu.observability.histogram import percentiles  # noqa: E402
 
 
 def build_rig(args):
-    """The trainer/state both A/B sides share (same params -> the
-    dense and paged runs serve identical token streams)."""
+    """The trainer/state every A/B side shares (same params -> the
+    dense and paged runs serve identical token streams), plus the
+    draft rig when --draft_k asks for speculative decode."""
     import jax
     import numpy as np
 
@@ -120,31 +153,63 @@ def build_rig(args):
     from model_zoo.transformer_lm import transformer_lm as zoo
 
     mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
-    trainer = Trainer(
-        load_model_spec_from_module(zoo), mesh=mesh,
-        model_params=args.model_params,
-    )
-    seq_len = int(trainer.model.seq_len)
-    dummy = np.zeros((1, seq_len), np.int32)
-    state = trainer.init_state(({"tokens": dummy}, dummy))
-    return trainer, state
+
+    def one(params):
+        trainer = Trainer(
+            load_model_spec_from_module(zoo), mesh=mesh,
+            model_params=params,
+        )
+        seq_len = int(trainer.model.seq_len)
+        dummy = np.zeros((1, seq_len), np.int32)
+        return trainer, trainer.init_state(({"tokens": dummy}, dummy))
+
+    trainer, state = one(args.model_params)
+    draft = None
+    if args.draft_k > 0:
+        draft = one(args.draft_params or args.model_params)
+    return trainer, state, draft
 
 
 def build_plan(args, seq_len, vocab):
     import numpy as np
 
-    p_lo, p_hi = _span(args.prompt_len)
     o_lo, o_hi = _span(args.out_len)
-    if p_hi + o_hi > seq_len:
-        raise SystemExit(
-            "prompt_len max %d + out_len max %d exceeds seq_len %d"
-            % (p_hi, o_hi, seq_len)
-        )
     rs = np.random.RandomState(args.seed)
+    if args.shared_prefix:
+        # the system-prompt workload: every request = one of a small
+        # pool of common prefixes + a short random suffix — what the
+        # refcounted prefix index dedupes to one resident chain
+        s_lo, s_hi = _span(args.suffix_len)
+        if args.prefix_len + s_hi + o_hi > seq_len:
+            raise SystemExit(
+                "prefix_len %d + suffix max %d + out max %d exceeds "
+                "seq_len %d"
+                % (args.prefix_len, s_hi, o_hi, seq_len)
+            )
+        pool = [
+            rs.randint(0, vocab, size=args.prefix_len)
+            for _ in range(max(1, args.prefix_pool))
+        ]
+
+        def prompt(i):
+            suffix = rs.randint(0, vocab,
+                                size=rs.randint(s_lo, s_hi + 1))
+            return np.concatenate([pool[i % len(pool)], suffix])
+    else:
+        p_lo, p_hi = _span(args.prompt_len)
+        if p_hi + o_hi > seq_len:
+            raise SystemExit(
+                "prompt_len max %d + out_len max %d exceeds seq_len %d"
+                % (p_hi, o_hi, seq_len)
+            )
+
+        def prompt(i):
+            return rs.randint(0, vocab,
+                              size=rs.randint(p_lo, p_hi + 1))
+
     return [
         {
-            "prompt": rs.randint(0, vocab,
-                                 size=rs.randint(p_lo, p_hi + 1)),
+            "prompt": prompt(i),
             "new": int(rs.randint(o_lo, o_hi + 1)),
             "gap": float(rs.exponential(1.0 / args.rate)),
             "seed": int(i),
@@ -154,7 +219,8 @@ def build_plan(args, seq_len, vocab):
 
 
 def run_load(args, trainer, state, plan, num_slots, kv_paged,
-             kv_block_size, kv_num_blocks):
+             kv_block_size, kv_num_blocks, kv_shared=False,
+             draft=None, draft_k=0):
     import jax
 
     from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -169,7 +235,10 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             kv_paged=kv_paged,
             kv_block_size=kv_block_size,
             kv_num_blocks=kv_num_blocks,
+            kv_shared=kv_shared,
+            draft_k=draft_k if draft is not None else 0,
         ),
+        draft=draft,
     ).start()
     stub = ServingStub(build_channel("localhost:%d" % server.port))
 
@@ -265,6 +334,7 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
         # memory-efficiency fields: the paged-vs-dense trajectory
         "kv": {
             "paged": bool(status.kv_paged),
+            "shared": bool(status.kv_shared),
             "block_size": status.kv_block_size,
             "blocks_total": status.kv_blocks_total,
             "bytes_total": status.kv_bytes_total,
@@ -272,12 +342,23 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             "bytes_per_token": round(status.kv_bytes_per_token, 1),
             "admitted": status.admitted,
             "rejected": status.rejected,
+            "prefix_hit_tokens": status.prefix_hit_tokens,
+            "cow_copies": status.cow_copies,
+        },
+        # speculative-decode economy (zeros when --draft_k is off)
+        "draft": {
+            "k": status.draft_k,
+            "proposed": status.draft_proposed,
+            "accepted": status.draft_accepted,
+            "accept_rate": round(
+                status.draft_accepted / status.draft_proposed, 3
+            ) if status.draft_proposed else 0.0,
         },
     }
 
 
 def run_bench(args):
-    trainer, state = build_rig(args)
+    trainer, state, draft = build_rig(args)
     seq_len = int(trainer.model.seq_len)
     vocab = int(trainer.model.vocab_size)
     plan = build_plan(args, seq_len, vocab)
@@ -296,20 +377,58 @@ def run_bench(args):
         kv_paged=bool(args.kv_paged),
         kv_block_size=args.kv_block_size,
         kv_num_blocks=num_blocks if args.kv_paged else 0,
+        kv_shared=bool(args.kv_paged and args.kv_shared),
+        draft=draft if args.kv_paged else None,
+        draft_k=args.draft_k,
     )
     if not args.compare_paged:
         return record
 
-    # paged side of the A/B: equal KV bytes (the dense pool's budget),
-    # spread over more slots — the concurrency those bytes now admit
+    # the A/B legs: equal KV bytes (the dense pool's budget), spread
+    # over more slots — first the private paged pool (the concurrency
+    # block granularity alone admits), then the prefix-SHARED pool
+    # (+ speculative decode when --draft_k is on): what dedup converts
+    # the same bytes into
     paged_slots = args.paged_slots or 2 * args.num_slots
     paged = run_load(
         args, trainer, state, plan, paged_slots,
         kv_paged=True,
         kv_block_size=args.kv_block_size,
         kv_num_blocks=dense_blocks,
+        kv_shared=False,
+    )
+    shared = run_load(
+        args, trainer, state, plan, paged_slots,
+        kv_paged=True,
+        kv_block_size=args.kv_block_size,
+        kv_num_blocks=dense_blocks,
+        kv_shared=True,
     )
     record["paged"] = paged
+    record["paged_shared"] = shared
+    if draft is not None:
+        # the draft on/off A/B rides the shared leg: same plan, same
+        # pool, plus the speculative draft-verify tick
+        spec = run_load(
+            args, trainer, state, plan, paged_slots,
+            kv_paged=True,
+            kv_block_size=args.kv_block_size,
+            kv_num_blocks=dense_blocks,
+            kv_shared=True,
+            draft=draft,
+            draft_k=args.draft_k,
+        )
+        record["paged_shared_spec"] = spec
+        shared_tok = shared["tokens_per_sec"] or 1e-9
+        record["spec_vs_shared"] = {
+            "draft_k": args.draft_k,
+            "tokens_per_sec": [shared["tokens_per_sec"],
+                               spec["tokens_per_sec"]],
+            "tokens_per_sec_ratio": round(
+                (spec["tokens_per_sec"] or 0.0) / shared_tok, 3
+            ),
+            "draft_accept_rate": spec["draft"]["accept_rate"],
+        }
     base_good = record["goodput_rps"] or 1e-9
     base_tok = record["tokens_per_sec"] or 1e-9
     record["paged_vs_dense"] = {
@@ -323,6 +442,24 @@ def run_bench(args):
                              paged["max_active_slots"]],
         "bytes_per_token": [record["kv"]["bytes_per_token"],
                             paged["kv"]["bytes_per_token"]],
+    }
+    paged_tok = paged["tokens_per_sec"] or 1e-9
+    paged_bpt = paged["kv"]["bytes_per_token"] or 1e-9
+    record["shared_vs_paged"] = {
+        "equal_kv_bytes": shared["kv"]["bytes_total"]
+        == paged["kv"]["bytes_total"],
+        "tokens_per_sec_ratio": round(
+            (shared["tokens_per_sec"] or 0.0) / paged_tok, 3
+        ),
+        "max_active_slots": [paged["max_active_slots"],
+                             shared["max_active_slots"]],
+        "bytes_per_token": [paged["kv"]["bytes_per_token"],
+                            shared["kv"]["bytes_per_token"]],
+        "bytes_per_token_improvement": round(
+            1.0 - (shared["kv"]["bytes_per_token"] or 0.0) / paged_bpt,
+            3,
+        ),
+        "prefix_hit_tokens": shared["kv"]["prefix_hit_tokens"],
     }
     return record
 

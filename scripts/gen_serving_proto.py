@@ -113,6 +113,21 @@ SERVING_MESSAGES = {
         # fleet-wide percentiles without percentile-averaging errors
         ("ttft_hist", 29, T.TYPE_INT64, _REP),
         ("queue_wait_hist", 30, T.TYPE_INT64, _REP),
+        # prefix-shared paged pool (serving/kv_pool.py): whether
+        # refcounted prefix sharing is on, blocks referenced by >1
+        # table right now, refcount-0 blocks held reclaimable by the
+        # prefix cache, prompt tokens seated by incref instead of
+        # re-prefilling, and copy-on-write faults served
+        ("kv_shared", 31, T.TYPE_BOOL, _OPT),
+        ("kv_blocks_shared", 32, T.TYPE_INT32, _OPT),
+        ("kv_blocks_cached", 33, T.TYPE_INT32, _OPT),
+        ("prefix_hit_tokens", 34, T.TYPE_INT64, _OPT),
+        ("cow_copies", 35, T.TYPE_INT64, _OPT),
+        # speculative decode: tokens drafted per tick (0 = off) and
+        # the proposal economy (accept rate = accepted / proposed)
+        ("draft_k", 36, T.TYPE_INT32, _OPT),
+        ("draft_proposed", 37, T.TYPE_INT64, _OPT),
+        ("draft_accepted", 38, T.TYPE_INT64, _OPT),
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],

@@ -21,12 +21,24 @@ Phase 2 — hard kill (EDL_FAULT_SPEC=generate:kill:1:skip=N, the same
   and common/retry.py classifies exactly these codes as transient for
   the retry-elsewhere path.
 
-Both phases run TWICE: against the dense KV pool and against the
+Phase 3 — shared-prefix ledger (paged mode: EDL_KV_SHARED=1): every
+  request carries a COMMON prompt prefix so refcounted shared chains
+  are resident (serving/kv_pool.py); a full wave completes and the
+  block ledger must drain clean (every block free or cached — no
+  leaked refcount, no double-free panic), then the server is SIGKILLed
+  mid-load with the chains still shared and a FRESH server must come
+  up, serve the same shared-prefix load, and drain to a clean ledger
+  again — a crash can never corrupt block accounting across restarts
+  because the ledger is process-local and rebuilt from nothing.
+
+All phases run TWICE: against the dense KV pool and against the
 block-paged pool (EDL_KV_PAGED=1, serving/kv_pool.py) — drain and
-SIGKILL semantics must hold regardless of where the cache rows live.
+SIGKILL semantics must hold regardless of where the cache rows live
+(phase 3's ledger assertions are paged-only; dense mode still proves
+the no-hang/clean-status contract under the shared-prefix load).
 
 Usage: python scripts/run_server_kill_drill.py
-Exit 0 = both phases hold in both modes."""
+Exit 0 = all phases hold in both modes."""
 
 import os
 import signal
@@ -82,23 +94,31 @@ def launch_ready(cmd, extra_env=None, ready_marker="SERVING_READY",
     return proc, port
 
 
-def start_server(extra_env=None):
+# the common system-prompt prefix phase 3 shares (2 full blocks at
+# the drill's --kv_block_size 4, so chains actually form)
+SHARED_PREFIX = [1, 2, 3, 4, 5, 6, 7, 2]
+
+
+def start_server(extra_env=None, num_slots=1):
     return launch_ready(
         [
             sys.executable, "-m", "elasticdl_tpu.serving.main",
             "--model_zoo", os.path.join(REPO, "model_zoo"),
             "--model_def", "transformer_lm.transformer_lm.custom_model",
             "--model_params", MODEL_PARAMS,
-            "--port", "0", "--num_slots", "1", "--queue_capacity", "4",
+            "--port", "0", "--num_slots", str(num_slots),
+            "--queue_capacity", "8", "--kv_block_size", "4",
         ],
         extra_env=extra_env,
     )
 
 
-def fire_requests(port, n, max_new=24):
+def fire_requests(port, n, max_new=24, shared_prefix=False):
     """n concurrent unary requests; returns (outcomes, elapsed) where
     outcomes[i] is 'OK' or a gRPC status name. Joins with a hard bound:
-    any thread still alive past the client timeout = a hang = failure."""
+    any thread still alive past the client timeout = a hang = failure.
+    shared_prefix=True sends the common system prompt + a per-request
+    tail, so the paged+shared pool builds refcounted chains."""
     import grpc
 
     from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -109,10 +129,14 @@ def fire_requests(port, n, max_new=24):
     lock = threading.Lock()
 
     def call(i):
+        prompt = (
+            SHARED_PREFIX + [1 + i % 5] if shared_prefix
+            else [1 + i % 5, 2]
+        )
         try:
             stub.generate(
                 pb.GenerateRequest(
-                    prompt=[1 + i % 5, 2], max_new_tokens=max_new,
+                    prompt=prompt, max_new_tokens=max_new,
                 ),
                 timeout=CLIENT_TIMEOUT,
             )
@@ -198,16 +222,92 @@ def phase_hard_kill(mode_env=None, mode="dense"):
     print("[drill] phase 2 (%s) OK" % mode)
 
 
+def _ledger(port):
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import ServingStub, build_channel
+
+    stub = ServingStub(build_channel("localhost:%d" % port))
+    return stub.server_status(pb.ServerStatusRequest(), timeout=30)
+
+
+def _assert_clean_ledger(st, where):
+    """Post-drain block accounting: every block free or cached —
+    a leaked refcount would show as blocks_free < blocks_total, a
+    double-free would have crashed the allocator long before."""
+    assert st.kv_blocks_free == st.kv_blocks_total, (
+        "%s: %d/%d blocks free (leaked refcount?)"
+        % (where, st.kv_blocks_free, st.kv_blocks_total)
+    )
+
+
+def phase_shared_ledger(mode_env=None, mode="dense"):
+    print("[drill] phase 3 (%s): shared prefixes resident through "
+          "SIGKILL + restart" % mode)
+    env = dict(mode_env or {})
+    env["EDL_KV_SHARED"] = "1"
+    proc, port = start_server(extra_env=env, num_slots=3)
+    paged = mode == "paged"
+    try:
+        # wave 1: completes fully; the ledger must drain clean with
+        # the prefix chains parked reclaimable (no leaked refcount)
+        threads, outcomes, t0 = fire_requests(
+            port, 6, max_new=16, shared_prefix=True
+        )
+        join_all(threads, outcomes, t0, 6)
+        assert set(outcomes.values()) == {"OK"}, outcomes
+        st = _ledger(port)
+        if paged:
+            assert st.kv_paged and st.kv_shared
+            assert st.prefix_hit_tokens > 0, (
+                "shared load never matched a prefix"
+            )
+            _assert_clean_ledger(st, "post-wave-1")
+        # wave 2: SIGKILL lands mid-load with shared chains LIVE
+        threads, outcomes, t0 = fire_requests(
+            port, 6, max_new=16, shared_prefix=True
+        )
+        time.sleep(0.3)
+        proc.kill()
+        join_all(threads, outcomes, t0, 6)
+        allowed = {"OK", "UNAVAILABLE", "CANCELLED",
+                   "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+        assert set(outcomes.values()) <= allowed, outcomes
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # restart: a fresh process must rebuild clean block accounting and
+    # serve the same shared-prefix load — nothing about the crash can
+    # poison the (process-local) ledger
+    proc, port = start_server(extra_env=env, num_slots=3)
+    try:
+        threads, outcomes, t0 = fire_requests(
+            port, 6, max_new=16, shared_prefix=True
+        )
+        join_all(threads, outcomes, t0, 6)
+        assert set(outcomes.values()) == {"OK"}, outcomes
+        st = _ledger(port)
+        if paged:
+            assert st.prefix_hit_tokens > 0
+            _assert_clean_ledger(st, "post-restart")
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+    print("[drill] phase 3 (%s) OK" % mode)
+
+
 def main():
-    # dense pool, then the block-paged pool (kv_block_size must divide
-    # the drill model's seq_len=32; the default 16 does)
+    # dense pool, then the block-paged pool (kv_block_size 4 divides
+    # the drill model's seq_len=32; sharing needs full blocks)
     for mode, env in (
         ("dense", {"EDL_KV_PAGED": "0"}),
         ("paged", {"EDL_KV_PAGED": "1"}),
     ):
         phase_graceful(mode_env=env, mode=mode)
         phase_hard_kill(mode_env=env, mode=mode)
-    print("[drill] serving kill drill PASSED (dense + paged)")
+        phase_shared_ledger(mode_env=env, mode=mode)
+    print("[drill] serving kill drill PASSED (dense + paged, shared-"
+          "prefix ledger)")
     return 0
 
 

@@ -1,0 +1,53 @@
+"""C11 negative fixture — the refcount pairs settle on every path:
+finally-guarded decref, the slot-level free as the chain's settle, and
+ownership transfer (the chain escapes to the caller / a container)."""
+
+
+class ChainSeater(object):
+    def __init__(self, allocator):
+        self._allocator = allocator
+        self._seated = {}
+
+    def seat_on_chain(self, allocator, bid, tokens):
+        allocator.incref(bid)
+        try:
+            if tokens > self.capacity():
+                return None
+            return bid
+        finally:
+            # a loop-shaped settle would NOT discharge the obligation
+            # (zero iterations is a real path); the direct call does
+            allocator.decref(bid)
+
+    def seat_shared(self, allocator, slot, prompt):
+        allocator.share(slot, prompt)
+        try:
+            rows = self.prefill(prompt)
+        except Exception:
+            allocator.free(slot)
+            raise
+        if rows is None:
+            allocator.free(slot)
+            return None
+        allocator.free(slot)
+        return rows
+
+    def diverge(self, allocator, slot, pos):
+        allocator.cow(slot, pos)
+        try:
+            return self.write_row(slot, pos)
+        finally:
+            allocator.free(slot)
+
+    def seat_deferred(self, allocator, bid, key):
+        allocator.incref(bid)
+        self._seated[key] = allocator  # ownership transferred to the map
+
+    def capacity(self):
+        return 0
+
+    def prefill(self, prompt):
+        return prompt
+
+    def write_row(self, slot, pos):
+        return bool(slot) and pos >= 0

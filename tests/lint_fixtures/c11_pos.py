@@ -1,0 +1,44 @@
+"""C11 positive fixture — EDL501 leaks of the prefix-shared KV pool's
+refcount pairs (serving/kv_pool.py discipline):
+
+1. an incref'd shared chain that an early-return path never decrefs —
+   the blocks (and their arena rows) stay pinned forever;
+2. a share() seat whose exception path drops the chain;
+3. a CoW copy abandoned when the post-copy write fails.
+"""
+
+
+class ChainSeater(object):
+    def __init__(self, allocator):
+        self._allocator = allocator
+
+    def seat_on_chain(self, allocator, chain, tokens):
+        for bid in chain:
+            allocator.incref(bid)
+        if tokens > self.capacity():
+            return None  # leak: the chain's refcounts never drop
+
+    def seat_shared(self, allocator, slot, prompt):
+        allocator.share(slot, prompt)
+        rows = self.prefill(prompt)
+        if rows is None:
+            raise RuntimeError("prefill failed")  # leak: no decref/free
+        allocator.free(slot)
+        return rows
+
+    def diverge(self, allocator, slot, pos):
+        allocator.cow(slot, pos)
+        ok = self.write_row(slot, pos)
+        if not ok:
+            return False  # leak: the CoW copy is never settled
+        allocator.free(slot)
+        return True
+
+    def capacity(self):
+        return 0
+
+    def prefill(self, prompt):
+        return prompt
+
+    def write_row(self, slot, pos):
+        return bool(slot) and pos >= 0

@@ -2,11 +2,14 @@
 
 The host-side block allocator (serving/kv_pool.py): alloc/extend/free
 reuse order, reservation-backed extends, fragmentation invariants under
-random request lengths, clean out-of-blocks signalling; plus the
-paged decode-attention op (ops/attention.py) against a dense oracle.
-Engine/server-level paged behavior (parity at concurrency, admission
-backpressure, reclamation on evict) lives in tests/test_serving_e2e.py
-on the drills shard."""
+random request lengths, clean out-of-blocks signalling; the
+prefix-sharing layer (refcounted chains, the content-addressed index,
+reclaimable-LRU revival/eviction, copy-on-write under reservation
+pressure); plus the paged decode-attention op (ops/attention.py)
+against a dense oracle for both the single-token step and the
+verify-k query tile. Engine/server-level paged behavior (parity at
+concurrency, admission backpressure, reclamation on evict) lives in
+tests/test_serving_e2e.py on the drills shard."""
 
 import numpy as np
 import pytest
@@ -28,16 +31,17 @@ def test_blocks_for():
 
 def test_alloc_free_reuse_order_is_lifo():
     a = BlockAllocator(num_blocks=8, block_size=4)
-    t0 = a.alloc("r0", tokens=8)          # 2 blocks
-    t1 = a.alloc("r1", tokens=4)          # 1 block
+    assert a.alloc("r0", tokens=8) == 0   # 2 blocks, nothing shared
+    assert a.alloc("r1", tokens=4) == 0   # 1 block
+    t0, t1 = a.table("r0"), a.table("r1")
     assert len(t0) == 2 and len(t1) == 1
     assert len(set(t0) | set(t1)) == 3    # disjoint
     assert a.num_free() == 5
     # free r0: its blocks come back and are reused FIRST, last-out
     # first-in (warm reuse)
     assert a.free("r0") == 2
-    t2 = a.alloc("r2", tokens=8)
-    assert t2 == list(reversed(t0))
+    a.alloc("r2", tokens=8)
+    assert a.table("r2") == list(reversed(t0))
     # double free is a harmless no-op
     assert a.free("r0") == 0
 
@@ -182,3 +186,224 @@ def test_paged_decode_attention_matches_dense_oracle():
                         err_msg="row %d head %d hkv=%d window=%r"
                                 % (i, j, hkv, window),
                     )
+
+
+def test_paged_decode_attention_tile_matches_dense_oracle():
+    """The verify-k query tile (speculative verify / shared-prefix
+    suffix prefill): row j attends every pool row < length plus tile
+    keys j' <= j, for MHA and GQA, with and without a window."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops.attention import paged_decode_attention
+
+    rs = np.random.RandomState(1)
+    bs, nb, d, b, t = 4, 10, 8, 3, 3
+    for hkv, h in ((2, 2), (1, 4)):
+        for window in (None, 5):
+            k_pool = rs.randn(nb, bs, hkv, d).astype(np.float32)
+            v_pool = rs.randn(nb, bs, hkv, d).astype(np.float32)
+            q = rs.randn(b, h, t, d).astype(np.float32)
+            k_cur = rs.randn(b, hkv, t, d).astype(np.float32)
+            v_cur = rs.randn(b, hkv, t, d).astype(np.float32)
+            lengths = np.asarray([0, 5, 11], np.int32)
+            table = np.full((b, 3), -1, np.int32)
+            table[1, :2] = [7, 2]
+            table[2, :3] = [4, 9, 1]
+            out = np.asarray(paged_decode_attention(
+                jnp.asarray(q), jnp.asarray(k_cur), jnp.asarray(v_cur),
+                jnp.asarray(k_pool), jnp.asarray(v_pool),
+                jnp.asarray(table), jnp.asarray(lengths),
+                window=window,
+            ))
+            assert out.shape == (b, h, t, d)
+            group = h // hkv
+            for i in range(b):
+                ln = int(lengths[i])
+                rows_k = np.concatenate(
+                    [k_pool[bid] for bid in table[i] if bid >= 0]
+                    or [np.zeros((0, hkv, d), np.float32)]
+                )[:ln]
+                rows_v = np.concatenate(
+                    [v_pool[bid] for bid in table[i] if bid >= 0]
+                    or [np.zeros((0, hkv, d), np.float32)]
+                )[:ln]
+                for jq in range(t):
+                    keys = np.concatenate(
+                        [rows_k, k_cur[i].transpose(1, 0, 2)[:jq + 1]]
+                    )
+                    vals = np.concatenate(
+                        [rows_v, v_cur[i].transpose(1, 0, 2)[:jq + 1]]
+                    )
+                    k_pos = np.arange(ln + jq + 1)
+                    keep = np.ones(len(k_pos), bool)
+                    if window is not None:
+                        keep = k_pos > ln + jq - window
+                    keys, vals = keys[keep], vals[keep]
+                    for j in range(h):
+                        kvh = j // group
+                        s = keys[:, kvh] @ q[i, j, jq] * d ** -0.5
+                        w = np.exp(s - s.max())
+                        w = w / w.sum()
+                        ref = w @ vals[:, kvh]
+                        np.testing.assert_allclose(
+                            out[i, j, jq], ref, rtol=2e-5, atol=2e-5,
+                            err_msg="row %d head %d tile %d hkv=%d "
+                                    "window=%r" % (i, j, jq, hkv,
+                                                   window),
+                        )
+
+
+# ------------------------------------------- prefix sharing + CoW
+
+
+def _shared(num_blocks=16, block_size=4):
+    return BlockAllocator(num_blocks=num_blocks, block_size=block_size,
+                          share_prefix=True)
+
+
+def test_prefix_match_seats_by_incref():
+    """An identical prompt seats on the resident chain: refcounts
+    bump, no fresh blocks are drawn for the shared prefix, and the
+    admission planner (can_seat) agrees with the seat."""
+    a = _shared()
+    prompt = list(range(10))  # 2 full blocks + a partial tail
+    a.alloc("r0", tokens=10, commit_tokens=14, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    free_before = a.num_free()
+    chain, needed = a.plan(prompt, 10, 14)
+    assert len(chain) == 2 and needed == 2  # 1 private + 1 growth
+    assert a.can_seat(prompt, 10, 14)
+    shared = a.alloc("r1", tokens=10, commit_tokens=14, prompt=prompt)
+    assert shared == 8
+    assert a.num_free() == free_before - 1  # only the private tail
+    assert a.table("r1")[:2] == a.table("r0")[:2]
+    assert a.table("r1")[2] != a.table("r0")[2]
+    assert a.shared_blocks() == 2
+    assert a.prefix_hits == 1 and a.prefix_hit_tokens == 8
+
+
+def test_shared_chain_freed_only_at_refcount_zero():
+    """free() decrefs; the chain's blocks leave the live set only when
+    the LAST owner releases them — and then to the reclaimable cache,
+    not the free list (they are still indexed)."""
+    a = _shared()
+    prompt = list(range(8))
+    a.alloc("r0", tokens=8, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    a.alloc("r1", tokens=8, prompt=prompt)
+    chain = a.table("r0")
+    assert a.table("r1") == chain  # fully shared (seat recomputes the
+    assert a.shared_blocks() == 2  # tail row via the CoW-credit path)
+    a.free("r0")
+    # r1 still owns the chain: nothing freed, nothing cached
+    assert a.blocks_in_use() == 2 and a.num_cached() == 0
+    a.free("r1")
+    assert a.blocks_in_use() == 0
+    assert a.num_cached() == 2  # reclaimable, revivable by a match
+    # a third request revives the chain at zero cost
+    free_before = a.num_free()
+    assert a.alloc("r2", tokens=8, prompt=prompt) == 8
+    assert a.num_cached() == 0 and a.num_free() == free_before
+
+
+def test_cow_under_reservation_pressure():
+    """A full-prompt match reserves ONE CoW credit at seat; the fault
+    draws it even when the pool is otherwise fully promised — and an
+    unplanned CoW with a dry pool raises cleanly."""
+    a = _shared(num_blocks=4, block_size=4)
+    prompt = list(range(8))
+    a.alloc("r0", tokens=8, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    # full-prompt match: 2 shared + 1 CoW credit reserved
+    chain, needed = a.plan(prompt, 8, 8)
+    assert len(chain) == 2 and needed == 1
+    a.alloc("r1", tokens=8, prompt=prompt)
+    # pool: 2 live shared + 2 free, 1 of them reserved for r1's CoW
+    assert a.available() == 1
+    # a competing alloc may take only the unreserved remainder
+    with pytest.raises(OutOfBlocks):
+        a.alloc("r2", tokens=8)
+    a.alloc("r2", tokens=4)
+    assert a.available() == 0
+    # the planned CoW still succeeds: it draws r1's credit
+    old, new = a.cow("r1", 1)
+    assert old == a.table("r0")[1] and a.table("r1")[1] == new
+    assert a.table("r0")[1] == old  # r0 keeps the original
+    # a SECOND (unplanned) CoW on the same slot has no credit and no
+    # free block -> clean OutOfBlocks, nothing taken
+    a.alloc("rX", tokens=0)  # no-op slot; keeps accounting honest
+    with pytest.raises(OutOfBlocks):
+        a.cow("r1", 0)
+    assert a.table("r1")[0] == a.table("r0")[0]
+
+
+def test_reclaimable_lru_eviction_is_leaf_first():
+    """Under pressure the allocator evicts reclaimable blocks from the
+    index; a chain's deeper blocks (leaves) go before their parents,
+    so a surviving partial chain still matches."""
+    a = _shared(num_blocks=4, block_size=4)
+    prompt = list(range(16))  # 4 full blocks
+    a.alloc("r0", tokens=16, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    a.free("r0")
+    assert a.num_cached() == 4 and a.num_free() == 0
+    # a private alloc must evict exactly one reclaimable block — and
+    # the LEAF (deepest chain block), never a parent
+    a.alloc("r1", tokens=4)
+    assert a.num_cached() == 3
+    chain = a.match_prefix(prompt)
+    assert len(chain) == 3  # prefix [0, 12) still matchable
+    a.free("r1")
+    # flush (the hot-reload hook) returns every cached block to free
+    a.flush_index()
+    assert a.num_cached() == 0 and a.num_free() == 4
+    assert a.match_prefix(prompt) == []
+
+
+def test_fragmentation_under_mixed_shared_private_churn():
+    """Random admit/complete churn with a pool of recurring system
+    prompts: conservation (live + free + cached == total), disjoint
+    private ownership, refcount consistency, and a drained pool is
+    whole again."""
+    rs = np.random.RandomState(11)
+    a = _shared(num_blocks=32, block_size=4)
+    prompts = [list(range(100 + i, 100 + i + 8)) for i in range(3)]
+    live = {}
+    for i in range(400):
+        if live and (rs.rand() < 0.45 or not a.can_fit(24)):
+            slot = rs.choice(sorted(live))
+            a.free(slot)
+            del live[slot]
+        else:
+            shared_prompt = rs.rand() < 0.6
+            prompt = (prompts[rs.randint(len(prompts))]
+                      if shared_prompt else
+                      [int(x) for x in rs.randint(0, 50, size=6)])
+            total = len(prompt) + int(rs.randint(1, 17))
+            slot = "r%d" % i
+            if a.can_seat(prompt, len(prompt), total):
+                a.alloc(slot, len(prompt), commit_tokens=total,
+                        prompt=prompt)
+                a.register_prefix(slot, prompt)
+                live[slot] = prompt
+                a.extend(slot, min(total,
+                                   len(prompt) + int(rs.randint(0, 9))))
+        # ---- invariants
+        assert a.blocks_in_use() + a.num_free() + a.num_cached() == 32
+        assert a.available() >= 0
+        refs = {}
+        for s in live:
+            for b in a.table(s):
+                refs[b] = refs.get(b, 0) + 1
+        # every live table block carries exactly its reference count
+        for b, n in refs.items():
+            assert a._refcount.get(b, 0) == n, (b, n)
+        # no block is simultaneously free/cached and referenced
+        assert not (set(refs) & set(a._free))
+        assert not (set(refs) & set(a._cached))
+    for slot in list(live):
+        a.free(slot)
+    assert a.blocks_in_use() == 0
+    assert a.num_free() + a.num_cached() == 32
+    a.flush_index()
+    assert a.num_free() == 32 and a.available() == 32

@@ -186,6 +186,23 @@ def test_c8_negative_settled_paths_are_clean():
     assert lint_file("c8_neg.py") == []
 
 
+def test_c11_positive_flags_refcount_leaks():
+    """The prefix-shared KV pool's refcount pairs: an incref'd chain
+    lost to an early return, a share() seat dropped on the exception
+    path, and an abandoned CoW copy."""
+    findings = lint_file("c11_pos.py")
+    assert rule_ids(findings) == ["EDL501"] * 3, findings
+    details = {f.detail for f in findings}
+    assert {"allocator.incref", "allocator.share",
+            "allocator.cow"} == details
+
+
+def test_c11_negative_settled_refcounts_are_clean():
+    """finally-guarded decref, slot-level free settles on every
+    branch, and the ownership-transfer escape."""
+    assert lint_file("c11_neg.py") == []
+
+
 # ------------------------------ C9: EDL202/EDL203 deadline propagation
 
 
@@ -250,7 +267,7 @@ def test_every_rule_has_fixture_coverage():
     emitted = set()
     for name in ("c1_pos.py", "c2_pos.py", "c3_pos.py", "c5_pos.py",
                  "c6_pos.py", "c7_pos.py", "c8_pos.py", "c9_pos.py",
-                 "c10_pos.py"):
+                 "c10_pos.py", "c11_pos.py"):
         emitted.update(f.rule for f in lint_file(name))
     ast_rule_ids = set()
     for rule in all_rules():

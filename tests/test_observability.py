@@ -207,7 +207,7 @@ class FinishingEngine(object):
             finished = len(req.generated) >= req.max_new_tokens
             if finished:
                 del self._slots[slot]
-            out.append((slot, req, 12, finished))
+            out.append((slot, req, [12], finished))
         return out
 
     def set_params(self, state, version):
@@ -216,10 +216,17 @@ class FinishingEngine(object):
     def max_cached_tokens(self):
         return self.seq_len
 
+    draft_k = 0
+    draft_proposed = 0
+    draft_accepted = 0
+
     def kv_stats(self):
-        return {"kv_paged": False, "kv_block_size": 0,
+        return {"kv_paged": False, "kv_shared": False,
+                "kv_block_size": 0,
                 "kv_blocks_total": 0, "kv_blocks_free": 0,
-                "kv_bytes_total": 0, "kv_bytes_in_use": 0}
+                "kv_blocks_cached": 0, "kv_blocks_shared": 0,
+                "kv_bytes_total": 0, "kv_bytes_in_use": 0,
+                "prefix_hit_tokens": 0, "cow_copies": 0}
 
 
 def _replica_rig():

@@ -206,7 +206,7 @@ class FakeEngine(object):
     def step(self):
         if self._slot is None:
             return []
-        return [(0, self._slot, 12, False)]
+        return [(0, self._slot, [12], False)]
 
     def set_params(self, state, version):
         self.reloaded.append(version)
@@ -215,10 +215,17 @@ class FakeEngine(object):
     def max_cached_tokens(self):
         return self.seq_len
 
+    draft_k = 0
+    draft_proposed = 0
+    draft_accepted = 0
+
     def kv_stats(self):
-        return {"kv_paged": False, "kv_block_size": 0,
+        return {"kv_paged": False, "kv_shared": False,
+                "kv_block_size": 0,
                 "kv_blocks_total": 0, "kv_blocks_free": 0,
-                "kv_bytes_total": 0, "kv_bytes_in_use": 0}
+                "kv_blocks_cached": 0, "kv_blocks_shared": 0,
+                "kv_bytes_total": 0, "kv_bytes_in_use": 0,
+                "prefix_hit_tokens": 0, "cow_copies": 0}
 
 
 def _rig(clock):
