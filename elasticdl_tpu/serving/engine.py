@@ -691,7 +691,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 jnp.asarray(request.temperature, jnp.float32),
             )
         if self.telemetry is not None:
-            self.telemetry.count("prefix_hit_tokens", start)
+            # count the allocator-reported shared tokens so this stays
+            # in lockstep with BlockAllocator.prefix_hit_tokens (start
+            # is shared - 1 on a full-prompt match: the re-run row)
+            self.telemetry.count("prefix_hit_tokens", shared)
         if hasattr(request, "trace_event"):
             request.trace_event("prefix_hit", slot=slot,
                                 shared_tokens=start, suffix_tokens=t)

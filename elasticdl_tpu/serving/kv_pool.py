@@ -183,9 +183,16 @@ class BlockAllocator(object):
         """(chain, needed) for seating `prompt` with `tokens` rows now
         and `commit_tokens` promised: the matched shared chain and how
         many blocks the seat would draw from `available()` (fresh
-        blocks + the CoW credit for a full-prompt match). The
-        admission-time answer `can_seat` and the seat itself (`alloc`)
-        both run through this, so they cannot disagree."""
+        blocks, the CoW credit for a full-prompt match, and the
+        RECLAIMABLE chain blocks the seat would revive — reviving pops
+        a block out of the cache `available()` counts, so it costs
+        capacity exactly like a fresh draw). The admission-time answer
+        `can_seat` and the seat itself (`alloc`) both run through
+        this, so they cannot disagree."""
+        chain, needed, _cow = self._plan(prompt, tokens, commit_tokens)
+        return chain, needed
+
+    def _plan(self, prompt, tokens, commit_tokens=None):
         now = blocks_for(tokens, self.block_size)
         commit = max(
             now, blocks_for(commit_tokens or tokens, self.block_size)
@@ -194,10 +201,21 @@ class BlockAllocator(object):
         chain = chain[:now]
         # full-prompt match: the engine must re-run the last prompt
         # token for its logits, which re-writes that token's row into
-        # the shared tail block -> one planned CoW copy, reserved here
+        # the shared tail block -> one planned CoW copy, reserved here.
+        # EXCEPT when the tail is reclaimable (refcount 0): the seat
+        # revives it as sole owner and the re-write lands in place, so
+        # no copy can fault — its cost is the revival charge below,
+        # and charging both would refuse a full-budget reseat forever
+        # on an idle pool
         cow = 1 if (chain and len(chain) * self.block_size
-                    >= int(tokens)) else 0
-        return chain, commit - len(chain) + cow
+                    >= int(tokens)
+                    and chain[-1] not in self._cached) else 0
+        # chain blocks at refcount 0 are counted by available(); the
+        # seat revives them (incref pops the cache), so they must be
+        # charged or reservations can exceed free + reclaimable and
+        # a reservation-backed extend could strand mid-decode
+        revived = sum(1 for b in chain if b in self._cached)
+        return chain, commit - len(chain) + cow + revived, cow
 
     def can_seat(self, prompt, tokens, commit_tokens=None):
         _chain, needed = self.plan(prompt, tokens, commit_tokens)
@@ -309,13 +327,12 @@ class BlockAllocator(object):
         commit = max(
             now, blocks_for(commit_tokens or tokens, self.block_size)
         )
-        chain, needed = self.plan(prompt, tokens, commit_tokens)
+        chain, needed, cow = self._plan(prompt, tokens, commit_tokens)
         if needed > self.available():
             raise OutOfBlocks(
                 "need %d new blocks (%d now, %d shared), %d available"
                 % (needed, now, len(chain), self.available())
             )
-        cow = needed - (commit - len(chain))  # 1 on a full-prompt match
         for bid in chain:
             self.incref(bid)
         fresh = []

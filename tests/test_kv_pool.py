@@ -337,6 +337,46 @@ def test_cow_under_reservation_pressure():
     assert a.table("r1")[0] == a.table("r0")[0]
 
 
+def test_seat_on_reclaimable_chain_charges_revived_blocks():
+    """Admission must charge the reclaimable chain blocks a seat
+    revives: incref pops them out of the cache available() counts, so
+    an uncharged revival lets _reserved exceed free + cached and a
+    reservation-backed extend strands MID-DECODE. Repro from review:
+    4-block pool, a 12-token prompt cached whole, then the same prompt
+    with a commitment of 5 blocks — it must be refused at admission,
+    not admitted and killed at its first extend."""
+    a = _shared(num_blocks=4, block_size=4)
+    prompt = list(range(12))  # 3 full blocks
+    a.alloc("e", tokens=12, commit_tokens=13, prompt=prompt)
+    a.register_prefix("e", prompt)
+    a.free("e")
+    assert a.num_cached() == 3 and a.num_free() == 1
+    # commit 17 tokens = 5 blocks > pool; the shared seat would revive
+    # 3 cached blocks (charged) + 2 growth = 5 > 4 (no CoW charge: the
+    # revived tail is sole-owned, its re-write lands in place)
+    chain, needed = a.plan(prompt, 12, 17)
+    assert len(chain) == 3 and needed == 5
+    assert not a.can_seat(prompt, 12, 17)
+    with pytest.raises(OutOfBlocks):
+        a.alloc("b", tokens=12, commit_tokens=17, prompt=prompt)
+    # nothing was taken by the refused seat
+    assert a.num_cached() == 3 and a.num_free() == 1
+    # the revival charge must not DOUBLE-charge the tail as a CoW
+    # credit: a full-budget reseat (commit = the whole pool) is
+    # physically seatable — 3 revived + 1 growth — and refusing it
+    # would starve it forever on an idle pool
+    assert a.can_seat(prompt, 12, 16)
+    assert a.alloc("b", tokens=12, commit_tokens=16,
+                   prompt=prompt) == 12
+    assert a.available() == 0  # 3 revived live, 1 free reserved
+    # "b" owns the revived tail alone: write-in-place, no copy
+    assert a.cow("b", 2) is None
+    a.extend("b", 16)  # the growth block draws the reservation
+    assert a.num_free() == 0 and a.available() == 0
+    a.free("b")
+    assert a.num_free() + a.num_cached() == 4 and a.available() == 4
+
+
 def test_reclaimable_lru_eviction_is_leaf_first():
     """Under pressure the allocator evicts reclaimable blocks from the
     index; a chain's deeper blocks (leaves) go before their parents,
