@@ -31,9 +31,14 @@
 #                   master/worker, re-formation, elasticity bench
 #   drill         — one real local training job + status validation,
 #                   then the master SIGKILL/journal-recovery drill, the
-#                   serving SIGTERM/SIGKILL drill, and the multi-replica
+#                   serving SIGTERM/SIGKILL drill, the multi-replica
 #                   router chaos drill (SIGKILL + hot reload under live
-#                   load, zero accepted-request loss)
+#                   load, zero accepted-request loss), and the elastic-
+#                   fleet autoscale drill (ramped Poisson load forces a
+#                   scale-up, a SIGKILL forces a replacement, idle
+#                   forces a drain-based scale-down; supervisor
+#                   kill+restart re-adopts from its journal; p99 TTFT
+#                   SLO held across every replica-count change)
 #   serve-smoke   — closed-loop load vs the generation server; emits
 #                   the BENCH_SERVING.json serving-throughput record
 #   cluster-smoke — kind/minikube manifests smoke, env-gated
@@ -78,6 +83,7 @@ drill:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_master_kill_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_server_kill_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_router_chaos_drill.py
+	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_autoscale_drill.py
 
 # Serving smoke: closed-loop load against the real continuous-batching
 # server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput).
@@ -86,10 +92,13 @@ drill:
 # (private), paged + refcounted prefix sharing, and paged + sharing +
 # speculative decode (draft_k) — bytes-per-token, prefix-hit tokens,
 # CoW copies and the draft accept rate recorded under
-# "kv"/"paged"/"paged_shared"/"paged_shared_spec"
+# "kv"/"paged"/"paged_shared"/"paged_shared_spec". Arrivals follow a
+# --ramp piecewise-Poisson profile (the SAME generator the autoscale
+# drill uses), so every record also carries per-phase percentiles
+# under "phases".
 serve-smoke:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py \
-		--requests 16 --rate 32 --compare_paged --kv_block_size 4 \
+		--ramp "8:0.8,32:0.5,8:0.5" --compare_paged --kv_block_size 4 \
 		--shared_prefix --prefix_len 16 --suffix_len 1:4 \
 		--out_len 4:12 --draft_k 2 \
 		--out BENCH_SERVING.json

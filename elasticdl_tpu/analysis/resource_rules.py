@@ -22,7 +22,9 @@ Two resource shapes:
   still carry the obligation (``return f.read()`` does not excuse an
   ``except`` branch that drops ``f``).
   Registered: ``start_span``→``finish``, ``open``→``close`` (when not
-  in a ``with``), ``build_channel``→``close``.
+  in a ``with``), ``build_channel``→``close``, and the supervisor
+  launcher's ``Popen``→``wait``/``communicate`` (a killed-but-never-
+  waited child is a zombie until its parent exits).
 
 * **receiver-bound** — ``rep.begin_dispatch()``: the RECEIVER owns a
   slot until a paired method releases it. Settled by
@@ -36,10 +38,14 @@ Two resource shapes:
   Registered: ``breaker.acquire``→``record_success``/
   ``record_failure``/``release_probe`` (the three-way settle from
   PR 4's fix), ``begin_dispatch``→``end_dispatch``,
-  ``begin_poll``→``end_poll``, ``<alloc>.alloc``→``free``, and the
+  ``begin_poll``→``end_poll``, ``<alloc>.alloc``→``free``, the
   prefix-shared pool's refcount pairs ``<alloc>.incref``/``share``/
   ``cow``→``decref``/``free`` (a leaked block reference pins arena
-  rows forever; the CoW draw owns its copy like any table block).
+  rows forever; the CoW draw owns its copy like any table block), and
+  the replica supervisor's seat lifecycle
+  ``<supervis*>.spawn``→``adopt``/``reap`` + ``begin_drain``→
+  ``retire``/``reap`` (serving/autoscaler.py: a seat lost between
+  spawn and adoption is an orphan process no journal remembers).
 
 Guarded acquisition idioms are recognized so the common "probe or
 bail" shape does not false-positive:
@@ -90,6 +96,13 @@ RECEIVER_PAIRS = {
     # is owned like any other table block and must settle through the
     # same decref/free discipline
     "cow": (frozenset(["decref", "free"]), "alloc"),
+    # the replica supervisor's seat lifecycle (serving/autoscaler.py):
+    # a spawned seat must be adopted into the roster or reaped on
+    # EVERY path — a seat lost between Popen and adoption is an orphan
+    # process no journal remembers; a drain begun must end in retire
+    # (or reap, the escalation) or the seat leaks mid-drain forever
+    "spawn": (frozenset(["adopt", "reap"]), "supervis"),
+    "begin_drain": (frozenset(["retire", "reap"]), None),
 }
 
 #: value-bound acquires: callable tail -> release method names
@@ -97,8 +110,11 @@ VALUE_ACQUIRES = {
     "start_span": frozenset(["finish"]),
     "open": frozenset(["close"]),
     "build_channel": frozenset(["close"]),
+    # a launcher Popen handle must be waited on (or escape to an
+    # owner that will): a killed-but-never-waited child is a zombie
+    # pinned until the supervisor exits
+    "Popen": frozenset(["wait", "communicate"]),
 }
-
 
 def _recv_text(node):
     parts = []

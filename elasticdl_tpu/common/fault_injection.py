@@ -256,6 +256,22 @@ SERVING_RPCS = (
     "server_status",
 ) + ROUTER_RPCS
 
+# The replica supervisor/autoscaler's process boundary
+# (serving/autoscaler.py). These are intercept HOOKS like the master's
+# worker_launch/worker_exit, not servicer methods: the supervisor calls
+# intercept() directly at each lifecycle step, so a spec can
+# manufacture exactly the failures its restart/backoff/circuit
+# machinery claims to survive —
+#   supervisor_spawn:drop:1          one spawn fails outright
+#   supervisor_ready:delay:*:secs=2  every replica is slow to ready
+#   supervisor_adopt:drop:1          one adoption is dropped (the seat
+#                                    is reaped and respawned)
+SUPERVISOR_RPCS = (
+    "supervisor_spawn",
+    "supervisor_ready",
+    "supervisor_adopt",
+)
+
 
 class FaultInjectingServicer(object):
     """Transparent servicer wrapper: same RPC surface, with

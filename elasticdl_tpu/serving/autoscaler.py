@@ -1,0 +1,960 @@
+"""Replica supervisor/autoscaler: the serving fleet's elasticity core.
+
+The router (serving/router.py) owns the replica REGISTRY — leases,
+breakers, load signals — but the fleet behind it is static: a traffic
+burst can only shed, and a dead replica is only removed, never
+replaced. This module closes the loop the way the master's instance
+manager does for worker pods (master/instance_manager.py): a
+supervisor that owns GenerationServer replica PROCESSES, holds a
+desired-count target, and converges the live roster onto it.
+
+    ReplicaSupervisor ──spawn/SIGTERM/SIGKILL──> replica processes
+          │  ^                                        │
+          │  └── lease/queue-wait/KV signals ── Router registry
+          └───── add_replica / remove_replica ───────┘
+
+One single-threaded decide loop (no watcher threads — every state
+transition happens inside `decide_once`, which makes the whole state
+machine clockable and unit-testable) runs three passes per tick:
+
+* **poll** — each seat's process is polled for exit and readiness.
+  A STARTING seat that prints its `SERVING_READY port=N` line is
+  ADOPTED: registered with the live router, journal first. A LIVE
+  seat that exits (or sits wedged: lease expired / breaker stuck open
+  past `wedged_after_secs`) is REAPED and replaced. A DRAINING seat
+  that exits is RETIRED: unregistered, channel closed.
+
+* **reconcile** — deficit (roster below target) spawns one replica
+  per tick, gated by a full-jitter exponential backoff after failures
+  and a `max_restarts` consecutive-failure CIRCUIT: a replica that
+  cannot come up (bad flags, poisoned checkpoint) must not be
+  respawned in a hot loop forever. Surplus drains one replica per
+  tick: SIGTERM (the replica advertises `draining`, finishes its
+  in-flight work, exits 0), wait for the exit, then retire — never a
+  kill of live work on the scale-down path.
+
+* **policy** — the scaling decision itself, driven purely by signals
+  the router already aggregates from heartbeats: sustained queue-wait
+  EWMA / queue depth above threshold for `up_window_secs` raises the
+  target; a fleet that is sustained-idle (no queued, no in-flight,
+  queue wait ~0, optional free-KV headroom) for `down_window_secs`
+  lowers it. Flapping is structurally impossible: decisions require
+  the fleet to be SETTLED (no seat starting or draining), every
+  decision starts a `cooldown_secs` dead time, both windows must be
+  SUSTAINED (any counter-signal resets them), and min/max bounds cap
+  the target.
+
+**Crash-safe supervision**: every lifecycle transition (`spawn` ->
+`launched` -> `adopt`, `begin_drain` -> `retire`, `reap`, target
+changes) is write-ahead journaled through the master's WAL machinery
+(master/state_store.py: journal.jsonl + compacted snapshot, torn-line
+tolerant). A supervisor that crashes and restarts replays the journal
+and RE-ADOPTS still-alive replicas — attaching to their pids and
+re-reading their log files for the ready line — instead of orphaning
+or double-spawning them; a seat whose pid died during the outage is
+reaped and respawned through the normal deficit path.
+
+Fault injection: the supervisor's three process-boundary hooks are
+interceptable under SUPERVISOR_RPCS (common/fault_injection.py) —
+`supervisor_spawn` (spawn-fail), `supervisor_ready` (slow-ready), and
+`supervisor_adopt` (adopt-drop) — so chaos specs can drill the
+failure handling exactly like the servicer boundaries.
+
+Drill: scripts/run_autoscale_drill.py ramps Poisson load through the
+real stack and asserts scale-up, SIGKILL replacement, drain-based
+scale-down, supervisor crash-recovery, zero accepted-request loss and
+a bounded p99 TTFT across every replica-count change.
+"""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.master.state_store import JobStateStore
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+STARTING = "starting"
+LIVE = "live"
+DRAINING = "draining"
+
+
+class AutoscalerConfig(object):
+    """Policy + supervision knobs. The scale-up window should be a few
+    heartbeat periods (the queue-wait EWMA only moves when polls land);
+    cooldown_secs must exceed the router's poll period by enough that a
+    decision's effect is VISIBLE in the signals before the next
+    decision is allowed — that, plus the settled-fleet gate, is what
+    makes flapping structurally impossible rather than merely
+    unlikely."""
+
+    def __init__(self, min_replicas=1, max_replicas=4,
+                 decide_secs=0.5,
+                 up_queue_wait_ms=200.0, up_queue_depth=4,
+                 up_window_secs=2.0,
+                 idle_queue_wait_ms=25.0, down_window_secs=6.0,
+                 down_free_kv_blocks=0,
+                 cooldown_secs=5.0,
+                 ready_timeout_secs=180.0, drain_timeout_secs=60.0,
+                 wedged_after_secs=30.0,
+                 max_restarts=3, base_delay_secs=0.2,
+                 max_delay_secs=5.0,
+                 journal_dir="", snapshot_every=100):
+        self.min_replicas = max(0, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.decide_secs = float(decide_secs)
+        self.up_queue_wait_ms = float(up_queue_wait_ms)
+        self.up_queue_depth = int(up_queue_depth)
+        self.up_window_secs = float(up_window_secs)
+        self.idle_queue_wait_ms = float(idle_queue_wait_ms)
+        self.down_window_secs = float(down_window_secs)
+        # scale-down additionally requires this much free paged-KV
+        # headroom across the fleet (0 disables the gate — the dense
+        # pool reports no block counts)
+        self.down_free_kv_blocks = int(down_free_kv_blocks)
+        self.cooldown_secs = float(cooldown_secs)
+        self.ready_timeout_secs = float(ready_timeout_secs)
+        self.drain_timeout_secs = float(drain_timeout_secs)
+        self.wedged_after_secs = float(wedged_after_secs)
+        self.max_restarts = int(max_restarts)
+        self.base_delay_secs = float(base_delay_secs)
+        self.max_delay_secs = float(max_delay_secs)
+        self.journal_dir = journal_dir
+        self.snapshot_every = int(snapshot_every)
+
+
+# ----------------------------------------------------------- launchers
+
+
+def _pid_alive(pid):
+    """Liveness for a pid we may or may not be the parent of: reap a
+    child zombie via waitpid, fall back to signal 0 + /proc Z-state
+    for non-children. Returns (alive, returncode_or_None)."""
+    try:
+        done, status = os.waitpid(pid, os.WNOHANG)
+        if done == 0:
+            return True, None
+        if hasattr(os, "waitstatus_to_exitcode"):
+            return False, os.waitstatus_to_exitcode(status)
+        return False, status
+    except ChildProcessError:
+        pass
+    except OSError:
+        return False, None
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False, None
+    try:
+        with open("/proc/%d/stat" % pid) as f:
+            if f.read().split(")")[-1].split()[0] == "Z":
+                return False, None
+    except (OSError, IndexError):
+        pass
+    return True, None
+
+
+def _scan_ready_line(log_path, marker):
+    """Port from the `<marker> port=N` line in a replica's log file,
+    or None. The log FILE (not a pipe) is what makes readiness
+    recoverable: a supervisor that crashed before the line appeared
+    can still learn the port after a restart."""
+    try:
+        with open(log_path, errors="replace") as f:
+            for line in f:
+                if line.startswith(marker):
+                    return int(line.strip().split("port=")[1])
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
+class _SpawnedHandle(object):
+    """A replica process this supervisor launched (Popen-backed)."""
+
+    def __init__(self, proc, log_path, marker, host):
+        self._proc = proc
+        self.pid = proc.pid
+        self.log_path = log_path
+        self._marker = marker
+        self._host = host
+
+    def poll(self):
+        return self._proc.poll()
+
+    def ready(self):
+        port = _scan_ready_line(self.log_path, self._marker)
+        return None if port is None else "%s:%d" % (self._host, port)
+
+    def terminate(self):
+        if self._proc.poll() is None:
+            self._proc.terminate()
+
+    def kill(self):
+        if self._proc.poll() is None:
+            self._proc.kill()
+
+
+class _AttachedHandle(object):
+    """A replica process inherited from a DEAD supervisor: no Popen,
+    just a pid to watch (and its log file for the ready line)."""
+
+    def __init__(self, pid, log_path, marker, host):
+        self.pid = pid
+        self.log_path = log_path
+        self._marker = marker
+        self._host = host
+        self._rc = None
+        self._dead = False
+
+    def poll(self):
+        if self._dead:
+            return self._rc if self._rc is not None else 1
+        alive, rc = _pid_alive(self.pid)
+        if alive:
+            return None
+        self._dead = True
+        self._rc = rc
+        return self._rc if self._rc is not None else 1
+
+    def ready(self):
+        if not self.log_path:
+            return None
+        port = _scan_ready_line(self.log_path, self._marker)
+        return None if port is None else "%s:%d" % (self._host, port)
+
+    def _signal(self, sig):
+        try:
+            os.kill(self.pid, sig)
+        except OSError:
+            pass
+
+    def terminate(self):
+        self._signal(signal.SIGTERM)
+
+    def kill(self):
+        self._signal(signal.SIGKILL)
+
+
+class SubprocessReplicaLauncher(object):
+    """Launches `python -m elasticdl_tpu.serving.main <replica_args>`
+    replicas with stdout+stderr to a per-seat LOG FILE under log_dir —
+    never a pipe: a pipe dies with the supervisor, a file survives it,
+    which is what lets a restarted supervisor re-read the ready line
+    of a replica spawned by its dead predecessor."""
+
+    def __init__(self, replica_args, log_dir, env=None,
+                 ready_marker="SERVING_READY", host="localhost",
+                 cwd=None):
+        self.replica_args = list(replica_args)
+        self.log_dir = log_dir
+        self.env = dict(env) if env is not None else None
+        self.ready_marker = ready_marker
+        self.host = host
+        self.cwd = cwd
+        os.makedirs(log_dir, exist_ok=True)
+
+    def _log_path(self, seat_id):
+        return os.path.join(self.log_dir, "replica-%d.log" % seat_id)
+
+    def spawn(self, seat_id):
+        cmd = (
+            [sys.executable, "-m", "elasticdl_tpu.serving.main"]
+            + self.replica_args
+        )
+        log_path = self._log_path(seat_id)
+        log = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd, cwd=self.cwd, env=self.env,
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+        finally:
+            log.close()  # the child holds its own fd now
+        return _SpawnedHandle(proc, log_path, self.ready_marker,
+                              self.host)
+
+    def attach(self, seat_id, pid, log_path):
+        return _AttachedHandle(pid, log_path, self.ready_marker,
+                               self.host)
+
+
+# ---------------------------------------------------------- supervisor
+
+
+class _Seat(object):
+    """One replica slot in the roster: a process handle plus its
+    lifecycle state (starting -> live -> draining -> gone)."""
+
+    __slots__ = ("seat_id", "handle", "state", "address",
+                 "spawned_at", "drain_since", "unhealthy_since")
+
+    def __init__(self, seat_id, handle, state, spawned_at, address=""):
+        self.seat_id = seat_id
+        self.handle = handle
+        self.state = state
+        self.address = address
+        self.spawned_at = spawned_at
+        self.drain_since = None
+        self.unhealthy_since = None
+
+
+class ReplicaSupervisor(object):
+    """Desired-state supervisor over replica processes + the live
+    Router registry. All state transitions run inside `decide_once`
+    under one lock; `status_block()` (served through router_status)
+    reads under the same lock. Constructing over a journal_dir that
+    already has state RECOVERS: still-alive replicas are re-adopted,
+    dead ones reaped — never double-spawned, never orphaned."""
+
+    def __init__(self, router, launcher, config=None,
+                 clock=time.monotonic, injector=None, rng=None):
+        from elasticdl_tpu.common.fault_injection import FaultInjector
+
+        self.config = config or AutoscalerConfig()
+        self._router = router
+        self._launcher = launcher
+        self._clock = clock
+        # EDL_FAULT_SPEC arms the supervisor_spawn / supervisor_ready /
+        # supervisor_adopt hooks (SUPERVISOR_RPCS) unless an explicit
+        # injector is handed in
+        self._injector = injector or FaultInjector.from_env()
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._seats = {}
+        self._next_seat = 0
+        self.target = self.config.min_replicas
+        # decision bookkeeping (status_block surfaces all of it)
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.replacements = 0
+        self.spawn_failures = 0
+        self.circuit_open = False
+        self.last_decision = "init"
+        self.last_reason = "supervisor created"
+        self.last_decision_at = self._clock()
+        self.supervisor_restarts = 0
+        # hysteresis state
+        self._above_since = None
+        self._idle_since = None
+        self._idle_routed = None  # routed count at idle-window start
+        self._cooldown_until = 0.0
+        self._consec_failures = 0
+        self._next_spawn_at = 0.0
+        self._stop = threading.Event()
+        self._thread = None
+        self._store = None
+        self._compact_pending = False
+        if self.config.journal_dir:
+            self._store = JobStateStore(
+                self.config.journal_dir,
+                snapshot_every=self.config.snapshot_every,
+            )
+            if self._store.has_state():
+                self._recover()
+            else:
+                self._journal({"ev": "target", "n": self.target,
+                               "why": "init"})
+
+    # ------------------------------------------------------- journaling
+
+    def _journal(self, event):
+        if self._store is None:
+            return
+        if self._store.append(event):
+            # compaction is DEFERRED to the end of the decide tick:
+            # a snapshot taken mid-transition (event journaled, roster
+            # not yet mutated) would truncate the journal while
+            # silently dropping the in-flight seat — an orphan on
+            # recovery
+            self._compact_pending = True
+
+    def _maybe_compact(self):
+        if self._store is not None and self._compact_pending:
+            self._store.write_snapshot(self._state_dict())
+            self._compact_pending = False
+
+    def _state_dict(self):
+        seats = {}
+        for seat in self._seats.values():
+            seats[str(seat.seat_id)] = {
+                "state": seat.state,
+                "pid": seat.handle.pid,
+                "address": seat.address,
+                "log": getattr(seat.handle, "log_path", ""),
+            }
+        return {
+            "target": self.target,
+            "next_seat": self._next_seat,
+            "seats": seats,
+            "counters": {
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "replacements": self.replacements,
+                "spawn_failures": self.spawn_failures,
+            },
+        }
+
+    @staticmethod
+    def _apply_event(state, ev):
+        """Replay one journal event onto a snapshot dict. Idempotent
+        under replay: transitions for unknown seats are no-ops (the
+        snapshot already incorporates them)."""
+        kind = ev.get("ev")
+        seats = state["seats"]
+        sid = str(ev.get("seat", ""))
+        counters = state.setdefault("counters", {})
+
+        def bump(name):
+            counters[name] = int(counters.get(name, 0)) + 1
+
+        if kind == "target":
+            state["target"] = int(ev["n"])
+            # decision counters replay from the journal too, so a
+            # recovered supervisor reports the roster's full history,
+            # not just what happened since the last snapshot
+            if ev.get("why") == "scale_up":
+                bump("scale_ups")
+            elif ev.get("why") == "scale_down":
+                bump("scale_downs")
+        elif kind == "spawn":
+            state["next_seat"] = max(
+                state.get("next_seat", 0), int(ev["seat"]) + 1
+            )
+        elif kind == "launched":
+            seats[sid] = {"state": STARTING, "pid": int(ev["pid"]),
+                          "address": "", "log": ev.get("log", "")}
+        elif kind == "adopt":
+            if sid in seats:
+                seats[sid]["state"] = LIVE
+                seats[sid]["address"] = ev.get("address", "")
+        elif kind == "begin_drain":
+            if sid in seats:
+                seats[sid]["state"] = DRAINING
+        elif kind in ("retire", "reap"):
+            if kind == "reap":
+                why = str(ev.get("why", ""))
+                if why.startswith("exited"):
+                    bump("replacements")  # unplanned live death
+                elif why != "dead at recovery":
+                    bump("spawn_failures")
+            seats.pop(sid, None)
+
+    def _recover(self):
+        """Rebuild the roster from the journal and RE-ADOPT replicas
+        that survived the supervisor outage: attach to their pids, read
+        their log files for the address, re-register with the router.
+        Dead pids are reaped; the deficit path respawns them."""
+        snapshot, events = self._store.load()
+        state = snapshot or {"target": self.target, "next_seat": 0,
+                             "seats": {}, "counters": {}}
+        for ev in events:
+            self._apply_event(state, ev)
+        self.target = max(
+            self.config.min_replicas,
+            min(self.config.max_replicas, int(state.get("target", 0))),
+        )
+        self._next_seat = int(state.get("next_seat", 0))
+        counters = state.get("counters", {})
+        self.scale_ups = int(counters.get("scale_ups", 0))
+        self.scale_downs = int(counters.get("scale_downs", 0))
+        self.replacements = int(counters.get("replacements", 0))
+        self.spawn_failures = int(counters.get("spawn_failures", 0))
+        self.supervisor_restarts = self._store.restart_count
+        now = self._clock()
+        for sid_text, info in sorted(state.get("seats", {}).items(),
+                                     key=lambda kv: int(kv[0])):
+            sid = int(sid_text)
+            handle = self._launcher.attach(
+                sid, int(info["pid"]), info.get("log", "")
+            )
+            if handle.poll() is not None:
+                # died during the outage: reap now (including its
+                # stale router registration — the lease would decay
+                # it from ROTATION, but the registry entry and its
+                # channel must not leak); respawn via the deficit path
+                self._journal({"ev": "reap", "seat": sid,
+                               "why": "dead at recovery"})
+                if info.get("address"):
+                    self._router.remove_replica(info["address"])
+                continue
+            seat = _Seat(sid, handle, info.get("state", STARTING),
+                         spawned_at=now,
+                         address=info.get("address", ""))
+            if seat.state == STARTING:
+                # the replica may have become ready while we were
+                # dead — the log file remembers
+                address = handle.ready()
+                if address:
+                    seat.address = address
+                    seat.state = LIVE
+                    self._journal({"ev": "adopt", "seat": sid,
+                                   "pid": handle.pid,
+                                   "address": address})
+            if seat.state in (LIVE, DRAINING) and seat.address:
+                self._router.add_replica(seat.address)
+            self._seats[sid] = seat
+            logger.info(
+                "autoscaler recovery: re-adopted seat %d pid %d (%s, "
+                "%s)", sid, handle.pid, seat.state,
+                seat.address or "no address yet",
+            )
+        self._record(now, "recover",
+                     "re-adopted %d seats from the journal"
+                     % len(self._seats))
+        self._maybe_compact()
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="replica-supervisor"
+        )
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.decide_once()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("autoscaler decide tick failed")
+            self._stop.wait(self.config.decide_secs)
+
+    def stop(self, grace=60.0):
+        """Graceful shutdown: SIGTERM every replica, wait for drains,
+        retire the roster, close the journal."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        with self._lock:
+            for seat in self._seats.values():
+                seat.handle.terminate()
+            deadline = time.monotonic() + grace
+            while time.monotonic() < deadline and any(
+                s.handle.poll() is None for s in self._seats.values()
+            ):
+                time.sleep(0.1)
+            for seat in list(self._seats.values()):
+                if seat.handle.poll() is None:
+                    seat.handle.kill()
+                self._journal({"ev": "retire", "seat": seat.seat_id,
+                               "why": "supervisor stop"})
+                if seat.address:
+                    self._router.remove_replica(seat.address)
+                del self._seats[seat.seat_id]
+            self._maybe_compact()
+            if self._store is not None:
+                self._store.close()
+
+    def abandon(self):
+        """Stop deciding WITHOUT journaling or touching any replica —
+        the crash-recovery drills' stand-in for supervisor process
+        death: the journal and the replica processes are left exactly
+        as a SIGKILL would leave them."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        if self._store is not None:
+            self._store.close()
+
+    # ------------------------------------------------------ decide tick
+
+    def decide_once(self):
+        with self._lock:
+            now = self._clock()
+            self._poll_seats(now)
+            self._policy(now)
+            self._reconcile(now)
+            self._maybe_compact()
+
+    def _intercept(self, name):
+        if self._injector is not None:
+            self._injector.intercept(name)
+
+    def _record(self, now, decision, reason):
+        self.last_decision = decision
+        self.last_reason = reason
+        self.last_decision_at = now
+        logger.info("autoscaler: %s (%s)", decision, reason)
+
+    # ---- pass 1: seat lifecycle
+
+    def _poll_seats(self, now):
+        for seat in list(self._seats.values()):
+            rc = seat.handle.poll()
+            if seat.state == STARTING:
+                self._poll_starting(seat, rc, now)
+            elif seat.state == LIVE:
+                self._poll_live(seat, rc, now)
+            else:  # DRAINING
+                self._poll_draining(seat, rc, now)
+
+    def _poll_starting(self, seat, rc, now):
+        if rc is not None:
+            self._spawn_failed(
+                seat, now, "died before ready (rc=%s)" % rc
+            )
+            return
+        if now - seat.spawned_at > self.config.ready_timeout_secs:
+            seat.handle.kill()
+            self._spawn_failed(
+                seat, now,
+                "not ready after %.0fs" % self.config.ready_timeout_secs,
+            )
+            return
+        address = seat.handle.ready()
+        if not address:
+            return
+        try:
+            # slow-ready faults delay here; adopt-drop faults abort
+            # the adoption — the seat is reaped and respawned through
+            # the backoff/circuit path like any other spawn failure
+            self._intercept("supervisor_ready")
+            self._intercept("supervisor_adopt")
+        except Exception as e:  # noqa: BLE001 - injected faults
+            seat.handle.kill()
+            self._spawn_failed(seat, now, "adopt failed: %r" % e)
+            return
+        seat.address = address
+        seat.state = LIVE
+        self._journal({"ev": "adopt", "seat": seat.seat_id,
+                       "pid": seat.handle.pid, "address": address})
+        self._router.add_replica(address)
+        self._consec_failures = 0
+        logger.info("autoscaler: adopted seat %d -> %s (pid %d)",
+                    seat.seat_id, address, seat.handle.pid)
+
+    def _poll_live(self, seat, rc, now):
+        if rc is not None:
+            self._reap_live(seat, now, "exited rc=%s" % rc)
+            return
+        # wedged detection: the process is alive but the router cannot
+        # renew its lease (SIGSTOP, hard hang) or its breaker never
+        # leaves OPEN — either way it serves nothing; replace it.
+        # wedged_after_secs must be CONSERVATIVE (default 30s): under
+        # hard overload a replica's status RPC can starve behind
+        # blocked generate handlers, and shooting the fleet's busiest
+        # replica at peak load is the one failure mode worse than a
+        # hung one — the lease must stay dead for a long, deliberate
+        # window before the supervisor reaches for SIGKILL
+        rep = self._router_view().get(seat.address)
+        unhealthy = rep is not None and (
+            not rep.lease_ok(now) or rep.breaker.state == "open"
+        )
+        if not unhealthy:
+            seat.unhealthy_since = None
+            return
+        if seat.unhealthy_since is None:
+            seat.unhealthy_since = now
+            return
+        if now - seat.unhealthy_since >= self.config.wedged_after_secs:
+            logger.warning(
+                "autoscaler: seat %d (%s) wedged for %.1fs — killing "
+                "for replacement", seat.seat_id, seat.address,
+                now - seat.unhealthy_since,
+            )
+            seat.handle.kill()  # the exit lands in a later tick
+
+    def _poll_draining(self, seat, rc, now):
+        if rc is not None:
+            self._journal({"ev": "retire", "seat": seat.seat_id,
+                           "rc": rc})
+            if seat.address:
+                self._router.remove_replica(seat.address)
+            del self._seats[seat.seat_id]
+            logger.info("autoscaler: retired seat %d (rc=%s)",
+                        seat.seat_id, rc)
+            return
+        if (seat.drain_since is not None
+                and now - seat.drain_since
+                > self.config.drain_timeout_secs):
+            logger.warning(
+                "autoscaler: seat %d drain exceeded %.0fs — killing",
+                seat.seat_id, self.config.drain_timeout_secs,
+            )
+            seat.handle.kill()
+
+    def _spawn_failed(self, seat, now, why):
+        self._journal({"ev": "reap", "seat": seat.seat_id, "why": why})
+        del self._seats[seat.seat_id]
+        self.spawn_failures += 1
+        self._consec_failures += 1
+        if self._consec_failures >= self.config.max_restarts:
+            if not self.circuit_open:
+                self.circuit_open = True
+                self._record(
+                    now, "circuit_open",
+                    "%d consecutive spawn failures (last: %s)"
+                    % (self._consec_failures, why),
+                )
+                logger.error(
+                    "autoscaler: restart circuit OPEN after %d "
+                    "consecutive failures — no more respawns until "
+                    "the supervisor is restarted", self._consec_failures,
+                )
+            return
+        delay = self._backoff(self._consec_failures - 1)
+        self._next_spawn_at = now + delay
+        logger.warning(
+            "autoscaler: seat %d spawn failed (%s); retry in %.2fs "
+            "(failure %d/%d)", seat.seat_id, why, delay,
+            self._consec_failures, self.config.max_restarts,
+        )
+
+    def _reap_live(self, seat, now, why):
+        """Unplanned loss of a LIVE replica: reap it; the deficit path
+        respawns the capacity (bounded by the same backoff/circuit)."""
+        self._journal({"ev": "reap", "seat": seat.seat_id, "why": why})
+        if seat.address:
+            self._router.remove_replica(seat.address)
+        del self._seats[seat.seat_id]
+        self.replacements += 1
+        self._record(now, "replace",
+                     "seat %d %s" % (seat.seat_id, why))
+
+    def _backoff(self, attempt):
+        """Full-jitter exponential backoff (AWS-style), on the
+        supervisor's own rng so tests can pin it."""
+        cap = min(self.config.max_delay_secs,
+                  self.config.base_delay_secs * (2 ** attempt))
+        return self._rng.uniform(0, cap)
+
+    # ---- pass 2: scaling policy
+
+    def _router_view(self):
+        return {r.address: r for r in self._router.replicas()}
+
+    def _policy(self, now):
+        n_starting = sum(1 for s in self._seats.values()
+                         if s.state == STARTING)
+        n_draining = sum(1 for s in self._seats.values()
+                         if s.state == DRAINING)
+        live = [s for s in self._seats.values() if s.state == LIVE]
+        # decisions only on a SETTLED fleet: while a spawn or a drain
+        # is still in flight the last decision's effect is not yet in
+        # the signals, and acting again would be acting blind
+        if n_starting or n_draining or not live:
+            self._above_since = None
+            self._idle_since = None
+            self._idle_routed = None
+            return
+        view = self._router_view()
+        sigs = [view[s.address] for s in live if s.address in view]
+        if not sigs:
+            self._above_since = None
+            self._idle_since = None
+            self._idle_routed = None
+            return
+        cfg = self.config
+        busiest_wait = max(r.queue_wait_ms for r in sigs)
+        deepest_queue = max(r.queue_depth for r in sigs)
+        quiet = all(
+            r.queue_depth == 0 and r.inflight == 0
+            and r.active_slots == 0
+            for r in sigs
+        )
+        # the wait EWMA is a LAGGING signal: alone (frozen from a
+        # burst that already ended) it is not pressure — there must be
+        # actual work present. quiet and pressure are thus mutually
+        # exclusive by construction.
+        pressure = ((not quiet
+                     and busiest_wait >= cfg.up_queue_wait_ms)
+                    or deepest_queue >= cfg.up_queue_depth)
+        # the queue-wait EWMA only moves when requests flow: after a
+        # burst stops dead it FREEZES at its last (high) value, so the
+        # EWMA gate alone would block scale-down forever. Zero routed
+        # traffic across the whole idle window is equally hard
+        # evidence of idleness — either satisfies the gate.
+        routed = self._router.telemetry.snapshot()["routed"]
+        ewma_ok = busiest_wait <= cfg.idle_queue_wait_ms
+        no_traffic = (self._idle_routed is not None
+                      and routed == self._idle_routed)
+        idle = quiet and (ewma_ok or no_traffic)
+        if cfg.down_free_kv_blocks > 0:
+            # reclaimable cached blocks (refcount-0 prefix chains
+            # parked by the shared pool) count as headroom: they are
+            # evictable on demand — with sharing on, a drained fleet
+            # parks EVERYTHING cached and free alone would read zero
+            idle = idle and sum(
+                r.kv_blocks_free + r.kv_blocks_cached for r in sigs
+            ) >= cfg.down_free_kv_blocks
+        self._above_since = (
+            (self._above_since or now) if pressure else None
+        )
+        if quiet:
+            if self._idle_routed is None:
+                self._idle_routed = routed
+        else:
+            self._idle_routed = None
+        self._idle_since = (self._idle_since or now) if idle else None
+        if now < self._cooldown_until:
+            return
+        if (self._above_since is not None
+                and now - self._above_since >= cfg.up_window_secs
+                and self.target < cfg.max_replicas):
+            self.target += 1
+            self.scale_ups += 1
+            self._cooldown_until = now + cfg.cooldown_secs
+            self._above_since = None
+            self._record(
+                now, "scale_up",
+                "queue_wait %.0fms / depth %d sustained %.1fs -> "
+                "target %d" % (busiest_wait, deepest_queue,
+                               cfg.up_window_secs, self.target),
+            )
+            self._journal({"ev": "target", "n": self.target,
+                           "why": "scale_up"})
+        elif (self._idle_since is not None
+                and now - self._idle_since >= cfg.down_window_secs
+                and self.target > cfg.min_replicas):
+            self.target -= 1
+            self.scale_downs += 1
+            self._cooldown_until = now + cfg.cooldown_secs
+            self._idle_since = None
+            self._record(
+                now, "scale_down",
+                "fleet idle %.1fs -> target %d"
+                % (cfg.down_window_secs, self.target),
+            )
+            self._journal({"ev": "target", "n": self.target,
+                           "why": "scale_down"})
+
+    # ---- pass 3: converge roster onto target
+
+    def _reconcile(self, now):
+        active = [s for s in self._seats.values()
+                  if s.state in (STARTING, LIVE)]
+        if len(active) < self.target:
+            if self.circuit_open or now < self._next_spawn_at:
+                return
+            self._spawn(now)
+        elif len(active) > self.target:
+            self._shrink_one(now)
+
+    def _spawn(self, now):
+        seat_id = self._next_seat
+        self._next_seat += 1
+        self._journal({"ev": "spawn", "seat": seat_id})
+        try:
+            self._intercept("supervisor_spawn")
+            handle = self._launcher.spawn(seat_id)
+        except Exception as e:  # noqa: BLE001 - spawn-fail drills
+            self._journal({"ev": "reap", "seat": seat_id,
+                           "why": "spawn raised: %r" % e})
+            self.spawn_failures += 1
+            self._consec_failures += 1
+            if self._consec_failures >= self.config.max_restarts:
+                if not self.circuit_open:
+                    self.circuit_open = True
+                    self._record(
+                        now, "circuit_open",
+                        "%d consecutive spawn failures (last: %r)"
+                        % (self._consec_failures, e),
+                    )
+            else:
+                self._next_spawn_at = now + self._backoff(
+                    self._consec_failures - 1
+                )
+            logger.warning("autoscaler: spawn of seat %d failed: %r",
+                           seat_id, e)
+            return
+        self._journal({"ev": "launched", "seat": seat_id,
+                       "pid": handle.pid,
+                       "log": getattr(handle, "log_path", "")})
+        self._seats[seat_id] = _Seat(seat_id, handle, STARTING,
+                                     spawned_at=now)
+        logger.info("autoscaler: spawned seat %d (pid %d)",
+                    seat_id, handle.pid)
+
+    def _shrink_one(self, now):
+        # prefer aborting a seat that never went live — no work to
+        # drain — then the least-loaded live seat, newest first
+        starting = [s for s in self._seats.values()
+                    if s.state == STARTING]
+        if starting:
+            seat = max(starting, key=lambda s: s.seat_id)
+            seat.handle.kill()
+            seat.state = DRAINING  # the exit retires it
+            seat.drain_since = now
+            self._journal({"ev": "begin_drain", "seat": seat.seat_id,
+                           "why": "surplus before ready"})
+            return
+        view = self._router_view()
+
+        def load(seat):
+            rep = view.get(seat.address)
+            if rep is None:
+                return (0, -seat.seat_id)
+            return (rep.queue_depth + rep.active_slots + rep.inflight,
+                    -seat.seat_id)
+
+        live = [s for s in self._seats.values() if s.state == LIVE]
+        if not live:
+            return
+        seat = min(live, key=load)
+        self._begin_drain(seat, now)
+
+    def _begin_drain(self, seat, now):
+        self._journal({"ev": "begin_drain", "seat": seat.seat_id})
+        seat.state = DRAINING
+        seat.drain_since = now
+        # SIGTERM -> the replica closes admission, advertises
+        # `draining` (the router takes it out of rotation for NEW
+        # requests), finishes in-flight work and exits 0; the exit is
+        # what retires the seat
+        seat.handle.terminate()
+        logger.info("autoscaler: draining seat %d (%s)",
+                    seat.seat_id, seat.address)
+
+    # ----------------------------------------------------------- status
+
+    def counts(self):
+        with self._lock:
+            return {
+                state: sum(1 for s in self._seats.values()
+                           if s.state == state)
+                for state in (STARTING, LIVE, DRAINING)
+            }
+
+    def roster(self):
+        """Snapshot of the seats (drills/tests/operator tooling)."""
+        with self._lock:
+            return [
+                {"seat": s.seat_id, "state": s.state,
+                 "pid": s.handle.pid, "address": s.address}
+                for s in sorted(self._seats.values(),
+                                key=lambda s: s.seat_id)
+            ]
+
+    def status_block(self):
+        """The router_status autoscaler block (pb.AutoscalerStatus)."""
+        with self._lock:
+            now = self._clock()
+            n = {state: 0 for state in (STARTING, LIVE, DRAINING)}
+            for seat in self._seats.values():
+                n[seat.state] += 1
+            return pb.AutoscalerStatus(
+                enabled=True,
+                target=self.target,
+                live=n[LIVE],
+                starting=n[STARTING],
+                draining=n[DRAINING],
+                scale_ups=self.scale_ups,
+                scale_downs=self.scale_downs,
+                replacements=self.replacements,
+                spawn_failures=self.spawn_failures,
+                circuit_open=self.circuit_open,
+                last_decision=self.last_decision,
+                last_reason=self.last_reason,
+                last_decision_age_secs=max(
+                    0.0, now - self.last_decision_at
+                ),
+                supervisor_restarts=self.supervisor_restarts,
+            )

@@ -39,6 +39,12 @@ def parse_serving_args(args=None):
     parser.add_argument("--top_k", type=int, default=0)
     parser.add_argument("--top_p", type=float, default=1.0)
     parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--max_workers", type=int, default=64,
+                        help="gRPC handler threads; size ABOVE the "
+                             "expected concurrent in-flight RPCs — a "
+                             "pool full of blocked generate handlers "
+                             "starves server_status and the router "
+                             "reads the silence as lease decay")
     parser.add_argument("--reload_poll_secs", type=float, default=2.0)
     parser.add_argument("--tensorboard_log_dir", default="")
     # KV pool layout: -1 resolves from EDL_KV_PAGED (the drill/CI
@@ -60,6 +66,11 @@ def parse_serving_args(args=None):
                         help="zoo model_def for the draft; empty = "
                              "speculative decode off")
     parser.add_argument("--draft_model_params", default="")
+    # pre-READY warmup: generate this many tokens in-process before
+    # printing the readiness line, so the jit compile is paid BEFORE a
+    # router/autoscaler routes live traffic here (a freshly adopted
+    # replica must not serve its first request cold)
+    parser.add_argument("--warmup_tokens", type=int, default=0)
     return parser.parse_args(args)
 
 
@@ -118,6 +129,7 @@ def build_server(args):
             reload_poll_secs=args.reload_poll_secs,
             telemetry_dir=args.tensorboard_log_dir,
             port=args.port,
+            max_workers=args.max_workers,
             kv_paged=None if args.kv_paged < 0 else bool(args.kv_paged),
             kv_block_size=args.kv_block_size,
             kv_num_blocks=args.kv_num_blocks,
@@ -133,9 +145,26 @@ def build_server(args):
     return server
 
 
+def warmup(server, tokens):
+    """One in-process generate through the UNWRAPPED servicer: pays
+    the jit compile (and records nothing against armed fault rules)
+    before the process advertises readiness."""
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+    server.raw_servicer.generate(
+        pb.GenerateRequest(prompt=[1, 2], max_new_tokens=tokens)
+    )
+    # the compile-heavy warmup latency must never surface in the
+    # percentiles a router/autoscaler SLOs on
+    server.telemetry.reset_latency()
+    logger.info("warmup complete (%d tokens)", tokens)
+
+
 def main(argv=None):
     args = parse_serving_args(argv)
     server = build_server(args).start()
+    if args.warmup_tokens > 0:
+        warmup(server, args.warmup_tokens)
     # name this process's span recorder after the bound port; spans
     # export to $EDL_TRACE_DIR on stop (plus an atexit backstop)
     from elasticdl_tpu.observability.tracing import configure

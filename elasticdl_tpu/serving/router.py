@@ -233,6 +233,12 @@ class Replica(object):
         self.address = address
         self.stub = stub
         self.breaker = breaker
+        # retire/close state: remove_replica marks the entry retired;
+        # the channel closes once every in-flight poll AND dispatch
+        # has settled (closing under a live call would turn a healthy
+        # heartbeat into a transport error)
+        self.retired = False
+        self._closed = False
         # registration grants one lease period of grace so routing
         # works before the first poll lands; a dead replica burns the
         # grace on its breaker instead
@@ -241,6 +247,10 @@ class Replica(object):
         self.queue_depth = 0
         self.active_slots = 0
         self.kv_blocks_free = 0
+        # refcount-0 blocks parked reclaimable by the prefix cache:
+        # not free, but evictable on demand — real headroom for the
+        # autoscaler's scale-down gate
+        self.kv_blocks_cached = 0
         self.queue_wait_ms = 0.0
         self.ttft_hist = []
         self.queue_wait_hist = []
@@ -266,6 +276,7 @@ class Replica(object):
     def end_poll(self):
         with self._inflight_lock:
             self._poll_inflight = False
+        self._maybe_close()
 
     def begin_dispatch(self):
         with self._inflight_lock:
@@ -275,6 +286,35 @@ class Replica(object):
     def end_dispatch(self):
         with self._inflight_lock:
             self.inflight -= 1
+        self._maybe_close()
+
+    def retire(self):
+        """Take this entry out of service for good: close the gRPC
+        channel now if nothing is in flight, otherwise defer the close
+        to the last in-flight poll/dispatch settling — safe against a
+        concurrent heartbeat poll by construction. Idempotent."""
+        with self._inflight_lock:
+            self.retired = True
+        return self._maybe_close()
+
+    def _maybe_close(self):
+        close_now = False
+        with self._inflight_lock:
+            if (self.retired and not self._closed
+                    and not self._poll_inflight and not self.inflight):
+                self._closed = True
+                close_now = True
+        if not close_now:
+            return False
+        # outside the lock: a real grpc channel close can block
+        close = getattr(self.stub, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception as e:  # noqa: BLE001 - best-effort close
+                logger.debug("closing channel to %s failed: %r",
+                             self.address, e)
+        return True
 
     def lease_ok(self, now):
         return now < self.lease_expires_at
@@ -302,6 +342,7 @@ class Replica(object):
         self.queue_depth = status.queue_depth
         self.active_slots = status.active_slots
         self.kv_blocks_free = status.kv_blocks_free
+        self.kv_blocks_cached = status.kv_blocks_cached
         self.queue_wait_ms = status.queue_wait_ms
         # raw histogram buckets (mergeable by addition): the router
         # sums these across replicas for fleet-wide percentiles
@@ -312,7 +353,12 @@ class Replica(object):
 def _default_stub_factory(address):
     from elasticdl_tpu.proto.service import ServingStub, build_channel
 
-    return ServingStub(build_channel(address))
+    channel = build_channel(address)
+    stub = ServingStub(channel)
+    # the retire path (Router.remove_replica) closes the channel
+    # through this handle once in-flight polls/dispatches settle
+    stub.close = channel.close
+    return stub
 
 
 def _code_name(exc, default="UNAVAILABLE"):
@@ -356,6 +402,17 @@ class Router(object):
         self._server = None
         self.servicer = None
         self.port = None
+        # optional replica supervisor (serving/autoscaler.py): owns
+        # the fleet processes and contributes the router_status
+        # autoscaler block; the router never calls INTO it while
+        # holding _lock (lock order: supervisor -> router, one way)
+        self.autoscaler = None
+
+    def set_autoscaler(self, supervisor):
+        """Attach the replica supervisor whose status_block() fills
+        router_status.autoscaler. The supervisor's lifecycle is owned
+        by the caller (router_main), not by Router.stop()."""
+        self.autoscaler = supervisor
 
     # ------------------------------------------------------- membership
 
@@ -373,8 +430,17 @@ class Router(object):
             return rep
 
     def remove_replica(self, address):
+        """Unregister AND retire: the entry leaves the registry (no
+        new dispatch can pick it) and its gRPC channel closes once any
+        concurrent heartbeat poll or in-flight dispatch settles — a
+        removed replica must not leak a channel or leave begin_* /
+        end_* counters unsettled. Returns the retired entry (None if
+        the address was unknown)."""
         with self._lock:
-            self._replicas.pop(address, None)
+            rep = self._replicas.pop(address, None)
+        if rep is not None:
+            rep.retire()
+        return rep
 
     def replicas(self):
         with self._lock:
@@ -842,7 +908,11 @@ class Router(object):
                 failures=rep.failures,
                 inflight=rep.inflight,
             ))
+        autoscaler = None
+        if self.autoscaler is not None:
+            autoscaler = self.autoscaler.status_block()
         return pb.RouterStatusResponse(
+            autoscaler=autoscaler,
             replicas=len(reps),
             healthy=sum(1 for r in reps if r.healthy),
             replica=reps,

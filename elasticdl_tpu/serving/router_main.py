@@ -10,13 +10,32 @@ and can sit in front of replicas on any mix of hosts.
         --replica localhost:50051 --replica localhost:50052 \\
         --replica localhost:50053 --port 50050
 
+With --autoscale the fleet is ELASTIC instead of static: the replica
+supervisor (serving/autoscaler.py) spawns `elasticdl_tpu.serving.main`
+replicas itself (pass their flags through --replica_args), replaces
+crashed ones, and scales the count between --min_replicas and
+--max_replicas on the router's own load signals — journaling every
+lifecycle transition to --journal_dir so a supervisor restart
+re-adopts the live fleet instead of orphaning or double-spawning it:
+
+    python -m elasticdl_tpu.serving.router_main --port 50050 \\
+        --autoscale --min_replicas 1 --max_replicas 4 \\
+        --journal_dir /var/lib/edl/fleet \\
+        --replica_args "--model_zoo model_zoo \\
+            --model_def transformer_lm.transformer_lm.custom_model \\
+            --port 0 --num_slots 4"
+
 Fault injection at the router boundary uses the same EDL_FAULT_SPEC
 grammar as every other drill, under the router RPC names:
 EDL_FAULT_SPEC='router_generate:error:2' rejects two routed calls
-without touching any replica.
+without touching any replica; the supervisor's process boundary
+listens on the supervisor_spawn / supervisor_ready / supervisor_adopt
+hooks (spawn-fail, slow-ready, adopt-drop).
 """
 
 import argparse
+import os
+import shlex
 import signal
 import sys
 import threading
@@ -30,7 +49,8 @@ def parse_router_args(args=None):
         description="elasticdl-tpu serving router"
     )
     parser.add_argument("--replica", action="append", default=[],
-                        help="replica address host:port (repeatable)")
+                        help="replica address host:port (repeatable; "
+                             "optional with --autoscale)")
     parser.add_argument("--port", type=int, default=50050)
     parser.add_argument("--poll_secs", type=float, default=0.5)
     parser.add_argument("--poll_timeout_secs", type=float, default=2.0)
@@ -45,9 +65,34 @@ def parse_router_args(args=None):
     parser.add_argument("--redispatch_window_secs", type=float,
                         default=30.0)
     parser.add_argument("--tensorboard_log_dir", default="")
+    # ---- elastic fleet (serving/autoscaler.py) ----
+    parser.add_argument("--autoscale", action="store_true",
+                        help="own the replica fleet: spawn/replace/"
+                             "drain elasticdl_tpu.serving.main "
+                             "processes instead of fronting a static "
+                             "--replica list")
+    parser.add_argument("--replica_args", default="",
+                        help="flags for the spawned serving.main "
+                             "processes (one shell-quoted string)")
+    parser.add_argument("--min_replicas", type=int, default=1)
+    parser.add_argument("--max_replicas", type=int, default=4)
+    parser.add_argument("--journal_dir", default="",
+                        help="supervisor WAL dir; restarts re-adopt "
+                             "the live fleet from it")
+    parser.add_argument("--decide_secs", type=float, default=0.5)
+    parser.add_argument("--up_queue_wait_ms", type=float, default=200.0)
+    parser.add_argument("--up_window_secs", type=float, default=2.0)
+    parser.add_argument("--down_window_secs", type=float, default=6.0)
+    parser.add_argument("--scale_cooldown_secs", type=float,
+                        default=5.0)
+    parser.add_argument("--max_restarts", type=int, default=3)
     parsed = parser.parse_args(args)
-    if not parsed.replica:
-        parser.error("at least one --replica is required")
+    if not parsed.replica and not parsed.autoscale:
+        parser.error("at least one --replica is required "
+                     "(or pass --autoscale)")
+    if parsed.autoscale and not parsed.replica_args:
+        parser.error("--autoscale needs --replica_args to know how to "
+                     "launch replicas")
     return parsed
 
 
@@ -69,9 +114,44 @@ def build_router(args):
     )
 
 
+def build_supervisor(args, router):
+    from elasticdl_tpu.serving.autoscaler import (
+        AutoscalerConfig,
+        ReplicaSupervisor,
+        SubprocessReplicaLauncher,
+    )
+
+    journal_dir = args.journal_dir or os.path.join(
+        ".", "edl_fleet_%d" % os.getpid()
+    )
+    launcher = SubprocessReplicaLauncher(
+        shlex.split(args.replica_args),
+        log_dir=os.path.join(journal_dir, "logs"),
+    )
+    supervisor = ReplicaSupervisor(
+        router, launcher,
+        AutoscalerConfig(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            decide_secs=args.decide_secs,
+            up_queue_wait_ms=args.up_queue_wait_ms,
+            up_window_secs=args.up_window_secs,
+            down_window_secs=args.down_window_secs,
+            cooldown_secs=args.scale_cooldown_secs,
+            max_restarts=args.max_restarts,
+            journal_dir=journal_dir,
+        ),
+    )
+    router.set_autoscaler(supervisor)
+    return supervisor
+
+
 def main(argv=None):
     args = parse_router_args(argv)
     router = build_router(args).start()
+    supervisor = None
+    if args.autoscale:
+        supervisor = build_supervisor(args, router).start()
     # name this process's span recorder; spans export to
     # $EDL_TRACE_DIR on stop (plus an atexit backstop)
     from elasticdl_tpu.observability.tracing import configure
@@ -87,6 +167,10 @@ def main(argv=None):
     signal.signal(signal.SIGINT, _graceful)
     print("ROUTER_READY port=%d" % router.port, flush=True)
     done.wait()
+    # supervisor first: it drains and retires the fleet it owns; the
+    # router keeps answering status RPCs until the roster is gone
+    if supervisor is not None:
+        supervisor.stop()
     router.stop()
     return 0
 

@@ -499,6 +499,10 @@ class GenerationServer(object):
             handler_poll_secs=cfg.handler_poll_secs,
             draining=self.scheduler.is_draining,
         )
+        # the unwrapped servicer: in-process warmup (serving/main.py
+        # --warmup_tokens) goes through it so a warmup request can
+        # never consume an armed fault rule meant for real traffic
+        self.raw_servicer = servicer
         # EDL_FAULT_SPEC (or an explicit injector) arms drop/error/
         # delay/kill at the RPC boundary, exactly like the master
         self.servicer = maybe_wrap_servicer(

@@ -115,6 +115,18 @@ class ServingTelemetry(object):
             self.counters[name] += n
             self._dirty = True
 
+    def reset_latency(self):
+        """Drop the latency DISTRIBUTIONS (histograms + the queue-wait
+        EWMA) without touching the monotone counters. The pre-ready
+        warmup path (serving/main.py --warmup_tokens) calls this so
+        the jit-compile latency of a request no client ever sent can
+        never surface in the percentiles a router/autoscaler SLOs on."""
+        with self._lock:
+            for name in self.hists:
+                self.hists[name] = LogLinearHistogram()
+            self._queue_wait_ewma_ms = 0.0
+            self._queue_waits_seen = 0
+
     def record_ttft(self, request):
         """Time-to-first-token for one request, at its first token."""
         ttft_ms = (self._clock() - request.submitted_at) * 1000.0

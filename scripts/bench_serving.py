@@ -69,6 +69,12 @@ def parse_args(argv=None):
     p.add_argument("--requests", type=int, default=32)
     p.add_argument("--rate", type=float, default=16.0,
                    help="mean arrival rate, requests/sec (Poisson)")
+    p.add_argument("--ramp", default="",
+                   help="piecewise-Poisson load profile r1:t1,r2:t2,"
+                        "... (rate req/s : duration secs per phase); "
+                        "overrides --rate/--requests and records "
+                        "per-phase percentiles — the SAME generator "
+                        "the autoscale drill ramps with")
     p.add_argument("--num_slots", type=int, default=4)
     p.add_argument("--queue_capacity", type=int, default=16)
     p.add_argument("--prompt_len", default="2:6",
@@ -129,6 +135,38 @@ def _span(text):
     if not 1 <= lo <= hi:
         raise ValueError("bad span %r" % text)
     return lo, hi
+
+
+def parse_ramp(spec):
+    """'r1:t1,r2:t2,...' -> [(rate_rps, duration_secs), ...]. The one
+    ramp grammar the bench and scripts/run_autoscale_drill.py share —
+    one load generator, so a drill phase and a bench phase mean the
+    same arrival process."""
+    phases = []
+    for part in spec.split(","):
+        rate_text, _, secs_text = part.strip().partition(":")
+        rate, secs = float(rate_text), float(secs_text)
+        if rate <= 0 or secs <= 0:
+            raise ValueError("bad ramp phase %r in %r" % (part, spec))
+        phases.append((rate, secs))
+    if not phases:
+        raise ValueError("empty ramp spec %r" % spec)
+    return phases
+
+
+def ramp_arrivals(phases, rs):
+    """Open-loop piecewise-Poisson arrival plan: [(offset_secs,
+    phase_index), ...] with exponential gaps at each phase's rate,
+    phase boundaries at the cumulative durations."""
+    out = []
+    t0 = 0.0
+    for idx, (rate, secs) in enumerate(phases):
+        t = t0 + float(rs.exponential(1.0 / rate))
+        while t < t0 + secs:
+            out.append((t, idx))
+            t += float(rs.exponential(1.0 / rate))
+        t0 += secs
+    return out
 
 
 # percentiles go through the SAME log-linear histogram code the live
@@ -207,12 +245,31 @@ def build_plan(args, seq_len, vocab):
             return rs.randint(0, vocab,
                               size=rs.randint(p_lo, p_hi + 1))
 
+    if args.ramp:
+        # piecewise-Poisson ramp: the arrival schedule fixes both the
+        # request count and each request's phase tag
+        arrivals = ramp_arrivals(parse_ramp(args.ramp), rs)
+        gaps = [
+            at - (arrivals[i - 1][0] if i else 0.0)
+            for i, (at, _phase) in enumerate(arrivals)
+        ]
+        return [
+            {
+                "prompt": prompt(i),
+                "new": int(rs.randint(o_lo, o_hi + 1)),
+                "gap": float(gaps[i]),
+                "seed": int(i),
+                "phase": int(arrivals[i][1]),
+            }
+            for i in range(len(arrivals))
+        ]
     return [
         {
             "prompt": prompt(i),
             "new": int(rs.randint(o_lo, o_hi + 1)),
             "gap": float(rs.exponential(1.0 / args.rate)),
             "seed": int(i),
+            "phase": None,
         }
         for i in range(args.requests)
     ]
@@ -252,7 +309,8 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
 
     def one(spec):
         t0 = time.monotonic()
-        row = {"status": "OK", "tokens": 0, "ttft_ms": None}
+        row = {"status": "OK", "tokens": 0, "ttft_ms": None,
+               "phase": spec.get("phase")}
         try:
             stream = stub.generate_stream(
                 pb.GenerateRequest(
@@ -295,13 +353,14 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
     ttfts = [r["ttft_ms"] for r in ok if r["ttft_ms"] is not None]
     lats = [r["latency_ms"] for r in ok]
     tokens_ok = sum(r["tokens"] for r in ok)
-    return {
+    record = {
         "metric": "serving_goodput_tokens_per_sec",
         "value": round(tokens_ok / wall, 3) if wall else None,
         "unit": "tokens/sec",
         "platform": jax.default_backend(),
-        "requests": args.requests,
+        "requests": len(plan),
         "rate_rps": args.rate,
+        "ramp": args.ramp or None,
         "num_slots": num_slots,
         "queue_capacity": args.queue_capacity,
         "completed": len(ok),
@@ -355,6 +414,33 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             ) if status.draft_proposed else 0.0,
         },
     }
+    if args.ramp:
+        # per-phase percentiles: one entry per ramp phase, same
+        # histogram code as everything else — the autoscale drill's
+        # per-transition SLO reads exactly this shape
+        record["phases"] = []
+        for idx, (rate, secs) in enumerate(parse_ramp(args.ramp)):
+            rows = [r for r in results if r["phase"] == idx]
+            rows_ok = [r for r in rows if r["status"] == "OK"]
+            record["phases"].append({
+                "phase": idx,
+                "rate_rps": rate,
+                "secs": secs,
+                "requests": len(rows),
+                "completed": len(rows_ok),
+                "rejected": sum(1 for r in rows
+                                if r["status"] == "RESOURCE_EXHAUSTED"),
+                "expired": sum(1 for r in rows
+                               if r["status"] == "DEADLINE_EXCEEDED"),
+                "ttft_ms": percentiles(
+                    [r["ttft_ms"] for r in rows_ok
+                     if r["ttft_ms"] is not None], (50, 90, 99)
+                ),
+                "latency_ms": percentiles(
+                    [r["latency_ms"] for r in rows_ok], (50, 90, 99)
+                ),
+            })
+    return record
 
 
 def run_bench(args):

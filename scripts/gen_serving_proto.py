@@ -131,6 +131,32 @@ SERVING_MESSAGES = {
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],
+    # the replica supervisor/autoscaler (serving/autoscaler.py):
+    # desired-count target, roster by lifecycle state, decision
+    # counters and the last scale decision + reason — absent (all
+    # zeros / enabled=false) when the router runs a static fleet
+    "AutoscalerStatus": [
+        ("enabled", 1, T.TYPE_BOOL, _OPT),
+        ("target", 2, T.TYPE_INT32, _OPT),
+        ("live", 3, T.TYPE_INT32, _OPT),
+        ("starting", 4, T.TYPE_INT32, _OPT),
+        ("draining", 5, T.TYPE_INT32, _OPT),
+        ("scale_ups", 6, T.TYPE_INT64, _OPT),
+        ("scale_downs", 7, T.TYPE_INT64, _OPT),
+        # unplanned replica losses (crash / wedged kill) replaced
+        # through the deficit path
+        ("replacements", 8, T.TYPE_INT64, _OPT),
+        ("spawn_failures", 9, T.TYPE_INT64, _OPT),
+        # max_restarts consecutive spawn failures opened the restart
+        # circuit: no more respawns until the supervisor restarts
+        ("circuit_open", 10, T.TYPE_BOOL, _OPT),
+        ("last_decision", 11, T.TYPE_STRING, _OPT),
+        ("last_reason", 12, T.TYPE_STRING, _OPT),
+        ("last_decision_age_secs", 13, T.TYPE_DOUBLE, _OPT),
+        # journal recoveries: how many supervisors have come up over
+        # this roster's write-ahead state
+        ("supervisor_restarts", 14, T.TYPE_INT64, _OPT),
+    ],
     "ReplicaStatus": [
         ("address", 1, T.TYPE_STRING, _OPT),
         ("healthy", 2, T.TYPE_BOOL, _OPT),
@@ -172,6 +198,10 @@ SERVING_MESSAGES = {
         ("queue_wait_p50_ms", 18, T.TYPE_DOUBLE, _OPT),
         ("queue_wait_p90_ms", 19, T.TYPE_DOUBLE, _OPT),
         ("queue_wait_p99_ms", 20, T.TYPE_DOUBLE, _OPT),
+        # replica supervisor/autoscaler block (serving/autoscaler.py);
+        # unset when the fleet is static
+        ("autoscaler", 21, T.TYPE_MESSAGE, _OPT,
+         ".elasticdl_tpu.AutoscalerStatus"),
     ],
 }
 

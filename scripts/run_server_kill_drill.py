@@ -87,6 +87,7 @@ def launch_ready(cmd, extra_env=None, ready_marker="SERVING_READY",
             break
     if port is None:
         proc.kill()
+        proc.wait(timeout=30)  # reap before bailing — no zombie
         raise RuntimeError("process never became ready: %r" % cmd)
     threading.Thread(
         target=lambda: [None for _ in proc.stdout], daemon=True

@@ -1,0 +1,56 @@
+"""C12 negative fixture — the supervisor seat pairs settle on every
+path: reap on the failure branch, finally-guarded retire, Popen waited
+on both branches, and ownership transfer (the handle escapes to the
+roster / the caller)."""
+
+import subprocess
+
+
+class FleetScaler(object):
+    def __init__(self, supervisor):
+        self._supervisor = supervisor
+        self._roster = {}
+
+    def grow(self, supervisor, want):
+        seat = supervisor.spawn(want)
+        if not self.healthy(seat):
+            supervisor.reap(seat)  # failure branch settles by reaping
+            return None
+        supervisor.adopt(seat)
+        return seat
+
+    def shrink(self, supervisor, seat):
+        supervisor.begin_drain(seat)
+        try:
+            return self.wait_drained(seat)
+        finally:
+            supervisor.retire(seat)
+
+    def shrink_escalating(self, supervisor, seat):
+        supervisor.begin_drain(seat)
+        ok = self.wait_drained(seat)
+        if not ok:
+            supervisor.reap(seat)  # drain stuck: escalate, still settled
+            return False
+        supervisor.retire(seat)
+        return True
+
+    def launch_once(self, cmd, deadline):
+        proc = subprocess.Popen(["python", "-m", "replica"])
+        if deadline <= 0:
+            proc.kill()
+            proc.wait(timeout=5)  # reap the kill before bailing
+            return None
+        proc.wait(timeout=deadline)
+        return cmd
+
+    def launch_owned(self, seat_id):
+        proc = subprocess.Popen(["python", "-m", "replica"])
+        self._roster[seat_id] = proc  # ownership transferred to roster
+        return seat_id
+
+    def healthy(self, seat):
+        return seat is not None
+
+    def wait_drained(self, seat):
+        return bool(seat)

@@ -1,0 +1,46 @@
+"""C12 positive fixture — EDL501 leaks of the replica supervisor's
+seat lifecycle pairs (serving/autoscaler.py discipline):
+
+1. a spawned seat that an early-return path neither adopts nor reaps —
+   an orphan replica process no journal remembers;
+2. a drain begun whose exception path never retires (or reaps) the
+   seat — it sits mid-drain forever;
+3. a launcher Popen handle killed but never waited on — a zombie
+   pinned until the supervisor exits.
+"""
+
+import subprocess
+
+
+class FleetScaler(object):
+    def __init__(self, supervisor):
+        self._supervisor = supervisor
+
+    def grow(self, supervisor, want):
+        seat = supervisor.spawn(want)
+        if not self.healthy(seat):
+            return None  # leak: the seat is never adopted or reaped
+        supervisor.adopt(seat)
+        return seat
+
+    def shrink(self, supervisor, seat):
+        supervisor.begin_drain(seat)
+        ok = self.wait_drained(seat)
+        if not ok:
+            raise RuntimeError("drain stuck")  # leak: no retire/reap
+        supervisor.retire(seat)
+        return seat
+
+    def launch_once(self, cmd, deadline):
+        proc = subprocess.Popen(["python", "-m", "replica"])
+        if deadline <= 0:
+            proc.kill()
+            return None  # leak: killed but never waited (zombie)
+        proc.wait(timeout=deadline)
+        return cmd
+
+    def healthy(self, seat):
+        return seat is not None
+
+    def wait_drained(self, seat):
+        return bool(seat)

@@ -203,6 +203,24 @@ def test_c11_negative_settled_refcounts_are_clean():
     assert lint_file("c11_neg.py") == []
 
 
+def test_c12_positive_flags_supervisor_lifecycle_leaks():
+    """The replica supervisor's seat pairs (serving/autoscaler.py): a
+    spawned seat never adopted nor reaped (an orphan process), a drain
+    begun that an exception path never retires, and a launcher Popen
+    handle killed but never waited on (a zombie)."""
+    findings = lint_file("c12_pos.py")
+    assert rule_ids(findings) == ["EDL501"] * 3, findings
+    assert {f.detail for f in findings} == {
+        "supervisor.spawn", "supervisor.begin_drain", "proc=Popen",
+    }
+
+
+def test_c12_negative_settled_lifecycles_are_clean():
+    """Reap on the failure branch, finally-guarded retire, waited
+    kills, and the roster ownership-transfer escape."""
+    assert lint_file("c12_neg.py") == []
+
+
 # ------------------------------ C9: EDL202/EDL203 deadline propagation
 
 
@@ -267,7 +285,7 @@ def test_every_rule_has_fixture_coverage():
     emitted = set()
     for name in ("c1_pos.py", "c2_pos.py", "c3_pos.py", "c5_pos.py",
                  "c6_pos.py", "c7_pos.py", "c8_pos.py", "c9_pos.py",
-                 "c10_pos.py", "c11_pos.py"):
+                 "c10_pos.py", "c11_pos.py", "c12_pos.py"):
         emitted.update(f.rule for f in lint_file(name))
     ast_rule_ids = set()
     for rule in all_rules():

@@ -69,6 +69,10 @@ class FakeReplicaStub(object):
         self.block_until = None  # Event: generate blocks until set
         self.status_calls = 0
         self.status_block_until = None  # Event: status blocks until set
+        self.closed = 0  # channel closes via the retire path
+
+    def close(self):
+        self.closed += 1
 
     def server_status(self, request, timeout=None):
         self.status_calls += 1
@@ -596,6 +600,84 @@ def test_router_servicer_maps_shed_to_admission_error():
     with pytest.raises(RouterError) as e:
         RouterServicer(router).router_generate(_req())
     assert e.value.code == "RESOURCE_EXHAUSTED"
+
+
+# -------------------------------------------------------- retire / close
+
+
+def test_remove_replica_closes_channel_once():
+    router, stubs, _ = make_router(2)
+    router.poll_once()
+    rep = router.remove_replica("rep0")
+    assert rep is not None and rep.retired
+    assert stubs["rep0"].closed == 1
+    assert [r.address for r in router.replicas()] == ["rep1"]
+    # idempotent: removing again neither errors nor double-closes
+    assert router.remove_replica("rep0") is None
+    assert stubs["rep0"].closed == 1
+    # traffic keeps flowing to the survivor
+    resp = router.dispatch_generate(_req())
+    assert list(resp.tokens) == [1, 2, 200]
+
+
+def test_remove_replica_defers_close_past_inflight_poll():
+    """Regression: remove_replica used to just pop the registry entry,
+    leaving the gRPC channel open forever — and closing it EAGERLY
+    would tear the socket out from under a concurrent heartbeat poll.
+    The close must wait for the in-flight poll to settle."""
+    router, stubs, _ = make_router(2)
+    gate = threading.Event()
+    stubs["rep0"].status_block_until = gate
+    try:
+        t = threading.Thread(target=router.poll_once)
+        t.start()
+        # wait until rep0's poll is provably in flight
+        import time as _time
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 2.0:
+            if stubs["rep0"].status_calls == 1:
+                break
+            _time.sleep(0.005)
+        assert stubs["rep0"].status_calls == 1
+        rep = router.remove_replica("rep0")
+        assert rep.retired
+        assert stubs["rep0"].closed == 0  # poll still holds the channel
+    finally:
+        gate.set()
+    t.join(timeout=5)
+    t0 = _time.monotonic()
+    while _time.monotonic() - t0 < 2.0 and not stubs["rep0"].closed:
+        _time.sleep(0.005)
+    assert stubs["rep0"].closed == 1  # settled poll released the close
+
+
+def test_remove_replica_defers_close_past_inflight_dispatch():
+    """Same deferral for a dispatch already running on the replica:
+    the in-flight counters settle (begin/end balanced to zero) and
+    only THEN does the channel close."""
+    router, stubs, _ = make_router(1)
+    router.poll_once()
+    rep = router.replicas()[0]
+    gate = threading.Event()
+    stubs["rep0"].block_until = gate
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(router.dispatch_generate(_req()))
+    )
+    t.start()
+    import time as _time
+    t0 = _time.monotonic()
+    while _time.monotonic() - t0 < 2.0 and rep.inflight != 1:
+        _time.sleep(0.005)
+    assert rep.inflight == 1
+    removed = router.remove_replica("rep0")
+    assert removed is rep
+    assert stubs["rep0"].closed == 0  # dispatch still on the wire
+    gate.set()
+    t.join(timeout=5)
+    assert len(done) == 1  # the in-flight request still completed
+    assert rep.inflight == 0  # counters settled, not abandoned
+    assert stubs["rep0"].closed == 1
 
 
 # ------------------------------------------------------- fault injection
