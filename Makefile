@@ -83,16 +83,19 @@ drill:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_master_kill_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_server_kill_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_router_chaos_drill.py
-	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_autoscale_drill.py
+	env -u PYTHONPATH JAX_PLATFORMS=cpu EDL_KV_CACHE_DTYPE=int8 $(PY) scripts/run_autoscale_drill.py
 
 # Serving smoke: closed-loop load against the real continuous-batching
 # server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput).
 # The shared-prefix workload (a pool of common system prompts + random
-# suffixes) runs FOUR ways at EQUAL KV bytes: dense, block-paged
-# (private), paged + refcounted prefix sharing, and paged + sharing +
-# speculative decode (draft_k) — bytes-per-token, prefix-hit tokens,
-# CoW copies and the draft accept rate recorded under
-# "kv"/"paged"/"paged_shared"/"paged_shared_spec". Arrivals follow a
+# suffixes) runs FIVE ways at EQUAL KV bytes: dense, block-paged
+# (private), paged + refcounted prefix sharing, paged + sharing +
+# speculative decode (draft_k), and paged + sharing + spec over INT8
+# arenas (quantized block storage, ~3x the blocks in the same bytes) —
+# bytes-per-token, prefix-hit tokens, CoW copies, the draft accept
+# rate and the int8 greedy-match rate vs the int8 dense oracle
+# recorded under "kv"/"paged"/"paged_shared"/"paged_shared_spec"/
+# "paged_int8"/"int8_vs_shared". Arrivals follow a
 # --ramp piecewise-Poisson profile (the SAME generator the autoscale
 # drill uses), so every record also carries per-phase percentiles
 # under "phases".
@@ -100,7 +103,7 @@ serve-smoke:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py \
 		--ramp "8:0.8,32:0.5,8:0.5" --compare_paged --kv_block_size 4 \
 		--shared_prefix --prefix_len 16 --suffix_len 1:4 \
-		--out_len 4:12 --draft_k 2 \
+		--out_len 4:12 --draft_k 2 --kv_cache_dtype int8 \
 		--out BENCH_SERVING.json
 
 ci-fast: lint test-fast

@@ -88,16 +88,27 @@ def resolve_block(explicit, which):
         return 128
 
 
-def softmax_merge(o, l, m, s, v_blk):
+def softmax_merge(o, l, m, s, v_blk, w_scale=None):
     """One online-softmax accumulation step: merge scores `s`
     [b,h,q,k_blk] and values `v_blk` [b,h,k_blk,d] into the running
-    (output, denominator, rowmax) triple. Shared by blockwise_attention
-    and ring attention so the subtle numerics live once."""
+    (output, denominator, rowmax) triple. Shared by blockwise_attention,
+    ring attention and the paged decode scan so the subtle numerics
+    live once.
+
+    `w_scale` [b,h,k_blk] (optional) multiplies the weights ONLY in the
+    value matmul — the v-side of the deferred int8-KV dequantize:
+    `p @ (v8 * vs) == (p * vs^T) @ v8`, so scaling the [*, k] weights
+    (a head_dim-times smaller array than the rows) lets `v_blk` stay
+    int8 all the way into the matmul operand read. The softmax
+    denominator `l` is NOT scaled — it normalizes probabilities, which
+    are dequantize-invariant."""
     m_new = jnp.maximum(m, s.max(-1))
     p = jnp.exp(s - m_new[..., None])
     corr = jnp.exp(m - m_new)
     l_new = l * corr + p.sum(-1)
-    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+    pv = p if w_scale is None else p * w_scale[..., None, :]
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", pv,
+                                             v_blk)
     return o_new, l_new, m_new
 
 
@@ -312,7 +323,9 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
 
 
 def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
-                           length, scale=None, window=None):
+                           length, scale=None, window=None,
+                           k_scale_pool=None, v_scale_pool=None,
+                           k_cur_scale=None, v_cur_scale=None):
     """Decode attention over a BLOCK-PAGED KV pool for a tile of
     1 <= t new query tokens per sequence.
 
@@ -350,17 +363,41 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
     window: sliding-window size (row j sees keys at
             `k_pos > length + j - window`).
 
+    INT8 ARENAS (k_scale_pool is not None): the pools hold symmetric
+    per-row int8 rows and the scale pools their f32 per-row scales
+    `[num_blocks, block_size, hkv, 1]`; k_cur/v_cur are then int8 with
+    `k_cur_scale`/`v_cur_scale` `[b, hkv, t, 1]` (the model quantizes
+    the tile at the sow — quantize-at-insertion). The dequantize is
+    DEFERRED into the blockwise online-softmax scan: k-scales fold
+    into the per-block [*, block_size] score tile and v-scales into
+    the weights (softmax_merge's w_scale), so no float copy of any
+    cache row is ever materialized — the per-step dequantize work is
+    on arrays head_dim-times smaller than the rows, and the dominant
+    HBM stream (the arenas) stays int8 end to end. Same math as the
+    offline dense int8 decode's deferral (transformer_lm._decode_step),
+    reduction order aside.
+
     Table entries are traced values: block churn and sequence growth
     never recompile the consuming program. k/v may carry fewer heads
     than q (GQA): q heads are grouped under their kv head like the
     dense `_decode_step`, so pool reads scale with hkv. Returns
     [b, h, t, d] in float32 (the dense decode path's softmax
     precision)."""
+    quantized = k_scale_pool is not None
+    if quantized and (v_scale_pool is None or k_cur_scale is None
+                      or v_cur_scale is None):
+        raise ValueError(
+            "int8 paged attention needs all four scale operands "
+            "(k_scale_pool, v_scale_pool, k_cur_scale, v_cur_scale)"
+        )
     squeeze = q.ndim == 3
     if squeeze:
         q = q[:, :, None, :]
         k_cur = k_cur[:, :, None, :]
         v_cur = v_cur[:, :, None, :]
+        if quantized:
+            k_cur_scale = k_cur_scale[:, :, None, :]
+            v_cur_scale = v_cur_scale[:, :, None, :]
     b, h, t, d = q.shape
     hkv = k_cur.shape[1]
     if h % hkv:
@@ -389,6 +426,15 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
         kb = k_pool[safe].astype(f32)  # [b, block_size, hkv, d]
         vb = v_pool[safe].astype(f32)
         s = jnp.einsum("bhqd,bkhd->bhqk", qf, kb)  # [b, hkv, g*t, bs]
+        w_scale = None
+        if quantized:
+            # deferred dequantize: the k-row scales multiply the
+            # [*, block_size] score tile (head_dim-times smaller than
+            # the rows), the v-row scales ride to softmax_merge's
+            # weight multiply — the arenas stream int8, nothing floats
+            ks = k_scale_pool[safe][..., 0]  # [b, block_size, hkv]
+            s = s * ks.transpose(0, 2, 1)[:, :, None, :]
+            w_scale = v_scale_pool[safe][..., 0].transpose(0, 2, 1)
         k_pos = j * block_size + jnp.arange(block_size)[None, :]
         valid = (k_pos < length[:, None]) & (bid >= 0)[:, None]  # [b,bs]
         valid = jnp.broadcast_to(valid[:, None, :], (b, t, block_size))
@@ -401,7 +447,8 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
             valid[:, None, None], (b, hkv, group, t, block_size)
         ).reshape(b, hkv, group * t, block_size)
         s = jnp.where(vt, s, _NEG_INF)
-        return softmax_merge(o, l, mx, s, vb.transpose(0, 2, 1, 3)), None
+        return softmax_merge(o, l, mx, s, vb.transpose(0, 2, 1, 3),
+                             w_scale=w_scale), None
 
     o0 = jnp.zeros((b, hkv, group * t, d), f32)
     l0 = jnp.zeros((b, hkv, group * t), f32)
@@ -413,6 +460,13 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
     s_cur = jnp.einsum(
         "bhqd,bhkd->bhqk", qf, k_cur.astype(f32)
     )  # [b, hkv, g*t, t]
+    cur_w_scale = None
+    if quantized:
+        # same deferral for the tile's own keys/values: the tile is
+        # quantized at the sow (it lands in the arenas as-is), so its
+        # scores see exactly the rows every LATER step will read back
+        s_cur = s_cur * k_cur_scale[..., 0][:, :, None, :]
+        cur_w_scale = v_cur_scale[..., 0]  # [b, hkv, t]
     tile = jnp.arange(t)
     tri = tile[:, None] >= tile[None, :]  # [t_q, t_k] causal
     if window is not None:
@@ -422,7 +476,8 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
     ).reshape(group * t, t)
     s_cur = jnp.where(trif[None, None], s_cur, _NEG_INF)
     o, l, mx = softmax_merge(
-        o, l, mx, s_cur, v_cur.astype(f32)  # already [b, hkv, t, d]
+        o, l, mx, s_cur, v_cur.astype(f32),  # already [b, hkv, t, d]
+        w_scale=cur_w_scale,
     )
     out = softmax_finalize(o, l).reshape(b, hkv, group, t, d)
     out = out.reshape(b, h, t, d)

@@ -237,6 +237,8 @@ class ContinuousBatchingEngine(object):
         return {
             "kv_paged": False,
             "kv_shared": False,
+            "kv_cache_dtype": getattr(
+                self.model, "kv_cache_dtype", "") or "",
             "kv_block_size": 0,
             "kv_blocks_total": 0,
             "kv_blocks_free": 0,
@@ -464,8 +466,20 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     can_seat() answers from the allocator (prefix matches shrink what
     a request needs), turning out-of-blocks into admission-queue
     backpressure instead of a crash. Requires the model's paged-decode
-    convention (TransformerLM: `paged` kwarg + "kv_out" sowing) and
-    the plain-dtype KV format.
+    convention (TransformerLM: `paged` kwarg + "kv_out" sowing).
+
+    INT8 ARENAS (model kv_cache_dtype="int8"): the arenas store
+    symmetric per-row int8 rows plus f32 per-row scale arenas
+    `[num_blocks, block_size, hkv, 1]` — the scales are KV row leaves
+    too, so the same tree-generic pool machinery (build, prompt write,
+    scatter, CoW copy) carries them with zero special cases. Rows are
+    quantized at the two insertion points only (the prefill cache
+    write and the model's decode-tile sow); every read defers the
+    dequantize into the paged attention scan. Halves-or-better
+    bytes-per-block ON TOP of prefix sharing at the same block count,
+    or buys proportionally more blocks at equal bytes — sharing, CoW
+    and speculative decode compose unchanged (the trie is keyed on
+    token ids, dtype-blind).
     """
 
     def __init__(self, trainer, state, num_slots, top_k=0, top_p=1.0,
@@ -481,10 +495,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 "kwarg); serve it with the dense engine"
                 % type(model).__name__
             )
-        if getattr(model, "kv_cache_dtype", ""):
+        if getattr(model, "kv_cache_dtype", "") not in ("", "int8"):
             raise ValueError(
-                "paged KV supports the plain-dtype cache format only "
-                "(kv_cache_dtype=%r)"
+                "paged KV supports the plain-dtype and int8 cache "
+                "formats (kv_cache_dtype=%r)"
                 % (getattr(model, "kv_cache_dtype", ""),)
             )
         self.block_size = int(block_size)

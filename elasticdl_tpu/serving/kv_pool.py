@@ -49,6 +49,21 @@ the engine then prefills only the unshared suffix. Invariants:
   reservation, never from thin air, keeping out-of-blocks an
   admission-time condition.
 
+INT8 ARENAS (model kv_cache_dtype="int8"): per-row scales are KV row
+leaves too — the batch-1 cache template then carries
+`[1, hkv, cache_len, 1]` f32 scale buffers beside the int8 rows, so
+`build_pools` maps them to `[num_blocks, block_size, hkv, 1]` scale
+arenas through the SAME `kv_row_leaf` convention, and every write path
+here (block-granular prompt insertion, decode-row scatter, CoW block
+copy) is tree-generic and carries scale leaves with no special case.
+The quantize-at-insertion invariant: rows are quantized exactly where
+they are produced (the model's prefill cache write / decode-tile sow)
+and the arenas only ever RECEIVE quantized data; every read defers the
+dequantize into the paged attention scan (k-scales fold into score
+tiles, v-scales into weights — ops.attention.paged_decode_attention),
+so no float copy of cached rows exists anywhere. The prefix trie is
+keyed on TOKEN IDS, not bytes, so sharing/CoW/reclaim are dtype-blind.
+
 Block ids enter the compiled decode step as DEVICE arrays (the tables),
 so slot churn and sequence growth never recompile anything — the same
 zero-recompile contract the dense pool holds, at block granularity.
@@ -542,12 +557,23 @@ class PagedKVPool(object):
             (int(num_slots), self.max_blocks_per_slot), -1, np.int32
         )
         self._tables_dev = None  # cached device upload of `tables`
-        row_bytes = [
-            leaf.nbytes for leaf in jax.tree.leaves(self.pools)
+        # TRUE arena bytes: summed per leaf at its OWN dtype, so int8
+        # arenas count their int8 rows AND f32 scale leaves exactly —
+        # never a homogeneous row-dtype assumption. This is what
+        # kv_bytes_in_use / bytes-per-generated-token report.
+        row_leaves = [
+            leaf for leaf in jax.tree.leaves(self.pools)
             if leaf.ndim == 4
         ]
-        self.bytes_total = int(sum(row_bytes))
+        self.bytes_total = int(sum(leaf.nbytes for leaf in row_leaves))
         self.block_bytes = self.bytes_total // max(1, self.num_blocks)
+        # the arenas' storage format, advertised on stats/ServerStatus:
+        # any int8 row leaf means the quantized format (its f32 scale
+        # leaves ride along)
+        self.kv_cache_dtype = (
+            "int8" if any(leaf.dtype == jnp.int8 for leaf in row_leaves)
+            else ""
+        )
         self._write_fn = None
         self._copy_fn = None
 
@@ -658,6 +684,7 @@ class PagedKVPool(object):
         return {
             "kv_paged": True,
             "kv_shared": self.allocator.share_prefix,
+            "kv_cache_dtype": self.kv_cache_dtype,
             "kv_block_size": self.block_size,
             "kv_blocks_total": self.num_blocks,
             # capacity available to new work: free + reclaimable —

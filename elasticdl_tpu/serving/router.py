@@ -251,6 +251,8 @@ class Replica(object):
         # not free, but evictable on demand — real headroom for the
         # autoscaler's scale-down gate
         self.kv_blocks_cached = 0
+        # the replica's KV arena storage format ("" | "int8")
+        self.kv_cache_dtype = ""
         self.queue_wait_ms = 0.0
         self.ttft_hist = []
         self.queue_wait_hist = []
@@ -343,6 +345,7 @@ class Replica(object):
         self.active_slots = status.active_slots
         self.kv_blocks_free = status.kv_blocks_free
         self.kv_blocks_cached = status.kv_blocks_cached
+        self.kv_cache_dtype = status.kv_cache_dtype
         self.queue_wait_ms = status.queue_wait_ms
         # raw histogram buckets (mergeable by addition): the router
         # sums these across replicas for fleet-wide percentiles
@@ -903,6 +906,7 @@ class Router(object):
                 queue_depth=rep.queue_depth,
                 active_slots=rep.active_slots,
                 kv_blocks_free=rep.kv_blocks_free,
+                kv_cache_dtype=rep.kv_cache_dtype,
                 queue_wait_ms=rep.queue_wait_ms,
                 dispatched=rep.dispatched,
                 failures=rep.failures,

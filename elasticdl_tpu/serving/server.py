@@ -333,6 +333,7 @@ class ServingServicer(object):
             max_active_slots=snap["max_active_slots"],
             kv_paged=kv["kv_paged"],
             kv_shared=kv["kv_shared"],
+            kv_cache_dtype=kv["kv_cache_dtype"],
             kv_block_size=kv["kv_block_size"],
             kv_blocks_total=kv["kv_blocks_total"],
             kv_blocks_free=kv["kv_blocks_free"],

@@ -244,6 +244,22 @@ class CausalSelfAttention(nn.Module):
                 )
             cvars = self._cache_vars(b, hkv, d, q.dtype)
             self._cache_write(cvars, k, v, 0)
+            if self.kv_cache_dtype == "int8":
+                # SELF-CONSISTENCY: attend over the rows decode will
+                # re-read. The cache stores quantized rows; if prefill
+                # attended the original floats, any later recompute of
+                # these logits from the cache (a paged shared-prefix
+                # seat re-running the last prompt token over resident
+                # int8 blocks; a speculative verify tile) would see
+                # different values and greedy parity across the
+                # offline/serving seams would break. Quantize-dequant
+                # here is a one-time prefill cost (the rows are live
+                # floats anyway) — decode's per-step reads stay int8
+                # with the deferred dequantize.
+                kq, ksc = _kv_quantize_rows(k)
+                vq, vsc = _kv_quantize_rows(v)
+                k = (kq.astype(jnp.float32) * ksc).astype(q.dtype)
+                v = (vq.astype(jnp.float32) * vsc).astype(q.dtype)
         if self.attn_impl not in ("auto", "xla", "jax_flash"):
             raise ValueError(
                 "Unknown attn_impl %r (valid: 'auto', 'xla', "
@@ -343,7 +359,11 @@ class CausalSelfAttention(nn.Module):
         (ops.paged_decode_attention) and the new token's k/v rows are
         SOWN into the "kv_out" collection for the engine to scatter
         into the pool — a module has no business writing an arena it
-        shares with every other sequence."""
+        shares with every other sequence. With kv_cache_dtype="int8"
+        the dict also carries "k_scale"/"v_scale" arenas; rows are
+        quantized HERE (at the sow — the one insertion point) and the
+        dequantize defers into the attention scan, so the arenas
+        stream int8 end to end."""
         if not self.causal:
             raise ValueError("decode mode requires a causal model")
         if self.cache_len < 1:
@@ -360,16 +380,38 @@ class CausalSelfAttention(nn.Module):
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
         if paged is not None:
-            if self.kv_cache_dtype:
-                raise ValueError(
-                    "paged decode supports the plain-dtype KV format "
-                    "only (kv_cache_dtype=%r)" % (self.kv_cache_dtype,)
-                )
             # t = 1: the classic per-token step. t > 1: a query TILE —
             # the speculative verify-k step and the shared-prefix
             # suffix prefill both decode t tokens at positions
             # [idx, idx + t) in ONE batched read of the pool, causal
             # within the tile (ops.paged_decode_attention).
+            if self.kv_cache_dtype == "int8":
+                # QUANTIZE AT INSERTION: the tile's rows are quantized
+                # here, once, and sown in arena format (int8 rows +
+                # f32 per-row scales) — the engine scatters them
+                # verbatim, so the arenas only ever hold quantized
+                # data and every later read defers the dequantize into
+                # the scan (no float cache copy anywhere). Attention
+                # over the tile's OWN keys uses the quantized rows
+                # too, exactly like the dense int8 path that writes
+                # the cache before reading it back.
+                kq, ksc = _kv_quantize_rows(k)
+                vq, vsc = _kv_quantize_rows(v)
+                self.sow("kv_out", "k", kq)
+                self.sow("kv_out", "v", vq)
+                self.sow("kv_out", "k_scale", ksc)
+                self.sow("kv_out", "v_scale", vsc)
+                out = paged_decode_attention(
+                    q, kq, vq,
+                    paged["k"], paged["v"], paged["table"],
+                    jnp.broadcast_to(idx, (b,)),
+                    scale=d ** -0.5, window=self.window or None,
+                    k_scale_pool=paged["k_scale"],
+                    v_scale_pool=paged["v_scale"],
+                    k_cur_scale=ksc, v_cur_scale=vsc,
+                ).astype(dtype)
+                out = out.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+                return self._proj(out, e)
             self.sow("kv_out", "k", k)  # [b, hkv, t, d] for the
             self.sow("kv_out", "v", v)  # engine's pool scatter
             out = paged_decode_attention(
@@ -676,6 +718,9 @@ class TransformerLM(nn.Module):
                     "k": arena["k"], "v": arena["v"],
                     "table": paged["table"],
                 }
+                if "k_scale" in arena:  # int8 arenas carry scale leaves
+                    blk_paged["k_scale"] = arena["k_scale"]
+                    blk_paged["v_scale"] = arena["v_scale"]
             if use_remat:
                 x = run_block(blk, x)
             else:

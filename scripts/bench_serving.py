@@ -28,14 +28,20 @@ emits ONE JSON line:
   resident in the pool at peak, average KV bytes per generated token,
   block budget and admitted-vs-rejected under it.
 
---compare_paged runs the SAME arrival plan THREE ways — the dense
+--compare_paged runs the SAME arrival plan several ways — the dense
 pool, the block-paged pool (serving/kv_pool.py) with prefix sharing
-OFF, and the paged pool with prefix sharing ON (plus speculative
-decode when --draft_k > 0) — all holding the SAME total KV bytes —
-and nests the records plus headline ratios under "paged" /
-"paged_shared" / "paged_vs_dense" / "shared_vs_paged". That A/B is
-the `make serve-smoke` shape: equal HBM, more admissible concurrency,
-and (shared) deduped prefixes converting into admitted slots.
+OFF, the paged pool with prefix sharing ON (plus speculative decode
+when --draft_k > 0), and with --kv_cache_dtype int8 an INT8-ARENA leg
+(quantized block storage, deferred dequantize in the paged scan) —
+all holding the SAME total KV bytes (the int8 leg pays its budget in
+~2-3x as many smaller blocks) — and nests the records plus headline
+ratios under "paged" / "paged_shared" / "paged_shared_spec" /
+"paged_int8" / "paged_vs_dense" / "shared_vs_paged" /
+"spec_vs_shared" / "int8_vs_shared" (the last with a greedy-match
+rate against the int8 DENSE oracle). That A/B is the
+`make serve-smoke` shape: equal HBM, more admissible concurrency,
+deduped prefixes converting into admitted slots, and quantized
+arenas compounding on top.
 
 --shared_prefix switches the workload to the system-prompt shape the
 sharing is FOR: every prompt = one of --prefix_pool common prefixes of
@@ -126,6 +132,14 @@ def parse_args(argv=None):
     p.add_argument("--draft_params", default="",
                    help="draft model_params; empty = the target's "
                         "(self-draft: the acceptance ceiling)")
+    # int8 KV arenas (model kv_cache_dtype): single-run mode serves
+    # the whole run quantized; with --compare_paged this adds an
+    # int8-arena leg at EQUAL KV BYTES (more blocks, not fewer bytes)
+    # plus an int8_vs_shared ratio block with a greedy-match rate
+    # against the int8 DENSE oracle (offline decode on the same
+    # quantized model)
+    p.add_argument("--kv_cache_dtype", default="",
+                   choices=("", "int8"))
     return p.parse_args(argv)
 
 
@@ -176,10 +190,14 @@ def ramp_arrivals(phases, rs):
 from elasticdl_tpu.observability.histogram import percentiles  # noqa: E402
 
 
-def build_rig(args):
+def build_rig(args, model_params=None):
     """The trainer/state every A/B side shares (same params -> the
     dense and paged runs serve identical token streams), plus the
-    draft rig when --draft_k asks for speculative decode."""
+    draft rig when --draft_k asks for speculative decode.
+    `model_params` overrides args.model_params (the int8-arena leg
+    builds a second rig with kv_cache_dtype='int8' — the knob changes
+    only the cache buffers, so the same seed yields the same
+    weights)."""
     import jax
     import numpy as np
 
@@ -201,11 +219,37 @@ def build_rig(args):
         dummy = np.zeros((1, seq_len), np.int32)
         return trainer, trainer.init_state(({"tokens": dummy}, dummy))
 
-    trainer, state = one(args.model_params)
+    trainer, state = one(model_params or args.model_params)
     draft = None
     if args.draft_k > 0:
         draft = one(args.draft_params or args.model_params)
     return trainer, state, draft
+
+
+def block_bytes_for(trainer, block_size):
+    """Per-block arena bytes for this model's KV row leaves at their
+    OWN dtypes — the same sum PagedKVPool computes, so the equal-byte
+    block budgets below are exact (int8 rows + f32 scale leaves, not a
+    homogeneous-dtype guess)."""
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.api.generation import (
+        _decode_cache,
+        _kv_shapes_for,
+        kv_row_leaf,
+    )
+
+    seq_len = int(trainer.model.seq_len)
+    kv_shapes = _kv_shapes_for(
+        _decode_cache(trainer), trainer.model, 1
+    )
+    return int(sum(
+        np.dtype(leaf.dtype).itemsize * block_size
+        * leaf.shape[1] * leaf.shape[3]
+        for leaf in jax.tree.leaves(kv_shapes)
+        if kv_row_leaf(leaf, seq_len)
+    ))
 
 
 def build_plan(args, seq_len, vocab):
@@ -310,7 +354,8 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
     def one(spec):
         t0 = time.monotonic()
         row = {"status": "OK", "tokens": 0, "ttft_ms": None,
-               "phase": spec.get("phase")}
+               "phase": spec.get("phase"), "spec": spec,
+               "out_tokens": []}
         try:
             stream = stub.generate_stream(
                 pb.GenerateRequest(
@@ -326,6 +371,7 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
                 if row["ttft_ms"] is None and chunk.tokens:
                     row["ttft_ms"] = (time.monotonic() - t0) * 1000.0
                 row["tokens"] += len(chunk.tokens)
+                row["out_tokens"].extend(int(t) for t in chunk.tokens)
         except Exception as e:  # noqa: BLE001 - status is the datum
             code = getattr(e, "code", None)
             row["status"] = (
@@ -394,6 +440,7 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
         "kv": {
             "paged": bool(status.kv_paged),
             "shared": bool(status.kv_shared),
+            "cache_dtype": status.kv_cache_dtype,
             "block_size": status.kv_block_size,
             "blocks_total": status.kv_blocks_total,
             "bytes_total": status.kv_bytes_total,
@@ -440,10 +487,43 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
                     [r["latency_ms"] for r in rows_ok], (50, 90, 99)
                 ),
             })
-    return record
+    return record, results
+
+
+def greedy_match_rate(trainer, state, results, temperature):
+    """Fraction of completed GREEDY streams whose tokens equal the
+    offline `autoregressive_generate(use_cache=True)` oracle on
+    `trainer` — for the int8 leg that oracle is the int8 DENSE decode
+    (same quantizer), so a miss means the paged deferred scan diverged,
+    not that quantization rounded differently."""
+    import numpy as np
+
+    from elasticdl_tpu.api.generation import autoregressive_generate
+
+    if temperature > 0.0:
+        return None  # sampled runs have no greedy oracle
+    compared = matched = 0
+    for row in results:
+        if row["status"] != "OK" or not row["out_tokens"]:
+            continue
+        spec = row["spec"]
+        off = np.asarray(autoregressive_generate(
+            trainer, state,
+            np.asarray([spec["prompt"]], np.int32), spec["new"],
+            use_cache=True,
+        ))[0]
+        compared += 1
+        if list(off[len(spec["prompt"]):]) == row["out_tokens"]:
+            matched += 1
+    return round(matched / compared, 4) if compared else None
 
 
 def run_bench(args):
+    if args.kv_cache_dtype and not args.compare_paged:
+        # single-run mode: the whole run serves quantized arenas
+        args.model_params += (
+            "; kv_cache_dtype=%r" % args.kv_cache_dtype
+        )
     trainer, state, draft = build_rig(args)
     seq_len = int(trainer.model.seq_len)
     vocab = int(trainer.model.vocab_size)
@@ -458,7 +538,7 @@ def run_bench(args):
     dense_blocks = args.num_slots * (seq_len // args.kv_block_size)
     num_blocks = args.kv_num_blocks or dense_blocks
 
-    record = run_load(
+    record, _ = run_load(
         args, trainer, state, plan, args.num_slots,
         kv_paged=bool(args.kv_paged),
         kv_block_size=args.kv_block_size,
@@ -476,14 +556,14 @@ def run_bench(args):
     # (+ speculative decode when --draft_k is on): what dedup converts
     # the same bytes into
     paged_slots = args.paged_slots or 2 * args.num_slots
-    paged = run_load(
+    paged, _ = run_load(
         args, trainer, state, plan, paged_slots,
         kv_paged=True,
         kv_block_size=args.kv_block_size,
         kv_num_blocks=dense_blocks,
         kv_shared=False,
     )
-    shared = run_load(
+    shared, _ = run_load(
         args, trainer, state, plan, paged_slots,
         kv_paged=True,
         kv_block_size=args.kv_block_size,
@@ -495,7 +575,7 @@ def run_bench(args):
     if draft is not None:
         # the draft on/off A/B rides the shared leg: same plan, same
         # pool, plus the speculative draft-verify tick
-        spec = run_load(
+        spec, _ = run_load(
             args, trainer, state, plan, paged_slots,
             kv_paged=True,
             kv_block_size=args.kv_block_size,
@@ -514,6 +594,60 @@ def run_bench(args):
                 (spec["tokens_per_sec"] or 0.0) / shared_tok, 3
             ),
             "draft_accept_rate": spec["draft"]["accept_rate"],
+        }
+    if args.kv_cache_dtype == "int8":
+        # the int8-arena leg: SAME byte budget, paid in ~2-3x as many
+        # int8 blocks (block bytes shrink to int8 rows + f32 scales),
+        # with slots raised to let the extra blocks become extra
+        # concurrency; sharing (and the draft, when on) ride along —
+        # the compounding the arenas exist for
+        i8_trainer, i8_state, _ = build_rig(
+            args,
+            model_params=(args.model_params
+                          + "; kv_cache_dtype='int8'"),
+        )
+        fp_bb = block_bytes_for(trainer, args.kv_block_size)
+        i8_bb = block_bytes_for(i8_trainer, args.kv_block_size)
+        i8_blocks = max(1, (dense_blocks * fp_bb) // i8_bb)
+        i8_slots = 2 * paged_slots
+        int8, i8_results = run_load(
+            args, i8_trainer, i8_state, plan, i8_slots,
+            kv_paged=True,
+            kv_block_size=args.kv_block_size,
+            kv_num_blocks=i8_blocks,
+            kv_shared=True,
+            draft=draft,
+            draft_k=args.draft_k,
+        )
+        record["paged_int8"] = int8
+        shared_tok = shared["tokens_per_sec"] or 1e-9
+        shared_bpt = shared["kv"]["bytes_per_token"] or 1e-9
+        record["int8_vs_shared"] = {
+            # equal BYTES, not equal blocks: the whole point
+            "equal_kv_bytes": abs(
+                int8["kv"]["bytes_total"]
+                - shared["kv"]["bytes_total"]
+            ) <= i8_bb,
+            "blocks": [shared["kv"]["blocks_total"],
+                       int8["kv"]["blocks_total"]],
+            "bytes_per_token": [shared["kv"]["bytes_per_token"],
+                                int8["kv"]["bytes_per_token"]],
+            "bytes_per_token_improvement": round(
+                1.0 - (int8["kv"]["bytes_per_token"] or 0.0)
+                / shared_bpt, 3,
+            ),
+            "max_active_slots": [shared["max_active_slots"],
+                                 int8["max_active_slots"]],
+            "goodput_rps": [shared["goodput_rps"],
+                            int8["goodput_rps"]],
+            "tokens_per_sec_ratio": round(
+                (int8["tokens_per_sec"] or 0.0) / shared_tok, 3
+            ),
+            # token-level correctness of the quantized serving path:
+            # completed greedy streams vs the int8 dense oracle
+            "greedy_match_rate_vs_int8_dense": greedy_match_rate(
+                i8_trainer, i8_state, i8_results, args.temperature
+            ),
         }
     base_good = record["goodput_rps"] or 1e-9
     base_tok = record["tokens_per_sec"] or 1e-9

@@ -128,6 +128,12 @@ SERVING_MESSAGES = {
         ("draft_k", 36, T.TYPE_INT32, _OPT),
         ("draft_proposed", 37, T.TYPE_INT64, _OPT),
         ("draft_accepted", 38, T.TYPE_INT64, _OPT),
+        # KV arena storage format: "" = compute dtype, "int8" =
+        # symmetric per-row int8 with f32 scale arenas. The byte
+        # fields above count TRUE arena bytes at each leaf's own
+        # dtype (int8 rows + f32 scale leaves), so equal-byte
+        # comparisons across formats are honest.
+        ("kv_cache_dtype", 39, T.TYPE_STRING, _OPT),
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],
@@ -172,6 +178,9 @@ SERVING_MESSAGES = {
         ("failures", 11, T.TYPE_INT64, _OPT),
         # router-side dispatches currently in flight on this replica
         ("inflight", 12, T.TYPE_INT32, _OPT),
+        # the replica's KV arena storage format ("" | "int8"),
+        # passed through from its ServerStatus
+        ("kv_cache_dtype", 13, T.TYPE_STRING, _OPT),
     ],
     "RouterStatusResponse": [
         ("replicas", 1, T.TYPE_INT32, _OPT),

@@ -78,6 +78,15 @@ DRILL_MODEL_PARAMS = (
     "vocab_size=64; seq_len=64; embed_dim=512; num_heads=8; "
     "num_layers=6"
 )
+# EDL_KV_CACHE_DTYPE=int8 runs the whole fleet on QUANTIZED paged
+# arenas (int8 rows + f32 scale leaves): supervision, drain-based
+# scale-down, SIGKILL replacement and journal re-adoption must all
+# hold with scale leaves in the arenas. `make drill` sets it, so the
+# drill suite covers both arena dtypes (fp paged rides the kill and
+# router-chaos drills).
+KV_CACHE_DTYPE = os.environ.get("EDL_KV_CACHE_DTYPE", "")
+if KV_CACHE_DTYPE:
+    DRILL_MODEL_PARAMS += "; kv_cache_dtype=%r" % KV_CACHE_DTYPE
 
 
 def replica_args():
@@ -541,6 +550,7 @@ def main():
 
         report = {
             "calibrated_single_replica_rps": round(rate, 2),
+            "kv_cache_dtype": KV_CACHE_DTYPE,
             "ramp": ramp,
             "slo_ttft_p99_ms": SLO_TTFT_P99_MS,
             "outcomes": counts,

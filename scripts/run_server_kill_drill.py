@@ -36,9 +36,14 @@ block-paged pool (EDL_KV_PAGED=1, serving/kv_pool.py) — drain and
 SIGKILL semantics must hold regardless of where the cache rows live
 (phase 3's ledger assertions are paged-only; dense mode still proves
 the no-hang/clean-status contract under the shared-prefix load).
+A THIRD pass runs phases 1 + 3 with INT8 arenas
+(kv_cache_dtype='int8'): graceful drain, the shared-chain ledger,
+SIGKILL mid-load and the fresh-restart rebuild must all hold with
+scale leaves in the arenas (the hard-kill transport semantics of
+phase 2 are dtype-blind and already covered).
 
 Usage: python scripts/run_server_kill_drill.py
-Exit 0 = all phases hold in both modes."""
+Exit 0 = all phases hold in all modes."""
 
 import os
 import signal
@@ -100,13 +105,13 @@ def launch_ready(cmd, extra_env=None, ready_marker="SERVING_READY",
 SHARED_PREFIX = [1, 2, 3, 4, 5, 6, 7, 2]
 
 
-def start_server(extra_env=None, num_slots=1):
+def start_server(extra_env=None, num_slots=1, model_params=None):
     return launch_ready(
         [
             sys.executable, "-m", "elasticdl_tpu.serving.main",
             "--model_zoo", os.path.join(REPO, "model_zoo"),
             "--model_def", "transformer_lm.transformer_lm.custom_model",
-            "--model_params", MODEL_PARAMS,
+            "--model_params", model_params or MODEL_PARAMS,
             "--port", "0", "--num_slots", str(num_slots),
             "--queue_capacity", "8", "--kv_block_size", "4",
         ],
@@ -170,10 +175,11 @@ def join_all(threads, outcomes, t0, n):
     return elapsed
 
 
-def phase_graceful(mode_env=None, mode="dense"):
+def phase_graceful(mode_env=None, mode="dense", model_params=None):
     print("[drill] phase 1 (%s): SIGTERM mid-load (graceful drain)"
           % mode)
-    proc, port = start_server(extra_env=mode_env)
+    proc, port = start_server(extra_env=mode_env,
+                              model_params=model_params)
     try:
         threads, outcomes, t0 = fire_requests(port, 8)
         time.sleep(0.4)  # let some seat, some queue
@@ -241,13 +247,15 @@ def _assert_clean_ledger(st, where):
     )
 
 
-def phase_shared_ledger(mode_env=None, mode="dense"):
+def phase_shared_ledger(mode_env=None, mode="dense",
+                        model_params=None):
     print("[drill] phase 3 (%s): shared prefixes resident through "
           "SIGKILL + restart" % mode)
     env = dict(mode_env or {})
     env["EDL_KV_SHARED"] = "1"
-    proc, port = start_server(extra_env=env, num_slots=3)
-    paged = mode == "paged"
+    proc, port = start_server(extra_env=env, num_slots=3,
+                              model_params=model_params)
+    paged = mode.startswith("paged")
     try:
         # wave 1: completes fully; the ledger must drain clean with
         # the prefix chains parked reclaimable (no leaked refcount)
@@ -279,7 +287,8 @@ def phase_shared_ledger(mode_env=None, mode="dense"):
     # restart: a fresh process must rebuild clean block accounting and
     # serve the same shared-prefix load — nothing about the crash can
     # poison the (process-local) ledger
-    proc, port = start_server(extra_env=env, num_slots=3)
+    proc, port = start_server(extra_env=env, num_slots=3,
+                              model_params=model_params)
     try:
         threads, outcomes, t0 = fire_requests(
             port, 6, max_new=16, shared_prefix=True
@@ -307,8 +316,17 @@ def main():
         phase_graceful(mode_env=env, mode=mode)
         phase_hard_kill(mode_env=env, mode=mode)
         phase_shared_ledger(mode_env=env, mode=mode)
-    print("[drill] serving kill drill PASSED (dense + paged, shared-"
-          "prefix ledger)")
+    # int8 arenas: the same drain / SIGKILL-restart / shared-chain
+    # ledger invariants must hold with scale leaves in the arenas
+    # (kv_cache_dtype='int8'); the hard-kill transport semantics are
+    # dtype-blind and already covered above
+    int8_params = MODEL_PARAMS + "; kv_cache_dtype='int8'"
+    phase_graceful(mode_env={"EDL_KV_PAGED": "1"}, mode="paged_int8",
+                   model_params=int8_params)
+    phase_shared_ledger(mode_env={"EDL_KV_PAGED": "1"},
+                        mode="paged_int8", model_params=int8_params)
+    print("[drill] serving kill drill PASSED (dense + paged + "
+          "paged-int8, shared-prefix ledger)")
     return 0
 
 

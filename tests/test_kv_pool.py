@@ -253,6 +253,268 @@ def test_paged_decode_attention_tile_matches_dense_oracle():
                         )
 
 
+# --------------------------------------------------- int8 arenas
+
+
+def _np_quantize_rows(rows):
+    """Numpy twin of the model's `_kv_quantize_rows` (symmetric
+    per-row int8, f32 scales, zero rows keep scale 1) — the oracle the
+    arena round-trip and attention tests quantize with."""
+    amax = np.abs(rows).max(-1, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q8 = np.clip(np.round(rows / scale), -127, 127).astype(np.int8)
+    return q8, scale
+
+
+def test_np_quantizer_matches_model_quantizer():
+    import jax.numpy as jnp
+
+    from model_zoo.transformer_lm.transformer_lm import (
+        _kv_quantize_rows,
+    )
+
+    rows = np.random.RandomState(2).randn(1, 2, 6, 8).astype(np.float32)
+    rows[0, 1, 3] = 0.0  # a zero row must keep scale 1
+    q8, sc = _np_quantize_rows(rows)
+    mq8, msc = _kv_quantize_rows(jnp.asarray(rows))
+    np.testing.assert_array_equal(q8, np.asarray(mq8))
+    np.testing.assert_allclose(sc, np.asarray(msc), rtol=1e-6)
+
+
+def test_int8_prompt_block_write_round_trips_quantizer():
+    """build_pools maps int8 rows AND their f32 scale leaves through
+    the same kv_row_leaf convention, and write_prompt_block inserts a
+    quantized cache block bit-exactly (quantize-at-insertion: the
+    arena holds exactly what the quantizer produced)."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.serving.kv_pool import (
+        build_pools,
+        write_prompt_block,
+    )
+
+    rs = np.random.RandomState(3)
+    hkv, d, cache_len, bs, nb = 2, 8, 16, 4, 6
+    rows = rs.randn(1, hkv, cache_len, d).astype(np.float32)
+    q8, sc = _np_quantize_rows(rows)
+    kv = {
+        "k": jnp.asarray(q8), "k_scale": jnp.asarray(sc),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+    pools = build_pools(kv, cache_len, nb, bs)
+    assert pools["k"].dtype == jnp.int8
+    assert pools["k"].shape == (nb, bs, hkv, d)
+    assert pools["k_scale"].dtype == jnp.float32
+    assert pools["k_scale"].shape == (nb, bs, hkv, 1)
+    assert pools["pos"].shape == ()  # non-row leaf stays a placeholder
+    pools = write_prompt_block(
+        pools, kv, jnp.asarray(1, jnp.int32), jnp.asarray(4, jnp.int32),
+        block_size=bs,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pools["k"][4]),
+        q8[0, :, bs:2 * bs, :].transpose(1, 0, 2),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pools["k_scale"][4]),
+        sc[0, :, bs:2 * bs, :].transpose(1, 0, 2),
+    )
+    # untouched blocks stay zero
+    assert not np.asarray(pools["k"][0]).any()
+
+
+def test_int8_scatter_rows_round_trips_and_drops():
+    """The per-step decode scatter writes int8 rows + scale rows in
+    lockstep; out-of-bounds lanes drop from BOTH leaves."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.serving.kv_pool import scatter_rows
+
+    rs = np.random.RandomState(4)
+    hkv, d, bs, nb, s = 2, 8, 4, 6, 3
+    pools = {
+        "k": jnp.zeros((nb, bs, hkv, d), jnp.int8),
+        "k_scale": jnp.zeros((nb, bs, hkv, 1), jnp.float32),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+    raw = rs.randn(s, hkv, d).astype(np.float32)
+    q8, sc = _np_quantize_rows(raw)
+    rows = {"k": jnp.asarray(q8), "k_scale": jnp.asarray(sc)}
+    bids = jnp.asarray([2, nb, 5], jnp.int32)  # lane 1 = drop sentinel
+    offs = jnp.asarray([1, 0, 3], jnp.int32)
+    out = scatter_rows(pools, rows, bids, offs)
+    np.testing.assert_array_equal(np.asarray(out["k"][2, 1]), q8[0])
+    np.testing.assert_array_equal(
+        np.asarray(out["k_scale"][2, 1]), sc[0]
+    )
+    np.testing.assert_array_equal(np.asarray(out["k"][5, 3]), q8[2])
+    np.testing.assert_array_equal(
+        np.asarray(out["k_scale"][5, 3]), sc[2]
+    )
+    # the dropped lane touched nothing: everything else is still zero
+    mask = np.ones((nb, bs), bool)
+    mask[2, 1] = mask[5, 3] = False
+    assert not np.asarray(out["k"])[mask].any()
+    assert not np.asarray(out["k_scale"])[mask].any()
+
+
+def test_copy_block_carries_scale_leaves():
+    """Device-side CoW must duplicate the scale arenas alongside the
+    int8 rows — a copied block that kept stale scales would silently
+    dequantize to wrong values."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.serving.kv_pool import copy_block
+
+    rs = np.random.RandomState(5)
+    nb, bs, hkv, d = 6, 4, 2, 8
+    pools = {
+        "k": jnp.asarray(
+            rs.randint(-127, 128, size=(nb, bs, hkv, d)), jnp.int8
+        ),
+        "k_scale": jnp.asarray(
+            rs.rand(nb, bs, hkv, 1).astype(np.float32)
+        ),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+    out = copy_block(pools, 1, 4)
+    np.testing.assert_array_equal(
+        np.asarray(out["k"][4]), np.asarray(pools["k"][1])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out["k_scale"][4]), np.asarray(pools["k_scale"][1])
+    )
+    # source untouched
+    np.testing.assert_array_equal(
+        np.asarray(out["k"][1]), np.asarray(pools["k"][1])
+    )
+
+
+def test_paged_int8_attention_matches_dense_deferred_oracle():
+    """The streaming int8 scan vs the dense DEFERRED-dequantize oracle
+    (same quantizer, so the comparison carries no quantization error —
+    float tolerance only): s = (q·k8)·ks, softmax, out = (w·vs)@v8,
+    for the t=1 legacy shape and the verify-k tile, MHA and GQA, with
+    and without a sliding window."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops.attention import paged_decode_attention
+
+    rs = np.random.RandomState(6)
+    bs, nb, d, b = 4, 10, 8, 3
+    for t in (1, 3):
+        for hkv, h in ((2, 2), (1, 4)):
+            for window in (None, 5):
+                kf = rs.randn(nb, bs, hkv, d).astype(np.float32)
+                vf = rs.randn(nb, bs, hkv, d).astype(np.float32)
+                k_pool, ks_pool = _np_quantize_rows(kf)
+                v_pool, vs_pool = _np_quantize_rows(vf)
+                q = rs.randn(b, h, t, d).astype(np.float32)
+                kc_f = rs.randn(b, hkv, t, d).astype(np.float32)
+                vc_f = rs.randn(b, hkv, t, d).astype(np.float32)
+                k_cur, ks_cur = _np_quantize_rows(kc_f)
+                v_cur, vs_cur = _np_quantize_rows(vc_f)
+                lengths = np.asarray([0, 5, 11], np.int32)
+                table = np.full((b, 3), -1, np.int32)
+                table[1, :2] = [7, 2]
+                table[2, :3] = [4, 9, 1]
+                args = (
+                    jnp.asarray(q), jnp.asarray(k_cur),
+                    jnp.asarray(v_cur), jnp.asarray(k_pool),
+                    jnp.asarray(v_pool), jnp.asarray(table),
+                    jnp.asarray(lengths),
+                )
+                kwargs = dict(
+                    window=window,
+                    k_scale_pool=jnp.asarray(ks_pool),
+                    v_scale_pool=jnp.asarray(vs_pool),
+                    k_cur_scale=jnp.asarray(ks_cur),
+                    v_cur_scale=jnp.asarray(vs_cur),
+                )
+                if t == 1:  # exercise the squeezed legacy shape
+                    args = (
+                        jnp.asarray(q[:, :, 0]),
+                        jnp.asarray(k_cur[:, :, 0]),
+                        jnp.asarray(v_cur[:, :, 0]),
+                    ) + args[3:]
+                    kwargs["k_cur_scale"] = jnp.asarray(ks_cur[:, :, 0])
+                    kwargs["v_cur_scale"] = jnp.asarray(vs_cur[:, :, 0])
+                out = np.asarray(
+                    paged_decode_attention(*args, **kwargs)
+                )
+                if t == 1:
+                    out = out[:, :, None, :]
+                group = h // hkv
+                for i in range(b):
+                    ln = int(lengths[i])
+                    zero = np.zeros((0, hkv, d), np.float32)
+                    pk = np.concatenate(
+                        [k_pool[bid].astype(np.float32)
+                         * ks_pool[bid]
+                         for bid in table[i] if bid >= 0] or [zero]
+                    )[:ln]
+                    pv8 = np.concatenate(
+                        [v_pool[bid].astype(np.float32)
+                         for bid in table[i] if bid >= 0] or [zero]
+                    )[:ln]
+                    pvs = np.concatenate(
+                        [np.broadcast_to(vs_pool[bid],
+                                         (bs, hkv, 1))
+                         for bid in table[i] if bid >= 0]
+                        or [np.zeros((0, hkv, 1), np.float32)]
+                    )[:ln]
+                    for jq in range(t):
+                        # deferred oracle: keys pre-scaled by ks; the
+                        # weights (not the values) carry vs
+                        ck = (k_cur[i].astype(np.float32)
+                              * ks_cur[i]).transpose(1, 0, 2)[:jq + 1]
+                        keys = np.concatenate([pk, ck])
+                        v8 = np.concatenate(
+                            [pv8,
+                             v_cur[i].astype(np.float32)
+                             .transpose(1, 0, 2)[:jq + 1]]
+                        )
+                        vs = np.concatenate(
+                            [pvs,
+                             vs_cur[i].transpose(1, 0, 2)[:jq + 1]]
+                        )
+                        k_pos = np.arange(ln + jq + 1)
+                        keep = np.ones(len(k_pos), bool)
+                        if window is not None:
+                            keep = k_pos > ln + jq - window
+                        keys, v8, vs = keys[keep], v8[keep], vs[keep]
+                        for j in range(h):
+                            kvh = j // group
+                            s = keys[:, kvh] @ q[i, j, jq] * d ** -0.5
+                            w = np.exp(s - s.max())
+                            w = w / w.sum()
+                            ref = (w * vs[:, kvh, 0]) @ v8[:, kvh]
+                            np.testing.assert_allclose(
+                                out[i, j, jq], ref,
+                                rtol=5e-5, atol=5e-5,
+                                err_msg="row %d head %d tile %d t=%d "
+                                        "hkv=%d window=%r"
+                                        % (i, j, jq, t, hkv, window),
+                            )
+
+
+def test_paged_int8_attention_requires_all_scales():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from elasticdl_tpu.ops.attention import paged_decode_attention
+
+    z8 = jnp.zeros((2, 4, 1, 8), jnp.int8)
+    zf = jnp.zeros((2, 4, 1, 1), jnp.float32)
+    with _pytest.raises(ValueError, match="scale operands"):
+        paged_decode_attention(
+            jnp.zeros((1, 1, 8)), jnp.zeros((1, 1, 8), jnp.int8),
+            jnp.zeros((1, 1, 8), jnp.int8), z8, z8,
+            jnp.zeros((1, 2), jnp.int32), jnp.zeros((1,), jnp.int32),
+            k_scale_pool=zf,  # v-side scales missing
+        )
+
+
 # ------------------------------------------- prefix sharing + CoW
 
 

@@ -221,6 +221,7 @@ class FakeEngine(object):
 
     def kv_stats(self):
         return {"kv_paged": False, "kv_shared": False,
+                "kv_cache_dtype": "",
                 "kv_block_size": 0,
                 "kv_blocks_total": 0, "kv_blocks_free": 0,
                 "kv_blocks_cached": 0, "kv_blocks_shared": 0,

@@ -572,6 +572,94 @@ def test_serving_telemetry_event_file_written(rig, tmp_path):
     assert os.path.getsize(os.path.join(str(tmp_path), files[0])) > 0
 
 
+def test_paged_int8_shared_spec_matches_offline_int8_32way():
+    """The int8-arena acceptance pin: 32 concurrent GREEDY requests
+    drawn from a small system-prompt pool against a paged + shared +
+    speculative server whose arenas are INT8 (kv_cache_dtype='int8',
+    mismatched draft so rollback exercises) — every token stream must
+    equal offline `autoregressive_generate(use_cache=True)` on the
+    SAME int8 model (the int8 dense oracle: same quantizer, so parity
+    carries no quantization slack). The post-drain ledger must be
+    clean with scale leaves in the arenas, and ServerStatus must
+    advertise the format."""
+    int8_params = PARAMS + "; kv_cache_dtype='int8'"
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(
+        load_model_spec_from_module(zoo), mesh=mesh,
+        model_params=int8_params,
+    )
+    state = _state(trainer)
+    draft_trainer = _trainer(seed=321)  # float draft, mismatched
+    draft_state = _state(draft_trainer)
+
+    systems = [[1, 2, 3, 4], [5, 6, 7, 1, 2, 3, 4, 5]]
+    specs = []
+    for i in range(32):
+        prompt = list(systems[i % 2]) + ([1 + i % 3] if i % 4 else [])
+        specs.append({"prompt": prompt, "new": 3 + i % 5})
+
+    cfg = ServingConfig(
+        num_slots=6, queue_capacity=64, kv_paged=True,
+        kv_block_size=4, kv_num_blocks=24, kv_shared=True, draft_k=2,
+    )
+    server = GenerationServer(
+        trainer, state, cfg, draft=(draft_trainer, draft_state)
+    ).start()
+    try:
+        stub = ServingStub(build_channel("localhost:%d" % server.port))
+        results, errors = {}, {}
+
+        def call(i, s):
+            try:
+                r = stub.generate(
+                    pb.GenerateRequest(
+                        prompt=s["prompt"], max_new_tokens=s["new"],
+                    ),
+                    timeout=120,
+                )
+                results[i] = list(r.tokens)
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=call, args=(i, s))
+            for i, s in enumerate(specs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == 32
+        st = stub.server_status(pb.ServerStatusRequest(), timeout=10)
+        assert st.kv_paged and st.kv_shared
+        assert st.kv_cache_dtype == "int8"
+        assert st.prefix_hit_tokens > 0  # sharing engaged over int8
+        assert st.draft_k == 2 and st.draft_proposed > 0
+        assert st.max_active_slots > 1
+        # clean post-drain ledger with scale leaves in the arenas
+        assert st.kv_blocks_free == st.kv_blocks_total == 24
+        assert st.completed == 32
+        # the byte accounting counts TRUE arena bytes (int8 rows + f32
+        # scales): strictly between the pure-int8 and pure-f32 figures
+        eng = server.engine
+        rows = eng.kv.num_blocks * eng.kv.block_size
+        hkv = trainer.model.num_kv_heads or trainer.model.num_heads
+        d = trainer.model.embed_dim // trainer.model.num_heads
+        layers = trainer.model.num_layers
+        expect = rows * layers * 2 * hkv * (d + 4)  # int8 rows + scales
+        assert st.kv_bytes_total == expect
+    finally:
+        server.stop()
+
+    for i, s in enumerate(specs):
+        off = np.asarray(autoregressive_generate(
+            trainer, state, np.asarray([s["prompt"]], np.int32),
+            s["new"], use_cache=True,
+        ))[0]
+        assert list(off) == results[i], (i, s)
+
+
 def test_shared_prefix_speculative_matches_dense_greedy_32way(rig):
     """The acceptance pin for prefix sharing + speculative decode:
     32 concurrent GREEDY requests drawn from a small system-prompt
