@@ -98,12 +98,18 @@ drill:
 # "paged_int8"/"int8_vs_shared". Arrivals follow a
 # --ramp piecewise-Poisson profile (the SAME generator the autoscale
 # drill uses), so every record also carries per-phase percentiles
-# under "phases".
+# under "phases". --kv_host_blocks additionally runs the tiered-KV
+# eviction-pressure A/B (its own long-prefix int8 rig, device pool
+# below the prefix working set, host tier off vs on at equal DEVICE
+# KV bytes) and records the "host_vs_evict" ratio block: what share
+# of the baseline's re-paid prefill tokens the host tier recovers by
+# revival upload, with steady-state post-eviction TTFT.
 serve-smoke:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py \
 		--ramp "8:0.8,32:0.5,8:0.5" --compare_paged --kv_block_size 4 \
 		--shared_prefix --prefix_len 16 --suffix_len 1:4 \
 		--out_len 4:12 --draft_k 2 --kv_cache_dtype int8 \
+		--kv_host_blocks 84 \
 		--out BENCH_SERVING.json
 
 ci-fast: lint test-fast

@@ -103,6 +103,12 @@ RECEIVER_PAIRS = {
     # (or reap, the escalation) or the seat leaks mid-drain forever
     "spawn": (frozenset(["adopt", "reap"]), "supervis"),
     "begin_drain": (frozenset(["retire", "reap"]), None),
+    # the tiered KV cache's spill lifecycle (serving/kv_pool.py): a
+    # chain block demoted to the host tier must either REVIVE (upload
+    # back into a device block) or DROP (host-budget LRU / reload
+    # flush) on every path — a spilled chain that is neither is host
+    # memory pinned forever with no index entry left to find it
+    "spill": (frozenset(["revive", "drop"]), "tier"),
 }
 
 #: value-bound acquires: callable tail -> release method names

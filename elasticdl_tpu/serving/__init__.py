@@ -11,7 +11,9 @@ the inference half — it turns the offline decode library
                  on membership change); dense per-slot stripes or the
                  block-paged pool (EDL_KV_PAGED / ServingConfig)
 * kv_pool.py     block-paged KV storage: free-list allocator, per-slot
-                 block tables, shared per-layer block arenas
+                 block tables, shared per-layer block arenas, and the
+                 tiered host-spill cache (evicted prefix chains park
+                 in bounded host RAM and revive by upload)
 * server.py      gRPC front-end (Generate / GenerateStream /
                  ServerStatus) + the scheduler thread
 * router.py      health-checked multi-replica routing tier: heartbeat

@@ -87,6 +87,16 @@ def kv_shared_default():
     return os.environ.get("EDL_KV_SHARED", "1") not in ("", "0")
 
 
+def kv_host_bytes_default():
+    """EDL_KV_HOST_BYTES resolves the paged pool's host spill-tier
+    budget when the config leaves it unset (0 = eviction forgets, the
+    pre-tier behavior) — the env toggle the drills/CI use."""
+    try:
+        return int(os.environ.get("EDL_KV_HOST_BYTES", "") or 0)
+    except ValueError:
+        return 0
+
+
 def _fused_dequant():
     return os.environ.get(
         "EDL_SERVING_FUSED_DEQUANT", "") not in ("", "0")
@@ -248,6 +258,12 @@ class ContinuousBatchingEngine(object):
             "kv_bytes_in_use": self.active_count() * per_slot,
             "prefix_hit_tokens": 0,
             "cow_copies": 0,
+            "kv_host_blocks": 0,
+            "kv_host_bytes": 0,
+            "kv_host_bytes_budget": 0,
+            "revive_uploads": 0,
+            "prefill_tokens_revived": 0,
+            "host_drops": 0,
         }
 
     def insert(self, request):
@@ -468,6 +484,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     backpressure instead of a crash. Requires the model's paged-decode
     convention (TransformerLM: `paged` kwarg + "kv_out" sowing).
 
+    TIERED HOST SPILL (host_bytes > 0 / EDL_KV_HOST_BYTES): evicted
+    refcount-0 prefix chains demote to bounded host-RAM buffers
+    instead of being forgotten; a prompt matching a spilled chain
+    seats by UPLOAD (serving/kv_pool.py revival) and then runs only
+    the unshared suffix through the same `_insert_shared` tile — the
+    engine cannot tell a revived prefix from one that never left the
+    device, which is exactly why parity holds. Admission charges one
+    fresh block per spilled chain entry, so upload latency replaces
+    prefill compute without the planner and the allocator ever
+    disagreeing.
+
     INT8 ARENAS (model kv_cache_dtype="int8"): the arenas store
     symmetric per-row int8 rows plus f32 per-row scale arenas
     `[num_blocks, block_size, hkv, 1]` — the scales are KV row leaves
@@ -484,7 +511,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def __init__(self, trainer, state, num_slots, top_k=0, top_p=1.0,
                  block_size=16, num_blocks=0, share_prefix=True,
-                 draft=None, draft_k=0):
+                 draft=None, draft_k=0, host_bytes=None):
         import inspect
 
         model = trainer.model
@@ -508,11 +535,26 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             int(num_slots) * -(-int(model.seq_len) // self.block_size)
         )
         self._share = bool(share_prefix)
+        # host spill tier (None resolves from EDL_KV_HOST_BYTES): the
+        # byte budget for chains demoted to host RAM on eviction,
+        # revived by upload instead of re-prefill
+        self.host_bytes = (
+            kv_host_bytes_default() if host_bytes is None
+            else int(host_bytes)
+        )
         super().__init__(trainer, state, num_slots, top_k=top_k,
                          top_p=top_p)
         self._positions = np.zeros(self.num_slots, np.int32)
         self._suffix_fns = {}  # suffix bucket -> compiled tile prefill
         self._spec_fn = None
+        # last-forwarded pool counters: the engine mirrors the pool's
+        # monotone spill/revival counters into the closed telemetry
+        # set by DELTA, so the event file stays in lockstep with the
+        # allocator no matter which path (seat/extend/CoW) spilled
+        self._host_counters_seen = {
+            "revive_uploads": 0, "prefill_tokens_revived": 0,
+            "host_drops": 0,
+        }
         self._init_draft(draft, draft_k)
 
     def _init_pool(self):
@@ -522,6 +564,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self._kv_shapes, self.seq_len, self.num_slots,
             self.num_blocks, self.block_size,
             share_prefix=self._share,
+            host_bytes=getattr(self, "host_bytes", 0),
         )
         self._kv_bytes_total = self.kv.bytes_total
 
@@ -603,6 +646,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def kv_stats(self):
         return self.kv.stats()
 
+    def _sync_host_telemetry(self):
+        """Forward the pool's monotone spill-tier counters (revival
+        uploads, tokens revived instead of re-prefilled, host LRU
+        drops) into the closed telemetry counter set by delta — the
+        pool is the single source of truth, the telemetry mirror can
+        never drift from it."""
+        if self.telemetry is None:
+            return
+        stats = self.kv.stats()
+        for name in ("revive_uploads", "prefill_tokens_revived",
+                     "host_drops"):
+            delta = stats[name] - self._host_counters_seen[name]
+            if delta:
+                self.telemetry.count(name, delta)
+                self._host_counters_seen[name] = stats[name]
+
     def insert(self, request):
         """Dense-engine contract (prefill + first token), with the KV
         landing in allocated blocks: the allocator reserves the FULL
@@ -661,6 +720,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self._prefill_draft(slot, request)
         request.generated.append(first)
         request.model_version = self.model_version
+        self._sync_host_telemetry()
         if not decoding:
             return slot, first, True
         self._slots[slot] = _Slot(request, total)
@@ -765,6 +825,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             # the block this step writes (position = the slot's pos);
             # drawn from the slot's reservation, so it cannot fail
             self.kv.ensure_blocks(i, int(self._positions[i]))
+        # an extend's pop can spill under pressure: keep the telemetry
+        # mirror current even on decode-only ticks
+        self._sync_host_telemetry()
         if self._step_fn is None:
             self._step_fn = self._build_paged_step()
         with self.trainer.mesh:
@@ -810,6 +873,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             budgets[i] = st.max_total - (
                 len(st.request.prompt) + len(st.request.generated)
             )
+        self._sync_host_telemetry()  # ensure_blocks pops can spill
         if self._spec_fn is None:
             self._spec_fn = self._build_spec_step()
         with self.trainer.mesh:

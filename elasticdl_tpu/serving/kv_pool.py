@@ -64,6 +64,33 @@ tiles, v-scales into weights — ops.attention.paged_decode_attention),
 so no float copy of cached rows exists anywhere. The prefix trie is
 keyed on TOKEN IDS, not bytes, so sharing/CoW/reclaim are dtype-blind.
 
+TIERED HOST SPILL (host_bytes > 0): eviction no longer forgets a
+chain — it DEMOTES it. When the reclaimable LRU must give up a
+refcount-0 block, the block's rows (int8 rows AND f32 scale leaves,
+through the same tree-generic `kv_row_leaf` paths that carry them
+everywhere else) are copied into host numpy buffers and the trie entry
+is re-keyed onto a stable negative VIRTUAL id, so the prefix index
+keeps resolving chains that are no longer device-resident — the same
+host⇄device split `embedding/host_spill.py` plays for embedding rows.
+A later prompt that matches a spilled chain revives it by DEVICE
+UPLOAD (a batched `dynamic_update_slice` scatter into freshly
+allocated blocks, one executable per size bucket) instead of
+re-running prefill; `plan`/`can_seat` charge each spilled chain block
+exactly like a fresh draw, so admission and allocation cannot
+disagree, and the admission cost of a warm prefix becomes upload
+latency rather than prefill compute. Invariants:
+
+* eviction is leaf-first in BOTH tiers: a block spills only when its
+  indexed children are all spilled, and a spilled entry drops only
+  when it has no indexed children at all — so every surviving trie
+  path is complete (resident prefix, spilled suffix, never a hole);
+* the host tier is BOUNDED (`host_bytes`, LRU drop of the oldest
+  childless spilled entry) and never exceeds its budget;
+* `flush_index` (hot reload) flushes BOTH tiers — stale-params rows
+  must never seat a new request from either side of the PCIe bus;
+* virtual ids are never reused, so a recycled device block id can
+  never collide with a spilled entry's key.
+
 Block ids enter the compiled decode step as DEVICE arrays (the tables),
 so slot churn and sequence growth never recompile anything — the same
 zero-recompile contract the dense pool holds, at block granularity.
@@ -111,7 +138,8 @@ class BlockAllocator(object):
     admission may promise to NEW work. Every operation is O(blocks
     touched); steady-state slot churn is O(1) per block."""
 
-    def __init__(self, num_blocks, block_size, share_prefix=False):
+    def __init__(self, num_blocks, block_size, share_prefix=False,
+                 host_blocks=0):
         if num_blocks < 1:
             raise ValueError(
                 "num_blocks must be >= 1, got %d" % num_blocks)
@@ -121,6 +149,8 @@ class BlockAllocator(object):
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.share_prefix = bool(share_prefix)
+        # host-spill tier capacity, in blocks (0 = eviction forgets)
+        self.host_blocks = int(host_blocks)
         # LIFO: the most recently freed block is reused first (warm
         # reuse; also what the reuse-order tests lock)
         self._free = list(range(self.num_blocks - 1, -1, -1))
@@ -129,16 +159,45 @@ class BlockAllocator(object):
         self._cow_credit = {}  # slot -> reserved CoW copies (0 or 1)
         self._reserved = 0    # promised-but-unmaterialized, all slots
         self._refcount = {}   # bid -> live references (allocated only)
-        # prefix index: (parent bid, block token tuple) -> bid; -1 is
-        # the root parent. Collision-free: the key IS the content path.
+        # prefix index: (parent id, block token tuple) -> id; -1 is
+        # the root parent. Collision-free: the key IS the content
+        # path. Ids >= 0 are device block ids (RESIDENT); ids <= -2
+        # are virtual ids of SPILLED entries whose rows live host-side
+        # — vids are minted monotonically and never reused, so a
+        # recycled device bid can never collide with a spilled key.
         self._index = {}
-        self._index_key = {}  # bid -> its index key (reverse map)
-        self._children = {}   # bid -> set of indexed child bids
+        self._index_key = {}  # id -> its index key (reverse map)
+        self._children = {}   # id -> set of indexed child ids
+        # resident indexed children per parent id: the leaf-first
+        # device-eviction predicate, maintained incrementally so
+        # eviction never scans (a block is device-evictable iff it is
+        # cached AND has no resident indexed children)
+        self._rkids = {}
         # refcount-0 blocks still indexed, oldest-first (LRU eviction)
         self._cached = collections.OrderedDict()
+        # the O(1) eviction frontier: the subset of _cached with no
+        # resident indexed children, in the order each block became
+        # evictable (a parent promoted by its last child's spill
+        # re-enters at the tail — whole cold chains drain bottom-up
+        # before a just-promoted parent jumps the line)
+        self._evictable = collections.OrderedDict()
+        # spilled entries: vid -> None, oldest spill first (host LRU)
+        self._spilled = collections.OrderedDict()
+        # droppable spilled entries (no indexed children), oldest first
+        self._spill_leaves = collections.OrderedDict()
+        self._next_vid = -2
+        # data-path hooks the PagedKVPool wires: spill copies a dying
+        # device block's rows out to the host store, drop discards a
+        # host entry. Accounting here, bytes there.
+        self._spill_sink = None   # fn(bid, vid)
+        self._drop_sink = None    # fn(vid)
+        self._revived = []        # [(vid, new bid)] drained by seat
         self.cow_copies = 0        # monotone: CoW faults served
         self.prefix_hits = 0       # monotone: seats that matched
         self.prefix_hit_tokens = 0  # monotone: tokens seated by incref
+        self.spills = 0            # monotone: blocks demoted to host
+        self.host_drops = 0        # monotone: spilled entries dropped
+        self.blocks_revived = 0    # monotone: spilled blocks uploaded
 
     # ------------------------------------------------------------ queries
 
@@ -149,6 +208,11 @@ class BlockAllocator(object):
         """Reclaimable blocks: refcount 0 but still in the prefix
         index — revivable by a match, evictable under pressure."""
         return len(self._cached)
+
+    def num_spilled(self):
+        """Spilled entries: chains demoted to the host tier, still
+        resolvable by the prefix index, revivable by upload."""
+        return len(self._spilled)
 
     def blocks_in_use(self):
         """Blocks pinned by LIVE references (refcount > 0)."""
@@ -198,12 +262,14 @@ class BlockAllocator(object):
         """(chain, needed) for seating `prompt` with `tokens` rows now
         and `commit_tokens` promised: the matched shared chain and how
         many blocks the seat would draw from `available()` (fresh
-        blocks, the CoW credit for a full-prompt match, and the
+        blocks, the CoW credit for a full-prompt match, the
         RECLAIMABLE chain blocks the seat would revive — reviving pops
         a block out of the cache `available()` counts, so it costs
-        capacity exactly like a fresh draw). The admission-time answer
-        `can_seat` and the seat itself (`alloc`) both run through
-        this, so they cannot disagree."""
+        capacity exactly like a fresh draw — and one fresh block per
+        SPILLED chain entry, whose revival-by-upload materializes a
+        new device block). The admission-time answer `can_seat` and
+        the seat itself (`alloc`) both run through this, so they
+        cannot disagree."""
         chain, needed, _cow = self._plan(prompt, tokens, commit_tokens)
         return chain, needed
 
@@ -217,20 +283,26 @@ class BlockAllocator(object):
         # full-prompt match: the engine must re-run the last prompt
         # token for its logits, which re-writes that token's row into
         # the shared tail block -> one planned CoW copy, reserved here.
-        # EXCEPT when the tail is reclaimable (refcount 0): the seat
-        # revives it as sole owner and the re-write lands in place, so
-        # no copy can fault — its cost is the revival charge below,
-        # and charging both would refuse a full-budget reseat forever
-        # on an idle pool
+        # EXCEPT when the tail is reclaimable (refcount 0) or SPILLED:
+        # the seat revives it as sole owner and the re-write lands in
+        # place, so no copy can fault — its cost is the revival/upload
+        # charge below, and charging both would refuse a full-budget
+        # reseat forever on an idle pool
         cow = 1 if (chain and len(chain) * self.block_size
                     >= int(tokens)
+                    and chain[-1] >= 0
                     and chain[-1] not in self._cached) else 0
         # chain blocks at refcount 0 are counted by available(); the
         # seat revives them (incref pops the cache), so they must be
         # charged or reservations can exceed free + reclaimable and
         # a reservation-backed extend could strand mid-decode
         revived = sum(1 for b in chain if b in self._cached)
-        return chain, commit - len(chain) + cow + revived, cow
+        # spilled entries (vids < 0) hold no device block: their
+        # revival draws a fresh one, charged exactly like an unmatched
+        # block — the chain only saves their PREFILL, not their bytes
+        spilled = sum(1 for b in chain if b < 0)
+        resident = len(chain) - spilled
+        return chain, commit - resident + cow + revived, cow
 
     def can_seat(self, prompt, tokens, commit_tokens=None):
         _chain, needed = self.plan(prompt, tokens, commit_tokens)
@@ -262,21 +334,35 @@ class BlockAllocator(object):
                 self._index[key] = bid
                 self._index_key[bid] = key
                 self._children.setdefault(parent, set()).add(bid)
+                if parent >= 0:
+                    # the parent gained a resident child: it is no
+                    # longer a device-eviction leaf
+                    self._rkids[parent] = self._rkids.get(parent, 0) + 1
+                    self._evictable.pop(parent, None)
             parent = bid
 
     def flush_index(self):
-        """Drop the whole prefix index (hot reload: cached rows were
-        computed under superseded params — new requests must never
-        seat on them). Reclaimable blocks return to the free list;
-        live blocks just lose their index entry and free normally at
-        refcount 0."""
+        """Drop the whole prefix index, BOTH tiers (hot reload: cached
+        rows were computed under superseded params — new requests must
+        never seat on them, whether the rows are device-resident or
+        spilled host-side). Reclaimable blocks return to the free
+        list; spilled entries drop their host buffers; live blocks
+        just lose their index entry and free normally at refcount 0."""
         for bid in list(self._cached):
             self._free.append(bid)
             self._refcount.pop(bid, None)
         self._cached.clear()
+        self._evictable.clear()
+        for vid in list(self._spilled):
+            if self._drop_sink is not None:
+                self._drop_sink(vid)
+            self.host_drops += 1
+        self._spilled.clear()
+        self._spill_leaves.clear()
         self._index.clear()
         self._index_key.clear()
         self._children.clear()
+        self._rkids.clear()
 
     # -------------------------------------------------------- refcounts
 
@@ -287,6 +373,7 @@ class BlockAllocator(object):
         pair)."""
         self._refcount[bid] = self._refcount.get(bid, 0) + 1
         self._cached.pop(bid, None)
+        self._evictable.pop(bid, None)
 
     def decref(self, bid):
         """Drop a live reference; at refcount 0 the block becomes
@@ -299,28 +386,163 @@ class BlockAllocator(object):
         self._refcount.pop(bid, None)
         if bid in self._index_key:
             self._cached[bid] = None  # newest at the LRU tail
+            if not self._rkids.get(bid):
+                self._evictable[bid] = None  # leaf: evictable now
         else:
             self._free.append(bid)
 
+    def _dec_resident_kid(self, parent):
+        """A resident indexed child of `parent` left the device tier
+        (evicted or spilled); at zero resident children a CACHED
+        parent becomes device-evictable — leaf-first, incrementally,
+        no scan."""
+        if parent < 0:
+            return
+        n = self._rkids.get(parent, 0) - 1
+        if n > 0:
+            self._rkids[parent] = n
+            return
+        self._rkids.pop(parent, None)
+        if parent in self._cached:
+            self._evictable[parent] = None
+
+    def _unindex(self, node):
+        """Remove `node` (bid or vid) from the prefix index entirely.
+        Only ever called on index leaves (no indexed children), so no
+        child re-keying is needed."""
+        key = self._index_key.pop(node)
+        del self._index[key]
+        parent = key[0]
+        kids = self._children.get(parent)
+        if kids is not None:
+            kids.discard(node)
+            if not kids:
+                del self._children[parent]
+                if parent in self._spilled:
+                    # the parent just became a host-droppable leaf
+                    self._spill_leaves[parent] = None
+        self._children.pop(node, None)
+        self._rkids.pop(node, None)
+        if node >= 0:
+            self._dec_resident_kid(parent)
+
+    def _rekey_children(self, old, new):
+        """Re-key `old`'s indexed children under id `new` (spill:
+        bid -> vid, revive: vid -> bid). The key IS the content path,
+        so only the parent-id half moves; the token tuples are
+        untouched."""
+        sub = self._children.pop(old, None)
+        if not sub:
+            return False
+        self._children[new] = sub
+        for child in sub:
+            ckey = self._index_key.pop(child)
+            del self._index[ckey]
+            nkey = (new, ckey[1])
+            self._index[nkey] = child
+            self._index_key[child] = nkey
+        return True
+
+    def _drop_spilled(self):
+        """Drop the oldest CHILDLESS spilled entry (leaf-first in the
+        host tier too: dropping an interior entry would orphan its
+        children's keys). Spilled entries always have a childless
+        descendant — device eviction is leaf-first, so a spilled
+        node's children are all spilled — hence progress."""
+        try:
+            vid = next(iter(self._spill_leaves))
+        except StopIteration:
+            raise OutOfBlocks(
+                "no droppable spilled entry (host tier invariant "
+                "broken)"
+            ) from None
+        del self._spill_leaves[vid]
+        del self._spilled[vid]
+        self._unindex(vid)
+        if self._drop_sink is not None:
+            self._drop_sink(vid)
+        self.host_drops += 1
+
+    def _spill(self, bid):
+        """Demote evicted block `bid` to the host tier under a fresh
+        virtual id: rows copy out through the spill sink BEFORE the
+        device block id is recycled, the trie entry re-keys onto the
+        vid (children — all spilled already — re-key under it), and
+        the host LRU drops its oldest leaves to stay inside the
+        budget."""
+        while len(self._spilled) >= self.host_blocks:
+            self._drop_spilled()
+        vid = self._next_vid
+        self._next_vid -= 1
+        if self._spill_sink is not None:
+            self._spill_sink(bid, vid)
+        key = self._index_key.pop(bid)
+        self._index[key] = vid
+        self._index_key[vid] = key
+        parent = key[0]
+        kids = self._children.get(parent)
+        if kids is not None:
+            kids.discard(bid)
+            kids.add(vid)
+        if not self._rekey_children(bid, vid):
+            self._spill_leaves[vid] = None
+        self._rkids.pop(bid, None)
+        self._spilled[vid] = None
+        self._dec_resident_kid(parent)
+        self.spills += 1
+
+    def _revive(self, vid, bid):
+        """Promote spilled entry `vid` back onto device block `bid`
+        (the caller uploads the rows): the trie entry re-keys onto the
+        bid, spilled children re-key under it, and the move is logged
+        for the pool's batched upload."""
+        del self._spilled[vid]
+        self._spill_leaves.pop(vid, None)
+        key = self._index_key.pop(vid)
+        self._index[key] = bid
+        self._index_key[bid] = key
+        parent = key[0]
+        kids = self._children.get(parent)
+        if kids is not None:
+            kids.discard(vid)
+            kids.add(bid)
+        self._rekey_children(vid, bid)
+        if parent >= 0:
+            self._rkids[parent] = self._rkids.get(parent, 0) + 1
+            self._evictable.pop(parent, None)
+        self._revived.append((vid, bid))
+        self.blocks_revived += 1
+
+    def take_revived(self):
+        """Drain the (vid, bid) moves the last alloc revived — the
+        pool uploads their host rows into the fresh device blocks in
+        one batched scatter."""
+        out = self._revived
+        self._revived = []
+        return out
+
     def _evict_cached(self):
-        """Reclaim the oldest LEAF in the reclaimable LRU (a live
-        block's ancestors are live, so every reclaimable subtree has a
-        reclaimable leaf — progress is guaranteed)."""
-        for bid in self._cached:
-            if not self._children.get(bid):
-                key = self._index_key.pop(bid)
-                del self._index[key]
-                kids = self._children.get(key[0])
-                if kids is not None:
-                    kids.discard(bid)
-                    if not kids:
-                        del self._children[key[0]]
-                self._children.pop(bid, None)
-                del self._cached[bid]
-                return bid
-        raise OutOfBlocks(
-            "no evictable cached block (allocator invariant broken)"
-        )
+        """Reclaim the oldest device-evictable block — O(1): the
+        `_evictable` frontier is maintained on every incref / decref /
+        index / spill transition, so eviction never scans the LRU (a
+        live block's ancestors are live, so every reclaimable subtree
+        has a reclaimable leaf — the frontier is empty iff the cache
+        is). With a host tier the block SPILLS (the chain survives,
+        demoted); without one it is forgotten outright."""
+        try:
+            bid = next(iter(self._evictable))
+        except StopIteration:
+            raise OutOfBlocks(
+                "no evictable cached block (allocator invariant "
+                "broken)"
+            ) from None
+        del self._evictable[bid]
+        del self._cached[bid]
+        if self.host_blocks > 0:
+            self._spill(bid)
+        else:
+            self._unindex(bid)
+        return bid
 
     def _pop_block(self):
         if self._free:
@@ -348,21 +570,47 @@ class BlockAllocator(object):
                 "need %d new blocks (%d now, %d shared), %d available"
                 % (needed, now, len(chain), self.available())
             )
-        for bid in chain:
-            self.incref(bid)
-        fresh = []
-        for _ in range(now - len(chain)):
+        # seat the chain: resident entries by incref, spilled entries
+        # by revival (pop a fresh block, re-key, log the upload). A
+        # pop's own spill cascade can drop a not-yet-revived chain
+        # entry under host-budget pressure — the chain truncates there
+        # and the remainder draws fresh (the plan charged a fresh
+        # block for every spilled entry either way, so accounting is
+        # unchanged; only the shared-token count shrinks).
+        table_ids = []
+        shared_blocks = 0
+        for node in chain:
+            if node >= 0:
+                self.incref(node)
+                table_ids.append(node)
+                shared_blocks += 1
+                continue
+            if node not in self._spilled:
+                break  # dropped since plan time: rest of chain is gone
+            bid = self._pop_block()
+            if node in self._spilled:
+                self._revive(node, bid)
+                self.incref(bid)
+                table_ids.append(bid)
+                shared_blocks += 1
+            else:
+                # the pop's cascade dropped THIS entry: the drawn
+                # block becomes a plain fresh draw for its position
+                self.incref(bid)
+                table_ids.append(bid)
+                break
+        while len(table_ids) < now:
             bid = self._pop_block()
             self.incref(bid)
-            fresh.append(bid)
-        self._tables[slot] = list(chain) + fresh
+            table_ids.append(bid)
+        self._tables[slot] = table_ids
         self._committed[slot] = commit
         self._cow_credit[slot] = cow
         self._reserved += (commit - now) + cow
-        if chain:
+        if shared_blocks:
             self.prefix_hits += 1
-            self.prefix_hit_tokens += len(chain) * self.block_size
-        return len(chain) * self.block_size
+            self.prefix_hit_tokens += shared_blocks * self.block_size
+        return shared_blocks * self.block_size
 
     def extend(self, slot, total_tokens):
         """Grow `slot`'s table to cover `total_tokens` rows; growth
@@ -537,7 +785,7 @@ class PagedKVPool(object):
     per-slot work."""
 
     def __init__(self, kv_shapes, cache_len, num_slots, num_blocks,
-                 block_size, share_prefix=False):
+                 block_size, share_prefix=False, host_bytes=0):
         cache_len = int(cache_len)
         block_size = int(block_size)
         if cache_len % block_size:
@@ -576,6 +824,22 @@ class PagedKVPool(object):
         )
         self._write_fn = None
         self._copy_fn = None
+        # ---- tiered host spill (serving the ROADMAP "Tiered KV
+        # cache" item): the budget is BYTES, the allocator accounts in
+        # BLOCKS — one spilled block costs exactly block_bytes (full
+        # blocks only enter the index, and a spill copies every row
+        # leaf, scale leaves included)
+        self.host_bytes_budget = int(host_bytes)
+        host_blocks = (self.host_bytes_budget // self.block_bytes
+                       if self.block_bytes else 0)
+        self.allocator.host_blocks = int(host_blocks)
+        self.allocator._spill_sink = self._spill_block
+        self.allocator._drop_sink = self._drop_host_block
+        self._host_rows = {}   # vid -> [np rows per 4-d leaf, in order]
+        self.host_blocks_peak = 0
+        self.revive_uploads = 0  # monotone: batched revival scatters
+        self._gather_fn = None
+        self._upload_fns = {}  # padded batch size -> compiled scatter
 
     # ----------------------------------------------------------- lifecycle
 
@@ -585,15 +849,94 @@ class PagedKVPool(object):
 
     def seat(self, slot, prompt, commit_tokens):
         """Reserve the request's full block budget and materialize the
-        prompt's blocks — shared-prefix blocks by incref, the rest
-        fresh; raises OutOfBlocks with nothing taken. Returns the
-        shared token count (0 without a match)."""
+        prompt's blocks — shared-prefix blocks by incref, spilled
+        chain blocks by revival upload, the rest fresh; raises
+        OutOfBlocks with nothing taken. Returns the shared token count
+        (0 without a match; revived tokens count as shared — they are
+        seated without re-running prefill either way)."""
         shared = self.allocator.alloc(
             slot, len(prompt), commit_tokens=commit_tokens,
             prompt=prompt,
         )
+        self._apply_revivals()
         self._sync_row(slot)
         return shared
+
+    # ------------------------------------------------- host spill tier
+
+    def _spill_block(self, bid, vid):
+        """Allocator spill sink: copy device block `bid`'s rows (every
+        4-d arena leaf — int8 rows and f32 scale leaves alike) into
+        host numpy buffers under `vid`, BEFORE the bid is recycled.
+        One compiled gather serves every spill (traced bid)."""
+        if self._gather_fn is None:
+            def gather(pools, b):
+                return [leaf[b] for leaf in jax.tree.leaves(pools)
+                        if leaf.ndim == 4]
+
+            self._gather_fn = jax.jit(gather)
+        rows = self._gather_fn(self.pools, jnp.asarray(bid, jnp.int32))
+        self._host_rows[vid] = [np.asarray(r) for r in rows]
+        self.host_blocks_peak = max(self.host_blocks_peak,
+                                    len(self._host_rows))
+
+    def _drop_host_block(self, vid):
+        """Allocator drop sink: the host LRU (or a flush) discarded a
+        spilled entry — its rows are gone for good."""
+        self._host_rows.pop(vid, None)
+
+    def _apply_revivals(self):
+        """Upload the rows of every chain entry the last seat revived
+        into its freshly allocated device block: ONE batched scatter
+        over the block axis per seat, padded to a power-of-two bucket
+        (pad lanes carry the out-of-bounds drop id), so a handful of
+        executables serve every revival size. The host copies are
+        consumed — revival is a MOVE, not a copy."""
+        moves = self.allocator.take_revived()
+        if not moves:
+            return
+        k = len(moves)
+        k_pad = 1
+        while k_pad < k:
+            k_pad *= 2
+        bids = np.full(k_pad, self.num_blocks, np.int32)  # drop lanes
+        per_leaf = None
+        for i, (vid, bid) in enumerate(moves):
+            bids[i] = bid
+            rows = self._host_rows.pop(vid)
+            if per_leaf is None:
+                per_leaf = [
+                    np.zeros((k_pad,) + r.shape, r.dtype) for r in rows
+                ]
+            for j, r in enumerate(rows):
+                per_leaf[j][i] = r
+        fn = self._upload_fns.get(k_pad)
+        if fn is None:
+            def upload(pools, rows_list, b):
+                flat, treedef = jax.tree_util.tree_flatten(pools)
+                out, it = [], iter(rows_list)
+                for leaf in flat:
+                    if leaf.ndim == 4:
+                        out.append(
+                            leaf.at[b].set(next(it), mode="drop")
+                        )
+                    else:
+                        out.append(leaf)
+                return jax.tree_util.tree_unflatten(treedef, out)
+
+            fn = jax.jit(upload)
+            self._upload_fns[k_pad] = fn
+        self.pools = fn(
+            self.pools,
+            [jnp.asarray(r) for r in per_leaf],
+            jnp.asarray(bids),
+        )
+        self.revive_uploads += 1
+
+    def host_bytes_in_use(self):
+        """True host-tier bytes: spilled blocks hold every row leaf of
+        one block at its own dtype, i.e. exactly block_bytes each."""
+        return len(self._host_rows) * self.block_bytes
 
     def register_prefix(self, slot, prompt):
         """Index the slot's full prompt blocks for future sharing
@@ -657,7 +1000,9 @@ class PagedKVPool(object):
 
     def flush_prefix_cache(self):
         """Hot reload hook: stale-params rows must never seat a new
-        request (see BlockAllocator.flush_index)."""
+        request — BOTH tiers flush (BlockAllocator.flush_index drops
+        every spilled entry through the drop sink, emptying the host
+        store here)."""
         self.allocator.flush_index()
 
     def _sync_row(self, slot):
@@ -697,4 +1042,16 @@ class PagedKVPool(object):
             "kv_bytes_in_use": self.bytes_in_use(),
             "prefix_hit_tokens": self.allocator.prefix_hit_tokens,
             "cow_copies": self.allocator.cow_copies,
+            # tiered host spill: current host-tier occupancy (gauges)
+            # and the monotone spill economy (counters). Tokens, not
+            # blocks, for the revival headline — spilled blocks are
+            # always full, so the product is exact.
+            "kv_host_blocks": self.allocator.num_spilled(),
+            "kv_host_bytes": self.host_bytes_in_use(),
+            "kv_host_bytes_budget": self.host_bytes_budget,
+            "revive_uploads": self.revive_uploads,
+            "prefill_tokens_revived": (
+                self.allocator.blocks_revived * self.block_size
+            ),
+            "host_drops": self.allocator.host_drops,
         }

@@ -58,6 +58,10 @@ def parse_serving_args(args=None):
     # (default on) — refcounted dedupe of matching prompt prefixes
     parser.add_argument("--kv_shared", type=int, default=-1,
                         choices=(-1, 0, 1))
+    # tiered host spill (paged only): byte budget for evicted prefix
+    # chains demoted to host RAM and revived by upload instead of
+    # re-prefill; -1 resolves from EDL_KV_HOST_BYTES, 0 = off
+    parser.add_argument("--kv_host_bytes", type=int, default=-1)
     # speculative decode: a small DRAFT model proposes draft_k tokens
     # per tick, verified in one target step (paged pool only; token-
     # exact with plain decode)
@@ -135,6 +139,8 @@ def build_server(args):
             kv_num_blocks=args.kv_num_blocks,
             kv_shared=(None if args.kv_shared < 0
                        else bool(args.kv_shared)),
+            kv_host_bytes=(None if args.kv_host_bytes < 0
+                           else args.kv_host_bytes),
             draft_k=draft_k if draft is not None else 0,
         ),
         draft=draft,

@@ -253,6 +253,14 @@ class Replica(object):
         self.kv_blocks_cached = 0
         # the replica's KV arena storage format ("" | "int8")
         self.kv_cache_dtype = ""
+        # tiered host spill, passed through from ServerStatus: how
+        # much warm prefix capacity survives eviction on this replica
+        # (the signal prefix-affinity routing will want: warm != cold)
+        self.kv_host_blocks = 0
+        self.kv_host_bytes = 0
+        self.revive_uploads = 0
+        self.prefill_tokens_revived = 0
+        self.host_drops = 0
         self.queue_wait_ms = 0.0
         self.ttft_hist = []
         self.queue_wait_hist = []
@@ -346,6 +354,11 @@ class Replica(object):
         self.kv_blocks_free = status.kv_blocks_free
         self.kv_blocks_cached = status.kv_blocks_cached
         self.kv_cache_dtype = status.kv_cache_dtype
+        self.kv_host_blocks = status.kv_host_blocks
+        self.kv_host_bytes = status.kv_host_bytes
+        self.revive_uploads = status.revive_uploads
+        self.prefill_tokens_revived = status.prefill_tokens_revived
+        self.host_drops = status.host_drops
         self.queue_wait_ms = status.queue_wait_ms
         # raw histogram buckets (mergeable by addition): the router
         # sums these across replicas for fleet-wide percentiles
@@ -907,6 +920,11 @@ class Router(object):
                 active_slots=rep.active_slots,
                 kv_blocks_free=rep.kv_blocks_free,
                 kv_cache_dtype=rep.kv_cache_dtype,
+                kv_host_blocks=rep.kv_host_blocks,
+                kv_host_bytes=rep.kv_host_bytes,
+                revive_uploads=rep.revive_uploads,
+                prefill_tokens_revived=rep.prefill_tokens_revived,
+                host_drops=rep.host_drops,
                 queue_wait_ms=rep.queue_wait_ms,
                 dispatched=rep.dispatched,
                 failures=rep.failures,
@@ -915,10 +933,29 @@ class Router(object):
         autoscaler = None
         if self.autoscaler is not None:
             autoscaler = self.autoscaler.status_block()
+        # fleet-wide host-tier view: occupancy gauges and the monotone
+        # revival economy sum across replicas (counters are monotone
+        # per replica, so the fleet sums are monotone too while the
+        # roster is stable; a replaced replica resets its share — the
+        # same contract every other fleet counter here has)
+        fleet_host_blocks = sum(r.kv_host_blocks
+                                for r in self.replicas())
+        fleet_host_bytes = sum(r.kv_host_bytes
+                               for r in self.replicas())
+        fleet_revive_uploads = sum(r.revive_uploads
+                                   for r in self.replicas())
+        fleet_revived_tokens = sum(r.prefill_tokens_revived
+                                   for r in self.replicas())
+        fleet_host_drops = sum(r.host_drops for r in self.replicas())
         return pb.RouterStatusResponse(
             autoscaler=autoscaler,
             replicas=len(reps),
             healthy=sum(1 for r in reps if r.healthy),
+            kv_host_blocks=fleet_host_blocks,
+            kv_host_bytes=fleet_host_bytes,
+            revive_uploads=fleet_revive_uploads,
+            prefill_tokens_revived=fleet_revived_tokens,
+            host_drops=fleet_host_drops,
             replica=reps,
             routed=snap["routed"],
             completed=snap["completed"],

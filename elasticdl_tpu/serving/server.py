@@ -43,6 +43,7 @@ from elasticdl_tpu.serving.admission import (
 from elasticdl_tpu.serving.engine import (
     ContinuousBatchingEngine,
     PagedContinuousBatchingEngine,
+    kv_host_bytes_default,
     kv_paged_default,
     kv_shared_default,
 )
@@ -69,7 +70,13 @@ class ServingConfig(object):
     same system prompt pay for its cache once. draft_k > 0 (with a
     draft model handed to GenerationServer) turns each scheduler tick
     into a speculative draft-verify step committing up to draft_k + 1
-    tokens, token-exact with plain decode."""
+    tokens, token-exact with plain decode.
+
+    kv_host_bytes (paged only; None resolves from EDL_KV_HOST_BYTES,
+    default 0 = off) bounds the host-RAM spill tier: evicted prefix
+    chains demote to host buffers and revive by device upload instead
+    of re-paying prefill — a cell's system-prompt working set survives
+    device pressure."""
 
     def __init__(self, num_slots=4, queue_capacity=64, top_k=0,
                  top_p=1.0, checkpoint_dir="", reload_poll_secs=2.0,
@@ -77,7 +84,7 @@ class ServingConfig(object):
                  idle_wait_secs=0.05, handler_poll_secs=0.25,
                  port=0, max_workers=64, kv_paged=None,
                  kv_block_size=16, kv_num_blocks=0, kv_shared=None,
-                 draft_k=0):
+                 draft_k=0, kv_host_bytes=None):
         self.num_slots = int(num_slots)
         self.queue_capacity = int(queue_capacity)
         self.top_k = int(top_k)
@@ -100,6 +107,10 @@ class ServingConfig(object):
             else bool(kv_shared)
         )
         self.draft_k = int(draft_k)
+        self.kv_host_bytes = (
+            kv_host_bytes_default() if kv_host_bytes is None
+            else int(kv_host_bytes)
+        )
 
 
 class _Scheduler(threading.Thread):
@@ -183,6 +194,8 @@ class _Scheduler(threading.Thread):
                 len(self.queue), len(results), dt, committed,
                 kv_bytes_in_use=kv["kv_bytes_in_use"],
                 kv_blocks_free=kv["kv_blocks_free"],
+                kv_host_blocks=kv.get("kv_host_blocks"),
+                kv_host_bytes=kv.get("kv_host_bytes"),
             )
         else:
             self.queue.wait_for_work(self.idle_wait_secs)
@@ -345,6 +358,14 @@ class ServingServicer(object):
             kv_bytes_per_token=snap["kv_bytes_per_token"],
             prefix_hit_tokens=kv["prefix_hit_tokens"],
             cow_copies=kv["cow_copies"],
+            # tiered host spill: occupancy gauges + the monotone
+            # revival economy (tokens seated by upload instead of
+            # re-prefill) — .get so bare test engines stay valid
+            kv_host_blocks=kv.get("kv_host_blocks", 0),
+            kv_host_bytes=kv.get("kv_host_bytes", 0),
+            revive_uploads=kv.get("revive_uploads", 0),
+            prefill_tokens_revived=kv.get("prefill_tokens_revived", 0),
+            host_drops=kv.get("host_drops", 0),
             draft_k=self._engine.draft_k,
             draft_proposed=self._engine.draft_proposed,
             draft_accepted=self._engine.draft_accepted,
@@ -459,6 +480,7 @@ class GenerationServer(object):
                 num_blocks=cfg.kv_num_blocks,
                 share_prefix=cfg.kv_shared,
                 draft=draft, draft_k=cfg.draft_k,
+                host_bytes=cfg.kv_host_bytes,
             )
         else:
             if draft is not None and cfg.draft_k:

@@ -12,6 +12,8 @@ whole system. Gauges, stepped by decode-step index:
                                at each request's first token)
     serving/kv_bytes_in_use    KV bytes live requests pin at the step
     serving/kv_blocks_free     paged pool's free blocks at the step
+    serving/kv_host_blocks     spilled chain blocks parked host-side
+    serving/kv_host_bytes      host spill-tier bytes at the step
     serving/queue_wait_ms      EWMA of time-queued-before-seating (the
                                router's load signal; ServerStatus field)
     serving/ttft_p99_ms        histogram percentiles, one scalar per
@@ -63,9 +65,16 @@ class ServingTelemetry(object):
     #: incref (never re-prefilled), cow_copies the copy-on-write
     #: faults, draft_proposed/draft_accepted the speculative-decode
     #: proposal economy (accept rate = accepted / proposed).
+    #: The tiered-KV trio: revive_uploads counts batched host->device
+    #: revival scatters, prefill_tokens_revived the prompt tokens
+    #: those uploads seated WITHOUT re-running prefill (the host
+    #: tier's whole reason to exist), host_drops the spilled entries
+    #: the bounded host LRU (or a reload flush) discarded.
     COUNTERS = ("admitted", "rejected", "expired", "completed",
                 "tokens_generated", "reloads", "prefix_hit_tokens",
-                "cow_copies", "draft_proposed", "draft_accepted")
+                "cow_copies", "draft_proposed", "draft_accepted",
+                "revive_uploads", "prefill_tokens_revived",
+                "host_drops")
     #: latency histograms (ms), all on the shared bucket scheme
     HISTOGRAMS = ("ttft_ms", "queue_wait_ms", "step_ms", "e2e_ms")
 
@@ -171,7 +180,8 @@ class ServingTelemetry(object):
 
     def record_step(self, queue_depth, active_slots, step_secs,
                     tokens_committed, kv_bytes_in_use=None,
-                    kv_blocks_free=None):
+                    kv_blocks_free=None, kv_host_blocks=None,
+                    kv_host_bytes=None):
         """Per-decode-step gauges; counters flush every flush_every
         steps so the event file stays O(steps / flush_every)."""
         with self._lock:
@@ -193,6 +203,12 @@ class ServingTelemetry(object):
             if kv_blocks_free is not None:
                 self._scalar("serving/kv_blocks_free",
                              kv_blocks_free, self._step)
+            if kv_host_blocks is not None:
+                self._scalar("serving/kv_host_blocks",
+                             kv_host_blocks, self._step)
+            if kv_host_bytes is not None:
+                self._scalar("serving/kv_host_bytes",
+                             kv_host_bytes, self._step)
             self._scalar("serving/queue_depth", queue_depth, self._step)
             self._scalar("serving/active_slots", active_slots, self._step)
             self._scalar(

@@ -140,6 +140,16 @@ def parse_args(argv=None):
     # quantized model)
     p.add_argument("--kv_cache_dtype", default="",
                    choices=("", "int8"))
+    # tiered host spill (serving/kv_pool.py): host-tier capacity in
+    # BLOCKS (converted to bytes at the serving rig's exact
+    # block_bytes). Single-run mode arms the tier directly; with
+    # --compare_paged AND --shared_prefix it also runs the
+    # EVICTION-PRESSURE A/B: the same shared-prefix plan over a
+    # device pool deliberately sized below the prefix working set,
+    # once with the host tier off (every evicted chain re-pays
+    # prefill) and once on (evicted chains revive by upload), at
+    # equal DEVICE KV bytes — the "host_vs_evict" ratio block
+    p.add_argument("--kv_host_blocks", type=int, default=0)
     return p.parse_args(argv)
 
 
@@ -321,7 +331,7 @@ def build_plan(args, seq_len, vocab):
 
 def run_load(args, trainer, state, plan, num_slots, kv_paged,
              kv_block_size, kv_num_blocks, kv_shared=False,
-             draft=None, draft_k=0):
+             draft=None, draft_k=0, kv_host_bytes=0):
     import jax
 
     from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -338,6 +348,7 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             kv_num_blocks=kv_num_blocks,
             kv_shared=kv_shared,
             draft_k=draft_k if draft is not None else 0,
+            kv_host_bytes=kv_host_bytes,
         ),
         draft=draft,
     ).start()
@@ -450,6 +461,12 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             "rejected": status.rejected,
             "prefix_hit_tokens": status.prefix_hit_tokens,
             "cow_copies": status.cow_copies,
+            # tiered host spill (zeros with the tier off)
+            "host_blocks": status.kv_host_blocks,
+            "host_bytes": status.kv_host_bytes,
+            "revive_uploads": status.revive_uploads,
+            "prefill_tokens_revived": status.prefill_tokens_revived,
+            "host_drops": status.host_drops,
         },
         # speculative-decode economy (zeros when --draft_k is off)
         "draft": {
@@ -518,6 +535,151 @@ def greedy_match_rate(trainer, state, results, temperature):
     return round(matched / compared, 4) if compared else None
 
 
+#: the eviction-pressure A/B's own serving rig: long system prompts
+#: over a real-ish context, so a re-paid prefill is real compute (the
+#: tiny smoke model's 32-token prefill costs ~2 ms — cheaper than any
+#: measurement overhead, so TTFT could not see the difference). At
+#: this scale a full re-prefill seat measures ~29 ms vs ~13 ms for a
+#: revive-by-upload seat on the CPU rig.
+PRESS_MODEL_PARAMS = (
+    "vocab_size=32; seq_len=256; embed_dim=256; num_heads=4; "
+    "num_layers=4"
+)
+PRESS_PREFIX_LEN = 224
+PRESS_BLOCK_SIZE = 16
+
+
+def run_host_evict_ab(args):
+    """The tiered-KV eviction-pressure A/B: a shared-prefix workload
+    whose prefix WORKING SET deliberately exceeds the device pool, so
+    reclaimable chains are forced out between hits — run twice at
+    EQUAL DEVICE KV BYTES, host tier off (every evicted chain re-pays
+    its prefill on the next hit) vs on (evicted chains spill and
+    revive by upload). The headline ratio: what fraction of the
+    prefill tokens the baseline re-pays after eviction does the host
+    tier recover (`prefill_tokens_revived` vs the baseline's
+    repeated-prefix re-prefill tokens)? Runs its own rig
+    (PRESS_MODEL_PARAMS, int8 arenas when --kv_cache_dtype says so)
+    with 96-token system prompts: long enough that a re-paid prefill
+    costs real compute, which is what the TTFT comparison measures."""
+    import numpy as np
+
+    model_params = PRESS_MODEL_PARAMS
+    if args.kv_cache_dtype:
+        model_params += "; kv_cache_dtype=%r" % args.kv_cache_dtype
+    trainer, state, _ = build_rig(args, model_params=model_params)
+    vocab = int(trainer.model.vocab_size)
+    bs = PRESS_BLOCK_SIZE
+    o_lo, o_hi = _span(args.out_len)
+    s_lo, s_hi = _span(args.suffix_len)
+    prefix_len = (PRESS_PREFIX_LEN // bs) * bs  # full blocks only
+    press_pool = 6   # distinct system prompts in the pressure pool
+    passes = 4       # times each prompt comes back around
+    # a seat's full commitment, in blocks — the device pool holds two
+    # concurrent seats and nothing more, far below the working set
+    seat_blocks = -(-(prefix_len + s_hi + o_hi - 1) // bs)
+    device_blocks = 2 * seat_blocks
+    working_set = press_pool * (prefix_len // bs)
+    if working_set <= device_blocks:
+        raise SystemExit(
+            "eviction-pressure A/B needs the prefix working set "
+            "(%d blocks) above the device pool (%d)"
+            % (working_set, device_blocks)
+        )
+    host_blocks = working_set  # the tier holds the whole working set
+    host_bytes = host_blocks * block_bytes_for(trainer, bs)
+    rs = np.random.RandomState(args.seed + 17)
+    pool = [rs.randint(0, vocab, size=prefix_len)
+            for _ in range(press_pool)]
+    # arrivals slow enough that TTFT is seat latency (prefill vs
+    # revive), not queueing — the quantity under test
+    rate = 1.5
+    plan = []
+    for i in range(passes * press_pool):
+        # round-robin: consecutive hits of one prefix are press_pool
+        # requests apart, so the tight pool has evicted it in between
+        suffix = rs.randint(0, vocab,
+                            size=rs.randint(s_lo, s_hi + 1))
+        plan.append({
+            "prompt": np.concatenate([pool[i % press_pool], suffix]),
+            "new": int(rs.randint(o_lo, o_hi + 1)),
+            "gap": float(rs.exponential(1.0 / rate)),
+            "seed": int(i),
+            "phase": None,
+        })
+    legs, rows = {}, {}
+    for name, bytes_budget in (("baseline", 0), ("host", host_bytes)):
+        legs[name], rows[name] = run_load(
+            args, trainer, state, plan, 2,
+            kv_paged=True,
+            kv_block_size=bs,
+            kv_num_blocks=device_blocks,
+            kv_shared=True,
+            kv_host_bytes=bytes_budget,
+        )
+
+    def post_evict_ttft(leg_rows):
+        """TTFT percentiles over the STEADY post-eviction hits: the
+        last two passes, by which point every compile (either leg's)
+        is paid and every seat of a pooled prompt finds its chain
+        evicted — re-prefilled by the baseline, revived by the host
+        tier. Same histogram code as every other percentile."""
+        steady = [
+            r["ttft_ms"] for r in leg_rows
+            if r["status"] == "OK" and r["ttft_ms"] is not None
+            and r["spec"]["seed"] >= 2 * press_pool
+        ]
+        return percentiles(steady, (50, 90, 99))
+
+    base, host = legs["baseline"], legs["host"]
+    base_steady = post_evict_ttft(rows["baseline"]) or {}
+    host_steady = post_evict_ttft(rows["host"]) or {}
+    offered = len(plan) * prefix_len   # full-block prefix tokens sent
+    cold = press_pool * prefix_len     # first-touch: unavoidable
+    repaid_base = max(
+        0, offered - base["kv"]["prefix_hit_tokens"] - cold
+    )
+    recovered = host["kv"]["prefill_tokens_revived"]
+    return {
+        "model_params": model_params,
+        "block_size": bs,
+        "device_blocks": device_blocks,
+        "host_blocks": host_blocks,
+        "prefix_pool": press_pool,
+        "passes": passes,
+        "prefix_working_set_blocks": working_set,
+        "equal_device_kv_bytes": (
+            base["kv"]["bytes_total"] == host["kv"]["bytes_total"]
+        ),
+        "prefix_tokens_offered": offered,
+        "cold_prefix_tokens": cold,
+        "baseline_repaid_prefix_tokens": repaid_base,
+        "prefill_tokens_revived": recovered,
+        "recovered_ratio": round(recovered / max(1, repaid_base), 3),
+        "revive_uploads": host["kv"]["revive_uploads"],
+        "host_drops": host["kv"]["host_drops"],
+        "prefix_hit_tokens": [base["kv"]["prefix_hit_tokens"],
+                              host["kv"]["prefix_hit_tokens"]],
+        # steady-state post-eviction TTFT: the headline the tier buys
+        "post_evict_ttft_ms": [base_steady, host_steady],
+        "ttft_p50_improved": (
+            (host_steady.get("p50") or 0.0)
+            < (base_steady.get("p50") or 0.0)
+        ),
+        "ttft_p99_improved": (
+            (host_steady.get("p99") or 0.0)
+            < (base_steady.get("p99") or 0.0)
+        ),
+        "goodput_rps": [base["goodput_rps"], host["goodput_rps"]],
+        "goodput_ratio": round(
+            (host["goodput_rps"] or 0.0)
+            / (base["goodput_rps"] or 1e-9), 3,
+        ),
+        "baseline": base,
+        "host": host,
+    }
+
+
 def run_bench(args):
     if args.kv_cache_dtype and not args.compare_paged:
         # single-run mode: the whole run serves quantized arenas
@@ -537,6 +699,11 @@ def run_bench(args):
     # pins for --num_slots, expressed in blocks
     dense_blocks = args.num_slots * (seq_len // args.kv_block_size)
     num_blocks = args.kv_num_blocks or dense_blocks
+    host_bytes = (
+        args.kv_host_blocks * block_bytes_for(trainer,
+                                              args.kv_block_size)
+        if args.kv_host_blocks > 0 else 0
+    )
 
     record, _ = run_load(
         args, trainer, state, plan, args.num_slots,
@@ -546,6 +713,7 @@ def run_bench(args):
         kv_shared=bool(args.kv_paged and args.kv_shared),
         draft=draft if args.kv_paged else None,
         draft_k=args.draft_k,
+        kv_host_bytes=host_bytes if args.kv_paged else 0,
     )
     if not args.compare_paged:
         return record
@@ -649,6 +817,11 @@ def run_bench(args):
                 i8_trainer, i8_state, i8_results, args.temperature
             ),
         }
+    if args.kv_host_blocks > 0 and args.shared_prefix:
+        # the tiered-KV eviction-pressure A/B: its own long-prefix
+        # rig (int8 arenas when --kv_cache_dtype says so — the
+        # serve-smoke shape, where one host GB buys ~3x the chains)
+        record["host_vs_evict"] = run_host_evict_ab(args)
     base_good = record["goodput_rps"] or 1e-9
     base_tok = record["tokens_per_sec"] or 1e-9
     record["paged_vs_dense"] = {

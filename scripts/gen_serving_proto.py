@@ -134,6 +134,19 @@ SERVING_MESSAGES = {
         # dtype (int8 rows + f32 scale leaves), so equal-byte
         # comparisons across formats are honest.
         ("kv_cache_dtype", 39, T.TYPE_STRING, _OPT),
+        # tiered host spill (serving/kv_pool.py): evicted prefix
+        # chains demoted to bounded host-RAM buffers and revived by
+        # device upload instead of re-prefill. Occupancy gauges
+        # (blocks/bytes parked host-side right now) plus the monotone
+        # revival economy: batched upload scatters served, prompt
+        # tokens those uploads seated WITHOUT re-running prefill, and
+        # spilled entries the bounded host LRU (or a reload flush)
+        # dropped.
+        ("kv_host_blocks", 40, T.TYPE_INT32, _OPT),
+        ("kv_host_bytes", 41, T.TYPE_INT64, _OPT),
+        ("revive_uploads", 42, T.TYPE_INT64, _OPT),
+        ("prefill_tokens_revived", 43, T.TYPE_INT64, _OPT),
+        ("host_drops", 44, T.TYPE_INT64, _OPT),
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],
@@ -181,6 +194,15 @@ SERVING_MESSAGES = {
         # the replica's KV arena storage format ("" | "int8"),
         # passed through from its ServerStatus
         ("kv_cache_dtype", 13, T.TYPE_STRING, _OPT),
+        # tiered host spill, passed through from ServerStatus: warm
+        # prefix capacity that survived device eviction on this
+        # replica — the warm-vs-cold signal prefix-affinity routing
+        # and the autoscaler read
+        ("kv_host_blocks", 14, T.TYPE_INT32, _OPT),
+        ("kv_host_bytes", 15, T.TYPE_INT64, _OPT),
+        ("revive_uploads", 16, T.TYPE_INT64, _OPT),
+        ("prefill_tokens_revived", 17, T.TYPE_INT64, _OPT),
+        ("host_drops", 18, T.TYPE_INT64, _OPT),
     ],
     "RouterStatusResponse": [
         ("replicas", 1, T.TYPE_INT32, _OPT),
@@ -211,6 +233,13 @@ SERVING_MESSAGES = {
         # unset when the fleet is static
         ("autoscaler", 21, T.TYPE_MESSAGE, _OPT,
          ".elasticdl_tpu.AutoscalerStatus"),
+        # fleet-wide tiered-host-spill view: occupancy gauges and the
+        # monotone revival economy summed across the roster
+        ("kv_host_blocks", 22, T.TYPE_INT64, _OPT),
+        ("kv_host_bytes", 23, T.TYPE_INT64, _OPT),
+        ("revive_uploads", 24, T.TYPE_INT64, _OPT),
+        ("prefill_tokens_revived", 25, T.TYPE_INT64, _OPT),
+        ("host_drops", 26, T.TYPE_INT64, _OPT),
     ],
 }
 

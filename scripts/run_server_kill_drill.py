@@ -31,16 +31,31 @@ Phase 3 — shared-prefix ledger (paged mode: EDL_KV_SHARED=1): every
   again — a crash can never corrupt block accounting across restarts
   because the ledger is process-local and rebuilt from nothing.
 
+Phase 4 — tiered host spill (paged mode, --kv_host_bytes): three
+  distinct system prompts over a device pool too small for their
+  chains plus an active seat, so reclaimable chains are forced to
+  SPILL to the host tier and REVIVE by upload when their prefix comes
+  back around. A full wave completes with revivals demonstrably
+  served (`prefill_tokens_revived > 0`), the two-tier ledger drains
+  clean (every device block free | cached, host bytes inside the
+  budget — a spilled chain is either revived or budget-dropped,
+  never leaked), then the server is SIGKILLed mid-load with spilled
+  chains live and a FRESH server must come up with an EMPTY host
+  tier (the tier is process-local — a crash can never leak host
+  memory across restarts), serve the same load, revive again, and
+  drain to a clean two-tier ledger.
+
 All phases run TWICE: against the dense KV pool and against the
 block-paged pool (EDL_KV_PAGED=1, serving/kv_pool.py) — drain and
 SIGKILL semantics must hold regardless of where the cache rows live
 (phase 3's ledger assertions are paged-only; dense mode still proves
-the no-hang/clean-status contract under the shared-prefix load).
-A THIRD pass runs phases 1 + 3 with INT8 arenas
+the no-hang/clean-status contract under the shared-prefix load; the
+phase 4 host tier exists only over the paged pool).
+A THIRD pass runs phases 1 + 3 + 4 with INT8 arenas
 (kv_cache_dtype='int8'): graceful drain, the shared-chain ledger,
-SIGKILL mid-load and the fresh-restart rebuild must all hold with
-scale leaves in the arenas (the hard-kill transport semantics of
-phase 2 are dtype-blind and already covered).
+the spill/revive lifecycle, SIGKILL mid-load and the fresh-restart
+rebuild must all hold with scale leaves in the arenas (the hard-kill
+transport semantics of phase 2 are dtype-blind and already covered).
 
 Usage: python scripts/run_server_kill_drill.py
 Exit 0 = all phases hold in all modes."""
@@ -105,7 +120,8 @@ def launch_ready(cmd, extra_env=None, ready_marker="SERVING_READY",
 SHARED_PREFIX = [1, 2, 3, 4, 5, 6, 7, 2]
 
 
-def start_server(extra_env=None, num_slots=1, model_params=None):
+def start_server(extra_env=None, num_slots=1, model_params=None,
+                 extra_args=()):
     return launch_ready(
         [
             sys.executable, "-m", "elasticdl_tpu.serving.main",
@@ -114,17 +130,21 @@ def start_server(extra_env=None, num_slots=1, model_params=None):
             "--model_params", model_params or MODEL_PARAMS,
             "--port", "0", "--num_slots", str(num_slots),
             "--queue_capacity", "8", "--kv_block_size", "4",
+            *extra_args,
         ],
         extra_env=extra_env,
     )
 
 
-def fire_requests(port, n, max_new=24, shared_prefix=False):
+def fire_requests(port, n, max_new=24, shared_prefix=False,
+                  prompt_fn=None):
     """n concurrent unary requests; returns (outcomes, elapsed) where
     outcomes[i] is 'OK' or a gRPC status name. Joins with a hard bound:
     any thread still alive past the client timeout = a hang = failure.
     shared_prefix=True sends the common system prompt + a per-request
-    tail, so the paged+shared pool builds refcounted chains."""
+    tail, so the paged+shared pool builds refcounted chains;
+    prompt_fn(i) overrides the prompt outright (the host-tier phase
+    rotates several distinct system prompts)."""
     import grpc
 
     from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -135,10 +155,13 @@ def fire_requests(port, n, max_new=24, shared_prefix=False):
     lock = threading.Lock()
 
     def call(i):
-        prompt = (
-            SHARED_PREFIX + [1 + i % 5] if shared_prefix
-            else [1 + i % 5, 2]
-        )
+        if prompt_fn is not None:
+            prompt = prompt_fn(i)
+        else:
+            prompt = (
+                SHARED_PREFIX + [1 + i % 5] if shared_prefix
+                else [1 + i % 5, 2]
+            )
         try:
             stub.generate(
                 pb.GenerateRequest(
@@ -306,6 +329,109 @@ def phase_shared_ledger(mode_env=None, mode="dense",
     print("[drill] phase 3 (%s) OK" % mode)
 
 
+# three distinct 2-block system prompts (kv_block_size 4): working
+# set 6 blocks, deliberately more than the phase-4 device pool can
+# cache beside an active seat — chains must spill and revive
+HOST_PREFIXES = [
+    [1, 2, 3, 4, 5, 6, 7, 2],
+    [2, 3, 4, 5, 6, 7, 1, 3],
+    [3, 4, 5, 6, 7, 1, 2, 4],
+]
+HOST_BUDGET_BYTES = 1 << 20
+
+
+def _host_prompt(i):
+    return HOST_PREFIXES[i % len(HOST_PREFIXES)] + [1 + i % 5]
+
+
+def phase_host_tier(mode_env=None, mode="paged", model_params=None):
+    print("[drill] phase 4 (%s): host tier — spill under pressure, "
+          "revive through a wave, SIGKILL with spilled chains live, "
+          "fresh restart rebuilds an empty tier" % mode)
+    env = dict(mode_env or {})
+    env["EDL_KV_SHARED"] = "1"
+    # 8 device blocks: one active seat commits 6 (9 prompt rows + 15
+    # decode rows), so at most one 2-block chain survives beside it —
+    # the other two spill; the host budget holds them all. The wave
+    # fires 12 concurrent requests, so the queue must hold the tail
+    # that waits out the block backpressure (argparse keeps the last
+    # --queue_capacity, overriding start_server's default of 8).
+    extra = ("--kv_num_blocks", "8",
+             "--kv_host_bytes", str(HOST_BUDGET_BYTES),
+             "--queue_capacity", "16")
+    proc, port = start_server(extra_env=env, num_slots=2,
+                              model_params=model_params,
+                              extra_args=extra)
+    try:
+        # wave 1: 12 requests rotating 3 distinct prefixes — every
+        # return of a prefix finds its chain evicted (spilled) and
+        # revives it by upload instead of re-prefilling
+        threads, outcomes, t0 = fire_requests(
+            port, 12, max_new=16, prompt_fn=_host_prompt
+        )
+        join_all(threads, outcomes, t0, 12)
+        assert set(outcomes.values()) == {"OK"}, outcomes
+        st = _ledger(port)
+        assert st.kv_paged and st.kv_shared
+        assert st.prefix_hit_tokens > 0
+        # the spill machinery demonstrably engaged: chains were
+        # demoted AND came back by upload
+        assert st.revive_uploads > 0, "no revival upload served"
+        assert st.prefill_tokens_revived > 0
+        # two-tier ledger: device side fully free|cached, host side
+        # inside its byte budget — spilled chains are revived or
+        # budget-dropped, never leaked
+        _assert_clean_ledger(st, "post-wave-1 (host tier)")
+        assert st.kv_host_bytes <= HOST_BUDGET_BYTES, (
+            "host tier over budget: %d > %d"
+            % (st.kv_host_bytes, HOST_BUDGET_BYTES)
+        )
+        revived_before_kill = st.prefill_tokens_revived
+        # wave 2: SIGKILL mid-load with spilled chains LIVE
+        threads, outcomes, t0 = fire_requests(
+            port, 6, max_new=16, prompt_fn=_host_prompt
+        )
+        time.sleep(0.3)
+        proc.kill()
+        join_all(threads, outcomes, t0, 6)
+        allowed = {"OK", "UNAVAILABLE", "CANCELLED",
+                   "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+        assert set(outcomes.values()) <= allowed, outcomes
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # restart: the host tier is process-local — a fresh server must
+    # come up EMPTY (no leaked host memory, no phantom spilled
+    # chains), serve the same rotating load, revive again, and drain
+    # to a clean two-tier ledger
+    proc, port = start_server(extra_env=env, num_slots=2,
+                              model_params=model_params,
+                              extra_args=extra)
+    try:
+        st0 = _ledger(port)
+        assert st0.kv_host_blocks == 0 and st0.kv_host_bytes == 0, (
+            "fresh server has a non-empty host tier"
+        )
+        assert st0.prefill_tokens_revived == 0
+        threads, outcomes, t0 = fire_requests(
+            port, 12, max_new=16, prompt_fn=_host_prompt
+        )
+        join_all(threads, outcomes, t0, 12)
+        assert set(outcomes.values()) == {"OK"}, outcomes
+        st = _ledger(port)
+        assert st.revive_uploads > 0
+        assert st.prefill_tokens_revived > 0
+        _assert_clean_ledger(st, "post-restart (host tier)")
+        assert st.kv_host_bytes <= HOST_BUDGET_BYTES
+        print("[drill]   revived %d tokens pre-kill, %d post-restart"
+              % (revived_before_kill, st.prefill_tokens_revived))
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+    print("[drill] phase 4 (%s) OK" % mode)
+
+
 def main():
     # dense pool, then the block-paged pool (kv_block_size 4 divides
     # the drill model's seq_len=32; sharing needs full blocks)
@@ -316,17 +442,21 @@ def main():
         phase_graceful(mode_env=env, mode=mode)
         phase_hard_kill(mode_env=env, mode=mode)
         phase_shared_ledger(mode_env=env, mode=mode)
+    # the tiered host spill lifecycle exists only over the paged pool
+    phase_host_tier(mode_env={"EDL_KV_PAGED": "1"}, mode="paged")
     # int8 arenas: the same drain / SIGKILL-restart / shared-chain
-    # ledger invariants must hold with scale leaves in the arenas
-    # (kv_cache_dtype='int8'); the hard-kill transport semantics are
-    # dtype-blind and already covered above
+    # ledger / spill-revive invariants must hold with scale leaves in
+    # the arenas (kv_cache_dtype='int8'); the hard-kill transport
+    # semantics are dtype-blind and already covered above
     int8_params = MODEL_PARAMS + "; kv_cache_dtype='int8'"
     phase_graceful(mode_env={"EDL_KV_PAGED": "1"}, mode="paged_int8",
                    model_params=int8_params)
     phase_shared_ledger(mode_env={"EDL_KV_PAGED": "1"},
                         mode="paged_int8", model_params=int8_params)
+    phase_host_tier(mode_env={"EDL_KV_PAGED": "1"},
+                    mode="paged_int8", model_params=int8_params)
     print("[drill] serving kill drill PASSED (dense + paged + "
-          "paged-int8, shared-prefix ledger)")
+          "paged-int8, shared-prefix ledger, host-tier spill/revive)")
     return 0
 
 

@@ -1,0 +1,53 @@
+"""C13 negative fixture — the spill lifecycle settles on every path:
+finally-guarded drop, revive-or-drop on every branch, and ownership
+transfer (the spilled entry escapes to the host store the caller
+owns)."""
+
+
+class ChainSpiller(object):
+    def __init__(self, tier):
+        self._tier = tier
+        self._host = {}
+
+    def demote(self, tier, bid, vid):
+        tier.spill(bid, vid)
+        try:
+            if not self.indexable(vid):
+                return None
+            return vid
+        finally:
+            tier.drop(vid)
+
+    def demote_checked(self, tier, bid, vid):
+        tier.spill(bid, vid)
+        try:
+            rows = self.gather(bid)
+        except Exception:
+            tier.drop(vid)
+            raise
+        if rows is None:
+            tier.drop(vid)
+            return None
+        tier.revive(vid)
+        return rows
+
+    def demote_budgeted(self, tier, bid, vid, budget):
+        tier.spill(bid, vid)
+        if self.bytes_used() > budget:
+            tier.drop(vid)
+            return False
+        tier.revive(vid)
+        return True
+
+    def demote_deferred(self, tier, bid, vid):
+        tier.spill(bid, vid)
+        self._host[vid] = tier  # ownership transferred to the store
+
+    def indexable(self, vid):
+        return vid < -1
+
+    def gather(self, bid):
+        return [bid]
+
+    def bytes_used(self):
+        return 0

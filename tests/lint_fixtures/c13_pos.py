@@ -1,0 +1,42 @@
+"""C13 positive fixture — EDL501 leaks of the tiered KV cache's spill
+lifecycle (serving/kv_pool.py discipline: spill -> revive | drop):
+
+1. a block spilled to the host tier that an early-return path neither
+   revives nor drops — host bytes pinned forever;
+2. a spill whose exception path loses the entry;
+3. a spill abandoned when the budget check bails out of the demotion.
+"""
+
+
+class ChainSpiller(object):
+    def __init__(self, tier):
+        self._tier = tier
+
+    def demote(self, tier, bid, vid):
+        tier.spill(bid, vid)
+        if not self.indexable(vid):
+            return None  # leak: the spilled entry is never settled
+
+    def demote_checked(self, tier, bid, vid):
+        tier.spill(bid, vid)
+        rows = self.gather(bid)
+        if rows is None:
+            raise RuntimeError("gather failed")  # leak: no revive/drop
+        tier.drop(vid)
+        return rows
+
+    def demote_budgeted(self, tier, bid, vid, budget):
+        tier.spill(bid, vid)
+        if self.bytes_used() > budget:
+            return False  # leak: over budget, entry lost anyway
+        tier.revive(vid)
+        return True
+
+    def indexable(self, vid):
+        return vid < -1
+
+    def gather(self, bid):
+        return [bid]
+
+    def bytes_used(self):
+        return 0

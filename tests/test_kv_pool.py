@@ -662,6 +662,270 @@ def test_reclaimable_lru_eviction_is_leaf_first():
     assert a.match_prefix(prompt) == []
 
 
+# --------------------------------------------------- tiered host spill
+
+
+def _tiered(num_blocks=4, block_size=4, host_blocks=8):
+    return BlockAllocator(num_blocks=num_blocks, block_size=block_size,
+                          share_prefix=True, host_blocks=host_blocks)
+
+
+def test_eviction_spills_instead_of_forgetting():
+    """With a host tier, device eviction DEMOTES the chain: the trie
+    keeps resolving it (tail re-keyed onto a virtual id < -1), and the
+    admission planner charges the spilled entry like a fresh draw —
+    the chain saves its prefill, never its bytes."""
+    a = _tiered()
+    prompt = list(range(16))  # 4 full blocks
+    a.alloc("r0", tokens=16, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    a.free("r0")
+    assert a.num_cached() == 4 and a.num_free() == 0
+    a.alloc("r1", tokens=4)  # pressure: evicts ONE block — the leaf
+    assert a.num_cached() == 3 and a.num_spilled() == 1
+    assert a.spills == 1
+    chain = a.match_prefix(prompt)
+    assert len(chain) == 4 and chain[-1] < -1  # still fully matchable
+    assert all(b >= 0 for b in chain[:3])  # resident prefix intact
+    # plan: 3 reclaimable revivals + 1 spilled upload = 4 fresh-like
+    # charges (no CoW: the tail is spilled, revival owns it solely)
+    _chain, needed = a.plan(prompt, 16, 16)
+    assert needed == 4
+    a.free("r1")
+    shared = a.alloc("r2", tokens=16, prompt=prompt)
+    assert shared == 16  # the WHOLE prompt seated without prefill
+    assert a.blocks_revived == 1
+    assert a.num_spilled() == 0  # revival is a move, not a copy
+    moves = a.take_revived()
+    assert len(moves) == 1 and moves[0][0] < -1 and moves[0][1] >= 0
+    a.free("r2")
+    assert a.num_free() + a.num_cached() == 4
+
+
+def test_spill_is_leaf_first_and_chain_stays_complete():
+    """Deeper blocks spill before their parents, so every surviving
+    trie path is a resident prefix + a spilled suffix — never a hole
+    a revival could not reconstruct through."""
+    a = _tiered()
+    prompt = list(range(16))
+    a.alloc("r0", tokens=16, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    a.free("r0")
+    for k in range(1, 5):
+        a.alloc("p%d" % k, tokens=4)  # one eviction each
+        chain = a.match_prefix(prompt)
+        assert len(chain) == 4  # the full chain always resolves
+        spilled = [b < 0 for b in chain]
+        assert spilled == [False] * (4 - k) + [True] * k
+    assert a.num_spilled() == 4 and a.num_cached() == 0
+
+
+def test_host_budget_drops_leaf_first_and_is_bounded():
+    """The host tier never exceeds its block budget: the oldest
+    CHILDLESS spilled entry drops to make room (dropping an interior
+    entry would orphan its children's keys)."""
+    a = _tiered(num_blocks=2, block_size=4, host_blocks=1)
+    prompt = list(range(8))  # 2 full blocks
+    a.alloc("r0", tokens=8, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    a.free("r0")
+    # both cached blocks evict for a private 8-token alloc: the leaf
+    # spills first, then the parent spills and the leaf (now the
+    # oldest spilled entry, childless) drops for room
+    a.alloc("r1", tokens=8)
+    assert a.spills == 2 and a.host_drops == 1
+    assert a.num_spilled() == 1  # never above the budget
+    chain = a.match_prefix(prompt)
+    assert len(chain) == 1 and chain[0] < -1  # root survived
+    a.free("r1")
+    # the surviving root still revives; the dropped tail re-prefills
+    shared = a.alloc("r2", tokens=8, prompt=prompt)
+    assert shared == 4 and a.blocks_revived == 1
+    a.take_revived()
+    a.free("r2")
+
+
+def test_flush_index_clears_both_tiers():
+    """Hot reload: stale-params rows must never seat a new request
+    from either tier — the flush drops every spilled entry (counted
+    as host drops) and empties the index."""
+    drops = []
+    a = _tiered()
+    a._drop_sink = drops.append
+    prompt = list(range(16))
+    a.alloc("r0", tokens=16, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    a.free("r0")
+    a.alloc("r1", tokens=8)  # spill two blocks
+    assert a.num_spilled() == 2
+    a.flush_index()
+    assert a.num_spilled() == 0 and a.num_cached() == 0
+    assert len(drops) == 2 and a.host_drops == 2
+    assert a.match_prefix(prompt) == []
+    a.free("r1")
+    assert a.num_free() == 4
+
+
+def test_sinks_fire_in_order_spill_before_bid_reuse():
+    """The spill sink must see the dying block id BEFORE it is
+    recycled (the pool copies rows out through it), and the revival
+    log pairs every vid with its fresh device block."""
+    events = []
+    a = _tiered(num_blocks=2, block_size=4, host_blocks=4)
+    a._spill_sink = lambda bid, vid: events.append(("spill", bid, vid))
+    a._drop_sink = lambda vid: events.append(("drop", vid))
+    prompt = list(range(8))
+    a.alloc("r0", tokens=8, prompt=prompt)
+    a.register_prefix("r0", prompt)
+    chain_bids = a.table("r0")
+    a.free("r0")
+    a.alloc("r1", tokens=8)  # both blocks spill, leaf first
+    assert events == [("spill", chain_bids[1], -2),
+                      ("spill", chain_bids[0], -3)]
+    a.free("r1")
+    shared = a.alloc("r2", tokens=8, prompt=prompt)
+    assert shared == 8
+    moves = a.take_revived()
+    assert [vid for vid, _bid in moves] == [-3, -2]  # root-first
+    assert sorted(bid for _vid, bid in moves) == sorted(a.table("r2"))
+    a.free("r2")
+
+
+def test_evictable_frontier_matches_brute_force_under_churn():
+    """The O(1) eviction frontier must equal the brute-force
+    definition — cached AND no resident indexed children — after every
+    operation, and host accounting must conserve across spills, drops,
+    revivals and flushes."""
+    rs = np.random.RandomState(23)
+    a = _tiered(num_blocks=16, block_size=4, host_blocks=6)
+    prompts = [list(range(100 + 10 * i, 100 + 10 * i + 8))
+               for i in range(4)]
+    live = {}
+    for i in range(500):
+        roll = rs.rand()
+        if live and (roll < 0.45 or not a.can_fit(16)):
+            slot = rs.choice(sorted(live))
+            a.free(slot)
+            del live[slot]
+        elif roll < 0.9:
+            prompt = (prompts[rs.randint(len(prompts))]
+                      if rs.rand() < 0.7 else
+                      [int(x) for x in rs.randint(0, 50, size=6)])
+            total = len(prompt) + int(rs.randint(1, 13))
+            slot = "r%d" % i
+            if a.can_seat(prompt, len(prompt), total):
+                a.alloc(slot, len(prompt), commit_tokens=total,
+                        prompt=prompt)
+                a.take_revived()
+                a.register_prefix(slot, prompt)
+                live[slot] = prompt
+        else:
+            a.flush_index()
+        # ---- invariants, after every op
+        assert a.blocks_in_use() + a.num_free() + a.num_cached() == 16
+        assert a.num_spilled() <= 6  # the budget holds at all times
+        # brute-force evictability: cached, no resident indexed child
+        brute = {
+            bid for bid in a._cached
+            if not any(c >= 0 for c in a._children.get(bid, ()))
+        }
+        assert set(a._evictable) == brute, (i, a._evictable, brute)
+        # droppable spilled entries: childless, and every spilled
+        # node's children are spilled (leaf-first both tiers)
+        for vid in a._spilled:
+            kids = a._children.get(vid, set())
+            assert all(c < 0 for c in kids), (i, vid, kids)
+        brute_leaves = {
+            vid for vid in a._spilled if not a._children.get(vid)
+        }
+        assert set(a._spill_leaves) == brute_leaves
+        # every index path is complete: a child's key parent resolves
+        for node, key in a._index_key.items():
+            parent = key[0]
+            assert parent == -1 or parent in a._index_key, (i, node)
+    for slot in list(live):
+        a.free(slot)
+    a.flush_index()
+    assert a.num_free() == 16 and a.available() == 16
+
+
+def test_pool_spill_revive_round_trips_rows_and_scales():
+    """PagedKVPool-level: a spilled block's rows — int8 rows AND f32
+    scale leaves — must round-trip the host tier bit-exactly through
+    revival, and the host byte gauge must track block_bytes."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.serving.kv_pool import PagedKVPool
+
+    rs = np.random.RandomState(31)
+    hkv, d, cache_len, bs, nb = 2, 8, 16, 4, 4
+    kv_shapes = {
+        "k": jnp.zeros((1, hkv, cache_len, d), jnp.int8),
+        "k_scale": jnp.zeros((1, hkv, cache_len, 1), jnp.float32),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+    pool = PagedKVPool(kv_shapes, cache_len, num_slots=2,
+                       num_blocks=nb, block_size=bs,
+                       share_prefix=True, host_bytes=10 ** 6)
+    prompt = list(range(100, 116))
+    pool.seat(0, prompt, 16)
+    table0 = pool.allocator.table(0)
+    pat = rs.randint(-127, 128, size=(nb, bs, hkv, d)).astype(np.int8)
+    sca = rs.rand(nb, bs, hkv, 1).astype(np.float32)
+    pool.pools = dict(pool.pools, k=jnp.asarray(pat),
+                      k_scale=jnp.asarray(sca))
+    pool.register_prefix(0, prompt)
+    pool.release(0)
+    # a colliding-size seat evicts all four blocks -> all spill
+    pool.seat(1, list(range(16)), 16)
+    assert pool.allocator.num_spilled() == 4
+    assert pool.host_bytes_in_use() == 4 * pool.block_bytes
+    assert pool.stats()["kv_host_blocks"] == 4
+    pool.release(1)
+    shared = pool.seat(0, prompt, 16)
+    assert shared == 16 and pool.revive_uploads == 1
+    assert pool.host_bytes_in_use() == 0  # moved, not copied
+    k = np.asarray(pool.pools["k"])
+    ks = np.asarray(pool.pools["k_scale"])
+    for old, new in zip(table0, pool.allocator.table(0)):
+        np.testing.assert_array_equal(k[new], pat[old])
+        np.testing.assert_array_equal(ks[new], sca[old])
+    assert pool.stats()["prefill_tokens_revived"] == 16
+    pool.release(0)
+
+
+def test_pool_host_budget_never_exceeded():
+    """The budget pin: under sustained eviction pressure the host
+    tier's bytes stay at or under kv_host_bytes at every step."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.serving.kv_pool import PagedKVPool
+
+    hkv, d, cache_len, bs, nb = 1, 4, 16, 4, 4
+    kv_shapes = {
+        "k": jnp.zeros((1, hkv, cache_len, d), jnp.float32),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+    probe = PagedKVPool(kv_shapes, cache_len, num_slots=2,
+                        num_blocks=nb, block_size=bs,
+                        share_prefix=True, host_bytes=0)
+    budget = 2 * probe.block_bytes  # room for exactly two blocks
+    pool = PagedKVPool(kv_shapes, cache_len, num_slots=2,
+                       num_blocks=nb, block_size=bs,
+                       share_prefix=True, host_bytes=budget)
+    assert pool.allocator.host_blocks == 2
+    rs = np.random.RandomState(7)
+    for i in range(40):
+        prompt = [int(x) for x in rs.randint(0, 9, size=12)]
+        if pool.can_seat(prompt, len(prompt), 16):
+            pool.seat(0, prompt, 16)
+            pool.register_prefix(0, prompt)
+            pool.release(0)
+        assert pool.host_bytes_in_use() <= budget, i
+        assert pool.stats()["kv_host_bytes"] <= budget, i
+    assert pool.allocator.spills > 2  # pressure actually engaged
+
+
 def test_fragmentation_under_mixed_shared_private_churn():
     """Random admit/complete churn with a pool of recurring system
     prompts: conservation (live + free + cached == total), disjoint

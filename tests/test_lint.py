@@ -221,6 +221,26 @@ def test_c12_negative_settled_lifecycles_are_clean():
     assert lint_file("c12_neg.py") == []
 
 
+def test_c13_positive_flags_spill_lifecycle_leaks():
+    """The tiered KV cache's spill pair (serving/kv_pool.py): a block
+    spilled to the host tier must REVIVE or DROP on every path — an
+    early return, an exception path, and a budget bail-out that each
+    lose the spilled entry are convicted leaks."""
+    findings = lint_file("c13_pos.py")
+    assert rule_ids(findings) == ["EDL501"] * 3, findings
+    assert {f.detail for f in findings} == {"tier.spill"}
+    scopes = {f.scope for f in findings}
+    assert scopes == {"ChainSpiller.demote",
+                      "ChainSpiller.demote_checked",
+                      "ChainSpiller.demote_budgeted"}
+
+
+def test_c13_negative_settled_spills_are_clean():
+    """finally-guarded drop, revive-or-drop on every branch, and the
+    host-store ownership-transfer escape."""
+    assert lint_file("c13_neg.py") == []
+
+
 # ------------------------------ C9: EDL202/EDL203 deadline propagation
 
 
@@ -285,7 +305,8 @@ def test_every_rule_has_fixture_coverage():
     emitted = set()
     for name in ("c1_pos.py", "c2_pos.py", "c3_pos.py", "c5_pos.py",
                  "c6_pos.py", "c7_pos.py", "c8_pos.py", "c9_pos.py",
-                 "c10_pos.py", "c11_pos.py", "c12_pos.py"):
+                 "c10_pos.py", "c11_pos.py", "c12_pos.py",
+                 "c13_pos.py"):
         emitted.update(f.rule for f in lint_file(name))
     ast_rule_ids = set()
     for rule in all_rules():

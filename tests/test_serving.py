@@ -226,7 +226,10 @@ class FakeEngine(object):
                 "kv_blocks_total": 0, "kv_blocks_free": 0,
                 "kv_blocks_cached": 0, "kv_blocks_shared": 0,
                 "kv_bytes_total": 0, "kv_bytes_in_use": 0,
-                "prefix_hit_tokens": 0, "cow_copies": 0}
+                "prefix_hit_tokens": 0, "cow_copies": 0,
+                "kv_host_blocks": 0, "kv_host_bytes": 0,
+                "revive_uploads": 0, "prefill_tokens_revived": 0,
+                "host_drops": 0}
 
 
 def _rig(clock):
@@ -413,6 +416,8 @@ def test_serving_proto_round_trip():
         kv_blocks_free=7, kv_bytes_total=1 << 20,
         kv_bytes_in_use=4096, kv_bytes_in_use_peak=8192,
         kv_bytes_per_token=96.5,
+        kv_host_blocks=5, kv_host_bytes=5 << 10,
+        revive_uploads=3, prefill_tokens_revived=80, host_drops=2,
     )
     st2 = pb.ServerStatusResponse.FromString(st.SerializeToString())
     assert st2.num_slots == 4 and st2.tokens_generated == 123
@@ -421,6 +426,10 @@ def test_serving_proto_round_trip():
     assert st2.kv_bytes_total == 1 << 20
     assert st2.kv_bytes_in_use_peak == 8192
     assert abs(st2.kv_bytes_per_token - 96.5) < 1e-9
+    # the tiered-host-spill fields survive the wire
+    assert st2.kv_host_blocks == 5 and st2.kv_host_bytes == 5 << 10
+    assert st2.revive_uploads == 3
+    assert st2.prefill_tokens_revived == 80 and st2.host_drops == 2
 
 
 def test_serving_service_descriptor():

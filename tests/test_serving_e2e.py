@@ -660,6 +660,144 @@ def test_paged_int8_shared_spec_matches_offline_int8_32way():
         assert list(off) == results[i], (i, s)
 
 
+def test_host_tier_spill_revive_matches_offline_int8_32way():
+    """The tiered-KV acceptance pin: 32 concurrent GREEDY requests
+    over a small system-prompt pool against a paged + shared +
+    speculative + INT8 server whose device pool is deliberately too
+    small for the prefix working set plus the active seats — chains
+    are forced to EVICT mid-run, spill to the host tier, and revive by
+    upload — and every token stream must still equal offline
+    `autoregressive_generate(use_cache=True)` on the same int8 model.
+    The drill-grade ledger must drain clean in BOTH tiers, the host
+    tier must never exceed its byte budget, and ServerStatus must show
+    the spill machinery actually engaged (revive_uploads > 0)."""
+    int8_params = PARAMS + "; kv_cache_dtype='int8'"
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(
+        load_model_spec_from_module(zoo), mesh=mesh,
+        model_params=int8_params,
+    )
+    state = _state(trainer)
+    draft_trainer = _trainer(seed=321)  # float draft, mismatched
+    draft_state = _state(draft_trainer)
+
+    systems = [[1, 2, 3, 4], [5, 6, 7, 1, 2, 3, 4, 5]]
+    specs = []
+    for i in range(32):
+        prompt = list(systems[i % 2]) + ([1 + i % 3] if i % 4 else [])
+        specs.append({"prompt": prompt, "new": 3 + i % 5})
+
+    # 8 blocks x 4 tokens: two concurrent seats of the long-prompt
+    # shape (4 blocks committed each) consume the WHOLE pool, so the
+    # reclaimable prefix chains (3 blocks) are forced to evict — and
+    # spill — mid-run, then revive when the next wave re-matches them;
+    # the host budget holds the whole working set
+    host_budget = 1 << 20
+    cfg = ServingConfig(
+        num_slots=4, queue_capacity=64, kv_paged=True,
+        kv_block_size=4, kv_num_blocks=8, kv_shared=True, draft_k=2,
+        kv_host_bytes=host_budget,
+    )
+    server = GenerationServer(
+        trainer, state, cfg, draft=(draft_trainer, draft_state)
+    ).start()
+    try:
+        stub = ServingStub(build_channel("localhost:%d" % server.port))
+        results, errors = {}, {}
+
+        def call(i, s):
+            try:
+                r = stub.generate(
+                    pb.GenerateRequest(
+                        prompt=s["prompt"], max_new_tokens=s["new"],
+                    ),
+                    timeout=120,
+                )
+                results[i] = list(r.tokens)
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=call, args=(i, s))
+            for i, s in enumerate(specs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == 32
+        st = stub.server_status(pb.ServerStatusRequest(), timeout=10)
+        assert st.kv_paged and st.kv_shared
+        assert st.kv_cache_dtype == "int8"
+        assert st.completed == 32
+        # the spill machinery demonstrably engaged mid-run: chains
+        # were demoted under pressure AND came back by upload
+        assert st.revive_uploads > 0
+        assert st.prefill_tokens_revived > 0
+        assert st.prefix_hit_tokens >= st.prefill_tokens_revived
+        # the host tier never exceeded its budget (engine-side pin —
+        # the peak tracks every spill, not just the final state)
+        eng = server.engine
+        assert eng.kv.host_blocks_peak <= eng.kv.allocator.host_blocks
+        assert (eng.kv.host_blocks_peak * eng.kv.block_bytes
+                <= host_budget)
+        assert eng.kv.allocator.spills > 0
+        # clean two-tier post-drain ledger: every device block free or
+        # cached, no leaked refcount; spilled entries all accounted
+        assert st.kv_blocks_free == st.kv_blocks_total == 8
+        assert (eng.kv.allocator.num_spilled()
+                == len(eng.kv._host_rows))
+        assert st.kv_host_blocks == eng.kv.allocator.num_spilled()
+    finally:
+        server.stop()
+
+    for i, s in enumerate(specs):
+        off = np.asarray(autoregressive_generate(
+            trainer, state, np.asarray([s["prompt"]], np.int32),
+            s["new"], use_cache=True,
+        ))[0]
+        assert list(off) == results[i], (i, s)
+
+
+def test_host_tier_reload_flushes_both_tiers(rig, tmp_path):
+    """A hot reload must flush the host tier too: spilled chains were
+    computed under superseded params and can never seat (or revive
+    for) a new request."""
+    from elasticdl_tpu.serving.admission import ServingRequest
+    from elasticdl_tpu.serving.engine import (
+        PagedContinuousBatchingEngine,
+    )
+
+    trainer, state = rig
+    eng = PagedContinuousBatchingEngine(
+        trainer, state, num_slots=2, block_size=4, num_blocks=4,
+        host_bytes=1 << 20,
+    )
+    # seat + index a 2-block prompt chain, then evict it under
+    # pressure so it spills
+    prompt = [1, 2, 3, 4, 5, 6, 7, 1]
+    r0 = ServingRequest(prompt, 2)
+    eng.insert(r0)
+    while eng.active_count():
+        eng.step()
+    assert eng.kv.allocator.num_cached() == 2
+    r1 = ServingRequest([2, 3], 14)  # commits all 4 blocks
+    eng.insert(r1)
+    while eng.active_count():
+        eng.step()
+    # decode growth drew the cached chain out of the device tier:
+    # both indexed blocks spilled instead of being forgotten
+    assert eng.kv.allocator.num_spilled() == 2
+    # reload: both tiers flush
+    eng.set_params(state, version=1)
+    assert eng.kv.allocator.num_spilled() == 0
+    assert eng.kv.host_bytes_in_use() == 0
+    assert eng.kv.allocator.match_prefix(prompt) == []
+    # and the device ledger is whole again
+    assert eng.kv.allocator.num_free() == 4
+
+
 def test_shared_prefix_speculative_matches_dense_greedy_32way(rig):
     """The acceptance pin for prefix sharing + speculative decode:
     32 concurrent GREEDY requests drawn from a small system-prompt
