@@ -103,13 +103,19 @@ drill:
 # below the prefix working set, host tier off vs on at equal DEVICE
 # KV bytes) and records the "host_vs_evict" ratio block: what share
 # of the baseline's re-paid prefill tokens the host tier recovers by
-# revival upload, with steady-state post-eviction TTFT.
+# revival upload, with steady-state post-eviction TTFT. --profile
+# records the per-step decode profiler breakdown (p50/p99 per phase:
+# prefill/suffix_tile/decode/draft/verify_commit/scatter/
+# revive_upload) under "profile" plus a validated /metrics scrape,
+# and --overhead_ab runs the metrics+profiler plane OFF-vs-ON A/B on
+# the paged+shared leg — the bench FAILS if the enabled plane costs
+# more than 5% tokens/sec ("profiler_overhead" block).
 serve-smoke:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py \
 		--ramp "8:0.8,32:0.5,8:0.5" --compare_paged --kv_block_size 4 \
 		--shared_prefix --prefix_len 16 --suffix_len 1:4 \
 		--out_len 4:12 --draft_k 2 --kv_cache_dtype int8 \
-		--kv_host_blocks 84 \
+		--kv_host_blocks 84 --profile --overhead_ab \
 		--out BENCH_SERVING.json
 
 ci-fast: lint test-fast

@@ -1,29 +1,35 @@
-"""C5 — telemetry counter-name checker (EDL401).
+"""C5 — telemetry counter/gauge-name checker (EDL401).
 
-The telemetry counter sets are CLOSED (ServingTelemetry.COUNTERS /
-RouterTelemetry.COUNTERS in serving/telemetry.py): `count()` raises at
-runtime on an undeclared name, because a typo like ``count("admittd")``
-used to silently fork a brand-new counter and under-report the real
-one forever — a observability bug that corrupts dashboards without
-ever failing a test that doesn't read the exact counter back.
+The telemetry counter AND gauge sets are CLOSED
+(ServingTelemetry.COUNTERS/GAUGES and RouterTelemetry.COUNTERS/GAUGES
+in serving/telemetry.py): `count()`/`gauge()` raise at runtime on an
+undeclared name, because a typo like ``count("admittd")`` used to
+silently fork a brand-new counter and under-report the real one
+forever — an observability bug that corrupts dashboards without ever
+failing a test that doesn't read the exact counter back. A typo'd
+gauge is the same bug on the scrape plane: a dead TensorBoard tag and
+a dead Prometheus series, silently.
 
-This rule is the STATIC twin of that runtime raise: it flags every
+This rule is the STATIC twin of those runtime raises: it flags every
 ``<telemetry-ish receiver>.count("<literal>")`` call site whose string
-literal is not in the declared union of both counter sets, so the typo
-fails `make lint` before any drill has to hit the code path.
+literal is not in the declared counter union, and every
+``<telemetry-ish receiver>.gauge("<literal>")`` not in the declared
+gauge union, so the typo fails `make lint` before any drill has to hit
+the code path.
 
-FLAGGED: attribute calls ``X.count("name")`` where the receiver's
-dotted spelling mentions ``telemetry`` (``self.telemetry.count``,
-``self._telemetry.count``, ``router.telemetry.count`` ...) and the
-first argument is a string literal not in the declared set.
+FLAGGED: attribute calls ``X.count("name")`` / ``X.gauge("name")``
+where the receiver's dotted spelling mentions ``telemetry``
+(``self.telemetry.count``, ``self._telemetry.gauge``,
+``router.telemetry.count`` ...) and the first argument is a string
+literal not in the matching declared set.
 
 NOT flagged: non-literal names (the runtime raise owns those),
 receivers that don't spell ``telemetry`` (list.count etc.), and call
 sites with no arguments.
 
-The declared set is read from elasticdl_tpu.serving.telemetry at rule
-run time (stdlib-only import), so declaring a new counter there is
-the single source of truth — no second list to update here.
+The declared sets are read from elasticdl_tpu.serving.telemetry at
+rule run time (stdlib-only import), so declaring a new counter/gauge
+there is the single source of truth — no second list to update here.
 """
 
 import ast
@@ -55,10 +61,25 @@ def declared_counters():
     )
 
 
+def declared_gauges():
+    """The closed gauge-name union — same import, same contract."""
+    from elasticdl_tpu.serving.telemetry import (
+        RouterTelemetry,
+        ServingTelemetry,
+    )
+
+    return frozenset(ServingTelemetry.GAUGES) | frozenset(
+        RouterTelemetry.GAUGES
+    )
+
+
 class _CounterVisitor(ast.NodeVisitor):
+    #: method name -> (allowed-set key, series noun in the message)
+    _CHECKED = {"count": "counter", "gauge": "gauge"}
+
     def __init__(self, path, allowed):
         self.path = path
-        self.allowed = allowed
+        self.allowed = allowed  # {"counter": frozenset, "gauge": ...}
         self.scope_stack = []
         self.findings = []
 
@@ -80,20 +101,23 @@ class _CounterVisitor(ast.NodeVisitor):
 
     def visit_Call(self, node):
         fn = node.func
-        if (isinstance(fn, ast.Attribute) and fn.attr == "count"
+        if (isinstance(fn, ast.Attribute)
+                and fn.attr in self._CHECKED
                 and node.args
                 and isinstance(node.args[0], ast.Constant)
                 and isinstance(node.args[0].value, str)
                 and "telemetry" in _receiver_text(fn.value)):
+            kind = self._CHECKED[fn.attr]
             name = node.args[0].value
-            if name not in self.allowed:
+            if name not in self.allowed[kind]:
                 self.findings.append(Finding(
                     "EDL401", self.path, node.lineno, self.scope,
                     name,
-                    "unknown telemetry counter %r — not in the "
-                    "declared ServingTelemetry/RouterTelemetry "
-                    "COUNTERS (a typo here silently forks a new "
-                    "counter; fix the name or declare it)" % name,
+                    "unknown telemetry %s %r — not in the declared "
+                    "ServingTelemetry/RouterTelemetry %sS (a typo "
+                    "here silently forks a new series; fix the name "
+                    "or declare it)"
+                    % (kind, name, kind.upper()),
                 ))
         self.generic_visit(node)
 
@@ -106,6 +130,9 @@ class TelemetryCounterRule(Rule):
     name = "telemetry-counter-name"
 
     def check_module(self, tree, lines, path):
-        visitor = _CounterVisitor(path, declared_counters())
+        visitor = _CounterVisitor(path, {
+            "counter": declared_counters(),
+            "gauge": declared_gauges(),
+        })
         visitor.visit(tree)
         return visitor.findings

@@ -195,6 +195,13 @@ def add_master_params(parser):
              "todo/doing/retry/epoch state exactly and resumes the job; "
              "empty disables journaling (the reference behavior).",
     )
+    parser.add_argument(
+        "--metrics_port", type=int, default=-1,
+        help="Prometheus-text /metrics exposition for the master "
+             "process (observability/metrics.py): task-queue depths, "
+             "model version, restart/recovery counters. -1 resolves "
+             "from EDL_METRICS_PORT (unset = off), 0 = ephemeral.",
+    )
 
 
 def add_worker_params(parser):

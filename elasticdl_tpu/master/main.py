@@ -233,8 +233,60 @@ def _run_master(args, status_file=""):
     from elasticdl_tpu.observability.tracing import configure
 
     configure(service="master:%d" % master.port)
+    metrics = _start_metrics(args, master)
     job_status.write_job_status(status_file, job_status.RUNNING)
-    return master.run()
+    try:
+        return master.run()
+    finally:
+        if metrics is not None:
+            metrics.close()
+
+
+def _start_metrics(args, master):
+    """The master's /metrics exposition (--metrics_port /
+    EDL_METRICS_PORT, off by default): task-queue pressure, model
+    version and the crash-recovery counters — the training-plane
+    corner of the same scrape surface the serving fleet exposes."""
+    from elasticdl_tpu.observability.metrics import (
+        MetricsServer,
+        counter_family,
+        gauge_family,
+        metrics_port_default,
+    )
+
+    port = (metrics_port_default() if args.metrics_port < 0
+            else args.metrics_port)
+    if port is None:
+        return None
+
+    def collect():
+        todo, doing, eval_todo = master.task_d.queue_depths()
+        restarts = (master.state_store.restart_count
+                    if master.state_store else 0)
+        return [
+            gauge_family("edl_master_tasks_todo",
+                         "training tasks queued", [({}, todo)]),
+            gauge_family("edl_master_tasks_doing",
+                         "training tasks dispatched and in flight",
+                         [({}, doing)]),
+            gauge_family("edl_master_eval_tasks_todo",
+                         "evaluation tasks queued", [({}, eval_todo)]),
+            gauge_family("edl_master_model_version",
+                         "dispatcher model version",
+                         [({}, master.task_d.model_version)]),
+            counter_family("edl_master_restarts_total",
+                           "master crash recoveries", restarts),
+            counter_family(
+                "edl_master_recovery_requeued_tasks_total",
+                "doing-tasks requeued by journal recovery",
+                master.task_d.requeued_on_recovery,
+            ),
+        ]
+
+    server = MetricsServer(collect, port=port)
+    logger.info("Master /metrics exposition on port %d", server.port)
+    print("METRICS_READY port=%d" % server.port, flush=True)
+    return server
 
 
 if __name__ == "__main__":

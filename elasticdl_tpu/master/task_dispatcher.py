@@ -163,6 +163,14 @@ class TaskDispatcher(object):
     def reset_job_counters(self, task_type):
         self._job_counters[task_type] = JobCounter()
 
+    def queue_depths(self):
+        """(todo, doing, eval_todo) under the lock — the master's
+        /metrics exposition reads queue pressure through this instead
+        of racing the raw lists."""
+        with self._lock:
+            return (len(self._todo), len(self._doing),
+                    len(self._eval_todo))
+
     def create_tasks(self, task_type, model_version=-1):
         """Public entry: callers outside the dispatcher (the evaluation
         service's trigger threads) do NOT hold the lock, but they race

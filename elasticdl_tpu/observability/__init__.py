@@ -13,6 +13,14 @@ Modules:
 * tracing    — trace/span ids, `Span`, the ring-buffer `SpanRecorder`,
                the process-global recorder, Chrome-trace conversion
 * histogram  — `LogLinearHistogram` + the shared `percentiles()` entry
+* metrics    — the LIVE metrics plane: `TimeSeriesRing` (windowed
+               counter/gauge/bucket deltas, mergeable by addition) +
+               Prometheus text exposition (`render_prometheus`,
+               `MetricsServer` behind --metrics_port/EDL_METRICS_PORT)
+* slo        — declared objectives evaluated as multi-window burn
+               rates over the ring (`SloSpec`, `BurnRateEngine`)
+* promparse  — INDEPENDENT text-format parser (shares nothing with the
+               renderer) for drills/tests to round-trip expositions
 * dump       — CLI merging per-process span exports into one trace
                (``python -m elasticdl_tpu.observability.dump``)
 
@@ -22,6 +30,18 @@ Design doc: docs/designs/observability.md.
 from elasticdl_tpu.observability.histogram import (  # noqa: F401
     LogLinearHistogram,
     percentiles,
+)
+from elasticdl_tpu.observability.metrics import (  # noqa: F401
+    MetricsServer,
+    TimeSeriesRing,
+    merge_window_deltas,
+    metrics_port_default,
+    render_prometheus,
+)
+from elasticdl_tpu.observability.slo import (  # noqa: F401
+    BurnRateEngine,
+    SloSpec,
+    default_router_slos,
 )
 from elasticdl_tpu.observability.tracing import (  # noqa: F401
     Span,

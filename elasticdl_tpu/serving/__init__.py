@@ -22,7 +22,11 @@ the inference half — it turns the offline decode library
                  (entry: python -m elasticdl_tpu.serving.router_main)
 * hot_reload.py  checkpoint-dir watcher that swaps params between
                  decode steps without dropping in-flight requests
-* telemetry.py   serving gauges on the common/tb_events.py path
+* telemetry.py   serving gauges/counters (closed name sets) on the
+                 common/tb_events.py path, each backed by a windowed
+                 time-series ring feeding the Prometheus /metrics
+                 exposition and the router's SLO burn-rate engine
+                 (observability/metrics.py, observability/slo.py)
 
 See docs/designs/serving.md for the slot lifecycle and failure modes.
 """

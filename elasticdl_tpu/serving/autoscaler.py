@@ -338,6 +338,11 @@ class ReplicaSupervisor(object):
         self.last_reason = "supervisor created"
         self.last_decision_at = self._clock()
         self.supervisor_restarts = 0
+        # last-logged SLO burn advisory (read-only consumption of the
+        # router's burn-rate engine: logged next to the queue-wait
+        # policy, never acted on — the signal earns trust in drills
+        # before it steers the target)
+        self._slo_alerting = ()
         # hysteresis state
         self._above_since = None
         self._idle_since = None
@@ -731,7 +736,42 @@ class ReplicaSupervisor(object):
     def _router_view(self):
         return {r.address: r for r in self._router.replicas()}
 
+    def _slo_advisory(self):
+        """Log the router's SLO burn-rate signal READ-ONLY, on every
+        change of the alerting set: the operator sees 'the error
+        budget is burning' in the same log as the scaling decisions,
+        while the decisions themselves stay on the PR 9 queue-wait
+        policy. Routers without the engine (old tests' fakes) are
+        silently fine."""
+        reports = getattr(self._router, "slo_reports", None)
+        if reports is None:
+            return
+        reports = reports()
+        alerting = tuple(sorted(
+            r["name"] for r in reports if r["alerting"]
+        ))
+        if alerting == self._slo_alerting:
+            return
+        if alerting:
+            detail = "; ".join(
+                "%s fast=%.1fx slow=%.1fx" % (
+                    r["name"], r["fast_burn"], r["slow_burn"]
+                )
+                for r in reports if r["alerting"]
+            )
+            logger.warning(
+                "autoscaler: SLO burn advisory — %s (advisory only; "
+                "scaling stays on the queue-wait policy)", detail,
+            )
+        else:
+            logger.info(
+                "autoscaler: SLO burn advisory cleared (%s back "
+                "under budget)", ", ".join(self._slo_alerting),
+            )
+        self._slo_alerting = alerting
+
     def _policy(self, now):
+        self._slo_advisory()
         n_starting = sum(1 for s in self._seats.values()
                          if s.state == STARTING)
         n_draining = sum(1 for s in self._seats.values()

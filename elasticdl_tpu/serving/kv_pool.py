@@ -840,6 +840,9 @@ class PagedKVPool(object):
         self.revive_uploads = 0  # monotone: batched revival scatters
         self._gather_fn = None
         self._upload_fns = {}  # padded batch size -> compiled scatter
+        # optional StepProfiler (serving/engine.py): the pool times its
+        # revive uploads — the one decode phase only it can see
+        self.profiler = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -895,6 +898,8 @@ class PagedKVPool(object):
         moves = self.allocator.take_revived()
         if not moves:
             return
+        prof = self.profiler
+        t0 = prof.t() if prof is not None else 0.0
         k = len(moves)
         k_pad = 1
         while k_pad < k:
@@ -932,6 +937,9 @@ class PagedKVPool(object):
             jnp.asarray(bids),
         )
         self.revive_uploads += 1
+        if prof is not None:
+            jax.block_until_ready(self.pools)
+            prof.observe("revive_upload", prof.t() - t0)
 
     def host_bytes_in_use(self):
         """True host-tier bytes: spilled blocks hold every row leaf of

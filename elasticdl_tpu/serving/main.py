@@ -75,6 +75,17 @@ def parse_serving_args(args=None):
     # router/autoscaler routes live traffic here (a freshly adopted
     # replica must not serve its first request cold)
     parser.add_argument("--warmup_tokens", type=int, default=0)
+    # live metrics plane: Prometheus-text /metrics exposition (stdlib
+    # http.server thread, observability/metrics.py); -1 resolves from
+    # EDL_METRICS_PORT (unset = off), 0 = ephemeral port — the bound
+    # port prints as `METRICS_READY port=N` next to the serving line
+    parser.add_argument("--metrics_port", type=int, default=-1)
+    # per-step decode profiler (engine.StepProfiler): phase timers
+    # around prefill / suffix tile / draft / verify / scatter / revive
+    # upload / reload swap; -1 resolves from EDL_PROFILE, default off
+    # (disabled = zero timing work)
+    parser.add_argument("--profile", type=int, default=-1,
+                        choices=(-1, 0, 1))
     return parser.parse_args(args)
 
 
@@ -142,6 +153,9 @@ def build_server(args):
             kv_host_bytes=(None if args.kv_host_bytes < 0
                            else args.kv_host_bytes),
             draft_k=draft_k if draft is not None else 0,
+            metrics_port=(None if args.metrics_port < 0
+                          else args.metrics_port),
+            profile=None if args.profile < 0 else bool(args.profile),
         ),
         draft=draft,
     )
@@ -184,6 +198,11 @@ def main(argv=None):
 
     signal.signal(signal.SIGTERM, _graceful)
     signal.signal(signal.SIGINT, _graceful)
+    if server.metrics is not None:
+        # same log-line discovery contract as SERVING_READY: a scraper
+        # (or the supervisor's log re-read) learns the bound port here
+        print("METRICS_READY port=%d" % server.metrics.port,
+              flush=True)
     print("SERVING_READY port=%d" % server.port, flush=True)
     done.wait()
     server.stop(drain=True)

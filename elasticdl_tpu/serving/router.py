@@ -104,6 +104,17 @@ from elasticdl_tpu.common.retry import (
     is_transient_rpc_error,
 )
 from elasticdl_tpu.observability.histogram import LogLinearHistogram
+from elasticdl_tpu.observability.metrics import (
+    MetricsServer,
+    add_counts,
+    counter_family,
+    gauge_family,
+    metrics_port_default,
+)
+from elasticdl_tpu.observability.slo import (
+    BurnRateEngine,
+    default_router_slos,
+)
 from elasticdl_tpu.observability.tracing import recorder
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.serving.admission import AdmissionError
@@ -124,7 +135,18 @@ class RouterConfig(object):
     depends on replica count — keep lease_secs > poll_timeout_secs /
     2 + poll_secs so one wedged-replica sweep cannot outlast a healthy
     lease. redispatch_window_secs bounds the TOTAL time one request
-    may spend being re-dispatched before its last error propagates."""
+    may spend being re-dispatched before its last error propagates.
+
+    SLO knobs: the burn-rate engine (observability/slo.py) windows the
+    router's time-series ring with `slo_fast/slow_window_secs` and
+    evaluates three declared objectives — fleet TTFT p99 under
+    `slo_ttft_p99_ms`, router e2e p99 under `slo_e2e_p99_ms` (both
+    with error budget `slo_latency_goal`), and the goodput floor
+    (shed+errors over routed under `slo_goodput_goal`). Burn rates
+    surface in router_status (SloObjective blocks) and /metrics
+    (`edl_router_slo_burn`); the autoscaler logs them read-only.
+    metrics_port (None resolves from EDL_METRICS_PORT, unset = off)
+    arms the /metrics exposition."""
 
     def __init__(self, poll_secs=0.5, poll_timeout_secs=2.0,
                  lease_secs=2.5, breaker_threshold=3,
@@ -132,7 +154,11 @@ class RouterConfig(object):
                  dispatch_timeout_secs=120.0,
                  redispatch_window_secs=30.0, base_delay_secs=0.05,
                  max_delay_secs=1.0, port=0, max_workers=64,
-                 telemetry_dir="", telemetry_flush_every=20):
+                 telemetry_dir="", telemetry_flush_every=20,
+                 metrics_port=None, slo_ttft_p99_ms=30000.0,
+                 slo_e2e_p99_ms=60000.0, slo_latency_goal=0.01,
+                 slo_goodput_goal=0.02, slo_fast_window_secs=30.0,
+                 slo_slow_window_secs=120.0):
         self.poll_secs = float(poll_secs)
         self.poll_timeout_secs = float(poll_timeout_secs)
         self.lease_secs = float(lease_secs)
@@ -147,6 +173,16 @@ class RouterConfig(object):
         self.max_workers = int(max_workers)
         self.telemetry_dir = telemetry_dir
         self.telemetry_flush_every = int(telemetry_flush_every)
+        self.metrics_port = (
+            metrics_port_default() if metrics_port is None
+            else int(metrics_port)
+        )
+        self.slo_ttft_p99_ms = float(slo_ttft_p99_ms)
+        self.slo_e2e_p99_ms = float(slo_e2e_p99_ms)
+        self.slo_latency_goal = float(slo_latency_goal)
+        self.slo_goodput_goal = float(slo_goodput_goal)
+        self.slo_fast_window_secs = float(slo_fast_window_secs)
+        self.slo_slow_window_secs = float(slo_slow_window_secs)
 
 
 class CircuitBreaker(object):
@@ -261,6 +297,10 @@ class Replica(object):
         self.revive_uploads = 0
         self.prefill_tokens_revived = 0
         self.host_drops = 0
+        # windowed warm-capacity signal (share of prompt tokens seated
+        # without prefill compute over the replica's trailing ring
+        # window) — what prefix-affinity routing will rank by
+        self.prefix_hit_rate_window = 0.0
         self.queue_wait_ms = 0.0
         self.ttft_hist = []
         self.queue_wait_hist = []
@@ -359,6 +399,7 @@ class Replica(object):
         self.revive_uploads = status.revive_uploads
         self.prefill_tokens_revived = status.prefill_tokens_revived
         self.host_drops = status.host_drops
+        self.prefix_hit_rate_window = status.prefix_hit_rate_window
         self.queue_wait_ms = status.queue_wait_ms
         # raw histogram buckets (mergeable by addition): the router
         # sums these across replicas for fleet-wide percentiles
@@ -418,6 +459,25 @@ class Router(object):
         self._server = None
         self.servicer = None
         self.port = None
+        self.metrics = None  # MetricsServer when config.metrics_port
+        # SLO burn-rate engine over the telemetry ring: last-seen
+        # CUMULATIVE replica histogram buckets per address (an entry
+        # outlives its replica, so a killed replica's history stays in
+        # the fleet sum — the TtftWindows convention), bucket-added
+        # into the ring each heartbeat
+        self._fleet_hists = {}
+        self._slo_engine = BurnRateEngine(
+            default_router_slos(
+                self.config.slo_ttft_p99_ms,
+                self.config.slo_e2e_p99_ms,
+                self.config.slo_goodput_goal,
+                latency_goal=self.config.slo_latency_goal,
+            ),
+            fast_window_secs=self.config.slo_fast_window_secs,
+            slow_window_secs=self.config.slo_slow_window_secs,
+        )
+        self._slo_lock = threading.Lock()
+        self._slo_reports = []
         # optional replica supervisor (serving/autoscaler.py): owns
         # the fleet processes and contributes the router_status
         # autoscaler block; the router never calls INTO it while
@@ -509,8 +569,36 @@ class Router(object):
             t.join(timeout=max(0.0, deadline - time.monotonic()))
         now = self._clock()
         healthy = sum(1 for r in self.replicas() if r.in_rotation(now))
-        self.telemetry.record_poll(healthy, len(self.replicas()))
+        # fleet-merged CUMULATIVE histogram buckets into the ring: the
+        # last-seen counts per ADDRESS (never deleted — a killed
+        # replica's history must stay in the sum or its window deltas
+        # would go negative), bucket-added across the roster. The SLO
+        # engine windows exactly this series.
+        for rep in self.replicas():
+            if rep.ttft_hist:
+                self._fleet_hists[rep.address] = (
+                    list(rep.ttft_hist), list(rep.queue_wait_hist)
+                )
+        ttft_cum, wait_cum = [], []
+        for ttft, wait in self._fleet_hists.values():
+            ttft_cum = add_counts(ttft_cum, ttft)
+            wait_cum = add_counts(wait_cum, wait)
+        self.telemetry.record_poll(
+            healthy, len(self.replicas()),
+            fleet_hists={"fleet_ttft_ms": ttft_cum,
+                         "fleet_queue_wait_ms": wait_cum},
+        )
+        reports = self.telemetry.evaluate_slos(self._slo_engine)
+        with self._slo_lock:
+            self._slo_reports = reports
         return healthy
+
+    def slo_reports(self):
+        """The last heartbeat's burn-rate evaluations (plain dicts —
+        the shape observability/slo.py documents). Read-only consumers:
+        router_status, /metrics, the autoscaler's logged advisory."""
+        with self._slo_lock:
+            return list(self._slo_reports)
 
     def _heartbeat_loop(self):
         while not self._stop.is_set():
@@ -925,6 +1013,7 @@ class Router(object):
                 revive_uploads=rep.revive_uploads,
                 prefill_tokens_revived=rep.prefill_tokens_revived,
                 host_drops=rep.host_drops,
+                prefix_hit_rate_window=rep.prefix_hit_rate_window,
                 queue_wait_ms=rep.queue_wait_ms,
                 dispatched=rep.dispatched,
                 failures=rep.failures,
@@ -947,8 +1036,25 @@ class Router(object):
         fleet_revived_tokens = sum(r.prefill_tokens_revived
                                    for r in self.replicas())
         fleet_host_drops = sum(r.host_drops for r in self.replicas())
+        slo_blocks = [
+            pb.SloObjective(
+                name=r["name"],
+                kind=r["kind"],
+                threshold_ms=r["threshold_ms"],
+                goal=r["goal"],
+                fast_burn=r["fast_burn"],
+                slow_burn=r["slow_burn"],
+                fast_window_secs=r["fast_window_secs"],
+                slow_window_secs=r["slow_window_secs"],
+                fast_samples=r["fast_samples"],
+                slow_samples=r["slow_samples"],
+                alerting=r["alerting"],
+            )
+            for r in self.slo_reports()
+        ]
         return pb.RouterStatusResponse(
             autoscaler=autoscaler,
+            slo=slo_blocks,
             replicas=len(reps),
             healthy=sum(1 for r in reps if r.healthy),
             kv_host_blocks=fleet_host_blocks,
@@ -976,6 +1082,57 @@ class Router(object):
             queue_wait_p99_ms=fleet_wait.percentile(99),
         )
 
+    # ----------------------------------------------------- /metrics
+
+    def _metrics_families(self):
+        """One router scrape: the closed telemetry sets + the
+        fleet-merged histograms (RouterTelemetry.prometheus), the SLO
+        burn-rate gauges, and — when a supervisor is attached — the
+        autoscaler roster/decision series. Runs on the exposition
+        HTTP thread; every collector locks itself."""
+        fams = self.telemetry.prometheus()
+        burn, alerting = [], []
+        for r in self.slo_reports():
+            burn.append(({"slo": r["name"], "window": "fast"},
+                         r["fast_burn"]))
+            burn.append(({"slo": r["name"], "window": "slow"},
+                         r["slow_burn"]))
+            alerting.append(({"slo": r["name"]},
+                             1.0 if r["alerting"] else 0.0))
+        fams.append(gauge_family(
+            "edl_router_slo_burn",
+            "SLO error-budget burn rate per objective and window "
+            "(1.0 = spending the budget exactly on schedule)",
+            burn,
+        ))
+        fams.append(gauge_family(
+            "edl_router_slo_alerting",
+            "1 when BOTH burn windows exceed 1.0 (multi-window rule)",
+            alerting,
+        ))
+        sup = self.autoscaler
+        if sup is not None:
+            block = sup.status_block()
+            for name in ("target", "live", "starting", "draining"):
+                fams.append(gauge_family(
+                    "edl_autoscaler_%s" % name,
+                    "autoscaler roster gauge %s" % name,
+                    [({}, getattr(block, name))],
+                ))
+            for name in ("scale_ups", "scale_downs", "replacements",
+                         "spawn_failures"):
+                fams.append(counter_family(
+                    "edl_autoscaler_%s_total" % name,
+                    "autoscaler decision counter %s" % name,
+                    getattr(block, name),
+                ))
+            fams.append(gauge_family(
+                "edl_autoscaler_circuit_open",
+                "1 when the restart circuit is open",
+                [({}, 1.0 if block.circuit_open else 0.0)],
+            ))
+        return fams
+
     # -------------------------------------------------------- lifecycle
 
     def start(self, grpc_server=True, injector=None):
@@ -991,6 +1148,14 @@ class Router(object):
         self.servicer = maybe_wrap_servicer(
             servicer, injector, rpcs=SERVING_RPCS
         )
+        if self.config.metrics_port is not None:
+            self.metrics = MetricsServer(
+                self._metrics_families, port=self.config.metrics_port
+            )
+            logger.info(
+                "Router /metrics exposition on port %d",
+                self.metrics.port,
+            )
         if grpc_server:
             from elasticdl_tpu.proto.service import (
                 add_router_servicer_to_server,
@@ -1023,6 +1188,9 @@ class Router(object):
         if self._server is not None:
             self._server.stop(grace).wait()
             self._server = None
+        if self.metrics is not None:
+            self.metrics.close()
+            self.metrics = None
         self.telemetry.close()
         # export the span ring when EDL_TRACE_DIR is set (no-op
         # otherwise); the dump tool merges per-process files

@@ -65,6 +65,25 @@ def parse_router_args(args=None):
     parser.add_argument("--redispatch_window_secs", type=float,
                         default=30.0)
     parser.add_argument("--tensorboard_log_dir", default="")
+    # live metrics plane: Prometheus /metrics exposition + the SLO
+    # burn-rate engine's declared objectives (observability/slo.py).
+    # -1 resolves metrics_port from EDL_METRICS_PORT (unset = off);
+    # 0 = ephemeral, printed as `METRICS_READY port=N`
+    parser.add_argument("--metrics_port", type=int, default=-1)
+    parser.add_argument("--slo_ttft_p99_ms", type=float,
+                        default=30000.0)
+    parser.add_argument("--slo_e2e_p99_ms", type=float,
+                        default=60000.0)
+    parser.add_argument("--slo_latency_goal", type=float, default=0.01,
+                        help="allowed fraction of requests over a "
+                             "latency threshold (the error budget)")
+    parser.add_argument("--slo_goodput_goal", type=float, default=0.02,
+                        help="allowed failed fraction (shed+errors "
+                             "over routed)")
+    parser.add_argument("--slo_fast_window_secs", type=float,
+                        default=30.0)
+    parser.add_argument("--slo_slow_window_secs", type=float,
+                        default=120.0)
     # ---- elastic fleet (serving/autoscaler.py) ----
     parser.add_argument("--autoscale", action="store_true",
                         help="own the replica fleet: spawn/replace/"
@@ -110,6 +129,14 @@ def build_router(args):
             redispatch_window_secs=args.redispatch_window_secs,
             port=args.port,
             telemetry_dir=args.tensorboard_log_dir,
+            metrics_port=(None if args.metrics_port < 0
+                          else args.metrics_port),
+            slo_ttft_p99_ms=args.slo_ttft_p99_ms,
+            slo_e2e_p99_ms=args.slo_e2e_p99_ms,
+            slo_latency_goal=args.slo_latency_goal,
+            slo_goodput_goal=args.slo_goodput_goal,
+            slo_fast_window_secs=args.slo_fast_window_secs,
+            slo_slow_window_secs=args.slo_slow_window_secs,
         ),
     )
 
@@ -165,6 +192,9 @@ def main(argv=None):
 
     signal.signal(signal.SIGTERM, _graceful)
     signal.signal(signal.SIGINT, _graceful)
+    if router.metrics is not None:
+        print("METRICS_READY port=%d" % router.metrics.port,
+              flush=True)
     print("ROUTER_READY port=%d" % router.port, flush=True)
     done.wait()
     # supervisor first: it drains and retires the fleet it owns; the

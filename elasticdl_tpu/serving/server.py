@@ -40,12 +40,18 @@ from elasticdl_tpu.serving.admission import (
     RequestQueue,
     ServingRequest,
 )
+from elasticdl_tpu.observability.metrics import (
+    MetricsServer,
+    metrics_port_default,
+)
 from elasticdl_tpu.serving.engine import (
     ContinuousBatchingEngine,
     PagedContinuousBatchingEngine,
+    StepProfiler,
     kv_host_bytes_default,
     kv_paged_default,
     kv_shared_default,
+    profile_default,
 )
 from elasticdl_tpu.serving.hot_reload import CheckpointWatcher
 from elasticdl_tpu.serving.telemetry import ServingTelemetry
@@ -76,7 +82,17 @@ class ServingConfig(object):
     default 0 = off) bounds the host-RAM spill tier: evicted prefix
     chains demote to host buffers and revive by device upload instead
     of re-paying prefill — a cell's system-prompt working set survives
-    device pressure."""
+    device pressure.
+
+    metrics_port (None resolves from EDL_METRICS_PORT; unset = OFF)
+    arms the Prometheus-text /metrics exposition on a stdlib HTTP
+    thread (observability/metrics.py): the closed telemetry sets, the
+    latency histograms and the per-step profiler phases, scrapeable by
+    anything that speaks the text format (0 = ephemeral port, for
+    drills/tests). profile (None resolves from EDL_PROFILE, default
+    off) arms the per-step decode profiler (engine.StepProfiler) —
+    phase-split compiled steps, <5% bound serve-smoke overhead; off,
+    the engine does no timing work at all."""
 
     def __init__(self, num_slots=4, queue_capacity=64, top_k=0,
                  top_p=1.0, checkpoint_dir="", reload_poll_secs=2.0,
@@ -84,7 +100,8 @@ class ServingConfig(object):
                  idle_wait_secs=0.05, handler_poll_secs=0.25,
                  port=0, max_workers=64, kv_paged=None,
                  kv_block_size=16, kv_num_blocks=0, kv_shared=None,
-                 draft_k=0, kv_host_bytes=None):
+                 draft_k=0, kv_host_bytes=None, metrics_port=None,
+                 profile=None):
         self.num_slots = int(num_slots)
         self.queue_capacity = int(queue_capacity)
         self.top_k = int(top_k)
@@ -110,6 +127,13 @@ class ServingConfig(object):
         self.kv_host_bytes = (
             kv_host_bytes_default() if kv_host_bytes is None
             else int(kv_host_bytes)
+        )
+        self.metrics_port = (
+            metrics_port_default() if metrics_port is None
+            else int(metrics_port)
+        )
+        self.profile = (
+            profile_default() if profile is None else bool(profile)
         )
 
 
@@ -231,6 +255,10 @@ class _Scheduler(threading.Thread):
             wait_ms = self.telemetry.record_queue_wait(
                 req.queue_wait_secs()
             )
+            # the windowed prefix-hit-rate's denominator: EVERY prompt
+            # token seated (the engine counts the prefix_hit_tokens
+            # numerator — the ones seated without prefill compute)
+            self.telemetry.count("prompt_tokens", len(req.prompt))
             req.trace_event("seated", queue_wait_ms=round(wait_ms, 3))
             slot, first, finished = self.engine.insert(req)
             ttft_ms = self.telemetry.record_ttft(req)
@@ -371,6 +399,10 @@ class ServingServicer(object):
             draft_accepted=self._engine.draft_accepted,
             draining=self._draining(),
             queue_wait_ms=snap["queue_wait_ms"],
+            # windowed warm-capacity signal (time-series ring): prompt
+            # tokens seated without prefill compute over the trailing
+            # horizon / all prompt tokens seated
+            prefix_hit_rate_window=snap["prefix_hit_rate_window"],
             # percentiles + raw mergeable buckets from the shared
             # log-linear histograms (observability/histogram.py)
             ttft_p50_ms=snap["ttft_p50_ms"],
@@ -504,6 +536,10 @@ class GenerationServer(object):
         # the engine reports the events only it can see (prefix hits,
         # CoW faults, draft accepts) through the same closed counters
         self.engine.telemetry = self.telemetry
+        # per-step decode profiler (phase-split compiled steps); the
+        # paged engine forwards it to the KV pool for revive timing
+        if cfg.profile:
+            self.engine.profiler = StepProfiler()
         watcher = None
         if cfg.checkpoint_dir:
             watcher = CheckpointWatcher(
@@ -533,9 +569,28 @@ class GenerationServer(object):
         )
         self._server = None
         self.port = None
+        self.metrics = None  # MetricsServer when cfg.metrics_port set
+
+    def _metrics_families(self):
+        """One replica scrape: the closed telemetry sets + latency
+        histograms, plus the profiler's phase histogram when armed
+        (called on the exposition HTTP thread; each collector locks
+        itself)."""
+        fams = self.telemetry.prometheus()
+        if self.engine.profiler is not None:
+            fams.extend(self.engine.profiler.prometheus())
+        return fams
 
     def start(self, grpc_server=True):
         self.scheduler.start()
+        if self.config.metrics_port is not None:
+            self.metrics = MetricsServer(
+                self._metrics_families, port=self.config.metrics_port
+            )
+            logger.info(
+                "Serving /metrics exposition on port %d",
+                self.metrics.port,
+            )
         if grpc_server:
             from elasticdl_tpu.proto.service import (
                 add_serving_servicer_to_server,
@@ -568,6 +623,9 @@ class GenerationServer(object):
         if self._server is not None:
             self._server.stop(grace).wait()
             self._server = None
+        if self.metrics is not None:
+            self.metrics.close()
+            self.metrics = None
         self.telemetry.close()
         # export this process's span ring when EDL_TRACE_DIR is set
         # (no-op otherwise) — the dump tool merges per-process files

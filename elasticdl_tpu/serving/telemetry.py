@@ -16,8 +16,11 @@ whole system. Gauges, stepped by decode-step index:
     serving/kv_host_bytes      host spill-tier bytes at the step
     serving/queue_wait_ms      EWMA of time-queued-before-seating (the
                                router's load signal; ServerStatus field)
-    serving/ttft_p99_ms        histogram percentiles, one scalar per
-    serving/e2e_p99_ms         flush window (see below)
+    serving/ttft_p99           histogram percentiles, one scalar per
+    serving/e2e_p99            flush window (see below)
+    serving/prefix_hit_rate_window  windowed share of prompt tokens
+                               seated by prefix incref/revival — the
+                               warm-capacity signal (ring-derived)
     serving/admitted_total     monotone counters, one scalar per flush
     serving/rejected_total
     serving/expired_total
@@ -32,53 +35,83 @@ replicas, and bench_serving.py computes its percentiles with the same
 histogram code, so bench numbers and live numbers are definitionally
 identical.
 
+The LIVE signal plane (observability/metrics.py): every telemetry
+object also feeds a windowed **TimeSeriesRing** — fixed-interval
+snapshots of counter deltas, last gauges and histogram BUCKET deltas —
+which is what the Prometheus `/metrics` exposition, the windowed
+prefix-hit-rate and the router's SLO burn-rate engine read. The ring
+and the tb_events path flush through the SAME lock at the SAME points,
+and `close()` lands the final partial window in BOTH: a server stopped
+mid-window reports identical totals to the event file and to the last
+ring window (pinned by a regression test).
+
 The snapshot derives the memory-efficiency headline
 `kv_bytes_per_token` = sum-over-steps(kv_bytes_in_use) /
 tokens_generated: the average KV bytes RESIDENT per generated token.
-The dense pool pins every seated slot's full `seq_len` stripe, the
-paged pool only the blocks written so far — this ratio is where the
-difference shows up as one number.
 
 Counters also back the ServerStatus RPC via snapshot() — the RPC must
 work with telemetry disabled (no log_dir), so counters live here and
 the event writer is optional. The counter NAME SET is closed
 (`COUNTERS`): count() raises on anything undeclared, because a typo'd
 name would silently fork a fresh counter and under-report the real
-one forever (edl-lint EDL401 flags literal call sites statically; the
-raise catches dynamic names).
+one forever. The GAUGE set is closed the same way (`GAUGES` /
+`gauge()`) — a typo'd gauge tag would fork a dead TensorBoard series
+and a dead Prometheus series just as silently. edl-lint EDL401 flags
+literal call sites of BOTH statically; the raises catch dynamic names.
 
 Thread-safety: the scheduler thread writes step gauges; gRPC threads
-bump admission counters and read snapshots — everything under one lock
-(the writes are tiny appends; contention is negligible next to a decode
-step)."""
+bump admission counters and read snapshots; the metrics-exposition
+thread reads `prometheus()` — everything under one lock (the writes
+are tiny appends; contention is negligible next to a decode step)."""
 
 import threading
 import time
 
 from elasticdl_tpu.common.tb_events import EventFileWriter
 from elasticdl_tpu.observability.histogram import LogLinearHistogram
+from elasticdl_tpu.observability.metrics import (
+    TimeSeriesRing,
+    counter_family,
+    gauge_family,
+    hist_family,
+)
 
 
 class ServingTelemetry(object):
     #: the closed counter set — count() REJECTS anything else.
     #: prefix_hit_tokens counts prompt tokens seated by shared-prefix
-    #: incref (never re-prefilled), cow_copies the copy-on-write
+    #: incref (never re-prefilled), prompt_tokens EVERY prompt token
+    #: seated (the hit-rate denominator), cow_copies the copy-on-write
     #: faults, draft_proposed/draft_accepted the speculative-decode
     #: proposal economy (accept rate = accepted / proposed).
     #: The tiered-KV trio: revive_uploads counts batched host->device
     #: revival scatters, prefill_tokens_revived the prompt tokens
-    #: those uploads seated WITHOUT re-running prefill (the host
-    #: tier's whole reason to exist), host_drops the spilled entries
-    #: the bounded host LRU (or a reload flush) discarded.
+    #: those uploads seated WITHOUT re-running prefill, host_drops the
+    #: spilled entries the bounded host LRU (or a reload flush)
+    #: discarded.
     COUNTERS = ("admitted", "rejected", "expired", "completed",
                 "tokens_generated", "reloads", "prefix_hit_tokens",
-                "cow_copies", "draft_proposed", "draft_accepted",
-                "revive_uploads", "prefill_tokens_revived",
-                "host_drops")
+                "prompt_tokens", "cow_copies", "draft_proposed",
+                "draft_accepted", "revive_uploads",
+                "prefill_tokens_revived", "host_drops")
+    #: the closed gauge set — gauge()/_gauge_locked REJECT anything
+    #: else, exactly like the counters (EDL401 is the static twin for
+    #: both). These are the serving/<name> TensorBoard tags and the
+    #: edl_serving_<name> Prometheus series.
+    GAUGES = ("queue_depth", "active_slots", "step_ms",
+              "tokens_per_sec", "ttft_ms", "queue_wait_ms",
+              "kv_bytes_in_use", "kv_blocks_free", "kv_host_blocks",
+              "kv_host_bytes", "ttft_p99", "e2e_p99",
+              "prefix_hit_rate_window")
     #: latency histograms (ms), all on the shared bucket scheme
     HISTOGRAMS = ("ttft_ms", "queue_wait_ms", "step_ms", "e2e_ms")
+    #: the windowed prefix-hit-rate's trailing horizon (secs): long
+    #: enough to smooth a single burst, short enough that a router
+    #: reading it sees the CURRENT warm-capacity regime
+    PREFIX_HIT_HORIZON_SECS = 30.0
 
-    def __init__(self, log_dir=None, flush_every=50, clock=time.monotonic):
+    def __init__(self, log_dir=None, flush_every=50, clock=time.monotonic,
+                 ring_secs=1.0, ring_windows=240):
         self._log_dir = log_dir
         self._flush_every = max(1, int(flush_every))
         self._clock = clock
@@ -86,8 +119,15 @@ class ServingTelemetry(object):
         self._writer = None
         self._started = clock()
         self.counters = {name: 0 for name in self.COUNTERS}
+        self.gauges = {name: 0.0 for name in self.GAUGES}
         self.hists = {name: LogLinearHistogram()
                       for name in self.HISTOGRAMS}
+        # the live metrics plane: windowed counter/bucket deltas
+        # (observability/metrics.py), fed under this lock at flush
+        # cadence; /metrics, the SLO engine and the windowed
+        # prefix-hit-rate all read it
+        self.ring = TimeSeriesRing(interval_secs=ring_secs,
+                                   capacity=ring_windows, clock=clock)
         self.max_active_slots = 0
         self.kv_bytes_in_use_peak = 0
         self._kv_byte_steps = 0  # sum of kv_bytes_in_use over steps
@@ -111,6 +151,40 @@ class ServingTelemetry(object):
         if writer is not None:
             writer.add_scalar(tag, float(value), step)
 
+    def _gauge_locked(self, name, value, step=None):
+        """One gauge write: last-value for the ring/exposition + a
+        TensorBoard scalar. Closed set — see the class docstring.
+        Caller holds the lock."""
+        if name not in self.gauges:
+            raise ValueError(
+                "unknown serving gauge %r (declared: %s) — a typo "
+                "here would fork a dead series"
+                % (name, ", ".join(self.GAUGES))
+            )
+        self.gauges[name] = float(value)
+        self._scalar("serving/%s" % name, value,
+                     self._step if step is None else step)
+
+    def gauge(self, name, value):
+        """Public gauge entry for callers outside this class (the
+        engine, the supervisor); internal call sites already hold the
+        lock and use _gauge_locked."""
+        with self._lock:
+            self._gauge_locked(name, value)
+
+    def _ring_observe_locked(self, roll=True):
+        """Feed the ring one CUMULATIVE snapshot (it differences at
+        window boundaries). Caller holds the lock. Copying the trimmed
+        bucket lists is the whole cost, so hot paths gate this behind
+        ring.due()."""
+        self.ring.observe(
+            counters=self.counters,
+            gauges=self.gauges,
+            hists={name: h.to_counts()
+                   for name, h in self.hists.items()},
+            roll=roll,
+        )
+
     # ------------------------------------------------------------ events
 
     def count(self, name, n=1):
@@ -129,12 +203,18 @@ class ServingTelemetry(object):
         EWMA) without touching the monotone counters. The pre-ready
         warmup path (serving/main.py --warmup_tokens) calls this so
         the jit-compile latency of a request no client ever sent can
-        never surface in the percentiles a router/autoscaler SLOs on."""
+        never surface in the percentiles a router/autoscaler SLOs on.
+        The ring restarts with the histograms: a warmup window must
+        not seed the burn-rate horizon either."""
         with self._lock:
             for name in self.hists:
                 self.hists[name] = LogLinearHistogram()
             self._queue_wait_ewma_ms = 0.0
             self._queue_waits_seen = 0
+            self.ring = TimeSeriesRing(
+                interval_secs=self.ring.interval_secs,
+                capacity=self.ring.capacity, clock=self._clock,
+            )
 
     def record_ttft(self, request):
         """Time-to-first-token for one request, at its first token."""
@@ -142,7 +222,9 @@ class ServingTelemetry(object):
         with self._lock:
             self._dirty = True
             self.hists["ttft_ms"].record(ttft_ms)
-            self._scalar("serving/ttft_ms", ttft_ms, self._step)
+            self._gauge_locked("ttft_ms", ttft_ms)
+            if self.ring.due():
+                self._ring_observe_locked()
         return ttft_ms
 
     def record_e2e(self, latency_ms):
@@ -174,8 +256,8 @@ class ServingTelemetry(object):
                 )
             self._queue_waits_seen += 1
             self.hists["queue_wait_ms"].record(wait_ms)
-            self._scalar("serving/queue_wait_ms",
-                         self._queue_wait_ewma_ms, self._step)
+            self._gauge_locked("queue_wait_ms",
+                               self._queue_wait_ewma_ms)
         return wait_ms
 
     def record_step(self, queue_depth, active_slots, step_secs,
@@ -198,33 +280,47 @@ class ServingTelemetry(object):
                     self.kv_bytes_in_use_peak, kv_bytes_in_use
                 )
                 self._kv_byte_steps += kv_bytes_in_use
-                self._scalar("serving/kv_bytes_in_use",
-                             kv_bytes_in_use, self._step)
+                self._gauge_locked("kv_bytes_in_use", kv_bytes_in_use)
             if kv_blocks_free is not None:
-                self._scalar("serving/kv_blocks_free",
-                             kv_blocks_free, self._step)
+                self._gauge_locked("kv_blocks_free", kv_blocks_free)
             if kv_host_blocks is not None:
-                self._scalar("serving/kv_host_blocks",
-                             kv_host_blocks, self._step)
+                self._gauge_locked("kv_host_blocks", kv_host_blocks)
             if kv_host_bytes is not None:
-                self._scalar("serving/kv_host_bytes",
-                             kv_host_bytes, self._step)
-            self._scalar("serving/queue_depth", queue_depth, self._step)
-            self._scalar("serving/active_slots", active_slots, self._step)
-            self._scalar(
-                "serving/step_ms", step_secs * 1000.0, self._step
-            )
+                self._gauge_locked("kv_host_bytes", kv_host_bytes)
+            self._gauge_locked("queue_depth", queue_depth)
+            self._gauge_locked("active_slots", active_slots)
+            self._gauge_locked("step_ms", step_secs * 1000.0)
             if self._step % self._flush_every == 0:
                 self._flush_window_locked()
+            if self.ring.due():
+                self._ring_observe_locked()
+
+    def _prefix_hit_rate_locked(self):
+        """Windowed warm-capacity signal: the share of prompt tokens
+        seated WITHOUT paying prefill compute (prefix incref + spilled
+        revival) over the trailing horizon — closed ring windows plus
+        the open partial, so the first seconds of a burst already
+        register. Caller holds the lock."""
+        horizon = self.PREFIX_HIT_HORIZON_SECS
+        # the live partial comes from the COUNTERS directly (the ring
+        # only learns cumulative values at observe points, which the
+        # hot path gates behind ring.due()) — live minus the open
+        # window's baseline is the pending delta
+        hit = (self.ring.sum_counter("prefix_hit_tokens", horizon)
+               + self.counters["prefix_hit_tokens"]
+               - self.ring.baseline_counter("prefix_hit_tokens"))
+        total = (self.ring.sum_counter("prompt_tokens", horizon)
+                 + self.counters["prompt_tokens"]
+                 - self.ring.baseline_counter("prompt_tokens"))
+        return hit / total if total > 0 else 0.0
 
     def _flush_window_locked(self):
         """Close the tokens/sec window and write the counter totals +
         headline percentiles. Caller holds the lock."""
         now = self._clock()
         window = max(now - self._window_t0, 1e-9)
-        self._scalar(
-            "serving/tokens_per_sec",
-            self._window_tokens / window, self._step,
+        self._gauge_locked(
+            "tokens_per_sec", self._window_tokens / window
         )
         self._window_tokens = 0
         self._window_t0 = now
@@ -235,10 +331,12 @@ class ServingTelemetry(object):
         for hist_name in ("ttft_ms", "e2e_ms"):
             hist = self.hists[hist_name]
             if hist.count:
-                self._scalar(
-                    "serving/%s_p99" % hist_name.replace("_ms", ""),
-                    hist.percentile(99), self._step,
+                self._gauge_locked(
+                    "%s_p99" % hist_name.replace("_ms", ""),
+                    hist.percentile(99),
                 )
+        self._gauge_locked("prefix_hit_rate_window",
+                           self._prefix_hit_rate_locked())
         self._counters_flushed_at = self._step
         self._dirty = False
 
@@ -256,6 +354,9 @@ class ServingTelemetry(object):
                 / max(1, self.counters["tokens_generated"])
             )
             snap["queue_wait_ms"] = self._queue_wait_ewma_ms
+            snap["prefix_hit_rate_window"] = (
+                self._prefix_hit_rate_locked()
+            )
             for prefix in ("ttft", "queue_wait", "e2e", "step"):
                 hist = self.hists[prefix + "_ms"]
                 for q in (50, 90, 99):
@@ -266,17 +367,67 @@ class ServingTelemetry(object):
             )
             return snap
 
+    def prometheus(self):
+        """The exposition families (observability/metrics.py shapes):
+        every closed counter as edl_serving_<name>_total, every closed
+        gauge as edl_serving_<name>, every histogram with
+        _bucket/_sum/_count on the shared bucket scheme, plus the
+        ring's drop accounting. Called from the metrics HTTP thread —
+        snapshots under the telemetry lock."""
+        with self._lock:
+            fams = []
+            for name in self.COUNTERS:
+                fams.append(counter_family(
+                    "edl_serving_%s_total" % name,
+                    "serving counter %s" % name,
+                    self.counters[name],
+                ))
+            gauges = dict(self.gauges)
+            gauges["prefix_hit_rate_window"] = (
+                self._prefix_hit_rate_locked()
+            )
+            for name in self.GAUGES:
+                fams.append(gauge_family(
+                    "edl_serving_%s" % name,
+                    "serving gauge %s" % name,
+                    [({}, gauges[name])],
+                ))
+            for name in self.HISTOGRAMS:
+                h = self.hists[name]
+                fams.append(hist_family(
+                    "edl_serving_%s" % name,
+                    "serving latency histogram %s (shared log-linear "
+                    "scheme)" % name,
+                    [({}, h.to_counts(), h.sum)],
+                ))
+            fams.append(gauge_family(
+                "edl_serving_ring_windows_dropped",
+                "time-series ring windows evicted by the bound",
+                [({}, self.ring.dropped)],
+            ))
+            return fams
+
     def close(self):
         """Flush the tail, then close the writer. Without this a
         server stopped mid-window under-reported in TensorBoard: the
         partial tokens/sec window and every counter bump since the
-        last flush_every boundary never reached the event file."""
+        last flush_every boundary never reached the event file. The
+        RING flushes at the same point with the same totals — the
+        tb_events path and the last ring window must agree on the
+        window boundary (regression-pinned), or the scrape plane and
+        the event file would tell different stories about the same
+        shutdown."""
         with self._lock:
             if self._log_dir and self._dirty:
                 # _flush_window_locked creates the writer on demand, so
                 # even a server that never reached a flush boundary
                 # leaves its final counters on disk
                 self._flush_window_locked()
+            # final cumulative observation + force-close of the open
+            # partial ring window: sum(ring deltas) == final counters
+            # == the tb totals written above, by construction
+            self._ring_observe_locked(roll=False)
+            self.ring.flush()
             if self._writer is not None:
                 self._writer.close()
                 self._writer = None
@@ -300,18 +451,27 @@ class RouterTelemetry(object):
 
     Counters back the router_status RPC via snapshot() — like the
     replica telemetry, the RPC must work with the writer disabled.
-    The counter name set is closed (count() raises on unknowns;
-    edl-lint EDL401 is the static twin). The router's end-to-end
-    dispatch latency (accept -> terminal outcome, re-dispatches and
-    hedges included) rides the shared log-linear histogram behind the
-    e2e_p* router_status fields, and snapshot() carries the
-    last-observed rotation gauges so operators aren't left scraping
-    the event file for fleet size."""
+    The counter AND gauge name sets are closed (count()/gauge() raise
+    on unknowns; edl-lint EDL401 is the static twin for both). The
+    router's end-to-end dispatch latency (accept -> terminal outcome,
+    re-dispatches and hedges included) rides the shared log-linear
+    histogram behind the e2e_p* router_status fields, and snapshot()
+    carries the last-observed rotation gauges so operators aren't left
+    scraping the event file for fleet size.
+
+    The ring: every heartbeat poll feeds one cumulative observation —
+    the router's own counters + e2e buckets PLUS the fleet-merged
+    replica histograms the router hands in (`fleet_hists`: last-seen
+    cumulative buckets per address, bucket-added — a killed replica's
+    history stays in the sum). The SLO burn-rate engine
+    (observability/slo.py) reads exactly this ring."""
 
     COUNTERS = ("routed", "completed", "redispatched", "hedges",
                 "hedge_wins", "shed", "breaker_trips", "errors")
+    GAUGES = ("healthy_replicas", "replicas")
 
-    def __init__(self, log_dir=None, flush_every=20, clock=time.monotonic):
+    def __init__(self, log_dir=None, flush_every=20, clock=time.monotonic,
+                 ring_secs=2.0, ring_windows=300):
         self._log_dir = log_dir
         self._flush_every = max(1, int(flush_every))
         self._clock = clock
@@ -319,12 +479,12 @@ class RouterTelemetry(object):
         self._writer = None
         self._started = clock()
         self._poll = 0
+        self._dirty = False
         self.counters = {name: 0 for name in self.COUNTERS}
+        self.gauges = {name: 0.0 for name in self.GAUGES}
         self.hists = {"e2e_ms": LogLinearHistogram()}
-        # last-observed rotation gauges (record_poll), surfaced by
-        # snapshot()/router_status
-        self._healthy_replicas = 0
-        self._replicas = 0
+        self.ring = TimeSeriesRing(interval_secs=ring_secs,
+                                   capacity=ring_windows, clock=clock)
 
     def _ensure_writer(self):
         if self._writer is None and self._log_dir:
@@ -337,6 +497,20 @@ class RouterTelemetry(object):
         writer = self._ensure_writer()
         if writer is not None:
             writer.add_scalar(tag, float(value), step)
+
+    def _gauge_locked(self, name, value, step=None):
+        if name not in self.gauges:
+            raise ValueError(
+                "unknown router gauge %r (declared: %s)"
+                % (name, ", ".join(self.GAUGES))
+            )
+        self.gauges[name] = float(value)
+        self._scalar("router/%s" % name, value,
+                     self._poll if step is None else step)
+
+    def gauge(self, name, value):
+        with self._lock:
+            self._gauge_locked(name, value)
 
     def count(self, name, n=1):
         with self._lock:
@@ -354,33 +528,93 @@ class RouterTelemetry(object):
         with self._lock:
             self.hists["e2e_ms"].record(latency_ms)
 
-    def record_poll(self, healthy, replicas):
+    def record_poll(self, healthy, replicas, fleet_hists=None):
         """One heartbeat sweep: rotation-size gauges now, counters
-        every flush_every polls."""
+        every flush_every polls, and one cumulative ring observation
+        carrying the router's own counters/buckets plus the
+        fleet-merged replica histograms (`fleet_hists`, e.g.
+        {"fleet_ttft_ms": cumulative bucket counts}) the burn-rate
+        engine windows over."""
         with self._lock:
             self._poll += 1
-            self._healthy_replicas = healthy
-            self._replicas = replicas
-            self._scalar("router/healthy_replicas", healthy, self._poll)
-            self._scalar("router/replicas", replicas, self._poll)
+            self._gauge_locked("healthy_replicas", healthy)
+            self._gauge_locked("replicas", replicas)
             if self._poll % self._flush_every == 0:
                 for name, value in self.counters.items():
                     self._scalar(
                         "router/%s_total" % name, value, self._poll
                     )
+            hists = {"e2e_ms": self.hists["e2e_ms"].to_counts()}
+            if fleet_hists:
+                hists.update(fleet_hists)
+            self.ring.observe(counters=self.counters,
+                              gauges=self.gauges, hists=hists)
+
+    def evaluate_slos(self, engine, now=None):
+        """Run a BurnRateEngine over this telemetry's ring UNDER the
+        telemetry lock (the ring itself is unlocked by design) — the
+        router calls this each heartbeat and caches the reports."""
+        with self._lock:
+            return engine.evaluate(self.ring, now)
 
     def snapshot(self):
         with self._lock:
             snap = dict(self.counters)
             snap["uptime_secs"] = self._clock() - self._started
             snap["polls"] = self._poll
-            snap["healthy_replicas"] = self._healthy_replicas
-            snap["replicas"] = self._replicas
+            snap["healthy_replicas"] = int(
+                self.gauges["healthy_replicas"]
+            )
+            snap["replicas"] = int(self.gauges["replicas"])
             for q in (50, 90, 99):
                 snap["e2e_p%d_ms" % q] = (
                     self.hists["e2e_ms"].percentile(q)
                 )
             return snap
+
+    def prometheus(self):
+        """Exposition families for the routing tier: closed counters
+        and gauges, the router's own e2e histogram, plus every
+        fleet-merged histogram the ring carries (the cumulative
+        last-seen sums record_poll fed) — so one scrape of the router
+        answers fleet-wide TTFT without touching a replica."""
+        with self._lock:
+            fams = []
+            for name in self.COUNTERS:
+                fams.append(counter_family(
+                    "edl_router_%s_total" % name,
+                    "router counter %s" % name,
+                    self.counters[name],
+                ))
+            for name in self.GAUGES:
+                fams.append(gauge_family(
+                    "edl_router_%s" % name,
+                    "router gauge %s" % name,
+                    [({}, self.gauges[name])],
+                ))
+            h = self.hists["e2e_ms"]
+            fams.append(hist_family(
+                "edl_router_e2e_ms",
+                "router end-to-end dispatch latency (shared "
+                "log-linear scheme)",
+                [({}, h.to_counts(), h.sum)],
+            ))
+            for name, counts in sorted(
+                    self.ring.latest()["hists"].items()):
+                if name == "e2e_ms":
+                    continue  # rendered from the live hist above
+                fams.append(hist_family(
+                    "edl_router_%s" % name,
+                    "fleet-merged replica histogram %s (bucket "
+                    "addition across the roster)" % name,
+                    [({}, counts, None)],
+                ))
+            fams.append(gauge_family(
+                "edl_router_ring_windows_dropped",
+                "time-series ring windows evicted by the bound",
+                [({}, self.ring.dropped)],
+            ))
+            return fams
 
     def close(self):
         with self._lock:
@@ -389,5 +623,14 @@ class RouterTelemetry(object):
                     self._scalar(
                         "router/%s_total" % name, value, self._poll
                     )
+            # same shutdown contract as the serving telemetry: the
+            # final partial window lands in the ring too
+            self.ring.observe(counters=self.counters,
+                              gauges=self.gauges,
+                              hists={"e2e_ms":
+                                     self.hists["e2e_ms"].to_counts()},
+                              roll=False)
+            self.ring.flush()
+            if self._writer is not None:
                 self._writer.close()
                 self._writer = None

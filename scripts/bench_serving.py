@@ -140,6 +140,16 @@ def parse_args(argv=None):
     # quantized model)
     p.add_argument("--kv_cache_dtype", default="",
                    choices=("", "int8"))
+    # per-step decode profiler (serving/engine.py StepProfiler): the
+    # run records each phase's p50/p99/count under "profile" —
+    # prefill / suffix_tile / decode / draft / verify_commit /
+    # scatter / revive_upload / reload_swap
+    p.add_argument("--profile", action="store_true")
+    # metrics+profiler overhead A/B: run the paged+shared leg twice —
+    # plane OFF (no profiler, no /metrics server) vs ON (profiler +
+    # live exposition being scraped is the serve path under test) —
+    # and assert the ON leg's tokens/sec within OVERHEAD_BOUND of OFF
+    p.add_argument("--overhead_ab", action="store_true")
     # tiered host spill (serving/kv_pool.py): host-tier capacity in
     # BLOCKS (converted to bytes at the serving rig's exact
     # block_bytes). Single-run mode arms the tier directly; with
@@ -331,7 +341,8 @@ def build_plan(args, seq_len, vocab):
 
 def run_load(args, trainer, state, plan, num_slots, kv_paged,
              kv_block_size, kv_num_blocks, kv_shared=False,
-             draft=None, draft_k=0, kv_host_bytes=0):
+             draft=None, draft_k=0, kv_host_bytes=0, profile=False,
+             metrics_port=None):
     import jax
 
     from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -349,6 +360,8 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             kv_shared=kv_shared,
             draft_k=draft_k if draft is not None else 0,
             kv_host_bytes=kv_host_bytes,
+            profile=profile,
+            metrics_port=metrics_port,
         ),
         draft=draft,
     ).start()
@@ -404,6 +417,29 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
     wall = time.monotonic() - bench_t0
 
     status = stub.server_status(pb.ServerStatusRequest(), timeout=30)
+    profile_snap = None
+    if profile and server.engine.profiler is not None:
+        profile_snap = server.engine.profiler.snapshot()
+    scrape = None
+    if server.metrics is not None:
+        # one real scrape through the stdlib HTTP server, validated by
+        # the INDEPENDENT parser — the exposition is part of the path
+        # under test, not a decoration
+        import urllib.request
+
+        from elasticdl_tpu.observability.promparse import (
+            parse_prometheus_text,
+        )
+
+        text = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % server.metrics.port,
+            timeout=10,
+        ).read().decode("utf-8")
+        fams = parse_prometheus_text(text)
+        scrape = {
+            "families": len(fams),
+            "samples": sum(len(f["samples"]) for f in fams.values()),
+        }
     server.stop()
 
     ok = [r for r in results if r["status"] == "OK"]
@@ -467,6 +503,10 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             "revive_uploads": status.revive_uploads,
             "prefill_tokens_revived": status.prefill_tokens_revived,
             "host_drops": status.host_drops,
+            # windowed warm-capacity signal (time-series ring)
+            "prefix_hit_rate_window": round(
+                status.prefix_hit_rate_window, 4
+            ),
         },
         # speculative-decode economy (zeros when --draft_k is off)
         "draft": {
@@ -478,6 +518,12 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             ) if status.draft_proposed else 0.0,
         },
     }
+    if profile_snap is not None:
+        # the per-step decode profiler breakdown: p50/p99/count per
+        # phase (serving/engine.py StepProfiler.snapshot shape)
+        record["profile"] = profile_snap
+    if scrape is not None:
+        record["metrics_scrape"] = scrape
     if args.ramp:
         # per-phase percentiles: one entry per ramp phase, same
         # histogram code as everything else — the autoscale drill's
@@ -680,6 +726,53 @@ def run_host_evict_ab(args):
     }
 
 
+#: the enabled metrics+profiler plane may cost at most this fraction
+#: of the disabled plane's tokens/sec (the PR 6 tracing bound, kept)
+OVERHEAD_BOUND = 0.05
+
+
+def run_overhead_ab(args, trainer, state, plan, num_slots,
+                    num_blocks, draft):
+    """The metrics+profiler overhead A/B: the SAME arrival plan on the
+    paged+shared pool, plane OFF (no profiler, no exposition) vs ON
+    (profiler armed — split compiled steps — plus a live /metrics
+    server that gets scraped at the end). tokens/sec must stay within
+    OVERHEAD_BOUND; one retry forgives a scheduler hiccup on a noisy
+    CI box, but two misses fail the bench (a >5% observability tax is
+    a regression, not noise)."""
+    ratios = []
+    for _attempt in range(2):
+        off, _ = run_load(
+            args, trainer, state, plan, num_slots,
+            kv_paged=True, kv_block_size=args.kv_block_size,
+            kv_num_blocks=num_blocks, kv_shared=True,
+            draft=draft, draft_k=args.draft_k,
+        )
+        on, _ = run_load(
+            args, trainer, state, plan, num_slots,
+            kv_paged=True, kv_block_size=args.kv_block_size,
+            kv_num_blocks=num_blocks, kv_shared=True,
+            draft=draft, draft_k=args.draft_k,
+            profile=True, metrics_port=0,
+        )
+        ratio = ((on["tokens_per_sec"] or 0.0)
+                 / (off["tokens_per_sec"] or 1e-9))
+        ratios.append(round(ratio, 4))
+        if ratio >= 1.0 - OVERHEAD_BOUND:
+            break
+    return {
+        "bound": OVERHEAD_BOUND,
+        "tokens_per_sec": [off["tokens_per_sec"],
+                           on["tokens_per_sec"]],
+        "goodput_rps": [off["goodput_rps"], on["goodput_rps"]],
+        "ratios": ratios,
+        "tokens_per_sec_ratio": ratios[-1],
+        "within_bound": ratios[-1] >= 1.0 - OVERHEAD_BOUND,
+        "profile": on.get("profile"),
+        "metrics_scrape": on.get("metrics_scrape"),
+    }
+
+
 def run_bench(args):
     if args.kv_cache_dtype and not args.compare_paged:
         # single-run mode: the whole run serves quantized arenas
@@ -714,7 +807,17 @@ def run_bench(args):
         draft=draft if args.kv_paged else None,
         draft_k=args.draft_k,
         kv_host_bytes=host_bytes if args.kv_paged else 0,
+        profile=args.profile,
+        metrics_port=0 if args.profile else None,
     )
+    if args.overhead_ab:
+        # metrics+profiler overhead A/B on the paged+shared shape (the
+        # path with the most instrumented phases)
+        record["profiler_overhead"] = run_overhead_ab(
+            args, trainer, state, plan,
+            args.paged_slots or 2 * args.num_slots, dense_blocks,
+            draft,
+        )
     if not args.compare_paged:
         return record
 
@@ -786,6 +889,11 @@ def run_bench(args):
             kv_shared=True,
             draft=draft,
             draft_k=args.draft_k,
+            # profiling the headline leg: its greedy-match rate below
+            # then ALSO pins the SPLIT (profiled) step path against
+            # the int8 dense oracle in a real serve
+            profile=args.profile,
+            metrics_port=0 if args.profile else None,
         )
         record["paged_int8"] = int8
         shared_tok = shared["tokens_per_sec"] or 1e-9
@@ -865,7 +973,15 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
-    # a bench run that completed nothing is a failure, not a datum
+    # a bench run that completed nothing is a failure, not a datum;
+    # an observability plane that taxes the serve path past the bound
+    # is one too
+    overhead = record.get("profiler_overhead")
+    if overhead is not None and not overhead["within_bound"]:
+        print("profiler overhead A/B OUT OF BOUND: ratio %.4f < %.4f"
+              % (overhead["tokens_per_sec_ratio"],
+                 1.0 - OVERHEAD_BOUND), file=sys.stderr)
+        return 1
     return 0 if record["completed"] > 0 else 1
 
 

@@ -147,6 +147,12 @@ SERVING_MESSAGES = {
         ("revive_uploads", 42, T.TYPE_INT64, _OPT),
         ("prefill_tokens_revived", 43, T.TYPE_INT64, _OPT),
         ("host_drops", 44, T.TYPE_INT64, _OPT),
+        # windowed prefix-hit-rate (time-series ring, trailing ~30 s):
+        # the share of prompt tokens seated WITHOUT paying prefill
+        # compute (prefix incref + spilled revival) — the warm-vs-cold
+        # capacity signal prefix-affinity routing reads, as a live
+        # window rather than a lifetime ratio
+        ("prefix_hit_rate_window", 45, T.TYPE_DOUBLE, _OPT),
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],
@@ -176,6 +182,25 @@ SERVING_MESSAGES = {
         # this roster's write-ahead state
         ("supervisor_restarts", 14, T.TYPE_INT64, _OPT),
     ],
+    # One SLO objective's burn-rate evaluation (observability/slo.py):
+    # the declared target, the error-budget goal, and the multi-window
+    # (fast/slow) burn rates over the router's time-series ring.
+    # alerting = both windows burning above 1.0 (spending the budget
+    # faster than planned) — the signal, not an action: the autoscaler
+    # consumes it read-only as a logged advisory.
+    "SloObjective": [
+        ("name", 1, T.TYPE_STRING, _OPT),
+        ("kind", 2, T.TYPE_STRING, _OPT),
+        ("threshold_ms", 3, T.TYPE_DOUBLE, _OPT),
+        ("goal", 4, T.TYPE_DOUBLE, _OPT),
+        ("fast_burn", 5, T.TYPE_DOUBLE, _OPT),
+        ("slow_burn", 6, T.TYPE_DOUBLE, _OPT),
+        ("fast_window_secs", 7, T.TYPE_DOUBLE, _OPT),
+        ("slow_window_secs", 8, T.TYPE_DOUBLE, _OPT),
+        ("fast_samples", 9, T.TYPE_INT64, _OPT),
+        ("slow_samples", 10, T.TYPE_INT64, _OPT),
+        ("alerting", 11, T.TYPE_BOOL, _OPT),
+    ],
     "ReplicaStatus": [
         ("address", 1, T.TYPE_STRING, _OPT),
         ("healthy", 2, T.TYPE_BOOL, _OPT),
@@ -203,6 +228,8 @@ SERVING_MESSAGES = {
         ("revive_uploads", 16, T.TYPE_INT64, _OPT),
         ("prefill_tokens_revived", 17, T.TYPE_INT64, _OPT),
         ("host_drops", 18, T.TYPE_INT64, _OPT),
+        # windowed prefix-hit-rate, passed through from ServerStatus
+        ("prefix_hit_rate_window", 19, T.TYPE_DOUBLE, _OPT),
     ],
     "RouterStatusResponse": [
         ("replicas", 1, T.TYPE_INT32, _OPT),
@@ -240,6 +267,11 @@ SERVING_MESSAGES = {
         ("revive_uploads", 24, T.TYPE_INT64, _OPT),
         ("prefill_tokens_revived", 25, T.TYPE_INT64, _OPT),
         ("host_drops", 26, T.TYPE_INT64, _OPT),
+        # declared SLO objectives evaluated as multi-window burn
+        # rates over the router's time-series ring (one block per
+        # objective; empty when the router has no SLO engine)
+        ("slo", 27, T.TYPE_MESSAGE, _REP,
+         ".elasticdl_tpu.SloObjective"),
     ],
 }
 

@@ -1,5 +1,5 @@
 """EDL401 clean fixture: declared names, non-telemetry receivers,
-and dynamic names are all out of scope."""
+and dynamic names are all out of scope — for counters AND gauges."""
 
 
 class Frontend(object):
@@ -12,6 +12,16 @@ class Frontend(object):
     def complete(self, name):
         self.telemetry.count(name)  # dynamic: the runtime raise owns it
 
+    def depth(self):
+        self.telemetry.gauge("queue_depth", 3)  # declared gauge: clean
+
+    def dynamic_gauge(self, name):
+        self.telemetry.gauge(name, 1)  # dynamic: runtime raise owns it
+
     def tally(self, items):
         # list.count — receiver doesn't spell telemetry
         return items.count("admittd")
+
+    def probe(self, meter):
+        # .gauge through a non-telemetry receiver: out of scope
+        return meter.gauge("whatever", 0)

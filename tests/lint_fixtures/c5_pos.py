@@ -1,4 +1,4 @@
-"""EDL401 triggering fixture: telemetry counter-name typos."""
+"""EDL401 triggering fixture: telemetry counter/gauge-name typos."""
 
 
 class Frontend(object):
@@ -13,6 +13,11 @@ class Frontend(object):
     def reject(self):
         self._telemetry.count("rejectd", 2)  # EDL401 (underscored attr)
 
+    def depth(self):
+        # typo'd gauge: forks a dead TB tag + Prometheus series -> EDL401
+        self.telemetry.gauge("queue_dept", 3)
+
 
 def module_level(router_telemetry):
     router_telemetry.count("breaker_tripz")  # EDL401 (bare receiver)
+    router_telemetry.gauge("healthy_replica", 1)  # EDL401 (gauge typo)

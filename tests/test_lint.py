@@ -100,11 +100,16 @@ def test_c3_negative():
 
 def test_c5_positive():
     findings = lint_file("c5_pos.py")
-    assert rule_ids(findings) == ["EDL401"] * 3, findings
+    assert rule_ids(findings) == ["EDL401"] * 5, findings
     details = {f.detail for f in findings}
-    assert details == {"admittd", "rejectd", "breaker_tripz"}
+    assert details == {"admittd", "rejectd", "breaker_tripz",
+                       "queue_dept", "healthy_replica"}
     scopes = {f.scope for f in findings}
     assert "Frontend.admit" in scopes and "module_level" in scopes
+    # gauge typos report as gauges, counter typos as counters
+    by_detail = {f.detail: f.message for f in findings}
+    assert "gauge" in by_detail["queue_dept"]
+    assert "counter" in by_detail["admittd"]
 
 
 def test_c5_negative():
@@ -113,8 +118,12 @@ def test_c5_negative():
 
 def test_c5_allowed_set_tracks_telemetry_declarations():
     """The rule reads the declared sets from serving/telemetry.py —
-    one source of truth, no drift-prone second list."""
-    from elasticdl_tpu.analysis.telemetry_rules import declared_counters
+    one source of truth, no drift-prone second list (counters AND the
+    gauge set the metrics plane closed)."""
+    from elasticdl_tpu.analysis.telemetry_rules import (
+        declared_counters,
+        declared_gauges,
+    )
     from elasticdl_tpu.serving.telemetry import (
         RouterTelemetry,
         ServingTelemetry,
@@ -125,6 +134,12 @@ def test_c5_allowed_set_tracks_telemetry_declarations():
         | frozenset(RouterTelemetry.COUNTERS)
     )
     assert "admitted" in declared_counters()
+    assert declared_gauges() == (
+        frozenset(ServingTelemetry.GAUGES)
+        | frozenset(RouterTelemetry.GAUGES)
+    )
+    assert "queue_depth" in declared_gauges()
+    assert "healthy_replicas" in declared_gauges()
 
 
 # ------------------------------------------ C6: EDL003 lock-order cycles

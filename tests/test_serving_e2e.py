@@ -882,3 +882,113 @@ def test_shared_prefix_speculative_matches_dense_greedy_32way(rig):
         ))[0]
         assert list(off) == shared_results[i], (i, s)
         assert dense_results[i] == shared_results[i], (i, s)
+
+
+def test_profiled_split_step_matches_offline_int8_32way():
+    """The metrics-plane parity pin: with the per-step decode profiler
+    ENABLED the paged engine runs SPLIT compiled steps (decode|scatter
+    and draft|verify|scatter instead of the fused executables) — the
+    token streams must STILL equal the offline int8 oracle at 32-way
+    paged + shared + speculative + int8 concurrency (mismatched draft,
+    so rollback exercises the split verify path). Also pins that every
+    speculative-path phase actually recorded, and that the /metrics
+    exposition of a live replica parses through the INDEPENDENT
+    text-format parser with the phase histogram present — the
+    acceptance criterion's "live replica serves Prometheus text"."""
+    import urllib.request
+
+    from elasticdl_tpu.observability.promparse import (
+        parse_prometheus_text,
+    )
+
+    int8_params = PARAMS + "; kv_cache_dtype='int8'"
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(
+        load_model_spec_from_module(zoo), mesh=mesh,
+        model_params=int8_params,
+    )
+    state = _state(trainer)
+    draft_trainer = _trainer(seed=321)  # float draft, mismatched
+    draft_state = _state(draft_trainer)
+
+    systems = [[1, 2, 3, 4], [5, 6, 7, 1, 2, 3, 4, 5]]
+    specs = []
+    for i in range(32):
+        prompt = list(systems[i % 2]) + ([1 + i % 3] if i % 4 else [])
+        specs.append({"prompt": prompt, "new": 3 + i % 5})
+
+    cfg = ServingConfig(
+        num_slots=6, queue_capacity=64, kv_paged=True,
+        kv_block_size=4, kv_num_blocks=24, kv_shared=True, draft_k=2,
+        profile=True, metrics_port=0,
+    )
+    server = GenerationServer(
+        trainer, state, cfg, draft=(draft_trainer, draft_state)
+    ).start()
+    try:
+        assert server.engine.profiler is not None
+        # the pool shares the profiler (revive-upload attribution)
+        assert server.engine.kv.profiler is server.engine.profiler
+        stub = ServingStub(build_channel("localhost:%d" % server.port))
+        results, errors = {}, {}
+
+        def call(i, s):
+            try:
+                r = stub.generate(
+                    pb.GenerateRequest(
+                        prompt=s["prompt"], max_new_tokens=s["new"],
+                    ),
+                    timeout=120,
+                )
+                results[i] = list(r.tokens)
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=call, args=(i, s))
+            for i, s in enumerate(specs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == 32
+        st = stub.server_status(pb.ServerStatusRequest(), timeout=10)
+        assert st.kv_cache_dtype == "int8"
+        assert st.draft_proposed > 0
+        assert st.prefix_hit_tokens > 0
+        # the windowed hit-rate signal is live and sane
+        assert 0.0 <= st.prefix_hit_rate_window <= 1.0
+        assert st.kv_blocks_free == st.kv_blocks_total == 24
+
+        snap = server.engine.profiler.snapshot()
+        # every phase the speculative+shared workload exercises
+        for phase in ("prefill", "suffix_tile", "draft",
+                      "verify_commit", "scatter"):
+            assert phase in snap and snap[phase]["count"] > 0, (
+                phase, snap,
+            )
+
+        text = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % server.metrics.port,
+            timeout=10,
+        ).read().decode("utf-8")
+        fams = parse_prometheus_text(text)  # raises on malformation
+        assert "edl_serving_phase_ms" in fams
+        assert "edl_serving_ttft_ms" in fams
+        assert "edl_serving_completed_total" in fams
+        completed = [
+            v for n, lab, v in
+            fams["edl_serving_completed_total"]["samples"]
+        ]
+        assert completed == [32]
+    finally:
+        server.stop()
+
+    for i, s in enumerate(specs):
+        off = np.asarray(autoregressive_generate(
+            trainer, state, np.asarray([s["prompt"]], np.int32),
+            s["new"], use_cache=True,
+        ))[0]
+        assert list(off) == results[i], (i, s)
