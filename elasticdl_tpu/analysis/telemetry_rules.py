@@ -1,35 +1,43 @@
-"""C5 — telemetry counter/gauge-name checker (EDL401).
+"""C5 — telemetry counter/gauge/slow-cause-name checker (EDL401).
 
-The telemetry counter AND gauge sets are CLOSED
-(ServingTelemetry.COUNTERS/GAUGES and RouterTelemetry.COUNTERS/GAUGES
-in serving/telemetry.py): `count()`/`gauge()` raise at runtime on an
+The telemetry counter, gauge AND slow-cause sets are CLOSED
+(ServingTelemetry.COUNTERS/GAUGES/SLOW_CAUSES and
+RouterTelemetry.COUNTERS/GAUGES in serving/telemetry.py):
+`count()`/`gauge()`/`count_slow_cause()` raise at runtime on an
 undeclared name, because a typo like ``count("admittd")`` used to
 silently fork a brand-new counter and under-report the real one
 forever — an observability bug that corrupts dashboards without ever
 failing a test that doesn't read the exact counter back. A typo'd
 gauge is the same bug on the scrape plane: a dead TensorBoard tag and
-a dead Prometheus series, silently.
+a dead Prometheus series, silently. A typo'd slow cause is the same
+bug on the forensics plane: a labeled `slow_cause{cause=...}` series
+nobody's dashboards or the fleet collector's cause taxonomy will ever
+aggregate.
 
 This rule is the STATIC twin of those runtime raises: it flags every
 ``<telemetry-ish receiver>.count("<literal>")`` call site whose string
-literal is not in the declared counter union, and every
+literal is not in the declared counter union, every
 ``<telemetry-ish receiver>.gauge("<literal>")`` not in the declared
-gauge union, so the typo fails `make lint` before any drill has to hit
-the code path.
+gauge union, and every
+``<telemetry-ish receiver>.count_slow_cause("<literal>")`` not in the
+declared cause union (observability/forensics.py CAUSES, re-exported
+by ServingTelemetry.SLOW_CAUSES), so the typo fails `make lint`
+before any drill has to hit the code path.
 
-FLAGGED: attribute calls ``X.count("name")`` / ``X.gauge("name")``
-where the receiver's dotted spelling mentions ``telemetry``
-(``self.telemetry.count``, ``self._telemetry.gauge``,
-``router.telemetry.count`` ...) and the first argument is a string
-literal not in the matching declared set.
+FLAGGED: attribute calls ``X.count("name")`` / ``X.gauge("name")`` /
+``X.count_slow_cause("name")`` where the receiver's dotted spelling
+mentions ``telemetry`` (``self.telemetry.count``,
+``self._telemetry.gauge``, ``router.telemetry.count`` ...) and the
+first argument is a string literal not in the matching declared set.
 
 NOT flagged: non-literal names (the runtime raise owns those),
 receivers that don't spell ``telemetry`` (list.count etc.), and call
 sites with no arguments.
 
 The declared sets are read from elasticdl_tpu.serving.telemetry at
-rule run time (stdlib-only import), so declaring a new counter/gauge
-there is the single source of truth — no second list to update here.
+rule run time (stdlib-only import), so declaring a new counter/gauge/
+cause there is the single source of truth — no second list to update
+here.
 """
 
 import ast
@@ -73,9 +81,18 @@ def declared_gauges():
     )
 
 
+def declared_slow_causes():
+    """The closed slow-cause union (forensics.CAUSES, re-exported as
+    ServingTelemetry.SLOW_CAUSES) — same import, same contract."""
+    from elasticdl_tpu.serving.telemetry import ServingTelemetry
+
+    return frozenset(ServingTelemetry.SLOW_CAUSES)
+
+
 class _CounterVisitor(ast.NodeVisitor):
     #: method name -> (allowed-set key, series noun in the message)
-    _CHECKED = {"count": "counter", "gauge": "gauge"}
+    _CHECKED = {"count": "counter", "gauge": "gauge",
+                "count_slow_cause": "slow cause"}
 
     def __init__(self, path, allowed):
         self.path = path
@@ -133,6 +150,7 @@ class TelemetryCounterRule(Rule):
         visitor = _CounterVisitor(path, {
             "counter": declared_counters(),
             "gauge": declared_gauges(),
+            "slow cause": declared_slow_causes(),
         })
         visitor.visit(tree)
         return visitor.findings

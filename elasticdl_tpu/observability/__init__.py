@@ -20,13 +20,28 @@ Modules:
 * slo        — declared objectives evaluated as multi-window burn
                rates over the ring (`SloSpec`, `BurnRateEngine`)
 * promparse  — INDEPENDENT text-format parser (shares nothing with the
-               renderer) for drills/tests to round-trip expositions
+               renderer) for drills/tests to round-trip expositions,
+               OpenMetrics exemplars included
 * dump       — CLI merging per-process span exports into one trace
-               (``python -m elasticdl_tpu.observability.dump``)
+               (``python -m elasticdl_tpu.observability.dump``), with
+               per-service drop accounting in the artifact
+* forensics  — per-request cause attribution: `attribute()` folds a
+               span tree into an ordered latency breakdown + a
+               dominant cause from the closed `CAUSES` taxonomy
+* collector  — the fleet collector
+               (``python -m elasticdl_tpu.observability.collector``):
+               scrape /metrics fleet-wide, re-evaluate declared SLOs,
+               join burning buckets to exemplar traces, attribute
+               them, and emit the incident report
 
 Design doc: docs/designs/observability.md.
 """
 
+from elasticdl_tpu.observability.forensics import (  # noqa: F401
+    CAUSES,
+    attribute,
+    cause_histogram,
+)
 from elasticdl_tpu.observability.histogram import (  # noqa: F401
     LogLinearHistogram,
     percentiles,

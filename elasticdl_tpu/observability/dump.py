@@ -48,9 +48,31 @@ def merge_dir(trace_dir):
             "pid": doc.get("pid", 0),
             "spans": len(doc.get("spans", ())),
             "dropped": doc.get("dropped", 0),
+            # the two-tier recorder's other loss accounting: retained-
+            # tier evictions and healthy roots sampled out (tail-based
+            # retention, tracing.py) — zero on pre-tier exports
+            "retained": doc.get("retained", 0),
+            "retained_dropped": doc.get("retained_dropped", 0),
+            "sampled_out": doc.get("sampled_out", 0),
         })
         spans.extend(doc.get("spans", ()))
     return spans, meta
+
+
+def drops_by_service(meta):
+    """{service: spans irrecoverably dropped} across the merged
+    exports (ring drop-oldest + retained-tier evictions; sampled-out
+    healthy roots are NOT drops — they were declined, not lost). A
+    forensics verdict over a service with nonzero drops is evidence-
+    incomplete and must say so rather than pose as the whole story."""
+    out = {}
+    for m in meta:
+        if "error" in m:
+            continue
+        d = int(m.get("dropped", 0)) + int(m.get("retained_dropped", 0))
+        if d:
+            out[m["service"]] = out.get(m["service"], 0) + d
+    return out
 
 
 def main(argv=None):
@@ -68,17 +90,34 @@ def main(argv=None):
               file=sys.stderr)
         return 2
     spans, meta = merge_dir(args.dir)
+    drops = drops_by_service(meta)
+    doc = chrome_trace(spans)
+    # Chrome-trace "otherData" rides unknown keys through Perfetto
+    # untouched: the merged evidence accounting lives IN the artifact,
+    # so a trace file can say its own evidence is incomplete
+    doc["otherData"] = {
+        "exports": meta,
+        "drops_by_service": drops,
+        "evidence_complete": not drops
+        and not any("error" in m for m in meta),
+    }
     with open(args.out, "w") as f:
-        json.dump(chrome_trace(spans), f)
-    dropped = sum(m.get("dropped", 0) for m in meta)
+        json.dump(doc, f)
     errors = [m for m in meta if "error" in m]
     print(
         "dump: merged %d spans across %d traces from %d exports -> %s"
-        " (%d dropped ring entries%s)"
+        " (%d unreadable exports)"
         % (len(spans), len(group_by_trace(spans)),
-           len(meta) - len(errors), args.out, dropped,
-           "; %d unreadable exports" % len(errors) if errors else "")
+           len(meta) - len(errors), args.out, len(errors))
     )
+    if drops:
+        print(
+            "dump: EVIDENCE INCOMPLETE — spans dropped before export: "
+            + ", ".join("%s=%d" % (svc, n)
+                        for svc, n in sorted(drops.items()))
+        )
+    else:
+        print("dump: evidence complete (zero recorder drops)")
     return 0
 
 

@@ -23,9 +23,23 @@ Thread-safety: none here, by design — every histogram in the system
 lives behind its owner's telemetry lock (serving/telemetry.py), and
 the bench records from a single aggregation thread. Keeping the lock
 out of the hot `record` keeps the overhead bound honest.
+
+EXEMPLARS: a histogram can answer "p99 is 1.2 s" but not "WHICH
+request" — the gap between a burning SLO gauge and a trace an operator
+can open. `record(value, trace_id=...)` optionally attaches a
+per-bucket exemplar (trace_id, value, unix_ts), bounded to
+``EXEMPLAR_SLOTS`` buckets with the HIGHEST-value buckets winning (the
+tail is what forensics wants; nobody debugs the p10 bucket) and the
+max-value sample winning within a bucket — which also makes the merge
+associative, so exemplars survive bucket-addition aggregation the same
+way counts do. The wire form (`exemplars_wire`/`from_counts`) rides
+next to `to_counts()` and the Prometheus renderer emits OpenMetrics
+exemplar syntax on `_bucket` lines; observability/promparse.py
+validates it independently.
 """
 
 import math
+import time
 
 #: smallest distinguishable value (0.01 => 10 us when recording ms)
 RESOLUTION = 0.01
@@ -36,6 +50,10 @@ _HALF = SUBBUCKETS // 2
 #: decades above the linear range (covers ~2.8 hours in ms)
 _DECADES = 24
 NUM_BUCKETS = SUBBUCKETS + _DECADES * _HALF
+#: max buckets carrying an exemplar per histogram; the HIGHEST-value
+#: buckets win a slot (tail forensics), the max-value sample wins
+#: within a bucket (merge stays associative)
+EXEMPLAR_SLOTS = 16
 
 
 def bucket_index(value):
@@ -74,7 +92,7 @@ class LogLinearHistogram(object):
     fidelity (percentiles of merged counts, never averages of
     percentiles)."""
 
-    __slots__ = ("counts", "count", "sum", "min", "max")
+    __slots__ = ("counts", "count", "sum", "min", "max", "exemplars")
 
     def __init__(self):
         self.counts = [0] * NUM_BUCKETS
@@ -82,21 +100,48 @@ class LogLinearHistogram(object):
         self.sum = 0.0
         self.min = math.inf
         self.max = 0.0
+        #: bucket index -> (trace_id, value, unix_ts); bounded to
+        #: EXEMPLAR_SLOTS entries, highest-index buckets win a slot
+        self.exemplars = {}
 
-    def record(self, value):
+    def record(self, value, trace_id=None, ts=None):
         value = float(value)
         if not 0.0 <= value < math.inf:  # negative/NaN/inf: refuse
             return
-        self.counts[bucket_index(value)] += 1
+        idx = bucket_index(value)
+        self.counts[idx] += 1
         self.count += 1
         self.sum += value
         if value < self.min:
             self.min = value
         if value > self.max:
             self.max = value
+        if trace_id:
+            self._note_exemplar(
+                idx, str(trace_id), value,
+                time.time() if ts is None else float(ts),
+            )
+
+    def _note_exemplar(self, idx, trace_id, value, ts):
+        """Keep at most EXEMPLAR_SLOTS exemplar-carrying buckets, the
+        HIGHEST-value buckets winning a slot and the max-value sample
+        winning within a bucket — the ordering that makes merge
+        associative and keeps the p99 tail covered."""
+        cur = self.exemplars.get(idx)
+        if cur is not None:
+            if value >= cur[1]:
+                self.exemplars[idx] = (trace_id, value, ts)
+            return
+        if len(self.exemplars) >= EXEMPLAR_SLOTS:
+            low = min(self.exemplars)
+            if idx <= low:
+                return  # a lower bucket never evicts a higher one
+            del self.exemplars[low]
+        self.exemplars[idx] = (trace_id, value, ts)
 
     def merge(self, other):
-        """Fold `other` in (elementwise bucket addition)."""
+        """Fold `other` in (elementwise bucket addition); exemplars
+        merge keep-max-per-bucket under the same slot bound."""
         for i, c in enumerate(other.counts):
             if c:
                 self.counts[i] += c
@@ -105,6 +150,8 @@ class LogLinearHistogram(object):
         if other.count:
             self.min = min(self.min, other.min)
             self.max = max(self.max, other.max)
+        for idx, (tid, value, ts) in other.exemplars.items():
+            self._note_exemplar(idx, tid, value, ts)
         return self
 
     def percentile(self, q):
@@ -140,12 +187,22 @@ class LogLinearHistogram(object):
                 last = i + 1
         return self.counts[:last]
 
+    def exemplars_wire(self):
+        """Exemplar wire form riding next to to_counts():
+        {bucket_index: [trace_id, value, unix_ts]} — JSON-safe (lists,
+        not tuples; from_counts re-accepts string keys a JSON
+        round-trip produces)."""
+        return {
+            idx: [tid, value, ts]
+            for idx, (tid, value, ts) in self.exemplars.items()
+        }
+
     @classmethod
-    def from_counts(cls, counts):
-        """Rebuild from wire-form counts. min/max/sum degrade to
-        bucket-midpoint estimates (bounded by the scheme's relative
-        error) — good enough for percentile math, which only needs
-        the counts."""
+    def from_counts(cls, counts, exemplars=None):
+        """Rebuild from wire-form counts (+ optional exemplar map).
+        min/max/sum degrade to bucket-midpoint estimates (bounded by
+        the scheme's relative error) — good enough for percentile
+        math, which only needs the counts."""
         h = cls()
         for i, c in enumerate(counts):
             c = int(c)
@@ -158,6 +215,10 @@ class LogLinearHistogram(object):
             h.sum += mid * c
             h.min = min(h.min, mid)
             h.max = max(h.max, mid)
+        for idx, ex in (exemplars or {}).items():
+            tid, value, ts = ex
+            h._note_exemplar(int(idx), str(tid), float(value),
+                             float(ts))
         return h
 
 

@@ -82,6 +82,32 @@ def add_counts(a, b):
     return out
 
 
+def merge_exemplars(a, b):
+    """Merge two exemplar maps ({bucket_index: (trace_id, value,
+    unix_ts)}), keeping the max-value sample per bucket — the same
+    associative rule LogLinearHistogram uses, so fleet-merged windows
+    still name a real trace per tail bucket."""
+    out = {int(k): tuple(v) for k, v in a.items()}
+    for k, ex in b.items():
+        k = int(k)
+        ex = tuple(ex)
+        cur = out.get(k)
+        if cur is None or ex[1] >= cur[1]:
+            out[k] = ex
+    return out
+
+
+def _sub_exemplars(cur, base):
+    """Exemplars NEW to this window: entries of `cur` absent from (or
+    changed since) `base` — the exemplar twin of the bucket-delta
+    subtraction, so a window only names traces recorded inside it."""
+    out = {}
+    for k, ex in cur.items():
+        if tuple(base.get(k, ())) != tuple(ex):
+            out[k] = tuple(ex)
+    return out
+
+
 def _sub_counts(cur, base):
     """Trimmed `cur - base` bucket deltas (cur is cumulative, so every
     delta is >= 0 for well-formed inputs; negative deltas clamp to 0 —
@@ -105,6 +131,8 @@ def merge_window_deltas(a, b):
         "counters": dict(a.get("counters", {})),
         "gauges": dict(a.get("gauges", {})),
         "hists": {k: list(v) for k, v in a.get("hists", {}).items()},
+        "exemplars": {k: dict(v)
+                      for k, v in a.get("exemplars", {}).items()},
     }
     for name, v in b.get("counters", {}).items():
         out["counters"][name] = out["counters"].get(name, 0) + v
@@ -113,6 +141,10 @@ def merge_window_deltas(a, b):
     for name, counts in b.get("hists", {}).items():
         out["hists"][name] = add_counts(
             out["hists"].get(name, []), counts
+        )
+    for name, exes in b.get("exemplars", {}).items():
+        out["exemplars"][name] = merge_exemplars(
+            out["exemplars"].get(name, {}), exes
         )
     return out
 
@@ -142,8 +174,9 @@ class TimeSeriesRing(object):
         self._windows = deque()
         self.dropped = 0  # closed windows evicted by the bound
         self._t0 = clock()
-        self._base = {"counters": {}, "hists": {}}
-        self._last = {"counters": {}, "gauges": {}, "hists": {}}
+        self._base = {"counters": {}, "hists": {}, "exemplars": {}}
+        self._last = {"counters": {}, "gauges": {}, "hists": {},
+                      "exemplars": {}}
         self._seen = False  # any observation since the last close
 
     def due(self, now=None):
@@ -153,10 +186,13 @@ class TimeSeriesRing(object):
         return now - self._t0 >= self.interval_secs
 
     def observe(self, counters=None, gauges=None, hists=None,
-                now=None, roll=True):
+                exemplars=None, now=None, roll=True):
         """One cumulative observation; closes the open window when the
         interval has elapsed (roll=True). Values are copied — callers
-        may hand live dicts/lists."""
+        may hand live dicts/lists. `exemplars` is {hist_name:
+        {bucket_index: (trace_id, value, unix_ts)}} — the histogram's
+        exemplars_wire() shape — differenced at window boundaries like
+        the bucket counts."""
         now = self._clock() if now is None else now
         if counters:
             self._last["counters"].update(counters)
@@ -165,9 +201,31 @@ class TimeSeriesRing(object):
         if hists:
             for name, counts in hists.items():
                 self._last["hists"][name] = list(counts)
+        if exemplars:
+            for name, exes in exemplars.items():
+                self._last["exemplars"][name] = {
+                    int(k): tuple(v) for k, v in exes.items()
+                }
         self._seen = True
         if roll and now - self._t0 >= self.interval_secs:
             self._close(now)
+
+    def rebase(self, now=None):
+        """Restart the open window from the CURRENT cumulative state
+        without emitting a window: the next close deltas against now,
+        not against zero. The fleet collector's first scrape of a
+        long-lived process calls this so lifetime totals never
+        masquerade as a window's worth of traffic."""
+        now = self._clock() if now is None else now
+        self._base = {
+            "counters": dict(self._last["counters"]),
+            "hists": {k: list(v)
+                      for k, v in self._last["hists"].items()},
+            "exemplars": {k: dict(v)
+                          for k, v in self._last["exemplars"].items()},
+        }
+        self._t0 = now
+        self._seen = False
 
     def flush(self, now=None):
         """Force-close the open partial window (even shorter than the
@@ -190,6 +248,12 @@ class TimeSeriesRing(object):
                 name: _sub_counts(counts, base["hists"].get(name, []))
                 for name, counts in self._last["hists"].items()
             },
+            "exemplars": {
+                name: _sub_exemplars(
+                    exes, base["exemplars"].get(name, {})
+                )
+                for name, exes in self._last["exemplars"].items()
+            },
         }
         self._windows.append(window)
         if len(self._windows) > self.capacity:
@@ -199,6 +263,8 @@ class TimeSeriesRing(object):
             "counters": dict(self._last["counters"]),
             "hists": {k: list(v)
                       for k, v in self._last["hists"].items()},
+            "exemplars": {k: dict(v)
+                          for k, v in self._last["exemplars"].items()},
         }
         self._t0 = now
         self._seen = False
@@ -231,6 +297,17 @@ class TimeSeriesRing(object):
                 out = add_counts(out, counts)
         return out
 
+    def merged_exemplars(self, name, horizon_secs=None, now=None):
+        """Max-value-per-bucket exemplar merge over the trailing
+        horizon — the traces the SLO engine's bad buckets can be
+        joined back to."""
+        out = {}
+        for w in self.windows(horizon_secs, now):
+            exes = w.get("exemplars", {}).get(name)
+            if exes:
+                out = merge_exemplars(out, exes)
+        return out
+
     def pending_counter(self, name):
         """The open partial window's delta for one counter (live view;
         the window is not closed)."""
@@ -254,6 +331,8 @@ class TimeSeriesRing(object):
             "gauges": dict(self._last["gauges"]),
             "hists": {k: list(v)
                       for k, v in self._last["hists"].items()},
+            "exemplars": {k: dict(v)
+                          for k, v in self._last["exemplars"].items()},
         }
 
 
@@ -299,6 +378,14 @@ def counter_family(name, help_text, value, labels=None):
     return (name, "counter", help_text, [("", labels or {}, value)])
 
 
+def labeled_counter_family(name, help_text, samples):
+    """A counter family with several labeled series (e.g. one
+    slow-cause counter per `cause` label). `samples` =
+    [(labels, value)]; `name` must end in `_total`."""
+    return (name, "counter", help_text,
+            [("", labels or {}, v) for labels, v in samples])
+
+
 def gauge_family(name, help_text, samples):
     """`samples` = [(labels, value)] — one family may carry several
     labeled series (e.g. one burn-rate gauge per SLO x window)."""
@@ -309,15 +396,22 @@ def gauge_family(name, help_text, samples):
 def hist_family(name, help_text, series):
     """A histogram family from trimmed log-linear bucket counts.
 
-    `series` = [(labels, counts, sum_ms_or_None)] — counts in the
-    shared scheme's wire form. Renders cumulative `_bucket` samples at
-    every NON-EMPTY bucket's upper bound plus the mandatory `+Inf`,
-    `_sum` (estimated from bucket midpoints when not supplied) and
-    `_count`. Subsetting the bounds is valid Prometheus — cumulative
-    counts stay monotone, and the shared scheme makes any two
-    expositions comparable bucket-for-bucket."""
+    `series` = [(labels, counts, sum_ms_or_None)] or
+    [(labels, counts, sum_ms_or_None, exemplars)] — counts in the
+    shared scheme's wire form, `exemplars` the histogram's
+    {bucket_index: (trace_id, value, unix_ts)} map. Renders cumulative
+    `_bucket` samples at every NON-EMPTY bucket's upper bound plus the
+    mandatory `+Inf`, `_sum` (estimated from bucket midpoints when not
+    supplied) and `_count`; a bucket with an exemplar renders it in
+    OpenMetrics exemplar syntax after the sample value
+    (``... # {trace_id="..."} 12.3 1722800000``). Subsetting the
+    bounds is valid Prometheus — cumulative counts stay monotone, and
+    the shared scheme makes any two expositions comparable
+    bucket-for-bucket."""
     samples = []
-    for labels, counts, sum_ms in series:
+    for entry in series:
+        labels, counts, sum_ms = entry[0], entry[1], entry[2]
+        exemplars = entry[3] if len(entry) > 3 else None
         cum = 0
         est_sum = 0.0
         for i, c in enumerate(counts):
@@ -330,7 +424,13 @@ def hist_family(name, help_text, series):
             est_sum += (lo + hi) / 2.0 * c
             lab = dict(labels or {})
             lab["le"] = _fmt_value(hi)
-            samples.append(("_bucket", lab, cum))
+            ex = (exemplars or {}).get(i)
+            if ex is not None:
+                tid, value, ts = ex
+                samples.append(("_bucket", lab, cum,
+                                (str(tid), float(value), float(ts))))
+            else:
+                samples.append(("_bucket", lab, cum))
         lab = dict(labels or {})
         lab["le"] = "+Inf"
         samples.append(("_bucket", lab, cum))
@@ -342,7 +442,9 @@ def hist_family(name, help_text, series):
 
 def render_prometheus(families):
     """Prometheus text format 0.0.4 from [(name, type, help, samples)]
-    families; samples are [(suffix, labels, value)]."""
+    families; samples are [(suffix, labels, value)] or — on histogram
+    `_bucket` lines only — [(suffix, labels, value, (trace_id,
+    ex_value, ex_unix_ts))], rendered as an OpenMetrics exemplar."""
     lines = []
     for name, mtype, help_text, samples in families:
         base = _sanitize(name)
@@ -351,11 +453,19 @@ def render_prometheus(families):
             str(help_text).replace("\\", "\\\\").replace("\n", "\\n"),
         ))
         lines.append("# TYPE %s %s" % (base, mtype))
-        for suffix, labels, value in samples:
-            lines.append("%s%s%s %s" % (
+        for sample in samples:
+            suffix, labels, value = sample[0], sample[1], sample[2]
+            line = "%s%s%s %s" % (
                 base, _sanitize(suffix) if suffix else "",
                 _fmt_labels(labels), _fmt_value(value),
-            ))
+            )
+            if len(sample) > 3 and sample[3] is not None:
+                tid, ex_value, ex_ts = sample[3]
+                line += " # %s %s %s" % (
+                    _fmt_labels({"trace_id": tid}),
+                    _fmt_value(ex_value), _fmt_value(ex_ts),
+                )
+            lines.append(line)
     return "\n".join(lines) + "\n"
 
 

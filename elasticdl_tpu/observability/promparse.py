@@ -16,7 +16,14 @@ line and VALIDATES structure as it goes:
 * sample values parse as floats (`+Inf`/`-Inf`/`NaN` included);
 * per histogram series (same non-`le` labels): `_bucket` cumulative
   counts are monotone in `le`, a `+Inf` bucket exists, and `_count`
-  equals it.
+  equals it;
+* OpenMetrics exemplars (``... # {trace_id="..."} 12.3 1722800000``)
+  are accepted ONLY on histogram `_bucket` samples, must carry at
+  least one well-formed label, a finite value, and — on a finite-`le`
+  bucket — a value not above that bucket's upper bound (an exemplar
+  must be a sample the bucket could actually have counted). Parsed
+  exemplars land in each family's ``exemplars`` list as
+  ``(metric_name, labels, exemplar_labels, value, unix_ts_or_None)``.
 
 Raises ValueError on ANY violation — a parse is a pass/fail check, not
 a best-effort scrape.
@@ -94,10 +101,63 @@ def _parse_labels(text, lineno):
     return labels
 
 
+def _split_exemplar(line):
+    """Split a SAMPLE line at its exemplar separator — the first `#`
+    outside quoted label values — returning (main, exemplar_text or
+    None). A `#` inside a quoted label value never splits."""
+    in_quotes = False
+    i = 0
+    while i < len(line):
+        ch = line[i]
+        if ch == "\\" and in_quotes:
+            i += 2
+            continue
+        if ch == '"':
+            in_quotes = not in_quotes
+        elif ch == "#" and not in_quotes:
+            return line[:i].rstrip(), line[i + 1:].strip()
+        i += 1
+    return line, None
+
+
+def _parse_exemplar(text, lineno):
+    """`{labels} value [unix_ts]` -> (labels, value, ts_or_None)."""
+    if not text.startswith("{"):
+        raise ValueError(
+            "line %d: exemplar must start with a label set, got %r"
+            % (lineno, text)
+        )
+    close = text.find("}")
+    if close < 0:
+        raise ValueError(
+            "line %d: unterminated exemplar label set" % lineno
+        )
+    labels = _parse_labels(text[1:close], lineno)
+    if not labels:
+        raise ValueError(
+            "line %d: exemplar has no labels" % lineno
+        )
+    rest = text[close + 1:].split()
+    if not rest or len(rest) > 2:
+        raise ValueError(
+            "line %d: exemplar needs `value [timestamp]`, got %r"
+            % (lineno, text[close + 1:])
+        )
+    value = _parse_float(rest[0])
+    if not (value == value and abs(value) != math.inf):
+        raise ValueError(
+            "line %d: exemplar value %r is not finite"
+            % (lineno, rest[0])
+        )
+    ts = _parse_float(rest[1]) if len(rest) == 2 else None
+    return labels, value, ts
+
+
 def parse_prometheus_text(text):
     """Parse + validate one exposition. Returns
     {family: {"type": ..., "help": ..., "samples":
-    [(metric_name, labels_dict, value)]}}."""
+    [(metric_name, labels_dict, value)], "exemplars":
+    [(metric_name, labels_dict, exemplar_labels, value, ts)]}}."""
     families = {}
     current = None
     for lineno, raw in enumerate(text.splitlines(), 1):
@@ -112,7 +172,8 @@ def parse_prometheus_text(text):
                     "line %d: bad family name %r" % (lineno, name)
                 )
             families.setdefault(
-                name, {"type": None, "help": None, "samples": []}
+                name, {"type": None, "help": None, "samples": [],
+                       "exemplars": []}
             )["help"] = help_text
             continue
         if line.startswith("# TYPE "):
@@ -126,14 +187,16 @@ def parse_prometheus_text(text):
                     "line %d: unknown type %r" % (lineno, mtype)
                 )
             fam = families.setdefault(
-                name, {"type": None, "help": None, "samples": []}
+                name, {"type": None, "help": None, "samples": [],
+                       "exemplars": []}
             )
             fam["type"] = mtype
             current = name
             continue
         if line.startswith("#"):
             continue  # comment
-        # sample line: name[{labels}] value [timestamp]
+        # sample line: name[{labels}] value [ts] [# exemplar]
+        line, exemplar_text = _split_exemplar(line)
         brace = line.find("{")
         if brace >= 0:
             name = line[:brace]
@@ -153,6 +216,27 @@ def parse_prometheus_text(text):
         value = _parse_float(rest[0])
         fam = _owning_family(families, name, current, lineno)
         families[fam]["samples"].append((name, labels, value))
+        if exemplar_text is not None:
+            if (families[fam]["type"] != "histogram"
+                    or not name.endswith("_bucket")):
+                raise ValueError(
+                    "line %d: exemplar on %r — exemplars are only "
+                    "valid on histogram _bucket samples"
+                    % (lineno, name)
+                )
+            ex_labels, ex_value, ex_ts = _parse_exemplar(
+                exemplar_text, lineno
+            )
+            le = _parse_float(labels.get("le", "+Inf"))
+            if not math.isinf(le) and ex_value > le:
+                raise ValueError(
+                    "line %d: exemplar value %r above the bucket "
+                    "bound le=%r — the bucket could never have "
+                    "counted it" % (lineno, ex_value, le)
+                )
+            families[fam]["exemplars"].append(
+                (name, labels, ex_labels, ex_value, ex_ts)
+            )
     _validate(families)
     return families
 

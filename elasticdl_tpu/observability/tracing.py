@@ -30,8 +30,26 @@ on these names, so stick to them.
 Recording is ALWAYS on and bounded: finished spans land in a
 lock-guarded ring buffer (drop-OLDEST on overflow, with a ``dropped``
 counter — a traced process can never grow without bound, and the drop
-is visible). Export to disk happens only when ``EDL_TRACE_DIR`` is
-set: each process writes ``spans-<service>-<pid>.json`` there
+is visible).
+
+TAIL-BASED RETENTION: the ring alone has a forensic blind spot — under
+pressure, drop-oldest evicts exactly the traces that explain a latency
+spike, because slow requests are by definition OLD by the time anyone
+looks. The recorder therefore runs TWO tiers: classifier hooks
+(``add_classifier``) judge each finished span — ``True`` moves the
+span AND every recorded span of its trace into a separately-bounded
+RETAINED tier (and pins later-finishing spans of that trace there
+too), ``False`` marks a healthy root that is kept only with
+probability ``sample_rate`` (below it, the root and its trace's spans
+leave the ring — counted in ``sampled_out``), ``None`` means "not
+mine" and falls through to the next hook / the plain ring. The
+router installs a hook judging its request roots against the declared
+SLO thresholds (RouterConfig.slo_*), the replica one judging `serve`
+spans against each request's OWN deadline — retention policy reuses
+the thresholds the system already declares, no new config surface.
+With no hooks installed, behavior is exactly the PR 6 single ring.
+
+Export to disk happens only when ``EDL_TRACE_DIR`` is set: each process writes ``spans-<service>-<pid>.json`` there
 (explicitly via ``flush()`` on clean shutdown, plus an atexit
 backstop), and ``python -m elasticdl_tpu.observability.dump`` merges
 every per-process export into one Chrome-trace JSON that loads in
@@ -47,6 +65,7 @@ process's spans.
 import atexit
 import json
 import os
+import random
 import threading
 import time
 from collections import deque
@@ -54,6 +73,10 @@ from collections import deque
 TRACE_DIR_ENV = "EDL_TRACE_DIR"
 
 _DEFAULT_CAPACITY = 4096
+#: the retained tier's own bound (slow/failed traces); deliberately
+#: smaller than the ring — retention is for the tail, not a second
+#: copy of everything
+_DEFAULT_RETAINED_CAPACITY = 2048
 
 
 def new_trace_id():
@@ -144,13 +167,49 @@ class SpanRecorder(object):
     section per REQUEST, not per token."""
 
     def __init__(self, service="proc", capacity=_DEFAULT_CAPACITY,
-                 clock=time.time):
+                 clock=time.time,
+                 retained_capacity=_DEFAULT_RETAINED_CAPACITY,
+                 sample_rate=1.0, seed=None):
         self.service = service
         self.capacity = int(capacity)
         self.clock = clock
         self.dropped = 0
         self._lock = threading.Lock()
         self._spans = deque()
+        # tail-based retention: verdict hooks + the retained tier
+        self.retained_capacity = int(retained_capacity)
+        self.retained_dropped = 0
+        self.sampled_out = 0
+        self.sample_rate = float(sample_rate)
+        self._retained = deque()
+        self._retained_traces = set()
+        self._classifiers = []
+        self._rand = random.Random(seed)
+
+    def add_classifier(self, fn):
+        """Register a verdict hook `fn(span) -> True | False | None`:
+        True = retain the span's whole trace in the retained tier,
+        False = healthy root (probabilistic sample), None = not this
+        hook's span (fall through). Hooks run under the recorder lock
+        at finish time — keep them pure and cheap. Idempotent per
+        function object."""
+        with self._lock:
+            if fn not in self._classifiers:
+                self._classifiers.append(fn)
+        return fn
+
+    def remove_classifier(self, fn):
+        """Unregister a hook (no-op if absent) — lifecycle owners
+        (e.g. a stopping Router) drop their hook so a long-lived test
+        process never accumulates stale verdicts."""
+        with self._lock:
+            self._classifiers = [
+                f for f in self._classifiers if f != fn
+            ]
+
+    def clear_classifiers(self):
+        with self._lock:
+            self._classifiers = []
 
     def start_span(self, name, trace_id=None, parent_span_id="",
                    **attrs):
@@ -159,12 +218,64 @@ class SpanRecorder(object):
         return Span(self, name, trace_id or new_trace_id(),
                     parent_span_id, attrs, self.clock())
 
+    def _verdict_locked(self, span):
+        """First non-None hook verdict, or None. A hook that raises is
+        treated as abstaining — observability must never take the
+        serving path down with it."""
+        for fn in self._classifiers:
+            try:
+                verdict = fn(span)
+            except Exception:  # noqa: BLE001 - hooks must not crash us
+                verdict = None
+            if verdict is not None:
+                return bool(verdict)
+        return None
+
+    def _retain_locked(self, span):
+        """Move `span` — and every already-recorded span of its trace —
+        into the retained tier, pinning the trace so stragglers follow.
+        The tier is bounded drop-oldest with its own counter."""
+        self._retained_traces.add(span.trace_id)
+        moved = [s for s in self._spans
+                 if s.trace_id == span.trace_id]
+        if moved:
+            self._spans = deque(
+                s for s in self._spans
+                if s.trace_id != span.trace_id
+            )
+        for s in moved:
+            self._retained.append(s)
+        self._retained.append(span)
+        while len(self._retained) > self.retained_capacity:
+            victim = self._retained.popleft()
+            self.retained_dropped += 1
+            if not any(s.trace_id == victim.trace_id
+                       for s in self._retained):
+                self._retained_traces.discard(victim.trace_id)
+
     def _finish(self, span, status):
         with self._lock:
             if span.end is not None:  # idempotent terminal
                 return
             span.end = self.clock()
             span.status = status
+            if span.trace_id in self._retained_traces:
+                self._retain_locked(span)
+                return
+            verdict = self._verdict_locked(span)
+            if verdict is True:
+                self._retain_locked(span)
+                return
+            if verdict is False and self._rand.random() >= self.sample_rate:
+                # healthy root sampled OUT: its trace's spans leave the
+                # ring too — pressure relief is the whole point
+                before = len(self._spans)
+                self._spans = deque(
+                    s for s in self._spans
+                    if s.trace_id != span.trace_id
+                )
+                self.sampled_out += 1 + (before - len(self._spans))
+                return
             self._spans.append(span)
             while len(self._spans) > self.capacity:
                 self._spans.popleft()
@@ -172,26 +283,38 @@ class SpanRecorder(object):
 
     def __len__(self):
         with self._lock:
-            return len(self._spans)
+            return len(self._retained) + len(self._spans)
 
     def snapshot(self):
+        """Every recorded span, retained tier first (it holds the
+        oldest surviving evidence)."""
         with self._lock:
-            return list(self._spans)
+            return list(self._retained) + list(self._spans)
 
     def clear(self):
         with self._lock:
             self._spans.clear()
+            self._retained.clear()
+            self._retained_traces.clear()
             self.dropped = 0
+            self.retained_dropped = 0
+            self.sampled_out = 0
 
     def export(self):
         """The on-disk per-process document the dump tool merges."""
         with self._lock:
-            spans = list(self._spans)
+            spans = list(self._retained) + list(self._spans)
+            retained = len(self._retained)
             dropped = self.dropped
+            retained_dropped = self.retained_dropped
+            sampled_out = self.sampled_out
         return {
             "service": self.service,
             "pid": os.getpid(),
             "dropped": dropped,
+            "retained": retained,
+            "retained_dropped": retained_dropped,
+            "sampled_out": sampled_out,
             "spans": [s.to_dict() for s in spans],
         }
 

@@ -250,6 +250,16 @@ class ContinuousBatchingEngine(object):
         self.draft_k = 0        # speculative decode off (paged engine
         self.draft_proposed = 0  # overrides when a draft is seated)
         self.draft_accepted = 0
+        # cumulative wall ms this engine has spent inside insert()
+        # (prefill / suffix tile / draft prefill) — the scheduler
+        # advances it; the servicer stamps it at admission so seating
+        # can report how long OTHER requests' prefills held the
+        # single-threaded scheduler while this one waited
+        # (forensics: prefill_blocked_by_other). Written only by the
+        # scheduler thread, read racily by handler threads — a stale
+        # read under-reports blocking by at most one prefill, which
+        # the attribution tolerates by design.
+        self.prefill_busy_ms = 0.0
 
         from elasticdl_tpu.api.quantization import is_quantized
 
@@ -831,8 +841,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         if decoding:
             # reserve-or-raise BEFORE any compute; the scheduler
             # checks can_seat first, so raising here is a bug guard
+            revived_before = self.kv.allocator.blocks_revived
+            seat_t0 = time.perf_counter()
             shared = self.kv.seat(slot, request.prompt,
                                   p + request.max_new_tokens - 1)
+            revived = (self.kv.allocator.blocks_revived
+                       - revived_before)
+            if revived and hasattr(request, "trace_event"):
+                # the seat revived a spilled chain: the upload IS the
+                # seat's cost here, and forensics.attribute() reads
+                # this event to split revive_upload out of prefill_own
+                request.trace_event(
+                    "revive_upload",
+                    ms=round((time.perf_counter() - seat_t0)
+                             * 1000.0, 3),
+                    tokens=revived * self.kv.block_size,
+                )
         if decoding and shared:
             first = self._insert_shared(slot, request, shared)
         else:

@@ -86,6 +86,11 @@ def parse_serving_args(args=None):
     # (disabled = zero timing work)
     parser.add_argument("--profile", type=int, default=-1,
                         choices=(-1, 0, 1))
+    # tail-forensics plane (histogram exemplars + tail-based trace
+    # retention + slow-cause attribution): -1 resolves from
+    # EDL_FORENSICS, default ON — priced by the bench overhead A/B
+    parser.add_argument("--forensics", type=int, default=-1,
+                        choices=(-1, 0, 1))
     return parser.parse_args(args)
 
 
@@ -156,6 +161,8 @@ def build_server(args):
             metrics_port=(None if args.metrics_port < 0
                           else args.metrics_port),
             profile=None if args.profile < 0 else bool(args.profile),
+            forensics=(None if args.forensics < 0
+                       else bool(args.forensics)),
         ),
         draft=draft,
     )

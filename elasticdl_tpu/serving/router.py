@@ -304,6 +304,11 @@ class Replica(object):
         self.queue_wait_ms = 0.0
         self.ttft_hist = []
         self.queue_wait_hist = []
+        # terminally-slow requests by dominant attributed cause
+        # (forensics taxonomy, declared order) — passed through from
+        # ServerStatus so router_status answers the fleet's
+        # distribution-of-why without touching a replica
+        self.slow_cause_counts = []
         self.dispatched = 0
         self.failures = 0
         self.poll_failures = 0
@@ -405,6 +410,7 @@ class Replica(object):
         # sums these across replicas for fleet-wide percentiles
         self.ttft_hist = list(status.ttft_hist)
         self.queue_wait_hist = list(status.queue_wait_hist)
+        self.slow_cause_counts = list(status.slow_cause_counts)
 
 
 def _default_stub_factory(address):
@@ -483,6 +489,37 @@ class Router(object):
         # autoscaler block; the router never calls INTO it while
         # holding _lock (lock order: supervisor -> router, one way)
         self.autoscaler = None
+        # tail-based trace retention: the router's request roots are
+        # classified against the SAME declared SLO thresholds the burn
+        # engine evaluates — a breaching, shed, re-dispatched, hedged
+        # or failed root's whole trace survives ring pressure that
+        # evicts healthy siblings (observability/tracing.py)
+        recorder().add_classifier(self._root_span_classifier)
+
+    #: root-span events that mark a trace worth retaining even when
+    #: the request eventually succeeded — the re-dispatch/hedge/shed
+    #: machinery fired, which is exactly what an incident replay wants
+    RETAIN_EVENTS = frozenset(
+        ("redispatched", "hedged", "breaker_trip", "shed")
+    )
+
+    def _root_span_classifier(self, span):
+        """Verdict hook for router_generate[_stream] roots: errors,
+        shed, re-dispatched/hedged legs and e2e beyond the declared
+        SLO threshold RETAIN the trace; clean fast roots sample."""
+        if span.name not in ("router_generate",
+                             "router_generate_stream"):
+            return None
+        if span.status != "ok":
+            return True
+        if any(name in self.RETAIN_EVENTS
+               for _ts, name, _attrs in span.events):
+            return True
+        if span.end is not None:
+            e2e_ms = (span.end - span.start) * 1000.0
+            if e2e_ms > self.config.slo_e2e_p99_ms:
+                return True
+        return False
 
     def set_autoscaler(self, supervisor):
         """Attach the replica supervisor whose status_block() fills
@@ -744,7 +781,10 @@ class Router(object):
         raise RouterError(_code_name(exc), str(exc))
 
     def _finish_e2e(self, root, t0, status="ok"):
-        self.telemetry.record_e2e((self._clock() - t0) * 1000.0)
+        # the trace_id rides into the e2e histogram as a bucket
+        # exemplar: a scraped p99 bucket names this very request
+        self.telemetry.record_e2e((self._clock() - t0) * 1000.0,
+                                  trace_id=root.trace_id)
         root.finish(status)
 
     def dispatch_generate(self, request):
@@ -1018,6 +1058,7 @@ class Router(object):
                 dispatched=rep.dispatched,
                 failures=rep.failures,
                 inflight=rep.inflight,
+                slow_cause_counts=rep.slow_cause_counts,
             ))
         autoscaler = None
         if self.autoscaler is not None:
@@ -1183,6 +1224,7 @@ class Router(object):
 
     def stop(self, grace=5.0):
         self._stop.set()
+        recorder().remove_classifier(self._root_span_classifier)
         if self._heartbeat is not None:
             self._heartbeat.join(timeout=10.0)
         if self._server is not None:

@@ -24,6 +24,7 @@ serving RPC names — overload and kill drills are spec-driven, e.g.
 ``generate:error:3`` or ``generate:kill:1:skip=8``.
 """
 
+import os
 import threading
 import time
 from concurrent import futures
@@ -33,6 +34,7 @@ from elasticdl_tpu.common.fault_injection import (
     maybe_wrap_servicer,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.observability import forensics
 from elasticdl_tpu.observability.tracing import recorder
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.serving.admission import (
@@ -55,6 +57,33 @@ from elasticdl_tpu.serving.engine import (
 )
 from elasticdl_tpu.serving.hot_reload import CheckpointWatcher
 from elasticdl_tpu.serving.telemetry import ServingTelemetry
+
+
+def forensics_default():
+    """EDL_FORENSICS resolves the tail-forensics plane (histogram
+    exemplars + tail-based trace retention + slow-cause attribution)
+    when the config leaves it unset: on unless explicitly '0' — the
+    plane's cost is bounded by the bench overhead A/B."""
+    return os.environ.get("EDL_FORENSICS", "1") != "0"
+
+
+def serve_span_classifier(span):
+    """Tail-retention verdict for replica `serve` spans (installed on
+    the process recorder when forensics is on): a span that expired,
+    was rejected or errored is RETAINED — and so is a completed one
+    that burned most of its own deadline budget (the replica's
+    deadline IS the classifier; no new config surface). Healthy serves
+    are sampled."""
+    if span.name != "serve":
+        return None
+    if span.status != "ok":
+        return True
+    deadline_ms = span.attrs.get("deadline_ms") or 0
+    if deadline_ms and span.end is not None:
+        e2e_ms = (span.end - span.start) * 1000.0
+        if forensics.is_terminally_slow("ok", e2e_ms, deadline_ms):
+            return True
+    return False
 
 
 class ServingConfig(object):
@@ -101,7 +130,7 @@ class ServingConfig(object):
                  port=0, max_workers=64, kv_paged=None,
                  kv_block_size=16, kv_num_blocks=0, kv_shared=None,
                  draft_k=0, kv_host_bytes=None, metrics_port=None,
-                 profile=None):
+                 profile=None, forensics=None):
         self.num_slots = int(num_slots)
         self.queue_capacity = int(queue_capacity)
         self.top_k = int(top_k)
@@ -135,6 +164,15 @@ class ServingConfig(object):
         self.profile = (
             profile_default() if profile is None else bool(profile)
         )
+        # the tail-forensics plane (None resolves from EDL_FORENSICS,
+        # default on): histogram exemplars at the latency record
+        # sites, the serve-span tail-retention classifier, and
+        # slow-cause attribution into the slow_cause counter family —
+        # one switch so the bench overhead A/B can price all of it
+        self.forensics = (
+            forensics_default() if forensics is None
+            else bool(forensics)
+        )
 
 
 class _Scheduler(threading.Thread):
@@ -145,13 +183,16 @@ class _Scheduler(threading.Thread):
     condition with a short timeout so reload polling stays live."""
 
     def __init__(self, engine, queue, telemetry, watcher=None,
-                 idle_wait_secs=0.05, clock=time.monotonic):
+                 idle_wait_secs=0.05, clock=time.monotonic,
+                 forensics_on=True):
         super().__init__(daemon=True, name="serving-scheduler")
         self.engine = engine
         self.queue = queue
         self.telemetry = telemetry
         self.watcher = watcher
         self.idle_wait_secs = idle_wait_secs
+        # slow-cause attribution at terminal paths (forensics plane)
+        self.forensics_on = bool(forensics_on)
         self._clock = clock
         self._stop_requested = threading.Event()
         self._drain = True
@@ -200,6 +241,7 @@ class _Scheduler(threading.Thread):
             self.telemetry.count("expired")
             req.trace_event("expired", where="mid-decode")
             req.finish_span("DEADLINE_EXCEEDED")
+            self._count_slow(req)
             req.push(("error", "DEADLINE_EXCEEDED",
                       "deadline expired mid-decode"))
         self._fill_slots()
@@ -230,11 +272,47 @@ class _Scheduler(threading.Thread):
         decode loop, the prefill-only fast path and the drain loop."""
         self.telemetry.count("completed")
         self.telemetry.record_e2e(
-            (self._clock() - req.submitted_at) * 1000.0
+            (self._clock() - req.submitted_at) * 1000.0,
+            trace_id=req.trace_id,
         )
         req.trace_event("completed", tokens=len(req.generated))
         req.finish_span("ok")
+        self._count_slow(req)
         req.push(("done", req.model_version))
+
+    def _count_slow(self, req):
+        """Attribute one TERMINALLY-SLOW request (deadline breach, or
+        a completion that burned most of its own deadline budget) to
+        its dominant cause and bump the closed slow_cause counter
+        family — the scrapeable distribution of WHY, next to the
+        expired/completed that."""
+        if not self.forensics_on:
+            return
+        span = req.span
+        if span is None or span.end is None:
+            return
+        deadline_ms = (
+            (req.deadline - req.submitted_at) * 1000.0
+            if req.deadline is not None else 0.0
+        )
+        e2e_ms = (span.end - span.start) * 1000.0
+        if not forensics.is_terminally_slow(
+                span.status, e2e_ms, deadline_ms):
+            return
+        verdict = forensics.attribute([span.to_dict()])
+        if verdict["dominant_cause"]:
+            self.telemetry.count_slow_cause(verdict["dominant_cause"])
+
+    def _blocked_ms(self, req):
+        """Wall ms other requests' prefills held the scheduler while
+        `req` waited: the engine's cumulative prefill-busy clock now
+        minus its value when the servicer admitted the request. The
+        forensics `prefill_blocked_by_other` component."""
+        stamp = getattr(req, "prefill_busy_at_queued", None)
+        if stamp is None:
+            return 0.0
+        busy = getattr(self.engine, "prefill_busy_ms", 0.0)
+        return max(0.0, busy - stamp)
 
     def _fill_slots(self):
         while self.engine.free_slots():
@@ -245,22 +323,35 @@ class _Scheduler(threading.Thread):
             req, expired = self.queue.pop_ready(fit=self.engine.can_seat)
             for e in expired:
                 self.telemetry.count("expired")
-                e.trace_event("expired", where="queued")
+                e.trace_event("expired", where="queued",
+                              prefill_blocked_ms=round(
+                                  self._blocked_ms(e), 3))
                 e.finish_span("DEADLINE_EXCEEDED")
+                self._count_slow(e)
                 e.push(("error", "DEADLINE_EXCEEDED",
                         "deadline expired while queued"))
             if req is None:
                 break
             req.seated_at = self._clock()
             wait_ms = self.telemetry.record_queue_wait(
-                req.queue_wait_secs()
+                req.queue_wait_secs(), trace_id=req.trace_id
             )
             # the windowed prefix-hit-rate's denominator: EVERY prompt
             # token seated (the engine counts the prefix_hit_tokens
             # numerator — the ones seated without prefill compute)
             self.telemetry.count("prompt_tokens", len(req.prompt))
-            req.trace_event("seated", queue_wait_ms=round(wait_ms, 3))
+            req.trace_event("seated", queue_wait_ms=round(wait_ms, 3),
+                            prefill_blocked_ms=round(
+                                self._blocked_ms(req), 3))
+            t0 = self._clock()
             slot, first, finished = self.engine.insert(req)
+            # advance the prefill-busy clock (insert = this request's
+            # prefill / suffix tile / draft prefill on this thread);
+            # getattr keeps bare test/bench engines valid
+            self.engine.prefill_busy_ms = (
+                getattr(self.engine, "prefill_busy_ms", 0.0)
+                + (self._clock() - t0) * 1000.0
+            )
             ttft_ms = self.telemetry.record_ttft(req)
             req.trace_event("first_token", slot=slot,
                             ttft_ms=round(ttft_ms, 3))
@@ -291,6 +382,7 @@ class _Scheduler(threading.Thread):
                 self.telemetry.count("expired")
                 req.trace_event("expired", where="mid-decode")
                 req.finish_span("DEADLINE_EXCEEDED")
+                self._count_slow(req)
                 req.push(("error", "DEADLINE_EXCEEDED",
                           "deadline expired mid-decode"))
             if not self.engine.active_count():
@@ -413,6 +505,9 @@ class ServingServicer(object):
             queue_wait_p99_ms=snap["queue_wait_p99_ms"],
             ttft_hist=snap["ttft_hist"],
             queue_wait_hist=snap["queue_wait_hist"],
+            # terminally-slow requests by dominant attributed cause,
+            # aligned with ServingTelemetry.SLOW_CAUSES declared order
+            slow_cause_counts=snap["slow_cause_counts"],
         )
 
     # --------------------------------------------------------- internals
@@ -438,8 +533,18 @@ class ServingServicer(object):
             request_id=req.request_id,
             prompt_len=len(req.prompt),
             max_new_tokens=req.max_new_tokens,
+            # the tail-retention classifier and forensics read the
+            # request's OWN deadline budget off the span
+            deadline_ms=int(proto_req.deadline_ms or 0),
         )
         req.trace_id = req.span.trace_id
+        # stamp the engine's cumulative prefill-busy clock: seating
+        # reads it back to report how long OTHER requests' prefills
+        # held the scheduler while this one queued (forensics:
+        # prefill_blocked_by_other)
+        req.prefill_busy_at_queued = getattr(
+            self._engine, "prefill_busy_ms", 0.0
+        )
         try:
             self._queue.submit(req)
         except AdmissionError as e:
@@ -532,7 +637,12 @@ class GenerationServer(object):
         self.telemetry = ServingTelemetry(
             log_dir=cfg.telemetry_dir or None,
             flush_every=cfg.telemetry_flush_every,
+            exemplars=cfg.forensics,
         )
+        if cfg.forensics:
+            # tail-based trace retention: slow/failed serve spans
+            # survive ring pressure (idempotent per function object)
+            recorder().add_classifier(serve_span_classifier)
         # the engine reports the events only it can see (prefix hits,
         # CoW faults, draft accepts) through the same closed counters
         self.engine.telemetry = self.telemetry
@@ -551,6 +661,7 @@ class GenerationServer(object):
         self.scheduler = _Scheduler(
             self.engine, self.queue, self.telemetry, watcher=watcher,
             idle_wait_secs=cfg.idle_wait_secs,
+            forensics_on=cfg.forensics,
         )
         servicer = ServingServicer(
             self.queue, self.engine, self.telemetry,

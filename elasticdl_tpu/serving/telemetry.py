@@ -68,12 +68,14 @@ import threading
 import time
 
 from elasticdl_tpu.common.tb_events import EventFileWriter
+from elasticdl_tpu.observability.forensics import CAUSES
 from elasticdl_tpu.observability.histogram import LogLinearHistogram
 from elasticdl_tpu.observability.metrics import (
     TimeSeriesRing,
     counter_family,
     gauge_family,
     hist_family,
+    labeled_counter_family,
 )
 
 
@@ -105,21 +107,34 @@ class ServingTelemetry(object):
               "prefix_hit_rate_window")
     #: latency histograms (ms), all on the shared bucket scheme
     HISTOGRAMS = ("ttft_ms", "queue_wait_ms", "step_ms", "e2e_ms")
+    #: the closed slow-cause label set (observability/forensics.py
+    #: CAUSES — single source of truth): count_slow_cause() REJECTS
+    #: anything else, exactly like count()/gauge(), and EDL401 is the
+    #: static twin. One labeled Prometheus counter family
+    #: (edl_serving_slow_cause_total{cause=...}) makes the
+    #: DISTRIBUTION OF WHY terminally-slow requests were slow
+    #: scrapeable, not just the that.
+    SLOW_CAUSES = CAUSES
     #: the windowed prefix-hit-rate's trailing horizon (secs): long
     #: enough to smooth a single burst, short enough that a router
     #: reading it sees the CURRENT warm-capacity regime
     PREFIX_HIT_HORIZON_SECS = 30.0
 
     def __init__(self, log_dir=None, flush_every=50, clock=time.monotonic,
-                 ring_secs=1.0, ring_windows=240):
+                 ring_secs=1.0, ring_windows=240, exemplars=True):
         self._log_dir = log_dir
         self._flush_every = max(1, int(flush_every))
         self._clock = clock
         self._lock = threading.Lock()
         self._writer = None
         self._started = clock()
+        # exemplars=False drops trace ids at the record sites (the
+        # overhead A/B's OFF leg); the histograms themselves are
+        # unchanged either way
+        self._exemplars = bool(exemplars)
         self.counters = {name: 0 for name in self.COUNTERS}
         self.gauges = {name: 0.0 for name in self.GAUGES}
+        self.slow_causes = {name: 0 for name in self.SLOW_CAUSES}
         self.hists = {name: LogLinearHistogram()
                       for name in self.HISTOGRAMS}
         # the live metrics plane: windowed counter/bucket deltas
@@ -176,12 +191,19 @@ class ServingTelemetry(object):
         """Feed the ring one CUMULATIVE snapshot (it differences at
         window boundaries). Caller holds the lock. Copying the trimmed
         bucket lists is the whole cost, so hot paths gate this behind
-        ring.due()."""
+        ring.due(). Slow-cause counts ride as `slow_cause.<cause>`
+        counters so window deltas carry the why-distribution too."""
+        counters = dict(self.counters)
+        for cause, n in self.slow_causes.items():
+            counters["slow_cause.%s" % cause] = n
         self.ring.observe(
-            counters=self.counters,
+            counters=counters,
             gauges=self.gauges,
             hists={name: h.to_counts()
                    for name, h in self.hists.items()},
+            exemplars={name: h.exemplars
+                       for name, h in self.hists.items()
+                       if h.exemplars},
             roll=roll,
         )
 
@@ -196,6 +218,21 @@ class ServingTelemetry(object):
                     % (name, ", ".join(self.COUNTERS))
                 )
             self.counters[name] += n
+            self._dirty = True
+
+    def count_slow_cause(self, cause, n=1):
+        """One terminally-slow request attributed to `cause` — the
+        dominant label forensics.attribute() produced. Closed set,
+        same contract as count(): a typo'd cause would silently fork a
+        dead series."""
+        with self._lock:
+            if cause not in self.slow_causes:
+                raise ValueError(
+                    "unknown slow cause %r (declared: %s) — a typo "
+                    "here would silently fork a new series"
+                    % (cause, ", ".join(self.SLOW_CAUSES))
+                )
+            self.slow_causes[cause] += n
             self._dirty = True
 
     def reset_latency(self):
@@ -217,30 +254,38 @@ class ServingTelemetry(object):
             )
 
     def record_ttft(self, request):
-        """Time-to-first-token for one request, at its first token."""
+        """Time-to-first-token for one request, at its first token.
+        The request's trace_id rides into the TTFT histogram as a
+        bucket exemplar, so a scraped p99 bucket names a real trace."""
         ttft_ms = (self._clock() - request.submitted_at) * 1000.0
+        trace_id = (getattr(request, "trace_id", "")
+                    if self._exemplars else "")
         with self._lock:
             self._dirty = True
-            self.hists["ttft_ms"].record(ttft_ms)
+            self.hists["ttft_ms"].record(ttft_ms,
+                                         trace_id=trace_id or None)
             self._gauge_locked("ttft_ms", ttft_ms)
             if self.ring.due():
                 self._ring_observe_locked()
         return ttft_ms
 
-    def record_e2e(self, latency_ms):
+    def record_e2e(self, latency_ms, trace_id=None):
         """End-to-end latency of one COMPLETED request (admission ->
         final token). Expired/rejected requests don't land here — the
         histogram answers "how long does a successful request take",
         the counters answer how many weren't."""
         with self._lock:
             self._dirty = True
-            self.hists["e2e_ms"].record(latency_ms)
+            self.hists["e2e_ms"].record(
+                latency_ms,
+                trace_id=trace_id if self._exemplars else None,
+            )
 
     # EWMA, not a running mean: the router reads this as a LOAD signal,
     # so it must track the current regime, not the lifetime average
     QUEUE_WAIT_ALPHA = 0.3
 
-    def record_queue_wait(self, wait_secs):
+    def record_queue_wait(self, wait_secs, trace_id=None):
         """Time one request spent queued before seating. Feeds the
         queue_wait_ms EWMA the router folds into least-loaded routing
         (ServerStatus.queue_wait_ms) and the queue-wait histogram
@@ -255,7 +300,10 @@ class ServingTelemetry(object):
                     a * wait_ms + (1.0 - a) * self._queue_wait_ewma_ms
                 )
             self._queue_waits_seen += 1
-            self.hists["queue_wait_ms"].record(wait_ms)
+            self.hists["queue_wait_ms"].record(
+                wait_ms,
+                trace_id=trace_id if self._exemplars else None,
+            )
             self._gauge_locked("queue_wait_ms",
                                self._queue_wait_ewma_ms)
         return wait_ms
@@ -365,6 +413,12 @@ class ServingTelemetry(object):
             snap["queue_wait_hist"] = (
                 self.hists["queue_wait_ms"].to_counts()
             )
+            # the slow-cause distribution, in declared order (the
+            # ServerStatus slow_cause_counts repeated field's contract)
+            snap["slow_cause_counts"] = [
+                self.slow_causes[c] for c in self.SLOW_CAUSES
+            ]
+            snap["slow_requests"] = sum(self.slow_causes.values())
             return snap
 
     def prometheus(self):
@@ -398,8 +452,15 @@ class ServingTelemetry(object):
                     "edl_serving_%s" % name,
                     "serving latency histogram %s (shared log-linear "
                     "scheme)" % name,
-                    [({}, h.to_counts(), h.sum)],
+                    [({}, h.to_counts(), h.sum, h.exemplars)],
                 ))
+            fams.append(labeled_counter_family(
+                "edl_serving_slow_cause_total",
+                "terminally-slow requests by dominant attributed "
+                "cause (observability/forensics.py taxonomy)",
+                [({"cause": c}, self.slow_causes[c])
+                 for c in self.SLOW_CAUSES],
+            ))
             fams.append(gauge_family(
                 "edl_serving_ring_windows_dropped",
                 "time-series ring windows evicted by the bound",
@@ -522,11 +583,14 @@ class RouterTelemetry(object):
             self.counters[name] += n
             self._dirty = True
 
-    def record_e2e(self, latency_ms):
+    def record_e2e(self, latency_ms, trace_id=None):
         """Router-observed end-to-end latency of one dispatch that
-        reached a terminal outcome."""
+        reached a terminal outcome. The request's trace_id becomes a
+        bucket exemplar on the e2e histogram — the metrics->traces
+        join the fleet collector walks."""
         with self._lock:
-            self.hists["e2e_ms"].record(latency_ms)
+            self.hists["e2e_ms"].record(latency_ms,
+                                        trace_id=trace_id)
 
     def record_poll(self, healthy, replicas, fleet_hists=None):
         """One heartbeat sweep: rotation-size gauges now, counters
@@ -547,8 +611,11 @@ class RouterTelemetry(object):
             hists = {"e2e_ms": self.hists["e2e_ms"].to_counts()}
             if fleet_hists:
                 hists.update(fleet_hists)
-            self.ring.observe(counters=self.counters,
-                              gauges=self.gauges, hists=hists)
+            self.ring.observe(
+                counters=self.counters, gauges=self.gauges,
+                hists=hists,
+                exemplars={"e2e_ms": self.hists["e2e_ms"].exemplars},
+            )
 
     def evaluate_slos(self, engine, now=None):
         """Run a BurnRateEngine over this telemetry's ring UNDER the
@@ -597,7 +664,7 @@ class RouterTelemetry(object):
                 "edl_router_e2e_ms",
                 "router end-to-end dispatch latency (shared "
                 "log-linear scheme)",
-                [({}, h.to_counts(), h.sum)],
+                [({}, h.to_counts(), h.sum, h.exemplars)],
             ))
             for name, counts in sorted(
                     self.ring.latest()["hists"].items()):

@@ -342,9 +342,10 @@ def build_plan(args, seq_len, vocab):
 def run_load(args, trainer, state, plan, num_slots, kv_paged,
              kv_block_size, kv_num_blocks, kv_shared=False,
              draft=None, draft_k=0, kv_host_bytes=0, profile=False,
-             metrics_port=None):
+             metrics_port=None, forensics=True):
     import jax
 
+    from elasticdl_tpu.observability.tracing import new_trace_id
     from elasticdl_tpu.proto import elasticdl_pb2 as pb
     from elasticdl_tpu.proto.service import ServingStub, build_channel
     from elasticdl_tpu.serving import GenerationServer, ServingConfig
@@ -362,6 +363,7 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             kv_host_bytes=kv_host_bytes,
             profile=profile,
             metrics_port=metrics_port,
+            forensics=forensics,
         ),
         draft=draft,
     ).start()
@@ -377,9 +379,13 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
 
     def one(spec):
         t0 = time.monotonic()
+        # mint the trace client-side (the server adopts inbound trace
+        # context), so the bench can join its own latency rows back to
+        # the in-process span trees — the --ramp tail_report path
+        trace_id = new_trace_id()
         row = {"status": "OK", "tokens": 0, "ttft_ms": None,
                "phase": spec.get("phase"), "spec": spec,
-               "out_tokens": []}
+               "out_tokens": [], "trace_id": trace_id}
         try:
             stream = stub.generate_stream(
                 pb.GenerateRequest(
@@ -388,6 +394,7 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
                     temperature=args.temperature,
                     seed=spec["seed"],
                     deadline_ms=args.deadline_ms,
+                    trace_id=trace_id,
                 ),
                 timeout=300,
             )
@@ -551,6 +558,69 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
                 ),
             })
     return record, results
+
+
+def tail_report(results, phases):
+    """Forensics over the RAMP's slowest requests: per phase, take the
+    slowest TTFT decile of completed requests, pull their span trees
+    from the in-process recorder, run forensics.attribute() on each,
+    and histogram the dominant causes. The output is the quantified
+    tail-latency evidence the disaggregated-prefill ROADMAP item asks
+    for BEFORE scheduling work starts: "N% of the p99 TTFT tail is
+    prefill monopolization" is a number here, not a hunch."""
+    from elasticdl_tpu.observability import forensics
+    from elasticdl_tpu.observability.tracing import (
+        group_by_trace,
+        recorder,
+    )
+
+    by_trace = group_by_trace(
+        [s.to_dict() for s in recorder().snapshot()]
+    )
+    per_phase = []
+    all_verdicts = []
+    agg_ms = {c: 0.0 for c in forensics.CAUSES}
+    for idx in range(len(phases)):
+        rows = [
+            r for r in results
+            if r["phase"] == idx and r["status"] == "OK"
+            and r["ttft_ms"] is not None and r["trace_id"] in by_trace
+        ]
+        rows.sort(key=lambda r: r["ttft_ms"], reverse=True)
+        decile = rows[:max(1, len(rows) // 10)] if rows else []
+        verdicts = [
+            forensics.attribute(by_trace[r["trace_id"]])
+            for r in decile
+        ]
+        for v in verdicts:
+            for part in v["breakdown"]:
+                agg_ms[part["cause"]] += part["ms"]
+        all_verdicts.extend(verdicts)
+        per_phase.append({
+            "phase": idx,
+            "rate_rps": phases[idx][0],
+            "analyzed": len(verdicts),
+            "dominant_causes": forensics.cause_histogram(verdicts),
+        })
+    total = forensics.cause_histogram(all_verdicts)
+    total_ms = sum(agg_ms.values()) or 1e-9
+    return {
+        "decile": "slowest 10% by TTFT, per phase, completed only",
+        "analyzed": len(all_verdicts),
+        "per_phase": per_phase,
+        "dominant_causes": total,
+        "top_cause": max(total, key=total.get) if total else None,
+        # aggregate wall-ms breakdown over the analyzed tail — the
+        # shares the scheduler items cite (e.g. what fraction of the
+        # tail is prefill_blocked_by_other)
+        "breakdown_ms": {c: round(agg_ms[c], 3)
+                         for c in forensics.CAUSES},
+        "breakdown_share": {c: round(agg_ms[c] / total_ms, 4)
+                            for c in forensics.CAUSES},
+        "evidence_complete": all(
+            v["evidence_complete"] for v in all_verdicts
+        ) if all_verdicts else False,
+    }
 
 
 def greedy_match_rate(trainer, state, results, temperature):
@@ -733,13 +803,15 @@ OVERHEAD_BOUND = 0.05
 
 def run_overhead_ab(args, trainer, state, plan, num_slots,
                     num_blocks, draft):
-    """The metrics+profiler overhead A/B: the SAME arrival plan on the
-    paged+shared pool, plane OFF (no profiler, no exposition) vs ON
-    (profiler armed — split compiled steps — plus a live /metrics
-    server that gets scraped at the end). tokens/sec must stay within
-    OVERHEAD_BOUND; one retry forgives a scheduler hiccup on a noisy
-    CI box, but two misses fail the bench (a >5% observability tax is
-    a regression, not noise)."""
+    """The observability overhead A/B: the SAME arrival plan on the
+    paged+shared pool, plane OFF (no profiler, no exposition, no
+    forensics — exemplars, tail retention and slow-cause attribution
+    all disarmed) vs ON (profiler armed — split compiled steps — plus
+    a live /metrics server that gets scraped at the end, plus the full
+    forensics plane). tokens/sec must stay within OVERHEAD_BOUND; one
+    retry forgives a scheduler hiccup on a noisy CI box, but two
+    misses fail the bench (a >5% observability tax is a regression,
+    not noise)."""
     ratios = []
     for _attempt in range(2):
         off, _ = run_load(
@@ -747,13 +819,14 @@ def run_overhead_ab(args, trainer, state, plan, num_slots,
             kv_paged=True, kv_block_size=args.kv_block_size,
             kv_num_blocks=num_blocks, kv_shared=True,
             draft=draft, draft_k=args.draft_k,
+            forensics=False,
         )
         on, _ = run_load(
             args, trainer, state, plan, num_slots,
             kv_paged=True, kv_block_size=args.kv_block_size,
             kv_num_blocks=num_blocks, kv_shared=True,
             draft=draft, draft_k=args.draft_k,
-            profile=True, metrics_port=0,
+            profile=True, metrics_port=0, forensics=True,
         )
         ratio = ((on["tokens_per_sec"] or 0.0)
                  / (off["tokens_per_sec"] or 1e-9))
@@ -798,7 +871,7 @@ def run_bench(args):
         if args.kv_host_blocks > 0 else 0
     )
 
-    record, _ = run_load(
+    record, results = run_load(
         args, trainer, state, plan, args.num_slots,
         kv_paged=bool(args.kv_paged),
         kv_block_size=args.kv_block_size,
@@ -810,6 +883,13 @@ def run_bench(args):
         profile=args.profile,
         metrics_port=0 if args.profile else None,
     )
+    if args.ramp:
+        # forensics over the ramp's slow tail: which cause dominates
+        # the slowest decile, per phase (the in-process span trees are
+        # still in the recorder — the bench minted the trace ids)
+        record["tail_report"] = tail_report(
+            results, parse_ramp(args.ramp)
+        )
     if args.overhead_ab:
         # metrics+profiler overhead A/B on the paged+shared shape (the
         # path with the most instrumented phases)

@@ -153,6 +153,11 @@ SERVING_MESSAGES = {
         # capacity signal prefix-affinity routing reads, as a live
         # window rather than a lifetime ratio
         ("prefix_hit_rate_window", 45, T.TYPE_DOUBLE, _OPT),
+        # terminally-slow requests by dominant attributed cause
+        # (observability/forensics.py CAUSES, declared order — the
+        # same closed set behind edl_serving_slow_cause_total): the
+        # scrapeable distribution of WHY, not just the that
+        ("slow_cause_counts", 46, T.TYPE_INT64, _REP),
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],
@@ -230,6 +235,9 @@ SERVING_MESSAGES = {
         ("host_drops", 18, T.TYPE_INT64, _OPT),
         # windowed prefix-hit-rate, passed through from ServerStatus
         ("prefix_hit_rate_window", 19, T.TYPE_DOUBLE, _OPT),
+        # slow-cause distribution, passed through from ServerStatus
+        # (forensics taxonomy, declared order)
+        ("slow_cause_counts", 20, T.TYPE_INT64, _REP),
     ],
     "RouterStatusResponse": [
         ("replicas", 1, T.TYPE_INT32, _OPT),

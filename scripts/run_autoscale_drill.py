@@ -48,10 +48,19 @@ Asserted invariants, all phases:
     with the renderer), and the SLO burn-rate series
     (edl_router_slo_burn{slo=...,window=fast|slow}) must be present
     and FINITE at every point across the ramp — the burn trajectory
-    is archived in the report.
+    is archived in the report;
+  * the TAIL-FORENSICS loop closes end-to-end: the replacement
+    checkpoint's scrape must carry >=1 parseable OpenMetrics
+    exemplar; a fleet-collector bundle scraped LIVE under load
+    becomes an incident report after teardown whose exemplar
+    trace_ids resolve to RETAINED traces in the span dump, each
+    yielding a dominant forensics.attribute() cause, with complete
+    span evidence and a passing validate_report schema gate.
 
 The scale timeline, per-phase client percentiles and per-window
-server p99s are archived at AUTOSCALE_REPORT.json (repo root).
+server p99s are archived at AUTOSCALE_REPORT.json (repo root); the
+collector's full exemplar join, per-trace attributions and cause
+histogram land next to it at INCIDENT_REPORT.json (+ .txt).
 
 Usage: python scripts/run_autoscale_drill.py
 Exit 0 = every invariant holds."""
@@ -289,15 +298,28 @@ class MetricsScrapes(object):
             assert key in burns, (
                 "scrape %r: burn series %s absent" % (name, key)
             )
+        # exemplar-linked buckets (the forensics loop's metrics end):
+        # the independent parser already validated their grammar and
+        # bucket-range; keep the trace ids so the post-teardown
+        # assertions can resolve them against the span dump
+        exemplars = [
+            {"family": fam, "trace_id": ex_labels["trace_id"],
+             "value_ms": value, "le": labels.get("le")}
+            for fam, info in fams.items()
+            for _m, labels, ex_labels, value, _ts in info["exemplars"]
+            if "trace_id" in ex_labels
+        ]
         self.points.append({
             "at": name,
             "families": len(fams),
             "burns": burns,
+            "exemplars": len(exemplars),
+            "exemplar_rows": exemplars,
         })
         print("[autoscale] /metrics @ %-12s %d families, "
-              "ttft_p99 burn fast=%.2f slow=%.2f"
+              "ttft_p99 burn fast=%.2f slow=%.2f, %d exemplars"
               % (name, len(fams), burns["ttft_p99/fast"],
-                 burns["ttft_p99/slow"]))
+                 burns["ttft_p99/slow"], len(exemplars)))
 
 
 def calibrate(stub, pb):
@@ -526,6 +548,21 @@ def main():
               % repl.replacements)
         windows.checkpoint("replacement")
         scrapes.scrape("replacement")
+        # the replacement scrape is the forensics loop's anchor: it
+        # must carry at least one parseable exemplar whose trace the
+        # post-teardown assertions resolve in the span dump
+        assert scrapes.points[-1]["exemplars"] >= 1, (
+            "replacement-checkpoint scrape carried no exemplars — "
+            "the metrics->traces join has nothing to walk"
+        )
+        # fleet-collector scrape bundle, taken LIVE under load (the
+        # trace join happens after teardown, once spans have exported)
+        from elasticdl_tpu.observability import collector as coll
+
+        bundle = coll.scrape_fleet(
+            ["127.0.0.1:%d" % router.metrics.port],
+            scrapes=3, interval_secs=2.0,
+        )
 
         # ---- load drains; then sustained idle forces scale-down
         loader.join(timeout=LEAD_SECS + HIGH_SECS + TAIL_SECS + 60)
@@ -653,6 +690,55 @@ def main():
               "serve spans merged across processes"
               % (len(spans), len(roots), merged))
 
+        # ---- the forensics loop, end to end: the collector bundle
+        # scraped under load joins to the spans every process has now
+        # exported — exemplar -> retained trace -> attributed cause —
+        # and the incident report must pass its own schema gate
+        from elasticdl_tpu.observability.forensics import CAUSES
+        from elasticdl_tpu.observability.slo import (
+            default_router_slos,
+        )
+
+        incident = coll.build_report(
+            bundle,
+            default_router_slos(SLO_TTFT_P99_MS,
+                                2 * SLO_TTFT_P99_MS, 0.02),
+            trace_dir=trace_dir,
+        )
+        coll.validate_report(incident)
+        assert incident["exemplars"], (
+            "collector scraped no exemplars off the router exposition"
+        )
+        resolved = [e for e in incident["exemplars"] if e["resolved"]]
+        assert resolved, (
+            "no scraped exemplar trace_id resolved to a retained "
+            "trace in the span dump — the metrics->traces loop is "
+            "broken"
+        )
+        attributed = [
+            incident["traces"][e["trace_id"]]["attribution"]
+            for e in resolved
+        ]
+        assert any(v["dominant_cause"] in CAUSES
+                   for v in attributed), (
+            "no resolved exemplar trace yielded a dominant cause"
+        )
+        assert incident["span_evidence"]["complete"], (
+            "span evidence incomplete: %r"
+            % (incident["span_evidence"],)
+        )
+        incident_out = os.path.join(REPO, "INCIDENT_REPORT.json")
+        with open(incident_out, "w") as f:
+            json.dump(incident, f, indent=1)
+        with open(os.path.join(REPO, "INCIDENT_REPORT.txt"),
+                  "w") as f:
+            f.write(coll.render_text(incident))
+        print("[autoscale] incident report archived -> %s "
+              "(%d exemplars, %d resolved to traces, dominant "
+              "cause: %s)"
+              % (incident_out, len(incident["exemplars"]),
+                 len(resolved), incident["dominant_cause"]))
+
         report = {
             "calibrated_single_replica_rps": round(rate, 2),
             "kv_cache_dtype": KV_CACHE_DTYPE,
@@ -669,6 +755,18 @@ def main():
             "phases": phase_stats,
             "timeline": watch.timeline,
             "trace_spans": len(spans),
+            # the forensics loop's summary (full report in
+            # INCIDENT_REPORT.json next to this file)
+            "incident": {
+                "exemplars": len(incident["exemplars"]),
+                "resolved": len(resolved),
+                "dominant_cause": incident["dominant_cause"],
+                "cause_histogram": incident["cause_histogram"],
+                "alerting": incident["alerting"],
+                "evidence_complete": (
+                    incident["span_evidence"]["complete"]
+                ),
+            },
         }
         out = os.path.join(REPO, "AUTOSCALE_REPORT.json")
         with open(out, "w") as f:
@@ -677,9 +775,12 @@ def main():
         print("[autoscale] autoscale drill PASSED: scale-up, journal "
               "recovery, SIGKILL replacement and drain-based "
               "scale-down with zero accepted-request loss, p99 "
-              "TTFT <= %.0f ms in every window, and a finite "
+              "TTFT <= %.0f ms in every window, a finite "
               "parse-clean SLO burn trajectory at all %d /metrics "
-              "scrapes" % (SLO_TTFT_P99_MS, len(scrapes.points)))
+              "scrapes, and the forensics loop closed (exemplar -> "
+              "retained trace -> attributed cause, schema-valid "
+              "incident report)"
+              % (SLO_TTFT_P99_MS, len(scrapes.points)))
         return 0
     finally:
         if watch is not None:

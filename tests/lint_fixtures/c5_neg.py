@@ -25,3 +25,11 @@ class Frontend(object):
     def probe(self, meter):
         # .gauge through a non-telemetry receiver: out of scope
         return meter.gauge("whatever", 0)
+
+    def slow(self):
+        # declared cause (forensics.CAUSES): clean
+        self.telemetry.count_slow_cause("prefill_blocked_by_other")
+
+    def slow_dynamic(self, cause):
+        # dynamic cause: the runtime raise owns it
+        self.telemetry.count_slow_cause(cause)

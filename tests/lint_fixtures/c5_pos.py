@@ -21,3 +21,9 @@ class Frontend(object):
 def module_level(router_telemetry):
     router_telemetry.count("breaker_tripz")  # EDL401 (bare receiver)
     router_telemetry.gauge("healthy_replica", 1)  # EDL401 (gauge typo)
+
+
+def slow(telemetry):
+    # typo'd slow cause: forks a labeled series no cause taxonomy
+    # consumer will ever aggregate -> EDL401
+    telemetry.count_slow_cause("queue_wiat")

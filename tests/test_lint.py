@@ -100,16 +100,18 @@ def test_c3_negative():
 
 def test_c5_positive():
     findings = lint_file("c5_pos.py")
-    assert rule_ids(findings) == ["EDL401"] * 5, findings
+    assert rule_ids(findings) == ["EDL401"] * 6, findings
     details = {f.detail for f in findings}
     assert details == {"admittd", "rejectd", "breaker_tripz",
-                       "queue_dept", "healthy_replica"}
+                       "queue_dept", "healthy_replica", "queue_wiat"}
     scopes = {f.scope for f in findings}
     assert "Frontend.admit" in scopes and "module_level" in scopes
-    # gauge typos report as gauges, counter typos as counters
+    # gauge typos report as gauges, counter typos as counters,
+    # slow-cause typos as slow causes
     by_detail = {f.detail: f.message for f in findings}
     assert "gauge" in by_detail["queue_dept"]
     assert "counter" in by_detail["admittd"]
+    assert "slow cause" in by_detail["queue_wiat"]
 
 
 def test_c5_negative():
@@ -140,6 +142,16 @@ def test_c5_allowed_set_tracks_telemetry_declarations():
     )
     assert "queue_depth" in declared_gauges()
     assert "healthy_replicas" in declared_gauges()
+    from elasticdl_tpu.analysis.telemetry_rules import (
+        declared_slow_causes,
+    )
+    from elasticdl_tpu.observability.forensics import CAUSES
+
+    assert declared_slow_causes() == frozenset(CAUSES)
+    assert declared_slow_causes() == frozenset(
+        ServingTelemetry.SLOW_CAUSES
+    )
+    assert "prefill_blocked_by_other" in declared_slow_causes()
 
 
 # ------------------------------------------ C6: EDL003 lock-order cycles
