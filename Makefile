@@ -38,9 +38,20 @@
 #                   scale-up, a SIGKILL forces a replacement, idle
 #                   forces a drain-based scale-down; supervisor
 #                   kill+restart re-adopts from its journal; p99 TTFT
-#                   SLO held across every replica-count change)
+#                   SLO held across every replica-count change), and
+#                   the runtime-health stall drill (an injected
+#                   scheduler wedge is self-reported, flight-recorder
+#                   bundled, and replaced in seconds — beating the
+#                   30 s lease heuristic — with zero accepted-request
+#                   loss; a deliberate device-buffer leak is convicted
+#                   by the memory accountant)
 #   serve-smoke   — closed-loop load vs the generation server; emits
 #                   the BENCH_SERVING.json serving-throughput record
+#   bench-compare — gate a fresh serve-smoke record against the
+#                   committed benchmarks/serving_baseline.json with
+#                   per-metric tolerances (tok/s, goodput, bytes/
+#                   token, the overhead-A/B ratio, zero steady
+#                   recompiles); exit nonzero on regression
 #   cluster-smoke — kind/minikube manifests smoke, env-gated
 #                   (EDL_CLUSTER_FULL=1 + a reachable cluster)
 
@@ -51,7 +62,7 @@ RUFF_VERSION = 0.8.4
 LINT_PATHS = elasticdl_tpu scripts tests
 
 .PHONY: native lint lint-changed test-fast test-drills drill serve-smoke \
-	ci ci-fast cluster-smoke clean
+	bench-compare ci ci-fast cluster-smoke clean
 
 native:
 	$(MAKE) -C elasticdl_tpu/native
@@ -84,6 +95,7 @@ drill:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_server_kill_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_router_chaos_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu EDL_KV_CACHE_DTYPE=int8 $(PY) scripts/run_autoscale_drill.py
+	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_stall_drill.py
 
 # Serving smoke: closed-loop load against the real continuous-batching
 # server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput).
@@ -117,6 +129,16 @@ serve-smoke:
 		--out_len 4:12 --draft_k 2 --kv_cache_dtype int8 \
 		--kv_host_blocks 84 --profile --overhead_ab \
 		--out BENCH_SERVING.json
+
+# the bench-trajectory gate: run AFTER serve-smoke has written a
+# fresh BENCH_SERVING.json; tolerances live in scripts/bench_compare.py
+# (override per metric with --tol). Update the baseline deliberately,
+# with the PR that improves it:
+#   make serve-smoke && cp BENCH_SERVING.json benchmarks/serving_baseline.json
+bench-compare:
+	env -u PYTHONPATH $(PY) scripts/bench_compare.py \
+		--fresh BENCH_SERVING.json \
+		--baseline benchmarks/serving_baseline.json
 
 ci-fast: lint test-fast
 

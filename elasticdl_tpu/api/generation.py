@@ -46,6 +46,28 @@ class _LRUCache(dict):
         super().__setitem__(key, value)
 
 
+#: recompile-sentry hook (observability/runtime_health.py): the
+#: serving engine attaches its sentry here so the offline decode
+#: paths' jit caches count their compilations into the same
+#: edl_serving_recompiles_total{fn=} family. None = counting off —
+#: the executables are plain jax.jit either way.
+_SENTRY = None
+
+
+def set_decode_sentry(sentry):
+    """Adopt `sentry` (RecompileSentry or None) for every decode-path
+    jit site in this module. Process-global like the compile caches
+    themselves: one serving process has one sentry."""
+    global _SENTRY
+    _SENTRY = sentry
+
+
+def _tjit(name, fn, **jit_kwargs):
+    from elasticdl_tpu.observability.runtime_health import tracked_jit
+
+    return tracked_jit(fn, name, lambda: _SENTRY, **jit_kwargs)
+
+
 def _decode_cache(trainer):
     return trainer.__dict__.setdefault("_generate_cache", _LRUCache())
 
@@ -231,7 +253,7 @@ def autoregressive_generate(trainer, state, prompt, max_new_tokens,
 
             return jax.lax.fori_loop(start, stop, body, tokens)
 
-        decode_fn = jax.jit(decode)
+        decode_fn = _tjit("offline_decode_nocache", decode)
         cache[key] = decode_fn
 
     variables = {"params": state.params, **state.model_state}
@@ -384,7 +406,7 @@ def _kv_generate(trainer, state, prompt, p, total, temperature, seed,
             )
             return tokens
 
-        fn = jax.jit(run)
+        fn = _tjit("offline_decode_kv", run)
         cache[key] = fn
 
     variables = {"params": state.params, **state.model_state}
@@ -488,7 +510,7 @@ def beam_search_generate(trainer, state, prompt, max_new_tokens,
                 tokens, best[:, None, None], axis=1
             )[:, 0], scores
 
-        fn = jax.jit(run)
+        fn = _tjit("offline_beam_nocache", run)
         cache[key] = fn
 
     variables = {"params": state.params, **state.model_state}
@@ -629,7 +651,7 @@ def _beam_kv_generate(trainer, state, prompt, max_new_tokens, num_beams):
                 tokens, best[:, None, None], axis=1
             )[:, 0]
 
-        fn = jax.jit(run)
+        fn = _tjit("offline_beam_kv", run)
         cache[key] = fn
 
     variables = {"params": state.params, **state.model_state}
@@ -825,7 +847,7 @@ def speculative_generate(trainer, state, draft_trainer, draft_state,
             )
             return tokens, n, acc
 
-        fn = jax.jit(run)
+        fn = _tjit("offline_speculative", run)
         cache[key] = (fn, draft_trainer)
 
     variables = {"params": state.params, **state.model_state}

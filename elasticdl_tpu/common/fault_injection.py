@@ -272,6 +272,25 @@ SUPERVISOR_RPCS = (
     "supervisor_adopt",
 )
 
+# The runtime-health plane's intercept hooks
+# (observability/runtime_health.py + serving/server.py). Like the
+# supervisor hooks these are direct intercept() call sites, not
+# servicer methods — a spec manufactures exactly the failures the
+# health plane claims to observe:
+#   engine_step:delay:1:secs=600,skip=5   the scheduler wedges on its
+#                                         6th decode tick (the stall
+#                                         drill's injected stall: work
+#                                         stays seated, tokens stop)
+#   health_leak:drop:1                    the health thread leaks one
+#                                         device buffer the byte
+#                                         ledger cannot name — the
+#                                         memory accountant must
+#                                         convict it
+HEALTH_RPCS = (
+    "engine_step",
+    "health_leak",
+)
+
 
 class FaultInjectingServicer(object):
     """Transparent servicer wrapper: same RPC surface, with

@@ -112,6 +112,13 @@ def main(argv=None):
     )
 
     honor_jax_platforms_env()
+    # SIGUSR2 -> all-thread stack dump: a live wedged master can
+    # always be interrogated without killing the job
+    from elasticdl_tpu.observability.runtime_health import (
+        install_sigusr2_dump,
+    )
+
+    install_sigusr2_dump()
     args = parse_master_args(argv)
     status_file = getattr(args, "job_status_file", "")
     job_status.write_job_status(status_file, job_status.PENDING)

@@ -33,6 +33,13 @@ Modules:
                scrape /metrics fleet-wide, re-evaluate declared SLOs,
                join burning buckets to exemplar traces, attribute
                them, and emit the incident report
+* runtime_health — the runtime's SELF-report: `tracked_jit` +
+               `RecompileSentry` (compilations per named executable,
+               steady-boundary anomalies), `DeviceMemoryAccountant`
+               (byte-ledger vs live-buffer reconciliation, leak
+               watermark), `ProgressWatchdog` + `FlightRecorder`
+               (stall detection off the scheduler thread, atomic
+               diagnostic bundles), `install_sigusr2_dump`
 
 Design doc: docs/designs/observability.md.
 """

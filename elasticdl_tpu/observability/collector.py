@@ -558,6 +558,13 @@ def percentile_of_counts(counts, q):
 
 
 def main(argv=None):
+    # SIGUSR2 -> all-thread stack dump: a long-running collector is a
+    # fleet process like any other — interrogable without killing it
+    from elasticdl_tpu.observability.runtime_health import (
+        install_sigusr2_dump,
+    )
+
+    install_sigusr2_dump()
     parser = argparse.ArgumentParser(
         description=__doc__.splitlines()[0]
     )

@@ -20,9 +20,16 @@ machine clockable and unit-testable) runs three passes per tick:
 * **poll** — each seat's process is polled for exit and readiness.
   A STARTING seat that prints its `SERVING_READY port=N` line is
   ADOPTED: registered with the live router, journal first. A LIVE
-  seat that exits (or sits wedged: lease expired / breaker stuck open
-  past `wedged_after_secs`) is REAPED and replaced. A DRAINING seat
-  that exits is RETIRED: unregistered, channel closed.
+  seat that exits is REAPED and replaced; so is a wedged one, via two
+  signals of very different confidence: a replica that SELF-REPORTS
+  `health_state == "stalled"` (its runtime-health watchdog,
+  observability/runtime_health.py — direct evidence, served off
+  threads the wedged scheduler cannot starve) is killed after
+  seconds (`stalled_kill_after_secs`), while a replica that merely
+  goes silent (lease expired / breaker stuck open — indirect
+  evidence that under overload can also mean "busy") keeps the
+  deliberately conservative `wedged_after_secs` window. A DRAINING
+  seat that exits is RETIRED: unregistered, channel closed.
 
 * **reconcile** — deficit (roster below target) spawns one replica
   per tick, gated by a full-jitter exponential backoff after failures
@@ -100,7 +107,7 @@ class AutoscalerConfig(object):
                  down_free_kv_blocks=0,
                  cooldown_secs=5.0,
                  ready_timeout_secs=180.0, drain_timeout_secs=60.0,
-                 wedged_after_secs=30.0,
+                 wedged_after_secs=30.0, stalled_kill_after_secs=3.0,
                  max_restarts=3, base_delay_secs=0.2,
                  max_delay_secs=5.0,
                  journal_dir="", snapshot_every=100):
@@ -120,6 +127,16 @@ class AutoscalerConfig(object):
         self.ready_timeout_secs = float(ready_timeout_secs)
         self.drain_timeout_secs = float(drain_timeout_secs)
         self.wedged_after_secs = float(wedged_after_secs)
+        # the runtime-health fast path: a replica that SELF-REPORTS
+        # `health_state == "stalled"` (its progress watchdog, served
+        # off gRPC threads the wedged scheduler cannot starve) is
+        # killed after this much SUSTAINED self-report — seconds, not
+        # the 30 s lease heuristic, because the evidence is direct:
+        # the replica itself says work is seated and nothing commits.
+        # The lease-decay path stays as the fallback for pre-health
+        # replicas (health_state == "") and for processes too far
+        # gone to answer status at all.
+        self.stalled_kill_after_secs = float(stalled_kill_after_secs)
         self.max_restarts = int(max_restarts)
         self.base_delay_secs = float(base_delay_secs)
         self.max_delay_secs = float(max_delay_secs)
@@ -291,7 +308,8 @@ class _Seat(object):
     lifecycle state (starting -> live -> draining -> gone)."""
 
     __slots__ = ("seat_id", "handle", "state", "address",
-                 "spawned_at", "drain_since", "unhealthy_since")
+                 "spawned_at", "drain_since", "unhealthy_since",
+                 "stalled_since")
 
     def __init__(self, seat_id, handle, state, spawned_at, address=""):
         self.seat_id = seat_id
@@ -301,6 +319,8 @@ class _Seat(object):
         self.spawned_at = spawned_at
         self.drain_since = None
         self.unhealthy_since = None
+        # sustained self-reported stall window (runtime health plane)
+        self.stalled_since = None
 
 
 class ReplicaSupervisor(object):
@@ -640,16 +660,43 @@ class ReplicaSupervisor(object):
         if rc is not None:
             self._reap_live(seat, now, "exited rc=%s" % rc)
             return
-        # wedged detection: the process is alive but the router cannot
-        # renew its lease (SIGSTOP, hard hang) or its breaker never
-        # leaves OPEN — either way it serves nothing; replace it.
-        # wedged_after_secs must be CONSERVATIVE (default 30s): under
-        # hard overload a replica's status RPC can starve behind
-        # blocked generate handlers, and shooting the fleet's busiest
-        # replica at peak load is the one failure mode worse than a
-        # hung one — the lease must stay dead for a long, deliberate
-        # window before the supervisor reaches for SIGKILL
         rep = self._router_view().get(seat.address)
+        # PREFERRED wedge signal — the replica's own runtime-health
+        # self-report (observability/runtime_health.py): its progress
+        # watchdog declares `stalled` from a thread the wedged
+        # scheduler cannot starve, and the evidence is direct (work
+        # seated, nothing committing), so the kill budget is seconds.
+        # Replicas that don't advertise health (health_state == "")
+        # never enter this branch — they keep the conservative
+        # lease-decay path below.
+        self_stalled = (
+            rep is not None and rep.health_state == "stalled"
+        )
+        if self_stalled:
+            if seat.stalled_since is None:
+                seat.stalled_since = now
+            elif (now - seat.stalled_since
+                    >= self.config.stalled_kill_after_secs):
+                logger.warning(
+                    "autoscaler: seat %d (%s) SELF-REPORTS stalled "
+                    "for %.1fs (last_progress_age %.0fms) — killing "
+                    "for replacement", seat.seat_id, seat.address,
+                    now - seat.stalled_since,
+                    rep.last_progress_age_ms,
+                )
+                seat.handle.kill()  # the exit lands in a later tick
+                return
+        else:
+            seat.stalled_since = None
+        # FALLBACK wedge detection: the process is alive but the
+        # router cannot renew its lease (SIGSTOP, hard hang) or its
+        # breaker never leaves OPEN — either way it serves nothing;
+        # replace it. wedged_after_secs must be CONSERVATIVE (default
+        # 30s): under hard overload a replica's status RPC can starve
+        # behind blocked generate handlers, and shooting the fleet's
+        # busiest replica at peak load is the one failure mode worse
+        # than a hung one — the lease must stay dead for a long,
+        # deliberate window before the supervisor reaches for SIGKILL
         unhealthy = rep is not None and (
             not rep.lease_ok(now) or rep.breaker.state == "open"
         )

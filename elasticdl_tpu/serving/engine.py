@@ -75,6 +75,7 @@ from elasticdl_tpu.api.generation import (
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.observability.histogram import LogLinearHistogram
 from elasticdl_tpu.observability.metrics import hist_family
+from elasticdl_tpu.observability.runtime_health import tracked_jit
 
 
 def kv_paged_default():
@@ -247,6 +248,13 @@ class ContinuousBatchingEngine(object):
         # wires it under ServingConfig.profile / EDL_PROFILE). None =
         # fused executables, no timing work at all
         self.profiler = None
+        # optional recompile sentry (runtime_health.RecompileSentry;
+        # the server attaches it under ServingConfig.runtime_health).
+        # Every jit site below compiles through _tjit, which resolves
+        # this LAZILY — executables built before the server attaches
+        # the sentry still count their later compiles. None = plain
+        # jax.jit, zero counting work.
+        self.sentry = None
         self.draft_k = 0        # speculative decode off (paged engine
         self.draft_proposed = 0  # overrides when a draft is seated)
         self.draft_accepted = 0
@@ -333,10 +341,11 @@ class ContinuousBatchingEngine(object):
                     dequantize_params,
                 )
 
-                self._dequant_fn = jax.jit(
+                self._dequant_fn = self._tjit(
+                    "dequant",
                     lambda v: dict(
                         v, params=dequantize_params(v["params"])
-                    )
+                    ),
                 )
             with self.trainer.mesh:
                 self._exec_variables = self._dequant_fn(self.variables)
@@ -510,6 +519,16 @@ class ContinuousBatchingEngine(object):
 
     # ------------------------------------------------------- compiled fns
 
+    def _tjit(self, name, fn, **jit_kwargs):
+        """jax.jit with recompile-sentry adoption: one fixed NAME per
+        call site (buckets included), so a second compile of any name
+        is, by construction, the churn-recompiles failure the sentry
+        exists to catch."""
+        return tracked_jit(
+            fn, name, lambda: getattr(self, "sentry", None),
+            **jit_kwargs,
+        )
+
     def _build_prefill(self, p_pad):
         model, kv_shapes = self.model, self._kv_shapes
         top_k, top_p, qz = self.top_k, self.top_p, self._exec_qz
@@ -525,7 +544,7 @@ class ContinuousBatchingEngine(object):
             return kv, first
 
         logger.info("serving: compiling prefill for bucket %d", p_pad)
-        return jax.jit(prefill)
+        return self._tjit("prefill[%d]" % p_pad, prefill)
 
     def _build_step(self):
         model = self.model
@@ -554,7 +573,7 @@ class ContinuousBatchingEngine(object):
         logger.info(
             "serving: compiling decode step for %d slots", self.num_slots
         )
-        return jax.jit(step)
+        return self._tjit("decode_step", step)
 
     def _write_slot(self, kv, slot):
         """Insert a batch-1 cache tree into the pool at a TRACED slot
@@ -569,7 +588,7 @@ class ContinuousBatchingEngine(object):
 
                 return jax.tree.map(upd, pool, kv)
 
-            self._write_fn = jax.jit(write)
+            self._write_fn = self._tjit("slot_write", write)
         return self._write_fn(
             self._pool, kv, jnp.asarray(slot, jnp.int32)
         )
@@ -773,6 +792,23 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._profiler = value
         if hasattr(self, "kv"):
             self.kv.profiler = value
+
+    @property
+    def sentry(self):
+        return self._sentry
+
+    @sentry.setter
+    def sentry(self, value):
+        # the paged pool compiles its own spill gather / revival
+        # upload / prompt write / CoW executables — the sentry
+        # forwards so those sites count into the same family; the
+        # offline decode caches adopt it too (one process, one sentry)
+        self._sentry = value
+        if hasattr(self, "kv"):
+            self.kv.sentry = value
+        from elasticdl_tpu.api import generation as _generation
+
+        _generation.set_decode_sentry(value)
 
     def set_params(self, state, version):
         """Hot reload, plus the sharing-specific obligation: cached
@@ -1159,7 +1195,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "%d x %d-token blocks", self.num_slots, self.num_blocks,
             self.block_size,
         )
-        return jax.jit(step)
+        return self._tjit("paged_step", step)
 
     # ------------------------------------------- profiled (split) steps
 
@@ -1273,7 +1309,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "serving: compiling SPLIT (profiled) paged decode step "
             "for %d slots", self.num_slots,
         )
-        return jax.jit(decode), jax.jit(scatter)
+        return (self._tjit("paged_decode.split", decode),
+                self._tjit("paged_scatter.split", scatter))
 
     def _build_spec_step_split(self):
         """The fused `_build_spec_step` math as three executables —
@@ -1369,7 +1406,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "serving: compiling SPLIT (profiled) speculative step "
             "(k=%d) for %d slots", k, self.num_slots,
         )
-        return jax.jit(draft), jax.jit(verify), jax.jit(scatter)
+        return (self._tjit("spec_draft.split", draft),
+                self._tjit("spec_verify.split", verify),
+                self._tjit("spec_scatter.split", scatter))
 
     def _build_suffix_prefill(self, t_pad):
         """Compiled shared-prefix suffix prefill: decode a tile of up
@@ -1416,7 +1455,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "serving: compiling shared-prefix suffix prefill for "
             "tile %d", t_pad,
         )
-        return jax.jit(fn)
+        return self._tjit("suffix_prefill[%d]" % t_pad, fn)
 
     def _build_draft_prefill(self, p_pad):
         d_model, d_kv_shapes = self._d_model, self._d_kv_shapes
@@ -1430,7 +1469,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         logger.info(
             "serving: compiling draft prefill for bucket %d", p_pad
         )
-        return jax.jit(prefill)
+        return self._tjit("draft_prefill[%d]" % p_pad, prefill)
 
     def _write_draft_slot(self, kv, slot):
         if self._d_write_fn is None:
@@ -1443,7 +1482,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
                 return jax.tree.map(upd, pool, kv)
 
-            self._d_write_fn = jax.jit(write)
+            self._d_write_fn = self._tjit("draft_slot_write", write)
         self._d_pool = self._d_write_fn(
             self._d_pool, kv, jnp.asarray(slot, jnp.int32)
         )
@@ -1550,4 +1589,4 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "serving: compiling speculative draft-verify step "
             "(k=%d) for %d slots", k, self.num_slots,
         )
-        return jax.jit(step)
+        return self._tjit("spec_step", step)

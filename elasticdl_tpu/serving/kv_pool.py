@@ -770,6 +770,18 @@ def scatter_rows(pools, rows, bids, offs):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _pool_tjit(pool, name, fn, **jit_kwargs):
+    """jax.jit with recompile-sentry adoption for the pool's compiled
+    helpers — lazy like the engine's _tjit, so executables built
+    before the server attaches the sentry still count later
+    compiles."""
+    from elasticdl_tpu.observability.runtime_health import tracked_jit
+
+    return tracked_jit(
+        fn, name, lambda: getattr(pool, "sentry", None), **jit_kwargs
+    )
+
+
 class PagedKVPool(object):
     """The device arenas + host tables for one serving engine.
 
@@ -843,6 +855,11 @@ class PagedKVPool(object):
         # optional StepProfiler (serving/engine.py): the pool times its
         # revive uploads — the one decode phase only it can see
         self.profiler = None
+        # recompile sentry (runtime health): the engine forwards its
+        # sentry so the pool's own executables (spill gather, revival
+        # upload buckets, prompt write, CoW copy) count into the same
+        # edl_serving_recompiles_total{fn=} family. None = plain jit.
+        self.sentry = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -877,7 +894,9 @@ class PagedKVPool(object):
                 return [leaf[b] for leaf in jax.tree.leaves(pools)
                         if leaf.ndim == 4]
 
-            self._gather_fn = jax.jit(gather)
+            self._gather_fn = _pool_tjit(
+                self, "kv_spill_gather", gather
+            )
         rows = self._gather_fn(self.pools, jnp.asarray(bid, jnp.int32))
         self._host_rows[vid] = [np.asarray(r) for r in rows]
         self.host_blocks_peak = max(self.host_blocks_peak,
@@ -929,7 +948,9 @@ class PagedKVPool(object):
                         out.append(leaf)
                 return jax.tree_util.tree_unflatten(treedef, out)
 
-            fn = jax.jit(upload)
+            fn = _pool_tjit(
+                self, "kv_revive_upload[%d]" % k_pad, upload
+            )
             self._upload_fns[k_pad] = fn
         self.pools = fn(
             self.pools,
@@ -957,8 +978,9 @@ class PagedKVPool(object):
         whole-slot copy (shared blocks below start_block are already
         resident and must not be re-written)."""
         if self._write_fn is None:
-            self._write_fn = jax.jit(
-                write_prompt_block, static_argnames=("block_size",)
+            self._write_fn = _pool_tjit(
+                self, "kv_prompt_write", write_prompt_block,
+                static_argnames=("block_size",),
             )
         table = self.allocator.table(slot)
         for j in range(start_block,
@@ -988,7 +1010,9 @@ class PagedKVPool(object):
             return None
         old, new = moved
         if self._copy_fn is None:
-            self._copy_fn = jax.jit(copy_block)
+            self._copy_fn = _pool_tjit(
+                self, "kv_cow_copy", copy_block
+            )
         self.pools = self._copy_fn(
             self.pools, jnp.asarray(old, jnp.int32),
             jnp.asarray(new, jnp.int32),

@@ -91,6 +91,19 @@ def parse_serving_args(args=None):
     # EDL_FORENSICS, default ON — priced by the bench overhead A/B
     parser.add_argument("--forensics", type=int, default=-1,
                         choices=(-1, 0, 1))
+    # runtime health plane (observability/runtime_health.py):
+    # recompile sentry + device-memory ledger reconciliation +
+    # progress watchdog with flight recorder, self-reported through
+    # ServerStatus health_state/last_progress_age_ms; -1 resolves
+    # from EDL_RUNTIME_HEALTH, default ON — priced by the same bench
+    # overhead A/B as the rest of the observability stack
+    parser.add_argument("--runtime_health", type=int, default=-1,
+                        choices=(-1, 0, 1))
+    # watchdog budget: work seated but no progress (tokens OR jit
+    # compiles) for this long = stalled; -1 resolves from
+    # EDL_STALL_AFTER_SECS (default 10 s). Stall bundles dump to
+    # $EDL_HEALTH_DIR when set.
+    parser.add_argument("--stall_after_secs", type=float, default=-1.0)
     return parser.parse_args(args)
 
 
@@ -163,6 +176,10 @@ def build_server(args):
             profile=None if args.profile < 0 else bool(args.profile),
             forensics=(None if args.forensics < 0
                        else bool(args.forensics)),
+            runtime_health=(None if args.runtime_health < 0
+                            else bool(args.runtime_health)),
+            stall_after_secs=(None if args.stall_after_secs < 0
+                              else args.stall_after_secs),
         ),
         draft=draft,
     )
@@ -184,11 +201,21 @@ def warmup(server, tokens):
     # the compile-heavy warmup latency must never surface in the
     # percentiles a router/autoscaler SLOs on
     server.telemetry.reset_latency()
+    # the runtime-health steady boundary: from here on a recompile is
+    # a counted anomaly and the memory baseline is anchored
+    server.mark_steady()
     logger.info("warmup complete (%d tokens)", tokens)
 
 
 def main(argv=None):
     args = parse_serving_args(argv)
+    # SIGUSR2 -> all-thread stack dump: a live wedged replica can
+    # always be interrogated without killing it
+    from elasticdl_tpu.observability.runtime_health import (
+        install_sigusr2_dump,
+    )
+
+    install_sigusr2_dump()
     server = build_server(args).start()
     if args.warmup_tokens > 0:
         warmup(server, args.warmup_tokens)

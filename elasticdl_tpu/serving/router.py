@@ -302,6 +302,14 @@ class Replica(object):
         # window) — what prefix-affinity routing will rank by
         self.prefix_hit_rate_window = 0.0
         self.queue_wait_ms = 0.0
+        # runtime-health self-report, passed through from
+        # ServerStatus: "" = the replica predates the health plane
+        # (or runs with it off) — lease decay is the only wedge
+        # signal then; "stalled" takes the replica out of the
+        # dispatch rotation and arms the autoscaler's fast
+        # self-report replacement path
+        self.health_state = ""
+        self.last_progress_age_ms = 0.0
         self.ttft_hist = []
         self.queue_wait_hist = []
         # terminally-slow requests by dominant attributed cause
@@ -375,7 +383,11 @@ class Replica(object):
         return now < self.lease_expires_at
 
     def in_rotation(self, now):
+        # a self-reported stalled replica serves nothing even though
+        # its (gRPC-thread) lease renews fine — dispatching to it
+        # only buys redispatch latency later
         return (self.lease_ok(now) and not self.draining
+                and self.health_state != "stalled"
                 and self.breaker.eligible(now))
 
     def load_score(self):
@@ -406,6 +418,8 @@ class Replica(object):
         self.host_drops = status.host_drops
         self.prefix_hit_rate_window = status.prefix_hit_rate_window
         self.queue_wait_ms = status.queue_wait_ms
+        self.health_state = status.health_state
+        self.last_progress_age_ms = status.last_progress_age_ms
         # raw histogram buckets (mergeable by addition): the router
         # sums these across replicas for fleet-wide percentiles
         self.ttft_hist = list(status.ttft_hist)
@@ -1059,6 +1073,8 @@ class Router(object):
                 failures=rep.failures,
                 inflight=rep.inflight,
                 slow_cause_counts=rep.slow_cause_counts,
+                health_state=rep.health_state,
+                last_progress_age_ms=rep.last_progress_age_ms,
             ))
         autoscaler = None
         if self.autoscaler is not None:

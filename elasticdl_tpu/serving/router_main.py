@@ -175,6 +175,13 @@ def build_supervisor(args, router):
 
 def main(argv=None):
     args = parse_router_args(argv)
+    # SIGUSR2 -> all-thread stack dump: a live wedged router can
+    # always be interrogated without killing it
+    from elasticdl_tpu.observability.runtime_health import (
+        install_sigusr2_dump,
+    )
+
+    install_sigusr2_dump()
     router = build_router(args).start()
     supervisor = None
     if args.autoscale:

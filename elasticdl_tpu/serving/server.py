@@ -31,6 +31,8 @@ from concurrent import futures
 
 from elasticdl_tpu.common.fault_injection import (
     SERVING_RPCS,
+    FaultInjector,
+    InjectedRpcError,
     maybe_wrap_servicer,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
@@ -54,6 +56,11 @@ from elasticdl_tpu.serving.engine import (
     kv_paged_default,
     kv_shared_default,
     profile_default,
+)
+from elasticdl_tpu.observability.runtime_health import (
+    RuntimeHealth,
+    runtime_health_default,
+    stall_after_default,
 )
 from elasticdl_tpu.serving.hot_reload import CheckpointWatcher
 from elasticdl_tpu.serving.telemetry import ServingTelemetry
@@ -130,7 +137,9 @@ class ServingConfig(object):
                  port=0, max_workers=64, kv_paged=None,
                  kv_block_size=16, kv_num_blocks=0, kv_shared=None,
                  draft_k=0, kv_host_bytes=None, metrics_port=None,
-                 profile=None, forensics=None):
+                 profile=None, forensics=None, runtime_health=None,
+                 stall_after_secs=None, health_reconcile_secs=2.0,
+                 health_dir=None):
         self.num_slots = int(num_slots)
         self.queue_capacity = int(queue_capacity)
         self.top_k = int(top_k)
@@ -173,6 +182,29 @@ class ServingConfig(object):
             forensics_default() if forensics is None
             else bool(forensics)
         )
+        # the runtime health plane (observability/runtime_health.py;
+        # None resolves from EDL_RUNTIME_HEALTH, default on): the
+        # recompile sentry on every engine/pool/decode jit site, the
+        # device-memory ledger reconciliation, and the progress
+        # watchdog + flight recorder behind ServerStatus
+        # health_state/last_progress_age_ms — one switch so the bench
+        # overhead A/B can price all three layers together
+        self.runtime_health = (
+            runtime_health_default() if runtime_health is None
+            else bool(runtime_health)
+        )
+        # watchdog budget: work seated but no progress (tokens OR jit
+        # compiles) for this long = stalled (None -> EDL_STALL_AFTER_
+        # SECS -> 10 s: far above a healthy step, far below the 30 s
+        # lease heuristic the self-report exists to beat)
+        self.stall_after_secs = (
+            stall_after_default() if stall_after_secs is None
+            else float(stall_after_secs)
+        )
+        self.health_reconcile_secs = float(health_reconcile_secs)
+        # bundle directory (None resolves from EDL_HEALTH_DIR; "" =
+        # advertise-only: stalls count and self-report, no dump)
+        self.health_dir = health_dir
 
 
 class _Scheduler(threading.Thread):
@@ -184,13 +216,20 @@ class _Scheduler(threading.Thread):
 
     def __init__(self, engine, queue, telemetry, watcher=None,
                  idle_wait_secs=0.05, clock=time.monotonic,
-                 forensics_on=True):
+                 forensics_on=True, injector=None, health=None):
         super().__init__(daemon=True, name="serving-scheduler")
         self.engine = engine
         self.queue = queue
         self.telemetry = telemetry
         self.watcher = watcher
         self.idle_wait_secs = idle_wait_secs
+        # runtime-health plane (RuntimeHealth or None): the loop feeds
+        # its flight ring one snapshot per decode tick
+        self.health = health
+        # the engine_step fault hook (HEALTH_RPCS): drills inject a
+        # scheduler stall (delay) or a dropped tick exactly here —
+        # the choke point every decode tick passes through
+        self._injector = injector
         # slow-cause attribution at terminal paths (forensics plane)
         self.forensics_on = bool(forensics_on)
         self._clock = clock
@@ -246,6 +285,16 @@ class _Scheduler(threading.Thread):
                       "deadline expired mid-decode"))
         self._fill_slots()
         if self.engine.active_count():
+            if self._injector is not None:
+                # the stall drill's injection point: a delay rule
+                # wedges THIS thread mid-loop (work stays seated, no
+                # tokens commit — exactly the failure the watchdog
+                # must catch from its own thread); a drop rule skips
+                # one tick
+                try:
+                    self._injector.intercept("engine_step")
+                except InjectedRpcError:
+                    return
             t0 = self._clock()
             results = self.engine.step()
             dt = self._clock() - t0
@@ -263,6 +312,10 @@ class _Scheduler(threading.Thread):
                 kv_host_blocks=kv.get("kv_host_blocks"),
                 kv_host_bytes=kv.get("kv_host_bytes"),
             )
+            if self.health is not None:
+                self.health.record_tick(
+                    len(self.queue), len(results), dt, committed
+                )
         else:
             self.queue.wait_for_work(self.idle_wait_secs)
 
@@ -414,7 +467,7 @@ class ServingServicer(object):
 
     def __init__(self, queue, engine, telemetry, scheduler_alive,
                  handler_poll_secs=0.25, clock=time.monotonic,
-                 draining=None):
+                 draining=None, health=None):
         self._queue = queue
         self._engine = engine
         self._telemetry = telemetry
@@ -422,6 +475,11 @@ class ServingServicer(object):
         self._poll = handler_poll_secs
         self._clock = clock
         self._draining = draining or (lambda: False)
+        # runtime-health plane (RuntimeHealth or None): the status
+        # RPC stamps its self-report onto ServerStatus — served from
+        # gRPC threads, deliberately NOT the scheduler, so a wedged
+        # scheduler can still confess
+        self._health = health
 
     # ------------------------------------------------------------- RPCs
 
@@ -508,7 +566,27 @@ class ServingServicer(object):
             # terminally-slow requests by dominant attributed cause,
             # aligned with ServingTelemetry.SLOW_CAUSES declared order
             slow_cause_counts=snap["slow_cause_counts"],
+            # runtime health self-report (observability/
+            # runtime_health.py); all-zero/"" with the plane off —
+            # the wire signal routers/autoscalers key the fallback on
+            **self._health_fields(),
         )
+
+    def _health_fields(self):
+        if self._health is None:
+            return {}
+        # a status read is also a watchdog evaluation: detection
+        # cannot lag the poll that would have reported it
+        self._health.check()
+        h = self._health.snapshot()
+        return {
+            "last_progress_age_ms": h["last_progress_age_ms"],
+            "health_state": h["health_state"],
+            "jit_compiles": h["jit_compiles"],
+            "steady_recompiles": h["steady_recompiles"],
+            "memory_unaccounted_bytes":
+                h["memory_unaccounted_bytes"],
+        }
 
     # --------------------------------------------------------- internals
 
@@ -650,6 +728,31 @@ class GenerationServer(object):
         # paged engine forwards it to the KV pool for revive timing
         if cfg.profile:
             self.engine.profiler = StepProfiler()
+        # one injector serves the servicer wrapper AND the health/
+        # scheduler hooks, so a single EDL_FAULT_SPEC drives a drill
+        # end-to-end (rule state is shared, as it must be)
+        self._injector = injector or FaultInjector.from_env()
+        # the runtime health plane (observability/runtime_health.py):
+        # recompile sentry adopted by the engine (which forwards it to
+        # the paged pool and the offline decode caches), device-memory
+        # ledger reconciliation, progress watchdog + flight recorder —
+        # driven by its OWN daemon thread, because the scheduler being
+        # wedged is the failure under observation
+        self.health = None
+        if cfg.runtime_health:
+            self.health = RuntimeHealth(
+                self.engine, self.queue, self.telemetry,
+                stall_after_secs=cfg.stall_after_secs,
+                reconcile_secs=cfg.health_reconcile_secs,
+                health_dir=cfg.health_dir,
+                injector=self._injector,
+            )
+            self.engine.sentry = self.health.sentry
+            # the dense engine carries a plain attribute (no property
+            # forwarding), so the offline decode caches adopt here
+            from elasticdl_tpu.api.generation import set_decode_sentry
+
+            set_decode_sentry(self.health.sentry)
         watcher = None
         if cfg.checkpoint_dir:
             watcher = CheckpointWatcher(
@@ -662,12 +765,14 @@ class GenerationServer(object):
             self.engine, self.queue, self.telemetry, watcher=watcher,
             idle_wait_secs=cfg.idle_wait_secs,
             forensics_on=cfg.forensics,
+            injector=self._injector, health=self.health,
         )
         servicer = ServingServicer(
             self.queue, self.engine, self.telemetry,
             scheduler_alive=self.scheduler.is_alive,
             handler_poll_secs=cfg.handler_poll_secs,
             draining=self.scheduler.is_draining,
+            health=self.health,
         )
         # the unwrapped servicer: in-process warmup (serving/main.py
         # --warmup_tokens) goes through it so a warmup request can
@@ -676,7 +781,7 @@ class GenerationServer(object):
         # EDL_FAULT_SPEC (or an explicit injector) arms drop/error/
         # delay/kill at the RPC boundary, exactly like the master
         self.servicer = maybe_wrap_servicer(
-            servicer, injector, rpcs=SERVING_RPCS
+            servicer, self._injector, rpcs=SERVING_RPCS
         )
         self._server = None
         self.port = None
@@ -690,10 +795,23 @@ class GenerationServer(object):
         fams = self.telemetry.prometheus()
         if self.engine.profiler is not None:
             fams.extend(self.engine.profiler.prometheus())
+        if self.health is not None:
+            # the per-fn recompile family (the scalar health gauges/
+            # counters already ride the closed telemetry sets)
+            fams.extend(self.health.prometheus())
         return fams
+
+    def mark_steady(self):
+        """Declare warmup over (runtime health): recompiles become
+        counted anomalies and the memory baseline re-anchors. No-op
+        with the plane off — warmup call sites never need to care."""
+        if self.health is not None:
+            self.health.mark_steady()
 
     def start(self, grpc_server=True):
         self.scheduler.start()
+        if self.health is not None:
+            self.health.start()
         if self.config.metrics_port is not None:
             self.metrics = MetricsServer(
                 self._metrics_families, port=self.config.metrics_port
@@ -731,6 +849,8 @@ class GenerationServer(object):
         then stop the transport. Safe to call twice."""
         self.scheduler.stop(drain=drain)
         self.scheduler.join(timeout=60.0)
+        if self.health is not None:
+            self.health.stop()
         if self._server is not None:
             self._server.stop(grace).wait()
             self._server = None

@@ -91,20 +91,33 @@ class ServingTelemetry(object):
     #: those uploads seated WITHOUT re-running prefill, host_drops the
     #: spilled entries the bounded host LRU (or a reload flush)
     #: discarded.
+    #: The runtime-health pair (observability/runtime_health.py):
+    #: steady_recompiles counts post-warmup-boundary recompiles of an
+    #: already-compiled executable (the zero-recompile anomaly class;
+    #: the per-fn distribution is the sentry's own labeled
+    #: edl_serving_recompiles_total{fn=} family), stalls the
+    #: ok->stalled watchdog transitions (work seated, no progress for
+    #: the budget — each one also dumps a diagnostic bundle).
     COUNTERS = ("admitted", "rejected", "expired", "completed",
                 "tokens_generated", "reloads", "prefix_hit_tokens",
                 "prompt_tokens", "cow_copies", "draft_proposed",
                 "draft_accepted", "revive_uploads",
-                "prefill_tokens_revived", "host_drops")
+                "prefill_tokens_revived", "host_drops",
+                "steady_recompiles", "stalls")
     #: the closed gauge set — gauge()/_gauge_locked REJECT anything
     #: else, exactly like the counters (EDL401 is the static twin for
     #: both). These are the serving/<name> TensorBoard tags and the
     #: edl_serving_<name> Prometheus series.
+    #: last_progress_age_ms / memory_unaccounted_bytes are the
+    #: runtime-health plane's scrape surface (watchdog age at the
+    #: last reconcile; the memory accountant's monotone PEAK
+    #: unaccounted-drift watermark)
     GAUGES = ("queue_depth", "active_slots", "step_ms",
               "tokens_per_sec", "ttft_ms", "queue_wait_ms",
               "kv_bytes_in_use", "kv_blocks_free", "kv_host_blocks",
               "kv_host_bytes", "ttft_p99", "e2e_p99",
-              "prefix_hit_rate_window")
+              "prefix_hit_rate_window", "last_progress_age_ms",
+              "memory_unaccounted_bytes")
     #: latency histograms (ms), all on the shared bucket scheme
     HISTOGRAMS = ("ttft_ms", "queue_wait_ms", "step_ms", "e2e_ms")
     #: the closed slow-cause label set (observability/forensics.py

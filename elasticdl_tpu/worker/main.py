@@ -73,6 +73,13 @@ def main(argv=None):
     )
 
     honor_jax_platforms_env()
+    # SIGUSR2 -> all-thread stack dump: a live wedged worker can
+    # always be interrogated without killing its task
+    from elasticdl_tpu.observability.runtime_health import (
+        install_sigusr2_dump,
+    )
+
+    install_sigusr2_dump()
     args = parse_worker_args(argv)
     logger.info(
         "Worker %d starting, master=%s", args.worker_id, args.master_addr

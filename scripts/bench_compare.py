@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare a fresh serve-smoke record against
+the committed baseline, per-metric tolerances, exit nonzero on
+regression.
+
+The serving bench has emitted `BENCH_SERVING.json` since PR 3, and
+every PR's numbers have been eyeballed in review — nothing MACHINE-
+checked that bytes/token, goodput, the observability overhead ratio or
+tok/s stayed where the trajectory left them. This script starts the
+bench trajectory as a CI gate:
+
+    python scripts/bench_compare.py \\
+        --fresh BENCH_SERVING.json \\
+        --baseline benchmarks/serving_baseline.json
+
+Semantics, tuned for a SHARED CPU CI box (the same reality that set
+the autoscale drill's SLO margins):
+
+* throughput metrics (tok/s, goodput) are noisy — the default
+  tolerance is generous (30% relative) and catches collapses, not
+  jitter;
+* memory metrics (bytes/token) are DETERMINISTIC for a fixed workload
+  — the tolerance is tight (10%), because a bytes/token regression is
+  an algorithmic change, not scheduling noise;
+* the observability overhead ratio and the steady-recompile count are
+  ABSOLUTE bounds (>= 0.95, == 0): they are invariants, not
+  trajectories, and no baseline drift may relax them;
+* a metric the BASELINE lacks is reported as `new` and passes (the
+  trajectory grows as benches grow); a metric the FRESH record lacks
+  that the baseline has FAILS (a silently vanished bench leg is a
+  regression of the gate itself).
+
+Tolerances are overridable per metric (``--tol tokens_per_sec=0.5``)
+so a deliberate trade (e.g. spending throughput to buy memory) can
+land with its justification visible in the CI config rather than by
+editing the gate. Update the baseline deliberately, with the PR that
+improves it:
+
+    make serve-smoke && cp BENCH_SERVING.json \\
+        benchmarks/serving_baseline.json
+"""
+
+import argparse
+import json
+import sys
+
+#: relative-tolerance metrics: (dotted path, direction, default tol).
+#: direction "higher" = fresh must be >= baseline * (1 - tol);
+#: "lower" = fresh must be <= baseline * (1 + tol).
+RELATIVE_METRICS = (
+    ("tokens_per_sec", "higher", 0.30),
+    ("goodput_rps", "higher", 0.30),
+    ("kv.bytes_per_token", "lower", 0.10),
+    ("paged_shared.tokens_per_sec", "higher", 0.30),
+    ("paged_shared.kv.bytes_per_token", "lower", 0.10),
+    ("paged_int8.kv.bytes_per_token", "lower", 0.10),
+)
+
+#: absolute-bound metrics: (dotted path, op, bound) — invariants the
+#: baseline can never relax.
+ABSOLUTE_METRICS = (
+    ("profiler_overhead.tokens_per_sec_ratio", ">=", 0.95),
+    ("health.steady_recompiles", "==", 0),
+)
+
+
+def lookup(record, path):
+    """Resolve a dotted path in a nested dict; None when any hop is
+    missing or not a dict."""
+    node = record
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def compare(fresh, baseline, tolerances=None):
+    """Pure comparison: returns {"rows": [...], "regressions": [...],
+    "ok": bool}. Each row: {metric, kind, fresh, baseline, bound,
+    status} with status in ok|new|regression|missing_fresh."""
+    tolerances = tolerances or {}
+    rows = []
+
+    for path, direction, default_tol in RELATIVE_METRICS:
+        tol = float(tolerances.get(path, default_tol))
+        f = lookup(fresh, path)
+        b = lookup(baseline, path)
+        row = {"metric": path, "kind": "relative:%s" % direction,
+               "fresh": f, "baseline": b, "tolerance": tol}
+        if b is None:
+            row["status"] = "new" if f is not None else "absent"
+        elif f is None:
+            row["status"] = "missing_fresh"
+        else:
+            f, b = float(f), float(b)
+            if direction == "higher":
+                bound = b * (1.0 - tol)
+                ok = f >= bound
+            else:
+                bound = b * (1.0 + tol)
+                ok = f <= bound
+            row["bound"] = round(bound, 3)
+            row["status"] = "ok" if ok else "regression"
+        rows.append(row)
+
+    for path, op, bound in ABSOLUTE_METRICS:
+        f = lookup(fresh, path)
+        row = {"metric": path, "kind": "absolute%s%s" % (op, bound),
+               "fresh": f, "baseline": lookup(baseline, path),
+               "bound": bound}
+        if f is None:
+            # absolute invariants bind only when the fresh record
+            # carries the leg (e.g. --overhead_ab off in a quick run);
+            # the baseline having it makes absence a failure
+            row["status"] = (
+                "missing_fresh"
+                if lookup(baseline, path) is not None else "absent"
+            )
+        else:
+            f = float(f)
+            ok = f >= bound if op == ">=" else f == bound
+            row["status"] = "ok" if ok else "regression"
+        rows.append(row)
+
+    regressions = [r for r in rows
+                   if r["status"] in ("regression", "missing_fresh")]
+    return {"rows": rows, "regressions": regressions,
+            "ok": not regressions}
+
+
+def render(result):
+    lines = []
+    for r in result["rows"]:
+        lines.append(
+            "%-45s %-18s fresh=%-12s base=%-12s %s"
+            % (r["metric"], r["kind"],
+               r["fresh"] if r["fresh"] is not None else "-",
+               r["baseline"] if r["baseline"] is not None else "-",
+               r["status"].upper())
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--fresh", default="BENCH_SERVING.json")
+    parser.add_argument("--baseline",
+                        default="benchmarks/serving_baseline.json")
+    parser.add_argument(
+        "--tol", action="append", default=[],
+        metavar="METRIC=TOL",
+        help="override one metric's relative tolerance "
+             "(repeatable), e.g. --tol tokens_per_sec=0.5",
+    )
+    parser.add_argument("--out", default="",
+                        help="also write the comparison JSON here")
+    args = parser.parse_args(argv)
+
+    tolerances = {}
+    for item in args.tol:
+        key, _, value = item.partition("=")
+        try:
+            tolerances[key] = float(value)
+        except ValueError:
+            parser.error("bad --tol %r (want METRIC=FLOAT)" % item)
+
+    with open(args.fresh) as f:
+        fresh = json.load(f)
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    result = compare(fresh, baseline, tolerances)
+    print(render(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    if not result["ok"]:
+        print("bench_compare: %d regression(s) vs %s"
+              % (len(result["regressions"]), args.baseline),
+              file=sys.stderr)
+        return 1
+    print("bench_compare: within tolerance of %s" % args.baseline)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

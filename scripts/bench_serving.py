@@ -342,7 +342,7 @@ def build_plan(args, seq_len, vocab):
 def run_load(args, trainer, state, plan, num_slots, kv_paged,
              kv_block_size, kv_num_blocks, kv_shared=False,
              draft=None, draft_k=0, kv_host_bytes=0, profile=False,
-             metrics_port=None, forensics=True):
+             metrics_port=None, forensics=True, runtime_health=True):
     import jax
 
     from elasticdl_tpu.observability.tracing import new_trace_id
@@ -364,6 +364,7 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             profile=profile,
             metrics_port=metrics_port,
             forensics=forensics,
+            runtime_health=runtime_health,
         ),
         draft=draft,
     ).start()
@@ -373,6 +374,12 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
     stub.generate(
         pb.GenerateRequest(prompt=[1, 2], max_new_tokens=2), timeout=300
     )
+    # the runtime-health steady boundary: every compile from here on
+    # of an ALREADY-COMPILED executable is a counted anomaly — the
+    # "churn never recompiles" invariant this bench asserts at zero.
+    # (First compiles of new bucket names mid-run are the cold path
+    # working as designed and stay legal.)
+    server.mark_steady()
 
     results = []
     lock = threading.Lock()
@@ -424,6 +431,8 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
     wall = time.monotonic() - bench_t0
 
     status = stub.server_status(pb.ServerStatusRequest(), timeout=30)
+    health_snap = (server.health.snapshot()
+                   if server.health is not None else None)
     profile_snap = None
     if profile and server.engine.profiler is not None:
         profile_snap = server.engine.profiler.snapshot()
@@ -525,6 +534,20 @@ def run_load(args, trainer, state, plan, num_slots, kv_paged,
             ) if status.draft_proposed else 0.0,
         },
     }
+    if health_snap is not None:
+        # the runtime health plane's own verdict on the run: total
+        # compiles, post-boundary recompiles (must be 0 — main()
+        # gates on it), the watchdog state and the accountant's peak
+        # unaccounted drift
+        record["health"] = {
+            "jit_compiles": health_snap["jit_compiles"],
+            "recompiles": health_snap["recompiles"],
+            "steady_recompiles": health_snap["steady_recompiles"],
+            "health_state": health_snap["health_state"],
+            "stalls": health_snap["stalls"],
+            "memory_unaccounted_bytes":
+                health_snap["memory_unaccounted_bytes"],
+        }
     if profile_snap is not None:
         # the per-step decode profiler breakdown: p50/p99/count per
         # phase (serving/engine.py StepProfiler.snapshot shape)
@@ -806,9 +829,12 @@ def run_overhead_ab(args, trainer, state, plan, num_slots,
     """The observability overhead A/B: the SAME arrival plan on the
     paged+shared pool, plane OFF (no profiler, no exposition, no
     forensics — exemplars, tail retention and slow-cause attribution
-    all disarmed) vs ON (profiler armed — split compiled steps — plus
-    a live /metrics server that gets scraped at the end, plus the full
-    forensics plane). tokens/sec must stay within OVERHEAD_BOUND; one
+    all disarmed — and no runtime health: sentry, accountant and
+    watchdog all absent) vs ON (profiler armed — split compiled
+    steps — plus a live /metrics server that gets scraped at the end,
+    the full forensics plane AND the runtime health plane: recompile
+    sentry on every executable, ledger reconciliation, progress
+    watchdog). tokens/sec must stay within OVERHEAD_BOUND; one
     retry forgives a scheduler hiccup on a noisy CI box, but two
     misses fail the bench (a >5% observability tax is a regression,
     not noise)."""
@@ -819,7 +845,7 @@ def run_overhead_ab(args, trainer, state, plan, num_slots,
             kv_paged=True, kv_block_size=args.kv_block_size,
             kv_num_blocks=num_blocks, kv_shared=True,
             draft=draft, draft_k=args.draft_k,
-            forensics=False,
+            forensics=False, runtime_health=False,
         )
         on, _ = run_load(
             args, trainer, state, plan, num_slots,
@@ -827,6 +853,7 @@ def run_overhead_ab(args, trainer, state, plan, num_slots,
             kv_num_blocks=num_blocks, kv_shared=True,
             draft=draft, draft_k=args.draft_k,
             profile=True, metrics_port=0, forensics=True,
+            runtime_health=True,
         )
         ratio = ((on["tokens_per_sec"] or 0.0)
                  / (off["tokens_per_sec"] or 1e-9))
@@ -1061,6 +1088,26 @@ def main(argv=None):
         print("profiler overhead A/B OUT OF BOUND: ratio %.4f < %.4f"
               % (overhead["tokens_per_sec_ratio"],
                  1.0 - OVERHEAD_BOUND), file=sys.stderr)
+        return 1
+    # the recompile sentry's steady-state invariant: once the warmup
+    # boundary is marked, membership churn must never recompile an
+    # existing executable — a nonzero count here is the compile-storm
+    # failure class the health plane exists to catch, and it fails
+    # the bench on every leg that carried the plane
+    steady_violations = [
+        (leg, rec["health"]["steady_recompiles"])
+        for leg, rec in [("base", record)] + [
+            (k, record[k]) for k in ("paged", "paged_shared",
+                                     "paged_shared_spec", "paged_int8")
+            if isinstance(record.get(k), dict)
+        ]
+        if isinstance(rec.get("health"), dict)
+        and rec["health"]["steady_recompiles"]
+    ]
+    if steady_violations:
+        print("STEADY-STATE RECOMPILES detected: %r (the zero-"
+              "recompile invariant is broken)" % steady_violations,
+              file=sys.stderr)
         return 1
     return 0 if record["completed"] > 0 else 1
 

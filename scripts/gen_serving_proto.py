@@ -158,6 +158,23 @@ SERVING_MESSAGES = {
         # same closed set behind edl_serving_slow_cause_total): the
         # scrapeable distribution of WHY, not just the that
         ("slow_cause_counts", 46, T.TYPE_INT64, _REP),
+        # runtime health plane (observability/runtime_health.py):
+        # the progress watchdog's self-report — ms since the
+        # scheduler last made progress with work seated (0 = idle or
+        # moving) and the watchdog state "ok" | "stalled" ("" = the
+        # replica predates the health plane / runs with it off, the
+        # autoscaler's cue to fall back to lease decay)
+        ("last_progress_age_ms", 47, T.TYPE_DOUBLE, _OPT),
+        ("health_state", 48, T.TYPE_STRING, _OPT),
+        # recompile sentry: total tracked jit compilations, and the
+        # post-warmup-boundary recompile anomalies ("churn never
+        # recompiles" — serve-smoke pins steady_recompiles at zero)
+        ("jit_compiles", 49, T.TYPE_INT64, _OPT),
+        ("steady_recompiles", 50, T.TYPE_INT64, _OPT),
+        # device-memory accountant: PEAK unaccounted device-byte
+        # drift since the steady baseline (ledger vs live buffers) —
+        # a leak detector, monotone by construction
+        ("memory_unaccounted_bytes", 51, T.TYPE_INT64, _OPT),
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],
@@ -238,6 +255,12 @@ SERVING_MESSAGES = {
         # slow-cause distribution, passed through from ServerStatus
         # (forensics taxonomy, declared order)
         ("slow_cause_counts", 20, T.TYPE_INT64, _REP),
+        # runtime health, passed through from ServerStatus: a
+        # "stalled" replica leaves the dispatch rotation and the
+        # supervisor replaces it on a seconds-scale budget instead
+        # of the 30 s lease heuristic ("" = pre-health replica)
+        ("last_progress_age_ms", 21, T.TYPE_DOUBLE, _OPT),
+        ("health_state", 22, T.TYPE_STRING, _OPT),
     ],
     "RouterStatusResponse": [
         ("replicas", 1, T.TYPE_INT32, _OPT),

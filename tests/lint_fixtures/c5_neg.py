@@ -33,3 +33,10 @@ class Frontend(object):
     def slow_dynamic(self, cause):
         # dynamic cause: the runtime raise owns it
         self.telemetry.count_slow_cause(cause)
+
+    def health(self):
+        # the runtime-health plane's declared names: clean
+        self.telemetry.count("steady_recompiles")
+        self.telemetry.count("stalls")
+        self.telemetry.gauge("last_progress_age_ms", 0.0)
+        self.telemetry.gauge("memory_unaccounted_bytes", 0)

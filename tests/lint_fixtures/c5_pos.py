@@ -27,3 +27,14 @@ def slow(telemetry):
     # typo'd slow cause: forks a labeled series no cause taxonomy
     # consumer will ever aggregate -> EDL401
     telemetry.count_slow_cause("queue_wiat")
+
+
+def health(telemetry):
+    # typo'd runtime-health counter (steady_recompiles): the anomaly
+    # count would fork and serve-smoke's zero-recompile gate would
+    # watch a dead series -> EDL401
+    telemetry.count("steady_recompile")
+    # typo'd runtime-health gauge (last_progress_age_ms): the
+    # autoscaler's self-report signal would scrape a dead series
+    # -> EDL401
+    telemetry.gauge("last_progress_age", 120.0)

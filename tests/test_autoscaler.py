@@ -50,6 +50,10 @@ class FakeReplicaStub(object):
         self.kv_blocks_free = 8
         self.kv_blocks_cached = 0
         self.queue_wait_ms = 0.0
+        # runtime-health self-report: "" = a pre-health replica (the
+        # lease-decay fallback's whole constituency)
+        self.health_state = ""
+        self.last_progress_age_ms = 0.0
         self.closed = 0
 
     def server_status(self, request, timeout=None):
@@ -62,6 +66,8 @@ class FakeReplicaStub(object):
             kv_blocks_cached=self.kv_blocks_cached,
             queue_wait_ms=self.queue_wait_ms,
             draining=self.draining,
+            health_state=self.health_state,
+            last_progress_age_ms=self.last_progress_age_ms,
         )
 
     def close(self):
@@ -446,11 +452,14 @@ def test_successful_adoption_resets_the_failure_streak():
 
 
 def test_wedged_replica_is_killed_and_replaced():
-    """A SIGSTOPped/hung replica never exits, but its lease decays:
-    the supervisor must kill and replace it."""
+    """LEASE-DECAY FALLBACK path (pre-health replicas: the stub's
+    health_state is ""): a SIGSTOPped/hung replica never exits, but
+    its lease decays — the supervisor must kill and replace it on the
+    conservative wedged_after_secs window."""
     sup, router, launcher, clock = build(lease_secs=5.0)
     settle(sup, router)
     wedged = launcher.spawned[0]
+    assert launcher.stubs[wedged.address].health_state == ""
     launcher.stubs[wedged.address].poll_ok = False
     clock.advance(6.0)  # lease decays un-renewed
     router.poll_once()
@@ -463,6 +472,65 @@ def test_wedged_replica_is_killed_and_replaced():
     assert sup.replacements == 1
     settle(sup, router)
     assert sup.status_block().live == 1
+
+
+def test_self_reported_stall_beats_the_lease_heuristic():
+    """SELF-REPORT path (runtime health plane): a replica whose
+    watchdog says `stalled` keeps renewing its lease (the gRPC
+    threads are fine — only the scheduler is wedged), so the lease
+    path would need wedged_after_secs of silence that never comes.
+    The supervisor must kill it on the seconds-scale
+    stalled_kill_after_secs budget instead, while the lease stays
+    VALID the whole way."""
+    sup, router, launcher, clock = build(
+        wedged_after_secs=30.0, stalled_kill_after_secs=1.0,
+    )
+    settle(sup, router)
+    wedged = launcher.spawned[0]
+    stub = launcher.stubs[wedged.address]
+    stub.health_state = "stalled"
+    stub.last_progress_age_ms = 4000.0
+    router.poll_once()
+    # the stalled replica leaves the dispatch rotation immediately
+    # (still registered, lease still valid)
+    rep = {r.address: r for r in router.replicas()}[wedged.address]
+    assert rep.lease_ok(clock()) and not rep.in_rotation(clock())
+    assert rep.health_state == "stalled"
+    sup.decide_once()  # stalled window opens
+    assert not wedged.killed
+    clock.advance(1.1)  # stalled_kill_after_secs — NOT 30 s
+    router.poll_once()
+    sup.decide_once()
+    assert wedged.killed
+    sup.decide_once()
+    assert sup.replacements == 1
+    settle(sup, router)
+    assert sup.status_block().live == 1
+
+
+def test_stall_self_report_recovery_cancels_the_kill():
+    """A stall that RECOVERS (tokens flow again — e.g. a pathological
+    but finite compile) before the kill budget elapses must reset the
+    window: transient pain is not grounds for execution."""
+    sup, router, launcher, clock = build(stalled_kill_after_secs=2.0)
+    settle(sup, router)
+    seat = launcher.spawned[0]
+    stub = launcher.stubs[seat.address]
+    stub.health_state = "stalled"
+    router.poll_once()
+    sup.decide_once()  # window opens
+    clock.advance(1.0)
+    stub.health_state = "ok"  # recovered
+    router.poll_once()
+    sup.decide_once()  # window must reset
+    clock.advance(5.0)
+    router.poll_once()
+    sup.decide_once()
+    assert not seat.killed
+    assert sup.replacements == 0
+    # a replica back to "ok" rejoins the rotation
+    rep = {r.address: r for r in router.replicas()}[seat.address]
+    assert rep.in_rotation(clock())
 
 
 # ------------------------------------------------------ fault injection

@@ -1,0 +1,125 @@
+"""Unit coverage for scripts/bench_compare.py — the serve-smoke
+regression gate: direction-aware relative tolerances, absolute
+invariants that no baseline drift may relax, new-metric grace,
+vanished-leg failure, and the CLI exit contract."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "scripts")
+)
+
+import bench_compare  # noqa: E402
+
+
+def record(tok=100.0, good=10.0, bpt=500.0, overhead=0.99, steady=0):
+    return {
+        "tokens_per_sec": tok,
+        "goodput_rps": good,
+        "kv": {"bytes_per_token": bpt},
+        "paged_shared": {
+            "tokens_per_sec": tok,
+            "kv": {"bytes_per_token": bpt},
+        },
+        "paged_int8": {"kv": {"bytes_per_token": bpt / 4}},
+        "profiler_overhead": {"tokens_per_sec_ratio": overhead},
+        "health": {"steady_recompiles": steady},
+    }
+
+
+def statuses(result):
+    return {r["metric"]: r["status"] for r in result["rows"]}
+
+
+def test_identical_records_pass():
+    base = record()
+    result = bench_compare.compare(record(), base)
+    assert result["ok"], result["regressions"]
+    assert set(statuses(result).values()) == {"ok"}
+
+
+def test_throughput_tolerance_is_directional():
+    base = record(tok=100.0)
+    # 20% slower: inside the 30% band
+    assert bench_compare.compare(record(tok=80.0), base)["ok"]
+    # 40% slower: a collapse
+    result = bench_compare.compare(record(tok=60.0), base)
+    assert not result["ok"]
+    assert statuses(result)["tokens_per_sec"] == "regression"
+    # 40% FASTER is never a regression (direction-aware)
+    assert bench_compare.compare(record(tok=140.0), base)["ok"]
+
+
+def test_memory_tolerance_is_tight_and_lower_is_better():
+    base = record(bpt=500.0)
+    assert bench_compare.compare(record(bpt=540.0), base)["ok"]
+    result = bench_compare.compare(record(bpt=600.0), base)
+    assert not result["ok"]
+    assert statuses(result)["kv.bytes_per_token"] == "regression"
+    # less memory per token passes at any magnitude
+    assert bench_compare.compare(record(bpt=100.0), base)["ok"]
+
+
+def test_absolute_invariants_ignore_the_baseline():
+    # a rotten baseline must not grandfather a violation in
+    base = record(overhead=0.80, steady=3)
+    result = bench_compare.compare(record(overhead=0.80), base)
+    assert statuses(result)[
+        "profiler_overhead.tokens_per_sec_ratio"] == "regression"
+    result = bench_compare.compare(record(steady=1), base)
+    assert statuses(result)["health.steady_recompiles"] == "regression"
+    assert bench_compare.compare(record(), base)["ok"]
+
+
+def test_new_metric_passes_vanished_leg_fails():
+    base = record()
+    del base["paged_int8"]  # baseline predates the int8 leg
+    assert bench_compare.compare(record(), base)["ok"]
+    fresh = record()
+    del fresh["paged_shared"]  # a bench leg silently vanished
+    result = bench_compare.compare(fresh, base)
+    assert not result["ok"]
+    assert statuses(result)[
+        "paged_shared.tokens_per_sec"] == "missing_fresh"
+
+
+def test_tolerance_override():
+    base = record(tok=100.0)
+    fresh = record(tok=60.0)
+    assert not bench_compare.compare(fresh, base)["ok"]
+    assert bench_compare.compare(
+        fresh, base, tolerances={"tokens_per_sec": 0.5,
+                                 "paged_shared.tokens_per_sec": 0.5}
+    )["ok"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    fresh, base = tmp_path / "fresh.json", tmp_path / "base.json"
+    base.write_text(json.dumps(record()))
+    fresh.write_text(json.dumps(record()))
+    assert bench_compare.main(
+        ["--fresh", str(fresh), "--baseline", str(base)]
+    ) == 0
+    fresh.write_text(json.dumps(record(tok=10.0)))
+    out = tmp_path / "cmp.json"
+    assert bench_compare.main(
+        ["--fresh", str(fresh), "--baseline", str(base),
+         "--out", str(out)]
+    ) == 1
+    summary = json.loads(out.read_text())
+    assert summary["regressions"]
+    # the override rescues a deliberate trade
+    assert bench_compare.main(
+        ["--fresh", str(fresh), "--baseline", str(base),
+         "--tol", "tokens_per_sec=0.95",
+         "--tol", "paged_shared.tokens_per_sec=0.95"]
+    ) == 0
+    capsys.readouterr()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

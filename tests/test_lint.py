@@ -100,10 +100,11 @@ def test_c3_negative():
 
 def test_c5_positive():
     findings = lint_file("c5_pos.py")
-    assert rule_ids(findings) == ["EDL401"] * 6, findings
+    assert rule_ids(findings) == ["EDL401"] * 8, findings
     details = {f.detail for f in findings}
     assert details == {"admittd", "rejectd", "breaker_tripz",
-                       "queue_dept", "healthy_replica", "queue_wiat"}
+                       "queue_dept", "healthy_replica", "queue_wiat",
+                       "steady_recompile", "last_progress_age"}
     scopes = {f.scope for f in findings}
     assert "Frontend.admit" in scopes and "module_level" in scopes
     # gauge typos report as gauges, counter typos as counters,
@@ -112,6 +113,9 @@ def test_c5_positive():
     assert "gauge" in by_detail["queue_dept"]
     assert "counter" in by_detail["admittd"]
     assert "slow cause" in by_detail["queue_wiat"]
+    # the runtime-health names extend the same closed sets
+    assert "counter" in by_detail["steady_recompile"]
+    assert "gauge" in by_detail["last_progress_age"]
 
 
 def test_c5_negative():
@@ -142,6 +146,13 @@ def test_c5_allowed_set_tracks_telemetry_declarations():
     )
     assert "queue_depth" in declared_gauges()
     assert "healthy_replicas" in declared_gauges()
+    # the runtime-health extension rides the SAME single source: the
+    # new counter/gauge names are in the unions because telemetry.py
+    # declares them, not because any list here grew
+    assert "steady_recompiles" in declared_counters()
+    assert "stalls" in declared_counters()
+    assert "last_progress_age_ms" in declared_gauges()
+    assert "memory_unaccounted_bytes" in declared_gauges()
     from elasticdl_tpu.analysis.telemetry_rules import (
         declared_slow_causes,
     )
