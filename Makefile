@@ -10,20 +10,30 @@
 #                   wrong-lock-held bindings, jit hazards, donated-
 #                   buffer aliasing, blocking calls + deadline
 #                   propagation in servicers/dispatch paths, must-
-#                   release resource tracking, proto drift; baseline
-#                   in .edl-lint-baseline.json) + ruff (pinned in
-#                   ci.yml; skipped with a notice when absent locally).
+#                   release resource tracking, proto drift, the v3
+#                   compile-discipline family on the value-origin
+#                   dataflow — EDL105 recompile hazards, EDL106
+#                   captured-constant bloat, EDL107 PRNG-key
+#                   discipline — the born-gated EDL601 sharding
+#                   discipline, and EDL000 unused-pragma policing;
+#                   baseline in .edl-lint-baseline.json) + ruff
+#                   (pinned in ci.yml; skipped with a notice when
+#                   absent locally).
 #                   Useful flags (pass via LINT_FLAGS): --jobs N fans
 #                   per-file analysis over N processes (0 = one per
 #                   CPU; output byte-identical to serial — worth it on
 #                   multi-core runners), --format github emits GitHub
-#                   Actions ::error annotations (CI uses this so
-#                   findings render inline on PRs). `make lint-changed`
-#                   = --changed-only: lint only files changed vs the
-#                   git merge base plus untracked ones — the
-#                   pre-commit hook mode, sub-second on typical diffs
-#                   (stale-baseline enforcement is skipped there; only
-#                   full runs police baseline rot).
+#                   Actions ::error annotations, --format sarif
+#                   [--output F] writes byte-deterministic SARIF 2.1.0
+#                   (CI uploads it to GitHub code scanning), and
+#                   --fix-pragmas deletes unused suppressions.
+#                   `make lint-changed` = --changed-only: lint only
+#                   files changed vs the git merge base plus untracked
+#                   ones — the pre-commit hook mode, sub-second on
+#                   typical diffs (stale-baseline enforcement is
+#                   skipped there; only full runs police baseline
+#                   rot). Install the hook: bash
+#                   scripts/install-hooks.sh.
 #   default/fast  — everything NOT marked slow/integration (< 5 min,
 #                   the per-commit gate)
 #   drills        — the slow + integration shard: multi-process SPMD

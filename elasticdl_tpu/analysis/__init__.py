@@ -35,9 +35,11 @@ from elasticdl_tpu.analysis.core import (  # noqa: F401
     run_rules,
 )
 
-# importing the rule modules registers their rules
+# importing the rule modules registers their rules (EDL000 registers
+# with core itself — the unused-pragma check lives in the runner)
 from elasticdl_tpu.analysis import (  # noqa: F401,E402
     blocking_rules,
+    compile_rules,
     deadline_rules,
     donate_rules,
     jit_rules,
@@ -45,5 +47,6 @@ from elasticdl_tpu.analysis import (  # noqa: F401,E402
     lockgraph_rules,
     proto_rules,
     resource_rules,
+    sharding_rules,
     telemetry_rules,
 )

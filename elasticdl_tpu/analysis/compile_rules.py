@@ -1,0 +1,523 @@
+"""C14–C16 — compile-discipline rules on the value-origin dataflow
+(EDL105 recompile hazard / EDL106 captured-constant bloat / EDL107
+PRNG-key discipline).
+
+These are the STATIC twins of the PR 14 runtime health plane: the
+recompile sentry convicts steady-state recompiles after the first
+churned executable has already cost a compile; these rules convict the
+shapes that produce them at lint time, on the CFG/dataflow engine.
+
+* EDL105 — a call to a jit-wrapped executable (``jax.jit``/``pjit``/
+  ``tracked_jit`` and the repo's ``_tjit``/``_pool_tjit`` adapters,
+  bound by assignment in the same function or at module scope) passes
+  an argument with an UNSTABLE value origin (see value_origin.py):
+  loop-counter-derived ints when the call repeats inside that loop,
+  ``len()``/``.shape`` of growing containers, wall-clock or env reads.
+  Each such call re-keys the compile cache — the steady-state
+  recompile loop the sentry counts. The engine/kv_pool bucketing
+  idioms (``*_bucket`` helpers, ``-(-p // 64) * 64`` pads, power-of-
+  two tiles) are STABILIZERS, not hazards, and a wrapper (re)built in
+  the same loop as the call is a deliberate per-shape executable, not
+  cache churn.
+* EDL106 — a traced function (any jit context, decorator or wrap
+  idiom) READS a free variable that the enclosing scope bound to a
+  numpy/jnp array constructor (``np.zeros``/``jnp.asarray``/
+  ``device_put``/...). The capture is baked into the trace as a
+  CONSTANT: every retrace re-hashes and re-embeds the full array
+  (slow compiles, bloated executables), and an update to the name is
+  silently invisible to the compiled code. Arrays threaded as proper
+  arguments are clean — that is the fix.
+* EDL107 — PRNG-key discipline, two shapes: (a) one
+  ``jax.random.PRNGKey``-tainted name consumed by two or more
+  ``jax.random.*`` sampler sinks along one CFG path (loops included:
+  a single in-loop sink re-consumes the same key every iteration)
+  without an intervening ``split``/``fold_in`` or rebind — identical
+  "randomness" at every sink; (b) a closure defined inside a loop
+  capturing a key created OUTSIDE the loop — every iteration's
+  closure shares one key. The sanctioned idioms (``fold_in(rng,
+  position)`` per step, ``split`` then consume each child once) are
+  untouched.
+
+All three follow the engine's precision-first contract: attribute
+state and cross-function flows contribute nothing without
+same-function evidence; unresolvable receivers are silent.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.cfg import walk_shallow
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+from elasticdl_tpu.analysis.value_origin import (
+    ORIGIN_LEN,
+    ORIGIN_LEN_LOCAL,
+    ORIGIN_LOOP,
+    OriginAnalysis,
+    call_tail,
+    collect_jit_wrappers,
+    dotted_text,
+    enclosing_loops,
+    loop_bodies,
+)
+
+#: human-facing names per origin tag for EDL105 messages
+_TAG_TEXT = {
+    ORIGIN_LOOP: "a Python loop counter",
+    ORIGIN_LEN: "len()/.shape of a growing container",
+    ORIGIN_LEN_LOCAL: "len()/.shape of a growing container",
+    "clock": "a wall-clock read",
+    "config": "an environment/config read",
+}
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scope_name(tree, fndef):
+    """Class.method for methods, bare name otherwise."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            if fndef in node.body:
+                return "%s.%s" % (node.name, fndef.name)
+    return fndef.name
+
+
+# ------------------------------------------------- EDL105 recompile hazard
+
+
+@register
+class RecompileHazardRule(Rule):
+    """EDL105 — see module docstring."""
+
+    id = "EDL105"
+    name = "recompile-hazard"
+
+    def check_module(self, tree, lines, path):
+        findings = []
+        module_wrappers = collect_jit_wrappers(tree.body)
+        class_wrappers = {}  # id(method fndef) -> {self.X: binding}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = [m for m in node.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            shared = {}
+            for m in methods:
+                for spelling, binding in collect_jit_wrappers(
+                    m.body
+                ).items():
+                    if spelling.startswith("self."):
+                        shared[spelling] = binding
+            for m in methods:
+                class_wrappers[id(m)] = shared
+        for fndef in _functions(tree):
+            wrappers = dict(module_wrappers)
+            wrappers.update(class_wrappers.get(id(fndef), {}))
+            wrappers.update(collect_jit_wrappers(fndef.body))
+            if not wrappers:
+                continue
+            findings.extend(
+                self._check_function(fndef, wrappers, tree, path)
+            )
+        return findings
+
+    def _check_function(self, fndef, wrappers, tree, path):
+        analysis = OriginAnalysis(fndef)
+        scope = _scope_name(tree, fndef)
+        for node_call in self._wrapper_calls(analysis.cfg, wrappers):
+            node, call, spelling, binding = node_call
+            call_loops = enclosing_loops(analysis.loops, call)
+            if binding is not None and any(
+                id(binding) in inner
+                for lp, inner in analysis.loops
+                if id(call) in inner
+            ):
+                # wrapper (re)built in the same loop as the call: a
+                # fresh executable per iteration is deliberate
+                # per-shape compilation, not cache churn
+                continue
+            for arg in list(call.args) + [
+                kw.value for kw in call.keywords
+            ]:
+                tags = analysis.origins_at(node, arg)
+                tags = self._gate(tags, call_loops)
+                for tag in sorted(tags):
+                    report = (ORIGIN_LEN if tag == ORIGIN_LEN_LOCAL
+                              else tag)
+                    yield Finding(
+                        "EDL105", path, call.lineno, scope,
+                        "%s(%s)" % (spelling, report),
+                        "argument to jit-wrapped %r derives from %s — "
+                        "its abstract signature varies across "
+                        "executions, so this call re-keys the compile "
+                        "cache every time (the steady-state recompile "
+                        "loop the runtime sentry counts); bucket/pad "
+                        "the value or hoist it out of the signature"
+                        % (spelling, _TAG_TEXT[tag]),
+                    )
+                    break  # one finding per argument
+
+    @staticmethod
+    def _gate(tags, call_loops):
+        """loop / local-len instability only matters when the call
+        itself repeats (inside a loop); clock/config/attr-len convict
+        anywhere."""
+        out = set(tags)
+        if not call_loops:
+            out.discard(ORIGIN_LOOP)
+            out.discard(ORIGIN_LEN_LOCAL)
+        return out
+
+    @staticmethod
+    def _wrapper_calls(cfg, wrappers):
+        """Yield (node, call, spelling, binding stmt) for calls of
+        known wrapper spellings, walking the SAME CFG the origin
+        states are keyed by."""
+        seen = set()
+        for node in cfg.nodes:
+            for root in node.scan_roots():
+                for n in walk_shallow(root):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    spelling = dotted_text(n.func)
+                    if spelling not in wrappers:
+                        continue
+                    key = (id(n), node.idx)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield node, n, spelling, wrappers[spelling]
+
+
+# --------------------------------------------- EDL106 captured constants
+
+#: array-constructor tails whose results are materialized ndarrays /
+#: device buffers when rooted at a numpy/jnp/jax spelling
+_ARRAY_CTORS = {
+    "zeros", "ones", "full", "empty", "arange", "linspace", "eye",
+    "asarray", "array", "rand", "randn", "device_put", "load",
+    "loadtxt",
+}
+_ARRAY_ROOTS = {"np", "numpy", "onp", "jnp", "jax"}
+
+
+#: shape/dtype methods that preserve array-ness through a chain
+#: (``np.arange(n).reshape(a, b)`` is still a materialized ndarray)
+_ARRAY_METHODS = {"reshape", "astype", "copy", "transpose", "ravel"}
+
+
+def _is_array_ctor(value):
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    if fn.attr in _ARRAY_METHODS:
+        return _is_array_ctor(fn.value)
+    if fn.attr not in _ARRAY_CTORS:
+        return False
+    root = fn.value
+    while isinstance(root, ast.Attribute):
+        root = root.value
+    return isinstance(root, ast.Name) and root.id in _ARRAY_ROOTS
+
+
+def _bound_names(fndef):
+    """Names bound WITHIN fndef (params, assignments, loop targets,
+    withitems, comprehension targets, nested def/class names) — reads
+    of anything else are free."""
+    bound = set()
+    a = fndef.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        bound.add(arg.arg)
+    if a.vararg:
+        bound.add(a.vararg.arg)
+    if a.kwarg:
+        bound.add(a.kwarg.arg)
+    for n in ast.walk(fndef):
+        if isinstance(n, ast.Name) and isinstance(
+            n.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            if n is not fndef:
+                bound.add(n.name)
+        elif isinstance(n, ast.comprehension):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+    return bound
+
+
+def _free_reads(fndef):
+    """{name: first-read line} of free Name loads in fndef's body
+    (nested defs included — the whole body is traced together)."""
+    bound = _bound_names(fndef)
+    reads = {}
+    for n in ast.walk(fndef):
+        if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                and n.id not in bound and n.id not in reads):
+            reads[n.id] = n.lineno
+    return reads
+
+
+@register
+class CapturedConstantRule(Rule):
+    """EDL106 — see module docstring."""
+
+    id = "EDL106"
+    name = "captured-constant-bloat"
+
+    def check_module(self, tree, lines, path):
+        from elasticdl_tpu.analysis.jit_rules import (
+            _collect_jit_contexts,
+        )
+
+        contexts = _collect_jit_contexts(tree)
+        if not contexts:
+            return []
+        findings = []
+        self._scan_scope(tree, tree.body, {}, contexts, tree, path,
+                         findings)
+        return findings
+
+    def _scan_scope(self, owner, body, inherited, contexts, tree,
+                    path, findings):
+        """One lexical scope: extend the visible array bindings, judge
+        jit contexts defined here, recurse."""
+        bindings = dict(inherited)
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Assign) and _is_array_ctor(
+                node.value
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        bindings[tgt.id] = node.lineno
+            stack.extend(ast.iter_child_nodes(node))
+        defs = []
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                defs.append(node)
+                continue
+            if isinstance(node, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        for sub in sorted(defs, key=lambda d: d.lineno):
+            if isinstance(sub, ast.ClassDef):
+                self._scan_scope(sub, sub.body, bindings, contexts,
+                                 tree, path, findings)
+                continue
+            if sub in contexts:
+                self._judge(sub, bindings, tree, path, findings)
+            self._scan_scope(sub, sub.body, bindings, contexts, tree,
+                             path, findings)
+
+    @staticmethod
+    def _judge(fndef, bindings, tree, path, findings):
+        for name, line in sorted(_free_reads(fndef).items()):
+            bound_line = bindings.get(name)
+            if bound_line is None:
+                continue
+            findings.append(Finding(
+                "EDL106", path, line, _scope_name(tree, fndef), name,
+                "traced function %r captures %r — an ndarray built at "
+                "line %d — by closure: every retrace re-hashes and "
+                "re-bakes the full array into the executable, and "
+                "rebinding the name never reaches compiled code; "
+                "thread it as an argument instead"
+                % (fndef.name, name, bound_line),
+            ))
+
+
+# --------------------------------------------- EDL107 PRNG-key discipline
+
+#: jax.random consuming sinks (first positional arg is the key)
+_SAMPLERS = {
+    "uniform", "normal", "categorical", "bernoulli", "gumbel",
+    "choice", "randint", "permutation", "truncated_normal",
+    "exponential", "beta", "gamma", "poisson", "dirichlet", "laplace",
+    "shuffle", "orthogonal", "bits",
+}
+_KEY_MAKERS = {"PRNGKey", "key", "fold_in", "split"}
+_KEY_SETTLERS = {"fold_in", "split"}
+
+
+def _random_receiver(fn):
+    """True for ``jax.random.X`` / ``random.X`` attribute chains."""
+    if not isinstance(fn, ast.Attribute):
+        return False
+    text = dotted_text(fn.value)
+    return text in ("jax.random", "random")
+
+
+def _sink_key(call, key_names):
+    """The consumed key name when `call` is a sampler sink over a
+    known key, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in _SAMPLERS
+            and _random_receiver(fn)):
+        return None
+    if call.args and isinstance(call.args[0], ast.Name) and \
+            call.args[0].id in key_names:
+        return call.args[0].id
+    return None
+
+
+def _settles_key(node, name):
+    """Does this CFG node rebind `name` or route it through
+    split/fold_in (minting fresh keys)?"""
+    for root in node.scan_roots():
+        for n in walk_shallow(root):
+            if isinstance(n, ast.Call):
+                tail = call_tail(n.func)
+                if tail in _KEY_SETTLERS and any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in n.args
+                ):
+                    return True
+            elif isinstance(n, ast.Name) and isinstance(
+                n.ctx, ast.Store
+            ) and n.id == name:
+                return True
+    return False
+
+
+@register
+class PrngKeyRule(Rule):
+    """EDL107 — see module docstring."""
+
+    id = "EDL107"
+    name = "prng-key-discipline"
+
+    def check_module(self, tree, lines, path):
+        findings = []
+        for fndef in _functions(tree):
+            findings.extend(self._check_function(fndef, tree, path))
+        return findings
+
+    def _check_function(self, fndef, tree, path):
+        from elasticdl_tpu.analysis.cfg import build_cfg
+
+        key_stmts = {}  # name -> creating Assign stmt
+        for n in walk_shallow(fndef):
+            if isinstance(n, ast.Assign) and isinstance(
+                n.value, ast.Call
+            ) and call_tail(n.value.func) in _KEY_MAKERS:
+                for tgt in n.targets:
+                    for t in ast.walk(tgt):
+                        if isinstance(t, ast.Name):
+                            key_stmts[t.id] = n
+        if not key_stmts:
+            return
+        key_names = frozenset(key_stmts)
+        scope = _scope_name(tree, fndef)
+        cfg = build_cfg(fndef)
+        loops = loop_bodies(fndef)
+
+        reported = set()
+        for node in cfg.nodes:
+            sinks = self._node_sinks(node, key_names)
+            for name, calls in sinks.items():
+                if len(calls) >= 2 and (name, calls[1].lineno) not in \
+                        reported:
+                    reported.add((name, calls[1].lineno))
+                    yield self._reuse_finding(
+                        path, calls[1].lineno, scope, name,
+                        calls[0].lineno,
+                    )
+                elif calls:
+                    hit = self._reaches_sink_again(
+                        cfg, node, name, key_names
+                    )
+                    if hit is not None and (name, hit) not in reported:
+                        reported.add((name, hit))
+                        yield self._reuse_finding(
+                            path, hit, scope, name, calls[0].lineno,
+                        )
+
+        # closures minted per loop iteration over a pre-loop key
+        for n in walk_shallow(fndef):
+            if not isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            n_loops = enclosing_loops(loops, n)
+            if not n_loops:
+                continue
+            body = n.body if isinstance(n.body, list) else [n.body]
+            for stmt in body:
+                for r in ast.walk(stmt):
+                    if not (isinstance(r, ast.Name)
+                            and isinstance(r.ctx, ast.Load)
+                            and r.id in key_names):
+                        continue
+                    made = key_stmts[r.id]
+                    if any(id(made) in inner for _lp, inner in loops
+                           if id(n) in inner):
+                        continue  # key minted inside the same loop
+                    fp = ("closure", r.id, n.lineno)
+                    if fp in reported:
+                        continue
+                    reported.add(fp)
+                    yield Finding(
+                        "EDL107", path, n.lineno, scope, r.id,
+                        "closure defined inside a loop captures PRNG "
+                        "key %r created before the loop — every "
+                        "iteration's closure shares ONE key, so all "
+                        "of them sample identical values; fold_in the "
+                        "loop counter (or split per iteration) first"
+                        % r.id,
+                    )
+
+    @staticmethod
+    def _reuse_finding(path, line, scope, name, first_line):
+        return Finding(
+            "EDL107", path, line, scope, name,
+            "PRNG key %r is consumed by a second jax.random sink on "
+            "the same CFG path (first sink at line %d) with no "
+            "split/fold_in in between — both sinks draw IDENTICAL "
+            "randomness; split the key or fold_in a counter"
+            % (name, first_line),
+        )
+
+    @staticmethod
+    def _node_sinks(node, key_names):
+        out = {}
+        for root in node.scan_roots():
+            for n in walk_shallow(root):
+                name = _sink_key(n, key_names)
+                if name is not None:
+                    out.setdefault(name, []).append(n)
+        return out
+
+    def _reaches_sink_again(self, cfg, start, name, key_names):
+        """Line of another sink consuming `name` CFG-reachable from
+        `start` (loops included — the start node itself counts when
+        re-entered) without a settle in between, else None."""
+        seen = set()
+        stack = list(start.succ)
+        while stack:
+            node = stack.pop()
+            if node.idx in seen:
+                continue
+            seen.add(node.idx)
+            sinks = self._node_sinks(node, key_names)
+            if name in sinks:
+                return sinks[name][0].lineno
+            if _settles_key(node, name):
+                continue
+            stack.extend(node.out)
+        return None

@@ -81,25 +81,104 @@ def all_rules():
 # ------------------------------------------------------------------ pragma
 
 
+def _pragma_names(text):
+    """The rule-name set of a ``# edl-lint: disable=...`` pragma in
+    `text`, else None."""
+    idx = text.find(_PRAGMA)
+    if idx < 0:
+        return None
+    spec = text[idx + len(_PRAGMA):].strip()
+    spec = spec.split()[0] if spec else ""
+    if not spec.startswith("disable="):
+        return None
+    return {n.strip() for n in spec[len("disable="):].split(",")}
+
+
+def pragma_line_for(finding, lines):
+    """The 1-based line number of the pragma suppressing this finding
+    (same line or the line directly above), else None. EDL000 findings
+    are never pragma-suppressed — a dead ``disable=all`` would
+    otherwise silence its own unused-suppression report."""
+    if finding.rule == "EDL000":
+        return None
+    for lineno in (finding.line, finding.line - 1):
+        if not 1 <= lineno <= len(lines):
+            continue
+        names = _pragma_names(lines[lineno - 1])
+        if names is None:
+            continue
+        if "all" in names or finding.rule in names:
+            return lineno
+    return None
+
+
 def suppressed_by_pragma(finding, lines):
     """True when the finding's source line (or the line directly above
     it) carries ``# edl-lint: disable=<rule>`` naming this rule or
     ``all``."""
-    for lineno in (finding.line, finding.line - 1):
-        if not 1 <= lineno <= len(lines):
+    return pragma_line_for(finding, lines) is not None
+
+
+def collect_pragmas(lines):
+    """[(lineno, frozenset(rule names))] for every pragma line."""
+    out = []
+    for i, text in enumerate(lines, 1):
+        names = _pragma_names(text)
+        if names is not None:
+            out.append((i, frozenset(names)))
+    return out
+
+
+def unused_pragma_findings(path, lines, used_lines, emitted_ids,
+                           full_run):
+    """EDL000 findings for pragmas that suppressed NOTHING in this
+    run — the pragma mirror of the stale-baseline failure: a dead
+    suppression is a standing invitation to hide the next real
+    finding on that line.
+
+    A pragma is only judged when this run could have vindicated it:
+    every rule it names was among the emitted ids of the selected
+    checkers (``disable=all`` needs the full registry)."""
+    out = []
+    for lineno, names in collect_pragmas(lines):
+        if lineno in used_lines:
             continue
-        text = lines[lineno - 1]
-        idx = text.find(_PRAGMA)
-        if idx < 0:
+        if "all" in names:
+            if not full_run:
+                continue
+        elif not (names - {"all"} <= emitted_ids):
             continue
-        spec = text[idx + len(_PRAGMA):].strip()
-        spec = spec.split()[0] if spec else ""
-        if not spec.startswith("disable="):
-            continue
-        names = {n.strip() for n in spec[len("disable="):].split(",")}
-        if "all" in names or finding.rule in names:
-            return True
-    return False
+        detail = "disable=%s" % ",".join(sorted(names))
+        out.append(Finding(
+            "EDL000", path, lineno, "<pragma>", detail,
+            "unused suppression: this pragma suppresses zero "
+            "findings — the code it vetted is gone or fixed; delete "
+            "the pragma (or run --fix-pragmas)",
+        ))
+    return out
+
+
+def strip_pragma(text):
+    """`text` with its ``# edl-lint: ...`` pragma removed; None when
+    the whole line was only the pragma (delete the line)."""
+    idx = text.find(_PRAGMA)
+    if idx < 0:
+        return text
+    head = text[:idx].rstrip()
+    return head if head else None
+
+
+class UnusedPragmaRule(Rule):
+    """EDL000 — unused-suppression detection. The detection itself
+    runs inside the per-file pass (it needs the pragma-application
+    bookkeeping), so this class only anchors the id in the registry
+    for --select / --list-rules."""
+
+    id = "EDL000"
+    name = "unused-suppression"
+
+
+register(UnusedPragmaRule)
 
 
 # ---------------------------------------------------------------- baseline
@@ -213,7 +292,7 @@ def _check_one_file(args):
     """Module-rule pass over ONE file — the process-pool work unit
     (top-level so it pickles; rules are reconstructed from ids in the
     child, where the registry import already ran)."""
-    path, rel, rule_ids = args
+    path, rel, rule_ids, full_run = args
     import elasticdl_tpu.analysis  # noqa: F401 - loads the registry
 
     rules = [r for r in all_rules() if r.id in rule_ids]
@@ -225,10 +304,24 @@ def _check_one_file(args):
     except (SyntaxError, UnicodeDecodeError) as e:
         return findings, ["%s: unparseable: %s" % (path, e)]
     lines = src.splitlines()
+    used_pragma_lines = set()
     for rule in rules:
         for finding in rule.check_module(tree, lines, rel):
-            if not suppressed_by_pragma(finding, lines):
+            pragma_line = pragma_line_for(finding, lines)
+            if pragma_line is None:
                 findings.append(finding)
+            else:
+                used_pragma_lines.add(pragma_line)
+    if "EDL000" in rule_ids:
+        from elasticdl_tpu.analysis.lint import RULE_FAMILIES
+
+        emitted = frozenset(
+            fid for rid in rule_ids
+            for fid in RULE_FAMILIES.get(rid, (rid,))
+        )
+        findings.extend(unused_pragma_findings(
+            rel, lines, used_pragma_lines, emitted, full_run,
+        ))
     return findings, errors
 
 
@@ -245,10 +338,12 @@ def run_rules(paths, rules=None, root=None, excludes=DEFAULT_EXCLUDES,
     repo-level checks always run in this process."""
     rules = rules if rules is not None else all_rules()
     rule_ids = frozenset(r.id for r in rules)
+    full_run = rule_ids == frozenset(r.id for r in all_rules())
     work = []
     for path in iter_python_files(paths, excludes=excludes):
         rel = os.path.relpath(path, root) if root else path
-        work.append((path, rel.replace(os.sep, "/"), rule_ids))
+        work.append((path, rel.replace(os.sep, "/"), rule_ids,
+                     full_run))
 
     findings, errors = [], []
     if jobs > 1 and len(work) > 1:

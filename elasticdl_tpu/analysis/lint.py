@@ -23,8 +23,16 @@ Options:
                      (plus untracked files) — the pre-commit mode.
                      Stale-baseline enforcement is skipped: a subset
                      scan cannot see every vetted finding
-  --format FMT       `human` (default) or `github` (GitHub Actions
-                     ::error annotations, rendered inline on PRs)
+  --format FMT       `human` (default), `github` (GitHub Actions
+                     ::error annotations, rendered inline on PRs) or
+                     `sarif` (SARIF 2.1.0 for GitHub code scanning;
+                     byte-deterministic; human lines go to stderr)
+  --output FILE      where `--format sarif` writes the document
+                     (default: stdout)
+  --fix-pragmas      delete every unused `# edl-lint: disable=` pragma
+                     (the EDL000 findings) from the scanned files and
+                     exit 0 — the suppression mirror of fixing stale
+                     baseline entries
   --list-rules       print the rule catalogue and exit
 """
 
@@ -41,16 +49,21 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 #: rule ids emitted by each registered checker (a checker is selected
 #: when ANY of its ids is selected)
 RULE_FAMILIES = {
+    "EDL000": ("EDL000",),
     "EDL001": ("EDL001", "EDL002"),
     "EDL003": ("EDL003",),
     "EDL004": ("EDL004",),
     "EDL101": ("EDL101", "EDL102", "EDL103"),
     "EDL104": ("EDL104",),
+    "EDL105": ("EDL105",),
+    "EDL106": ("EDL106",),
+    "EDL107": ("EDL107",),
     "EDL201": ("EDL201",),
     "EDL202": ("EDL202", "EDL203"),
     "EDL301": ("EDL301",),
     "EDL401": ("EDL401",),
     "EDL501": ("EDL501",),
+    "EDL601": ("EDL601",),
 }
 
 DEFAULT_PATHS = ("elasticdl_tpu", "scripts", "tests")
@@ -114,6 +127,38 @@ def changed_files(root, base=None):
     })
 
 
+def _fix_pragmas(findings, root):
+    """Delete the pragmas behind every EDL000 finding from their
+    files (baseline-vetted pragmas were filtered before this runs, so
+    a consciously kept suppression survives)."""
+    from elasticdl_tpu.analysis.core import strip_pragma
+
+    dead = {}
+    for f in findings:
+        if f.rule == "EDL000":
+            dead.setdefault(f.path, set()).add(f.line)
+    removed = 0
+    for rel, linenos in sorted(dead.items()):
+        path = rel if os.path.isabs(rel) else os.path.join(root, rel)
+        with open(path) as fh:
+            lines = fh.read().splitlines(keepends=True)
+        out = []
+        for i, text in enumerate(lines, 1):
+            if i not in linenos:
+                out.append(text)
+                continue
+            ending = "\n" if text.endswith("\n") else ""
+            stripped = strip_pragma(text.rstrip("\n"))
+            if stripped is not None:
+                out.append(stripped + ending)
+            removed += 1
+        with open(path, "w") as fh:
+            fh.write("".join(out))
+    print("edl-lint: removed %d unused pragma(s) from %d file(s)"
+          % (removed, len(dead)))
+    return 0
+
+
 def _print_finding(finding, fmt):
     if fmt == "github":
         # GitHub Actions annotation: renders inline on the PR diff
@@ -141,7 +186,10 @@ def main(argv=None):
     parser.add_argument("--base", default=None,
                         help="merge-base ref for --changed-only")
     parser.add_argument("--format", dest="fmt", default="human",
-                        choices=("human", "github"))
+                        choices=("human", "github", "sarif"))
+    parser.add_argument("--output", default=None,
+                        help="sarif output file (default: stdout)")
+    parser.add_argument("--fix-pragmas", action="store_true")
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument("--root", default=REPO_ROOT,
                         help=argparse.SUPPRESS)
@@ -213,8 +261,24 @@ def main(argv=None):
         # a subset scan cannot distinguish "fixed" from "not scanned"
         stale = []
 
-    for f in findings:
-        _print_finding(f, args.fmt)
+    if args.fix_pragmas:
+        return _fix_pragmas(findings, root)
+
+    if args.fmt == "sarif":
+        from elasticdl_tpu.analysis.sarif import render_sarif
+
+        text = render_sarif(findings, rules)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        # keep the findings human-readable for whoever reads the log
+        for f in findings:
+            print(f.format(), file=sys.stderr)
+    else:
+        for f in findings:
+            _print_finding(f, args.fmt)
     for e in stale:
         msg = ("STALE baseline entry %s %s [%s] %s — the finding it "
                "vetted is gone; delete the entry"

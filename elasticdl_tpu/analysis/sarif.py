@@ -1,0 +1,93 @@
+"""SARIF 2.1.0 rendering for the lint driver (``--format sarif``).
+
+GitHub code scanning ingests SARIF and annotates PRs from it — the
+code-scanning twin of the ``--format github`` ::error annotations,
+with two properties those lack: findings persist as dismissable
+alerts, and the fingerprint travels with the alert so a line shift
+does not re-open it.
+
+The document is BYTE-DETERMINISTIC by construction (the same contract
+as `--jobs` output parity and the proto generator): findings arrive
+already sorted from the runner, rule metadata is sorted by id, and
+serialization is ``sort_keys`` with fixed indentation — no
+timestamps, no absolute paths, no environment. `tests/test_lint.py`
+pins serial == fanned-out bytes.
+
+Only FINDINGS are rendered; stale-baseline entries and runner errors
+stay on stderr (they are run-hygiene failures, not code locations).
+"""
+
+import json
+
+
+def _rule_meta(rules, families):
+    """One reportingDescriptor per EMITTED id of the selected
+    checkers (a checker like EDL101 emits EDL101/102/103 — each needs
+    a descriptor or the uploader drops the result's rule link)."""
+    import sys
+
+    metas = {}
+    for rule in rules:
+        doc = (sys.modules[rule.__module__].__doc__ or "")
+        title = doc.strip().splitlines()[0] if doc else (rule.name or "")
+        for fid in families.get(rule.id, (rule.id,)):
+            metas[fid] = {
+                "id": fid,
+                "name": rule.name or fid,
+                "shortDescription": {"text": title},
+            }
+    return [metas[k] for k in sorted(metas)]
+
+
+def sarif_document(findings, rules):
+    """The SARIF run for one lint invocation, as a dict."""
+    from elasticdl_tpu.analysis.lint import RULE_FAMILIES
+
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {
+                "text": "[%s] %s: %s" % (f.scope, f.detail, f.message),
+            },
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "partialFingerprints": {
+                "edlLintFingerprint/v1": "%s:%s:%s:%s" % f.fingerprint,
+            },
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "edl-lint",
+                    "informationUri": (
+                        "docs/designs/static_analysis.md"
+                    ),
+                    "rules": _rule_meta(rules, RULE_FAMILIES),
+                },
+            },
+            "columnKind": "utf16CodeUnits",
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file:///"},
+            },
+            "results": results,
+        }],
+    }
+
+
+def render_sarif(findings, rules):
+    """Byte-deterministic SARIF text (trailing newline included)."""
+    return json.dumps(
+        sarif_document(findings, rules), indent=2, sort_keys=True,
+    ) + "\n"

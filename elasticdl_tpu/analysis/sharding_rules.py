@@ -1,0 +1,313 @@
+"""C17 — sharding-discipline checker (EDL601), BORN GATED for the
+GSPMD serving PR.
+
+The ROADMAP's "multi-chip GSPMD serving and sharded weight updates"
+item will multiply the sharding-annotation surface (per-layer
+NamedShardings, with_sharding_constraint pins inside the decode step,
+donated sharded optimizer state a la ZeRO). Sharding-annotation drift
+is the dominant silent-wrongness risk of that work: a constraint
+outside jit silently does nothing, a typo'd mesh-axis name silently
+replicates (or raises only on hardware the CI doesn't have), and a
+donated-but-unsharded output silently materializes a gathered copy of
+the state the donation existed to avoid. This family exists BEFORE
+that PR lands — the same precedent as PR 7 gating the aggregation
+tier — seeded on today's surface (`parallel/mesh.py`,
+`parallel/sharding.py`, the MoE a2a machinery, the trainer's
+donate+shardings jit calls), so the GSPMD PR is born with its
+discipline machine-checked.
+
+Three shapes, all lexical/precision-first:
+
+* **constraint-outside-jit** — ``with_sharding_constraint(x, s)``
+  called in a function that is neither a jit context (decorator or
+  wrap idiom, per the EDL101 context collection) nor lexically nested
+  inside one. Outside a trace the call is a silent no-op (or an
+  error, backend-depending): the pin the author wrote does not exist.
+* **unknown-mesh-axis** — a string-literal axis name inside a
+  ``PartitionSpec``/``P(...)`` (incl. nested tuples) or the axis-name
+  argument of ``shard_map``/``all_to_all``-style collectives that is
+  not declared by the enclosing mesh: checked against a literal
+  ``Mesh(devs, ("a", "b"))`` axis tuple in the same function or
+  module when one exists, else against the repo's canonical axis set
+  (``common.constants.MeshAxis.ALL`` — imported at rule runtime, the
+  single source of truth). A typo'd axis name places NOTHING and
+  raises only at mesh-build time on the right topology.
+* **donated-sharding-drop** — a ``jax.jit``/``pjit`` call that
+  declares ``donate_argnums``/``donate_argnames`` AND
+  ``in_shardings`` but NO ``out_shardings``: the donated buffers'
+  output placement is left to inference, and a silently replicated
+  output un-does the sharded-update memory win (and round-trips the
+  full state through every device). Declare the output sharding —
+  the trainer's train-step/apply-rows calls are the sanctioned shape.
+
+Non-literal axis expressions (``MeshAxis.EP``, computed tuples)
+contribute nothing — the rule never guesses.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+from elasticdl_tpu.analysis.value_origin import call_tail, dotted_text
+
+#: PartitionSpec-ish constructors whose string args are axis names
+_PSPEC_TAILS = {"P", "PartitionSpec"}
+
+#: collective call keywords/positions whose string args name axes
+_AXIS_KEYWORDS = {"axis_name", "axis_names"}
+
+
+def canonical_axes():
+    """The repo's canonical mesh-axis union (MeshAxis.ALL in
+    common/constants.py — stdlib-only import, single source of
+    truth)."""
+    from elasticdl_tpu.common.constants import MeshAxis
+
+    return frozenset(MeshAxis.ALL)
+
+
+def _literal_strs(node):
+    """Every string constant inside `node` (tuples/lists walked)."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append((n.value, n.lineno))
+    return out
+
+
+def _mesh_axes_of_call(call):
+    """Literal axis tuple of a ``Mesh(devs, ("dp", ...))`` /
+    ``Mesh(devs, axis_names=(...))`` call, else None."""
+    if call_tail(call.func) != "Mesh":
+        return None
+    cand = None
+    if len(call.args) >= 2:
+        cand = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            cand = kw.value
+    if cand is None:
+        return None
+    if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
+        return frozenset([cand.value])
+    if isinstance(cand, (ast.Tuple, ast.List)) and all(
+        isinstance(e, ast.Constant) and isinstance(e.value, str)
+        for e in cand.elts
+    ):
+        return frozenset(e.value for e in cand.elts)
+    return None  # computed axis names: contribute nothing
+
+
+def _collect_literal_meshes(body):
+    """Union of literal mesh axis declarations in one scope (nested
+    function bodies excluded)."""
+    axes = set()
+    found = False
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            mesh_axes = _mesh_axes_of_call(node)
+            if mesh_axes is not None:
+                axes.update(mesh_axes)
+                found = True
+        stack.extend(ast.iter_child_nodes(node))
+    return (frozenset(axes) if found else None)
+
+
+@register
+class ShardingDisciplineRule(Rule):
+    """EDL601 — see module docstring."""
+
+    id = "EDL601"
+    name = "sharding-discipline"
+
+    def check_module(self, tree, lines, path):
+        from elasticdl_tpu.analysis.jit_rules import (
+            _collect_jit_contexts,
+        )
+
+        contexts = _collect_jit_contexts(tree)
+        traced = self._traced_functions(tree, contexts)
+        module_axes = _collect_literal_meshes(tree.body)
+        findings = []
+        findings.extend(
+            self._check_constraints(tree, traced, path)
+        )
+        findings.extend(
+            self._check_axis_names(tree, module_axes, path)
+        )
+        findings.extend(self._check_donate_shardings(tree, path))
+        return findings
+
+    # ---------------------------------------------------- jit nesting
+
+    @staticmethod
+    def _traced_functions(tree, contexts):
+        """Jit contexts plus every function lexically nested inside
+        one (traced with it)."""
+        traced = set(id(f) for f in contexts)
+        for ctx in contexts:
+            for n in ast.walk(ctx):
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    traced.add(id(n))
+        return traced
+
+    def _enclosing_chain(self, tree):
+        """{id(fndef): [enclosing fndefs outermost-first]} so a
+        constraint inside a helper nested in a jit context resolves."""
+        chains = {}
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    chains[id(child)] = list(stack) + [child]
+                    walk(child, stack + [child])
+                else:
+                    walk(child, stack)
+
+        walk(tree, [])
+        return chains
+
+    # ------------------------------------------- constraint-outside-jit
+
+    def _check_constraints(self, tree, traced, path):
+        # module scope is never traced
+        stack = list(tree.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call) and call_tail(
+                n.func
+            ) == "with_sharding_constraint":
+                yield Finding(
+                    "EDL601", path, n.lineno, "<module>",
+                    "with_sharding_constraint",
+                    "with_sharding_constraint outside a jit context "
+                    "is a silent no-op — the pin you wrote does not "
+                    "exist in any executable; move it inside the "
+                    "traced function (or delete it)",
+                )
+            stack.extend(ast.iter_child_nodes(n))
+
+        chains = self._enclosing_chain(tree)
+        for fid, chain in sorted(chains.items(),
+                                 key=lambda kv: kv[1][-1].lineno):
+            fndef = chain[-1]
+            if any(id(f) in traced for f in chain):
+                continue
+            for n in self._own_nodes(fndef):
+                if isinstance(n, ast.Call) and call_tail(
+                    n.func
+                ) == "with_sharding_constraint":
+                    yield Finding(
+                        "EDL601", path, n.lineno, fndef.name,
+                        "with_sharding_constraint",
+                        "with_sharding_constraint outside a jit "
+                        "context is a silent no-op — the pin you "
+                        "wrote does not exist in any executable; "
+                        "move it inside the traced function (or "
+                        "delete it)",
+                    )
+
+    @staticmethod
+    def _own_nodes(fndef):
+        """Nodes of fndef excluding nested function bodies (those are
+        judged under their own chain)."""
+        stack = list(fndef.body)
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    # ----------------------------------------------- unknown-mesh-axis
+
+    def _check_axis_names(self, tree, module_axes, path):
+        canon = canonical_axes()
+
+        def judge(call, allowed, scope, source):
+            names = []
+            if call_tail(call.func) in _PSPEC_TAILS:
+                for arg in call.args:
+                    names.extend(_literal_strs(arg))
+            for kw in call.keywords:
+                if kw.arg in _AXIS_KEYWORDS:
+                    names.extend(_literal_strs(kw.value))
+            for name, lineno in names:
+                if name not in allowed:
+                    yield Finding(
+                        "EDL601", path, lineno, scope,
+                        "axis:%s" % name,
+                        "mesh-axis name %r is not declared by %s — a "
+                        "typo'd axis places nothing (silent "
+                        "replication) and only raises on the real "
+                        "topology; declared axes: %s"
+                        % (name, source, ", ".join(sorted(allowed))),
+                    )
+
+        findings = []
+
+        def visit(node, scope, allowed, source):
+            if isinstance(node, ast.ClassDef):
+                for c in node.body:
+                    visit(c, node.name, allowed, source)
+                return
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                fn_axes = _collect_literal_meshes(node.body)
+                if fn_axes is not None:
+                    allowed = fn_axes
+                    source = "the enclosing Mesh declaration"
+                inner = (node.name if scope == "<module>"
+                         else "%s.%s" % (scope, node.name))
+                for c in node.body:
+                    visit(c, inner, allowed, source)
+                return
+            if isinstance(node, ast.Lambda):
+                pass  # fall through: lambdas share the scope
+            if isinstance(node, ast.Call):
+                findings.extend(judge(node, allowed, scope, source))
+            for c in ast.iter_child_nodes(node):
+                visit(c, scope, allowed, source)
+
+        allowed = module_axes if module_axes is not None else canon
+        source = ("the enclosing Mesh declaration"
+                  if module_axes is not None
+                  else "the canonical MeshAxis.ALL set")
+        for node in tree.body:
+            visit(node, "<module>", allowed, source)
+        return findings
+
+    # ------------------------------------------- donated-sharding-drop
+
+    @staticmethod
+    def _check_donate_shardings(tree, path):
+        for n in ast.walk(tree):
+            if not isinstance(n, ast.Call):
+                continue
+            if call_tail(n.func) not in ("jit", "pjit"):
+                continue
+            kws = {kw.arg for kw in n.keywords if kw.arg}
+            if not kws & {"donate_argnums", "donate_argnames"}:
+                continue
+            if "in_shardings" in kws and "out_shardings" not in kws:
+                target = dotted_text(n.args[0]) if n.args else "<fn>"
+                yield Finding(
+                    "EDL601", path, n.lineno, "<module>",
+                    "donate:%s" % target,
+                    "jit call donates input buffers and declares "
+                    "in_shardings but NO out_shardings — the donated "
+                    "state's output placement is left to inference, "
+                    "and a silently replicated output un-does the "
+                    "sharded-update memory win; re-declare the "
+                    "sharding on the output",
+                )

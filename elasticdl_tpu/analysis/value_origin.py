@@ -1,0 +1,439 @@
+"""Value-origin / trace-stability dataflow (the v3 engine layer).
+
+The compile-discipline rules (EDL105/106/107) and the sharding family
+(EDL601) all ask the same underlying question about an expression at a
+jit call site: *would its abstract signature be the same every time
+this statement executes?* The PR 14 recompile sentry answers that at
+runtime, one churned executable too late; this module answers it
+statically on the CFG engine from PRs 5/7.
+
+Origins are a small closed tag set, each an UNSTABLE provenance:
+
+* ``loop``   — derived from a Python loop counter: the target of a
+  ``for i in range(...)`` / ``enumerate(...)`` loop, or a name
+  augassigned inside a loop body (an accumulator). Such a value takes
+  a different concrete int every iteration, so a jit signature built
+  from it churns the compile cache once per iteration.
+* ``len``    — ``len(c)`` / ``c.shape`` of a container that is MUTATED
+  in the same function (``.append``/``.extend``/``+=`` ...): the
+  classic "shape read off a growing batch list" recompile loop.
+* ``clock``  — wall-clock reads (``time.time()`` and friends,
+  ``datetime.now()``): different every call, by construction.
+* ``config`` — environment reads (``os.environ[...]`` / ``os.getenv``):
+  stable within one process run but re-read idioms (hot reload) make
+  them signature poison at jit boundaries.
+
+STABILIZERS are the repo's sanctioned bucketing idioms — they
+collapse an unstable int onto a small closed set of values, which is
+exactly what makes the engine/kv_pool prefill buckets safe:
+
+* a call whose name spells the convention: ``*_bucket``/``*bucket*``,
+  ``*pad*``, ``round_up*``, ``*pow2*`` (``_prefill_bucket``,
+  ``_suffix_bucket``, ``pad_to_multiple`` ...);
+* ceil-to-multiple arithmetic: ``-(-p // 64) * 64`` and
+  ``((p + 63) // 64) * 64`` (a Mult with a constant where the other
+  operand floor-divides);
+* next-power-of-two: ``1 << (n - 1).bit_length()``, ``2 ** k``, or any
+  expression routed through ``.bit_length()``;
+* ``min``/``max`` clamps whose unstable operands are themselves
+  stabilized (``min(seq_len, -(-p // 64) * 64)``);
+* scalar DEVICE BINDING: ``jnp.asarray(j, jnp.int32)`` and friends —
+  the unstable Python int becomes a shape-``()`` traced array, so its
+  abstract signature is constant (the PR 3 "tables and positions are
+  device arrays, churn never recompiles" convention). Binding a
+  MUTATED CONTAINER itself (``jnp.asarray(growing_list)``) does NOT
+  stabilize: there the instability IS the shape.
+
+A stabilized expression contributes NO origin tags, and an assignment
+from a stabilizer KILLS the taint — ``p_pad = _prefill_bucket(p, n)``
+launders ``p``'s instability, because the repo's convention then keys
+one compiled executable per bucket.
+
+Like every v2/v3 analysis here: heuristic by design, precision over
+recall. Attribute state (``self._x``) contributes nothing unless the
+evidence is in the same function; unresolvable means silent.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.cfg import build_cfg, walk_shallow
+from elasticdl_tpu.analysis.dataflow import forward
+
+ORIGIN_LOOP = "loop"
+ORIGIN_LEN = "len"
+#: same provenance as ``len`` but the growing container is a bare
+#: LOCAL: it resets every invocation, so the instability only matters
+#: when the consuming call repeats within one invocation (in a loop).
+#: Attribute containers (``self._buf``) persist across calls and
+#: convict anywhere. Rules gate on this distinction; both report as
+#: "len".
+ORIGIN_LEN_LOCAL = "len_local"
+ORIGIN_CLOCK = "clock"
+ORIGIN_CONFIG = "config"
+
+#: wall-clock reads: ``time.X()`` for X here, plus ``datetime.now()``
+_CLOCK_FUNCS = {
+    "time", "monotonic", "perf_counter", "process_time", "thread_time",
+    "monotonic_ns", "perf_counter_ns", "time_ns",
+}
+
+#: container mutators that make a later ``len()``/``.shape`` unstable
+_MUTATORS = {
+    "append", "extend", "insert", "add", "pop", "remove", "clear",
+    "update", "appendleft", "popleft", "setdefault",
+}
+
+#: jit wrapper factories whose RESULT is a compile-cached executable —
+#: the call surfaces EDL105 guards (tracked_jit and the repo's _tjit /
+#: _pool_tjit adapters included; vmap/pmap alone are not caches)
+JIT_WRAPPER_TAILS = {"jit", "pjit", "tracked_jit", "_tjit", "_pool_tjit"}
+
+
+def dotted_text(node):
+    """``self._write_fn`` -> 'self._write_fn'; bare Name -> its id;
+    anything else -> None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_tail(fn):
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+# ------------------------------------------------------------ stabilizers
+
+
+def _is_bucket_name(name):
+    if not name:
+        return False
+    low = name.lower()
+    return ("bucket" in low or "pad" in low or "pow2" in low
+            or low.startswith("round_up") or low.startswith("next_pow"))
+
+
+def is_stabilizer(expr):
+    """True when `expr`'s VALUE is bucketed regardless of how unstable
+    its inputs are (see module docstring for the recognized idioms)."""
+    if isinstance(expr, ast.Call):
+        tail = call_tail(expr.func)
+        if _is_bucket_name(tail):
+            return True
+        if (isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "bit_length"):
+            return True
+        if isinstance(expr.func, ast.Name) and expr.func.id in (
+            "min", "max"
+        ):
+            return all(
+                is_stabilizer(a) or not _has_any_source(a)
+                for a in expr.args
+            )
+        return False
+    if isinstance(expr, ast.BinOp):
+        if isinstance(expr.op, ast.Mult):
+            for const, other in (
+                (expr.right, expr.left), (expr.left, expr.right),
+            ):
+                if isinstance(const, ast.Constant) and any(
+                    isinstance(n, ast.BinOp)
+                    and isinstance(n.op, ast.FloorDiv)
+                    for n in ast.walk(other)
+                ):
+                    return True
+            return False
+        if isinstance(expr.op, ast.LShift):
+            return isinstance(expr.left, ast.Constant)
+        if isinstance(expr.op, ast.Pow):
+            return isinstance(expr.left, ast.Constant)
+        return False
+    return False
+
+
+def _has_any_source(expr):
+    """Conservative: does this expression read ANY name or direct
+    source? (Used only to let min/max over constants count as
+    stabilized.)"""
+    for n in ast.walk(expr):
+        if isinstance(n, (ast.Name, ast.Call, ast.Subscript)):
+            return True
+    return False
+
+
+#: jnp-rooted calls that bind a host scalar onto the device (value
+#: becomes traced data; abstract signature pinned at shape ())
+_DEVICE_BIND_TAILS = {
+    "asarray", "array", "int32", "int8", "int16", "int64", "uint32",
+    "uint8", "float32", "float16", "bfloat16", "float64",
+}
+_DEVICE_BIND_ROOTS = {"jnp", "jax.numpy"}
+
+
+def _device_binding(expr):
+    """The bound sub-expression of a ``jnp.asarray(x, ...)``-style
+    call, else None."""
+    if not (isinstance(expr, ast.Call) and expr.args):
+        return None
+    fn = expr.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    if fn.attr not in _DEVICE_BIND_TAILS:
+        return None
+    root = dotted_text(fn.value)
+    if root in _DEVICE_BIND_ROOTS:
+        return expr.args[0]
+    return None
+
+
+# ----------------------------------------------------- per-function facts
+
+
+def mutated_containers(fndef):
+    """Dotted spellings of locals/attrs that GROW in this function:
+    receivers of mutator calls plus AugAssign targets of list-ish
+    ops. Evidence is same-function only — precision over recall."""
+    out = set()
+    for node in walk_shallow(fndef):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS):
+            text = dotted_text(node.func.value)
+            if text:
+                out.add(text)
+        elif isinstance(node, ast.AugAssign):
+            text = dotted_text(node.target)
+            if text:
+                out.add(text)
+    return out
+
+
+def loop_bodies(fndef):
+    """[(loop stmt, frozenset(id(node) for nodes lexically inside))]
+    for every for/while loop in this function (nested scopes pruned)."""
+    loops = []
+    for node in walk_shallow(fndef):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            inner = set()
+            for stmt in node.body + node.orelse:
+                for n in walk_shallow(stmt):
+                    inner.add(id(n))
+            loops.append((node, frozenset(inner)))
+    return loops
+
+
+def enclosing_loops(loops, node):
+    """The loop statements whose body lexically contains `node`."""
+    nid = id(node)
+    return [lp for lp, inner in loops if nid in inner]
+
+
+# -------------------------------------------------------- the analysis
+
+
+class OriginAnalysis(object):
+    """Forward may-analysis over one function's CFG: which local names
+    may, entering each node, hold a value with an unstable origin
+    (state = frozenset of (name, tag) pairs)."""
+
+    def __init__(self, fndef):
+        self.fndef = fndef
+        self.cfg = build_cfg(fndef)
+        self.mutated = mutated_containers(fndef)
+        self.loops = loop_bodies(fndef)
+        self._aug_in_loop = self._augassigned_loop_names()
+        self.states = forward(self.cfg, self._transfer,
+                              entry_state=frozenset())
+
+    # -------------------------------------------------------- helpers
+
+    def _augassigned_loop_names(self):
+        names = set()
+        for node in walk_shallow(self.fndef):
+            if isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                if enclosing_loops(self.loops, node):
+                    names.add(node.target.id)
+        return names
+
+    def _stable(self, expr):
+        """Stabilized under THIS function's facts: the syntactic
+        bucketing idioms, plus scalar device binding — unless the
+        bound value is a growing container itself (its shape IS the
+        instability)."""
+        if is_stabilizer(expr):
+            return True
+        bound = _device_binding(expr)
+        if bound is not None:
+            text = dotted_text(bound)
+            return not (text and text in self.mutated)
+        return False
+
+    def expr_origins(self, expr, state):
+        """Union of origin tags this expression may carry under
+        `state`. Stabilized subexpressions contribute nothing."""
+        if self._stable(expr):
+            return frozenset()
+        tags = set()
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if self._stable(n):
+                continue
+            if isinstance(n, ast.Name):
+                for name, tag in state:
+                    if name == n.id:
+                        tags.add(tag)
+            elif isinstance(n, ast.Call):
+                tail = call_tail(n.func)
+                if tail == "len" and n.args:
+                    text = dotted_text(n.args[0])
+                    if text and text in self.mutated:
+                        tags.add(ORIGIN_LEN if "." in text
+                                 else ORIGIN_LEN_LOCAL)
+                elif (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _CLOCK_FUNCS
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "time"):
+                    tags.add(ORIGIN_CLOCK)
+                elif (isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "now"):
+                    tags.add(ORIGIN_CLOCK)
+                elif tail == "getenv":
+                    tags.add(ORIGIN_CONFIG)
+                elif (isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "get"
+                        and dotted_text(n.func.value) == "os.environ"):
+                    tags.add(ORIGIN_CONFIG)
+            elif isinstance(n, ast.Attribute):
+                if n.attr == "shape":
+                    text = dotted_text(n.value)
+                    if text and text in self.mutated:
+                        tags.add(ORIGIN_LEN if "." in text
+                                 else ORIGIN_LEN_LOCAL)
+            elif isinstance(n, ast.Subscript):
+                if dotted_text(n.value) == "os.environ":
+                    tags.add(ORIGIN_CONFIG)
+            stack.extend(ast.iter_child_nodes(n))
+        return frozenset(tags)
+
+    # ------------------------------------------------------- transfer
+
+    @staticmethod
+    def _kill(state, names):
+        names = set(names)
+        return frozenset(
+            (n, t) for n, t in state if n not in names
+        )
+
+    def _transfer(self, node, state):
+        if node.kind == "iter":
+            stmt = node.payload
+            tgt_names = [
+                n.id for n in ast.walk(stmt.target)
+                if isinstance(n, ast.Name)
+            ]
+            tail = call_tail(stmt.iter.func) if isinstance(
+                stmt.iter, ast.Call
+            ) else None
+            if tail in ("range", "enumerate"):
+                state = state | frozenset(
+                    (n, ORIGIN_LOOP) for n in tgt_names
+                )
+            else:
+                tags = self.expr_origins(stmt.iter, state)
+                if tags:
+                    state = state | frozenset(
+                        (n, t) for n in tgt_names for t in tags
+                    )
+            return state
+        if node.kind != "stmt":
+            return state
+        stmt = node.payload
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            tgt_names = [
+                n.id for tgt in stmt.targets
+                for n in ast.walk(tgt) if isinstance(n, ast.Name)
+            ]
+            if self._stable(value):
+                return self._kill(state, tgt_names)
+            tags = self.expr_origins(value, state)
+            state = self._kill(
+                state,
+                [t.id for t in stmt.targets
+                 if isinstance(t, ast.Name)],
+            )
+            if tags:
+                state = state | frozenset(
+                    (n, t) for n in tgt_names for t in tags
+                )
+            return state
+        if isinstance(stmt, ast.AugAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            extra = set()
+            if stmt.target.id in self._aug_in_loop:
+                extra.add((stmt.target.id, ORIGIN_LOOP))
+            tags = self.expr_origins(stmt.value, state)
+            extra.update((stmt.target.id, t) for t in tags)
+            if extra:
+                state = state | frozenset(extra)
+            return state
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and isinstance(stmt.target, ast.Name):
+            if self._stable(stmt.value):
+                return self._kill(state, [stmt.target.id])
+            tags = self.expr_origins(stmt.value, state)
+            state = self._kill(state, [stmt.target.id])
+            if tags:
+                state = state | frozenset(
+                    (stmt.target.id, t) for t in tags
+                )
+            return state
+        return state
+
+    # ------------------------------------------------------ rule API
+
+    def origins_at(self, node, expr):
+        """Origin tags of `expr` evaluated at CFG `node` (entry
+        state)."""
+        return self.expr_origins(expr, self.states.get(node,
+                                                       frozenset()))
+
+
+# ------------------------------------------------- jit wrapper bindings
+
+
+def collect_jit_wrappers(scope_stmts):
+    """{spelling: binding stmt} for names bound to a compile-cached
+    executable in these statements: ``fn = jax.jit(step)``,
+    ``self._fn = self._tjit("name", fn)``, ``w = tracked_jit(f, ...)``.
+    Nested function/class bodies are NOT entered (their bindings are
+    not visible at this level)."""
+    wrappers = {}
+    stack = list(scope_stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            if call_tail(node.value.func) in JIT_WRAPPER_TAILS:
+                for tgt in node.targets:
+                    text = dotted_text(tgt)
+                    if text:
+                        wrappers[text] = node
+        stack.extend(ast.iter_child_nodes(node))
+    return wrappers

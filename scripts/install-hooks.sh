@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Install the repo's git hooks: a pre-commit hook that runs
+# `make lint-changed` (edl-lint --changed-only over the files of the
+# commit — sub-second on typical diffs; full-tree enforcement stays in
+# CI, where stale-baseline and unused-pragma policing need the whole
+# tree). Bypass a single commit with `git commit --no-verify`.
+#
+# Usage: bash scripts/install-hooks.sh
+set -euo pipefail
+
+repo_root="$(git rev-parse --show-toplevel)"
+hooks_dir="$(git -C "$repo_root" rev-parse --git-path hooks)"
+hook="$hooks_dir/pre-commit"
+
+if [ -e "$hook" ] && ! grep -q "edl-lint pre-commit" "$hook"; then
+    echo "install-hooks: $hook exists and is not ours; not overwriting" >&2
+    exit 1
+fi
+
+mkdir -p "$hooks_dir"
+cat > "$hook" <<'EOF'
+#!/usr/bin/env sh
+# edl-lint pre-commit hook (installed by scripts/install-hooks.sh).
+# Lints only the files changed vs the merge base plus untracked ones;
+# skip once with --no-verify.
+cd "$(git rev-parse --show-toplevel)" && make lint-changed
+EOF
+chmod +x "$hook"
+echo "install-hooks: installed $hook (runs 'make lint-changed')"

@@ -1,0 +1,25 @@
+"""POSITIVE fixture for EDL000 (unused suppression): pragmas that
+suppress nothing — the line they vetted was fixed or deleted, and the
+dead pragma now stands ready to hide the NEXT real finding there.
+Expected findings: EDL000 x2 (the trailing and the whole-line
+pragma). The used pragmas in c1_pragma.py are the clean twin."""
+
+import threading
+
+
+class Ledger(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def add(self, n):
+        with self._lock:
+            self._total += n
+
+    def total(self):
+        with self._lock:
+            return self._total  # edl-lint: disable=EDL002
+
+    # edl-lint: disable=EDL001
+    def reset_locked(self):
+        self._total = 0

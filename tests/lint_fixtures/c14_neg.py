@@ -1,0 +1,57 @@
+"""NEGATIVE fixture for EDL105: the sanctioned stabilizer idioms —
+bucket helpers, ceil-to-multiple pads, power-of-two tiles, min clamps,
+scalar device binding, and per-shape wrappers rebuilt in the loop.
+Expected findings: none."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _prefill_bucket(p, seq_len):
+    return min(seq_len, -(-p // 64) * 64)
+
+
+def bucketed_prefill(model, prompts, seq_len):
+    fn = jax.jit(model)
+    out = []
+    for p in prompts:
+        p_pad = _prefill_bucket(len(out), seq_len)  # bucketed
+        out.append(fn(np.zeros((1, p_pad))))
+    return out
+
+
+def ceil_multiple_inline(model, items, seq_len):
+    fn = jax.jit(model)
+    out = []
+    for i in range(len(items)):
+        t_pad = min(seq_len, ((i + 7) // 8) * 8)  # tile bucket of 8
+        out.append(fn(np.zeros((1, t_pad))))
+    return out
+
+
+def pow2_pad(model, items):
+    fn = jax.jit(model)
+    out = []
+    for i, item in enumerate(items):
+        width = 1 << max(1, i).bit_length()  # next power of two
+        out.append(fn(np.zeros((1, width))))
+    return out
+
+
+def device_bound_index(write_fn, pool, table, start, stop):
+    fn = jax.jit(write_fn)
+    for j in range(start, stop):
+        # the counter is a shape-() device scalar: traced DATA, the
+        # signature never changes (the kv_pool block-write idiom)
+        pool = fn(pool, jnp.asarray(j, jnp.int32),
+                  jnp.asarray(table[j], jnp.int32))
+    return pool
+
+
+def per_shape_wrapper(make_step, shapes):
+    out = []
+    for n in shapes:
+        fn = jax.jit(make_step(n))  # fresh executable per shape:
+        out.append(fn(np.zeros((1, n))))  # deliberate, not churn
+    return out

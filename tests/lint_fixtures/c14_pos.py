@@ -1,0 +1,44 @@
+"""POSITIVE fixture for EDL105 (recompile hazard): jit-wrapped
+executables fed arguments whose abstract signature varies across
+executions. Expected findings: EDL105 x4 — a loop-derived shape, a
+len() of a growing attribute container, a wall-clock read and an
+environment read in the signature."""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def churn_loop(model, n_iters):
+    step = jax.jit(model)
+    out = None
+    for i in range(n_iters):
+        # the loop counter becomes an array SHAPE: one compile per
+        # iteration — the steady-state recompile loop
+        out = step(np.zeros((1, i + 1)))  # EDL105 (loop)
+    return out
+
+
+class BatchRunner(object):
+    def __init__(self, model):
+        self._fn = jax.jit(model)
+        self._staging = []
+
+    def run(self, item):
+        self._staging.append(item)
+        # the staging list grows across calls; its len re-keys the
+        # compile cache on every admission
+        return self._fn(np.zeros((len(self._staging), 8)))  # EDL105
+
+
+def stamped(fn0, x):
+    fn = jax.jit(fn0)
+    return fn(x, time.time())  # EDL105 (clock)
+
+
+def env_sized(fn0):
+    fn = jax.jit(fn0)
+    width = int(os.environ.get("EDL_WIDTH", "64"))
+    return fn(np.zeros((1, width)))  # EDL105 (config)

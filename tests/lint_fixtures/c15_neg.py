@@ -1,0 +1,35 @@
+"""NEGATIVE fixture for EDL106: arrays threaded as arguments, scalar/
+config captures, and untraced closures over arrays. Expected
+findings: none."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_step(scale, causal):
+    def step(weights, x):
+        # params threaded as proper args: donated/updated normally
+        y = x @ weights * scale
+        return jnp.where(causal, y, x)
+
+    return jax.jit(step)
+
+
+def make_weights():
+    return jnp.asarray(np.ones((4096, 4096)))
+
+
+def run(x):
+    weights = make_weights()  # call result, not a ctor literal: the
+    fn = build_step(2.0, True)  # rule never guesses through calls
+    return fn(weights, x)
+
+
+def untraced_closure():
+    table = np.arange(100)
+
+    def host_side(i):
+        return table[i]  # never jitted: a plain python closure
+
+    return host_side

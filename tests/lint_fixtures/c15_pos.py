@@ -1,0 +1,40 @@
+"""POSITIVE fixture for EDL106 (captured-constant bloat): traced
+functions capturing materialized ndarrays by closure. Expected
+findings: EDL106 x3 — a module-level table baked into a decorated jit
+fn, a device matrix captured by the wrap idiom, and a numpy buffer
+captured through a partial-decorated step."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+VOCAB_TABLE = np.arange(1 << 20).reshape(1 << 10, 1 << 10)
+
+
+@jax.jit
+def lookup(idx):
+    # the whole table is re-hashed and re-baked on every retrace
+    return VOCAB_TABLE[idx]  # EDL106
+
+
+def build_step(scale):
+    weights = jnp.asarray(np.ones((4096, 4096)))
+
+    def step(x):
+        return x @ weights * scale  # EDL106 (weights; scale is fine)
+
+    return jax.jit(step)
+
+
+def build_masked():
+    mask = np.ones((2048, 2048))
+
+    @partial(jax.jit, static_argnames=("causal",))
+    def apply(scores, causal):
+        if causal:
+            return scores * mask  # EDL106
+        return scores
+
+    return apply

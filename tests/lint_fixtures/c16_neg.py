@@ -1,0 +1,52 @@
+"""NEGATIVE fixture for EDL107: the sanctioned key idioms — split
+then consume each child once, fold_in a counter per iteration (the
+api/generation position-keyed sampling shape), rebinding between
+sinks, and keys handed to non-sampler consumers. Expected findings:
+none."""
+
+import jax
+
+
+def split_then_sample(shape):
+    key = jax.random.PRNGKey(0)
+    k_q, k_k = jax.random.split(key)
+    q = jax.random.normal(k_q, shape)
+    k = jax.random.uniform(k_k, shape)
+    return q + k
+
+
+def fold_per_position(shape, positions):
+    rng = jax.random.PRNGKey(11)
+    out = []
+    for pos in positions:
+        # fold_in(rng, position): the generation.py sampling idiom
+        sub = jax.random.fold_in(rng, pos)
+        out.append(jax.random.categorical(sub, shape))
+    return out
+
+
+def rebind_between_sinks(shape, n):
+    key = jax.random.PRNGKey(1)
+    rows = []
+    for i in range(n):
+        rows.append(jax.random.normal(key, shape))
+        key, _ = jax.random.split(key)  # fresh key before re-use
+    return rows
+
+
+def closure_folds_inside(n):
+    root = jax.random.PRNGKey(5)
+    samplers = []
+    for i in range(n):
+        sub = jax.random.fold_in(root, i)
+
+        def sample(shape, sub=sub):
+            return jax.random.normal(sub, shape)
+
+        samplers.append(sample)
+    return samplers
+
+
+def init_consumer(model, batch):
+    key = jax.random.PRNGKey(0)
+    return model.init(key, batch)  # not a sampler sink

@@ -1,0 +1,33 @@
+"""POSITIVE fixture for EDL107 (PRNG-key discipline): one key feeding
+two sampler sinks, a key re-consumed across loop iterations, and a
+per-iteration closure sharing one pre-loop key. Expected findings:
+EDL107 x3."""
+
+import jax
+
+
+def double_sink(shape):
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, shape)
+    k = jax.random.uniform(key, shape)  # EDL107: identical randomness
+    return q + k
+
+
+def loop_reconsume(shape, n):
+    key = jax.random.PRNGKey(7)
+    rows = []
+    for _ in range(n):
+        # every iteration draws with the SAME key: n identical rows
+        rows.append(jax.random.normal(key, shape))  # EDL107
+    return rows
+
+
+def closure_shares_key(n):
+    key = jax.random.PRNGKey(3)
+    samplers = []
+    for i in range(n):
+        def sample(shape):
+            return jax.random.normal(key, shape)  # EDL107 (closure)
+
+        samplers.append(sample)
+    return samplers

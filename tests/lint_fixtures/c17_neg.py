@@ -1,0 +1,50 @@
+"""NEGATIVE fixture for EDL601: constraints inside jit contexts
+(decorator, wrap idiom, and a helper nested in one), canonical and
+mesh-declared axis names, constant-derived axes (never guessed), and
+the sanctioned donate + in/out shardings shape. Expected findings:
+none."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.common.constants import MeshAxis
+
+
+@jax.jit
+def decorated_pin(x):
+    return jax.lax.with_sharding_constraint(x, P("dp"))
+
+
+def wrapped_pin(x, sharding):
+    def step(v):
+        def helper(u):
+            # nested inside a traced function: traced with it
+            return jax.lax.with_sharding_constraint(u, sharding)
+
+        return helper(v + 1)
+
+    return jax.jit(step)(x)
+
+
+def declared_axes(devices):
+    mesh = Mesh(np.asarray(devices), ("dp", "fsdp", "ep"))
+    return NamedSharding(mesh, P(("dp", "fsdp"), "ep"))
+
+
+def canonical_axes():
+    return P("tp", "sp")
+
+
+def constant_axes(mesh):
+    # non-literal axis expressions contribute nothing (never guess)
+    return NamedSharding(mesh, P(MeshAxis.EP))
+
+
+def donated_sharded_update(step_fn, state_sharding, batch_sharding):
+    return jax.jit(
+        step_fn,
+        donate_argnums=(0,),
+        in_shardings=(state_sharding, batch_sharding),
+        out_shardings=state_sharding,
+    )

@@ -1,0 +1,36 @@
+"""POSITIVE fixture for EDL601 (sharding discipline): a constraint
+pinned outside any jit context, a mesh-axis typo against the lexical
+Mesh declaration, an axis name outside the canonical MeshAxis set,
+and a donated jit call that drops the output sharding. Expected
+findings: EDL601 x4."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pin_after_the_fact(x, sharding):
+    # outside a trace this is a silent no-op: nothing is pinned
+    y = jax.lax.with_sharding_constraint(x, sharding)  # EDL601
+    return y
+
+
+def typo_against_mesh(devices):
+    mesh = Mesh(np.asarray(devices), ("dp", "fsdp"))
+    # "ddp" names no axis of the enclosing mesh: silent replication
+    return NamedSharding(mesh, P("ddp"))  # EDL601
+
+
+def typo_against_canon(batch_axes):
+    # no lexical mesh here: judged against MeshAxis.ALL
+    return P("tpx", None)  # EDL601
+
+
+def donated_unsharded_update(step_fn, state_sharding, batch_sharding):
+    return jax.jit(
+        step_fn,
+        donate_argnums=(0,),
+        in_shardings=(state_sharding, batch_sharding),
+        # no out_shardings: the donated state's placement is left to
+        # inference — a replicated output un-does the memory win
+    )  # EDL601
