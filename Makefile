@@ -43,7 +43,11 @@
 #                   then the master SIGKILL/journal-recovery drill, the
 #                   serving SIGTERM/SIGKILL drill, the multi-replica
 #                   router chaos drill (SIGKILL + hot reload under live
-#                   load, zero accepted-request loss), and the elastic-
+#                   load, zero accepted-request loss, plus the router-
+#                   kill phase: two journal-sharing router cells, the
+#                   ring-owning cell SIGKILLed mid-load, its traffic
+#                   rerouted by the CellFront and the corpse restarted
+#                   from the journal), and the elastic-
 #                   fleet autoscale drill (ramped Poisson load forces a
 #                   scale-up, a SIGKILL forces a replacement, idle
 #                   forces a drain-based scale-down; supervisor

@@ -103,6 +103,13 @@ RECEIVER_PAIRS = {
     # (or reap, the escalation) or the seat leaks mid-drain forever
     "spawn": (frozenset(["adopt", "reap"]), "supervis"),
     "begin_drain": (frozenset(["retire", "reap"]), None),
+    # the cell supervisor's router-cell lifecycle
+    # (serving/router_main.py CellRoster): a spawned cell must be
+    # adopted into the roster or retired (terminate + wait) on EVERY
+    # path — an unadopted cell is an orphan router process serving
+    # traffic no supervisor restarts, no drill kills, no shutdown
+    # reaps
+    "spawn_cell": (frozenset(["adopt", "retire"]), None),
     # the tiered KV cache's spill lifecycle (serving/kv_pool.py): a
     # chain block demoted to the host tier must either REVIVE (upload
     # back into a device block) or DROP (host-budget LRU / reload

@@ -272,6 +272,21 @@ SUPERVISOR_RPCS = (
     "supervisor_adopt",
 )
 
+# The multi-cell router tier's process boundary (serving/router_cell.py
+# + router_main --cells). Direct intercept() hooks like the supervisor
+# tuple: the cell supervisor intercepts `cell_spawn` per cell launch
+# and each cell intercepts `cell_kill` at its heartbeat tick, so a
+# chaos spec can SIGKILL a live router cell mid-load —
+#   cell_kill:kill:1:skip=4    the cell dies on its 5th heartbeat (the
+#                              router-kill drill phase: in-flight
+#                              accepted requests must re-dispatch
+#                              through a surviving cell)
+#   cell_spawn:drop:1          one cell launch fails outright
+CELL_HOOKS = (
+    "cell_spawn",
+    "cell_kill",
+)
+
 # The runtime-health plane's intercept hooks
 # (observability/runtime_health.py + serving/server.py). Like the
 # supervisor hooks these are direct intercept() call sites, not

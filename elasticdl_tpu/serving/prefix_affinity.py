@@ -1,0 +1,208 @@
+"""Prefix-affinity primitives for the multi-cell router tier.
+
+Three small, dependency-free pieces:
+
+``prefix_fingerprint``
+    Hashes a request's *leading full KV blocks* with the same chain
+    structure the PR 8 content-addressed trie uses — each link is
+    ``H(parent_digest || block_tokens)`` with the trie's root-parent
+    sentinel seeding the chain — so two prompts share a fingerprint
+    exactly when their leading block chain would share trie nodes
+    (and therefore shared KV blocks) on a replica. Prompts shorter
+    than one full block return ``None``: there is nothing to share,
+    and the router decays to pure least-loaded.
+
+``HashRing``
+    A deterministic consistent-hash ring (blake2b points, NOT
+    Python's salted ``hash``) used twice: the cell front consistent-
+    hashes request fingerprints across router cells, and the drill
+    asserts bounded reshuffle under cell add/remove. ``successors``
+    yields distinct nodes in ring order — the failover walk.
+
+``AffinityIndex``
+    A TTL'd, capacity-bounded LRU of fingerprint → replica address,
+    learned on successful dispatch. Staleness is handled by decay,
+    not by trust: an expired or evicted entry simply means the router
+    falls back to least-loaded for that request.
+"""
+
+import bisect
+import hashlib
+import struct
+import threading
+from collections import OrderedDict
+
+#: the trie's root-parent sentinel (the ``parent bid = -1`` analog):
+#: every chain starts here so the first block's digest depends only
+#: on its tokens, exactly like the content-addressed block key.
+_ROOT_DIGEST = b"\xff" * 8
+
+#: digest width: 8 bytes is plenty for an affinity hint (collisions
+#: cost one misrouted dispatch, not correctness).
+_DIGEST_SIZE = 8
+
+
+def _chain_digest(parent, tokens):
+    h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+    h.update(parent)
+    for tok in tokens:
+        h.update(struct.pack("<q", int(tok)))
+    return h.digest()
+
+
+def prefix_fingerprint(prompt, block_tokens=16, max_blocks=4):
+    """Fingerprint the leading full blocks of ``prompt``.
+
+    Returns a hex digest stable across processes (suitable both as an
+    affinity-index key and as a consistent-hash key), or ``None`` when
+    the prompt holds no complete block — short prompts have no
+    shareable prefix chain and should be routed purely by load.
+
+    ``max_blocks`` caps the chain: system prompts dominate sharing,
+    and hashing the whole prompt would make every request's
+    fingerprint unique, defeating affinity.
+    """
+    if block_tokens < 1:
+        raise ValueError("block_tokens must be >= 1")
+    toks = list(prompt)
+    full = len(toks) // block_tokens
+    if full < 1:
+        return None
+    digest = _ROOT_DIGEST
+    for i in range(min(full, max_blocks)):
+        block = toks[i * block_tokens:(i + 1) * block_tokens]
+        digest = _chain_digest(digest, block)
+    return digest.hex()
+
+
+def _ring_point(data):
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big"
+    )
+
+
+class HashRing(object):
+    """Deterministic consistent-hash ring with virtual nodes.
+
+    Every process that builds a ring from the same node set computes
+    the same mapping (blake2b, never the salted builtin ``hash``), so
+    the cell front in the drill process and the cells themselves agree
+    on which cell owns which fingerprint.
+    """
+
+    def __init__(self, nodes=(), vnodes=64):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self._vnodes = int(vnodes)
+        self._points = []  # sorted [(point, node)]
+        self._nodes = set()
+        for node in nodes:
+            self.add(node)
+
+    def __len__(self):
+        return len(self._nodes)
+
+    def __contains__(self, node):
+        return node in self._nodes
+
+    def nodes(self):
+        return sorted(self._nodes)
+
+    def add(self, node):
+        node = str(node)
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for v in range(self._vnodes):
+            point = _ring_point(
+                ("%s#%d" % (node, v)).encode("utf-8")
+            )
+            bisect.insort(self._points, (point, node))
+
+    def remove(self, node):
+        node = str(node)
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._points = [p for p in self._points if p[1] != node]
+
+    def lookup(self, key):
+        """The node owning ``key``, or None on an empty ring."""
+        if not self._points:
+            return None
+        point = _ring_point(str(key).encode("utf-8"))
+        idx = bisect.bisect_right(self._points, (point, chr(0x10FFFF)))
+        if idx == len(self._points):
+            idx = 0
+        return self._points[idx][1]
+
+    def successors(self, key):
+        """All distinct nodes in ring order starting at ``key``'s
+        owner — the failover walk order for the cell front."""
+        if not self._points:
+            return []
+        point = _ring_point(str(key).encode("utf-8"))
+        idx = bisect.bisect_right(self._points, (point, chr(0x10FFFF)))
+        out, seen = [], set()
+        n = len(self._points)
+        for off in range(n):
+            node = self._points[(idx + off) % n][1]
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+        return out
+
+
+class AffinityIndex(object):
+    """TTL'd LRU mapping prefix fingerprint → replica address.
+
+    Learned on successful dispatch; consulted before least-loaded.
+    Entries expire after ``ttl_secs`` (affinity data older than a few
+    lease periods says nothing about current residency) and the table
+    is capacity-bounded so a fingerprint flood cannot balloon router
+    memory. ``forget_address`` drops every entry pointing at a retired
+    replica so affinity never resurrects a dead address.
+    """
+
+    def __init__(self, ttl_secs=60.0, capacity=4096):
+        self._ttl = float(ttl_secs)
+        self._cap = int(capacity)
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()  # fp -> (address, learned_at)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def learn(self, fingerprint, address, now):
+        if fingerprint is None:
+            return
+        with self._lock:
+            self._entries.pop(fingerprint, None)
+            self._entries[fingerprint] = (str(address), float(now))
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+
+    def lookup(self, fingerprint, now):
+        """The learned address, or None when unknown or stale."""
+        if fingerprint is None:
+            return None
+        with self._lock:
+            entry = self._entries.get(fingerprint)
+            if entry is None:
+                return None
+            address, learned_at = entry
+            if now - learned_at > self._ttl:
+                del self._entries[fingerprint]
+                return None
+            self._entries.move_to_end(fingerprint)
+            return address
+
+    def forget_address(self, address):
+        address = str(address)
+        with self._lock:
+            stale = [fp for fp, (addr, _) in self._entries.items()
+                     if addr == address]
+            for fp in stale:
+                del self._entries[fp]
+            return len(stale)

@@ -31,6 +31,19 @@ Mechanisms, each its own small state machine:
   catches the case where two replicas have equal queue DEPTH but very
   different queue TIME), ties broken toward more free KV blocks.
 
+* **Prefix-affine dispatch** — requests with at least one full KV
+  block of prompt are fingerprinted over their leading blocks (the
+  content-addressed trie's chain key: H(parent ‖ block tokens)) and
+  steered to the replica that last served that chain, so shared
+  system prompts prefill once instead of once per unlucky dispatch.
+  Affinity is a HINT with a decay ladder, never an override: the
+  learned target must still be in rotation (a draining or stalled
+  replica is never affine-dispatched, perfect prefix match or not),
+  must still report warm prefix capacity, and must sit within a load
+  margin of the least-loaded candidate — any rung failing decays the
+  request to pure least-loaded routing. `affinity_hits`/
+  `affinity_misses` count the ladder's verdicts.
+
 * **Circuit breakers** — per replica, CLOSED -> OPEN after
   `breaker_threshold` CONSECUTIVE transient dispatch failures; OPEN
   rejects dispatch for `breaker_cooldown_secs`, then HALF_OPEN admits
@@ -118,6 +131,10 @@ from elasticdl_tpu.observability.slo import (
 from elasticdl_tpu.observability.tracing import recorder
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.serving.admission import AdmissionError
+from elasticdl_tpu.serving.prefix_affinity import (
+    AffinityIndex,
+    prefix_fingerprint,
+)
 from elasticdl_tpu.serving.telemetry import RouterTelemetry
 
 
@@ -146,7 +163,20 @@ class RouterConfig(object):
     surface in router_status (SloObjective blocks) and /metrics
     (`edl_router_slo_burn`); the autoscaler logs them read-only.
     metrics_port (None resolves from EDL_METRICS_PORT, unset = off)
-    arms the /metrics exposition."""
+    arms the /metrics exposition.
+
+    Affinity knobs: with `affinity` on, requests whose prompt holds at
+    least one full KV block are fingerprinted over their leading
+    `affinity_block_tokens`-sized blocks (capped at
+    `affinity_max_blocks` — system prompts dominate sharing) and
+    routed to the replica that last served that chain, PROVIDED the
+    learned entry is younger than `affinity_ttl_secs`, the target is
+    still in rotation, still reports warm prefix capacity, and its
+    load is within `affinity_load_margin` score points of the best
+    candidate; any rung failing decays the request to pure
+    least-loaded. cell_id/cells identify this process inside a
+    multi-cell tier (serving/router_cell.py); the single-router
+    defaults are cell_id=0, cells=1."""
 
     def __init__(self, poll_secs=0.5, poll_timeout_secs=2.0,
                  lease_secs=2.5, breaker_threshold=3,
@@ -158,7 +188,10 @@ class RouterConfig(object):
                  metrics_port=None, slo_ttft_p99_ms=30000.0,
                  slo_e2e_p99_ms=60000.0, slo_latency_goal=0.01,
                  slo_goodput_goal=0.02, slo_fast_window_secs=30.0,
-                 slo_slow_window_secs=120.0):
+                 slo_slow_window_secs=120.0, affinity=True,
+                 affinity_block_tokens=16, affinity_max_blocks=4,
+                 affinity_ttl_secs=60.0, affinity_load_margin=2.0,
+                 affinity_capacity=4096, cell_id=0, cells=1):
         self.poll_secs = float(poll_secs)
         self.poll_timeout_secs = float(poll_timeout_secs)
         self.lease_secs = float(lease_secs)
@@ -183,6 +216,14 @@ class RouterConfig(object):
         self.slo_goodput_goal = float(slo_goodput_goal)
         self.slo_fast_window_secs = float(slo_fast_window_secs)
         self.slo_slow_window_secs = float(slo_slow_window_secs)
+        self.affinity = bool(affinity)
+        self.affinity_block_tokens = int(affinity_block_tokens)
+        self.affinity_max_blocks = int(affinity_max_blocks)
+        self.affinity_ttl_secs = float(affinity_ttl_secs)
+        self.affinity_load_margin = float(affinity_load_margin)
+        self.affinity_capacity = int(affinity_capacity)
+        self.cell_id = int(cell_id)
+        self.cells = int(cells)
 
 
 class CircuitBreaker(object):
@@ -263,7 +304,72 @@ class CircuitBreaker(object):
 
 
 class Replica(object):
-    """Registry entry: address, stub, lease, breaker, load signals."""
+    """Registry entry: address, stub, lease, breaker, load signals.
+
+    The heartbeat signals live in DECLARED TABLES, not ad-hoc copies:
+    `OBSERVED_SCALARS` (name -> reset default; the default's type is
+    the coercion `observe` applies) and `OBSERVED_LISTS` name every
+    field one ServerStatus heartbeat refreshes, and `STATUS_FORWARD`
+    names every entry attribute `Router.status_response` forwards
+    verbatim into `pb.ReplicaStatus` (`STATUS_COMPUTED` covers the
+    router-derived rest). A field added to the heartbeat or to the
+    proto therefore fails LOUDLY — the completeness pin test diffs
+    these tables against both message descriptors — instead of being
+    silently dropped between servicer and router_status, which is how
+    `kv_host_blocks`/`prefix_hit_rate_window` nearly went dark."""
+
+    #: every scalar one heartbeat refreshes, with its reset default.
+    #: Notable members: kv_blocks_cached (refcount-0 blocks parked
+    #: reclaimable by the prefix cache — evictable-on-demand headroom
+    #: for the autoscaler's scale-down gate), kv_blocks_shared
+    #: (blocks referenced by >1 sequence: live prefix dedup),
+    #: kv_host_blocks/kv_host_bytes (tiered host spill: warm prefix
+    #: capacity that survives device eviction), prefix_hit_rate_window
+    #: (share of prompt tokens seated without prefill compute over the
+    #: replica's trailing window) — together the warm-capacity ladder
+    #: prefix-affinity routing ranks by; health_state ("" = the
+    #: replica predates the health plane; "stalled" leaves rotation
+    #: and arms the autoscaler's fast replacement path).
+    OBSERVED_SCALARS = {
+        "draining": False,
+        "queue_depth": 0,
+        "active_slots": 0,
+        "kv_blocks_free": 0,
+        "kv_blocks_cached": 0,
+        "kv_blocks_shared": 0,
+        "kv_cache_dtype": "",
+        "kv_host_blocks": 0,
+        "kv_host_bytes": 0,
+        "revive_uploads": 0,
+        "prefill_tokens_revived": 0,
+        "host_drops": 0,
+        "prefix_hit_rate_window": 0.0,
+        "queue_wait_ms": 0.0,
+        "health_state": "",
+        "last_progress_age_ms": 0.0,
+    }
+
+    #: repeated heartbeat fields (histogram BUCKETS, mergeable by
+    #: addition; slow_cause_counts = terminally-slow requests by
+    #: dominant attributed cause, forensics taxonomy order)
+    OBSERVED_LISTS = ("ttft_hist", "queue_wait_hist",
+                      "slow_cause_counts")
+
+    #: ReplicaStatus fields forwarded verbatim from the entry by
+    #: status_response (attribute name == proto field name)
+    STATUS_FORWARD = (
+        "address", "draining", "queue_depth", "active_slots",
+        "kv_blocks_free", "kv_blocks_cached", "kv_blocks_shared",
+        "kv_cache_dtype", "kv_host_blocks", "kv_host_bytes",
+        "revive_uploads", "prefill_tokens_revived", "host_drops",
+        "prefix_hit_rate_window", "queue_wait_ms", "dispatched",
+        "failures", "inflight", "slow_cause_counts", "health_state",
+        "last_progress_age_ms",
+    )
+
+    #: the router-derived remainder of pb.ReplicaStatus —
+    #: STATUS_FORWARD + STATUS_COMPUTED must cover the message exactly
+    STATUS_COMPUTED = ("healthy", "breaker", "lease_remaining_secs")
 
     def __init__(self, address, stub, breaker, lease_until):
         self.address = address
@@ -279,44 +385,10 @@ class Replica(object):
         # works before the first poll lands; a dead replica burns the
         # grace on its breaker instead
         self.lease_expires_at = lease_until
-        self.draining = False
-        self.queue_depth = 0
-        self.active_slots = 0
-        self.kv_blocks_free = 0
-        # refcount-0 blocks parked reclaimable by the prefix cache:
-        # not free, but evictable on demand — real headroom for the
-        # autoscaler's scale-down gate
-        self.kv_blocks_cached = 0
-        # the replica's KV arena storage format ("" | "int8")
-        self.kv_cache_dtype = ""
-        # tiered host spill, passed through from ServerStatus: how
-        # much warm prefix capacity survives eviction on this replica
-        # (the signal prefix-affinity routing will want: warm != cold)
-        self.kv_host_blocks = 0
-        self.kv_host_bytes = 0
-        self.revive_uploads = 0
-        self.prefill_tokens_revived = 0
-        self.host_drops = 0
-        # windowed warm-capacity signal (share of prompt tokens seated
-        # without prefill compute over the replica's trailing ring
-        # window) — what prefix-affinity routing will rank by
-        self.prefix_hit_rate_window = 0.0
-        self.queue_wait_ms = 0.0
-        # runtime-health self-report, passed through from
-        # ServerStatus: "" = the replica predates the health plane
-        # (or runs with it off) — lease decay is the only wedge
-        # signal then; "stalled" takes the replica out of the
-        # dispatch rotation and arms the autoscaler's fast
-        # self-report replacement path
-        self.health_state = ""
-        self.last_progress_age_ms = 0.0
-        self.ttft_hist = []
-        self.queue_wait_hist = []
-        # terminally-slow requests by dominant attributed cause
-        # (forensics taxonomy, declared order) — passed through from
-        # ServerStatus so router_status answers the fleet's
-        # distribution-of-why without touching a replica
-        self.slow_cause_counts = []
+        for name, default in self.OBSERVED_SCALARS.items():
+            setattr(self, name, default)
+        for name in self.OBSERVED_LISTS:
+            setattr(self, name, [])
         self.dispatched = 0
         self.failures = 0
         self.poll_failures = 0
@@ -403,28 +475,29 @@ class Replica(object):
         return (self.queue_depth + self.active_slots + inflight
                 + self.queue_wait_ms / 50.0)
 
+    def warm_capacity(self):
+        """Whether this replica plausibly still HOLDS warm prefix
+        state worth routing toward: shared or cached device blocks, a
+        host tier with spilled chains, or a recent window of prompt
+        tokens seated without prefill. All four zero means a prefix
+        match here would prefill cold anyway — affinity decays to
+        least-loaded rather than herding onto a cold target."""
+        return (self.kv_blocks_shared > 0 or self.kv_blocks_cached > 0
+                or self.kv_host_blocks > 0
+                or self.prefix_hit_rate_window > 0.0)
+
     def observe(self, status, lease_until):
+        """One heartbeat's signal copy, driven by the declared tables:
+        every OBSERVED_SCALARS member is coerced through its default's
+        type (bool for draining, str for kv_cache_dtype, ...), every
+        OBSERVED_LISTS member is snapshotted as a plain list (raw
+        histogram buckets merge by addition fleet-wide)."""
         self.lease_expires_at = lease_until
-        self.draining = bool(status.draining)
-        self.queue_depth = status.queue_depth
-        self.active_slots = status.active_slots
-        self.kv_blocks_free = status.kv_blocks_free
-        self.kv_blocks_cached = status.kv_blocks_cached
-        self.kv_cache_dtype = status.kv_cache_dtype
-        self.kv_host_blocks = status.kv_host_blocks
-        self.kv_host_bytes = status.kv_host_bytes
-        self.revive_uploads = status.revive_uploads
-        self.prefill_tokens_revived = status.prefill_tokens_revived
-        self.host_drops = status.host_drops
-        self.prefix_hit_rate_window = status.prefix_hit_rate_window
-        self.queue_wait_ms = status.queue_wait_ms
-        self.health_state = status.health_state
-        self.last_progress_age_ms = status.last_progress_age_ms
-        # raw histogram buckets (mergeable by addition): the router
-        # sums these across replicas for fleet-wide percentiles
-        self.ttft_hist = list(status.ttft_hist)
-        self.queue_wait_hist = list(status.queue_wait_hist)
-        self.slow_cause_counts = list(status.slow_cause_counts)
+        for name, default in self.OBSERVED_SCALARS.items():
+            setattr(self, name,
+                    type(default)(getattr(status, name)))
+        for name in self.OBSERVED_LISTS:
+            setattr(self, name, list(getattr(status, name)))
 
 
 def _default_stub_factory(address):
@@ -469,6 +542,14 @@ class Router(object):
             base_delay_secs=self.config.base_delay_secs,
             max_delay_secs=self.config.max_delay_secs,
             reconnect_window_secs=self.config.redispatch_window_secs,
+        )
+        # prefix-affinity memory: fingerprint -> last replica that
+        # served it, learned on successful dispatch, TTL'd + LRU
+        # bounded (stale affinity decays to least-loaded, it never
+        # overrides rotation state)
+        self._affinity = AffinityIndex(
+            ttl_secs=self.config.affinity_ttl_secs,
+            capacity=self.config.affinity_capacity,
         )
         self._lock = threading.Lock()
         self._replicas = {}
@@ -567,6 +648,9 @@ class Router(object):
             rep = self._replicas.pop(address, None)
         if rep is not None:
             rep.retire()
+            # affinity must never resurrect a removed address: drop
+            # every fingerprint that learned it
+            self._affinity.forget_address(address)
         return rep
 
     def replicas(self):
@@ -658,9 +742,30 @@ class Router(object):
 
     # -------------------------------------------------------- selection
 
-    def _acquire_replica(self, now, exclude=()):
-        """Best in-rotation replica (least-loaded, then most free KV
-        blocks), with its breaker probe slot acquired. None = shed."""
+    def _fingerprint(self, request):
+        """The request's prefix fingerprint under the configured block
+        geometry, or None when affinity is off or the prompt holds no
+        complete block (nothing shareable -> pure least-loaded)."""
+        if not self.config.affinity:
+            return None
+        return prefix_fingerprint(
+            request.prompt,
+            block_tokens=self.config.affinity_block_tokens,
+            max_blocks=self.config.affinity_max_blocks,
+        )
+
+    def _acquire_replica(self, now, exclude=(), fingerprint=None):
+        """Best in-rotation replica with its breaker probe slot
+        acquired, as `(replica, affine)`; (None, False) = shed.
+
+        With a fingerprint, the affinity decay ladder runs first:
+        learned entry fresh -> target among the in-rotation candidates
+        (so a draining/stalled replica or an open breaker is NEVER
+        affine-dispatched, however perfect the prefix match — the
+        candidate filter IS the guard) -> target still reports warm
+        prefix capacity -> target's load within affinity_load_margin
+        of the least-loaded candidate -> breaker slot acquired. Any
+        rung failing falls through to the least-loaded order below."""
         with self._lock:
             candidates = [
                 r for r in self._replicas.values()
@@ -669,10 +774,22 @@ class Router(object):
         candidates.sort(
             key=lambda r: (r.load_score(), -r.kv_blocks_free, r.address)
         )
+        if fingerprint is not None and candidates:
+            target = self._affinity.lookup(fingerprint, now)
+            if target is not None:
+                affine = next((r for r in candidates
+                               if r.address == target), None)
+                if (affine is not None
+                        and affine.warm_capacity()
+                        and affine.load_score()
+                        <= (candidates[0].load_score()
+                            + self.config.affinity_load_margin)):
+                    if affine.breaker.acquire(now):
+                        return affine, True
         for rep in candidates:
             if rep.breaker.acquire(now):
-                return rep
-        return None
+                return rep, False
+        return None, False
 
     # --------------------------------------------------------- dispatch
 
@@ -808,6 +925,7 @@ class Router(object):
         attempt — token parity guarantees replica-independence."""
         self.telemetry.count("routed")
         root = self._root_span("router_generate", request)
+        fp = self._fingerprint(request)
         t0 = self._clock()
         window_ends = t0 + self.config.redispatch_window_secs
         attempt = 0
@@ -818,12 +936,16 @@ class Router(object):
             except RouterError as e:
                 self._raise_terminal(e, root=root)
             now = self._clock()
-            rep = self._acquire_replica(now, exclude=failed)
+            rep, affine = self._acquire_replica(
+                now, exclude=failed, fingerprint=fp
+            )
             if rep is None and failed:
                 # every live replica failed this request once already;
                 # forgive and re-pick — the breaker/lease state decides
                 failed = set()
-                rep = self._acquire_replica(now)
+                rep, affine = self._acquire_replica(
+                    now, fingerprint=fp
+                )
             if rep is None:
                 self.telemetry.count("shed")
                 root.event("shed")
@@ -832,12 +954,25 @@ class Router(object):
                     "RESOURCE_EXHAUSTED",
                     "no healthy replicas in rotation (shed)",
                 )
+            if fp is not None and attempt == 0:
+                # the ladder's verdict, counted once per request (the
+                # first pick; re-dispatches would double-count)
+                self.telemetry.count(
+                    "affinity_hits" if affine else "affinity_misses"
+                )
+                if affine:
+                    root.event("affinity", replica=rep.address)
             try:
                 resp = self._dispatch_maybe_hedged(
                     rep, request, remaining_ms, timeout, failed,
                     root, attempt,
                 )
                 self.telemetry.count("completed")
+                # a success TEACHES affinity: the chain's blocks are
+                # resident on this replica now
+                if fp is not None:
+                    self._affinity.learn(fp, rep.address,
+                                         self._clock())
                 self._finish_e2e(root, t0)
                 return resp
             except Exception as e:  # noqa: BLE001 - classified below
@@ -906,7 +1041,9 @@ class Router(object):
                         "hedged dispatch timed out on every leg",
                     )
                 hedged = True
-                hedge_rep = self._acquire_replica(
+                # no fingerprint: the hedge exists to land SOMEWHERE
+                # ELSE than the (possibly affine) slow primary
+                hedge_rep, _ = self._acquire_replica(
                     self._clock(),
                     exclude=set(failed) | {primary.address},
                 )
@@ -940,6 +1077,7 @@ class Router(object):
         never silently truncated, never hung."""
         self.telemetry.count("routed")
         root = self._root_span("router_generate_stream", request)
+        fp = self._fingerprint(request)
         t0 = self._clock()
         window_ends = t0 + self.config.redispatch_window_secs
         attempt = 0
@@ -954,10 +1092,14 @@ class Router(object):
                 except RouterError as e:
                     self._raise_terminal(e, root=root)
                 now = self._clock()
-                rep = self._acquire_replica(now, exclude=failed)
+                rep, affine = self._acquire_replica(
+                    now, exclude=failed, fingerprint=fp
+                )
                 if rep is None and failed:
                     failed = set()
-                    rep = self._acquire_replica(now)
+                    rep, affine = self._acquire_replica(
+                        now, fingerprint=fp
+                    )
                 if rep is None:
                     self.telemetry.count("shed")
                     root.event("shed")
@@ -966,6 +1108,13 @@ class Router(object):
                         "RESOURCE_EXHAUSTED",
                         "no healthy replicas in rotation (shed)",
                     )
+                if fp is not None and attempt == 0:
+                    self.telemetry.count(
+                        "affinity_hits" if affine
+                        else "affinity_misses"
+                    )
+                    if affine:
+                        root.event("affinity", replica=rep.address)
                 span = recorder().start_span(
                     "dispatch", trace_id=root.trace_id,
                     parent_span_id=root.span_id, replica=rep.address,
@@ -987,6 +1136,9 @@ class Router(object):
                     self._on_success(rep)
                     span.finish("ok")
                     self.telemetry.count("completed")
+                    if fp is not None:
+                        self._affinity.learn(fp, rep.address,
+                                             self._clock())
                     self._finish_e2e(root, t0)
                     return
                 except Exception as e:  # noqa: BLE001 - classified
@@ -1050,32 +1202,20 @@ class Router(object):
                 )
         reps = []
         for rep in sorted(self.replicas(), key=lambda r: r.address):
-            reps.append(pb.ReplicaStatus(
-                address=rep.address,
+            # table-driven: STATUS_FORWARD attrs pass through verbatim
+            # (attribute name == proto field name by declaration), the
+            # STATUS_COMPUTED remainder is derived here — the pin test
+            # holds the union congruent with the message descriptor
+            kwargs = {name: getattr(rep, name)
+                      for name in Replica.STATUS_FORWARD}
+            kwargs.update(
                 healthy=rep.in_rotation(now),
-                draining=rep.draining,
                 breaker=rep.breaker.state,
                 lease_remaining_secs=max(
                     0.0, rep.lease_expires_at - now
                 ),
-                queue_depth=rep.queue_depth,
-                active_slots=rep.active_slots,
-                kv_blocks_free=rep.kv_blocks_free,
-                kv_cache_dtype=rep.kv_cache_dtype,
-                kv_host_blocks=rep.kv_host_blocks,
-                kv_host_bytes=rep.kv_host_bytes,
-                revive_uploads=rep.revive_uploads,
-                prefill_tokens_revived=rep.prefill_tokens_revived,
-                host_drops=rep.host_drops,
-                prefix_hit_rate_window=rep.prefix_hit_rate_window,
-                queue_wait_ms=rep.queue_wait_ms,
-                dispatched=rep.dispatched,
-                failures=rep.failures,
-                inflight=rep.inflight,
-                slow_cause_counts=rep.slow_cause_counts,
-                health_state=rep.health_state,
-                last_progress_age_ms=rep.last_progress_age_ms,
-            ))
+            )
+            reps.append(pb.ReplicaStatus(**kwargs))
         autoscaler = None
         if self.autoscaler is not None:
             autoscaler = self.autoscaler.status_block()
@@ -1127,6 +1267,10 @@ class Router(object):
             hedge_wins=snap["hedge_wins"],
             shed=snap["shed"],
             breaker_trips=snap["breaker_trips"],
+            affinity_hits=snap["affinity_hits"],
+            affinity_misses=snap["affinity_misses"],
+            cell_id=self.config.cell_id,
+            cells=self.config.cells,
             uptime_secs=snap["uptime_secs"],
             e2e_p50_ms=snap["e2e_p50_ms"],
             e2e_p90_ms=snap["e2e_p90_ms"],
@@ -1193,6 +1337,11 @@ class Router(object):
     # -------------------------------------------------------- lifecycle
 
     def start(self, grpc_server=True, injector=None):
+        # identify this process inside the (possibly multi-cell) tier:
+        # a per-cell scrape disambiguates which cell's counters these
+        # are without parsing ports out of labels
+        self.telemetry.gauge("cell_id", self.config.cell_id)
+        self.telemetry.gauge("cells", self.config.cells)
         self._heartbeat = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
             name="router-heartbeat",

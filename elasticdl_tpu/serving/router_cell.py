@@ -1,0 +1,646 @@
+"""Multi-cell router tier: journaled registry sharing + cell failover.
+
+One router process is a single point of failure in front of the whole
+serving fleet: SIGKILL it and admission, dispatch, SLO accounting and
+the autoscaler's eyes die together, however fault-tolerant the
+replicas behind it are. This module removes that SPOF with the same
+journaled-state machinery that makes the master restartable
+(master/state_store.py):
+
+``CellRegistryJournal``
+    A write-ahead journal + compacted snapshot of the REPLICA
+    REGISTRY, shared by every cell through one ``--cell_journal_dir``.
+    Membership transitions (``adopt``/``retire``) and periodic
+    ``lease`` beacons are appended write-ahead and replayed on cell
+    start, so a cell that crashes — or a brand-new cell started with
+    NO ``--replica`` flags — rebuilds the fleet view from disk alone.
+    Cross-process safety is one ``flock`` around every append/refresh/
+    compact; each cell tails the journal from its own byte offset, so
+    a membership change recorded by cell 0 reaches cell 1 at its next
+    heartbeat tick. Compaction (snapshot + journal truncate) happens
+    at tick boundaries, like the PR 9 supervisor roster; a tailing
+    cell that sees the journal shrink under its offset resyncs from
+    the snapshot.
+
+``RouterCell``
+    A ``Router`` whose membership is journal-backed: local
+    ``add_replica``/``remove_replica`` journal the transition, remote
+    transitions arrive via ``refresh()`` at each heartbeat tick, and
+    ``router_status`` grows the cell block (cell_id/cells,
+    journal_events/journal_replayed/cell_restarts). The ``cell_kill``
+    fault hook fires at the tick, so a chaos spec can SIGKILL a live
+    cell exactly the way pod eviction would.
+
+``CellFront``
+    The thin client-side cell map: requests are consistent-hashed by
+    prefix fingerprint across cells (shared-prompt traffic lands on
+    ONE cell, whose affinity index then keeps it on ONE replica — the
+    prefill-once-per-cell property), and a dead cell's requests walk
+    the ring to the surviving cells under the common/retry.py
+    classification: transient failures reroute with full-jitter
+    backoff inside a bounded window, backpressure propagates (the
+    registry is SHARED — every cell would shed the same fleet), and a
+    stream reroutes only before its first delivered chunk. Per-cell
+    circuit breakers keep a dead cell from eating a probe per request.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX fallback
+    fcntl = None
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.retry import (
+    RetryPolicy,
+    is_backpressure_rpc_error,
+    is_transient_rpc_error,
+)
+from elasticdl_tpu.master.state_store import JOURNAL_FILE, JobStateStore
+from elasticdl_tpu.serving.prefix_affinity import (
+    HashRing,
+    prefix_fingerprint,
+)
+from elasticdl_tpu.serving.router import (
+    CircuitBreaker,
+    Router,
+    RouterError,
+    _code_name,
+)
+
+#: registry lock file inside the journal dir: ONE flock serializes
+#: append/refresh/compact across every cell process
+REGISTRY_LOCK_FILE = ".registry.lock"
+
+
+class CellRegistryJournal(object):
+    """flock-serialized write-ahead journal of the replica registry,
+    shared by the cells of one router tier through a common directory.
+
+    Event schema (one JSON object per journal line):
+
+        {"op": "adopt",  "address": "<addr>", "cell": <id>}
+        {"op": "retire", "address": "<addr>", "cell": <id>}
+        {"op": "lease",  "addresses": ["<addr>", ...], "cell": <id>}
+
+    ``adopt``/``retire`` are the membership transitions; ``lease`` is
+    a periodic liveness beacon (which addresses the recording cell saw
+    in rotation) — informational under replay, since every cell runs
+    its own heartbeat and re-earns leases itself. All three are
+    idempotent under replay: adopt of a present address and retire of
+    an absent one are no-ops, which is what lets compaction truncate
+    mid-stream and crashes replay the journal against the newest
+    snapshot. The snapshot is ``{"replicas": [addr, ...]}``.
+
+    Offsets: each process tails the journal from its own byte offset
+    (advanced past its OWN appends inside the same flock, so refresh
+    never re-applies them). A journal shorter than the offset means
+    another cell compacted — the tailer resyncs from snapshot+journal.
+    """
+
+    def __init__(self, journal_dir, cell_id=0, snapshot_every=64):
+        self._dir = journal_dir
+        self.cell_id = int(cell_id)
+        self._store = JobStateStore(journal_dir,
+                                    snapshot_every=snapshot_every)
+        self._journal_path = os.path.join(journal_dir, JOURNAL_FILE)
+        self._lock_path = os.path.join(journal_dir, REGISTRY_LOCK_FILE)
+        # one mutex per process: the heartbeat tick and a concurrent
+        # membership change must not interleave inside the flock
+        self._mutex = threading.RLock()
+        self._offset = 0
+        self._pending_compact = False
+        self._apply = None
+        self._snapshot_state = None
+        self.replayed = 0
+        self.appends = 0
+        self.resyncs = 0
+
+    @property
+    def restarts(self):
+        return self._store.restart_count
+
+    @contextlib.contextmanager
+    def _flock(self):
+        with self._mutex:
+            f = open(self._lock_path, "a+")
+            try:
+                if fcntl is not None:
+                    fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+                yield
+            finally:
+                if fcntl is not None:
+                    fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+                f.close()
+
+    def bind(self, apply_event, snapshot_state):
+        """Wire the owning cell in: ``apply_event(event)`` applies one
+        journal event to the registry (without re-journaling it);
+        ``snapshot_state()`` returns the compaction snapshot dict."""
+        self._apply = apply_event
+        self._snapshot_state = snapshot_state
+
+    # ---------------------------------------------------------- replay
+
+    def replay(self):
+        """Rebuild the registry view from disk at cell start: snapshot
+        first, then every surviving journal event, in order. Returns
+        the number of membership items replayed."""
+        with self._flock():
+            return self._resync_locked(initial=True)
+
+    def _resync_locked(self, initial=False):
+        snapshot, events = self._store.load()
+        n = 0
+        if snapshot:
+            for addr in snapshot.get("replicas", ()):
+                self._apply({"op": "adopt", "address": addr})
+                n += 1
+        for event in events:
+            self._apply(event)
+            n += 1
+        self._offset = self._journal_size()
+        if initial:
+            self.replayed = n
+        else:
+            self.resyncs += 1
+        return n
+
+    def _journal_size(self):
+        try:
+            return os.path.getsize(self._journal_path)
+        except OSError:
+            return 0
+
+    # ----------------------------------------------------------- tailing
+
+    def refresh(self):
+        """Apply every event other cells appended since our offset.
+        Called at each heartbeat tick (and before every append, so a
+        record can never reorder against an unseen remote event)."""
+        with self._flock():
+            return self._refresh_locked()
+
+    def _refresh_locked(self):
+        size = self._journal_size()
+        if size < self._offset:
+            # another cell compacted under us: the snapshot now owns
+            # our prefix — resync the whole view (events idempotent)
+            return self._resync_locked()
+        if size == self._offset:
+            return 0
+        n = 0
+        with open(self._journal_path) as f:
+            f.seek(self._offset)
+            for line in f.readlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    self._apply(json.loads(line))
+                    n += 1
+                except ValueError:
+                    # torn tail mid-append by another cell: leave the
+                    # offset short of it; the next refresh re-reads
+                    break
+            self._offset = f.tell()
+        return n
+
+    # ---------------------------------------------------------- writing
+
+    def record(self, event):
+        """Write-ahead one registry event (refresh-first under the same
+        flock, so local appends serialize AFTER every remote event we
+        had not yet applied)."""
+        event = dict(event)
+        event.setdefault("cell", self.cell_id)
+        with self._flock():
+            self._refresh_locked()
+            if self._store.append(event):
+                self._pending_compact = True
+            self.appends += 1
+            # our own append is already applied locally: advance past
+            # it so refresh never replays it at us
+            self._offset = self._journal_size()
+
+    def compact_at_tick(self):
+        """Tick-boundary compaction (the PR 9 roster discipline): when
+        an append crossed the snapshot_every threshold, write the
+        snapshot and truncate the journal under one flock — no event
+        is lost because refresh runs first inside the same critical
+        section."""
+        if not self._pending_compact:
+            return False
+        with self._flock():
+            if not self._pending_compact:
+                return False
+            self._refresh_locked()
+            self._store.write_snapshot(self._snapshot_state())
+            self._offset = 0
+            self._pending_compact = False
+        return True
+
+    def close(self):
+        self._store.close()
+
+
+class RouterCell(Router):
+    """A Router whose replica registry is journal-backed and shared
+    with sibling cells. Construction order matters: the journal attrs
+    exist BEFORE Router.__init__ runs (which registers the seed
+    replicas through our overridden add_replica)."""
+
+    #: journal a lease beacon every N heartbeat ticks (liveness is
+    #: re-earned per cell; the beacon is forensic, not authoritative)
+    LEASE_JOURNAL_EVERY = 8
+
+    def __init__(self, replica_addrs, config=None, journal_dir=None,
+                 **kwargs):
+        # set before super().__init__: Router's constructor calls
+        # add_replica for every seed, and the override consults these
+        self._journal = None
+        self._tick = 0
+        self._cell_injector = None
+        super(RouterCell, self).__init__(replica_addrs, config=config,
+                                         **kwargs)
+        if journal_dir:
+            self._journal = CellRegistryJournal(
+                journal_dir, cell_id=self.config.cell_id,
+            )
+            self._journal.bind(self._apply_event, self._snapshot_state)
+            replayed = self._journal.replay()
+            # seeds the journal had not seen yet become adopt events,
+            # so a sibling cell started with NO --replica flags still
+            # learns the full fleet
+            for rep in self.replicas():
+                self._journal.record(
+                    {"op": "adopt", "address": rep.address}
+                )
+            logger.info(
+                "router cell %d/%d: journal %s replayed %d items "
+                "(restart #%d)", self.config.cell_id,
+                self.config.cells, journal_dir, replayed,
+                self._journal.restarts,
+            )
+
+    # ------------------------------------------------- journal plumbing
+
+    def _apply_event(self, event):
+        """One journal event into the registry, WITHOUT re-journaling:
+        apply goes through the base-class membership calls."""
+        op = event.get("op")
+        addr = event.get("address")
+        if op == "adopt" and addr:
+            Router.add_replica(self, addr)
+        elif op == "retire" and addr:
+            Router.remove_replica(self, addr)
+        # "lease" beacons and unknown (newer-schema) ops: forensic
+        # only — every cell re-earns leases through its own heartbeat
+
+    def _snapshot_state(self):
+        return {"replicas": sorted(r.address
+                                   for r in self.replicas())}
+
+    # ------------------------------------------------------- membership
+
+    def add_replica(self, address):
+        with self._lock:
+            known = address in self._replicas
+        rep = Router.add_replica(self, address)
+        if not known and self._journal is not None:
+            self._journal.record({"op": "adopt", "address": address})
+        return rep
+
+    def remove_replica(self, address):
+        rep = Router.remove_replica(self, address)
+        if rep is not None and self._journal is not None:
+            self._journal.record({"op": "retire", "address": address})
+        return rep
+
+    # -------------------------------------------------------- heartbeat
+
+    def poll_once(self):
+        if self._journal is not None:
+            try:
+                self._journal.refresh()
+            except Exception as e:  # noqa: BLE001 - next tick retries
+                logger.warning("cell %d journal refresh failed: %r",
+                               self.config.cell_id, e)
+        healthy = Router.poll_once(self)
+        self._tick += 1
+        if self._journal is not None:
+            if self._tick % self.LEASE_JOURNAL_EVERY == 0:
+                now = self._clock()
+                self._journal.record({
+                    "op": "lease",
+                    "addresses": sorted(
+                        r.address for r in self.replicas()
+                        if r.in_rotation(now)
+                    ),
+                })
+            try:
+                self._journal.compact_at_tick()
+            except Exception as e:  # noqa: BLE001 - next tick retries
+                logger.warning("cell %d journal compact failed: %r",
+                               self.config.cell_id, e)
+        if self._cell_injector is not None:
+            # the chaos drill's router-kill phase: a `cell_kill:kill`
+            # rule SIGKILLs this very process at a tick boundary —
+            # exactly the pod-eviction shape the tier must survive
+            self._cell_injector.intercept("cell_kill", context=None,
+                                          when="before")
+        return healthy
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self, grpc_server=True, injector=None):
+        self._cell_injector = injector
+        return Router.start(self, grpc_server=grpc_server,
+                            injector=injector)
+
+    def stop(self, grace=5.0):
+        Router.stop(self, grace=grace)
+        if self._journal is not None:
+            self._journal.close()
+
+    # ----------------------------------------------------------- status
+
+    def status_response(self):
+        resp = Router.status_response(self)
+        if self._journal is not None:
+            resp.journal_events = self._journal.appends
+            resp.journal_replayed = self._journal.replayed
+            resp.cell_restarts = self._journal.restarts
+        return resp
+
+
+def _default_cell_stub_factory(address):
+    from elasticdl_tpu.proto.service import RouterStub, build_channel
+
+    channel = build_channel(address)
+    stub = RouterStub(channel)
+    stub.close = channel.close
+    return stub
+
+
+class CellFront(object):
+    """Client-side cell map with consistent-hash dispatch and bounded
+    reroute on cell death.
+
+    Requests are keyed by prefix fingerprint (whole shared-prompt
+    families land on one cell — whose affinity index then lands them
+    on one replica) and walk the ring's successor order on failure.
+    Classification mirrors the router's own re-dispatch ladder
+    (common/retry.py): transient (UNAVAILABLE/CANCELLED/timeout) means
+    THIS CELL died or wedged — reroute to the next ring successor with
+    full-jitter backoff inside `reroute_window_secs`; backpressure
+    (RESOURCE_EXHAUSTED) means the FLEET is out of capacity — the
+    registry is shared, every surviving cell sees the same replicas,
+    so rerouting would only add load, and the shed propagates;
+    anything else is the request's own fault and propagates untouched.
+    Streams reroute only before their first delivered chunk. Unary
+    router_generate is idempotent end to end, so a reroute at any
+    point — including after a cell accepted the request and died
+    mid-dispatch — is safe: zero accepted-request loss is the drill's
+    acceptance bar."""
+
+    def __init__(self, cell_addrs, stub_factory=None,
+                 reroute_window_secs=15.0, base_delay_secs=0.05,
+                 max_delay_secs=0.5, timeout_secs=120.0,
+                 breaker_threshold=3, breaker_cooldown_secs=1.0,
+                 block_tokens=16, max_blocks=4,
+                 clock=time.monotonic, sleep=time.sleep):
+        self._stub_factory = stub_factory or _default_cell_stub_factory
+        self._clock = clock
+        self._sleep = sleep
+        self._timeout = float(timeout_secs)
+        self._window = float(reroute_window_secs)
+        self._block_tokens = int(block_tokens)
+        self._max_blocks = int(max_blocks)
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown = float(breaker_cooldown_secs)
+        self._policy = RetryPolicy(
+            base_delay_secs=base_delay_secs,
+            max_delay_secs=max_delay_secs,
+            reconnect_window_secs=reroute_window_secs,
+        )
+        self._lock = threading.Lock()
+        self._ring = HashRing()
+        self._stubs = {}
+        self._breakers = {}
+        self.counters = {"routed": 0, "completed": 0, "rerouted": 0,
+                         "cell_failures": 0, "shed": 0}
+        for addr in cell_addrs:
+            self.add_cell(addr)
+
+    # ---------------------------------------------------------- cell map
+
+    def add_cell(self, address):
+        with self._lock:
+            if address in self._stubs:
+                return
+            self._stubs[address] = self._stub_factory(address)
+            self._breakers[address] = CircuitBreaker(
+                self._breaker_threshold, self._breaker_cooldown,
+            )
+            self._ring.add(address)
+
+    def remove_cell(self, address):
+        with self._lock:
+            stub = self._stubs.pop(address, None)
+            self._breakers.pop(address, None)
+            self._ring.remove(address)
+        if stub is not None:
+            close = getattr(stub, "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception as e:  # noqa: BLE001 - best effort
+                    logger.debug("closing cell channel %s failed: %r",
+                                 address, e)
+
+    def cells(self):
+        with self._lock:
+            return self._ring.nodes()
+
+    def close(self):
+        for addr in list(self.cells()):
+            self.remove_cell(addr)
+
+    # ----------------------------------------------------------- routing
+
+    def _route_key(self, request):
+        fp = prefix_fingerprint(request.prompt,
+                                block_tokens=self._block_tokens,
+                                max_blocks=self._max_blocks)
+        if fp is not None:
+            return fp
+        # short prompts have no shareable prefix: any deterministic
+        # key spreads them; affinity inside the cell is moot anyway
+        return "short:%d:%s" % (
+            len(request.prompt),
+            ",".join(str(t) for t in list(request.prompt)[:8]),
+        )
+
+    def _targets(self, key):
+        """The ring's failover walk for this key: owner first, then
+        every other cell in ring order (deterministic across
+        processes)."""
+        with self._lock:
+            return [
+                (addr, self._stubs[addr], self._breakers[addr])
+                for addr in self._ring.successors(key)
+                if addr in self._stubs
+            ]
+
+    def _count(self, name):
+        with self._lock:
+            self.counters[name] += 1
+
+    def generate(self, request, timeout=None):
+        """Unary generate through the owning cell, walking the ring on
+        transient cell failure. Raises RouterError with the terminal
+        status name, exactly like the router itself."""
+        self._count("routed")
+        key = self._route_key(request)
+        timeout = self._timeout if timeout is None else timeout
+        deadline = self._clock() + self._window
+        attempt = 0
+        last_exc = None
+        while True:
+            dispatched = False
+            for addr, stub, breaker in self._targets(key):
+                now = self._clock()
+                if not breaker.acquire(now):
+                    continue
+                if attempt or dispatched:
+                    self._count("rerouted")
+                dispatched = True
+                try:
+                    resp = stub.router_generate(request,
+                                                timeout=timeout)
+                except Exception as e:  # noqa: BLE001 - classified
+                    last_exc = e
+                    if is_backpressure_rpc_error(e):
+                        # the cell ANSWERED: alive, fleet saturated.
+                        # Every cell shares the registry — reroute
+                        # would re-shed — so propagate the shed.
+                        breaker.record_success()
+                        self._count("shed")
+                        raise RouterError(_code_name(e), str(e))
+                    if is_transient_rpc_error(e):
+                        breaker.record_failure(self._clock())
+                        self._count("cell_failures")
+                        continue  # next cell in ring order
+                    breaker.release_probe()
+                    raise RouterError(_code_name(e), str(e))
+                breaker.record_success()
+                self._count("completed")
+                return resp
+            if self._clock() >= deadline:
+                raise RouterError(
+                    _code_name(last_exc) if last_exc is not None
+                    else "UNAVAILABLE",
+                    "no router cell reachable inside the %.1fs "
+                    "reroute window: %r" % (self._window, last_exc),
+                )
+            self._sleep(min(self._policy.backoff(attempt),
+                            max(0.0, deadline - self._clock())))
+            attempt += 1
+
+    def generate_stream(self, request, timeout=None):
+        """Streaming generate: reroute to the next cell only BEFORE
+        the first chunk reaches the caller — after that a replay would
+        duplicate delivered tokens, so a mid-stream cell loss fails
+        the stream explicitly (the router's own stream contract)."""
+        self._count("routed")
+        key = self._route_key(request)
+        call_timeout = self._timeout if timeout is None else timeout
+        deadline = self._clock() + self._window
+
+        def gen():
+            attempt = 0
+            delivered = 0
+            last_exc = None
+            while True:
+                for addr, stub, breaker in self._targets(key):
+                    now = self._clock()
+                    if not breaker.acquire(now):
+                        continue
+                    if attempt or last_exc is not None:
+                        self._count("rerouted")
+                    try:
+                        stream = stub.router_generate_stream(
+                            request, timeout=call_timeout,
+                        )
+                        for chunk in stream:
+                            delivered += len(chunk.tokens)
+                            yield chunk
+                        breaker.record_success()
+                        self._count("completed")
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        last_exc = e
+                        if delivered:
+                            breaker.record_failure(self._clock())
+                            raise RouterError(
+                                "UNAVAILABLE",
+                                "cell %s lost mid-stream after %d "
+                                "delivered tokens (%s)"
+                                % (addr, delivered, _code_name(e)),
+                            )
+                        if is_backpressure_rpc_error(e):
+                            breaker.record_success()
+                            self._count("shed")
+                            raise RouterError(_code_name(e), str(e))
+                        if is_transient_rpc_error(e):
+                            breaker.record_failure(self._clock())
+                            self._count("cell_failures")
+                            continue
+                        breaker.release_probe()
+                        raise RouterError(_code_name(e), str(e))
+                if self._clock() >= deadline:
+                    raise RouterError(
+                        _code_name(last_exc)
+                        if last_exc is not None else "UNAVAILABLE",
+                        "no router cell reachable inside the %.1fs "
+                        "reroute window: %r"
+                        % (self._window, last_exc),
+                    )
+                self._sleep(min(self._policy.backoff(attempt),
+                                max(0.0, deadline - self._clock())))
+                attempt += 1
+
+        return gen()
+
+    def status(self, request=None, timeout=5.0):
+        """router_status from the first answering cell (ring order by
+        a fixed key, so repeated calls prefer the same cell)."""
+        from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+        request = request or pb.RouterStatusRequest()
+        last_exc = None
+        for _addr, stub, breaker in self._targets("status"):
+            if not breaker.acquire(self._clock()):
+                continue
+            try:
+                resp = stub.router_status(request, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 - try next cell
+                last_exc = e
+                if is_transient_rpc_error(e):
+                    breaker.record_failure(self._clock())
+                else:
+                    breaker.release_probe()
+                continue
+            breaker.record_success()
+            return resp
+        raise RouterError(
+            _code_name(last_exc) if last_exc is not None
+            else "UNAVAILABLE",
+            "no router cell answered status: %r" % (last_exc,),
+        )

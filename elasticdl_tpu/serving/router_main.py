@@ -25,12 +25,31 @@ re-adopts the live fleet instead of orphaning or double-spawning it:
             --model_def transformer_lm.transformer_lm.custom_model \\
             --port 0 --num_slots 4"
 
+With --cells N (> 1) the process becomes a CELL SUPERVISOR instead of
+a router: it spawns N router cells (serving/router_cell.py) on ports
+--port .. --port+N-1, all sharing one replica registry through the
+write-ahead journal in --cell_journal_dir, and restarts a cell that
+dies. Each cell is this same entrypoint with an explicit --cell_id,
+so a cell can equally be launched by hand (or by a drill) without the
+supervisor:
+
+    python -m elasticdl_tpu.serving.router_main --cells 2 \\
+        --replica localhost:50051 --replica localhost:50052 \\
+        --port 50050 --cell_journal_dir /var/lib/edl/cells
+
+Clients reach the tier through the CellFront (consistent-hash by
+prefix fingerprint, ring-successor reroute on cell death) or any
+single cell directly — every cell serves the full Router surface.
+
 Fault injection at the router boundary uses the same EDL_FAULT_SPEC
 grammar as every other drill, under the router RPC names:
 EDL_FAULT_SPEC='router_generate:error:2' rejects two routed calls
 without touching any replica; the supervisor's process boundary
 listens on the supervisor_spawn / supervisor_ready / supervisor_adopt
-hooks (spawn-fail, slow-ready, adopt-drop).
+hooks (spawn-fail, slow-ready, adopt-drop); the cell tier listens on
+cell_spawn (supervisor launch path) and cell_kill (each cell's
+heartbeat tick — `cell_kill:kill:1:skip=4` SIGKILLs a live cell, the
+router-kill chaos phase).
 """
 
 import argparse
@@ -84,6 +103,33 @@ def parse_router_args(args=None):
                         default=30.0)
     parser.add_argument("--slo_slow_window_secs", type=float,
                         default=120.0)
+    # ---- prefix-affine dispatch (serving/prefix_affinity.py) ----
+    parser.add_argument("--affinity", type=int, default=1,
+                        help="1 = prefix-affine dispatch (decays to "
+                             "least-loaded), 0 = prefix-blind")
+    parser.add_argument("--affinity_block_tokens", type=int,
+                        default=16,
+                        help="KV block size the fingerprint chains "
+                             "over (match the replicas' "
+                             "--kv_block_size)")
+    parser.add_argument("--affinity_ttl_secs", type=float,
+                        default=60.0)
+    parser.add_argument("--affinity_load_margin", type=float,
+                        default=2.0,
+                        help="max load-score excess over the least-"
+                             "loaded candidate an affine target may "
+                             "carry before affinity decays")
+    # ---- multi-cell tier (serving/router_cell.py) ----
+    parser.add_argument("--cells", type=int, default=1,
+                        help="> 1: supervise N router cells on ports "
+                             "--port..--port+N-1 sharing "
+                             "--cell_journal_dir")
+    parser.add_argument("--cell_id", type=int, default=-1,
+                        help="this process's cell id (assigned by the "
+                             "cell supervisor; -1 = standalone)")
+    parser.add_argument("--cell_journal_dir", default="",
+                        help="shared registry WAL dir; a (re)started "
+                             "cell replays the fleet view from it")
     # ---- elastic fleet (serving/autoscaler.py) ----
     parser.add_argument("--autoscale", action="store_true",
                         help="own the replica fleet: spawn/replace/"
@@ -106,9 +152,11 @@ def parse_router_args(args=None):
                         default=5.0)
     parser.add_argument("--max_restarts", type=int, default=3)
     parsed = parser.parse_args(args)
-    if not parsed.replica and not parsed.autoscale:
-        parser.error("at least one --replica is required "
-                     "(or pass --autoscale)")
+    if (not parsed.replica and not parsed.autoscale
+            and not parsed.cell_journal_dir):
+        parser.error("at least one --replica is required (or pass "
+                     "--autoscale, or --cell_journal_dir to replay "
+                     "the fleet from a sibling cell's journal)")
     if parsed.autoscale and not parsed.replica_args:
         parser.error("--autoscale needs --replica_args to know how to "
                      "launch replicas")
@@ -116,29 +164,38 @@ def parse_router_args(args=None):
 
 
 def build_router(args):
-    return Router(
-        args.replica,
-        RouterConfig(
-            poll_secs=args.poll_secs,
-            poll_timeout_secs=args.poll_timeout_secs,
-            lease_secs=args.lease_secs,
-            breaker_threshold=args.breaker_threshold,
-            breaker_cooldown_secs=args.breaker_cooldown_secs,
-            hedge_delay_secs=args.hedge_delay_ms / 1000.0,
-            dispatch_timeout_secs=args.dispatch_timeout_secs,
-            redispatch_window_secs=args.redispatch_window_secs,
-            port=args.port,
-            telemetry_dir=args.tensorboard_log_dir,
-            metrics_port=(None if args.metrics_port < 0
-                          else args.metrics_port),
-            slo_ttft_p99_ms=args.slo_ttft_p99_ms,
-            slo_e2e_p99_ms=args.slo_e2e_p99_ms,
-            slo_latency_goal=args.slo_latency_goal,
-            slo_goodput_goal=args.slo_goodput_goal,
-            slo_fast_window_secs=args.slo_fast_window_secs,
-            slo_slow_window_secs=args.slo_slow_window_secs,
-        ),
+    config = RouterConfig(
+        poll_secs=args.poll_secs,
+        poll_timeout_secs=args.poll_timeout_secs,
+        lease_secs=args.lease_secs,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_secs=args.breaker_cooldown_secs,
+        hedge_delay_secs=args.hedge_delay_ms / 1000.0,
+        dispatch_timeout_secs=args.dispatch_timeout_secs,
+        redispatch_window_secs=args.redispatch_window_secs,
+        port=args.port,
+        telemetry_dir=args.tensorboard_log_dir,
+        metrics_port=(None if args.metrics_port < 0
+                      else args.metrics_port),
+        slo_ttft_p99_ms=args.slo_ttft_p99_ms,
+        slo_e2e_p99_ms=args.slo_e2e_p99_ms,
+        slo_latency_goal=args.slo_latency_goal,
+        slo_goodput_goal=args.slo_goodput_goal,
+        slo_fast_window_secs=args.slo_fast_window_secs,
+        slo_slow_window_secs=args.slo_slow_window_secs,
+        affinity=bool(args.affinity),
+        affinity_block_tokens=args.affinity_block_tokens,
+        affinity_ttl_secs=args.affinity_ttl_secs,
+        affinity_load_margin=args.affinity_load_margin,
+        cell_id=max(0, args.cell_id),
+        cells=max(1, args.cells),
     )
+    if args.cell_journal_dir:
+        from elasticdl_tpu.serving.router_cell import RouterCell
+
+        return RouterCell(args.replica, config,
+                          journal_dir=args.cell_journal_dir)
+    return Router(args.replica, config)
 
 
 def build_supervisor(args, router):
@@ -173,8 +230,176 @@ def build_supervisor(args, router):
     return supervisor
 
 
+def _cell_child_argv(args, cell_id):
+    """The child cell's command line: this very entrypoint with an
+    explicit --cell_id (so the child runs as ONE cell, never recurses
+    into the supervisor branch), its own port, and the shared journal
+    dir. Flags the tier shares pass through verbatim."""
+    argv = [
+        sys.executable, "-m", "elasticdl_tpu.serving.router_main",
+        "--cell_id", str(cell_id),
+        "--cells", str(args.cells),
+        "--port", str(args.port + cell_id),
+        "--cell_journal_dir", args.cell_journal_dir,
+        "--poll_secs", str(args.poll_secs),
+        "--poll_timeout_secs", str(args.poll_timeout_secs),
+        "--lease_secs", str(args.lease_secs),
+        "--breaker_threshold", str(args.breaker_threshold),
+        "--breaker_cooldown_secs", str(args.breaker_cooldown_secs),
+        "--dispatch_timeout_secs", str(args.dispatch_timeout_secs),
+        "--redispatch_window_secs", str(args.redispatch_window_secs),
+        "--affinity", str(args.affinity),
+        "--affinity_block_tokens", str(args.affinity_block_tokens),
+        "--affinity_ttl_secs", str(args.affinity_ttl_secs),
+        "--affinity_load_margin", str(args.affinity_load_margin),
+    ]
+    for addr in args.replica:
+        argv += ["--replica", addr]
+    return argv
+
+
+class CellRoster(object):
+    """The cell supervisor's process roster, under the same resource
+    discipline as the replica supervisor's seats (edl-lint EDL501):
+    every spawn_cell() MUST settle in adopt() (the cell joins the
+    roster) or retire() (terminate + wait) on every path — an
+    unadopted cell is an orphan router no journal remembers, and a
+    retired-but-unwaited one is a zombie pinned until the supervisor
+    exits. Child stdout/stderr go to per-cell log FILES (not pipes):
+    the cells outlive any supervisor wedge and their ready lines stay
+    greppable post-mortem."""
+
+    def __init__(self, args, log_dir=None):
+        self._args = args
+        self._log_dir = log_dir or os.path.join(
+            args.cell_journal_dir, "logs"
+        )
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._cells = {}  # cell_id -> subprocess.Popen
+        self.restarts = {}  # cell_id -> count
+
+    def spawn_cell(self, cell_id):
+        import subprocess
+
+        log_path = os.path.join(self._log_dir,
+                                "cell_%d.log" % cell_id)
+        log = open(log_path, "a")
+        try:
+            proc = subprocess.Popen(
+                _cell_child_argv(self._args, cell_id),
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+        finally:
+            # the child owns the descriptor now (or the spawn failed);
+            # either way the parent's handle must not leak
+            log.close()
+        proc.cell_id = cell_id
+        return proc
+
+    def adopt(self, proc):
+        self._cells[proc.cell_id] = proc
+        logger.info("cell %d adopted (pid %d, port %d)",
+                    proc.cell_id, proc.pid,
+                    self._args.port + proc.cell_id)
+
+    def retire(self, proc):
+        self._cells.pop(proc.cell_id, None)
+        if proc.poll() is None:
+            proc.terminate()
+        try:
+            proc.wait(timeout=10.0)
+        except Exception:  # noqa: BLE001 - escalate to SIGKILL
+            proc.kill()
+            proc.wait()
+
+    def live(self):
+        return dict(self._cells)
+
+    def reap_dead(self):
+        """Cells that exited on their own (already waited — no zombie
+        survives this call). Returns their ids."""
+        dead = [cid for cid, p in self._cells.items()
+                if p.poll() is not None]
+        for cid in dead:
+            self._cells.pop(cid)
+        return dead
+
+
+#: a cell that dies more than this many times stays down — the same
+#: give-up bar the replica supervisor's restart circuit enforces
+MAX_CELL_RESTARTS = 3
+
+
+def launch_cells(args):
+    """Supervisor mode (--cells N): spawn one router cell per id on
+    ports --port..--port+N-1, restart a dead cell (bounded), SIGTERM
+    the roster on shutdown. The registry journal — not this process —
+    carries the fleet view, so a supervisor crash orphans nothing a
+    restarted cell can't replay."""
+    from elasticdl_tpu.common.fault_injection import FaultInjector
+
+    if not args.cell_journal_dir:
+        args.cell_journal_dir = os.path.join(
+            ".", "edl_cells_%d" % os.getpid()
+        )
+    os.makedirs(args.cell_journal_dir, exist_ok=True)
+    injector = FaultInjector.from_env()
+    roster = CellRoster(args)
+
+    def spawn_adopted(cell_id):
+        if injector is not None:
+            # cell_spawn hook: a `cell_spawn:drop` rule fails this
+            # launch the way a bad node would
+            injector.intercept("cell_spawn", context=None,
+                               when="before")
+        proc = roster.spawn_cell(cell_id)
+        try:
+            roster.adopt(proc)
+        except Exception:
+            roster.retire(proc)
+            raise
+        return proc
+
+    for i in range(args.cells):
+        spawn_adopted(i)
+        print("CELL_STARTED cell=%d port=%d" % (i, args.port + i),
+              flush=True)
+    print("ROUTER_CELLS_READY count=%d" % args.cells, flush=True)
+    done = threading.Event()
+
+    def _graceful(_signum, _frame):
+        logger.info("signal received: stopping %d router cells",
+                    len(roster.live()))
+        done.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    while not done.wait(0.5):
+        for cell_id in roster.reap_dead():
+            n = roster.restarts.get(cell_id, 0)
+            if n >= MAX_CELL_RESTARTS:
+                logger.error(
+                    "cell %d exceeded %d restarts; leaving it down "
+                    "(surviving cells keep serving)",
+                    cell_id, MAX_CELL_RESTARTS,
+                )
+                continue
+            roster.restarts[cell_id] = n + 1
+            logger.warning("cell %d died; restarting (%d/%d)",
+                           cell_id, n + 1, MAX_CELL_RESTARTS)
+            try:
+                spawn_adopted(cell_id)
+            except Exception as e:  # noqa: BLE001 - retried next tick
+                logger.error("cell %d respawn failed: %r", cell_id, e)
+    for proc in roster.live().values():
+        roster.retire(proc)
+    return 0
+
+
 def main(argv=None):
     args = parse_router_args(argv)
+    if args.cells > 1 and args.cell_id < 0:
+        return launch_cells(args)
     # SIGUSR2 -> all-thread stack dump: a live wedged router can
     # always be interrogated without killing it
     from elasticdl_tpu.observability.runtime_health import (
@@ -201,6 +426,12 @@ def main(argv=None):
     signal.signal(signal.SIGINT, _graceful)
     if router.metrics is not None:
         print("METRICS_READY port=%d" % router.metrics.port,
+              flush=True)
+    if args.cell_id >= 0:
+        # its own line: launch_ready parses `port=` as the LAST token
+        # of the READY line, so cell annotations must not ride it
+        print("ROUTER_CELL cell=%d cells=%d" % (args.cell_id,
+                                                args.cells),
               flush=True)
     print("ROUTER_READY port=%d" % router.port, flush=True)
     done.wait()

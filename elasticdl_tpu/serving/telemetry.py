@@ -521,7 +521,15 @@ class RouterTelemetry(object):
         router/hedges_total       = re-sent after a replica failure,
         router/hedge_wins_total   shed = RESOURCE_EXHAUSTED with no
         router/shed_total         healthy replica, breaker_trips =
-        router/breaker_trips_total  closed->open transitions)
+        router/breaker_trips_total  closed->open transitions,
+        router/affinity_hits_total  affinity_hits/misses = requests
+        router/affinity_misses_total  with a prefix fingerprint that
+                                  did / did not land on their learned
+                                  replica — the decay-ladder telemetry)
+
+    The cell gauges (`router/cell_id`, `router/cells`) identify this
+    process inside a multi-cell router tier (serving/router_cell.py);
+    a single-cell router reports cell_id=0, cells=1.
 
     Counters back the router_status RPC via snapshot() — like the
     replica telemetry, the RPC must work with the writer disabled.
@@ -541,8 +549,9 @@ class RouterTelemetry(object):
     (observability/slo.py) reads exactly this ring."""
 
     COUNTERS = ("routed", "completed", "redispatched", "hedges",
-                "hedge_wins", "shed", "breaker_trips", "errors")
-    GAUGES = ("healthy_replicas", "replicas")
+                "hedge_wins", "shed", "breaker_trips", "errors",
+                "affinity_hits", "affinity_misses")
+    GAUGES = ("healthy_replicas", "replicas", "cell_id", "cells")
 
     def __init__(self, log_dir=None, flush_every=20, clock=time.monotonic,
                  ring_secs=2.0, ring_windows=300):

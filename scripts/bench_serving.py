@@ -48,7 +48,10 @@ sharing is FOR: every prompt = one of --prefix_pool common prefixes of
 --prefix_len tokens + a random --suffix_len suffix. --draft_k k seats
 a draft model (--draft_params; default = the target's params, i.e.
 self-draft — the acceptance ceiling) and verifies k drafted tokens
-per tick.
+per tick. --shared_prefix also runs the ROUTER-tier prefix-affinity
+A/B ("affinity_ab"): the same shape through a real two-replica fleet
+behind the Router, fingerprint-affine dispatch ON vs OFF — fleet
+re-paid prefix prefill tokens and warm TTFT percentiles.
 
 Defaults are CPU-smoke sized; on hardware raise --requests/--rate and
 the model dims.
@@ -819,6 +822,216 @@ def run_host_evict_ab(args):
     }
 
 
+def run_affinity_ab(args):
+    """The prefix-affinity A/B at the ROUTER tier: the same
+    shared-prefix Poisson plan dispatched through a real two-replica
+    in-process fleet behind the real Router, affinity ON vs OFF.
+
+    The off-leg's pathology is structural, not statistical: with
+    load scores tied, the least-loaded order tie-breaks on free
+    blocks, and the replica that just cached a family's prefix chain
+    has FEWER free blocks — so consecutive hits of one family
+    ping-pong between replicas and each bounce re-pays the family's
+    prefill cold. The on-leg pins each family to the replica already
+    holding its chain (the fingerprint ladder), so the fleet pays
+    each family's prefill once. The headline: fleet re-paid prefix
+    prefill tokens (offered minus hits minus the one unavoidable
+    first touch per family) and the warm-pass TTFT percentiles."""
+    import numpy as np
+
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import ServingStub, build_channel
+    from elasticdl_tpu.serving import GenerationServer, ServingConfig
+    from elasticdl_tpu.serving.router import (
+        Router,
+        RouterConfig,
+        RouterError,
+    )
+
+    trainer, state, _ = build_rig(args, model_params=PRESS_MODEL_PARAMS)
+    vocab = int(trainer.model.vocab_size)
+    bs = PRESS_BLOCK_SIZE
+    o_lo, o_hi = _span(args.out_len)
+    s_lo, s_hi = _span(args.suffix_len)
+    prefix_len = (PRESS_PREFIX_LEN // bs) * bs  # full blocks only
+    families = 4  # distinct system prompts
+    passes = 6    # times each family comes back around
+    # arrivals BELOW fleet capacity: with slots idle, load scores sit
+    # near zero and the affinity_load_margin can hold — the A/B
+    # measures placement, not saturation (under which the ladder's
+    # load rung decays affinity to least-loaded, by design)
+    rate = 1.0
+    # roomy per-replica pools: every family's chain fits on BOTH
+    # replicas plus full seats — zero eviction pressure, so the A/B
+    # isolates WHERE a family lands, not whether its chain survives
+    seat_blocks = -(-(prefix_len + s_hi + o_hi - 1) // bs)
+    # +1 family of room for the full-shape warmup chain each replica
+    # seats outside the measurement window
+    num_blocks = ((families + 1) * (prefix_len // bs)
+                  + 2 * seat_blocks + 8)
+    rs = np.random.RandomState(args.seed + 29)
+    pool = [rs.randint(0, vocab, size=prefix_len)
+            for _ in range(families)]
+    plan = []
+    for i in range(passes * families):
+        suffix = rs.randint(0, vocab,
+                            size=rs.randint(s_lo, s_hi + 1))
+        plan.append({
+            "prompt": np.concatenate([pool[i % families], suffix]),
+            "new": int(rs.randint(o_lo, o_hi + 1)),
+            "gap": float(rs.exponential(1.0 / rate)),
+            "seed": int(i),
+        })
+
+    def run_leg(affinity_on):
+        servers, router = [], None
+        try:
+            for _ in range(2):
+                srv = GenerationServer(
+                    trainer, state,
+                    ServingConfig(
+                        num_slots=2,
+                        queue_capacity=args.queue_capacity,
+                        kv_paged=True, kv_block_size=bs,
+                        kv_num_blocks=num_blocks, kv_shared=True,
+                    ),
+                ).start()
+                servers.append(srv)
+            warm_prompt = [0] * prefix_len + [1, 2]
+            for srv in servers:
+                # pay each replica's jit compiles outside the window
+                # with a FULL-SHAPE request (block-aligned prefix +
+                # suffix + decode): a cold family inside the window
+                # must cost one prefill, never a multi-second compile
+                # stall that blows the load margin and cascades
+                ServingStub(
+                    build_channel("localhost:%d" % srv.port)
+                ).generate(
+                    pb.GenerateRequest(prompt=warm_prompt,
+                                       max_new_tokens=4),
+                    timeout=600,
+                )
+                srv.mark_steady()
+            router = Router(
+                ["localhost:%d" % s.port for s in servers],
+                config=RouterConfig(
+                    poll_secs=0.2, lease_secs=2.0,
+                    affinity=affinity_on,
+                    affinity_block_tokens=bs,
+                    # a couple of cold prefills stacked on the
+                    # affine target (queue+slots+inflight) must not
+                    # decay the whole family off its warm replica:
+                    # the A/B's on-leg expresses "placement first",
+                    # and the off-leg ignores the knob entirely
+                    affinity_load_margin=8.0,
+                ),
+            )
+            router.start(grpc_server=False)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if router.status_response().healthy >= len(servers):
+                    break
+                time.sleep(0.1)
+
+            rows = []
+            lock = threading.Lock()
+
+            def one(spec):
+                t0 = time.monotonic()
+                row = {"status": "OK", "ttft_ms": None, "spec": spec}
+                try:
+                    for chunk in router.dispatch_stream(
+                        pb.GenerateRequest(
+                            prompt=[int(t) for t in spec["prompt"]],
+                            max_new_tokens=spec["new"],
+                            temperature=args.temperature,
+                            seed=spec["seed"],
+                        )
+                    ):
+                        if row["ttft_ms"] is None and chunk.tokens:
+                            row["ttft_ms"] = (
+                                (time.monotonic() - t0) * 1000.0
+                            )
+                except RouterError as e:
+                    row["status"] = e.code
+                with lock:
+                    rows.append(row)
+
+            threads = []
+            for spec in plan:
+                time.sleep(spec["gap"])
+                t = threading.Thread(target=one, args=(spec,))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=600)
+
+            hits = sum(
+                s.engine.kv_stats()["prefix_hit_tokens"]
+                for s in servers
+            )
+            snap = router.telemetry.snapshot()
+            warm = [
+                r["ttft_ms"] for r in rows
+                if r["status"] == "OK" and r["ttft_ms"] is not None
+                and r["spec"]["seed"] >= families  # pass 2 onward
+            ]
+            offered = len(plan) * prefix_len
+            cold = families * prefix_len  # first touch: unavoidable
+            return {
+                "completed": sum(
+                    1 for r in rows if r["status"] == "OK"
+                ),
+                "prefix_hit_tokens": hits,
+                "repaid_prefix_tokens": max(
+                    0, offered - hits - cold
+                ),
+                "warm_ttft_ms": percentiles(warm, (50, 90, 99)) or {},
+                "affinity_hits": snap["affinity_hits"],
+                "affinity_misses": snap["affinity_misses"],
+            }
+        finally:
+            if router is not None:
+                router.stop()
+            for srv in servers:
+                srv.stop()
+
+    on, off = run_leg(True), run_leg(False)
+    return {
+        "model_params": PRESS_MODEL_PARAMS,
+        "block_size": bs,
+        "replicas": 2,
+        "prefix_families": families,
+        "passes": passes,
+        "prefix_tokens_offered": len(plan) * prefix_len,
+        "cold_prefix_tokens": families * prefix_len,
+        # the headline: prefill the FLEET re-pays because requests
+        # landed away from the replica already holding their chain
+        "repaid_prefix_tokens": [on["repaid_prefix_tokens"],
+                                 off["repaid_prefix_tokens"]],
+        "repaid_drop": (
+            off["repaid_prefix_tokens"] - on["repaid_prefix_tokens"]
+        ),
+        "repaid_improved": (
+            on["repaid_prefix_tokens"] < off["repaid_prefix_tokens"]
+        ),
+        "prefix_hit_tokens": [on["prefix_hit_tokens"],
+                              off["prefix_hit_tokens"]],
+        "affinity_hit_rate": round(
+            on["affinity_hits"]
+            / max(1, on["affinity_hits"] + on["affinity_misses"]), 3,
+        ),
+        "warm_ttft_ms": [on["warm_ttft_ms"], off["warm_ttft_ms"]],
+        "warm_ttft_p99_improved": (
+            (on["warm_ttft_ms"].get("p99") or 0.0)
+            < (off["warm_ttft_ms"].get("p99") or 0.0)
+        ),
+        "completed": [on["completed"], off["completed"]],
+        "affinity_on": on,
+        "affinity_off": off,
+    }
+
+
 #: the enabled metrics+profiler plane may cost at most this fraction
 #: of the disabled plane's tokens/sec (the PR 6 tracing bound, kept)
 OVERHEAD_BOUND = 0.05
@@ -1037,6 +1250,11 @@ def run_bench(args):
         # rig (int8 arenas when --kv_cache_dtype says so — the
         # serve-smoke shape, where one host GB buys ~3x the chains)
         record["host_vs_evict"] = run_host_evict_ab(args)
+    if args.shared_prefix:
+        # the router-tier prefix-affinity A/B: the same shared-prefix
+        # shape one tier up — does fingerprint-affine dispatch stop
+        # the fleet re-paying prefills it already holds?
+        record["affinity_ab"] = run_affinity_ab(args)
     base_good = record["goodput_rps"] or 1e-9
     base_tok = record["tokens_per_sec"] or 1e-9
     record["paged_vs_dense"] = {

@@ -261,6 +261,12 @@ SERVING_MESSAGES = {
         # of the 30 s lease heuristic ("" = pre-health replica)
         ("last_progress_age_ms", 21, T.TYPE_DOUBLE, _OPT),
         ("health_state", 22, T.TYPE_STRING, _OPT),
+        # prefix-cache occupancy, passed through from ServerStatus:
+        # cached = refcount-0 blocks parked reclaimable, shared =
+        # blocks referenced by >1 sequence — with the host tier and
+        # hit-rate above, the warm-capacity ladder affinity ranks by
+        ("kv_blocks_cached", 23, T.TYPE_INT32, _OPT),
+        ("kv_blocks_shared", 24, T.TYPE_INT32, _OPT),
     ],
     "RouterStatusResponse": [
         ("replicas", 1, T.TYPE_INT32, _OPT),
@@ -303,6 +309,20 @@ SERVING_MESSAGES = {
         # objective; empty when the router has no SLO engine)
         ("slo", 27, T.TYPE_MESSAGE, _REP,
          ".elasticdl_tpu.SloObjective"),
+        # multi-cell router tier (serving/router_cell.py): which cell
+        # answered this status and how many the tier runs; the
+        # affinity counters are the prefix-affine dispatch ladder's
+        # verdicts; journal_* report the shared-registry write-ahead
+        # journal (events appended by this cell / replayed into it at
+        # start), cell_restarts the journal dir's restart marker —
+        # the crash-recovery odometer
+        ("cell_id", 28, T.TYPE_INT32, _OPT),
+        ("cells", 29, T.TYPE_INT32, _OPT),
+        ("affinity_hits", 30, T.TYPE_INT64, _OPT),
+        ("affinity_misses", 31, T.TYPE_INT64, _OPT),
+        ("journal_events", 32, T.TYPE_INT64, _OPT),
+        ("journal_replayed", 33, T.TYPE_INT64, _OPT),
+        ("cell_restarts", 34, T.TYPE_INT64, _OPT),
     ],
 }
 

@@ -40,6 +40,14 @@ ROUTER_CHAOS_TRACE.json (repo root) — open it at ui.perfetto.dev.
 Runs TWICE: dense KV pool and block-paged pool (EDL_KV_PAGED), like
 the single-replica kill drill.
 
+A third ROUTER-KILL phase then moves the chaos one tier up: three
+replicas behind TWO router cells sharing a registry journal
+(--cells / --cell_journal_dir), a CellFront dispatching shared-prefix
+load pinned by fingerprint to one owning cell, SIGKILL of that cell
+mid-load — every accepted request must reroute through the surviving
+cell with zero loss, and the killed cell must restart replica-flag-
+free and rebuild its whole fleet view from journal replay.
+
 Usage: python scripts/run_router_chaos_drill.py
 Exit 0 = the invariant holds in both modes."""
 
@@ -85,6 +93,26 @@ def start_router(replica_ports, extra_env=None):
         "--port", "0", "--poll_secs", "0.25", "--lease_secs", "1.5",
         "--breaker_cooldown_secs", "1.0",
         "--redispatch_window_secs", "60",
+    ]
+    for p in replica_ports:
+        cmd += ["--replica", "localhost:%d" % p]
+    return launch_ready(cmd, extra_env=extra_env,
+                        ready_marker="ROUTER_READY")
+
+
+def start_router_cell(replica_ports, cell_id, cells, journal_dir,
+                      extra_env=None):
+    """One router CELL: a full router process that shares its replica
+    registry with its siblings through the write-ahead journal in
+    `journal_dir`. Launched with an explicit --cell_id (no supervisor)
+    so the drill controls each cell's lifetime directly."""
+    cmd = [
+        sys.executable, "-m", "elasticdl_tpu.serving.router_main",
+        "--port", "0", "--poll_secs", "0.25", "--lease_secs", "1.5",
+        "--breaker_cooldown_secs", "1.0",
+        "--redispatch_window_secs", "60",
+        "--cell_id", str(cell_id), "--cells", str(cells),
+        "--cell_journal_dir", journal_dir,
     ]
     for p in replica_ports:
         cmd += ["--replica", "localhost:%d" % p]
@@ -403,6 +431,228 @@ def run_mode(mode, mode_env, state, tmp_root):
     print("[chaos:%s] PASSED" % mode)
 
 
+def run_cell_failover(tmp_root):
+    """Router-kill phase: the router tier itself is the victim.
+
+    Three replicas behind TWO router cells sharing one registry
+    journal. Cell 1 starts with NO --replica flags — its whole fleet
+    view is journal replay of cell 0's adopt events. A CellFront in
+    this process dispatches a Poisson stream of shared-prefix unary
+    generates (one prefix family -> one fingerprint -> one owning
+    cell), the drill SIGKILLs the OWNING cell mid-load, and every
+    accepted request must re-dispatch through the surviving cell with
+    zero loss — then the killed cell restarts replica-flag-free and
+    must rebuild the full fleet from the journal."""
+    import numpy as np
+
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import RouterStub, build_channel
+    from elasticdl_tpu.serving.router import RouterError
+    from elasticdl_tpu.serving.router_cell import CellFront
+
+    mode = "cells"
+    env = {"EDL_KV_PAGED": "1"}
+    journal_dir = os.path.join(tmp_root, "cell_journal")
+    os.makedirs(journal_dir, exist_ok=True)
+    procs = []  # every subprocess, for the finally-kill backstop
+    front = None
+    try:
+        print("[chaos:%s] starting %d replicas + 2 router cells"
+              % (mode, NUM_REPLICAS))
+        replica_ports = []
+        for _ in range(NUM_REPLICAS):
+            proc, port = start_replica(extra_env=env)
+            procs.append(proc)
+            replica_ports.append(port)
+        for port in replica_ports:
+            warm(port)
+        # cell 0 seeds the journal with the fleet; cell 1 starts BLIND
+        # (no --replica flags) and must learn every replica from replay
+        cell0, port0 = start_router_cell(
+            replica_ports, 0, 2, journal_dir, extra_env=env
+        )
+        procs.append(cell0)
+        cell1, port1 = start_router_cell(
+            [], 1, 2, journal_dir, extra_env=env
+        )
+        procs.append(cell1)
+        stub1 = RouterStub(build_channel("localhost:%d" % port1))
+        deadline = time.time() + 30
+        st = None
+        while time.time() < deadline:
+            st = stub1.router_status(pb.RouterStatusRequest(),
+                                     timeout=10)
+            if st.replicas >= NUM_REPLICAS and st.healthy >= NUM_REPLICAS:
+                break
+            time.sleep(0.3)
+        assert st is not None and st.replicas >= NUM_REPLICAS, (
+            "cell 1 never learned the fleet from the journal: %s" % st
+        )
+        assert st.journal_replayed >= NUM_REPLICAS, (
+            "cell 1 reports no adopt replay (journal_replayed=%d)"
+            % st.journal_replayed
+        )
+        print("[chaos:%s] cell 1 learned %d replicas purely from "
+              "journal replay (%d events)"
+              % (mode, st.replicas, st.journal_replayed))
+
+        front = CellFront(
+            ["localhost:%d" % port0, "localhost:%d" % port1],
+            reroute_window_secs=30.0, timeout_secs=CLIENT_TIMEOUT,
+        )
+        # one shared-prefix family: every request carries the same
+        # full leading block, so every request fingerprints to the
+        # same key and the ring pins the whole stream to ONE owning
+        # cell — the one the drill kills.
+        prefix = [3] * 16
+
+        def prompt_for(i):
+            return prefix + [1 + i % 5, 2]
+
+        owner = front._targets(
+            front._route_key(pb.GenerateRequest(prompt=prompt_for(0)))
+        )[0][0]
+        victim, victim_port = (
+            (cell0, port0) if owner.endswith(":%d" % port0)
+            else (cell1, port1)
+        )
+        survivor_port = port1 if victim is cell0 else port0
+        print("[chaos:%s] prefix family owner is cell @ %s"
+              % (mode, owner))
+
+        rs = np.random.RandomState(7)
+        outcomes = {}
+        lock = threading.Lock()
+
+        def call(i):
+            try:
+                # prompt is 18 tokens of the drill model's seq_len=32
+                # budget: cap new tokens so prompt+new always fits
+                front.generate(
+                    pb.GenerateRequest(
+                        prompt=prompt_for(i),
+                        max_new_tokens=2 + i % 12,
+                        seed=i,
+                    ),
+                    timeout=CLIENT_TIMEOUT,
+                )
+                code = "OK"
+            except RouterError as e:
+                code = e.code
+            with lock:
+                outcomes[i] = code
+
+        threads = []
+        t0 = time.monotonic()
+
+        def launch(i):
+            time.sleep(float(rs.exponential(1.0 / RATE_RPS)))
+            t = threading.Thread(target=call, args=(i,))
+            t.start()
+            threads.append(t)
+
+        i = 0
+        for _ in range(WARMUP_REQS):
+            launch(i)
+            i += 1
+        print("[chaos:%s] SIGKILL owning cell (port %d) mid-load"
+              % (mode, victim_port))
+        victim.kill()
+        while i < REQUESTS:
+            launch(i)
+            i += 1
+
+        for t in threads:
+            t.join(timeout=CLIENT_TIMEOUT + 30)
+        elapsed = time.monotonic() - t0
+        hung = [t for t in threads if t.is_alive()]
+        if hung:
+            raise AssertionError(
+                "[chaos:%s] %d client threads HUNG" % (mode, len(hung))
+            )
+        codes = sorted(outcomes.values())
+        ok = codes.count("OK")
+        print("[chaos:%s] outcomes=%s elapsed=%.1fs front=%s"
+              % (mode, {c: codes.count(c) for c in set(codes)},
+                 elapsed, front.counters))
+
+        # THE invariant again, one tier up: a SIGKILL'd ROUTER CELL
+        # must not lose a single accepted request — the front reroutes
+        # to the surviving cell, which shares the same replica fleet.
+        allowed = {"OK", "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+        leaked = set(codes) - allowed
+        assert not leaked, (
+            "accepted requests LOST across the cell kill: %s" % leaked
+        )
+        assert len(outcomes) == REQUESTS, (
+            "only %d/%d clients terminated" % (len(outcomes), REQUESTS)
+        )
+        assert ok >= REQUESTS // 2, (
+            "too few completions for a surviving cell: %d/%d OK"
+            % (ok, REQUESTS)
+        )
+        assert elapsed < CLIENT_TIMEOUT - 10, "clients rode the timeout"
+        assert front.counters["rerouted"] >= 1, (
+            "the cell kill never forced a reroute — the drill "
+            "exercised nothing"
+        )
+
+        # the survivor carried the rerouted tail
+        surv = RouterStub(
+            build_channel("localhost:%d" % survivor_port)
+        ).router_status(pb.RouterStatusRequest(), timeout=10)
+        assert surv.routed >= 1, "survivor cell never routed anything"
+
+        # failover epilogue: the killed cell restarts with NO replica
+        # flags and must rebuild its fleet view from the journal alone
+        print("[chaos:%s] restarting killed cell from the journal"
+              % mode)
+        cell_id = 0 if victim is cell0 else 1
+        reborn, reborn_port = start_router_cell(
+            [], cell_id, 2, journal_dir, extra_env=env
+        )
+        procs.append(reborn)
+        stub_r = RouterStub(build_channel("localhost:%d" % reborn_port))
+        deadline = time.time() + 30
+        rst = None
+        while time.time() < deadline:
+            rst = stub_r.router_status(pb.RouterStatusRequest(),
+                                       timeout=10)
+            if rst.replicas >= NUM_REPLICAS:
+                break
+            time.sleep(0.3)
+        assert rst is not None and rst.replicas >= NUM_REPLICAS, (
+            "reborn cell did not recover the fleet from the journal: "
+            "%s" % rst
+        )
+        assert rst.cell_restarts >= 1, (
+            "journal store never counted a cold start over existing "
+            "state (cell_restarts=%d)" % rst.cell_restarts
+        )
+        print("[chaos:%s] reborn cell recovered %d replicas from the "
+              "journal (restart #%d)"
+              % (mode, rst.replicas, rst.cell_restarts))
+
+        # graceful teardown: survivors drain and exit 0; the SIGKILL'd
+        # cell's nonzero rc proves the kill was real
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            if proc is victim:
+                continue
+            rc = proc.wait(timeout=60)
+            assert rc == 0, "graceful exit must return 0, got %s" % rc
+        assert victim.wait(timeout=10) != 0  # SIGKILL, by design
+        print("[chaos:%s] PASSED" % mode)
+    finally:
+        if front is not None:
+            front.close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+
 def main():
     import json
     import tempfile
@@ -416,15 +666,18 @@ def main():
             ("paged", {"EDL_KV_PAGED": "1"}),
         ):
             spans = run_mode(mode, env, state, tmp_root)
+        # router-kill phase: same invariant one tier up — SIGKILL a
+        # ROUTER CELL mid-load, zero accepted-request loss
+        run_cell_failover(tmp_root)
     # archive the last mode's merged trace as the CI artifact — one
     # real chaos run, loadable at ui.perfetto.dev / chrome://tracing
     out = os.path.join(REPO, "ROUTER_CHAOS_TRACE.json")
     with open(out, "w") as f:
         json.dump(chrome_trace(spans), f)
     print("[chaos] merged trace archived -> %s" % out)
-    print("[chaos] router chaos drill PASSED (dense + paged): zero "
-          "accepted-request loss under SIGKILL + hot reload, causal "
-          "trace story verified structurally")
+    print("[chaos] router chaos drill PASSED (dense + paged + cells): "
+          "zero accepted-request loss under replica SIGKILL, hot "
+          "reload, AND router-cell SIGKILL with journaled failover")
     return 0
 
 

@@ -1,0 +1,33 @@
+"""C18 negative fixture — the cell lifecycle pair settled on every
+path: spawn_cell ends in adopt on the happy path and retire on every
+failure branch (including the exception path), so EDL501 must stay
+silent here."""
+
+
+class CellScaler(object):
+    def __init__(self, roster):
+        self._roster = roster
+
+    def grow(self, roster, cell_id):
+        cell = roster.spawn_cell(cell_id)
+        if not self.ready(cell):
+            roster.retire(cell)
+            return None
+        roster.adopt(cell)
+        return cell
+
+    def grow_checked(self, roster, cell_id):
+        cell = roster.spawn_cell(cell_id)
+        try:
+            self.probe(cell)
+        except Exception:
+            roster.retire(cell)
+            raise
+        roster.adopt(cell)
+        return cell
+
+    def ready(self, cell):
+        return cell is not None
+
+    def probe(self, cell):
+        return bool(cell)

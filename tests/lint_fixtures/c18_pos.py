@@ -1,0 +1,35 @@
+"""C18 positive fixture — EDL501 leaks of the cell supervisor's
+router-cell lifecycle pair (serving/router_main.py CellRoster
+discipline, spawn_cell -> adopt | retire):
+
+1. a spawned cell that an early-return path neither adopts nor
+   retires — an orphan router process serving traffic no supervisor
+   restarts and no shutdown reaps;
+2. a spawned cell whose failed-adoption exception path never retires
+   it — the pid leaks past the raise.
+"""
+
+
+class CellScaler(object):
+    def __init__(self, roster):
+        self._roster = roster
+
+    def grow(self, roster, cell_id):
+        cell = roster.spawn_cell(cell_id)
+        if not self.ready(cell):
+            return None  # leak: the cell is never adopted or retired
+        roster.adopt(cell)
+        return cell
+
+    def grow_checked(self, roster, cell_id):
+        cell = roster.spawn_cell(cell_id)
+        if self.port_taken(cell):
+            raise RuntimeError("port collision")  # leak: no retire
+        roster.adopt(cell)
+        return cell
+
+    def ready(self, cell):
+        return cell is not None
+
+    def port_taken(self, cell):
+        return bool(cell)

@@ -279,6 +279,25 @@ def test_c13_negative_settled_spills_are_clean():
     assert lint_file("c13_neg.py") == []
 
 
+def test_c18_positive_flags_cell_lifecycle_leaks():
+    """The cell supervisor's router-cell pair (serving/router_main.py
+    CellRoster): a spawned cell never adopted nor retired (an orphan
+    router process), and a failed-adoption exception path that leaks
+    the pid past the raise."""
+    findings = lint_file("c18_pos.py")
+    assert rule_ids(findings) == ["EDL501"] * 2, findings
+    assert {f.detail for f in findings} == {"roster.spawn_cell"}
+    assert {f.scope for f in findings} == {
+        "CellScaler.grow", "CellScaler.grow_checked",
+    }
+
+
+def test_c18_negative_settled_cells_are_clean():
+    """Adopt on the happy path, retire on the not-ready branch and on
+    the exception path — every spawn settles, EDL501 stays silent."""
+    assert lint_file("c18_neg.py") == []
+
+
 # ------------------- C14: EDL105 recompile hazard (value-origin v3)
 
 
@@ -494,7 +513,7 @@ FAMILY_FIXTURES = {
     "EDL202": (("c9_pos.py",), "c9_neg.py"),
     "EDL401": (("c5_pos.py",), "c5_neg.py"),
     "EDL501": (("c8_pos.py", "c11_pos.py", "c12_pos.py",
-                "c13_pos.py"), "c8_neg.py"),
+                "c13_pos.py", "c18_pos.py"), "c8_neg.py"),
     "EDL601": (("c17_pos.py",), "c17_neg.py"),
     # EDL301 is repo-level; its trigger/clean pair is the tampered/
     # pristine pb2 in the proto tests below

@@ -1,0 +1,195 @@
+"""serving/prefix_affinity.py unit tests (tier-1: pure python).
+
+Locks the three primitives the prefix-affine router tier stands on:
+the content-addressed block-chain fingerprint (deterministic across
+processes, block-aligned, capped), the consistent-hash ring (stable
+ownership, BOUNDED reshuffle on membership change, deterministic
+failover walk), and the TTL'd affinity index (expiry, LRU capacity,
+address forgetting on replica retirement)."""
+
+import pytest
+
+from elasticdl_tpu.serving.prefix_affinity import (
+    AffinityIndex,
+    HashRing,
+    prefix_fingerprint,
+)
+
+# ------------------------------------------------------------ fingerprint
+
+
+def test_fingerprint_deterministic_across_calls():
+    prompt = list(range(40))
+    a = prefix_fingerprint(prompt, block_tokens=16)
+    b = prefix_fingerprint(list(prompt), block_tokens=16)
+    assert a is not None and a == b
+
+
+def test_fingerprint_none_below_one_full_block():
+    # no complete block -> nothing shareable -> no fingerprint
+    assert prefix_fingerprint([], block_tokens=16) is None
+    assert prefix_fingerprint([1] * 15, block_tokens=16) is None
+    assert prefix_fingerprint([1] * 16, block_tokens=16) is not None
+
+
+def test_fingerprint_ignores_partial_trailing_block():
+    # the suffix past the last FULL block must not perturb the key:
+    # that is what lets a family of prompts share one fingerprint
+    base = [7] * 32
+    assert (prefix_fingerprint(base + [9, 9, 9], block_tokens=16)
+            == prefix_fingerprint(base, block_tokens=16))
+
+
+def test_fingerprint_first_block_sensitivity():
+    # same-length prompts differing in ONE leading token must diverge
+    # (the chain key is content-addressed, not length-addressed)
+    a = prefix_fingerprint([1] + [0] * 31, block_tokens=16)
+    b = prefix_fingerprint([2] + [0] * 31, block_tokens=16)
+    assert a != b
+
+
+def test_fingerprint_is_chained_not_flat():
+    # block order matters: the second block's key is chained on the
+    # first, so swapping blocks changes the fingerprint
+    blk_a, blk_b = [1] * 16, [2] * 16
+    assert (prefix_fingerprint(blk_a + blk_b, block_tokens=16)
+            != prefix_fingerprint(blk_b + blk_a, block_tokens=16))
+
+
+def test_fingerprint_max_blocks_cap():
+    # beyond the cap, longer prefixes collapse onto one fingerprint —
+    # the router keys on the head of the chain, not the whole prompt
+    short = [3] * 32
+    long = [3] * 64
+    assert (prefix_fingerprint(short, block_tokens=16, max_blocks=2)
+            == prefix_fingerprint(long, block_tokens=16, max_blocks=2))
+    assert (prefix_fingerprint(short, block_tokens=16, max_blocks=4)
+            != prefix_fingerprint(long, block_tokens=16, max_blocks=4))
+
+
+def test_fingerprint_rejects_bad_block_tokens():
+    with pytest.raises(ValueError):
+        prefix_fingerprint([1, 2, 3], block_tokens=0)
+
+
+# -------------------------------------------------------------- hash ring
+
+
+def test_ring_empty_degenerate():
+    ring = HashRing()
+    assert ring.lookup("anything") is None
+    assert ring.successors("anything") == []
+    assert ring.nodes() == []
+
+
+def test_ring_single_node_owns_everything():
+    ring = HashRing(["only"])
+    for key in ("a", "b", "c", "zz-%d" % 7):
+        assert ring.lookup(key) == "only"
+        assert ring.successors(key) == ["only"]
+
+
+def test_ring_lookup_deterministic_across_instances():
+    # two independently-built rings (any insertion order) agree on
+    # every key: ownership is a pure function of the membership set
+    a = HashRing(["cell0", "cell1", "cell2"])
+    b = HashRing(["cell2", "cell0", "cell1"])
+    keys = ["k%d" % i for i in range(200)]
+    assert [a.lookup(k) for k in keys] == [b.lookup(k) for k in keys]
+
+
+def test_ring_successors_walk_every_node_once():
+    ring = HashRing(["c0", "c1", "c2", "c3"])
+    walk = ring.successors("some-key")
+    assert sorted(walk) == ["c0", "c1", "c2", "c3"]
+    assert walk[0] == ring.lookup("some-key")
+
+
+def test_ring_add_node_bounded_reshuffle():
+    nodes = ["c%d" % i for i in range(4)]
+    ring = HashRing(nodes)
+    keys = ["req-%d" % i for i in range(400)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.add("c4")
+    moved = sum(1 for k in keys if ring.lookup(k) != before[k])
+    # consistent hashing's whole point: adding the 5th node remaps
+    # roughly 1/5 of the keyspace, NOT most of it (modulo hashing
+    # would remap ~4/5). Generous bound: strictly under half.
+    assert 0 < moved < len(keys) // 2
+    # every moved key moved TO the new node, never between old nodes
+    for k in keys:
+        if ring.lookup(k) != before[k]:
+            assert ring.lookup(k) == "c4"
+
+
+def test_ring_remove_node_only_reassigns_its_keys():
+    nodes = ["c%d" % i for i in range(4)]
+    ring = HashRing(nodes)
+    keys = ["req-%d" % i for i in range(400)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.remove("c2")
+    for k in keys:
+        if before[k] != "c2":
+            # keys the dead node did not own must not move at all
+            assert ring.lookup(k) == before[k]
+        else:
+            assert ring.lookup(k) != "c2"
+
+
+def test_ring_failover_order_stable_under_death():
+    # the ring's successor walk IS the failover plan: when the owner
+    # dies, every key lands exactly on its precomputed next successor
+    ring = HashRing(["c0", "c1", "c2"])
+    keys = ["req-%d" % i for i in range(100)]
+    planned = {k: ring.successors(k) for k in keys}
+    ring.remove("c1")
+    for k in keys:
+        survivors = [n for n in planned[k] if n != "c1"]
+        assert ring.lookup(k) == survivors[0]
+
+
+# --------------------------------------------------------- affinity index
+
+
+def test_index_learn_lookup_roundtrip():
+    idx = AffinityIndex(ttl_secs=60.0)
+    idx.learn("fp1", "rep0", now=100.0)
+    assert idx.lookup("fp1", now=101.0) == "rep0"
+    assert idx.lookup("missing", now=101.0) is None
+
+
+def test_index_ttl_expiry():
+    idx = AffinityIndex(ttl_secs=60.0)
+    idx.learn("fp1", "rep0", now=100.0)
+    assert idx.lookup("fp1", now=159.0) == "rep0"
+    assert idx.lookup("fp1", now=161.0) is None  # stale -> purged
+    assert len(idx) == 0
+
+
+def test_index_relearn_refreshes_ttl():
+    idx = AffinityIndex(ttl_secs=60.0)
+    idx.learn("fp1", "rep0", now=100.0)
+    idx.learn("fp1", "rep1", now=150.0)  # fresh dispatch re-learns
+    assert idx.lookup("fp1", now=205.0) == "rep1"
+
+
+def test_index_capacity_evicts_least_recently_used():
+    idx = AffinityIndex(ttl_secs=1000.0, capacity=3)
+    for i in range(3):
+        idx.learn("fp%d" % i, "rep0", now=float(i))
+    assert idx.lookup("fp0", now=10.0) == "rep0"  # fp0 now MRU
+    idx.learn("fp3", "rep1", now=11.0)  # evicts fp1 (the LRU), not fp0
+    assert idx.lookup("fp0", now=12.0) == "rep0"
+    assert idx.lookup("fp1", now=12.0) is None
+    assert len(idx) == 3
+
+
+def test_index_forget_address_on_replica_retirement():
+    idx = AffinityIndex(ttl_secs=1000.0)
+    idx.learn("fp1", "rep0", now=0.0)
+    idx.learn("fp2", "rep1", now=0.0)
+    idx.learn("fp3", "rep0", now=0.0)
+    idx.forget_address("rep0")
+    assert idx.lookup("fp1", now=1.0) is None
+    assert idx.lookup("fp3", now=1.0) is None
+    assert idx.lookup("fp2", now=1.0) == "rep1"

@@ -61,6 +61,9 @@ class FakeReplicaStub(object):
         self.queue_depth = 0
         self.active_slots = 0
         self.kv_blocks_free = 0
+        self.kv_blocks_cached = 0
+        self.kv_blocks_shared = 0
+        self.health_state = ""
         self.queue_wait_ms = 0.0
         self.gen_errors = []  # exceptions raised by upcoming generates
         self.stream_errors = []
@@ -84,6 +87,9 @@ class FakeReplicaStub(object):
             queue_depth=self.queue_depth,
             active_slots=self.active_slots,
             kv_blocks_free=self.kv_blocks_free,
+            kv_blocks_cached=self.kv_blocks_cached,
+            kv_blocks_shared=self.kv_blocks_shared,
+            health_state=self.health_state,
             queue_wait_ms=self.queue_wait_ms,
             draining=self.draining,
         )
@@ -735,3 +741,178 @@ def test_router_start_stop_in_process():
         assert len(resp.tokens) == 3
     finally:
         router.stop()
+
+# ----------------------------------------- field-table completeness pin
+
+
+def test_status_field_tables_cover_the_protos_exactly():
+    """The declared signal tables ARE the contract: a field added to
+    pb.ReplicaStatus must land in STATUS_FORWARD or STATUS_COMPUTED,
+    and every observed heartbeat name must exist on
+    pb.ServerStatusResponse — otherwise new telemetry silently goes
+    dark between servicer and router_status."""
+    from elasticdl_tpu.serving.router import Replica
+
+    replica_fields = {f.name for f in pb.ReplicaStatus.DESCRIPTOR.fields}
+    forward = set(Replica.STATUS_FORWARD)
+    computed = set(Replica.STATUS_COMPUTED)
+    assert not forward & computed  # one owner per field
+    assert forward | computed == replica_fields
+
+    status_fields = {
+        f.name for f in pb.ServerStatusResponse.DESCRIPTOR.fields
+    }
+    observed = set(Replica.OBSERVED_SCALARS) | set(Replica.OBSERVED_LISTS)
+    assert not set(Replica.OBSERVED_SCALARS) & set(Replica.OBSERVED_LISTS)
+    assert observed <= status_fields
+
+    # every observed/forwarded name resolves on a live entry, so the
+    # table-driven observe()/status_response() loops cannot AttributeError
+    rep = Replica("addr", object(), CircuitBreaker(2, 1.0), 0.0)
+    for name in observed | forward - {"address"}:
+        assert hasattr(rep, name), name
+
+
+# ------------------------------------------------- prefix-affine dispatch
+
+
+_PREFIX = tuple([7] * 16)  # one full affinity block (block_tokens=16)
+
+
+def _warm(stub):
+    stub.kv_blocks_cached = 4
+    stub.kv_blocks_shared = 2
+
+
+def test_affinity_sticks_within_load_margin():
+    """A learned prefix keeps landing on its replica while the load
+    penalty stays inside affinity_load_margin, even when another
+    replica is strictly less loaded."""
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (1, 2)))
+    assert list(resp.tokens)[-1] == 100  # rep0: first by address tie
+    # rep0 is now the BUSIER replica, but within the margin (2.0)
+    stubs["rep0"].queue_depth = 1
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    assert list(resp.tokens)[-1] == 100  # affinity held
+    snap = router.telemetry.snapshot()
+    assert snap["affinity_hits"] == 1
+
+
+def test_affinity_decays_to_least_loaded_past_margin():
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    router.dispatch_generate(_req(prompt=_PREFIX + (1, 2)))  # learn rep0
+    stubs["rep0"].queue_depth = 5  # margin (2.0) blown
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    assert list(resp.tokens)[-1] == 200
+    assert router.telemetry.snapshot()["affinity_misses"] >= 1
+
+
+def test_affinity_decays_when_target_reports_no_warm_capacity():
+    """The chain evicted fleet-side: all warm signals zero means the
+    match would prefill cold anyway — route by load instead."""
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    router.dispatch_generate(_req(prompt=_PREFIX + (1, 2)))  # learn rep0
+    stubs["rep0"].kv_blocks_cached = 0
+    stubs["rep0"].kv_blocks_shared = 0
+    stubs["rep0"].queue_depth = 1  # rep1 is otherwise least-loaded
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    assert list(resp.tokens)[-1] == 200
+
+
+def test_affinity_never_dispatches_to_draining_replica():
+    """ISSUE regression: however perfect the prefix match, a draining
+    replica is out of rotation — the candidate filter IS the guard."""
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    router.dispatch_generate(_req(prompt=_PREFIX + (1, 2)))  # learn rep0
+    stubs["rep0"].draining = True
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    assert list(resp.tokens)[-1] == 200
+    assert stubs["rep0"].calls == 1  # only the learning dispatch
+
+
+def test_affinity_never_dispatches_to_stalled_replica():
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    router.dispatch_generate(_req(prompt=_PREFIX + (1, 2)))  # learn rep0
+    stubs["rep0"].health_state = "stalled"
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    assert list(resp.tokens)[-1] == 200
+    assert stubs["rep0"].calls == 1
+
+
+def test_affinity_skips_open_breaker_and_reroutes():
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    router.dispatch_generate(_req(prompt=_PREFIX + (1, 2)))  # learn rep0
+    # two consecutive transport failures trip rep0's breaker (threshold
+    # 2); the affine rung must not probe an OPEN breaker
+    stubs["rep0"].gen_errors = [_unavailable(), _unavailable()]
+    router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    router.dispatch_generate(_req(prompt=_PREFIX + (5, 6)))
+    calls_before = stubs["rep0"].calls
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (9, 9)))
+    assert list(resp.tokens)[-1] == 200
+    assert stubs["rep0"].calls == calls_before
+
+
+def test_short_prompt_never_learns_affinity():
+    """Below one full block there is nothing shareable: no fingerprint,
+    no index entry, pure least-loaded routing."""
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    router.dispatch_generate(_req(prompt=(1, 2)))
+    assert len(router._affinity) == 0
+
+
+def test_stream_success_teaches_affinity():
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    chunks = list(router.dispatch_stream(_req(prompt=_PREFIX + (1, 2))))
+    assert chunks[-1].done
+    stubs["rep0"].queue_depth = 1  # within margin
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    assert list(resp.tokens)[-1] == 100  # the stream taught the chain
+
+
+def test_remove_replica_forgets_learned_affinity():
+    router, stubs, _ = make_router(2)
+    _warm(stubs["rep0"])
+    router.poll_once()
+    router.dispatch_generate(_req(prompt=_PREFIX + (1, 2)))  # learn rep0
+    router.remove_replica("rep0")
+    assert len(router._affinity) == 0  # forgotten WITH the membership
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    assert list(resp.tokens)[-1] == 200  # ...and relearned on rep1
+
+
+def test_affinity_off_routes_pure_least_loaded():
+    router, stubs, _ = make_router(2, affinity=False)
+    _warm(stubs["rep0"])
+    stubs["rep1"].queue_depth = 1
+    router.poll_once()
+    router.dispatch_generate(_req(prompt=_PREFIX + (1, 2)))  # rep0
+    stubs["rep0"].queue_depth = 2
+    stubs["rep1"].queue_depth = 0
+    router.poll_once()
+    resp = router.dispatch_generate(_req(prompt=_PREFIX + (3, 4)))
+    assert list(resp.tokens)[-1] == 200  # no stickiness whatsoever

@@ -1,0 +1,414 @@
+"""Multi-cell router tier unit tests (tier-1: no jax, no sockets —
+fake replica stubs behind real RouterCells sharing a real on-disk
+journal, fake cell stubs in front of a real CellFront).
+
+Locks the ISSUE's failover semantics: journaled registry sharing
+(adopt/retire replay, cross-cell tailing, tick-boundary compaction,
+torn-tail tolerance, crash-restart recovery), the cell_kill chaos
+hook at the heartbeat tick, and the client-side cell front's bounded
+reroute ladder (transient -> next ring successor, backpressure ->
+propagate, stream reroute only before the first delivered chunk)."""
+
+import json
+import os
+import threading
+
+import pytest
+from test_router import FakeClock, FakeReplicaStub, _req
+
+from elasticdl_tpu.common.fault_injection import FaultInjector
+from elasticdl_tpu.master.state_store import JOURNAL_FILE
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.serving.router import RouterConfig, RouterError
+from elasticdl_tpu.serving.router_cell import (
+    CellFront,
+    CellRegistryJournal,
+    RouterCell,
+)
+
+
+def make_cell(journal_dir, seeds=(), cell_id=0, cells=2, clock=None,
+              stubs=None, **cfg_kwargs):
+    """RouterCell over fake replica stubs; the stub factory mints a
+    FakeReplicaStub on demand so journal-learned replicas resolve."""
+    clock = clock or FakeClock()
+    stubs = {} if stubs is None else stubs
+
+    def factory(addr):
+        if addr not in stubs:
+            stubs[addr] = FakeReplicaStub(
+                token=100 * (len(stubs) + 1)
+            )
+        return stubs[addr]
+
+    cfg = RouterConfig(
+        lease_secs=10.0, breaker_threshold=2,
+        breaker_cooldown_secs=5.0, redispatch_window_secs=8.0,
+        base_delay_secs=0.01, max_delay_secs=0.05,
+        cell_id=cell_id, cells=cells, **cfg_kwargs
+    )
+    cell = RouterCell(
+        list(seeds), config=cfg, journal_dir=str(journal_dir),
+        stub_factory=factory, clock=clock, sleep=clock.advance,
+    )
+    return cell, stubs, clock
+
+
+# ------------------------------------------------------- journal sharing
+
+
+def test_sibling_cell_learns_fleet_from_journal_alone(tmp_path):
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0", "rep1", "rep2"])
+    # the sibling starts with NO seeds: its whole fleet view is replay
+    c1, _, _ = make_cell(tmp_path, seeds=[], cell_id=1)
+    assert sorted(r.address for r in c1.replicas()) == [
+        "rep0", "rep1", "rep2"
+    ]
+    assert c1._journal.replayed >= 3
+    c0.stop()
+    c1.stop()
+
+
+def test_membership_change_propagates_at_heartbeat_tick(tmp_path):
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0"])
+    c1, _, _ = make_cell(tmp_path, seeds=[], cell_id=1)
+    c0.add_replica("rep9")
+    assert "rep9" not in [r.address for r in c1.replicas()]
+    c1.poll_once()  # the tick tails the journal
+    assert "rep9" in [r.address for r in c1.replicas()]
+    c0.remove_replica("rep9")
+    c1.poll_once()
+    assert "rep9" not in [r.address for r in c1.replicas()]
+    c0.stop()
+    c1.stop()
+
+
+def test_own_appends_are_never_replayed_back(tmp_path):
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0"])
+    c0.add_replica("rep1")
+    before = [r.address for r in c0.replicas()]
+    for _ in range(3):
+        c0.poll_once()
+    assert [r.address for r in c0.replicas()] == before
+    c0.stop()
+
+
+def test_restarted_cell_recovers_fleet_from_disk(tmp_path):
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0", "rep1"])
+    c0.stop()  # simulated crash+restart: a new process, same dir
+    c0b, _, _ = make_cell(tmp_path, seeds=[])
+    assert sorted(r.address for r in c0b.replicas()) == [
+        "rep0", "rep1"
+    ]
+    # the store's cold-start-over-existing-state odometer moved
+    assert c0b._journal.restarts >= 1
+    c0b.stop()
+
+
+def test_compaction_truncates_journal_and_preserves_state(tmp_path):
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0"])
+    # force the snapshot threshold with direct journal records
+    c0._journal._store.snapshot_every = 4
+    for i in range(6):
+        c0.add_replica("extra%d" % i)
+        c0.remove_replica("extra%d" % i)
+    assert c0._journal._pending_compact
+    journal_path = os.path.join(str(tmp_path), JOURNAL_FILE)
+    assert os.path.getsize(journal_path) > 0
+    assert c0._journal.compact_at_tick()
+    assert os.path.getsize(journal_path) == 0
+    # a cold start now rebuilds purely from the snapshot
+    c1, _, _ = make_cell(tmp_path, seeds=[], cell_id=1)
+    assert [r.address for r in c1.replicas()] == ["rep0"]
+    c0.stop()
+    c1.stop()
+
+
+def test_tailing_cell_resyncs_after_remote_compaction(tmp_path):
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0"])
+    c1, _, _ = make_cell(tmp_path, seeds=[], cell_id=1)
+    c0._journal._store.snapshot_every = 2
+    for i in range(4):
+        c0.add_replica("r%d" % i)
+    c0._journal.compact_at_tick()  # journal shrinks under c1's offset
+    c1.poll_once()
+    assert c1._journal.resyncs >= 1
+    assert set(r.address for r in c1.replicas()) >= {
+        "rep0", "r0", "r1", "r2", "r3"
+    }
+    c0.stop()
+    c1.stop()
+
+
+def test_torn_journal_tail_is_tolerated(tmp_path):
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0"])
+    c1, _, _ = make_cell(tmp_path, seeds=[], cell_id=1)
+    journal_path = os.path.join(str(tmp_path), JOURNAL_FILE)
+    # another cell dies mid-append: a torn, newline-less half event
+    with open(journal_path, "a") as f:
+        f.write('{"op": "adopt", "addr')
+    c1.poll_once()  # must not crash, must not apply the torn tail
+    # the writer comes back and completes its line as a FRESH event
+    with open(journal_path, "a") as f:
+        f.write('\n')
+        f.write(json.dumps(
+            {"op": "adopt", "address": "late", "cell": 0}
+        ) + "\n")
+    c1.poll_once()
+    assert "late" in [r.address for r in c1.replicas()]
+    c0.stop()
+    c1.stop()
+
+
+def test_lease_beacon_journaled_and_inert_under_replay(tmp_path):
+    c0, stubs, _ = make_cell(tmp_path, seeds=["rep0"])
+    for _ in range(RouterCell.LEASE_JOURNAL_EVERY):
+        c0.poll_once()
+    journal_path = os.path.join(str(tmp_path), JOURNAL_FILE)
+    with open(journal_path) as f:
+        ops = [json.loads(line)["op"] for line in f if line.strip()]
+    assert "lease" in ops
+    # a fresh cell replays the beacon as a no-op: same fleet, no crash
+    c1, _, _ = make_cell(tmp_path, seeds=[], cell_id=1)
+    assert [r.address for r in c1.replicas()] == ["rep0"]
+    c0.stop()
+    c1.stop()
+
+
+def test_status_response_reports_cell_and_journal_block(tmp_path):
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0"], cell_id=1, cells=3)
+    resp = c0.status_response()
+    assert resp.cell_id == 1
+    assert resp.cells == 3
+    assert resp.journal_events >= 1   # the seed adopt
+    assert resp.cell_restarts == c0._journal.restarts
+    c0.stop()
+
+
+# --------------------------------------------------------- cell_kill hook
+
+
+def test_cell_kill_hook_fires_at_the_heartbeat_tick(tmp_path):
+    killed = []
+    injector = FaultInjector(spec="cell_kill:kill:1:skip=2",
+                             kill_fn=lambda: killed.append(True))
+    c0, _, _ = make_cell(tmp_path, seeds=["rep0"])
+    c0._cell_injector = injector
+    c0.poll_once()
+    c0.poll_once()
+    assert not killed  # skip=2: the first two ticks survive
+    c0.poll_once()
+    assert killed == [True]
+    c0.stop()
+
+
+# ------------------------------------------------------------- cell front
+
+
+class FakeCellStub(object):
+    """RouterStub-shaped fake cell: scripted failures per call."""
+
+    def __init__(self, token):
+        self.token = token
+        self.gen_errors = []
+        self.stream_errors = []
+        self.stream_fail_after_chunks = None
+        self.calls = 0
+        self.closed = 0
+
+    def close(self):
+        self.closed += 1
+
+    def router_generate(self, request, timeout=None):
+        self.calls += 1
+        if self.gen_errors:
+            raise self.gen_errors.pop(0)
+        return pb.GenerateResponse(
+            tokens=list(request.prompt) + [self.token],
+            model_version=1,
+        )
+
+    def router_generate_stream(self, request, timeout=None):
+        self.calls += 1
+        if self.stream_errors:
+            raise self.stream_errors.pop(0)
+
+        def chunks():
+            for i in range(request.max_new_tokens):
+                if self.stream_fail_after_chunks is not None \
+                        and i >= self.stream_fail_after_chunks:
+                    from test_router import _unavailable
+
+                    raise _unavailable("cell died mid-stream")
+                yield pb.TokenChunk(tokens=[self.token + i],
+                                    model_version=1)
+            yield pb.TokenChunk(tokens=[], done=True, model_version=1)
+
+        return chunks()
+
+    def router_status(self, request, timeout=None):
+        return pb.RouterStatusResponse(replicas=1, healthy=1)
+
+
+def make_front(n=2, clock=None):
+    clock = clock or FakeClock()
+    stubs = {"cell%d" % i: FakeCellStub(token=100 * (i + 1))
+             for i in range(n)}
+    front = CellFront(
+        sorted(stubs), stub_factory=lambda a: stubs[a],
+        reroute_window_secs=8.0, base_delay_secs=0.01,
+        max_delay_secs=0.05, clock=clock, sleep=clock.advance,
+    )
+    return front, stubs, clock
+
+
+def _long_req(seed_token=5):
+    # >= one full block (16 tokens): fingerprint-keyed routing
+    return _req(prompt=[seed_token] * 16 + [1, 2], new=3)
+
+
+def test_front_routes_to_ring_owner_deterministically():
+    front_a, _, _ = make_front(3)
+    front_b, _, _ = make_front(3)
+    req = _long_req()
+    key = front_a._route_key(req)
+    assert key == front_b._route_key(req)  # content-addressed
+    assert (front_a._targets(key)[0][0]
+            == front_b._targets(key)[0][0])
+
+
+def test_front_reroutes_dead_cell_zero_loss():
+    front, stubs, _ = make_front(2)
+    req = _long_req()
+    owner = front._targets(front._route_key(req))[0][0]
+    from test_router import _unavailable
+
+    stubs[owner].gen_errors = [_unavailable()]
+    resp = front.generate(req)
+    assert list(resp.tokens)[-1] in (100, 200)  # a cell DID answer
+    assert front.counters["rerouted"] == 1
+    assert front.counters["cell_failures"] == 1
+    assert front.counters["completed"] == 1
+
+
+def test_front_breaker_stops_probing_a_dead_cell():
+    front, stubs, _ = make_front(2)
+    req = _long_req()
+    owner = front._targets(front._route_key(req))[0][0]
+    dead = stubs[owner]
+    from test_router import _unavailable
+
+    dead.gen_errors = [_unavailable() for _ in range(50)]
+    for _ in range(5):
+        front.generate(req)
+    # threshold=3 transient failures tripped the owner's breaker:
+    # later requests skip it entirely instead of paying a probe each
+    assert dead.calls < 5
+
+
+def test_front_backpressure_propagates_not_rerouted():
+    front, stubs, _ = make_front(2)
+    req = _long_req()
+    owner = front._targets(front._route_key(req))[0][0]
+    other = [a for a in stubs if a != owner][0]
+    from test_router import _exhausted
+
+    stubs[owner].gen_errors = [_exhausted()]
+    with pytest.raises(RouterError) as err:
+        front.generate(req)
+    assert err.value.code == "RESOURCE_EXHAUSTED"
+    # the registry is shared: rerouting a shed would only re-shed
+    assert stubs[other].calls == 0
+    assert front.counters["shed"] == 1
+    assert front.counters["rerouted"] == 0
+
+
+def test_front_application_error_propagates_untouched():
+    front, stubs, _ = make_front(2)
+    req = _long_req()
+    owner = front._targets(front._route_key(req))[0][0]
+    other = [a for a in stubs if a != owner][0]
+    from test_router import _invalid
+
+    stubs[owner].gen_errors = [_invalid()]
+    with pytest.raises(RouterError) as err:
+        front.generate(req)
+    assert err.value.code == "INVALID_ARGUMENT"
+    assert stubs[other].calls == 0
+
+
+def test_front_all_cells_dead_raises_after_window():
+    front, stubs, clock = make_front(2)
+    from test_router import _unavailable
+
+    for stub in stubs.values():
+        stub.gen_errors = [_unavailable() for _ in range(100)]
+    with pytest.raises(RouterError) as err:
+        front.generate(_long_req())
+    assert err.value.code == "UNAVAILABLE"
+
+
+def test_front_stream_reroutes_before_first_chunk():
+    front, stubs, _ = make_front(2)
+    req = _long_req()
+    owner = front._targets(front._route_key(req))[0][0]
+    from test_router import _unavailable
+
+    stubs[owner].gen_errors = []
+    stubs[owner].stream_errors = [_unavailable()]
+    tokens = []
+    for chunk in front.generate_stream(req):
+        tokens.extend(chunk.tokens)
+    assert tokens  # the survivor streamed the whole request
+    assert front.counters["rerouted"] == 1
+
+
+def test_front_stream_never_reroutes_after_first_chunk():
+    front, stubs, _ = make_front(2)
+    req = _long_req()
+    owner = front._targets(front._route_key(req))[0][0]
+    stubs[owner].stream_fail_after_chunks = 1
+    delivered = []
+    with pytest.raises(RouterError) as err:
+        for chunk in front.generate_stream(req):
+            delivered.extend(chunk.tokens)
+    # a replay past a delivered chunk would duplicate tokens: the
+    # stream fails EXPLICITLY instead, with the partial delivery
+    assert err.value.code == "UNAVAILABLE"
+    assert len(delivered) == 1
+    assert front.counters["rerouted"] == 0
+
+
+def test_front_add_remove_cell_closes_channel():
+    front, stubs, _ = make_front(2)
+    gone = front.cells()[0]
+    front.remove_cell(gone)
+    assert stubs[gone].closed == 1
+    assert gone not in front.cells()
+    front.close()
+    assert all(s.closed == 1 for s in stubs.values())
+
+
+def test_front_short_prompt_still_routes():
+    front, _, _ = make_front(2)
+    resp = front.generate(_req(prompt=(1, 2), new=2))
+    assert list(resp.tokens)[-1] in (100, 200)
+    assert front.counters["completed"] == 1
+
+
+def test_front_concurrent_requests_thread_safe():
+    front, stubs, _ = make_front(2)
+    done = []
+
+    def one(i):
+        resp = front.generate(_long_req(seed_token=i % 7))
+        done.append(list(resp.tokens)[-1])
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(done) == 16
+    assert front.counters["completed"] == 16
