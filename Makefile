@@ -141,7 +141,7 @@ serve-smoke:
 		--ramp "8:0.8,32:0.5,8:0.5" --compare_paged --kv_block_size 4 \
 		--shared_prefix --prefix_len 16 --suffix_len 1:4 \
 		--out_len 4:12 --draft_k 2 --kv_cache_dtype int8 \
-		--kv_host_blocks 84 --profile --overhead_ab \
+		--kv_host_blocks 84 --profile --overhead_ab --disagg \
 		--out BENCH_SERVING.json
 
 # the bench-trajectory gate: run AFTER serve-smoke has written a

@@ -116,6 +116,18 @@ RECEIVER_PAIRS = {
     # flush) on every path — a spilled chain that is neither is host
     # memory pinned forever with no index entry left to find it
     "spill": (frozenset(["revive", "drop"]), "tier"),
+    # the disaggregated handoff's transfer obligation
+    # (serving/disagg.py HandoffCoordinator): every chain exported off
+    # a prefill replica must land on the decode side (import_chain,
+    # the success settle) or be closed as a failure record
+    # (abort_transfer) on EVERY path — an unsettled export is a
+    # handoff the two-pool ledger cannot reconcile. Hinted to the
+    # coordinator spelling ("disagg"): pool-level export_chain calls
+    # in tests/benches return plain data and owe nothing.
+    "export_chain": (
+        frozenset(["import_chain", "abort_transfer"]),
+        "disagg",
+    ),
 }
 
 #: value-bound acquires: callable tail -> release method names

@@ -254,6 +254,16 @@ SERVING_RPCS = (
     "generate",
     "generate_stream",
     "server_status",
+    # disaggregated prefill/decode handoff surface (serving/disagg.py):
+    # the three transfer RPCs wrap on whichever servicer exposes them;
+    # disagg_handoff is an intercept HOOK the router consults directly
+    # before starting a transfer (the handoff is router-initiated — no
+    # inbound RPC exists for the wrapper to see), so a drill can force
+    # the fallback path with both replicas healthy
+    "export_chain",
+    "transfer_chain",
+    "abort_transfer",
+    "disagg_handoff",
 ) + ROUTER_RPCS
 
 # The replica supervisor/autoscaler's process boundary

@@ -40,6 +40,23 @@ _SERVING_METHODS = {
         pb.ServerStatusResponse,
         False,
     ),
+    # disaggregated prefill/decode handoff (serving/disagg.py): the
+    # export response IS the transfer payload the decode side imports
+    "export_chain": (
+        pb.ExportChainRequest,
+        pb.TransferChainRequest,
+        False,
+    ),
+    "transfer_chain": (
+        pb.TransferChainRequest,
+        pb.TransferChainResponse,
+        False,
+    ),
+    "abort_transfer": (
+        pb.AbortTransferRequest,
+        pb.TransferChainResponse,
+        False,
+    ),
 }
 
 # the routing tier's surface (serving/router.py); names are distinct

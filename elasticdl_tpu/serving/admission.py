@@ -59,11 +59,15 @@ class ServingRequest(object):
 
     def __init__(self, prompt, max_new_tokens, temperature=0.0, seed=0,
                  deadline_ms=0, clock=time.monotonic, trace_id="",
-                 parent_span_id=""):
+                 parent_span_id="", prefill_only=False):
         with ServingRequest._ids_lock:
             self.request_id = next(ServingRequest._ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
+        # disaggregated cache warming (serving/disagg.py): seat, run
+        # the prompt's prefill, register the chain, release — the
+        # blocks park refcount-0 cached, matchable and exportable
+        self.prefill_only = bool(prefill_only)
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.submitted_at = clock()
@@ -195,8 +199,10 @@ class RequestQueue(object):
                 "seq_len %d" % (p, request.max_new_tokens, self.seq_len),
             )
         cached = p + request.max_new_tokens - 1
+        caches = (request.max_new_tokens > 1
+                  or getattr(request, "prefill_only", False))
         if (self.max_cached_tokens is not None
-                and request.max_new_tokens > 1
+                and caches
                 and cached > self.max_cached_tokens):
             raise AdmissionError(
                 "INVALID_ARGUMENT",

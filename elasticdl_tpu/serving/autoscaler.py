@@ -102,7 +102,7 @@ class AutoscalerConfig(object):
     def __init__(self, min_replicas=1, max_replicas=4,
                  decide_secs=0.5,
                  up_queue_wait_ms=200.0, up_queue_depth=4,
-                 up_window_secs=2.0,
+                 up_window_secs=2.0, up_free_kv_blocks=0,
                  idle_queue_wait_ms=25.0, down_window_secs=6.0,
                  down_free_kv_blocks=0,
                  cooldown_secs=5.0,
@@ -117,6 +117,13 @@ class AutoscalerConfig(object):
         self.up_queue_wait_ms = float(up_queue_wait_ms)
         self.up_queue_depth = int(up_queue_depth)
         self.up_window_secs = float(up_window_secs)
+        # the decode pool's own scale-up signal in a disaggregated
+        # fleet (serving/disagg.py): free+cached paged-KV headroom
+        # across decode-capable replicas below this floor is pressure,
+        # even while queues look healthy — imported chains and new
+        # seats will soon stop fitting. 0 disables (dense pools report
+        # no block counts; unified fleets scale on queue-wait alone).
+        self.up_free_kv_blocks = int(up_free_kv_blocks)
         self.idle_queue_wait_ms = float(idle_queue_wait_ms)
         self.down_window_secs = float(down_window_secs)
         # scale-down additionally requires this much free paged-KV
@@ -840,20 +847,40 @@ class ReplicaSupervisor(object):
             self._idle_routed = None
             return
         cfg = self.config
-        busiest_wait = max(r.queue_wait_ms for r in sigs)
-        deepest_queue = max(r.queue_depth for r in sigs)
+        # a disaggregated fleet prices each phase off its OWN signal
+        # (serving/disagg.py): prompt pressure queues on the prefill
+        # pool, so when one exists the wait/depth terms read only that
+        # pool; the decode pool's pressure is KV headroom, read below
+        prefill_sigs = [r for r in sigs
+                        if getattr(r, "role", "") == "prefill"]
+        decode_sigs = [r for r in sigs
+                       if getattr(r, "role", "") != "prefill"]
+        wait_sigs = prefill_sigs or sigs
+        busiest_wait = max(r.queue_wait_ms for r in wait_sigs)
+        deepest_queue = max(r.queue_depth for r in wait_sigs)
         quiet = all(
             r.queue_depth == 0 and r.inflight == 0
             and r.active_slots == 0
             for r in sigs
         )
+        kv_pressure = False
+        if cfg.up_free_kv_blocks > 0 and decode_sigs:
+            # free+cached counts as headroom (parked refcount-0
+            # chains are evictable on demand), same reading as the
+            # scale-down gate below
+            kv_pressure = sum(
+                r.kv_blocks_free + r.kv_blocks_cached
+                for r in decode_sigs
+            ) < cfg.up_free_kv_blocks
         # the wait EWMA is a LAGGING signal: alone (frozen from a
         # burst that already ended) it is not pressure — there must be
         # actual work present. quiet and pressure are thus mutually
-        # exclusive by construction.
+        # exclusive by construction (the KV term excepted: exhausted
+        # headroom is pressure even on a momentarily quiet fleet).
         pressure = ((not quiet
                      and busiest_wait >= cfg.up_queue_wait_ms)
-                    or deepest_queue >= cfg.up_queue_depth)
+                    or deepest_queue >= cfg.up_queue_depth
+                    or kv_pressure)
         # the queue-wait EWMA only moves when requests flow: after a
         # burst stops dead it FREEZES at its last (high) value, so the
         # EWMA gate alone would block scale-down forever. Zero routed
@@ -892,8 +919,10 @@ class ReplicaSupervisor(object):
             self._above_since = None
             self._record(
                 now, "scale_up",
-                "queue_wait %.0fms / depth %d sustained %.1fs -> "
+                "queue_wait %.0fms / depth %d%s sustained %.1fs -> "
                 "target %d" % (busiest_wait, deepest_queue,
+                               " / decode KV headroom low"
+                               if kv_pressure else "",
                                cfg.up_window_secs, self.target),
             )
             self._journal({"ev": "target", "n": self.target,

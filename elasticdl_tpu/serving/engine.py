@@ -107,6 +107,44 @@ def _fused_dequant():
         "EDL_SERVING_FUSED_DEQUANT", "") not in ("", "0")
 
 
+def prefill_chunk_default():
+    """EDL_PREFILL_CHUNK_TOKENS resolves the chunked-prefill tile
+    width when the config leaves it unset (0 = off: a prompt prefills
+    monolithically, monopolizing its scheduler tick)."""
+    try:
+        return int(os.environ.get("EDL_PREFILL_CHUNK_TOKENS", "") or 0)
+    except ValueError:
+        return 0
+
+
+def prefill_budget_default():
+    """EDL_PREFILL_BUDGET_MS resolves the scheduler's per-tick chunked
+    prefill budget when the config leaves it unset: the wall-clock ms
+    of prefill tiles a tick may run while decode slots are active
+    (<= 0 = unbounded). At least one tile always runs per tick, so
+    prefill makes progress no matter how small the budget."""
+    try:
+        return float(
+            os.environ.get("EDL_PREFILL_BUDGET_MS", "") or 8.0
+        )
+    except ValueError:
+        return 8.0
+
+
+def role_default():
+    """EDL_SERVING_ROLE resolves the replica's disaggregation role
+    when the config leaves it unset: "prefill" | "decode" | "unified"
+    (serving/disagg.py). Unified replicas serve both phases — the
+    pre-disagg behavior."""
+    role = os.environ.get("EDL_SERVING_ROLE", "") or "unified"
+    if role not in ("prefill", "decode", "unified"):
+        raise ValueError(
+            "EDL_SERVING_ROLE must be prefill|decode|unified, got %r"
+            % role
+        )
+    return role
+
+
 def profile_default():
     """EDL_PROFILE resolves the per-step decode profiler when the
     config leaves it unset (off by default: the disabled engine does
@@ -129,6 +167,10 @@ class StepProfiler(object):
 
         prefill        full-prompt prefill forward + cache/block write
         suffix_tile    shared-prefix suffix tile over resident blocks
+        prefill_tile   one chunked-prefill tile: a fixed-token chunk
+                       of a long prompt run between decode ticks (the
+                       scheduler prices its per-tick chunk budget off
+                       this phase's percentiles)
         decode         the plain vmapped single-token step (model
                        apply + sample; paged: minus the row scatter,
                        which times separately)
@@ -155,8 +197,8 @@ class StepProfiler(object):
     Thread-safety: the scheduler thread records, the metrics HTTP
     thread snapshots — one lock, record is O(1)."""
 
-    PHASES = ("prefill", "suffix_tile", "decode", "draft",
-              "verify_commit", "scatter", "revive_upload",
+    PHASES = ("prefill", "suffix_tile", "prefill_tile", "decode",
+              "draft", "verify_commit", "scatter", "revive_upload",
               "reload_swap")
 
     def __init__(self, clock=time.perf_counter):
@@ -220,6 +262,31 @@ class _Slot(object):
         self.max_total = max_total
 
 
+class _PrefillJob(object):
+    """One chunked prefill in flight (paged engine): the slot is
+    seated — its full block budget reserved — but the prompt's rows
+    materialize tile by tile across scheduler ticks via
+    advance_prefill(). `first` is the request's first generated token,
+    set when the final tile lands; `finished` mirrors the insert()
+    contract (a prefill-only or one-token request completes at its
+    first token)."""
+
+    __slots__ = ("slot", "request", "pos", "prompt_len", "first",
+                 "finished", "tiles")
+
+    def __init__(self, slot, request, pos):
+        self.slot = slot
+        self.request = request
+        self.pos = int(pos)  # next un-prefilled prompt position
+        self.prompt_len = len(request.prompt)
+        self.first = None
+        self.finished = False
+        self.tiles = 0
+
+    def done(self):
+        return self.first is not None
+
+
 class ContinuousBatchingEngine(object):
     """The decode pool. `top_k`/`top_p` are server-level static sampling
     filters (part of the compiled step); temperature and seed ride per
@@ -258,6 +325,9 @@ class ContinuousBatchingEngine(object):
         self.draft_k = 0        # speculative decode off (paged engine
         self.draft_proposed = 0  # overrides when a draft is seated)
         self.draft_accepted = 0
+        # chunked prefill tile width (0 = monolithic); the dense pool
+        # never chunks — only the paged engine overrides this
+        self.prefill_chunk_tokens = 0
         # cumulative wall ms this engine has spent inside insert()
         # (prefill / suffix tile / draft prefill) — the scheduler
         # advances it; the servicer stamps it at admission so seating
@@ -670,7 +740,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def __init__(self, trainer, state, num_slots, top_k=0, top_p=1.0,
                  block_size=16, num_blocks=0, share_prefix=True,
-                 draft=None, draft_k=0, host_bytes=None):
+                 draft=None, draft_k=0, host_bytes=None,
+                 prefill_chunk_tokens=None):
         import inspect
 
         model = trainer.model
@@ -703,6 +774,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         )
         super().__init__(trainer, state, num_slots, top_k=top_k,
                          top_p=top_p)
+        # chunked prefill (None resolves from EDL_PREFILL_CHUNK_TOKENS;
+        # 0 = monolithic): long prompts run as fixed-token tiles via
+        # begin_insert/advance_prefill so the scheduler can interleave
+        # decode ticks between tiles
+        self.prefill_chunk_tokens = (
+            prefill_chunk_default() if prefill_chunk_tokens is None
+            else int(prefill_chunk_tokens)
+        )
+        self._prefilling = {}  # slot -> _PrefillJob (chunked, pending)
         self._positions = np.zeros(self.num_slots, np.int32)
         self._suffix_fns = {}  # suffix bucket -> compiled tile prefill
         self._spec_fn = None
@@ -823,8 +903,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     # ------------------------------------------------------------- slots
 
     def can_seat(self, request):
-        if request.max_new_tokens <= 1:
-            return True  # prefill-only; never touches the pool
+        if (request.max_new_tokens <= 1
+                and not getattr(request, "prefill_only", False)):
+            return True  # one-token answer; never touches the pool
         cached = len(request.prompt) + request.max_new_tokens - 1
         return self.kv.can_seat(request.prompt, len(request.prompt),
                                 cached)
@@ -872,7 +953,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 "request needs %d positions > seq_len %d"
                 % (total, self.seq_len)
             )
-        decoding = request.max_new_tokens > 1
+        prefill_only = getattr(request, "prefill_only", False)
+        decoding = request.max_new_tokens > 1 or prefill_only
         shared = 0
         if decoding:
             # reserve-or-raise BEFORE any compute; the scheduler
@@ -925,11 +1007,18 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             # make this prompt's full blocks matchable (the shared
             # ones are already indexed; walking is idempotent)
             self.kv.register_prefix(slot, request.prompt)
-            if self.draft_k:
+            if self.draft_k and not prefill_only:
                 self._prefill_draft(slot, request)
         request.generated.append(first)
         request.model_version = self.model_version
         self._sync_host_telemetry()
+        if prefill_only:
+            # cache-warming seat (disagg prefill replica): the chain
+            # is registered; release the slot's references NOW so the
+            # blocks park refcount-0 in the reclaimable cache —
+            # matchable, exportable, and reclaimable under pressure
+            self.kv.release(slot)
+            return slot, first, True
         if not decoding:
             return slot, first, True
         self._slots[slot] = _Slot(request, total)
@@ -987,6 +1076,166 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             request.trace_event("prefix_hit", slot=slot,
                                 shared_tokens=start, suffix_tokens=t)
         return int(first)
+
+    # --------------------------------------------------- chunked prefill
+
+    def free_slots(self):
+        # a seated-but-still-prefilling slot is occupied: its blocks
+        # are reserved and its tiles are mid-flight
+        return [i for i, s in enumerate(self._slots)
+                if s is None and i not in self._prefilling]
+
+    def active_requests(self):
+        reqs = [s.request for s in self._slots if s is not None]
+        reqs.extend(j.request for j in self._prefilling.values())
+        return reqs
+
+    def prefilling_count(self):
+        return len(self._prefilling)
+
+    def begin_insert(self, request):
+        """Chunked admission: seat `request` — the same full-budget
+        reservation as insert() — and return a _PrefillJob whose tiles
+        advance_prefill() runs between decode ticks. Prompts that need
+        no chunking (chunking off, one-token answers, full-prompt
+        prefix matches) complete immediately: job.done() is True and
+        job.first/job.finished carry the insert() result, so the
+        caller has ONE completion path either way."""
+        chunk = self.prefill_chunk_tokens
+        prefill_only = getattr(request, "prefill_only", False)
+        if not chunk or (request.max_new_tokens <= 1
+                         and not prefill_only):
+            slot, first, finished = self.insert(request)
+            job = _PrefillJob(slot, request, len(request.prompt))
+            job.first, job.finished = first, finished
+            return job
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slot")
+        slot = free[0]
+        p = len(request.prompt)
+        total = p + request.max_new_tokens
+        if total > self.seq_len:
+            raise ValueError(
+                "request needs %d positions > seq_len %d"
+                % (total, self.seq_len)
+            )
+        revived_before = self.kv.allocator.blocks_revived
+        seat_t0 = time.perf_counter()
+        shared = self.kv.seat(slot, request.prompt,
+                              p + request.max_new_tokens - 1)
+        revived = self.kv.allocator.blocks_revived - revived_before
+        if revived and hasattr(request, "trace_event"):
+            request.trace_event(
+                "revive_upload",
+                ms=round((time.perf_counter() - seat_t0) * 1000.0, 3),
+                tokens=revived * self.kv.block_size,
+            )
+        if shared >= p:
+            # full-prompt match: the one-token re-run tile IS the
+            # whole prefill — nothing left to chunk
+            first = self._insert_shared(slot, request, shared)
+            job = _PrefillJob(slot, request, p)
+            self._finish_prefill(job, first)
+            return job
+        if shared:
+            if self.telemetry is not None:
+                self.telemetry.count("prefix_hit_tokens", shared)
+            if hasattr(request, "trace_event"):
+                request.trace_event(
+                    "prefix_hit", slot=slot, shared_tokens=shared,
+                    suffix_tokens=p - shared,
+                )
+        job = _PrefillJob(slot, request, shared)
+        self._prefilling[slot] = job
+        return job
+
+    def advance_prefill(self, job):
+        """Run ONE tile of `job`'s pending prompt: decode up to
+        prefill_chunk_tokens prompt tokens at positions
+        [pos, pos + t) over the slot's resident blocks and scatter
+        their rows — the shared-prefix suffix executable pointed at a
+        chunk window, so chunking adds no new compiled surface. The
+        FINAL tile's sample (position = prompt length, the monolithic
+        prefill's sampling position) is the request's first generated
+        token; non-final samples are discarded. Returns True when the
+        job completed this call."""
+        if job.done():
+            return True
+        slot, request = job.slot, job.request
+        p = job.prompt_len
+        t = min(self.prefill_chunk_tokens, p - job.pos)
+        final = job.pos + t >= p
+        t_pad = self._suffix_bucket(t)
+        fn = self._suffix_fns.get(t_pad)
+        if fn is None:
+            fn = self._build_suffix_prefill(t_pad)
+            self._suffix_fns[t_pad] = fn
+        chunk = np.zeros((1, t_pad), np.int32)
+        chunk[0, :t] = request.prompt[job.pos:job.pos + t]
+        prof = self.profiler
+        t0 = prof.t() if prof is not None else 0.0
+        with self.trainer.mesh:
+            self.kv.pools, first = fn(
+                self._exec_variables, self.kv.pools,
+                jnp.asarray(self.kv.tables[slot]),
+                jnp.asarray(chunk),
+                jnp.asarray(job.pos, jnp.int32),
+                jnp.asarray(t, jnp.int32),
+                jnp.asarray(request.seed, jnp.int32),
+                jnp.asarray(request.temperature, jnp.float32),
+            )
+        if prof is not None:
+            jax.block_until_ready(self.kv.pools)
+            prof.observe("prefill_tile", prof.t() - t0)
+        job.pos += t
+        job.tiles += 1
+        if not final:
+            return False
+        if hasattr(request, "trace_event"):
+            request.trace_event(
+                "prefill", slot=slot, paged=True, tiles=job.tiles,
+                chunk_tokens=self.prefill_chunk_tokens,
+            )
+        self._finish_prefill(job, int(first))
+        return True
+
+    def _finish_prefill(self, job, first):
+        """The chunked path's insert() epilogue: index the prompt,
+        seat the draft, commit the first token, and either activate
+        the slot for decode or (prefill-only) release it with the
+        chain parked exportable."""
+        slot, request = job.slot, job.request
+        self._prefilling.pop(slot, None)
+        prefill_only = getattr(request, "prefill_only", False)
+        self.kv.register_prefix(slot, request.prompt)
+        if self.draft_k and not prefill_only:
+            self._prefill_draft(slot, request)
+        request.generated.append(first)
+        request.model_version = self.model_version
+        self._sync_host_telemetry()
+        job.first = first
+        if prefill_only or request.max_new_tokens <= 1:
+            self.kv.release(slot)
+            job.finished = True
+            return
+        self._slots[slot] = _Slot(
+            request, job.prompt_len + request.max_new_tokens
+        )
+        self._positions[slot] = job.prompt_len
+        self._last_tokens[slot] = first
+        self._seeds[slot] = request.seed
+        self._temps[slot] = request.temperature
+
+    def abort_prefill(self, job):
+        """Abandon a pending chunked prefill (deadline expiry between
+        tiles): release the seat — rows already scattered die with
+        their blocks' refcounts; shared ancestors survive under their
+        other owners."""
+        if self._prefilling.pop(job.slot, None) is None:
+            return
+        job.finished = True
+        self.kv.release(job.slot)
 
     def _prefill_draft(self, slot, request):
         """Fill the draft's dense cache for this prompt (the draft has

@@ -770,6 +770,15 @@ def scatter_rows(pools, rows, bids, offs):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _settle_chain_refs(alloc, bids):
+    """Drop an import walk's keep-alive references root-first so the
+    chain parks refcount-0 cached. Takes ownership of the references
+    (the EDL501 settle for import_chain's increfs): called from a
+    finally, it must run even when the walk or the upload failed."""
+    for bid in bids:
+        alloc.decref(bid)
+
+
 def _pool_tjit(pool, name, fn, **jit_kwargs):
     """jax.jit with recompile-sentry adoption for the pool's compiled
     helpers — lazy like the engine's _tjit, so executables built
@@ -850,6 +859,12 @@ class PagedKVPool(object):
         self._host_rows = {}   # vid -> [np rows per 4-d leaf, in order]
         self.host_blocks_peak = 0
         self.revive_uploads = 0  # monotone: batched revival scatters
+        # disaggregated handoff economy (serving/disagg.py): chains
+        # exported to / imported from sibling replicas, and the prompt
+        # tokens imports seated without re-running prefill here
+        self.chain_exports = 0
+        self.chain_imports = 0
+        self.chain_import_tokens = 0
         self._gather_fn = None
         self._upload_fns = {}  # padded batch size -> compiled scatter
         # optional StepProfiler (serving/engine.py): the pool times its
@@ -884,11 +899,13 @@ class PagedKVPool(object):
 
     # ------------------------------------------------- host spill tier
 
-    def _spill_block(self, bid, vid):
-        """Allocator spill sink: copy device block `bid`'s rows (every
-        4-d arena leaf — int8 rows and f32 scale leaves alike) into
-        host numpy buffers under `vid`, BEFORE the bid is recycled.
-        One compiled gather serves every spill (traced bid)."""
+    def _gather_rows(self, bid):
+        """One block's rows as host numpy arrays — every 4-d arena
+        leaf (int8 rows and f32 scale leaves alike) through ONE
+        compiled gather with a traced bid. The spill sink and the
+        chain export both read through here, so an exported chain is
+        byte-identical to what the host spill tier would hold for the
+        same blocks."""
         if self._gather_fn is None:
             def gather(pools, b):
                 return [leaf[b] for leaf in jax.tree.leaves(pools)
@@ -898,7 +915,12 @@ class PagedKVPool(object):
                 self, "kv_spill_gather", gather
             )
         rows = self._gather_fn(self.pools, jnp.asarray(bid, jnp.int32))
-        self._host_rows[vid] = [np.asarray(r) for r in rows]
+        return [np.asarray(r) for r in rows]
+
+    def _spill_block(self, bid, vid):
+        """Allocator spill sink: copy device block `bid`'s rows into
+        host numpy buffers under `vid`, BEFORE the bid is recycled."""
+        self._host_rows[vid] = self._gather_rows(bid)
         self.host_blocks_peak = max(self.host_blocks_peak,
                                     len(self._host_rows))
 
@@ -907,27 +929,24 @@ class PagedKVPool(object):
         spilled entry — its rows are gone for good."""
         self._host_rows.pop(vid, None)
 
-    def _apply_revivals(self):
-        """Upload the rows of every chain entry the last seat revived
-        into its freshly allocated device block: ONE batched scatter
-        over the block axis per seat, padded to a power-of-two bucket
-        (pad lanes carry the out-of-bounds drop id), so a handful of
-        executables serve every revival size. The host copies are
-        consumed — revival is a MOVE, not a copy."""
-        moves = self.allocator.take_revived()
-        if not moves:
-            return
+    def _upload_rows(self, staged):
+        """Upload staged `(bid, [np rows per leaf])` row sets into
+        their device blocks: ONE batched scatter over the block axis,
+        padded to a power-of-two bucket (pad lanes carry the
+        out-of-bounds drop id), so a handful of executables serve
+        every upload size. Revival and chain import both land here —
+        the import path is the revival upload pointed at a sibling
+        replica's bytes instead of this host's spill store."""
         prof = self.profiler
         t0 = prof.t() if prof is not None else 0.0
-        k = len(moves)
+        k = len(staged)
         k_pad = 1
         while k_pad < k:
             k_pad *= 2
         bids = np.full(k_pad, self.num_blocks, np.int32)  # drop lanes
         per_leaf = None
-        for i, (vid, bid) in enumerate(moves):
+        for i, (bid, rows) in enumerate(staged):
             bids[i] = bid
-            rows = self._host_rows.pop(vid)
             if per_leaf is None:
                 per_leaf = [
                     np.zeros((k_pad,) + r.shape, r.dtype) for r in rows
@@ -961,6 +980,140 @@ class PagedKVPool(object):
         if prof is not None:
             jax.block_until_ready(self.pools)
             prof.observe("revive_upload", prof.t() - t0)
+
+    def _apply_revivals(self):
+        """Upload the rows of every chain entry the last seat revived
+        into its freshly allocated device block. The host copies are
+        consumed — revival is a MOVE, not a copy."""
+        moves = self.allocator.take_revived()
+        if not moves:
+            return
+        self._upload_rows(
+            [(bid, self._host_rows.pop(vid)) for vid, bid in moves]
+        )
+
+    # ------------------------------------------- disaggregated handoff
+
+    def leaf_dtypes(self):
+        """Row-leaf dtype names in jax.tree.leaves order — the arena
+        format fingerprint a chain transfer carries so an importer can
+        refuse a mismatched payload."""
+        return [str(leaf.dtype) for leaf in jax.tree.leaves(self.pools)
+                if leaf.ndim == 4]
+
+    def export_chain(self, prompt):
+        """Export the longest indexed chain covering `prompt` as a
+        dense byte copy: `[(block token tuple, [np rows per leaf])]`
+        root-first, resident blocks through the same compiled gather
+        the spill tier uses and spilled blocks straight from the host
+        store (copied, not consumed). Runs on the scheduler thread, so
+        nothing can evict a chain entry mid-gather. Empty list = no
+        full prompt block is indexed (nothing to hand off)."""
+        alloc = self.allocator
+        chain = alloc.match_prefix(prompt)
+        tuples = alloc._full_block_tuples(prompt)[:len(chain)]
+        blocks = []
+        for node, toks in zip(chain, tuples):
+            if node >= 0:
+                rows = self._gather_rows(node)
+            else:
+                host = self._host_rows.get(node)
+                if host is None:
+                    break
+                rows = [np.array(r) for r in host]
+            blocks.append((toks, rows))
+        if blocks:
+            self.chain_exports += 1
+        return blocks
+
+    def import_chain(self, blocks, leaf_dtypes=None):
+        """Import an exported chain into THIS pool: walk the
+        `(parent, tokens)` keys root-first, dedup against entries the
+        trie already resolves (resident or spilled), allocate a fresh
+        block for each missing level and re-key it into the index as a
+        refcount-0 reclaimable entry, then land every new block's rows
+        in one batched upload. Returns `(blocks_added, tokens_added)`.
+        A later prompt seats on the imported chain exactly like any
+        prefix hit, so sharing, CoW and spec decode compose unchanged.
+        Import stops early (partial chain, still a usable prefix) when
+        the pool runs out of blocks."""
+        alloc = self.allocator
+        if not alloc.share_prefix:
+            raise ValueError(
+                "chain import requires a prefix-shared pool "
+                "(kv_shared=True)"
+            )
+        if leaf_dtypes is not None:
+            mine = self.leaf_dtypes()
+            if list(leaf_dtypes) != mine:
+                raise ValueError(
+                    "chain leaf dtypes %r do not match this pool's %r"
+                    % (list(leaf_dtypes), mine)
+                )
+        # validate the WHOLE payload before allocating anything: a
+        # malformed level mid-chain must not leave earlier levels'
+        # references un-settled
+        blocks = [(tuple(int(t) for t in toks), rows)
+                  for toks, rows in blocks]
+        for toks, _ in blocks:
+            if len(toks) != self.block_size:
+                raise ValueError(
+                    "chain block carries %d tokens, block_size is %d"
+                    % (len(toks), self.block_size)
+                )
+        parent = -1
+        staged = []   # (bid, rows) for the batched upload
+        fresh = []    # bids held live until the walk finishes
+        try:
+            for toks, rows in blocks:
+                key = (parent, toks)
+                node = alloc._index.get(key)
+                if node is not None:
+                    # the trie already resolves this level (resident
+                    # or spilled) — dedup: keep walking under the
+                    # existing id
+                    parent = node
+                    continue
+                if parent < -1:
+                    # the chain continues under a SPILLED level this
+                    # pool already held: importing a device child
+                    # under a vid parent would invert the leaf-first
+                    # spill invariant (resident child of a spilled
+                    # parent) — stop; the spilled prefix still
+                    # resolves and revives normally
+                    break
+                try:
+                    bid = alloc._pop_block()
+                except OutOfBlocks:
+                    break
+                # held live while the walk continues so a later pop's
+                # eviction cascade cannot reclaim the chain under us
+                alloc.incref(bid)
+                alloc._index[key] = bid
+                alloc._index_key[bid] = key
+                alloc._children.setdefault(parent, set()).add(bid)
+                if parent >= 0:
+                    alloc._rkids[parent] = (
+                        alloc._rkids.get(parent, 0) + 1
+                    )
+                    alloc._evictable.pop(parent, None)
+                staged.append((bid, rows))
+                fresh.append(bid)
+                parent = bid
+            if staged:
+                self._upload_rows(staged)
+        finally:
+            # settle: imported blocks park refcount-0 in the
+            # reclaimable cache (root-first, so each non-leaf has
+            # resident children and only the chain tail joins the
+            # eviction frontier) — in a finally so neither a failed
+            # upload nor a mid-walk error can leave the chain pinned
+            _settle_chain_refs(alloc, fresh)
+        added = len(staged)
+        if added:
+            self.chain_imports += 1
+            self.chain_import_tokens += added * self.block_size
+        return added, added * self.block_size
 
     def host_bytes_in_use(self):
         """True host-tier bytes: spilled blocks hold every row leaf of
@@ -1086,4 +1239,8 @@ class PagedKVPool(object):
                 self.allocator.blocks_revived * self.block_size
             ),
             "host_drops": self.allocator.host_drops,
+            # disaggregated handoff economy (serving/disagg.py)
+            "chain_exports": self.chain_exports,
+            "chain_imports": self.chain_imports,
+            "chain_import_tokens": self.chain_import_tokens,
         }

@@ -104,6 +104,23 @@ def parse_serving_args(args=None):
     # EDL_STALL_AFTER_SECS (default 10 s). Stall bundles dump to
     # $EDL_HEALTH_DIR when set.
     parser.add_argument("--stall_after_secs", type=float, default=-1.0)
+    # disaggregated serving (serving/disagg.py): the phase this
+    # replica advertises through ServerStatus.role — "prefill"
+    # replicas are kept out of the router's normal rotation and serve
+    # cache-warming handoffs only; "" resolves from EDL_SERVING_ROLE
+    # (default "unified")
+    parser.add_argument("--role", default="",
+                        choices=("", "prefill", "decode", "unified"))
+    # chunked prefill: tile size in tokens (paged pool only; long
+    # prompts prefill in tiles interleaved with decode steps instead
+    # of monopolizing a tick); -1 resolves from
+    # EDL_PREFILL_CHUNK_TOKENS, 0 = monolithic prefill
+    parser.add_argument("--prefill_chunk_tokens", type=int, default=-1)
+    # SLO-aware per-tick prefill budget in milliseconds (at least one
+    # tile always runs; the EWMA tile price decides whether the NEXT
+    # one fits); -1 resolves from EDL_PREFILL_BUDGET_MS (default 8),
+    # 0 = unbounded
+    parser.add_argument("--prefill_budget_ms", type=float, default=-1.0)
     return parser.parse_args(args)
 
 
@@ -180,6 +197,11 @@ def build_server(args):
                             else bool(args.runtime_health)),
             stall_after_secs=(None if args.stall_after_secs < 0
                               else args.stall_after_secs),
+            role=args.role or None,
+            prefill_chunk_tokens=(None if args.prefill_chunk_tokens < 0
+                                  else args.prefill_chunk_tokens),
+            prefill_budget_ms=(None if args.prefill_budget_ms < 0
+                               else args.prefill_budget_ms),
         ),
         draft=draft,
     )

@@ -108,6 +108,8 @@ except ImportError:  # pragma: no cover - py2 never happens here
 
 from elasticdl_tpu.common.fault_injection import (
     SERVING_RPCS,
+    FaultInjector,
+    InjectedRpcError,
     maybe_wrap_servicer,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
@@ -131,6 +133,7 @@ from elasticdl_tpu.observability.slo import (
 from elasticdl_tpu.observability.tracing import recorder
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.serving.admission import AdmissionError
+from elasticdl_tpu.serving.disagg import HandoffCoordinator
 from elasticdl_tpu.serving.prefix_affinity import (
     AffinityIndex,
     prefix_fingerprint,
@@ -191,7 +194,8 @@ class RouterConfig(object):
                  slo_slow_window_secs=120.0, affinity=True,
                  affinity_block_tokens=16, affinity_max_blocks=4,
                  affinity_ttl_secs=60.0, affinity_load_margin=2.0,
-                 affinity_capacity=4096, cell_id=0, cells=1):
+                 affinity_capacity=4096, cell_id=0, cells=1,
+                 disagg=True, disagg_timeout_secs=10.0):
         self.poll_secs = float(poll_secs)
         self.poll_timeout_secs = float(poll_timeout_secs)
         self.lease_secs = float(lease_secs)
@@ -224,6 +228,13 @@ class RouterConfig(object):
         self.affinity_capacity = int(affinity_capacity)
         self.cell_id = int(cell_id)
         self.cells = int(cells)
+        # disaggregated prefill/decode handoff (serving/disagg.py):
+        # with `disagg` on and a replica advertising role=prefill in
+        # rotation, a cold-prefix request is first warmed there and
+        # its chain transferred to the least-loaded decode replica;
+        # off, prefill replicas simply sit out of rotation
+        self.disagg = bool(disagg)
+        self.disagg_timeout_secs = float(disagg_timeout_secs)
 
 
 class CircuitBreaker(object):
@@ -347,6 +358,10 @@ class Replica(object):
         "queue_wait_ms": 0.0,
         "health_state": "",
         "last_progress_age_ms": 0.0,
+        # disaggregated serving phase ("" = predates roles, treated
+        # as unified): "prefill" replicas leave normal rotation and
+        # serve only cache-warming handoffs
+        "role": "",
     }
 
     #: repeated heartbeat fields (histogram BUCKETS, mergeable by
@@ -364,7 +379,7 @@ class Replica(object):
         "revive_uploads", "prefill_tokens_revived", "host_drops",
         "prefix_hit_rate_window", "queue_wait_ms", "dispatched",
         "failures", "inflight", "slow_cause_counts", "health_state",
-        "last_progress_age_ms",
+        "last_progress_age_ms", "role",
     )
 
     #: the router-derived remainder of pb.ReplicaStatus —
@@ -551,6 +566,12 @@ class Router(object):
             ttl_secs=self.config.affinity_ttl_secs,
             capacity=self.config.affinity_capacity,
         )
+        # disaggregated handoff orchestration (serving/disagg.py);
+        # the fault injector (start()) arms the disagg_handoff hook
+        self._disagg = HandoffCoordinator(
+            timeout_secs=self.config.disagg_timeout_secs
+        )
+        self._injector = None
         self._lock = threading.Lock()
         self._replicas = {}
         for addr in replica_addrs:
@@ -770,6 +791,9 @@ class Router(object):
             candidates = [
                 r for r in self._replicas.values()
                 if r.address not in exclude and r.in_rotation(now)
+                # dedicated prefill replicas serve cache-warming
+                # handoffs only — never normal decode traffic
+                and r.role != "prefill"
             ]
         candidates.sort(
             key=lambda r: (r.load_score(), -r.kv_blocks_free, r.address)
@@ -790,6 +814,94 @@ class Router(object):
             if rep.breaker.acquire(now):
                 return rep, False
         return None, False
+
+    def _acquire_prefill(self, now):
+        """Least-loaded in-rotation PREFILL replica with its breaker
+        probe slot acquired; None = no dedicated prefill pool in
+        rotation right now (the caller just dispatches cold)."""
+        with self._lock:
+            pool = [r for r in self._replicas.values()
+                    if r.in_rotation(now) and r.role == "prefill"]
+        pool.sort(key=lambda r: (r.load_score(), r.address))
+        for rep in pool:
+            if rep.breaker.acquire(now):
+                return rep
+        return None
+
+    def _decode_target(self, now):
+        """Least-loaded in-rotation decode-capable replica — the same
+        ordering _acquire_replica dispatches by, so the warmed chain
+        lands where the follow-up dispatch will go. No breaker slot is
+        held: a failed import falls back to a cold dispatch without
+        judging the target's transport."""
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.in_rotation(now) and r.role != "prefill"]
+        if not candidates:
+            return None
+        candidates.sort(
+            key=lambda r: (r.load_score(), -r.kv_blocks_free, r.address)
+        )
+        return candidates[0]
+
+    def _maybe_handoff(self, request, fp, root):
+        """Phase-split cache warming for a COLD prefix: run the prompt
+        on a dedicated prefill replica, move the finished chain to the
+        least-loaded decode replica (export -> transfer, a dense byte
+        copy), and teach affinity so the dispatch that follows seats
+        there by prefix hit. Every failure path falls back to a plain
+        cold dispatch — a handoff can cost the warm-start, never the
+        request. No-op without a fingerprint, with disagg off, with no
+        prefill pool in rotation, or when affinity already knows a
+        warm target."""
+        if fp is None or not self.config.disagg:
+            return
+        now = self._clock()
+        if self._affinity.lookup(fp, now) is not None:
+            return
+        prefill_rep = self._acquire_prefill(now)
+        if prefill_rep is None:
+            return
+        decode_rep = self._decode_target(now)
+        if decode_rep is None:
+            prefill_rep.breaker.release_probe()
+            return
+        if self._injector is not None:
+            # the disagg drill's injection point: a drop/error rule
+            # here forces the fallback path with both replicas healthy
+            try:
+                self._injector.intercept("disagg_handoff")
+            except InjectedRpcError as e:
+                prefill_rep.breaker.release_probe()
+                self.telemetry.count("disagg_fallbacks")
+                root.event("disagg_fallback", error=str(e))
+                return
+        disagg = self._disagg
+        tid = disagg.new_transfer_id()
+        prefill_rep.begin_dispatch()
+        decode_rep.begin_dispatch()
+        try:
+            payload = disagg.export_chain(prefill_rep, request, tid)
+            disagg.import_chain(decode_rep, payload)
+        except Exception as e:  # noqa: BLE001 - fallback is the policy
+            # settle the export obligation (the failure's ledger
+            # entry) and the probe slot; the request dispatches cold
+            disagg.abort_transfer(prefill_rep, tid)
+            prefill_rep.breaker.release_probe()
+            self.telemetry.count("disagg_fallbacks")
+            root.event("disagg_fallback",
+                       prefill=prefill_rep.address,
+                       decode=decode_rep.address,
+                       error=_code_name(e))
+            return
+        finally:
+            prefill_rep.end_dispatch()
+            decode_rep.end_dispatch()
+        self._on_success(prefill_rep)
+        self.telemetry.count("disagg_handoffs")
+        root.event("disagg_handoff", prefill=prefill_rep.address,
+                   decode=decode_rep.address, transfer_id=tid)
+        self._affinity.learn(fp, decode_rep.address, self._clock())
 
     # --------------------------------------------------------- dispatch
 
@@ -927,6 +1039,7 @@ class Router(object):
         root = self._root_span("router_generate", request)
         fp = self._fingerprint(request)
         t0 = self._clock()
+        self._maybe_handoff(request, fp, root)
         window_ends = t0 + self.config.redispatch_window_secs
         attempt = 0
         failed = set()  # addresses that failed THIS request
@@ -1079,6 +1192,7 @@ class Router(object):
         root = self._root_span("router_generate_stream", request)
         fp = self._fingerprint(request)
         t0 = self._clock()
+        self._maybe_handoff(request, fp, root)
         window_ends = t0 + self.config.redispatch_window_secs
         attempt = 0
         failed = set()
@@ -1269,6 +1383,8 @@ class Router(object):
             breaker_trips=snap["breaker_trips"],
             affinity_hits=snap["affinity_hits"],
             affinity_misses=snap["affinity_misses"],
+            disagg_handoffs=snap["disagg_handoffs"],
+            disagg_fallbacks=snap["disagg_fallbacks"],
             cell_id=self.config.cell_id,
             cells=self.config.cells,
             uptime_secs=snap["uptime_secs"],
@@ -1348,6 +1464,12 @@ class Router(object):
         )
         self._heartbeat.start()
         servicer = RouterServicer(self)
+        # the handoff path consults the injector directly (the
+        # disagg_handoff hook) — a transfer is router-initiated, so
+        # there is no inbound RPC for the wrapper to intercept. Same
+        # EDL_FAULT_SPEC env fallback as the servicer wrapper below.
+        injector = injector or FaultInjector.from_env()
+        self._injector = injector
         # EDL_FAULT_SPEC arms drop/error/delay/kill at the router
         # boundary under the router_* RPC names; replica-name rules
         # never fire here (and vice versa)

@@ -119,6 +119,15 @@ def parse_router_args(args=None):
                         help="max load-score excess over the least-"
                              "loaded candidate an affine target may "
                              "carry before affinity decays")
+    parser.add_argument("--disagg", type=int, default=1,
+                        help="1 = orchestrate prefill->decode chain "
+                             "handoffs when a replica advertises "
+                             "--role prefill (serving/disagg.py), "
+                             "0 = treat every replica as unified")
+    parser.add_argument("--disagg_timeout_secs", type=float,
+                        default=10.0,
+                        help="per-leg deadline for the handoff RPCs "
+                             "(prefill generate / export / import)")
     # ---- multi-cell tier (serving/router_cell.py) ----
     parser.add_argument("--cells", type=int, default=1,
                         help="> 1: supervise N router cells on ports "
@@ -187,6 +196,8 @@ def build_router(args):
         affinity_block_tokens=args.affinity_block_tokens,
         affinity_ttl_secs=args.affinity_ttl_secs,
         affinity_load_margin=args.affinity_load_margin,
+        disagg=bool(args.disagg),
+        disagg_timeout_secs=args.disagg_timeout_secs,
         cell_id=max(0, args.cell_id),
         cells=max(1, args.cells),
     )
@@ -252,6 +263,8 @@ def _cell_child_argv(args, cell_id):
         "--affinity_block_tokens", str(args.affinity_block_tokens),
         "--affinity_ttl_secs", str(args.affinity_ttl_secs),
         "--affinity_load_margin", str(args.affinity_load_margin),
+        "--disagg", str(int(args.disagg)),
+        "--disagg_timeout_secs", str(args.disagg_timeout_secs),
     ]
     for addr in args.replica:
         argv += ["--replica", addr]

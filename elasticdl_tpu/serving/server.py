@@ -24,6 +24,7 @@ serving RPC names — overload and kill drills are spec-driven, e.g.
 ``generate:error:3`` or ``generate:kill:1:skip=8``.
 """
 
+import collections
 import os
 import threading
 import time
@@ -55,7 +56,10 @@ from elasticdl_tpu.serving.engine import (
     kv_host_bytes_default,
     kv_paged_default,
     kv_shared_default,
+    prefill_budget_default,
+    prefill_chunk_default,
     profile_default,
+    role_default,
 )
 from elasticdl_tpu.observability.runtime_health import (
     RuntimeHealth,
@@ -139,7 +143,8 @@ class ServingConfig(object):
                  draft_k=0, kv_host_bytes=None, metrics_port=None,
                  profile=None, forensics=None, runtime_health=None,
                  stall_after_secs=None, health_reconcile_secs=2.0,
-                 health_dir=None):
+                 health_dir=None, role=None, prefill_chunk_tokens=None,
+                 prefill_budget_ms=None):
         self.num_slots = int(num_slots)
         self.queue_capacity = int(queue_capacity)
         self.top_k = int(top_k)
@@ -205,6 +210,31 @@ class ServingConfig(object):
         # bundle directory (None resolves from EDL_HEALTH_DIR; "" =
         # advertise-only: stalls count and self-report, no dump)
         self.health_dir = health_dir
+        # disaggregated serving (serving/disagg.py). role (None
+        # resolves from EDL_SERVING_ROLE, default "unified") is the
+        # replica's advertised phase: a router keeps "prefill"
+        # replicas out of normal rotation and targets them only for
+        # cache-warming handoffs. prefill_chunk_tokens (None resolves
+        # from EDL_PREFILL_CHUNK_TOKENS, 0 = off; paged only) splits
+        # prompt prefill into fixed-token tiles the scheduler
+        # interleaves with decode ticks; prefill_budget_ms (None
+        # resolves from EDL_PREFILL_BUDGET_MS, default 8.0, <= 0 =
+        # unbounded) caps the tile time one tick may spend while
+        # decode slots are waiting — at least one tile always runs.
+        self.role = role_default() if role is None else str(role)
+        if self.role not in ("prefill", "decode", "unified"):
+            raise ValueError(
+                "role must be prefill|decode|unified, got %r"
+                % (self.role,)
+            )
+        self.prefill_chunk_tokens = (
+            prefill_chunk_default() if prefill_chunk_tokens is None
+            else int(prefill_chunk_tokens)
+        )
+        self.prefill_budget_ms = (
+            prefill_budget_default() if prefill_budget_ms is None
+            else float(prefill_budget_ms)
+        )
 
 
 class _Scheduler(threading.Thread):
@@ -216,13 +246,27 @@ class _Scheduler(threading.Thread):
 
     def __init__(self, engine, queue, telemetry, watcher=None,
                  idle_wait_secs=0.05, clock=time.monotonic,
-                 forensics_on=True, injector=None, health=None):
+                 forensics_on=True, injector=None, health=None,
+                 prefill_budget_ms=0.0):
         super().__init__(daemon=True, name="serving-scheduler")
         self.engine = engine
         self.queue = queue
         self.telemetry = telemetry
         self.watcher = watcher
         self.idle_wait_secs = idle_wait_secs
+        # chunked prefill (paged engine only): seated-but-prefilling
+        # jobs advance one tile per visit, budgeted per tick while
+        # decode slots are waiting (engine.prefill_chunk_tokens = 0 or
+        # a dense engine keeps the monolithic insert path)
+        self._chunked = bool(getattr(engine, "prefill_chunk_tokens", 0)
+                             and hasattr(engine, "begin_insert"))
+        self.prefill_budget_ms = float(prefill_budget_ms)
+        self._pending_prefills = []
+        self._tile_ms = 0.0  # EWMA tile cost; prices the budget check
+        # scheduler-thread work submitted by gRPC handlers (chain
+        # export/import touch the jax pool, and ALL jax work belongs
+        # to this thread); submit_job blocks with a liveness bound
+        self._jobs = collections.deque()
         # runtime-health plane (RuntimeHealth or None): the loop feeds
         # its flight ring one snapshot per decode tick
         self.health = health
@@ -260,7 +304,45 @@ class _Scheduler(threading.Thread):
             self._abort_all("RESOURCE_EXHAUSTED",
                             "scheduler crashed: %r" % (e,))
 
+    def submit_job(self, fn, timeout=30.0):
+        """Run `fn` on the scheduler thread and return its result (or
+        re-raise its exception). Called from gRPC handler threads for
+        work that must serialize with the decode loop — chain export/
+        import mutate the jax pool. Liveness-bounded like _events: a
+        dead scheduler turns into a clean error, never a hang."""
+        done = threading.Event()
+        cell = {}
+
+        def job():
+            try:
+                cell["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                cell["error"] = e
+            done.set()
+
+        self._jobs.append(job)
+        self.queue.wake()
+        deadline = self._clock() + timeout
+        while not done.wait(0.05):
+            if self.crashed is not None or not self.is_alive():
+                raise AdmissionError(
+                    "RESOURCE_EXHAUSTED",
+                    "serving scheduler is not running",
+                )
+            if self._clock() > deadline:
+                raise AdmissionError(
+                    "DEADLINE_EXCEEDED", "scheduler job timed out"
+                )
+        if "error" in cell:
+            raise cell["error"]
+        return cell["result"]
+
+    def _run_jobs(self):
+        while self._jobs:
+            self._jobs.popleft()()
+
     def _iterate(self):
+        self._run_jobs()
         if self.watcher is not None:
             reloaded = self.watcher.poll()
             if reloaded is not None:
@@ -284,6 +366,7 @@ class _Scheduler(threading.Thread):
             req.push(("error", "DEADLINE_EXCEEDED",
                       "deadline expired mid-decode"))
         self._fill_slots()
+        self._advance_prefills()
         if self.engine.active_count():
             if self._injector is not None:
                 # the stall drill's injection point: a delay rule
@@ -316,8 +399,74 @@ class _Scheduler(threading.Thread):
                 self.health.record_tick(
                     len(self.queue), len(results), dt, committed
                 )
-        else:
+        elif not self._pending_prefills:
             self.queue.wait_for_work(self.idle_wait_secs)
+        # pending prefills and no decode: loop again immediately —
+        # the next tick runs another budget's worth of tiles and
+        # still polls admission between them
+
+    def _advance_prefills(self):
+        """Run pending chunked-prefill tiles, round-robin, under the
+        per-tick budget. The budget bites only while decode slots are
+        waiting (that is the latency being protected); at least one
+        tile always runs, so prefill can never starve. Tile cost is
+        priced by an EWMA of measured tile time — the same number the
+        profiler's prefill_tile phase exports when armed."""
+        budget = self.prefill_budget_ms
+        spent, ran = 0.0, 0
+        while self._pending_prefills:
+            job = self._pending_prefills[0]
+            req = job.request
+            if req.expired(self._clock()):
+                self._pending_prefills.pop(0)
+                self.engine.abort_prefill(job)
+                self.telemetry.count("expired")
+                req.trace_event("expired", where="mid-prefill",
+                                tiles=job.tiles)
+                req.finish_span("DEADLINE_EXCEEDED")
+                self._count_slow(req)
+                req.push(("error", "DEADLINE_EXCEEDED",
+                          "deadline expired mid-prefill"))
+                continue
+            if (ran and budget > 0.0 and self.engine.active_count()
+                    and spent + self._tile_ms > budget):
+                break
+            t0 = self._clock()
+            finished = self.engine.advance_prefill(job)
+            dt_ms = (self._clock() - t0) * 1000.0
+            # the tile held the scheduler: same busy clock insert()
+            # advances, so prefill_blocked_by_other attribution and
+            # the chunked A/B read one ledger
+            self.engine.prefill_busy_ms = (
+                getattr(self.engine, "prefill_busy_ms", 0.0) + dt_ms
+            )
+            spent += dt_ms
+            self._tile_ms = (
+                0.8 * self._tile_ms + 0.2 * dt_ms
+                if self._tile_ms else dt_ms
+            )
+            ran += 1
+            # rotate for fairness: concurrent prompts share the budget
+            self._pending_prefills.append(self._pending_prefills.pop(0))
+            if finished:
+                self._pending_prefills.remove(job)
+                self._first_token(job)
+
+    def _first_token(self, job):
+        """Prefill-completion bookkeeping shared by the monolithic and
+        chunked paths: TTFT record, first-token push, and terminal
+        completion for one-shot (max_new_tokens <= 1 / prefill-only)
+        requests."""
+        req = job.request
+        ttft_ms = self.telemetry.record_ttft(req)
+        req.trace_event("first_token", slot=job.slot,
+                        ttft_ms=round(ttft_ms, 3))
+        # the prefill produced this token; step() only counts the
+        # decode-loop tokens
+        self.telemetry.count("tokens_generated")
+        req.push(("tokens", [job.first], req.model_version))
+        if job.finished:
+            self._complete(req)
 
     def _complete(self, req):
         """Terminal success bookkeeping: completion counter, e2e
@@ -397,7 +546,11 @@ class _Scheduler(threading.Thread):
                             prefill_blocked_ms=round(
                                 self._blocked_ms(req), 3))
             t0 = self._clock()
-            slot, first, finished = self.engine.insert(req)
+            if self._chunked:
+                job = self.engine.begin_insert(req)
+            else:
+                job = None
+                slot, first, finished = self.engine.insert(req)
             # advance the prefill-busy clock (insert = this request's
             # prefill / suffix tile / draft prefill on this thread);
             # getattr keeps bare test/bench engines valid
@@ -405,6 +558,15 @@ class _Scheduler(threading.Thread):
                 getattr(self.engine, "prefill_busy_ms", 0.0)
                 + (self._clock() - t0) * 1000.0
             )
+            if job is not None:
+                # chunked admission: a short/fully-shared prompt
+                # completes inside begin_insert; a long one queues
+                # for tile-at-a-time advancement between decode ticks
+                if job.done():
+                    self._first_token(job)
+                else:
+                    self._pending_prefills.append(job)
+                continue
             ttft_ms = self.telemetry.record_ttft(req)
             req.trace_event("first_token", slot=slot,
                             ttft_ms=round(ttft_ms, 3))
@@ -429,7 +591,7 @@ class _Scheduler(threading.Thread):
         if not self._drain:
             self._abort_all("RESOURCE_EXHAUSTED", "server shutting down")
             return
-        while self.engine.active_count():
+        while self.engine.active_count() or self._pending_prefills:
             now = self._clock()
             for req in self.engine.evict_expired(now):
                 self.telemetry.count("expired")
@@ -438,14 +600,20 @@ class _Scheduler(threading.Thread):
                 self._count_slow(req)
                 req.push(("error", "DEADLINE_EXCEEDED",
                           "deadline expired mid-decode"))
+            # mid-prefill jobs hold real compute progress too: run
+            # their remaining tiles (budget still paced by the loop)
+            self._advance_prefills()
             if not self.engine.active_count():
-                break
+                continue
             for _slot, req, tokens, finished in self.engine.step():
                 req.push(("tokens", list(tokens), req.model_version))
                 if finished:
                     self._complete(req)
 
     def _abort_all(self, code, message):
+        # active_requests covers seated-but-prefilling jobs too (the
+        # paged engine's override); the pending list just drops
+        self._pending_prefills = []
         for req in self.engine.active_requests():
             req.finish_span(code)
             req.push(("error", code, message))
@@ -467,7 +635,8 @@ class ServingServicer(object):
 
     def __init__(self, queue, engine, telemetry, scheduler_alive,
                  handler_poll_secs=0.25, clock=time.monotonic,
-                 draining=None, health=None):
+                 draining=None, health=None, role="unified",
+                 submit_job=None):
         self._queue = queue
         self._engine = engine
         self._telemetry = telemetry
@@ -480,6 +649,17 @@ class ServingServicer(object):
         # gRPC threads, deliberately NOT the scheduler, so a wedged
         # scheduler can still confess
         self._health = health
+        # disaggregated serving: the advertised phase role, and the
+        # scheduler-thread executor for chain export/import (jax work
+        # may not run on gRPC threads; None = run inline, which only
+        # bare single-threaded tests use)
+        self._role = role
+        self._submit_job = submit_job or (lambda fn, timeout=30.0: fn())
+        # transfer-family RPCs currently executing here; 0 after a
+        # drain is the kill-drill's clean-handoff-ledger assertion
+        self._transfers_inflight = 0
+        self._transfer_aborts = 0
+        self._transfers_lock = threading.Lock()
 
     # ------------------------------------------------------------- RPCs
 
@@ -506,9 +686,100 @@ class ServingServicer(object):
 
         return stream()
 
+    def export_chain(self, request, context=None):
+        """Disaggregated handoff, exporter side: gather the prompt's
+        resident chain (int8 rows + scale leaves, the same tree-
+        generic gather the host spill tier reads through) into a dense
+        TransferChainRequest the decode side imports verbatim. Holds
+        NO references — exported chains park refcount-0 cached, so a
+        crash mid-transfer leaks nothing (abort_transfer is the
+        coordinator's accounting obligation, not a resource release)."""
+        from elasticdl_tpu.serving import disagg
+
+        kv = getattr(self._engine, "kv", None)
+        alloc = getattr(kv, "allocator", None)
+        if alloc is None or not alloc.share_prefix:
+            self._fail(context, "FAILED_PRECONDITION",
+                       "chain export needs the shared paged pool")
+        prompt = list(request.prompt)
+        with self._transfers_lock:
+            self._transfers_inflight += 1
+        try:
+            chain, dtypes = self._submit_job(
+                lambda: (kv.export_chain(prompt), kv.leaf_dtypes())
+            )
+            if not chain:
+                self._fail(context, "NOT_FOUND",
+                           "no resident chain for prompt")
+            return disagg.chain_to_proto(
+                chain, kv.block_size, dtypes, request.transfer_id
+            )
+        finally:
+            with self._transfers_lock:
+                self._transfers_inflight -= 1
+
+    def transfer_chain(self, request, context=None):
+        """Disaggregated handoff, importer side: one batched upload of
+        the payload's blocks into fresh pool blocks, re-keyed into the
+        content-addressed trie — the next generate with this prompt
+        seats by prefix hit, exactly as if the chain were computed
+        here. The response reports the chain's RESOLVED coverage on
+        this pool (imported + already-resident levels): a fully
+        deduped import is a success — the chain is warm either way —
+        so blocks=0 means only that nothing of the chain landed
+        (pool exhausted). Layout mismatches come back ok=False (the
+        coordinator falls back to a plain dispatch), not as an RPC
+        failure."""
+        from elasticdl_tpu.serving import disagg
+
+        kv = getattr(self._engine, "kv", None)
+        alloc = getattr(kv, "allocator", None)
+        if alloc is None or not alloc.share_prefix:
+            self._fail(context, "FAILED_PRECONDITION",
+                       "chain import needs the shared paged pool")
+        with self._transfers_lock:
+            self._transfers_inflight += 1
+        try:
+            blocks, dtypes = disagg.proto_to_blocks(request, kv)
+
+            def _import_and_resolve():
+                kv.import_chain(blocks, leaf_dtypes=dtypes)
+                flat = [t for toks, _ in blocks for t in toks]
+                return len(kv.allocator.match_prefix(flat))
+
+            resolved = self._submit_job(_import_and_resolve)
+            return pb.TransferChainResponse(
+                transfer_id=request.transfer_id, ok=True,
+                blocks=resolved, tokens=resolved * kv.block_size,
+            )
+        except AdmissionError:
+            raise
+        except ValueError as e:
+            return pb.TransferChainResponse(
+                transfer_id=request.transfer_id, ok=False,
+                error=str(e),
+            )
+        finally:
+            with self._transfers_lock:
+                self._transfers_inflight -= 1
+
+    def abort_transfer(self, request, context=None):
+        """Close a failed handoff's obligation (EDL501 pairs every
+        export_chain with import_chain or this). Structurally there is
+        nothing to release — exports hold no references — so this is
+        the failure's accounting record."""
+        with self._transfers_lock:
+            self._transfer_aborts += 1
+        return pb.TransferChainResponse(
+            transfer_id=request.transfer_id, ok=True
+        )
+
     def server_status(self, request, context=None):
         snap = self._telemetry.snapshot()
         kv = self._engine.kv_stats()
+        with self._transfers_lock:
+            transfer_aborts = self._transfer_aborts
+            transfers_inflight = self._transfers_inflight
         return pb.ServerStatusResponse(
             queue_depth=len(self._queue),
             active_slots=self._engine.active_count(),
@@ -566,6 +837,16 @@ class ServingServicer(object):
             # terminally-slow requests by dominant attributed cause,
             # aligned with ServingTelemetry.SLOW_CAUSES declared order
             slow_cause_counts=snap["slow_cause_counts"],
+            # disaggregated serving: the advertised phase role plus
+            # the handoff ledger (pool-side chain counters, the
+            # transfer RPCs executing right now, and closed-out
+            # failures) — .get so bare/dense engines stay valid
+            role=self._role,
+            chain_exports=kv.get("chain_exports", 0),
+            chain_imports=kv.get("chain_imports", 0),
+            chain_import_tokens=kv.get("chain_import_tokens", 0),
+            transfer_aborts=transfer_aborts,
+            transfers_inflight=transfers_inflight,
             # runtime health self-report (observability/
             # runtime_health.py); all-zero/"" with the plane off —
             # the wire signal routers/autoscalers key the fallback on
@@ -599,6 +880,7 @@ class ServingServicer(object):
             deadline_ms=proto_req.deadline_ms,
             trace_id=getattr(proto_req, "trace_id", ""),
             parent_span_id=getattr(proto_req, "parent_span_id", ""),
+            prefill_only=getattr(proto_req, "prefill_only", False),
         )
         # the serve span: parented under the caller's dispatch span
         # when the RPC carried trace context (router/traced client),
@@ -696,6 +978,7 @@ class GenerationServer(object):
                 share_prefix=cfg.kv_shared,
                 draft=draft, draft_k=cfg.draft_k,
                 host_bytes=cfg.kv_host_bytes,
+                prefill_chunk_tokens=cfg.prefill_chunk_tokens,
             )
         else:
             if draft is not None and cfg.draft_k:
@@ -766,6 +1049,7 @@ class GenerationServer(object):
             idle_wait_secs=cfg.idle_wait_secs,
             forensics_on=cfg.forensics,
             injector=self._injector, health=self.health,
+            prefill_budget_ms=cfg.prefill_budget_ms,
         )
         servicer = ServingServicer(
             self.queue, self.engine, self.telemetry,
@@ -773,6 +1057,8 @@ class GenerationServer(object):
             handler_poll_secs=cfg.handler_poll_secs,
             draining=self.scheduler.is_draining,
             health=self.health,
+            role=cfg.role,
+            submit_job=self.scheduler.submit_job,
         )
         # the unwrapped servicer: in-process warmup (serving/main.py
         # --warmup_tokens) goes through it so a warmup request can

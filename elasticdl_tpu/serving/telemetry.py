@@ -550,7 +550,11 @@ class RouterTelemetry(object):
 
     COUNTERS = ("routed", "completed", "redispatched", "hedges",
                 "hedge_wins", "shed", "breaker_trips", "errors",
-                "affinity_hits", "affinity_misses")
+                "affinity_hits", "affinity_misses",
+                # disaggregated prefill->decode handoffs (serving/
+                # disagg.py): a fallback means the request dispatched
+                # cold, not that it failed
+                "disagg_handoffs", "disagg_fallbacks")
     GAUGES = ("healthy_replicas", "replicas", "cell_id", "cells")
 
     def __init__(self, log_dir=None, flush_every=20, clock=time.monotonic,

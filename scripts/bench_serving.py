@@ -163,6 +163,14 @@ def parse_args(argv=None):
     # prefill) and once on (evicted chains revive by upload), at
     # equal DEVICE KV bytes — the "host_vs_evict" ratio block
     p.add_argument("--kv_host_blocks", type=int, default=0)
+    # the disaggregation A/B (serving/disagg.py): the same open-loop
+    # plan of long COLD prompts through a real two-replica in-process
+    # fleet behind the Router, three ways at EQUAL FLEET KV BYTES —
+    # monolithic prefill, chunked prefill, and chunked + phase-split
+    # (dedicated prefill replica handing chains to the decode replica
+    # over TransferChain) — each leg with its own slowest-TTFT-decile
+    # cause breakdown (the "disagg_ab" record block)
+    p.add_argument("--disagg", action="store_true")
     return p.parse_args(argv)
 
 
@@ -1032,6 +1040,280 @@ def run_affinity_ab(args):
     }
 
 
+def run_disagg_ab(args):
+    """The disaggregation A/B at EQUAL FLEET KV BYTES: one open-loop
+    plan of long COLD prompts (every prompt unique — every prefill is
+    paid inside the window) through a real two-replica in-process
+    fleet behind the Router, three ways:
+
+      monolithic      two unified replicas, chunking OFF — a 224-token
+                      prefill monopolizes its scheduler tick, and
+                      requests admitted meanwhile wait it out
+                      (prefill_blocked_by_other)
+      chunked         same fleet, prefill tiled (PRESS_BLOCK_SIZE
+                      tokens per tile) under the per-tick budget —
+                      decode steps and other admissions interleave
+                      between tiles
+      chunked_disagg  chunked + phase-split: replica 0 re-roles as a
+                      dedicated PREFILL replica (out of rotation), the
+                      router runs every cold prompt through a
+                      prefill->TransferChain handoff, and the decode
+                      replica seats the imported chain by prefix hit —
+                      its scheduler never runs a cold prompt's prefill
+
+    Every leg fires the SAME plan and holds the same fleet KV bytes
+    (2 pools x num_blocks x block_bytes). Per leg, tail_report runs
+    the slowest-TTFT-decile forensics — the headline is the
+    prefill_blocked_by_other share of the tail breakdown, which
+    chunking must REDUCE vs monolithic at goodput >= 0.95x."""
+    import numpy as np
+
+    from elasticdl_tpu.observability.tracing import new_trace_id
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import ServingStub, build_channel
+    from elasticdl_tpu.serving import GenerationServer, ServingConfig
+    from elasticdl_tpu.serving.router import (
+        Router,
+        RouterConfig,
+        RouterError,
+    )
+
+    trainer, state, _ = build_rig(args, model_params=PRESS_MODEL_PARAMS)
+    vocab = int(trainer.model.vocab_size)
+    bs = PRESS_BLOCK_SIZE
+    o_lo, o_hi = _span(args.out_len)
+    s_lo, s_hi = _span(args.suffix_len)
+    prompt_len = (PRESS_PREFIX_LEN // bs) * bs  # full blocks
+    # 64-token tiles: big enough that per-tile dispatch overhead stays
+    # noise on the CPU rig (4 tiles per prompt), small enough that a
+    # cold prompt's monopolization window shrinks 4x
+    chunk_tokens = 4 * bs
+    # BURSTY arrivals — the contention is structural, not Poisson
+    # luck: each burst lands burst_size cold prompts on 2 replicas at
+    # once, so at least two share a replica and the later one's
+    # admission waits out the earlier one's prefill (monolithic) or
+    # only its current tile (chunked). Bursts are spaced so the fleet
+    # drains between them — the A/B measures scheduling, not
+    # saturation.
+    bursts, burst_size, burst_gap = 8, 4, 1.2
+    requests = bursts * burst_size
+    rate = burst_size / burst_gap
+    seat_blocks = -(-(prompt_len + s_hi + o_hi - 1) // bs)
+    # pools hold EVERY chain the window creates (plus warmup and
+    # seats): eviction must never clip a chain between its register
+    # and its export, or between its import and its seat — a clipped
+    # chain re-prefills an odd-length suffix whose tile bucket would
+    # COMPILE inside the measurement window and swamp the tail with
+    # compile stalls instead of scheduling
+    num_blocks = (requests + 3) * seat_blocks
+    rs = np.random.RandomState(args.seed + 43)
+    plan = []
+    for i in range(requests):
+        suffix = rs.randint(0, vocab,
+                            size=rs.randint(s_lo, s_hi + 1))
+        plan.append({
+            "prompt": np.concatenate([
+                rs.randint(0, vocab, size=prompt_len), suffix,
+            ]),
+            "new": int(rs.randint(o_lo, o_hi + 1)),
+            "gap": (burst_gap if i and i % burst_size == 0 else 0.0),
+            "seed": int(i),
+        })
+
+    def run_leg(chunk_tokens, disagg):
+        servers, router = [], None
+        roles = ("prefill", "decode") if disagg else (None, None)
+        try:
+            for role in roles:
+                srv = GenerationServer(
+                    trainer, state,
+                    ServingConfig(
+                        num_slots=2, queue_capacity=32,
+                        kv_paged=True, kv_block_size=bs,
+                        kv_num_blocks=num_blocks, kv_shared=True,
+                        role=role,
+                        prefill_chunk_tokens=chunk_tokens,
+                    ),
+                ).start()
+                servers.append(srv)
+            warm_prompt = [0] * prompt_len + [1, 2]
+            for srv in servers:
+                # pay each replica's compiles outside the measurement
+                # window: the full prefill (or its tiles) + decode
+                # step first, then a same-prefix request whose short
+                # suffix compiles the prefix-hit tile — the path every
+                # imported chain's request runs on the decode side
+                stub = ServingStub(
+                    build_channel("localhost:%d" % srv.port)
+                )
+                stub.generate(
+                    pb.GenerateRequest(prompt=warm_prompt,
+                                       max_new_tokens=4),
+                    timeout=600,
+                )
+                stub.generate(
+                    pb.GenerateRequest(
+                        prompt=[0] * prompt_len + [3],
+                        max_new_tokens=4,
+                    ),
+                    timeout=600,
+                )
+                srv.mark_steady()
+            router = Router(
+                ["localhost:%d" % s.port for s in servers],
+                config=RouterConfig(
+                    poll_secs=0.2, lease_secs=2.0,
+                    affinity=True, affinity_block_tokens=bs,
+                    affinity_load_margin=8.0, disagg=disagg,
+                ),
+            )
+            router.start(grpc_server=False)
+            want = 1 if disagg else 2
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                st = router.status_response()
+                roles_seen = sum(
+                    1 for r in router.replicas() if r.role
+                )
+                if st.healthy >= want and (
+                    not disagg or roles_seen >= 2
+                ):
+                    break
+                time.sleep(0.1)
+
+            rows = []
+            lock = threading.Lock()
+
+            def one(spec):
+                t0 = time.monotonic()
+                trace_id = new_trace_id()
+                row = {"status": "OK", "ttft_ms": None, "phase": 0,
+                       "tokens": 0, "trace_id": trace_id,
+                       "spec": spec}
+                try:
+                    for chunk in router.dispatch_stream(
+                        pb.GenerateRequest(
+                            prompt=[int(t) for t in spec["prompt"]],
+                            max_new_tokens=spec["new"],
+                            temperature=args.temperature,
+                            seed=spec["seed"],
+                            trace_id=trace_id,
+                        )
+                    ):
+                        if row["ttft_ms"] is None and chunk.tokens:
+                            row["ttft_ms"] = (
+                                (time.monotonic() - t0) * 1000.0
+                            )
+                        row["tokens"] += len(chunk.tokens)
+                except RouterError as e:
+                    row["status"] = e.code
+                row["latency_ms"] = (time.monotonic() - t0) * 1000.0
+                with lock:
+                    rows.append(row)
+
+            threads = []
+            t_start = time.monotonic()
+            for spec in plan:
+                time.sleep(spec["gap"])
+                t = threading.Thread(target=one, args=(spec,))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.monotonic() - t_start
+
+            ok = [r for r in rows if r["status"] == "OK"]
+            snap = router.telemetry.snapshot()
+            pools = [s.engine.kv_stats() for s in servers]
+            tail = tail_report(rows, [(rate, wall)])
+            return {
+                "chunk_tokens": chunk_tokens,
+                "disagg": disagg,
+                "completed": len(ok),
+                "goodput_rps": round(len(ok) / wall, 3),
+                "tokens_per_sec": round(
+                    sum(r["tokens"] for r in ok) / wall, 3
+                ),
+                "ttft_ms": percentiles(
+                    [r["ttft_ms"] for r in ok
+                     if r["ttft_ms"] is not None], (50, 90, 99)
+                ) or {},
+                "fleet_kv_bytes": sum(
+                    p["kv_bytes_total"] for p in pools
+                ),
+                "disagg_handoffs": snap.get("disagg_handoffs", 0),
+                "disagg_fallbacks": snap.get("disagg_fallbacks", 0),
+                "chain_exports": sum(
+                    p.get("chain_exports", 0) for p in pools
+                ),
+                "chain_imports": sum(
+                    p.get("chain_imports", 0) for p in pools
+                ),
+                # the two-pool post-drain ledger (drill-grade)
+                "pools_clean": all(
+                    p["kv_blocks_free"] == p["kv_blocks_total"]
+                    for p in pools
+                ),
+                "tail_report": tail,
+                "tail_blocked_share": tail["breakdown_share"][
+                    "prefill_blocked_by_other"
+                ],
+                "tail_blocked_ms": tail["breakdown_ms"][
+                    "prefill_blocked_by_other"
+                ],
+            }
+        finally:
+            if router is not None:
+                router.stop()
+            for srv in servers:
+                srv.stop()
+
+    mono = run_leg(0, False)
+    chunked = run_leg(chunk_tokens, False)
+    split = run_leg(chunk_tokens, True)
+    mono_good = mono["goodput_rps"] or 1e-9
+    return {
+        "model_params": PRESS_MODEL_PARAMS,
+        "block_size": bs,
+        "prompt_len": prompt_len,
+        "requests": requests,
+        "rate_rps": rate,
+        "replicas": 2,
+        "equal_fleet_kv_bytes": (
+            mono["fleet_kv_bytes"] == chunked["fleet_kv_bytes"]
+            == split["fleet_kv_bytes"]
+        ),
+        # the headline: what share of the slowest-TTFT-decile wall is
+        # sitting behind ANOTHER request's prefill, per leg
+        "tail_blocked_share": [mono["tail_blocked_share"],
+                               chunked["tail_blocked_share"],
+                               split["tail_blocked_share"]],
+        "tail_blocked_ms": [mono["tail_blocked_ms"],
+                            chunked["tail_blocked_ms"],
+                            split["tail_blocked_ms"]],
+        "blocked_reduced_chunked_vs_mono": (
+            chunked["tail_blocked_ms"] < mono["tail_blocked_ms"]
+        ),
+        "goodput_rps": [mono["goodput_rps"], chunked["goodput_rps"],
+                        split["goodput_rps"]],
+        "chunked_goodput_ratio": round(
+            (chunked["goodput_rps"] or 0.0) / mono_good, 3
+        ),
+        "disagg_goodput_ratio": round(
+            (split["goodput_rps"] or 0.0) / mono_good, 3
+        ),
+        "ttft_ms": [mono["ttft_ms"], chunked["ttft_ms"],
+                    split["ttft_ms"]],
+        "disagg_handoffs": split["disagg_handoffs"],
+        "disagg_fallbacks": split["disagg_fallbacks"],
+        "pools_clean": [mono["pools_clean"], chunked["pools_clean"],
+                        split["pools_clean"]],
+        "monolithic": mono,
+        "chunked": chunked,
+        "chunked_disagg": split,
+    }
+
+
 #: the enabled metrics+profiler plane may cost at most this fraction
 #: of the disabled plane's tokens/sec (the PR 6 tracing bound, kept)
 OVERHEAD_BOUND = 0.05
@@ -1138,6 +1420,12 @@ def run_bench(args):
             args.paged_slots or 2 * args.num_slots, dense_blocks,
             draft,
         )
+    if args.disagg:
+        # the disaggregation A/B: monolithic vs chunked prefill vs
+        # chunked + phase-split fleet at equal fleet KV bytes, with
+        # the slowest-TTFT-decile cause breakdown per leg — its own
+        # long-prompt rig, so it runs with or without --compare_paged
+        record["disagg_ab"] = run_disagg_ab(args)
     if not args.compare_paged:
         return record
 

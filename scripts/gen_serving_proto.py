@@ -55,6 +55,12 @@ SERVING_MESSAGES = {
         # Empty = untraced sender; the receiver mints a fresh trace.
         ("trace_id", 6, T.TYPE_STRING, _OPT),
         ("parent_span_id", 7, T.TYPE_STRING, _OPT),
+        # disaggregated serving (serving/disagg.py): run the prompt to
+        # completion as cache-warming only — the chain is seated,
+        # registered in the prefix trie and released for export; the
+        # single sampled token is NOT the answer (the decode replica
+        # re-derives it token-exactly from the shared chain)
+        ("prefill_only", 8, T.TYPE_BOOL, _OPT),
     ],
     "GenerateResponse": [
         ("tokens", 1, T.TYPE_INT32, _REP),
@@ -175,6 +181,63 @@ SERVING_MESSAGES = {
         # drift since the steady baseline (ledger vs live buffers) —
         # a leak detector, monotone by construction
         ("memory_unaccounted_bytes", 51, T.TYPE_INT64, _OPT),
+        # disaggregated prefill/decode (serving/disagg.py): the
+        # replica's advertised phase role — "prefill" | "decode" |
+        # "unified" ("" = pre-disagg replica, treated as unified) —
+        # and the KV chain-transfer economy: chains exported to /
+        # imported from sibling replicas, prompt tokens those imports
+        # seated without re-prefill, transfers dropped via
+        # abort_transfer, and exports currently awaiting their
+        # import/abort resolution (0 after drain = clean handoff
+        # ledger, the kill-drill's post-drain assertion)
+        ("role", 52, T.TYPE_STRING, _OPT),
+        ("chain_exports", 53, T.TYPE_INT64, _OPT),
+        ("chain_imports", 54, T.TYPE_INT64, _OPT),
+        ("chain_import_tokens", 55, T.TYPE_INT64, _OPT),
+        ("transfer_aborts", 56, T.TYPE_INT64, _OPT),
+        ("transfers_inflight", 57, T.TYPE_INT32, _OPT),
+    ],
+    # ---- disaggregated prefill/decode handoff (serving/disagg.py) ----
+    # One finished prefix chain exported as a dense byte copy: the
+    # same tree-generic kv_row_leaf gather the host spill tier uses,
+    # one KvChainBlock per trie block in root-first chain order. The
+    # decode side imports the blocks into freshly allocated device
+    # blocks and re-keys them into its content-addressed trie, so
+    # prefix sharing and speculative decode compose unchanged.
+    "ExportChainRequest": [
+        ("prompt", 1, T.TYPE_INT32, _REP),
+        # coordinator-minted id correlating export -> import|abort
+        ("transfer_id", 2, T.TYPE_STRING, _OPT),
+    ],
+    "KvChainBlock": [
+        # the block's token ids (a full kv_block_size run of the
+        # prompt) — with the parent chain implied by list order this
+        # re-derives the (parent, tokens) trie key on the importer
+        ("tokens", 1, T.TYPE_INT32, _REP),
+        # raw row bytes, one entry per 4-d kv_row_leaf in
+        # jax.tree.leaves order (int8 rows + f32 scale leaves travel
+        # as siblings, exactly like the host spill tier)
+        ("leaves", 2, T.TYPE_BYTES, _REP),
+    ],
+    "TransferChainRequest": [
+        ("transfer_id", 1, T.TYPE_STRING, _OPT),
+        ("block_size", 2, T.TYPE_INT32, _OPT),
+        # leaf dtype names in the same order as KvChainBlock.leaves —
+        # the importer refuses a chain whose arena format differs
+        ("leaf_dtypes", 3, T.TYPE_STRING, _REP),
+        ("blocks", 4, T.TYPE_MESSAGE, _REP, ".elasticdl_tpu.KvChainBlock"),
+    ],
+    "TransferChainResponse": [
+        ("transfer_id", 1, T.TYPE_STRING, _OPT),
+        ("ok", 2, T.TYPE_BOOL, _OPT),
+        # blocks/tokens actually uploaded (deduped against blocks the
+        # importer's trie already held)
+        ("blocks", 3, T.TYPE_INT32, _OPT),
+        ("tokens", 4, T.TYPE_INT32, _OPT),
+        ("error", 5, T.TYPE_STRING, _OPT),
+    ],
+    "AbortTransferRequest": [
+        ("transfer_id", 1, T.TYPE_STRING, _OPT),
     ],
     # ---- router tier (serving/router.py) ----
     "RouterStatusRequest": [],
@@ -267,6 +330,10 @@ SERVING_MESSAGES = {
         # hit-rate above, the warm-capacity ladder affinity ranks by
         ("kv_blocks_cached", 23, T.TYPE_INT32, _OPT),
         ("kv_blocks_shared", 24, T.TYPE_INT32, _OPT),
+        # advertised phase role, passed through from ServerStatus:
+        # "prefill" replicas leave the normal dispatch rotation and
+        # serve only cache-warming prefills + chain exports
+        ("role", 25, T.TYPE_STRING, _OPT),
     ],
     "RouterStatusResponse": [
         ("replicas", 1, T.TYPE_INT32, _OPT),
@@ -323,6 +390,13 @@ SERVING_MESSAGES = {
         ("journal_events", 32, T.TYPE_INT64, _OPT),
         ("journal_replayed", 33, T.TYPE_INT64, _OPT),
         ("cell_restarts", 34, T.TYPE_INT64, _OPT),
+        # disaggregated dispatch (serving/disagg.py): requests whose
+        # prefill ran on a dedicated prefill replica with the chain
+        # handed to the decode target, and handoffs that failed
+        # mid-transfer and fell back to the unified path (the decode
+        # replica paid prefill itself — degraded, never lost)
+        ("disagg_handoffs", 35, T.TYPE_INT64, _OPT),
+        ("disagg_fallbacks", 36, T.TYPE_INT64, _OPT),
     ],
 }
 
@@ -346,6 +420,16 @@ SERVICES = {
         ("generate", "GenerateRequest", "GenerateResponse", False),
         ("generate_stream", "GenerateRequest", "TokenChunk", True),
         ("server_status", "ServerStatusRequest", "ServerStatusResponse",
+         False),
+        # disaggregated handoff surface: export a finished chain as a
+        # dense byte copy (the response IS the transfer payload),
+        # import one on the decode side, or abandon an export whose
+        # import failed so the exporter's inflight ledger settles
+        ("export_chain", "ExportChainRequest", "TransferChainRequest",
+         False),
+        ("transfer_chain", "TransferChainRequest", "TransferChainResponse",
+         False),
+        ("abort_transfer", "AbortTransferRequest", "TransferChainResponse",
          False),
     ],
     # the multi-replica routing tier in front of N Serving replicas;

@@ -45,6 +45,17 @@ Phase 4 — tiered host spill (paged mode, --kv_host_bytes): three
   memory across restarts), serve the same load, revive again, and
   drain to a clean two-tier ledger.
 
+Phase 5 — disaggregated handoff (paged+shared, serving/disagg.py): a
+  role-split fleet (one prefill replica, one decode replica, a router
+  orchestrating the chain handoff between them) first proves the
+  success path — handoffs counted, chains exported/imported, both
+  pool ledgers drain clean with zero transfers in flight — then a
+  fresh prefill replica armed with EDL_FAULT_SPEC=export_chain:kill:1
+  SIGKILLs itself WITH A TRANSFER IN FLIGHT: every accepted request
+  must still complete (the router falls back to a cold dispatch; a
+  handoff may cost the warm-start, never the request) and the
+  surviving decode pool must drain to a clean ledger.
+
 All phases run TWICE: against the dense KV pool and against the
 block-paged pool (EDL_KV_PAGED=1, serving/kv_pool.py) — drain and
 SIGKILL semantics must hold regardless of where the cache rows live
@@ -432,6 +443,183 @@ def phase_host_tier(mode_env=None, mode="paged", model_params=None):
     print("[drill] phase 4 (%s) OK" % mode)
 
 
+# two distinct 2-block system prompts for the disagg phase (one per
+# leg, so the kill leg's handoff is never satisfied by leg 1's
+# already-imported chain)
+DISAGG_PREFIXES = [
+    [1, 2, 3, 4, 5, 6, 7, 2],
+    [4, 5, 6, 7, 1, 2, 3, 5],
+]
+
+
+def _start_disagg_router(replica_ports):
+    """Router subprocess over the two-pool fleet; affinity blocks
+    sized to the drill's 8-token system prompts so requests carry a
+    fingerprint (no fingerprint = no handoff to drill)."""
+    cmd = [
+        sys.executable, "-m", "elasticdl_tpu.serving.router_main",
+        "--port", "0", "--poll_secs", "0.25", "--lease_secs", "2.0",
+        "--breaker_cooldown_secs", "1.0",
+        "--redispatch_window_secs", "60",
+        "--affinity_block_tokens", "8",
+    ]
+    for p in replica_ports:
+        cmd += ["--replica", "localhost:%d" % p]
+    return launch_ready(cmd, ready_marker="ROUTER_READY")
+
+
+def _fire_routed(router_port, n, prefix, max_new=8):
+    """n concurrent requests through the ROUTER (RouterStub), all
+    sharing `prefix` + a per-request tail; same hang-bounded join
+    contract as fire_requests."""
+    import grpc
+
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import RouterStub, build_channel
+
+    stub = RouterStub(build_channel("localhost:%d" % router_port))
+    outcomes = {}
+    lock = threading.Lock()
+
+    def call(i):
+        try:
+            stub.router_generate(
+                pb.GenerateRequest(
+                    prompt=prefix + [1 + i % 5],
+                    max_new_tokens=max_new,
+                ),
+                timeout=CLIENT_TIMEOUT,
+            )
+            code = "OK"
+        except grpc.RpcError as e:
+            code = e.code().name
+        with lock:
+            outcomes[i] = code
+
+    threads = [
+        threading.Thread(target=call, args=(i,)) for i in range(n)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    return threads, outcomes, t0
+
+
+def _router_status(port):
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import RouterStub, build_channel
+
+    stub = RouterStub(build_channel("localhost:%d" % port))
+    return stub.router_status(pb.RouterStatusRequest(), timeout=30)
+
+
+def _assert_pool_settled(st, where):
+    """A disagg pool's post-drain ledger: every block free|cached AND
+    no transfer-family RPC still executing — a stuck inflight gauge
+    would mean a handoff the two-pool ledger cannot reconcile."""
+    _assert_clean_ledger(st, where)
+    assert st.transfers_inflight == 0, (
+        "%s: %d transfers still in flight after drain"
+        % (where, st.transfers_inflight)
+    )
+
+
+def phase_disagg_handoff():
+    """Phase 5 — disaggregated prefill/decode handoff (paged+shared):
+    a dedicated prefill replica warms chains and hands them to the
+    decode replica as a dense byte copy (router-orchestrated,
+    serving/disagg.py). Leg 1 proves the success path end to end:
+    requests complete through the router with the handoff ledger
+    moving on BOTH pools and both ledgers draining clean. Leg 2 arms
+    EDL_FAULT_SPEC=export_chain:kill:1 on a fresh prefill replica, so
+    the replica SIGKILLs itself WITH THE TRANSFER IN FLIGHT — the
+    router must fall back to a plain cold dispatch (zero accepted-
+    request loss) and the surviving decode pool must still drain to a
+    clean ledger with nothing in flight."""
+    print("[drill] phase 5 (disagg): prefill->decode handoff, then "
+          "SIGKILL the prefill replica mid-transfer")
+    env = {"EDL_KV_PAGED": "1", "EDL_KV_SHARED": "1"}
+    decode, decode_port = start_server(
+        extra_env=env, num_slots=3,
+        extra_args=("--role", "decode", "--queue_capacity", "16"),
+    )
+    prefill = prefill2 = router = router2 = None
+    try:
+        # ---- leg 1: the handoff succeeds
+        prefill, prefill_port = start_server(
+            extra_env=env, num_slots=2,
+            extra_args=("--role", "prefill"),
+        )
+        router, router_port = _start_disagg_router(
+            [prefill_port, decode_port]
+        )
+        threads, outcomes, t0 = _fire_routed(
+            router_port, 4, DISAGG_PREFIXES[0]
+        )
+        join_all(threads, outcomes, t0, 4)
+        assert set(outcomes.values()) == {"OK"}, outcomes
+        rst = _router_status(router_port)
+        assert rst.disagg_handoffs >= 1, (
+            "no handoff happened: handoffs=%d fallbacks=%d"
+            % (rst.disagg_handoffs, rst.disagg_fallbacks)
+        )
+        pst = _ledger(prefill_port)
+        dst = _ledger(decode_port)
+        assert pst.role == "prefill" and dst.role == "decode"
+        assert pst.chain_exports >= 1, "prefill pool exported nothing"
+        assert dst.chain_imports >= 1, "decode pool imported nothing"
+        assert dst.chain_import_tokens >= 8
+        _assert_pool_settled(pst, "leg-1 prefill pool")
+        _assert_pool_settled(dst, "leg-1 decode pool")
+        print("[drill]   leg 1: handoffs=%d exports=%d imports=%d "
+              "(%d tokens)" % (rst.disagg_handoffs, pst.chain_exports,
+                               dst.chain_imports,
+                               dst.chain_import_tokens))
+        router.send_signal(signal.SIGTERM)
+        router.wait(timeout=60)
+        prefill.send_signal(signal.SIGTERM)
+        prefill.wait(timeout=60)
+        # ---- leg 2: the prefill replica dies mid-transfer
+        kill_env = dict(env)
+        kill_env["EDL_FAULT_SPEC"] = "export_chain:kill:1"
+        prefill2, prefill2_port = start_server(
+            extra_env=kill_env, num_slots=2,
+            extra_args=("--role", "prefill"),
+        )
+        router2, router2_port = _start_disagg_router(
+            [prefill2_port, decode_port]
+        )
+        threads, outcomes, t0 = _fire_routed(
+            router2_port, 4, DISAGG_PREFIXES[1]
+        )
+        join_all(threads, outcomes, t0, 4)
+        # the client-visible invariant: a handoff can cost the warm
+        # start, NEVER the request — every accepted request completes
+        assert set(outcomes.values()) == {"OK"}, (
+            "accepted requests lost to a mid-transfer kill: %s"
+            % outcomes
+        )
+        prefill2.wait(timeout=30)
+        assert prefill2.returncode != 0  # SIGKILL, by design
+        rst2 = _router_status(router2_port)
+        assert rst2.disagg_fallbacks >= 1, (
+            "the kill never interrupted a transfer: handoffs=%d "
+            "fallbacks=%d" % (rst2.disagg_handoffs,
+                              rst2.disagg_fallbacks)
+        )
+        dst2 = _ledger(decode_port)
+        _assert_pool_settled(dst2, "leg-2 decode pool")
+        print("[drill]   leg 2: fallbacks=%d, all %d requests OK, "
+              "decode ledger clean" % (rst2.disagg_fallbacks,
+                                       len(outcomes)))
+    finally:
+        for proc in (router, router2, prefill, prefill2, decode):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    print("[drill] phase 5 (disagg) OK")
+
+
 def main():
     # dense pool, then the block-paged pool (kv_block_size 4 divides
     # the drill model's seq_len=32; sharing needs full blocks)
@@ -455,8 +643,13 @@ def main():
                         mode="paged_int8", model_params=int8_params)
     phase_host_tier(mode_env={"EDL_KV_PAGED": "1"},
                     mode="paged_int8", model_params=int8_params)
+    # disaggregated prefill/decode: clean handoff, then a SIGKILL'd
+    # prefill replica mid-transfer (paged+shared only — the handoff
+    # surface exists only over the prefix-shared paged pool)
+    phase_disagg_handoff()
     print("[drill] serving kill drill PASSED (dense + paged + "
-          "paged-int8, shared-prefix ledger, host-tier spill/revive)")
+          "paged-int8, shared-prefix ledger, host-tier spill/revive, "
+          "disagg handoff)")
     return 0
 
 

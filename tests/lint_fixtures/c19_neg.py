@@ -1,0 +1,37 @@
+"""C19 negative fixture — the handoff transfer obligation settled on
+every path: import_chain on the happy path, abort_transfer on the
+not-ready branch and on the exception path, so EDL501 must stay silent.
+The last method calls a POOL-level export_chain through a receiver
+without the "disagg" hint spelling — plain data with no obligation
+(tests and benches do this constantly), which the hint exists to keep
+untracked."""
+
+
+class HandoffDriver(object):
+    def __init__(self, disagg):
+        self._disagg = disagg
+
+    def warm(self, disagg, prefill_rep, decode_rep, request, tid):
+        payload = disagg.export_chain(prefill_rep, request, tid)
+        if not self.ready(decode_rep):
+            disagg.abort_transfer(prefill_rep, tid)
+            return None
+        disagg.import_chain(decode_rep, payload)
+        return payload
+
+    def warm_checked(self, disagg, prefill_rep, decode_rep, request,
+                     tid):
+        payload = disagg.export_chain(prefill_rep, request, tid)
+        try:
+            disagg.import_chain(decode_rep, payload)
+        except Exception:
+            disagg.abort_transfer(prefill_rep, tid)
+            raise
+        return payload
+
+    def snapshot(self, pool, prompt):
+        # pool-level export: returns block rows, owes nothing
+        return pool.export_chain(prompt)
+
+    def ready(self, rep):
+        return rep is not None

@@ -1,0 +1,36 @@
+"""C19 positive fixture — EDL501 leaks of the disaggregated handoff's
+transfer obligation (serving/disagg.py HandoffCoordinator discipline,
+export_chain -> import_chain | abort_transfer, receiver hint
+"disagg"):
+
+1. an exported chain that a not-ready early return neither imports nor
+   aborts — a transfer the two-pool ledger cannot reconcile;
+2. an export whose failed-import exception path never records the
+   abort — the failure leaves no ledger entry past the raise.
+"""
+
+
+class HandoffDriver(object):
+    def __init__(self, disagg):
+        self._disagg = disagg
+
+    def warm(self, disagg, prefill_rep, decode_rep, request, tid):
+        payload = disagg.export_chain(prefill_rep, request, tid)
+        if not self.ready(decode_rep):
+            return None  # leak: neither imported nor aborted
+        disagg.import_chain(decode_rep, payload)
+        return payload
+
+    def warm_checked(self, disagg, prefill_rep, decode_rep, request,
+                     tid):
+        payload = disagg.export_chain(prefill_rep, request, tid)
+        if self.draining(decode_rep):
+            raise RuntimeError("decode draining")  # leak: no abort
+        disagg.import_chain(decode_rep, payload)
+        return payload
+
+    def ready(self, rep):
+        return rep is not None
+
+    def draining(self, rep):
+        return bool(rep)

@@ -298,6 +298,27 @@ def test_c18_negative_settled_cells_are_clean():
     assert lint_file("c18_neg.py") == []
 
 
+def test_c19_positive_flags_unsettled_handoff_exports():
+    """The disaggregated transfer pair (serving/disagg.py
+    HandoffCoordinator): an exported chain that an early return
+    neither imports nor aborts, and a failed-import exception path
+    that records no abort past the raise."""
+    findings = lint_file("c19_pos.py")
+    assert rule_ids(findings) == ["EDL501"] * 2, findings
+    assert {f.detail for f in findings} == {"disagg.export_chain"}
+    assert {f.scope for f in findings} == {
+        "HandoffDriver.warm", "HandoffDriver.warm_checked",
+    }
+
+
+def test_c19_negative_settled_handoffs_are_clean():
+    """import_chain on the happy path, abort_transfer on the not-ready
+    branch and the exception path — and the pool-level export_chain
+    (no "disagg" receiver spelling) stays untracked, because pool
+    exports return plain data and owe nothing."""
+    assert lint_file("c19_neg.py") == []
+
+
 # ------------------- C14: EDL105 recompile hazard (value-origin v3)
 
 
@@ -513,7 +534,7 @@ FAMILY_FIXTURES = {
     "EDL202": (("c9_pos.py",), "c9_neg.py"),
     "EDL401": (("c5_pos.py",), "c5_neg.py"),
     "EDL501": (("c8_pos.py", "c11_pos.py", "c12_pos.py",
-                "c13_pos.py", "c18_pos.py"), "c8_neg.py"),
+                "c13_pos.py", "c18_pos.py", "c19_pos.py"), "c8_neg.py"),
     "EDL601": (("c17_pos.py",), "c17_neg.py"),
     # EDL301 is repo-level; its trigger/clean pair is the tampered/
     # pristine pb2 in the proto tests below

@@ -435,9 +435,13 @@ def test_serving_proto_round_trip():
 def test_serving_service_descriptor():
     svc = pb.DESCRIPTOR.services_by_name["Serving"]
     names = [m.name for m in svc.methods]
-    assert names == ["generate", "generate_stream", "server_status"]
+    assert names == ["generate", "generate_stream", "server_status",
+                     "export_chain", "transfer_chain",
+                     "abort_transfer"]
     assert svc.methods_by_name["generate_stream"].server_streaming
     assert not svc.methods_by_name["generate"].server_streaming
+    # the disagg transfer RPCs are all unary
+    assert not svc.methods_by_name["transfer_chain"].server_streaming
     # the hand-rolled binding table mirrors the descriptor
     from elasticdl_tpu.proto.service import _SERVING_METHODS
 
