@@ -149,10 +149,20 @@ serve-smoke:
 # (override per metric with --tol). Update the baseline deliberately,
 # with the PR that improves it:
 #   make serve-smoke && cp BENCH_SERVING.json benchmarks/serving_baseline.json
+# The second leg re-runs the paged-attention microbench (scan + fused
+# Pallas kernel, smoke-sized) and gates its ratio blocks against
+# benchmarks/int8_scan_baseline.json the same way; refresh with
+#   python scripts/bench_int8_scan.py --seq_len 128 --iters 20 \
+#       --out benchmarks/int8_scan_baseline.json
 bench-compare:
 	env -u PYTHONPATH $(PY) scripts/bench_compare.py \
 		--fresh BENCH_SERVING.json \
 		--baseline benchmarks/serving_baseline.json
+	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/bench_int8_scan.py \
+		--seq_len 128 --iters 20 --out BENCH_INT8_SCAN.json
+	env -u PYTHONPATH $(PY) scripts/bench_compare.py \
+		--fresh BENCH_INT8_SCAN.json \
+		--baseline benchmarks/int8_scan_baseline.json
 
 ci-fast: lint test-fast
 

@@ -23,6 +23,19 @@ Inside a jit context:
   compile time, so they LIE at runtime (a timestamp becomes a
   constant). Use ``jax.debug.print`` / time outside the jit boundary.
 
+EDL108 extends the same hazard surface to ``pallas_call`` index maps:
+a ``BlockSpec(..., lambda i, j, tbl_ref, ...: ...)`` lambda (2nd
+positional arg or ``index_map=``) is traced with grid indices and
+scalar-prefetch refs as its arguments — ALWAYS tracer inputs, no
+taint analysis needed. ``np.asarray``/``np.array``, ``.item()`` and
+``int()``/``float()``/``bool()`` casts inside one either raise
+TracerArrayConversionError at trace time or, when the table happens
+to be concrete (interpret-mode tests), silently BAKE a stale block
+table into the compiled kernel — the block-table indirection the
+paged decode kernel exists for then reads freed blocks after churn.
+Index maps are checked module-wide, not only inside jit contexts: a
+``pallas_call`` built in a plain helper is traced all the same.
+
 TAINT is a deliberate approximation of "derived from a traced value":
 the jit'd function's parameters seed the set, and single-assignment
 propagation (``y = f(x)`` with ``x`` tainted taints ``y``) extends it
@@ -89,6 +102,54 @@ def _jit_call_static_names(call, fndef):
                 if 0 <= i < len(args):
                     static.add(args[i])
     return static
+
+
+def _index_map_lambdas(tree):
+    """Every index-map lambda of a BlockSpec(...) call in the module:
+    the 2nd positional argument or the ``index_map=`` keyword (both
+    spellings: ``pl.BlockSpec`` and a bare imported ``BlockSpec``)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted_tail(node.func) != "BlockSpec":
+            continue
+        cands = []
+        if len(node.args) >= 2:
+            cands.append(node.args[1])
+        cands.extend(kw.value for kw in node.keywords
+                     if kw.arg == "index_map")
+        for cand in cands:
+            if isinstance(cand, ast.Lambda):
+                yield cand
+
+
+def _check_index_map(lam, path, findings):
+    """EDL108 hits inside one index-map lambda body."""
+
+    def emit(line, detail, what):
+        findings.append(Finding(
+            "EDL108", path, line, "BlockSpec.index_map", detail,
+            "%s inside a pallas_call index map: the lambda is traced "
+            "with grid indices and scalar-prefetch refs — host "
+            "materialization raises at trace time or bakes a stale "
+            "block table into the kernel; index with jnp ops on the "
+            "prefetch ref" % what,
+        ))
+
+    for sub in ast.walk(lam.body):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "item" and not sub.args:
+                emit(sub.lineno, ".item()", ".item()")
+            elif (fn.attr in ("asarray", "array")
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in _NP_NAMES):
+                emit(sub.lineno, "np.%s" % fn.attr,
+                     "np.%s()" % fn.attr)
+        elif isinstance(fn, ast.Name) and fn.id in _CASTS:
+            emit(sub.lineno, "%s()" % fn.id, "%s() cast" % fn.id)
 
 
 def _collect_jit_contexts(tree):
@@ -322,6 +383,8 @@ class JitHazardRule(Rule):
 
     def check_module(self, tree, lines, path):
         findings = []
+        for lam in _index_map_lambdas(tree):
+            _check_index_map(lam, path, findings)
         for fndef, static in _collect_jit_contexts(tree).items():
             params = {a.arg for a in fndef.args.args}
             params.update(a.arg for a in fndef.args.kwonlyargs)

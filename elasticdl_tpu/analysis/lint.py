@@ -53,7 +53,7 @@ RULE_FAMILIES = {
     "EDL001": ("EDL001", "EDL002"),
     "EDL003": ("EDL003",),
     "EDL004": ("EDL004",),
-    "EDL101": ("EDL101", "EDL102", "EDL103"),
+    "EDL101": ("EDL101", "EDL102", "EDL103", "EDL108"),
     "EDL104": ("EDL104",),
     "EDL105": ("EDL105",),
     "EDL106": ("EDL106",),

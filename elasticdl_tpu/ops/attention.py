@@ -24,9 +24,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.ops.dispatch import (
+    CompilerParams,
     interpret_mode,
     is_tpu_backend,
     use_cond_mask,
+    use_paged_kernel,
     use_pallas,
 )
 
@@ -86,6 +88,34 @@ def resolve_block(explicit, which):
         return _align8(value) if value else 128
     except (TypeError, ValueError):
         return 128
+
+
+def resolve_paged_rows(explicit=None):
+    """Query-row tile for the fused paged decode kernel
+    (_paged_decode_fused): the group*t query rows of each (batch,
+    kv-head) program are padded up to a multiple of this, so it is the
+    kernel's sublane occupancy knob — bigger tiles round tiny
+    verify-k/GQA row counts up to fuller VPU/MXU sublanes at the price
+    of masked-row FLOPs. Resolution order mirrors the flash blocks:
+    explicit argument > EDL_PAGED_ROWS env > flash_tuning.json
+    "paged_rows" > 8. The default 8 is the CPU-SAFE floor (one f32
+    sublane tile): interpret mode pays per-element for padding, and 8
+    is also the smallest legal Mosaic row tile, so an untuned install
+    is correct everywhere — scripts/bench_attention.py --paged sweeps
+    and persists the hardware winner."""
+    if explicit is not None:
+        return _align8(explicit)
+    raw = os.environ.get("EDL_PAGED_ROWS", "")
+    if raw:
+        try:
+            return _align8(raw)
+        except ValueError:
+            pass
+    value = _tuned_blocks().get("paged_rows")
+    try:
+        return _align8(value) if value else 8
+    except (TypeError, ValueError):
+        return 8
 
 
 def softmax_merge(o, l, m, s, v_blk, w_scale=None):
@@ -322,10 +352,48 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=512,
     return out
 
 
+def _paged_valid(k_pos, bid, length, row_pos, window):
+    """The ONE paged-decode visibility predicate, shared by the lax.scan
+    oracle and the fused Pallas kernel so the two paths can never
+    disagree about a mask bit (the flash kernels' _block_run/_block_mask
+    discipline, applied to the paged shape). All operands broadcast:
+
+      k_pos:   absolute position of a pool row (block j row r sits at
+               j*block_size + r — the block table is position-ordered)
+      bid:     the row's block id; -1 marks an unallocated table slot
+               (the gather clamps to block 0, this predicate masks it)
+      length:  tokens already cached; rows at k_pos >= length are junk
+               (the partially-filled tail of the newest block)
+      row_pos: the query row's absolute position (length + tile offset)
+      window:  sliding window — a row sees keys k_pos > row_pos - window
+               (static; None = unbounded)
+    """
+    valid = (k_pos < length) & (bid >= 0)
+    if window is not None:
+        valid = valid & (k_pos > row_pos - window)
+    return valid
+
+
+def _tile_causal_mask(group, t, window):
+    """[group*t, t] visibility of the query tile's OWN keys, shared by
+    the scan and fused paths (both merge the tile outside the pool
+    stream): tile key j' (absolute position length + j') is visible to
+    tile row j iff j' <= j — causal within the tile — and any window >= 1
+    keeps the diagonal (_check_window)."""
+    tile = jnp.arange(t)
+    tri = tile[:, None] >= tile[None, :]  # [t_q, t_k] causal
+    if window is not None:
+        tri = tri & (tile[:, None] - tile[None, :] < window)
+    return jnp.broadcast_to(
+        tri[None, :, :], (group, t, t)
+    ).reshape(group * t, t)
+
+
 def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
                            length, scale=None, window=None,
                            k_scale_pool=None, v_scale_pool=None,
-                           k_cur_scale=None, v_cur_scale=None):
+                           k_cur_scale=None, v_cur_scale=None,
+                           use_kernel=None):
     """Decode attention over a BLOCK-PAGED KV pool for a tile of
     1 <= t new query tokens per sequence.
 
@@ -382,7 +450,16 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
     than q (GQA): q heads are grouped under their kv head like the
     dense `_decode_step`, so pool reads scale with hkv. Returns
     [b, h, t, d] in float32 (the dense decode path's softmax
-    precision)."""
+    precision).
+
+    DISPATCH (`use_kernel`): None (default) auto-selects — the fused
+    Pallas kernel (_paged_decode_fused) when dispatch.use_paged_kernel()
+    says kernels are on AND _paged_kernel_supported() accepts the
+    shapes; the lax.scan above otherwise. True/False (static) pin a
+    path — the bench legs and the parity battery compare the two
+    directly. Both paths share _paged_valid/_tile_causal_mask and the
+    same outside-the-stream tile merge, so they can only differ by
+    floating-point reduction order."""
     quantized = k_scale_pool is not None
     if quantized and (v_scale_pool is None or k_cur_scale is None
                       or v_cur_scale is None):
@@ -435,13 +512,17 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
             ks = k_scale_pool[safe][..., 0]  # [b, block_size, hkv]
             s = s * ks.transpose(0, 2, 1)[:, :, None, :]
             w_scale = v_scale_pool[safe][..., 0].transpose(0, 2, 1)
-        k_pos = j * block_size + jnp.arange(block_size)[None, :]
-        valid = (k_pos < length[:, None]) & (bid >= 0)[:, None]  # [b,bs]
-        valid = jnp.broadcast_to(valid[:, None, :], (b, t, block_size))
-        if window is not None:
-            valid = valid & (
-                k_pos[:, None, :] > (row_pos - window)[..., None]
-            )
+        k_pos = j * block_size + jnp.arange(block_size)[None, None, :]
+        valid = jnp.broadcast_to(
+            _paged_valid(
+                k_pos,                   # [1, 1, block_size]
+                bid[:, None, None],      # [b, 1, 1]
+                length[:, None, None],   # [b, 1, 1]
+                row_pos[..., None],      # [b, t, 1]
+                window,
+            ),
+            (b, t, block_size),
+        )
         # [b, t, bs] -> [b, 1, group, t, bs] -> flatten the query axis
         vt = jnp.broadcast_to(
             valid[:, None, None], (b, hkv, group, t, block_size)
@@ -450,10 +531,20 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
         return softmax_merge(o, l, mx, s, vb.transpose(0, 2, 1, 3),
                              w_scale=w_scale), None
 
-    o0 = jnp.zeros((b, hkv, group * t, d), f32)
-    l0 = jnp.zeros((b, hkv, group * t), f32)
-    m0 = jnp.full((b, hkv, group * t), _NEG_INF, f32)
-    (o, l, mx), _ = jax.lax.scan(step, (o0, l0, m0), jnp.arange(m))
+    if use_kernel is None:
+        use_kernel = use_paged_kernel() and _paged_kernel_supported(
+            d, block_size, m
+        )
+    if use_kernel:
+        o, l, mx = _paged_decode_fused(
+            qf, k_pool, v_pool, block_table, length, t, window=window,
+            k_scale_pool=k_scale_pool, v_scale_pool=v_scale_pool,
+        )
+    else:
+        o0 = jnp.zeros((b, hkv, group * t, d), f32)
+        l0 = jnp.zeros((b, hkv, group * t), f32)
+        m0 = jnp.full((b, hkv, group * t), _NEG_INF, f32)
+        (o, l, mx), _ = jax.lax.scan(step, (o0, l0, m0), jnp.arange(m))
     # the tile attends to itself causally: key j' (position
     # length + j') is visible to row j iff j' <= j (the diagonal is
     # always inside any window >= 1) — merged as one t-key block
@@ -467,13 +558,7 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
         # scores see exactly the rows every LATER step will read back
         s_cur = s_cur * k_cur_scale[..., 0][:, :, None, :]
         cur_w_scale = v_cur_scale[..., 0]  # [b, hkv, t]
-    tile = jnp.arange(t)
-    tri = tile[:, None] >= tile[None, :]  # [t_q, t_k] causal
-    if window is not None:
-        tri = tri & (tile[:, None] - tile[None, :] < window)
-    trif = jnp.broadcast_to(
-        tri[None, :, :], (group, t, t)
-    ).reshape(group * t, t)
+    trif = _tile_causal_mask(group, t, window)
     s_cur = jnp.where(trif[None, None], s_cur, _NEG_INF)
     o, l, mx = softmax_merge(
         o, l, mx, s_cur, v_cur.astype(f32),  # already [b, hkv, t, d]
@@ -482,6 +567,193 @@ def paged_decode_attention(q, k_cur, v_cur, k_pool, v_pool, block_table,
     out = softmax_finalize(o, l).reshape(b, hkv, group, t, d)
     out = out.reshape(b, h, t, d)
     return out[:, :, 0, :] if squeeze else out
+
+
+# ------------------------------------------------- fused paged kernel
+
+
+def _paged_kernel_supported(d, block_size, m):
+    """Shape gate for the fused paged decode kernel. Interpret mode
+    (CPU tests, FORCE_INTERPRET debugging) takes any shape — no tiling
+    constraints apply. COMPILED Mosaic streams (1, block_size, 1, d)
+    arena tiles, so the arena's lane dim d must be a 128 multiple and
+    the block_size sublane dim 8-aligned: unlike q (a [b,h,t,d]-sized
+    array, padded for free in _paged_decode_fused), padding the SHARED
+    arenas would copy the whole pool every step — misaligned pools
+    keep the scan. m == 0 (no table slots) has no pool to stream."""
+    if m < 1:
+        return False
+    if interpret_mode():
+        return True
+    return d % 128 == 0 and block_size % 8 == 0
+
+
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                  hkv, m, t, bs, window, quantized, n_rows):
+    """Fused paged decode attention, one Mosaic program per
+    (batch·kv_head, table slot) grid point.
+
+    Scalar-prefetch operands (the vLLM PagedAttention shape): the
+    flattened [b*m] block table and the [b] lengths land in SMEM before
+    the grid runs, so the K/V BlockSpec index maps gather each slot's
+    block HBM->VMEM by TABLE INDIRECTION — `tbl[batch*m + j]` IS the
+    index map, -1 slots clamped to resident block 0 and masked here.
+
+    Per step: the (1, bs, 1, d) k/v tiles collapse to (bs, d); int8
+    rows dequantize IN-REGISTER by the (bs, 1) scale-leaf column
+    broadcast (one multiply per row element in VMEM — algebraically
+    the scan's score-tile/weight folding, chosen because the sublane
+    broadcast needs no transpose of the scale column). Scores run in
+    the exp2 domain like the flash kernels (log2e pre-folded into q's
+    scale multiply), masked by the SAME _paged_valid predicate the
+    scan uses, and accumulate into the fp32 VMEM scratch (o, l, m)
+    online-softmax triple; the last slot writes the raw partials out
+    (m converted back to natural log) for the shared current-tile
+    merge + finalize in paged_decode_attention."""
+    if quantized:
+        ks_ref, vs_ref = rest[:2]
+        rest = rest[2:]
+    o_ref, l_ref, m_ref, acc_o, acc_l, acc_m = rest
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_o[:] = jnp.zeros_like(acc_o)
+        acc_l[:] = jnp.zeros_like(acc_l)
+        acc_m[:] = jnp.full_like(acc_m, _NEG_INF)
+
+    batch = i // hkv
+    bid = tbl_ref[batch * m + j]
+    seq_len = len_ref[batch]
+
+    kb = k_ref[0, :, 0, :].astype(jnp.float32)  # (bs, d)
+    vb = v_ref[0, :, 0, :].astype(jnp.float32)
+    if quantized:
+        kb = kb * ks_ref[0, :, 0, :]  # (bs, 1) sublane broadcast
+        vb = vb * vs_ref[0, :, 0, :]
+
+    q = q_ref[0, 0]  # (n_rows, d), exp2-domain prescaled f32
+    s = jax.lax.dot_general(
+        q, kb, dimension_numbers=_dims(1, 1),
+        preferred_element_type=jnp.float32,
+    )  # (n_rows, bs), log2 units
+
+    k_pos = j * bs + jax.lax.broadcasted_iota(
+        jnp.int32, (n_rows, bs), 1
+    )
+    # row r of the padded tile is tile token r % t (group-major
+    # [group, t] flatten; pad rows alias real positions and are
+    # sliced off by the caller)
+    row_pos = seq_len + (
+        jax.lax.broadcasted_iota(jnp.int32, (n_rows, bs), 0) % t
+    )
+    s = jnp.where(
+        _paged_valid(k_pos, bid, seq_len, row_pos, window), s, _NEG_INF
+    )
+
+    m_prev = acc_m[:]
+    m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+    p = jnp.exp2(s - m_new)
+    corr = jnp.exp2(m_prev - m_new)
+    acc_l[:] = acc_l[:] * corr + p.sum(-1, keepdims=True)
+    acc_o[:] = acc_o[:] * corr + jax.lax.dot_general(
+        p, vb, dimension_numbers=_dims(1, 0),
+        preferred_element_type=jnp.float32,
+    )
+    acc_m[:] = m_new
+
+    @pl.when(j == m - 1)
+    def _():
+        o_ref[0, 0] = acc_o[:]
+        l_ref[0, 0] = acc_l[:]
+        # natural-log units at the boundary, like the flash epilogue:
+        # nothing outside the kernel ever sees base-2 values
+        m_ref[0, 0] = acc_m[:] * _LN2
+
+
+def _paged_decode_fused(qf, k_pool, v_pool, block_table, length, t,
+                        window=None, k_scale_pool=None,
+                        v_scale_pool=None, rows=None):
+    """pallas_call wrapper for _paged_kernel: returns the pool-stream
+    online-softmax partials (o [b,hkv,g*t,d], l, m [b,hkv,g*t]) in
+    fp32 natural-log units — drop-in for the lax.scan's carry, so
+    paged_decode_attention's tile merge + finalize is shared verbatim.
+
+    qf is the scan's query layout: [b, hkv, group*t, d], already scale-
+    multiplied, f32. The row axis pads up to resolve_paged_rows() (the
+    tuned sublane tile); k/v pools stream untouched — int8 arenas stay
+    int8 through the DMA, scale leaves ride as (1, bs, 1, 1) tiles."""
+    b, hkv, gt, d = qf.shape
+    bs = k_pool.shape[1]
+    m = block_table.shape[1]
+    quantized = k_scale_pool is not None
+    rows = resolve_paged_rows(rows)
+    n_rows = max(rows, ((gt + rows - 1) // rows) * rows)
+    q2 = qf.astype(jnp.float32) * _LOG2E  # exp2 domain
+    if n_rows != gt:
+        q2 = jnp.pad(
+            q2, ((0, 0), (0, 0), (0, n_rows - gt), (0, 0))
+        )
+    tbl = jnp.asarray(block_table, jnp.int32).reshape(b * m)
+    ln = jnp.asarray(length, jnp.int32)
+
+    def _bh_spec(last):
+        """Per-(batch, kv-head) tile, revisited across the j stream."""
+        return pl.BlockSpec(
+            (1, 1, n_rows, last),
+            lambda i, j, tbl_ref, len_ref: (i // hkv, i % hkv, 0, 0),
+            memory_space=pltpu.VMEM,
+        )
+
+    def _pool_spec(last):
+        """THE tentpole index map: the scalar-prefetched block table
+        routes the HBM->VMEM DMA — slot j of sequence i//hkv names the
+        arena block to stream; -1 (unallocated) clamps to block 0,
+        whose rows _paged_valid masks. Same-index revisits (clamped
+        runs) elide the copy like the flash stream clamps."""
+        return pl.BlockSpec(
+            (1, bs, 1, last),
+            lambda i, j, tbl_ref, len_ref: (
+                jnp.maximum(tbl_ref[(i // hkv) * m + j], 0),
+                0, i % hkv, 0,
+            ),
+            memory_space=pltpu.VMEM,
+        )
+
+    in_specs = [_bh_spec(d), _pool_spec(d), _pool_spec(d)]
+    inputs = [q2, k_pool, v_pool]
+    if quantized:
+        in_specs += [_pool_spec(1), _pool_spec(1)]
+        inputs += [k_scale_pool, v_scale_pool]
+    kernel = functools.partial(
+        _paged_kernel, hkv=hkv, m=m, t=t, bs=bs, window=window,
+        quantized=quantized, n_rows=n_rows,
+    )
+    o, l, mx = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b * hkv, m),
+            in_specs=in_specs,
+            out_specs=(_bh_spec(d), _bh_spec(1), _bh_spec(1)),
+            scratch_shapes=[
+                pltpu.VMEM((n_rows, d), jnp.float32),
+                pltpu.VMEM((n_rows, 1), jnp.float32),
+                pltpu.VMEM((n_rows, 1), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, n_rows, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, n_rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, n_rows, 1), jnp.float32),
+        ),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret_mode(),
+    )(tbl, ln, *inputs)
+    return o[:, :, :gt], l[:, :, :gt, 0], mx[:, :, :gt, 0]
 
 
 def _check_window(window, lq, lk):
@@ -862,8 +1134,10 @@ def _dkv_q_spec(block, d, h, hkv, n_q, clamp=None):
 def _mosaic_params():
     """Grid semantics for all three flash kernels: (bh, output-block,
     streamed-block) = two parallel dims + one arbitrary (sequential
-    accumulation over scratch). Lets Mosaic pipeline the parallel dims."""
-    return pltpu.CompilerParams(
+    accumulation over scratch). Lets Mosaic pipeline the parallel dims.
+    `CompilerParams` comes from ops.dispatch — the one place the
+    jax-0.4.37 `TPUCompilerParams` rename is resolved."""
+    return CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
 

@@ -12,11 +12,47 @@ Env knobs:
                                   (opts non-TPU backends INTO the kernel
                                   path; on TPU, debugs the kernel without
                                   Mosaic)
+  EDL_DISABLE_PAGED_KERNEL=1      keep paged decode on the lax.scan
+                                  oracle even where the fused Pallas
+                                  kernel would engage (A/B + bisection
+                                  knob; the scan is the parity fallback)
+
+This module is also the ONE place the jax Pallas API version skew is
+resolved: jax 0.4.37 ships the TPU compiler/memory-space types under
+their old names (`pltpu.TPUCompilerParams`, `pltpu.TPUMemorySpace` with
+no `HBM` member) while the current documented surface spells them
+`pltpu.CompilerParams` / `pltpu.MemorySpace.HBM`. Every Pallas call
+site imports `CompilerParams` / `MemorySpace` from HERE instead of
+probing `pltpu` itself, so a jax upgrade (or downgrade) is a one-file
+change and the kernels never crash with AttributeError on the other
+side of the rename.
 """
 
 import os
 
 import jax
+from jax.experimental.pallas import tpu as _pltpu
+
+if hasattr(_pltpu, "CompilerParams"):
+    CompilerParams = _pltpu.CompilerParams
+else:  # jax 0.4.37: pre-rename spelling
+    CompilerParams = _pltpu.TPUCompilerParams
+
+if hasattr(_pltpu, "MemorySpace"):
+    MemorySpace = _pltpu.MemorySpace
+else:
+    class MemorySpace(object):
+        """jax-0.4.37 stand-in for `pltpu.MemorySpace`: same member
+        names, values from `TPUMemorySpace`. 0.4.37 has no HBM member
+        at all — ANY is the closest semantics (the compiler may leave
+        the buffer off-chip and the kernel DMAs it explicitly), and it
+        is exactly what the old API resolved HBM-style usage to."""
+
+        ANY = _pltpu.TPUMemorySpace.ANY
+        HBM = _pltpu.TPUMemorySpace.ANY
+        VMEM = _pltpu.TPUMemorySpace.VMEM
+        SMEM = _pltpu.TPUMemorySpace.SMEM
+        SEMAPHORE = _pltpu.TPUMemorySpace.SEMAPHORE
 
 
 def use_pallas():
@@ -32,6 +68,21 @@ def use_pallas():
     if os.environ.get("ELASTICDL_TPU_FORCE_INTERPRET", "") == "1":
         return True
     return is_tpu_backend()
+
+
+def use_paged_kernel():
+    """Whether paged_decode_attention should try the fused Pallas
+    kernel (ops/attention.py::_paged_decode_fused) instead of the
+    lax.scan oracle. Rides use_pallas() — same TPU/FORCE_INTERPRET/
+    DISABLE_PALLAS ladder as every other kernel — with its own kill
+    switch so the scan fallback stays one env var away during
+    bring-up/bisection (the kernel is numerically tile-parallel where
+    the scan is sequential; EDL_DISABLE_PAGED_KERNEL=1 pins the
+    oracle). Shape support is the call site's problem
+    (_paged_kernel_supported): this is only the policy bit."""
+    if os.environ.get("EDL_DISABLE_PAGED_KERNEL", "") == "1":
+        return False
+    return use_pallas()
 
 
 def use_cond_mask():

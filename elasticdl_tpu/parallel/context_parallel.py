@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.common.constants import MeshAxis
+from elasticdl_tpu.parallel.pipeline import shard_map
 from elasticdl_tpu.ops.attention import (
     NEG_INF as _NEG_INF,
     attention_backward_lse,
@@ -401,15 +402,13 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
         window=window,
     )
     if segments is None:
-        fn = jax.shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=spec, check_vma=False,
+        fn = shard_map(
+            local, mesh, (spec, spec, spec), spec,
         )
         return fn(q, k, v)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda qq, kk, vv, ss: local(qq, kk, vv, segments=ss),
-        mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
-        out_specs=spec, check_vma=False,
+        mesh, (spec, spec, spec, seg_spec), spec,
     )
     return fn(q, k, v, jnp.asarray(segments, jnp.int32))
 
@@ -507,14 +506,12 @@ def ulysses_attention(q, k, v, mesh, causal=False, scale=None,
         window=window,
     )
     if segments is None:
-        fn = jax.shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=spec, check_vma=False,
+        fn = shard_map(
+            local, mesh, (spec, spec, spec), spec,
         )
         return fn(q, k, v)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda qq, kk, vv, ss: local(qq, kk, vv, segments=ss),
-        mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
-        out_specs=spec, check_vma=False,
+        mesh, (spec, spec, spec, seg_spec), spec,
     )
     return fn(q, k, v, jnp.asarray(segments, jnp.int32))

@@ -6,6 +6,20 @@ bundled TPU flash kernel. Timing is fetch-forced (block_until_ready can
 return early over the tunneled PJRT plugin — see BENCHNOTES.md).
 
 Usage:  python scripts/bench_attention.py [b h s d]
+
+`--paged` instead sweeps the fused paged decode kernel's query-row
+tile (attention.resolve_paged_rows: the sublane occupancy knob) over
+the serving decode shapes — the legacy single-token step and the
+verify-k tile — against the lax.scan fallback, and with `--write`
+persists the winner into ops/flash_tuning.json under "paged_rows",
+exactly like the flash block sizes. Without a tuned entry the kernel
+uses the CPU-SAFE default of 8 rows (one f32 sublane tile, the
+smallest legal Mosaic row tile — correct everywhere, fuller tiles are
+a hardware-measured upgrade). Run the sweep on real TPU: off-TPU the
+kernel interprets and the timings only rank interpreter overhead.
+
+Usage:  python scripts/bench_attention.py --paged [--write] \\
+            [b h hkv d L bs t]
 """
 
 import os
@@ -29,6 +43,88 @@ def timed(fn, args, iters=20):
         out = fn(*args)
     fetch(out)
     return (time.perf_counter() - t0) / iters
+
+
+def paged_sweep(argv, write):
+    """Sweep resolve_paged_rows candidates for _paged_decode_fused on
+    the two serving decode shapes; optionally persist the winner."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops.attention import paged_decode_attention
+
+    try:
+        shape = [int(a) for a in argv] or [8, 8, 8, 128, 2048, 16, 4]
+        b, h, hkv, d, L, bs, t = shape
+    except ValueError:
+        sys.exit("usage: bench_attention.py --paged [b h hkv d L bs t]")
+    if L % bs:
+        sys.exit("--paged needs L %% bs == 0")
+    rs = np.random.RandomState(0)
+    nb = b * (L // bs)
+    table = jnp.asarray(
+        np.arange(nb, dtype=np.int32).reshape(b, L // bs)
+    )
+    length = jnp.full((b,), L, jnp.int32)
+    k_pool = jnp.asarray(rs.randn(nb, bs, hkv, d).astype(np.float32))
+    v_pool = jnp.asarray(rs.randn(nb, bs, hkv, d).astype(np.float32))
+
+    def legs(tq):
+        q = jnp.asarray(rs.randn(b, h, tq, d).astype(np.float32))
+        kc = jnp.asarray(rs.randn(b, hkv, tq, d).astype(np.float32))
+        vc = jnp.asarray(rs.randn(b, hkv, tq, d).astype(np.float32))
+        if tq == 1:  # legacy single-token shape
+            q, kc, vc = q[:, :, 0], kc[:, :, 0], vc[:, :, 0]
+        return q, kc, vc, k_pool, v_pool, table, length
+
+    results = {}
+    for tq in (1, t):
+        inputs = legs(tq)
+        scan = jax.jit(lambda *a: paged_decode_attention(
+            *a, use_kernel=False))
+        t_scan = timed(scan, inputs)
+        print("t=%-3d scan (lax.scan oracle)          %8.1f us"
+              % (tq, t_scan * 1e6))
+        for rows in (8, 16, 32, 64):
+            # rows threads through the EDL_PAGED_ROWS env knob, read
+            # by resolve_paged_rows at trace time (first timed call)
+            os.environ["EDL_PAGED_ROWS"] = str(rows)
+            try:
+                t_fused = timed(jax.jit(lambda *a: paged_decode_attention(
+                    *a, use_kernel=True)), inputs)
+            except Exception as e:  # noqa: BLE001
+                print("t=%-3d rows=%-3d FAILED: %r"
+                      % (tq, rows, repr(e)[:80]))
+                continue
+            finally:
+                os.environ.pop("EDL_PAGED_ROWS", None)
+            results.setdefault(rows, 0.0)
+            results[rows] += t_fused
+            print("t=%-3d rows=%-3d fused                  %8.1f us"
+                  " (%.2fx scan)"
+                  % (tq, rows, t_fused * 1e6, t_fused / t_scan))
+    if not results:
+        sys.exit("--paged: every fused config failed")
+    best = min(results, key=results.get)
+    print("winner: paged_rows=%d (summed %0.1f us over both shapes)"
+          % (best, results[best] * 1e6))
+    if write:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), os.pardir,
+            "elasticdl_tpu", "ops", "flash_tuning.json",
+        )
+        with open(path) as f:
+            tuning = json.load(f)
+        tuning["paged_rows"] = best
+        tuning["paged_tuned_on"] = "%s b=%d h=%d hkv=%d d=%d L=%d " \
+            "bs=%d t=%d" % (jax.default_backend(), b, h, hkv, d, L,
+                            bs, t)
+        with open(path, "w") as f:
+            json.dump(tuning, f)
+            f.write("\n")
+        print("wrote paged_rows=%d to %s" % (best, path))
 
 
 def main():
@@ -107,4 +203,12 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    _argv = sys.argv[1:]
+    if "--paged" in _argv:
+        _argv.remove("--paged")
+        _write = "--write" in _argv
+        if _write:
+            _argv.remove("--write")
+        paged_sweep(_argv, _write)
+    else:
+        main()

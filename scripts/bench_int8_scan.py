@@ -12,16 +12,28 @@ bench pins that the blockwise formulation does not REGRESS the dense
 deferred path — the scan adds block bookkeeping (table gather, online
 softmax merges) but the dequantize work per cache row is identical.
 
-Three timed legs over the SAME logical cache (one decode step,
+Timed legs over the SAME logical cache (one decode step,
 steady-state, jit-compiled):
 
   dense_deferred_int8  the model's dense int8 decode attention
                        (transformer_lm._decode_step shape): one
                        [*, L] score softmax with scales folded in
   paged_int8           paged_decode_attention over int8 block arenas
-                       with the deferred scan
+                       with the deferred scan (use_kernel=False)
   paged_fp             the same scan over fp arenas (the int8 delta
                        WITHIN the paged formulation)
+  fused_int8/fused_fp  the FUSED Pallas kernel (use_kernel=True) on
+                       the same arenas — the PR 18 leg. On TPU this
+                       is the streaming VMEM kernel and the
+                       acceptance number is fused_int8_vs_dense
+                       <= 1.0; off-TPU the kernel INTERPRETS
+                       (fused_interpreted=true in the record), which
+                       checks the path end to end but times the
+                       Pallas interpreter, not Mosaic — interpreted
+                       ratios are reported for trajectory only.
+  tile_*               the verify-k [b, h, t, d] variants of all four
+                       paged legs (t = --verify_k: the speculative
+                       verify tile / suffix-prefill shape)
 
 Emits one JSON line; `--out` also writes it to a file. Defaults are
 CPU-smoke sized; on hardware raise --seq_len/--batch and the dims.
@@ -50,6 +62,17 @@ def parse_args(argv=None):
     p.add_argument("--seq_len", type=int, default=512)
     p.add_argument("--block_size", type=int, default=16)
     p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--verify_k", type=int, default=4,
+                   help="query-tile rows for the tile_* legs")
+    p.add_argument("--fused_iters", type=int, default=0,
+                   help="iters for the fused legs; 0 = --iters on "
+                        "TPU, min(--iters, 10) when the kernel can "
+                        "only run interpreted (the interpreter is "
+                        "~100x XLA, full iters would dominate the "
+                        "bench wall clock)")
+    p.add_argument("--no-fused", dest="fused", action="store_false",
+                   help="skip the fused-kernel legs (pre-PR-18 "
+                        "record shape)")
     p.add_argument("--out", default="")
     return p.parse_args(argv)
 
@@ -137,12 +160,54 @@ def main(argv=None):
             k_poolf[bid] = kf[i, :, rows].transpose(1, 0, 2)
             v_poolf[bid] = vf[i, :, rows].transpose(1, 0, 2)
 
-    paged_int8 = jax.jit(lambda *a: paged_decode_attention(
-        a[0], a[1], a[2], a[3], a[4], a[5], a[6],
-        k_scale_pool=a[7], v_scale_pool=a[8],
-        k_cur_scale=a[9], v_cur_scale=a[10],
-    ))
-    paged_fp = jax.jit(lambda *a: paged_decode_attention(*a))
+    def paged_call(kernel):
+        def call_int8(*a):
+            return paged_decode_attention(
+                a[0], a[1], a[2], a[3], a[4], a[5], a[6],
+                k_scale_pool=a[7], v_scale_pool=a[8],
+                k_cur_scale=a[9], v_cur_scale=a[10],
+                use_kernel=kernel,
+            )
+        def call_fp(*a):
+            return paged_decode_attention(*a, use_kernel=kernel)
+        return jax.jit(call_int8), jax.jit(call_fp)
+
+    scan_int8, scan_fp_fn = paged_call(False)
+    fused_int8_fn, fused_fp_fn = paged_call(True)
+
+    int8_args = (
+        jnp.asarray(q), jnp.asarray(kc8[:, :, 0]),
+        jnp.asarray(vc8[:, :, 0]), jnp.asarray(k_pool8),
+        jnp.asarray(v_pool8), jnp.asarray(table),
+        jnp.asarray(length), jnp.asarray(ks_pool),
+        jnp.asarray(vs_pool), jnp.asarray(kcs[:, :, 0]),
+        jnp.asarray(vcs[:, :, 0]),
+    )
+    fp_args = (
+        jnp.asarray(q), jnp.asarray(kc[:, :, 0]),
+        jnp.asarray(vc[:, :, 0]), jnp.asarray(k_poolf),
+        jnp.asarray(v_poolf), jnp.asarray(table),
+        jnp.asarray(length),
+    )
+    # the verify-k tile ([b, h, t, d]): same cache, t query rows
+    t = args.verify_k
+    q_t = rs.randn(b, h, t, d).astype(np.float32)
+    kct = rs.randn(b, hkv, t, d).astype(np.float32)
+    vct = rs.randn(b, hkv, t, d).astype(np.float32)
+    kct8, kcts = q8(kct)
+    vct8, vcts = q8(vct)
+    tile_int8_args = (
+        jnp.asarray(q_t), jnp.asarray(kct8), jnp.asarray(vct8),
+        jnp.asarray(k_pool8), jnp.asarray(v_pool8),
+        jnp.asarray(table), jnp.asarray(length),
+        jnp.asarray(ks_pool), jnp.asarray(vs_pool),
+        jnp.asarray(kcts), jnp.asarray(vcts),
+    )
+    tile_fp_args = (
+        jnp.asarray(q_t), jnp.asarray(kct), jnp.asarray(vct),
+        jnp.asarray(k_poolf), jnp.asarray(v_poolf),
+        jnp.asarray(table), jnp.asarray(length),
+    )
 
     dense_s = time_fn(
         dense_deferred,
@@ -150,37 +215,52 @@ def main(argv=None):
          jnp.asarray(v8), jnp.asarray(vs)),
         args.iters,
     )
-    i8_s = time_fn(
-        paged_int8,
-        (jnp.asarray(q), jnp.asarray(kc8[:, :, 0]),
-         jnp.asarray(vc8[:, :, 0]), jnp.asarray(k_pool8),
-         jnp.asarray(v_pool8), jnp.asarray(table),
-         jnp.asarray(length), jnp.asarray(ks_pool),
-         jnp.asarray(vs_pool), jnp.asarray(kcs[:, :, 0]),
-         jnp.asarray(vcs[:, :, 0])),
-        args.iters,
-    )
-    fp_s = time_fn(
-        paged_fp,
-        (jnp.asarray(q), jnp.asarray(kc[:, :, 0]),
-         jnp.asarray(vc[:, :, 0]), jnp.asarray(k_poolf),
-         jnp.asarray(v_poolf), jnp.asarray(table),
-         jnp.asarray(length)),
-        args.iters,
-    )
+    i8_s = time_fn(scan_int8, int8_args, args.iters)
+    fp_s = time_fn(scan_fp_fn, fp_args, args.iters)
+    tile_i8_s = time_fn(scan_int8, tile_int8_args, args.iters)
+    tile_fp_s = time_fn(scan_fp_fn, tile_fp_args, args.iters)
     record = {
         "metric": "paged_int8_scan_vs_dense_deferred",
         "platform": jax.default_backend(),
         "batch": b, "heads": h, "kv_heads": hkv, "head_dim": d,
         "seq_len": L, "block_size": bs, "iters": args.iters,
+        "verify_k": t,
         "dense_deferred_int8_us": round(dense_s * 1e6, 1),
         "paged_int8_us": round(i8_s * 1e6, 1),
         "paged_fp_us": round(fp_s * 1e6, 1),
+        "tile_paged_int8_us": round(tile_i8_s * 1e6, 1),
+        "tile_paged_fp_us": round(tile_fp_s * 1e6, 1),
         # the pin: the blockwise deferral vs the dense deferral
         "paged_int8_vs_dense_deferred": round(i8_s / dense_s, 3),
         # the int8 cost WITHIN the paged formulation
         "paged_int8_vs_paged_fp": round(i8_s / fp_s, 3),
     }
+    if args.fused:
+        from elasticdl_tpu.ops.dispatch import interpret_mode
+
+        interpreted = interpret_mode()
+        fi = args.fused_iters or (
+            min(args.iters, 10) if interpreted else args.iters
+        )
+        f8_s = time_fn(fused_int8_fn, int8_args, fi)
+        ffp_s = time_fn(fused_fp_fn, fp_args, fi)
+        tile_f8_s = time_fn(fused_int8_fn, tile_int8_args, fi)
+        tile_ffp_s = time_fn(fused_fp_fn, tile_fp_args, fi)
+        record.update({
+            "fused_interpreted": interpreted,
+            "fused_iters": fi,
+            "fused_int8_us": round(f8_s * 1e6, 1),
+            "fused_fp_us": round(ffp_s * 1e6, 1),
+            "tile_fused_int8_us": round(tile_f8_s * 1e6, 1),
+            "tile_fused_fp_us": round(tile_ffp_s * 1e6, 1),
+            # the PR 18 acceptance number (meaningful on TPU; the
+            # interpreter's python-loop timings only track trajectory)
+            "fused_int8_vs_dense_deferred": round(f8_s / dense_s, 3),
+            "fused_int8_vs_paged_int8": round(f8_s / i8_s, 3),
+            "fused_fp_vs_paged_fp": round(ffp_s / fp_s, 3),
+            "tile_fused_int8_vs_tile_paged_int8":
+                round(tile_f8_s / tile_i8_s, 3),
+        })
     line = json.dumps(record)
     print(line, flush=True)
     if args.out:

@@ -1,0 +1,54 @@
+"""CLEAN fixture for EDL108: pallas_call index-map lambdas that index
+the scalar-prefetch block table with jnp/tracer-safe ops only — the
+ops/attention.py _paged_decode_fused idiom. Also exercises the
+lookalikes the rule must NOT flag: np.asarray OUTSIDE the lambda (host
+prep before pallas_call is fine) and a non-BlockSpec call taking a
+lambda.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def good_pool_spec(hkv, m, bs, d):
+    # table-indirect DMA the tracer-safe way: jnp ops on the ref
+    return pl.BlockSpec(
+        (1, bs, 1, d),
+        lambda i, j, tbl_ref, len_ref: (
+            jnp.maximum(tbl_ref[(i // hkv) * m + j], 0),
+            0,
+            i % hkv,
+            0,
+        ),
+    )
+
+
+def good_keyword_spec(bs, d):
+    return pl.BlockSpec(
+        block_shape=(bs, d),
+        index_map=lambda i, tbl_ref: (tbl_ref[i], 0),
+    )
+
+
+def kernel(tbl_ref, x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def build(x, table):
+    # host-side np.asarray BEFORE the call is the normal prep idiom
+    tbl = np.asarray(table, np.int32).reshape(-1)
+    run = sorted([3, 1, 2], key=lambda v: int(v))  # lambda, not a spec
+    del run
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(4,),
+            in_specs=[good_pool_spec(2, 4, 8, 128)],
+            out_specs=good_pool_spec(2, 4, 8, 128),
+        ),
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(jnp.asarray(tbl), x)

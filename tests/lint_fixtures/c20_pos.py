@@ -1,0 +1,58 @@
+"""POSITIVE fixture for EDL108: host-side materialization inside
+pallas_call BlockSpec index-map lambdas — the hazard class the fused
+paged decode kernel introduced (the block table rides a
+scalar-prefetch ref; np.asarray/.item()/int() on it concretizes a
+tracer or bakes a stale table in). Expected findings: EDL108 x4
+(np.asarray, .item(), int() cast, keyword index_map= spelling).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def bad_positional_specs(table, hkv, m, bs, d):
+    # positional index map (2nd arg), two hazards inside one lambda
+    return pl.BlockSpec(
+        (1, bs, 1, d),
+        lambda i, j, tbl_ref, len_ref: (
+            np.asarray(tbl_ref)[i * m + j],  # EDL108
+            0,
+            int(i) % hkv,  # EDL108
+            0,
+        ),
+    )
+
+
+def bad_item_spec(bs, d):
+    return pl.BlockSpec(
+        (1, bs, 1, d),
+        lambda i, j, tbl_ref: (tbl_ref[j].item(), 0, 0, 0),  # EDL108
+    )
+
+
+def bad_keyword_spec(bs, d):
+    # keyword spelling of the same mistake
+    return pl.BlockSpec(
+        block_shape=(bs, d),
+        index_map=lambda i, tbl_ref: (np.array(tbl_ref[i]), 0),  # EDL108
+    )
+
+
+def kernel(tbl_ref, x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def build(x, table):
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(4,),
+            in_specs=[bad_item_spec(8, 128)],
+            out_specs=bad_item_spec(8, 128),
+        ),
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(table, x)

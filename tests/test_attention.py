@@ -1098,3 +1098,152 @@ def test_fully_masked_rows_chunked_matches_one_shot():
                                          45, 83, chunk=32)
             np.testing.assert_array_equal(np.asarray(one),
                                           np.asarray(chunked))
+
+
+# ------------------------------------------- fused paged decode kernel
+#
+# The CPU interpret=True parity battery for _paged_decode_fused (the
+# autouse fixture above sets FORCE_INTERPRET=1, so use_kernel=True runs
+# the REAL kernel body through the Pallas interpreter). Two oracles:
+# the lax.scan path of paged_decode_attention itself (bit-for-bit the
+# shared masks/merge, only reduction order differs) and naive_attention
+# over the logically contiguous cache (independent math). Every case
+# includes a drop-lane row (length=0, all-(-1) table) — the masked
+# lanes the serving engine scatters between seated requests.
+
+
+def _rowquant(rows):
+    """Symmetric per-row int8 + f32 scale, matching the serving
+    quantizer's layout (scale leaf on the trailing axis)."""
+    sc = (np.abs(rows).max(-1, keepdims=True) / 127.0
+          + 1e-8).astype(np.float32)
+    q8 = np.clip(np.round(rows / sc), -127, 127).astype(np.int8)
+    return q8, sc
+
+
+def _paged_case(seed, b, h, hkv, t, d, bs, nb, m, quantized):
+    """Pools + scattered -1-padded table + current tile; row 0 is the
+    drop lane (nothing cached, no blocks)."""
+    rs = np.random.RandomState(seed)
+    q = rs.randn(b, h, t, d).astype(np.float32)
+    k_cur = rs.randn(b, hkv, t, d).astype(np.float32)
+    v_cur = rs.randn(b, hkv, t, d).astype(np.float32)
+    k_pool = rs.randn(nb, bs, hkv, d).astype(np.float32)
+    v_pool = rs.randn(nb, bs, hkv, d).astype(np.float32)
+    length = rs.randint(1, m * bs + 1, size=(b,)).astype(np.int32)
+    length[0] = 0  # drop lane
+    table = np.full((b, m), -1, np.int32)
+    order = rs.permutation(nb)
+    ptr = 0
+    for i in range(b):
+        for j in range(-(-int(length[i]) // bs)):
+            table[i, j] = order[ptr % nb]
+            ptr += 1
+    kwargs = dict(window=None)
+    if quantized:
+        k_pool, ksp = _rowquant(k_pool)
+        v_pool, vsp = _rowquant(v_pool)
+        k_cur, kcs = _rowquant(k_cur)
+        v_cur, vcs = _rowquant(v_cur)
+        kwargs.update(
+            k_scale_pool=jnp.asarray(ksp), v_scale_pool=jnp.asarray(vsp),
+            k_cur_scale=jnp.asarray(kcs), v_cur_scale=jnp.asarray(vcs),
+        )
+    args = tuple(jnp.asarray(a) for a in
+                 (q, k_cur, v_cur, k_pool, v_pool, table, length))
+    return args, kwargs
+
+
+@pytest.mark.parametrize("quantized", (False, True),
+                         ids=("fp32", "int8"))
+@pytest.mark.parametrize("t", (1, 3))
+@pytest.mark.parametrize("window", (None, 5))
+@pytest.mark.parametrize("h,hkv", ((4, 4), (4, 2)),
+                         ids=("mha", "gqa"))
+def test_paged_fused_matches_scan_oracle(h, hkv, window, t, quantized):
+    """use_kernel=True vs use_kernel=False on identical inputs: the
+    two paths share _paged_valid/_tile_causal_mask and the tile merge,
+    so any drift is a kernel bug, not a mask disagreement. t=1 runs
+    the legacy [b, h, d] squeeze shape."""
+    from elasticdl_tpu.ops.attention import paged_decode_attention
+
+    args, kwargs = _paged_case(
+        seed=17 * t + hkv, b=3, h=h, hkv=hkv, t=t, d=8, bs=4, nb=12,
+        m=3, quantized=quantized,
+    )
+    kwargs["window"] = window
+    if t == 1:  # legacy single-token shape (and its scale shapes)
+        q, k_cur, v_cur = (a[:, :, 0] for a in args[:3])
+        args = (q, k_cur, v_cur) + args[3:]
+        for key in ("k_cur_scale", "v_cur_scale"):
+            if key in kwargs:
+                kwargs[key] = kwargs[key][:, :, 0]
+    scan = paged_decode_attention(*args, use_kernel=False, **kwargs)
+    fused = paged_decode_attention(*args, use_kernel=True, **kwargs)
+    assert fused.shape == scan.shape
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(scan), rtol=2e-5, atol=2e-5,
+        err_msg="h=%d hkv=%d window=%r t=%d quantized=%r"
+                % (h, hkv, window, t, quantized),
+    )
+
+
+@pytest.mark.parametrize("quantized", (False, True),
+                         ids=("fp32", "int8"))
+@pytest.mark.parametrize("window", (None, 4))
+def test_paged_fused_matches_naive(window, quantized):
+    """Independent oracle: gather each row's cache contiguously
+    (table order, dequantized for int8 — the kernel's in-register
+    dequant is exact, so parity carries no quantization slack), append
+    the current tile, and run naive_attention causally over the full
+    sequence; the last t rows must equal the fused output."""
+    from elasticdl_tpu.ops.attention import paged_decode_attention
+
+    b, h, hkv, t, d, bs, nb, m = 3, 4, 2, 3, 8, 4, 12, 3
+    args, kwargs = _paged_case(
+        seed=5 if quantized else 6, b=b, h=h, hkv=hkv, t=t, d=d,
+        bs=bs, nb=nb, m=m, quantized=quantized,
+    )
+    kwargs["window"] = window
+    fused = np.asarray(
+        paged_decode_attention(*args, use_kernel=True, **kwargs)
+    )
+    q, k_cur, v_cur, k_pool, v_pool, table, length = (
+        np.asarray(a) for a in args
+    )
+    if quantized:
+        k_pool = k_pool * np.asarray(kwargs["k_scale_pool"])
+        v_pool = v_pool * np.asarray(kwargs["v_scale_pool"])
+        k_cur = k_cur * np.asarray(kwargs["k_cur_scale"])
+        v_cur = v_cur * np.asarray(kwargs["v_cur_scale"])
+    for i in range(b):
+        ln = int(length[i])
+        rows_k = np.concatenate(
+            [k_pool[bid] for bid in table[i] if bid >= 0]
+            or [np.zeros((0, bs, hkv, d), np.float32).reshape(0, hkv, d)]
+        )[:ln]
+        rows_v = np.concatenate(
+            [v_pool[bid] for bid in table[i] if bid >= 0]
+            or [np.zeros((0, bs, hkv, d), np.float32).reshape(0, hkv, d)]
+        )[:ln]
+        # [ln + t, hkv, d] -> [1, hkv, ln + t, d]
+        keys = np.concatenate(
+            [rows_k, k_cur[i].transpose(1, 0, 2)]
+        ).transpose(1, 0, 2)[None]
+        vals = np.concatenate(
+            [rows_v, v_cur[i].transpose(1, 0, 2)]
+        ).transpose(1, 0, 2)[None]
+        # tail-align the tile in a full-length causal query: rows
+        # [ln, ln + t) get the tile's queries (the prefix rows carry
+        # zeros — their outputs are ignored), so naive's square causal
+        # + window mask at those rows IS the decode visibility
+        q_full = np.zeros((1, h, ln + t, d), np.float32)
+        q_full[:, :, ln:] = q[i]
+        ref = np.asarray(naive_attention(
+            jnp.asarray(q_full), jnp.asarray(keys), jnp.asarray(vals),
+            causal=True, window=window, scale=d ** -0.5,
+        ))[0, :, ln:]
+        np.testing.assert_allclose(
+            fused[i], ref, rtol=2e-5, atol=2e-5,
+            err_msg="row %d window=%r int8=%r" % (i, window, quantized),
+        )

@@ -76,6 +76,29 @@ def test_c2_negative():
     assert lint_file("c2_neg.py") == []
 
 
+def test_c20_positive_index_map_host_sync():
+    """EDL108: np.asarray/.item()/int() inside BlockSpec index-map
+    lambdas, positional and index_map= spellings both."""
+    findings = lint_file("c20_pos.py")
+    ids = rule_ids(findings)
+    assert ids.count("EDL108") == 4, findings
+    assert {f.scope for f in findings
+            if f.rule == "EDL108"} == {"BlockSpec.index_map"}
+    details = [f.detail for f in findings if f.rule == "EDL108"]
+    assert sorted(details) == [
+        ".item()", "int()", "np.array", "np.asarray",
+    ], details
+
+
+def test_c20_negative_index_map_clean():
+    """The tracer-safe index-map idiom (jnp ops on the prefetch ref),
+    host-side np.asarray BEFORE pallas_call, and non-BlockSpec lambdas
+    must all stay clean."""
+    findings = [f for f in lint_file("c20_neg.py")
+                if f.rule in RULE_FAMILIES["EDL101"]]
+    assert findings == [], findings
+
+
 # ----------------------------------------------------------- C3 fixtures
 
 
@@ -525,7 +548,7 @@ FAMILY_FIXTURES = {
     "EDL001": (("c1_pos.py",), "c1_neg.py"),
     "EDL003": (("c6_pos.py",), "c6_neg.py"),
     "EDL004": (("c7_pos.py",), "c7_neg.py"),
-    "EDL101": (("c2_pos.py",), "c2_neg.py"),
+    "EDL101": (("c2_pos.py", "c20_pos.py"), "c2_neg.py"),
     "EDL104": (("c10_pos.py",), "c10_neg.py"),
     "EDL105": (("c14_pos.py",), "c14_neg.py"),
     "EDL106": (("c15_pos.py",), "c15_neg.py"),

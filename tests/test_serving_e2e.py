@@ -572,16 +572,10 @@ def test_serving_telemetry_event_file_written(rig, tmp_path):
     assert os.path.getsize(os.path.join(str(tmp_path), files[0])) > 0
 
 
-def test_paged_int8_shared_spec_matches_offline_int8_32way():
-    """The int8-arena acceptance pin: 32 concurrent GREEDY requests
-    drawn from a small system-prompt pool against a paged + shared +
-    speculative server whose arenas are INT8 (kv_cache_dtype='int8',
-    mismatched draft so rollback exercises) — every token stream must
-    equal offline `autoregressive_generate(use_cache=True)` on the
-    SAME int8 model (the int8 dense oracle: same quantizer, so parity
-    carries no quantization slack). The post-drain ledger must be
-    clean with scale leaves in the arenas, and ServerStatus must
-    advertise the format."""
+def _run_paged_int8_shared_spec_32way():
+    """Body of the int8-arena acceptance pin, shared by the scan-path
+    test and the fused-kernel variant below (which reroutes
+    paged_decode_attention before calling this)."""
     int8_params = PARAMS + "; kv_cache_dtype='int8'"
     mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
     trainer = Trainer(
@@ -658,6 +652,45 @@ def test_paged_int8_shared_spec_matches_offline_int8_32way():
             s["new"], use_cache=True,
         ))[0]
         assert list(off) == results[i], (i, s)
+
+
+def test_paged_int8_shared_spec_matches_offline_int8_32way():
+    """The int8-arena acceptance pin: 32 concurrent GREEDY requests
+    drawn from a small system-prompt pool against a paged + shared +
+    speculative server whose arenas are INT8 (kv_cache_dtype='int8',
+    mismatched draft so rollback exercises) — every token stream must
+    equal offline `autoregressive_generate(use_cache=True)` on the
+    SAME int8 model (the int8 dense oracle: same quantizer, so parity
+    carries no quantization slack). The post-drain ledger must be
+    clean with scale leaves in the arenas, and ServerStatus must
+    advertise the format."""
+    _run_paged_int8_shared_spec_32way()
+
+
+def test_paged_int8_32way_token_exact_with_fused_kernel(monkeypatch):
+    """Serving-level pin for the fused paged decode kernel: the SAME
+    32-way paged + shared + spec + int8 battery, but with
+    paged_decode_attention routed through _paged_decode_fused (forced
+    on via use_paged_kernel; interpret_mode() makes the Pallas call
+    interpret on CPU, so the real kernel body runs inside the jitted
+    serving step). Token streams must stay EXACTLY equal to the dense
+    int8 offline oracle — the kernel may differ from the scan only in
+    fp reduction order, and greedy argmax over a real vocab gap
+    doesn't flip on that. The spy proves the kernel actually traced
+    into the serving step rather than silently falling back."""
+    import elasticdl_tpu.ops.attention as attn_mod
+
+    calls = {"n": 0}
+    real = attn_mod._paged_decode_fused
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(attn_mod, "_paged_decode_fused", spy)
+    monkeypatch.setattr(attn_mod, "use_paged_kernel", lambda: True)
+    _run_paged_int8_shared_spec_32way()
+    assert calls["n"] > 0, "fused kernel never engaged in the server"
 
 
 def test_host_tier_spill_revive_matches_offline_int8_32way():

@@ -163,22 +163,26 @@ def _compiled_hlo(vocab, sparse):
 
 def _vocab_sized_compute_ops(hlo, vocab, dim=16):
     """HLO ops producing a [vocab, dim] result, excluding parameters,
-    tuples, and scatters. In-place scatters on donated buffers touch only
-    the updated rows at runtime; anything else vocab-sized (adds,
+    tuples/get-tuple-element plumbing, and in-place row updates.
+    Depending on the XLA version the sparse row writes lower either to
+    named `scatter` ops or to `dynamic-update-slice` (and
+    `select_dynamic-update-slice` fusions); both touch only the updated
+    rows at runtime when the destination buffer is donated, so both are
+    O(touched rows), not O(vocab). Anything else vocab-sized (adds,
     selects, multiplies, zeros broadcasts) is real O(vocab) per-step
     traffic."""
     import re
 
-    pat = re.compile(r"= f32\[%d,%d\]\{[0-9,]*\} (\w+)" % (vocab, dim))
+    pat = re.compile(r"= f32\[%d,%d\]\{[0-9,]*\} ([\w-]+)" % (vocab, dim))
     ops = []
     for line in hlo.splitlines():
         m = pat.search(line)
         if not m:
             continue
         kind = m.group(1)
-        if kind in ("parameter", "tuple"):
+        if kind in ("parameter", "tuple", "get-tuple-element"):
             continue
-        if "scatter" in line:
+        if "scatter" in line or "dynamic-update-slice" in line:
             continue
         ops.append(line.strip()[:120])
     return ops
