@@ -110,6 +110,7 @@ drill:
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_router_chaos_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu EDL_KV_CACHE_DTYPE=int8 $(PY) scripts/run_autoscale_drill.py
 	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_stall_drill.py
+	env -u PYTHONPATH JAX_PLATFORMS=cpu $(PY) scripts/run_rollout_drill.py
 
 # Serving smoke: closed-loop load against the real continuous-batching
 # server, one BENCH_*-style JSON line (p50/p99 TTFT, tok/s, goodput).

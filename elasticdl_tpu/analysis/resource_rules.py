@@ -128,6 +128,18 @@ RECEIVER_PAIRS = {
         frozenset(["import_chain", "abort_transfer"]),
         "disagg",
     ),
+    # the rollout controller's wave lifecycle (serving/rollout.py): a
+    # wave opened over a set of replicas must settle in commit_wave
+    # (the soak passed) or rollback_wave (judgment turned the fleet
+    # around) on EVERY path — an unsettled wave is a fleet stuck on a
+    # mixed version with the journal claiming the wave is still in
+    # flight
+    "begin_wave": (frozenset(["commit_wave", "rollback_wave"]), None),
+    # and its checkpoint staging: a staged target version must be
+    # activated (manifest accepted, swaps may start) or discarded
+    # (verification error surfaced) — a staged-and-forgotten
+    # checkpoint is a verification verdict nobody read
+    "stage_checkpoint": (frozenset(["activate", "discard"]), None),
 }
 
 #: value-bound acquires: callable tail -> release method names

@@ -23,6 +23,7 @@ variables, so resume restores the *optimizer* exactly, and shard files are
 written by hosts (process h writes shards h, h+P, ...) instead of PS pods.
 """
 
+import hashlib
 import json
 import os
 import re
@@ -266,13 +267,13 @@ class CheckpointSaver(object):
             if extra:
                 # process-local leaves ride this process's first shard
                 shards[proc].update(extra)
+            digests = {}
             for i in range(proc, self.num_shards, nproc):
-                path = os.path.join(
-                    write_dir,
-                    "variables-%d-of-%d.ckpt" % (i, self.num_shards),
-                )
-                with open(path, "wb") as f:
-                    f.write(serialize_ndarray_dict(shards[i]))
+                name = "variables-%d-of-%d.ckpt" % (i, self.num_shards)
+                payload = serialize_ndarray_dict(shards[i])
+                with open(os.path.join(write_dir, name), "wb") as f:
+                    f.write(payload)
+                digests[name] = hashlib.sha256(payload).hexdigest()
             if proc == 0:
                 meta = {
                     "version": version,
@@ -282,6 +283,14 @@ class CheckpointSaver(object):
                     # shards whose counts process 0 cannot know
                     "leaf_count": len(flat),
                 }
+                if nproc == 1:
+                    # integrity manifest: single-process saves cover the
+                    # full shard set, so the digests let a rollout
+                    # controller reject a torn/bit-flipped checkpoint
+                    # BEFORE any replica swaps (verify_checkpoint).
+                    # Multi-host saves skip it — process 0 never sees the
+                    # other hosts' shard bytes.
+                    meta["shard_digests"] = digests
                 with open(os.path.join(write_dir, "meta.json"), "w") as f:
                     json.dump(meta, f)
                 if tmp_dir is not None:
@@ -401,6 +410,83 @@ def load_checkpoint(checkpoint_dir, version=None):
             with open(os.path.join(vdir, name), "rb") as f:
                 flat.update(deserialize_ndarray_dict(f.read()))
     return flat, version
+
+
+class CheckpointCorruptError(Exception):
+    """A checkpoint version failed integrity verification (torn shard
+    set, digest mismatch, unreadable meta). Raised by verify_checkpoint
+    so callers can distinguish 'bad bytes on disk' from 'no checkpoint
+    yet' (FileNotFoundError)."""
+
+
+def verify_checkpoint(checkpoint_dir, version):
+    """Integrity-check one checkpoint version WITHOUT deserializing it.
+
+    Returns a manifest dict {version, num_shards, leaf_count, bytes,
+    verified_digests} suitable for journaling. Raises FileNotFoundError
+    when the version dir does not exist at all, CheckpointCorruptError
+    when it exists but is torn or corrupt:
+
+    * the shard set must be complete (M files of ``variables-*-of-M``);
+    * when meta.json names a shard count, that exact set must be the
+      complete one (a stale foreign-count set does not pass);
+    * when meta.json carries shard_digests (single-process saves), every
+      named shard must exist and hash to its recorded sha256 — this is
+      the check that catches a poisoned/bit-flipped weight file before a
+      rollout swaps any replica.
+    """
+    vdir = os.path.join(checkpoint_dir, "version-%d" % int(version))
+    if not os.path.isdir(vdir):
+        raise FileNotFoundError("No checkpoint dir %r" % vdir)
+    complete = _complete_set_counts(vdir)
+    if not complete:
+        raise CheckpointCorruptError(
+            "torn checkpoint %r: no complete shard set" % vdir
+        )
+    meta = {}
+    meta_path = os.path.join(vdir, "meta.json")
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (ValueError, OSError) as e:
+            raise CheckpointCorruptError(
+                "unreadable meta.json in %r: %s" % (vdir, e)
+            )
+    want = meta.get("num_shards")
+    if want is not None and int(want) not in complete:
+        raise CheckpointCorruptError(
+            "torn checkpoint %r: meta names %s shards but complete "
+            "sets are %s" % (vdir, want, complete)
+        )
+    if want is None:
+        want = max(complete)
+    digests = meta.get("shard_digests") or {}
+    verified = 0
+    total_bytes = 0
+    for name, recorded in sorted(digests.items()):
+        path = os.path.join(vdir, name)
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                "missing digested shard %r: %s" % (path, e)
+            )
+        total_bytes += len(payload)
+        if hashlib.sha256(payload).hexdigest() != recorded:
+            raise CheckpointCorruptError(
+                "digest mismatch for %r: checkpoint bytes do not match "
+                "the manifest written at save time" % path
+            )
+        verified += 1
+    return {
+        "version": int(version),
+        "num_shards": int(want),
+        "leaf_count": meta.get("leaf_count"),
+        "bytes": total_bytes,
+        "verified_digests": verified,
+    }
 
 
 def restore_state_from_flat(state, flat, strict=True):

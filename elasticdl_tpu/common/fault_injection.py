@@ -264,6 +264,14 @@ SERVING_RPCS = (
     "transfer_chain",
     "abort_transfer",
     "disagg_handoff",
+    # explicit checkpoint swap (rollout controller handshake) plus the
+    # checkpoint_read intercept HOOK the hot-reload watcher consults
+    # before every filesystem read — a drill can manufacture a torn or
+    # glacially slow checkpoint store without touching disk:
+    #   checkpoint_read:error:*         every reload attempt fails
+    #   checkpoint_read:delay:1:secs=5  one slow shard read
+    "reload_checkpoint",
+    "checkpoint_read",
 ) + ROUTER_RPCS
 
 # The replica supervisor/autoscaler's process boundary
@@ -280,6 +288,21 @@ SUPERVISOR_RPCS = (
     "supervisor_spawn",
     "supervisor_ready",
     "supervisor_adopt",
+    # the fleet rollout controller (serving/rollout.py), same direct
+    # intercept() style: rollout_swap fires before each replica's
+    # reload_checkpoint dispatch, rollout_judge before each canary
+    # judgment evaluation —
+    #   rollout_swap:kill:1:skip=1   the controller dies mid-wave (the
+    #                                rollout drill's journal-resume
+    #                                phase: a fresh controller must
+    #                                finish the rollout with no
+    #                                double-swap)
+    #   rollout_swap:delay:*:secs=2  every swap is slow
+    #   rollout_judge:drop:1         one judgment evaluation is skipped
+    #                                (the timeout fail-safe path: no
+    #                                verdict => no promotion)
+    "rollout_swap",
+    "rollout_judge",
 )
 
 # The multi-cell router tier's process boundary (serving/router_cell.py

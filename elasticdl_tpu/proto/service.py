@@ -57,6 +57,14 @@ _SERVING_METHODS = {
         pb.TransferChainResponse,
         False,
     ),
+    # explicit checkpoint swap (serving/rollout.py handshake): load
+    # exactly the named version — newer or older — on the scheduler
+    # thread, draining advertised for the duration
+    "reload_checkpoint": (
+        pb.ReloadCheckpointRequest,
+        pb.ReloadCheckpointResponse,
+        False,
+    ),
 }
 
 # the routing tier's surface (serving/router.py); names are distinct

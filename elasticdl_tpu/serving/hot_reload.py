@@ -17,10 +17,21 @@ request whose prompt straddled a training checkpoint boundary, and the
 response carries the version that produced its last token. Requests
 never drop: nothing about the pool changes shape.
 
-Failure isolation: a checkpoint that fails to load (torn write beaten
-by the validity check, architecture drift, ...) logs and keeps serving
-the current params; the watcher retries on the next poll only when a
-newer version appears.
+Failure isolation: a checkpoint that fails integrity or load (torn
+write beaten by the validity check, digest mismatch, architecture
+drift, ...) MUST leave the old params serving. Each attempt retries
+with backoff up to `retries` times inside the same poll; exhaustion
+latches `reload_failed` (surfaced on ServerStatus so the router and the
+rollout controller can see a replica that cannot take the new version)
+until a load eventually succeeds. The poll path additionally remembers
+the failed version so it doesn't re-chew the same bytes every tick —
+only a NEWER version clears that latch.
+
+`load_version` is the rollout controller's explicit handshake: unlike
+poll it accepts any target — including an OLDER version, which is
+exactly what a rollback is — and raises ReloadError on exhaustion so
+the reload RPC can return a structured failure instead of a silent
+no-op.
 """
 
 import time
@@ -29,8 +40,14 @@ from elasticdl_tpu.checkpoint.saver import (
     get_latest_checkpoint_version,
     load_checkpoint,
     restore_state_from_flat,
+    verify_checkpoint,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class ReloadError(Exception):
+    """All load attempts for an explicitly requested checkpoint version
+    failed; the old params are still serving."""
 
 
 class CheckpointWatcher(object):
@@ -39,23 +56,84 @@ class CheckpointWatcher(object):
     template_state: a TrainState-shaped pytree (the serving trainer's
     own init_state) that gives every leaf its dtype and sharding;
     strict=False so a dense training checkpoint can warm-start a
-    serving model with extra leaves (e.g. LoRA adapters)."""
+    serving model with extra leaves (e.g. LoRA adapters).
+
+    retries/backoff_secs: per-reload retry ladder (attempt, sleep b,
+    attempt, sleep 2b, ...). injector: optional FaultInjector whose
+    `checkpoint_read` hook fires before every filesystem read, so
+    drills can manufacture torn/slow checkpoint stores."""
 
     def __init__(self, checkpoint_dir, template_state,
-                 poll_secs=2.0, start_version=-1, clock=time.monotonic):
+                 poll_secs=2.0, start_version=-1, clock=time.monotonic,
+                 retries=3, backoff_secs=0.2, sleep=time.sleep,
+                 injector=None):
         self.checkpoint_dir = checkpoint_dir
         self.template_state = template_state
         self.poll_secs = float(poll_secs)
         self.version = int(start_version)
         self._clock = clock
+        self._sleep = sleep
         self._next_poll = 0.0
         self._failed_version = None
+        self.retries = max(1, int(retries))
+        self.backoff_secs = float(backoff_secs)
+        self.injector = injector
+        self.reload_failed = False
+        self.last_error = ""
+
+    # ------------------------------------------------------------ internals
+
+    def _intercept(self):
+        if self.injector is not None:
+            self.injector.intercept("checkpoint_read")
+
+    def _try_load(self, version):
+        """One integrity-checked load attempt. Raises on any failure."""
+        self._intercept()
+        verify_checkpoint(self.checkpoint_dir, version)
+        flat, got = load_checkpoint(self.checkpoint_dir, version=version)
+        state = restore_state_from_flat(
+            self.template_state, flat, strict=False
+        )
+        return state, got
+
+    def _load_with_retries(self, version):
+        """Retry ladder around _try_load. Returns (state, version) or
+        raises the LAST error after `retries` attempts; never mutates
+        self.version on failure — old params keep serving."""
+        last = None
+        for attempt in range(self.retries):
+            try:
+                out = self._try_load(version)
+                self.reload_failed = False
+                self.last_error = ""
+                return out
+            except Exception as e:  # noqa: BLE001 - keep serving
+                last = e
+                logger.error(
+                    "checkpoint version-%d load attempt %d/%d failed "
+                    "(still serving version-%d): %s",
+                    version, attempt + 1, self.retries, self.version, e,
+                )
+                if attempt + 1 < self.retries:
+                    self._sleep(self.backoff_secs * (2 ** attempt))
+        self.reload_failed = True
+        self.last_error = "%s: %s" % (type(last).__name__, last)
+        raise last
+
+    # ------------------------------------------------------------ public
 
     def poll(self, force=False):
         """Returns (new_state, version) when a newer valid checkpoint
         loaded, else None. Rate-limited to poll_secs; `force` bypasses
         the limiter (tests, explicit reload RPCs)."""
         if not self.checkpoint_dir:
+            return None
+        if self.poll_secs <= 0 and not force:
+            # explicit-reload-only mode (--reload_poll_secs 0): a
+            # rollout-managed fleet must not self-upgrade behind the
+            # controller's back — or self-REVERT a rollback the moment
+            # its own poll sees the (newer) version it was rolled off
             return None
         now = self._clock()
         if not force and now < self._next_poll:
@@ -65,20 +143,35 @@ class CheckpointWatcher(object):
         if latest <= self.version or latest == self._failed_version:
             return None
         try:
-            flat, version = load_checkpoint(
-                self.checkpoint_dir, version=latest
-            )
-            state = restore_state_from_flat(
-                self.template_state, flat, strict=False
-            )
-        except Exception as e:  # noqa: BLE001 - keep serving on failure
-            logger.error(
-                "hot reload of version-%d failed (still serving "
-                "version-%d): %s", latest, self.version, e,
-            )
+            state, version = self._load_with_retries(latest)
+        except Exception:  # noqa: BLE001 - keep serving on failure
             self._failed_version = latest
             return None
         self.version = version
         self._failed_version = None
         logger.info("hot reload: serving checkpoint version-%d", version)
         return state, version
+
+    def load_version(self, version):
+        """Explicitly load `version` (newer OR older — rollbacks go
+        through here). Returns (state, version) on success; raises
+        ReloadError after the retry ladder is exhausted, with the old
+        params untouched and reload_failed latched."""
+        version = int(version)
+        if not self.checkpoint_dir:
+            raise ReloadError("no checkpoint_dir configured")
+        if version == self.version:
+            return None  # already serving it — idempotent no-op
+        try:
+            state, got = self._load_with_retries(version)
+        except Exception as e:  # noqa: BLE001 - structured failure
+            raise ReloadError(
+                "reload to version-%d failed after %d attempts: %s"
+                % (version, self.retries, e)
+            )
+        self.version = got
+        self._failed_version = None
+        logger.info(
+            "explicit reload: serving checkpoint version-%d", got
+        )
+        return state, got

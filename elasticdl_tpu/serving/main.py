@@ -45,7 +45,11 @@ def parse_serving_args(args=None):
                              "pool full of blocked generate handlers "
                              "starves server_status and the router "
                              "reads the silence as lease decay")
-    parser.add_argument("--reload_poll_secs", type=float, default=2.0)
+    parser.add_argument("--reload_poll_secs", type=float, default=2.0,
+                        help="0 disables the watcher's self-upgrade "
+                             "poll: checkpoints load only through the "
+                             "explicit reload_checkpoint RPC (the "
+                             "rollout-managed fleet mode)")
     parser.add_argument("--tensorboard_log_dir", default="")
     # KV pool layout: -1 resolves from EDL_KV_PAGED (the drill/CI
     # toggle); 1 = block-paged pool (serving/kv_pool.py), 0 = dense

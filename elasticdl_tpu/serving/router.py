@@ -362,6 +362,12 @@ class Replica(object):
         # as unified): "prefill" replicas leave normal rotation and
         # serve only cache-warming handoffs
         "role": "",
+        # checkpoint identity: the version this replica is serving
+        # plus the hot-reload failure latch — the rollout controller's
+        # per-replica ground truth (a wave commits only when every
+        # member advertises the target version)
+        "model_version": 0,
+        "reload_failed": False,
     }
 
     #: repeated heartbeat fields (histogram BUCKETS, mergeable by
@@ -379,7 +385,8 @@ class Replica(object):
         "revive_uploads", "prefill_tokens_revived", "host_drops",
         "prefix_hit_rate_window", "queue_wait_ms", "dispatched",
         "failures", "inflight", "slow_cause_counts", "health_state",
-        "last_progress_age_ms", "role",
+        "last_progress_age_ms", "role", "model_version",
+        "reload_failed",
     )
 
     #: the router-derived remainder of pb.ReplicaStatus —
@@ -605,6 +612,13 @@ class Router(object):
         # autoscaler block; the router never calls INTO it while
         # holding _lock (lock order: supervisor -> router, one way)
         self.autoscaler = None
+        # optional fleet rollout controller (serving/rollout.py):
+        # contributes the router_status rollout block; same one-way
+        # lock order as the autoscaler (controller -> router). The
+        # hold set steers NEW dispatches away from a replica about to
+        # swap checkpoints before its own draining advertisement lands
+        self.rollout = None
+        self._rollout_hold = set()
         # tail-based trace retention: the router's request roots are
         # classified against the SAME declared SLO thresholds the burn
         # engine evaluates — a breaching, shed, re-dispatched, hedged
@@ -642,6 +656,33 @@ class Router(object):
         router_status.autoscaler. The supervisor's lifecycle is owned
         by the caller (router_main), not by Router.stop()."""
         self.autoscaler = supervisor
+
+    def set_rollout(self, controller):
+        """Attach the fleet rollout controller whose status_block()
+        fills router_status.rollout. Same ownership contract as the
+        autoscaler: lifecycle belongs to router_main, and the
+        controller calls INTO the router (hold/release, slo_reports)
+        — never the reverse while a router lock is held."""
+        self.rollout = controller
+
+    # ------------------------------------------------- rollout steering
+
+    def hold_replica(self, address):
+        """Steer NEW dispatches away from a replica about to swap
+        checkpoints, ahead of its own `draining` advertisement landing
+        on a heartbeat (the advertisement lags by up to poll_secs; the
+        hold closes that window). In-flight work is untouched. The
+        rollout controller pairs every hold with release_replica."""
+        with self._lock:
+            self._rollout_hold.add(address)
+
+    def release_replica(self, address):
+        with self._lock:
+            self._rollout_hold.discard(address)
+
+    def held_replicas(self):
+        with self._lock:
+            return set(self._rollout_hold)
 
     # ------------------------------------------------------- membership
 
@@ -794,6 +835,9 @@ class Router(object):
                 # dedicated prefill replicas serve cache-warming
                 # handoffs only — never normal decode traffic
                 and r.role != "prefill"
+                # rollout steering: a replica held for a checkpoint
+                # swap takes no new work
+                and r.address not in self._rollout_hold
             ]
         candidates.sort(
             key=lambda r: (r.load_score(), -r.kv_blocks_free, r.address)
@@ -821,7 +865,8 @@ class Router(object):
         rotation right now (the caller just dispatches cold)."""
         with self._lock:
             pool = [r for r in self._replicas.values()
-                    if r.in_rotation(now) and r.role == "prefill"]
+                    if r.in_rotation(now) and r.role == "prefill"
+                    and r.address not in self._rollout_hold]
         pool.sort(key=lambda r: (r.load_score(), r.address))
         for rep in pool:
             if rep.breaker.acquire(now):
@@ -836,7 +881,8 @@ class Router(object):
         judging the target's transport."""
         with self._lock:
             candidates = [r for r in self._replicas.values()
-                          if r.in_rotation(now) and r.role != "prefill"]
+                          if r.in_rotation(now) and r.role != "prefill"
+                          and r.address not in self._rollout_hold]
         if not candidates:
             return None
         candidates.sort(
@@ -1333,6 +1379,9 @@ class Router(object):
         autoscaler = None
         if self.autoscaler is not None:
             autoscaler = self.autoscaler.status_block()
+        rollout = None
+        if self.rollout is not None:
+            rollout = self.rollout.status_block()
         # fleet-wide host-tier view: occupancy gauges and the monotone
         # revival economy sum across replicas (counters are monotone
         # per replica, so the fleet sums are monotone too while the
@@ -1365,6 +1414,7 @@ class Router(object):
         ]
         return pb.RouterStatusResponse(
             autoscaler=autoscaler,
+            rollout=rollout,
             slo=slo_blocks,
             replicas=len(reps),
             healthy=sum(1 for r in reps if r.healthy),
@@ -1447,6 +1497,31 @@ class Router(object):
                 "edl_autoscaler_circuit_open",
                 "1 when the restart circuit is open",
                 [({}, 1.0 if block.circuit_open else 0.0)],
+            ))
+        ctl = self.rollout
+        if ctl is not None:
+            block = ctl.status_block()
+            for name in ("target_version", "old_version", "wave",
+                         "waves_total", "swapped", "fleet"):
+                fams.append(gauge_family(
+                    "edl_rollout_%s" % name,
+                    "rollout controller gauge %s" % name,
+                    [({}, getattr(block, name))],
+                ))
+            fams.append(gauge_family(
+                "edl_rollout_active",
+                "1 while a rollout is in flight (any non-terminal "
+                "phase)",
+                [({"phase": block.phase},
+                  0.0 if block.phase in ("idle", "committed",
+                                         "rolled_back", "aborted")
+                  else 1.0)],
+            ))
+            fams.append(counter_family(
+                "edl_rollout_rollbacks_total",
+                "replica checkpoint swaps reversed by judgment or "
+                "burn (rollback swap count)",
+                block.rollbacks,
             ))
         return fams
 

@@ -41,6 +41,21 @@ Clients reach the tier through the CellFront (consistent-hash by
 prefix fingerprint, ring-successor reroute on cell death) or any
 single cell directly — every cell serves the full Router surface.
 
+With --rollout V the process also owns a ROLLOUT CONTROLLER
+(serving/rollout.py): the fleet rolls to checkpoint version V through
+canary -> greedy-parity + SLO-burn judgment -> progressive waves ->
+commit, journaling every transition to --rollout_journal_dir so a
+router restart resumes the rollout mid-wave (no --rollout needed the
+second time) with no replica double-swapped or left on a mixed
+version. A failed judgment — parity drift, fast-window burn, or no
+verdict inside the judge timeout — rolls every swapped replica back
+in reverse order automatically:
+
+    python -m elasticdl_tpu.serving.router_main --port 50050 \\
+        --replica localhost:50051 --replica localhost:50052 \\
+        --rollout 7 --rollout_checkpoint_dir /ckpt \\
+        --rollout_journal_dir /var/lib/edl/rollout
+
 Fault injection at the router boundary uses the same EDL_FAULT_SPEC
 grammar as every other drill, under the router RPC names:
 EDL_FAULT_SPEC='router_generate:error:2' rejects two routed calls
@@ -160,6 +175,34 @@ def parse_router_args(args=None):
     parser.add_argument("--scale_cooldown_secs", type=float,
                         default=5.0)
     parser.add_argument("--max_restarts", type=int, default=3)
+    # ---- zero-downtime model rollout (serving/rollout.py) ----
+    parser.add_argument("--rollout_journal_dir", default="",
+                        help="enable the rollout controller, journaling "
+                             "every wave transition here; a restarted "
+                             "router resumes an unfinished rollout "
+                             "from this journal even without --rollout")
+    parser.add_argument("--rollout_checkpoint_dir", default="",
+                        help="checkpoint store the fleet reads (match "
+                             "the replicas' --checkpoint_dir)")
+    parser.add_argument("--rollout", type=int, default=-1,
+                        help=">= 0: roll the fleet to this checkpoint "
+                             "version (canary -> judge -> waves -> "
+                             "commit); -1 only resumes a journaled "
+                             "rollout, if one is in flight")
+    parser.add_argument("--rollout_wave_size", type=int, default=1,
+                        help="replicas swapped per progressive wave "
+                             "after the canary passes judgment")
+    parser.add_argument("--rollout_soak_secs", type=float, default=3.0,
+                        help="burn-rate observation window per wave "
+                             "(and the canary's minimum soak)")
+    parser.add_argument("--rollout_judge_timeout_secs", type=float,
+                        default=60.0,
+                        help="no canary verdict within this window is "
+                             "itself a verdict: no promotion")
+    parser.add_argument("--rollout_parity_prompts", default="1,2,3",
+                        help="pinned greedy-parity prompt set: "
+                             "semicolon-separated comma-lists of token "
+                             "ids, e.g. '1,2,3;4,5'")
     parsed = parser.parse_args(args)
     if (not parsed.replica and not parsed.autoscale
             and not parsed.cell_journal_dir):
@@ -169,6 +212,13 @@ def parse_router_args(args=None):
     if parsed.autoscale and not parsed.replica_args:
         parser.error("--autoscale needs --replica_args to know how to "
                      "launch replicas")
+    if parsed.rollout >= 0 and not parsed.rollout_journal_dir:
+        parser.error("--rollout needs --rollout_journal_dir: an "
+                     "unjournaled fleet swap cannot survive a "
+                     "controller crash")
+    if (parsed.rollout >= 0 and not parsed.rollout_checkpoint_dir):
+        parser.error("--rollout needs --rollout_checkpoint_dir to "
+                     "verify the target checkpoint before any swap")
     return parsed
 
 
@@ -424,6 +474,18 @@ def main(argv=None):
     supervisor = None
     if args.autoscale:
         supervisor = build_supervisor(args, router).start()
+    rollout = None
+    if args.rollout_journal_dir:
+        from elasticdl_tpu.serving.rollout import build_rollout
+
+        rollout = build_rollout(args, router)
+        router.set_rollout(rollout)
+        if args.rollout >= 0:
+            # deferred: the first decide tick that finds a registered
+            # fleet opens the rollout (the autoscaler may still be
+            # spawning replicas when we get here)
+            rollout.request(args.rollout)
+        rollout.start()
     # name this process's span recorder; spans export to
     # $EDL_TRACE_DIR on stop (plus an atexit backstop)
     from elasticdl_tpu.observability.tracing import configure
@@ -448,8 +510,11 @@ def main(argv=None):
               flush=True)
     print("ROUTER_READY port=%d" % router.port, flush=True)
     done.wait()
-    # supervisor first: it drains and retires the fleet it owns; the
-    # router keeps answering status RPCs until the roster is gone
+    # rollout controller first (it calls INTO the fleet), then the
+    # supervisor (it drains and retires the fleet it owns); the router
+    # keeps answering status RPCs until the roster is gone
+    if rollout is not None:
+        rollout.stop()
     if supervisor is not None:
         supervisor.stop()
     router.stop()

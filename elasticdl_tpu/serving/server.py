@@ -66,7 +66,7 @@ from elasticdl_tpu.observability.runtime_health import (
     runtime_health_default,
     stall_after_default,
 )
-from elasticdl_tpu.serving.hot_reload import CheckpointWatcher
+from elasticdl_tpu.serving.hot_reload import CheckpointWatcher, ReloadError
 from elasticdl_tpu.serving.telemetry import ServingTelemetry
 
 
@@ -340,6 +340,31 @@ class _Scheduler(threading.Thread):
     def _run_jobs(self):
         while self._jobs:
             self._jobs.popleft()()
+
+    def reload_to(self, version):
+        """Explicit checkpoint swap (the rollout controller's
+        reload_checkpoint handshake). MUST run on the scheduler thread
+        — handlers reach it through submit_job — because set_params is
+        jax work that serializes with the decode loop. Unlike the poll
+        path this accepts any target version, older included (that is
+        what a rollback is). Raises ReloadError with the old params
+        still serving when the watcher's retry ladder is exhausted.
+        Returns the version now serving."""
+        if self.watcher is None:
+            raise ReloadError("no checkpoint watcher configured")
+        # same flag discipline as the poll reload: only the reload's
+        # OWN transient flag clears, so a SIGTERM drain that starts
+        # mid-swap stays advertised
+        self._reloading.set()
+        try:
+            got = self.watcher.load_version(version)
+            if got is not None:
+                state, ver = got
+                self.engine.set_params(state, ver)
+                self.telemetry.count("reloads")
+        finally:
+            self._reloading.clear()
+        return int(self.engine.model_version)
 
     def _iterate(self):
         self._run_jobs()
@@ -636,7 +661,7 @@ class ServingServicer(object):
     def __init__(self, queue, engine, telemetry, scheduler_alive,
                  handler_poll_secs=0.25, clock=time.monotonic,
                  draining=None, health=None, role="unified",
-                 submit_job=None):
+                 submit_job=None, watcher=None, reload_fn=None):
         self._queue = queue
         self._engine = engine
         self._telemetry = telemetry
@@ -655,6 +680,12 @@ class ServingServicer(object):
         # bare single-threaded tests use)
         self._role = role
         self._submit_job = submit_job or (lambda fn, timeout=30.0: fn())
+        # explicit checkpoint handshake (serving/rollout.py): the
+        # watcher is read for the reload_failed advertisement on
+        # ServerStatus; reload_fn (scheduler.reload_to) runs through
+        # submit_job because the swap is scheduler-thread jax work
+        self._watcher = watcher
+        self._reload_fn = reload_fn
         # transfer-family RPCs currently executing here; 0 after a
         # drain is the kill-drill's clean-handoff-ledger assertion
         self._transfers_inflight = 0
@@ -774,6 +805,35 @@ class ServingServicer(object):
             transfer_id=request.transfer_id, ok=True
         )
 
+    def reload_checkpoint(self, request, context=None):
+        """Explicit checkpoint swap (the rollout controller's
+        handshake): load exactly request.version — newer or older — on
+        the scheduler thread, draining advertised for the duration.
+        Load failures come back as a structured ok=False verdict (old
+        params still serving, reload_failed latched on ServerStatus);
+        only scheduler-liveness problems surface as RPC errors."""
+        if self._reload_fn is None:
+            self._fail(context, "FAILED_PRECONDITION",
+                       "no checkpoint watcher configured")
+        version = int(request.version)
+        try:
+            now_serving = self._submit_job(
+                lambda: self._reload_fn(version), timeout=120.0
+            )
+        except AdmissionError:
+            raise
+        except Exception as e:  # noqa: BLE001 - structured verdict
+            return pb.ReloadCheckpointResponse(
+                ok=False,
+                model_version=int(self._engine.model_version),
+                error="%s" % (e,),
+            )
+        return pb.ReloadCheckpointResponse(
+            ok=bool(now_serving == version), model_version=now_serving,
+            error="" if now_serving == version else
+            "serving version-%d after reload" % now_serving,
+        )
+
     def server_status(self, request, context=None):
         snap = self._telemetry.snapshot()
         kv = self._engine.kv_stats()
@@ -847,6 +907,15 @@ class ServingServicer(object):
             chain_import_tokens=kv.get("chain_import_tokens", 0),
             transfer_aborts=transfer_aborts,
             transfers_inflight=transfers_inflight,
+            # hot-reload failure latch: the watcher exhausted its retry
+            # ladder — old params still serving, error carried verbatim
+            reload_failed=(
+                bool(self._watcher.reload_failed) if self._watcher
+                else False
+            ),
+            reload_error=(
+                self._watcher.last_error if self._watcher else ""
+            ),
             # runtime health self-report (observability/
             # runtime_health.py); all-zero/"" with the plane off —
             # the wire signal routers/autoscalers key the fallback on
@@ -1042,6 +1111,7 @@ class GenerationServer(object):
                 cfg.checkpoint_dir, state,
                 poll_secs=cfg.reload_poll_secs,
                 start_version=self.engine.model_version,
+                injector=self._injector,
             )
         self.watcher = watcher
         self.scheduler = _Scheduler(
@@ -1059,6 +1129,8 @@ class GenerationServer(object):
             health=self.health,
             role=cfg.role,
             submit_job=self.scheduler.submit_job,
+            watcher=watcher,
+            reload_fn=self.scheduler.reload_to if watcher else None,
         )
         # the unwrapped servicer: in-process warmup (serving/main.py
         # --warmup_tokens) goes through it so a warmup request can

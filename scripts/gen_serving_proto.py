@@ -196,6 +196,26 @@ SERVING_MESSAGES = {
         ("chain_import_tokens", 55, T.TYPE_INT64, _OPT),
         ("transfer_aborts", 56, T.TYPE_INT64, _OPT),
         ("transfers_inflight", 57, T.TYPE_INT32, _OPT),
+        # hot-reload failure advertisement (serving/hot_reload.py):
+        # the watcher exhausted its retry ladder against a checkpoint
+        # that would not verify/load — old params still serving, error
+        # carried verbatim so the rollout controller can abort with
+        # evidence instead of inferring from a version that never moves
+        ("reload_failed", 58, T.TYPE_BOOL, _OPT),
+        ("reload_error", 59, T.TYPE_STRING, _OPT),
+    ],
+    # ---- explicit checkpoint handshake (serving/rollout.py) ----
+    # The rollout controller's swap RPC: unlike the poll path this
+    # names an exact target version — including an OLDER one, which is
+    # what a rollback is — and returns a structured verdict instead of
+    # relying on the caller to notice the version never moved.
+    "ReloadCheckpointRequest": [
+        ("version", 1, T.TYPE_INT32, _OPT),
+    ],
+    "ReloadCheckpointResponse": [
+        ("ok", 1, T.TYPE_BOOL, _OPT),
+        ("model_version", 2, T.TYPE_INT32, _OPT),
+        ("error", 3, T.TYPE_STRING, _OPT),
     ],
     # ---- disaggregated prefill/decode handoff (serving/disagg.py) ----
     # One finished prefix chain exported as a dense byte copy: the
@@ -286,6 +306,29 @@ SERVING_MESSAGES = {
         ("slow_samples", 10, T.TYPE_INT64, _OPT),
         ("alerting", 11, T.TYPE_BOOL, _OPT),
     ],
+    # the fleet rollout controller (serving/rollout.py): journaled
+    # canary -> judge -> progressive waves -> commit state machine.
+    # phase names the wave controller's current state ("idle" when no
+    # rollout has ever run); verdict carries the canary judgment
+    # ("pass" | "parity_fail" | "burn_fail" | "timeout" | "" while
+    # undecided); rollout_restarts counts controllers that came up over
+    # this journal — the crash-recovery odometer the rollout drill
+    # asserts on
+    "RolloutStatus": [
+        ("enabled", 1, T.TYPE_BOOL, _OPT),
+        ("phase", 2, T.TYPE_STRING, _OPT),
+        ("target_version", 3, T.TYPE_INT32, _OPT),
+        ("old_version", 4, T.TYPE_INT32, _OPT),
+        ("wave", 5, T.TYPE_INT32, _OPT),
+        ("waves_total", 6, T.TYPE_INT32, _OPT),
+        ("swapped", 7, T.TYPE_INT32, _OPT),
+        ("fleet", 8, T.TYPE_INT32, _OPT),
+        ("canary", 9, T.TYPE_STRING, _OPT),
+        ("verdict", 10, T.TYPE_STRING, _OPT),
+        ("last_error", 11, T.TYPE_STRING, _OPT),
+        ("rollbacks", 12, T.TYPE_INT64, _OPT),
+        ("rollout_restarts", 13, T.TYPE_INT64, _OPT),
+    ],
     "ReplicaStatus": [
         ("address", 1, T.TYPE_STRING, _OPT),
         ("healthy", 2, T.TYPE_BOOL, _OPT),
@@ -334,6 +377,13 @@ SERVING_MESSAGES = {
         # "prefill" replicas leave the normal dispatch rotation and
         # serve only cache-warming prefills + chain exports
         ("role", 25, T.TYPE_STRING, _OPT),
+        # checkpoint identity, passed through from ServerStatus: the
+        # version this replica is serving right now plus the hot-reload
+        # failure latch — together the rollout controller's per-replica
+        # ground truth (a wave commits only when every member's
+        # advertised version equals the target)
+        ("model_version", 26, T.TYPE_INT32, _OPT),
+        ("reload_failed", 27, T.TYPE_BOOL, _OPT),
     ],
     "RouterStatusResponse": [
         ("replicas", 1, T.TYPE_INT32, _OPT),
@@ -397,6 +447,10 @@ SERVING_MESSAGES = {
         # replica paid prefill itself — degraded, never lost)
         ("disagg_handoffs", 35, T.TYPE_INT64, _OPT),
         ("disagg_fallbacks", 36, T.TYPE_INT64, _OPT),
+        # fleet rollout controller block (serving/rollout.py); unset
+        # when no controller is attached
+        ("rollout", 37, T.TYPE_MESSAGE, _OPT,
+         ".elasticdl_tpu.RolloutStatus"),
     ],
 }
 
@@ -431,6 +485,11 @@ SERVICES = {
          False),
         ("abort_transfer", "AbortTransferRequest", "TransferChainResponse",
          False),
+        # explicit checkpoint swap (rollout controller handshake):
+        # load exactly this version — newer or older — on the
+        # scheduler thread, draining advertised for the duration
+        ("reload_checkpoint", "ReloadCheckpointRequest",
+         "ReloadCheckpointResponse", False),
     ],
     # the multi-replica routing tier in front of N Serving replicas;
     # method names are distinct from the replica surface so

@@ -1,0 +1,525 @@
+#!/usr/bin/env python
+"""Zero-downtime model ROLLOUT drill: the journaled wave controller
+(serving/rollout.py) rolls a REAL 3-replica fleet between checkpoint
+versions under live Poisson load, and every failure mode it claims to
+survive is manufactured for real:
+
+  * HEALTHY ROLLOUT — version-1 -> version-2 (a republish of the same
+    weights, so greedy parity must hold) through canary -> judgment
+    (pinned-prompt parity + SLO burn over a soak window) -> waves ->
+    commit, with the open-loop load running throughout: zero
+    accepted-request loss and a steady p99 across the swap;
+  * CORRUPT CHECKPOINT — a torn shard (truncated mid-write) must
+    ABORT at staging, before ANY replica swaps: the integrity
+    manifest, not a crashed replica, is the tripwire;
+  * POISONED CANARY — perturbed weights pass integrity (they were
+    saved whole) but DRIFT on the pinned prompts: the canary is
+    judged parity_fail and auto-rolled back, and the fleet must end
+    PROVABLY UNIFORM on the old version;
+  * CONTROLLER SIGKILL MID-WAVE — the controller is abandoned (journal
+    and fleet left exactly as a kill would leave them) after the
+    canary and first wave swapped; a FRESH controller over the same
+    journal must resume and finish the rollout with every replica
+    reloaded EXACTLY ONCE — the per-replica version history is
+    asserted from the journal itself (no double-swap, no mixed
+    fleet), with a `rollout_swap` delay fault injected on the resumed
+    controller's first swap (the slow-swap spec) to prove the hook
+    sits on the real swap path.
+
+The replicas run --reload_poll_secs 0 (explicit-reload-only): a
+rollout-managed fleet must not self-upgrade behind the controller —
+or self-revert a rollback the moment its own poll sees the newer
+poisoned version again. Checkpoint loads land through the
+reload_checkpoint RPC only.
+
+The wave ledger is also audited from the journal: every wave_begin
+must settle in wave_commit or wave_rollback (the same EDL501 pair
+edl-lint enforces statically, asserted here on the real event log).
+
+Client outcomes, per-phase latency percentiles, verdicts, the
+journal's swap history and the final fleet versions are archived at
+ROLLOUT_REPORT.json (repo root).
+
+Usage: python scripts/run_rollout_drill.py
+Exit 0 = every invariant holds."""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from run_server_kill_drill import MODEL_PARAMS, launch_ready  # noqa: E402
+
+NUM_REPLICAS = 3
+OLD_V, NEW_V, POISON_V, CORRUPT_V, RESUME_V = 1, 2, 3, 4, 5
+RATE_RPS = 3.0
+MAX_NEW = 8
+CLIENT_TIMEOUT = 120.0  # backstop; the drill asserts we stay far under
+P99_BOUND_MS = 30_000.0  # generous CPU bound; a dropped/wedged swap
+# stalls dispatches far past it, a clean swap never gets near it
+PARITY_PROMPTS = ((1, 2, 3), (2, 3, 4))
+
+
+def start_replica(ckpt_dir):
+    cmd = [
+        sys.executable, "-m", "elasticdl_tpu.serving.main",
+        "--model_zoo", os.path.join(REPO, "model_zoo"),
+        "--model_def", "transformer_lm.transformer_lm.custom_model",
+        "--model_params", MODEL_PARAMS,
+        "--port", "0", "--num_slots", "2", "--queue_capacity", "32",
+        "--max_workers", "64",
+        # pay the jit compile BEFORE advertising ready
+        "--warmup_tokens", "4",
+        # explicit-reload-only: version moves ONLY via the rollout
+        # controller's reload_checkpoint RPC
+        "--checkpoint_dir", ckpt_dir, "--reload_poll_secs", "0",
+    ]
+    return launch_ready(cmd)
+
+
+def wait_for(cond, timeout, what, poll=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(poll)
+    raise AssertionError("timed out after %.0fs waiting for %s"
+                         % (timeout, what))
+
+
+def build_trainer_state():
+    """Trainer state matching the replicas' model: the checkpoint
+    payload every rollout version derives from."""
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.common.model_utils import (
+        load_model_spec_from_module,
+    )
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+    from elasticdl_tpu.training.trainer import Trainer
+    from model_zoo.transformer_lm import transformer_lm as zoo
+
+    mesh = mesh_lib.build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = Trainer(load_model_spec_from_module(zoo), mesh=mesh,
+                      model_params=MODEL_PARAMS)
+    seq_len = int(trainer.model.seq_len)
+    dummy = np.zeros((1, seq_len), np.int32)
+    return trainer.init_state(({"tokens": dummy}, dummy))
+
+
+def poison(state):
+    """Weights that pass every integrity check (saved whole, digests
+    valid) but drift on greedy decode: the silent-corruption case only
+    the parity judgment can catch."""
+    import jax
+    import jax.numpy as jnp
+
+    def twist(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype,
+                                                  jnp.floating):
+            return x * -1.5 + 0.25
+        return x
+
+    return jax.tree_util.tree_map(twist, state)
+
+
+def journal_events(journal_dir):
+    events = []
+    with open(os.path.join(journal_dir, "journal.jsonl")) as f:
+        for line in f:
+            if line.strip():
+                row = json.loads(line)
+                if "ev" in row:
+                    events.append(row)
+    return events
+
+
+def swap_history(events):
+    """addr -> [versions in landed order] from the journal's ok
+    swap_done events — the per-replica version history the no-double-
+    swap and uniform-fleet claims are audited against."""
+    hist = {}
+    for ev in events:
+        if ev.get("ev") == "swap_done" and ev.get("ok"):
+            hist.setdefault(ev["addr"], []).append(int(ev["to"]))
+    return hist
+
+
+def main():
+    import tempfile
+
+    import numpy as np
+
+    from elasticdl_tpu.checkpoint import CheckpointSaver
+    from elasticdl_tpu.common.fault_injection import FaultInjector
+    from elasticdl_tpu.observability.histogram import percentiles
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.proto.service import (
+        RouterStub,
+        ServingStub,
+        build_channel,
+    )
+    from elasticdl_tpu.serving import rollout as ro
+    from elasticdl_tpu.serving.router import Router, RouterConfig
+
+    tmp_root = tempfile.mkdtemp(prefix="edl_rollout_")
+    ckpt_dir = os.path.join(tmp_root, "ckpt")
+    journal_dir = os.path.join(tmp_root, "journal")
+
+    print("[rollout] building checkpoint payloads (jax init)")
+    state = build_trainer_state()
+    saver = CheckpointSaver(ckpt_dir, checkpoint_steps=1)
+    saver.save(state, version=OLD_V)
+    saver.save(state, version=NEW_V)  # republish: parity must hold
+
+    procs, ports = [], []
+    router = None
+    ctl = None
+    stop_load = threading.Event()
+    try:
+        print("[rollout] launching %d replicas (explicit-reload-only)"
+              % NUM_REPLICAS)
+        for _ in range(NUM_REPLICAS):
+            proc, port = start_replica(ckpt_dir)
+            procs.append(proc)
+            ports.append(port)
+        addrs = ["localhost:%d" % p for p in ports]
+
+        router = Router(addrs, RouterConfig(
+            poll_secs=0.25, poll_timeout_secs=2.0, lease_secs=2.0,
+            breaker_cooldown_secs=1.0, redispatch_window_secs=60.0,
+            max_workers=64,
+        )).start(grpc_server=True)
+        rstub = RouterStub(build_channel("localhost:%d" % router.port))
+        wait_for(
+            lambda: sum(r.in_rotation(time.monotonic())
+                        for r in router.replicas())
+            >= NUM_REPLICAS,
+            120, "all replicas healthy behind the router",
+        )
+
+        # seed the fleet onto OLD_V through the explicit reload RPC —
+        # the same handshake every rollout swap uses
+        for addr in addrs:
+            resp = ServingStub(build_channel(addr)).reload_checkpoint(
+                pb.ReloadCheckpointRequest(version=OLD_V), timeout=120
+            )
+            assert resp.ok and resp.model_version == OLD_V, (
+                "seeding %s onto version-%d failed: %s"
+                % (addr, OLD_V, resp.error)
+            )
+
+        def fleet_versions():
+            return {r.address: int(r.model_version)
+                    for r in router.replicas()}
+
+        def fleet_uniform(version):
+            vs = fleet_versions()
+            return (len(vs) == NUM_REPLICAS
+                    and set(vs.values()) == {version}) and vs
+
+        wait_for(lambda: fleet_uniform(OLD_V), 60,
+                 "fleet advertising version-%d" % OLD_V)
+        print("[rollout] fleet seeded on version-%d: %s"
+              % (OLD_V, sorted(addrs)))
+
+        # ---- open-loop Poisson load across every phase
+        outcomes, latencies = {}, {}
+        lock = threading.Lock()
+        phase_mark = ["setup"]
+        threads = []
+        rs = np.random.RandomState(7)
+
+        def call(i, phase):
+            t0 = time.monotonic()
+            try:
+                rstub.router_generate(
+                    pb.GenerateRequest(
+                        prompt=[1 + i % 5, 2],
+                        max_new_tokens=MAX_NEW, seed=i,
+                    ),
+                    timeout=CLIENT_TIMEOUT,
+                )
+                code = "OK"
+            except Exception as e:  # noqa: BLE001 - status is the datum
+                code_fn = getattr(e, "code", None)
+                code = (code_fn().name if callable(code_fn)
+                        else type(e).__name__)
+            with lock:
+                outcomes[i] = code
+                latencies[i] = (phase,
+                                (time.monotonic() - t0) * 1000.0)
+
+        def drive_load():
+            i = 0
+            while not stop_load.is_set():
+                t = threading.Thread(
+                    target=call, args=(i, phase_mark[0]), daemon=True
+                )
+                t.start()
+                threads.append(t)
+                i += 1
+                stop_load.wait(rs.exponential(1.0 / RATE_RPS))
+
+        loader = threading.Thread(target=drive_load, daemon=True)
+        loader.start()
+
+        def make_controller(injector=None):
+            cfg = ro.RolloutConfig(
+                checkpoint_dir=ckpt_dir, journal_dir=journal_dir,
+                decide_secs=0.2, wave_size=1, soak_secs=2.0,
+                judge_timeout_secs=90.0,
+                parity_prompts=PARITY_PROMPTS, parity_max_tokens=6,
+            )
+            return ro.RolloutController(router, cfg,
+                                        injector=injector)
+
+        ctl = make_controller()
+        router.set_rollout(ctl)
+        ctl.start()
+
+        def rollout_done():
+            return ctl.phase if ctl.phase in ro.TERMINAL else None
+
+        # ================= phase 1: healthy rollout, zero loss
+        phase_mark[0] = "healthy"
+        assert ctl.begin(NEW_V)
+        phase = wait_for(rollout_done, 180, "healthy rollout terminal")
+        assert phase == ro.COMMITTED, (
+            "healthy rollout did not commit: phase=%s verdict=%s "
+            "error=%s" % (phase, ctl.verdict, ctl.last_error)
+        )
+        assert ctl.verdict == "pass"
+        vs = wait_for(lambda: fleet_uniform(NEW_V), 60,
+                      "fleet uniform on version-%d" % NEW_V)
+        print("[rollout] HEALTHY rollout committed: %s" % vs)
+        # the rollout block rides router_status for operators
+        block = rstub.router_status(
+            pb.RouterStatusRequest(), timeout=20
+        ).rollout
+        assert block.enabled and block.phase == "committed"
+        assert block.swapped == block.fleet == NUM_REPLICAS
+        assert block.target_version == NEW_V
+
+        # ================= phase 2: corrupt checkpoint -> staging abort
+        phase_mark[0] = "corrupt"
+        saver.save(state, version=CORRUPT_V)
+        shard = os.path.join(
+            ckpt_dir, "version-%d" % CORRUPT_V,
+            sorted(f for f in os.listdir(
+                os.path.join(ckpt_dir, "version-%d" % CORRUPT_V)
+            ) if f.startswith("variables-"))[0],
+        )
+        with open(shard, "r+b") as f:
+            f.truncate(16)  # the torn write
+        assert ctl.begin(CORRUPT_V)
+        phase = wait_for(rollout_done, 120, "corrupt rollout terminal")
+        assert phase == ro.ABORTED, (
+            "torn checkpoint was not rejected at staging: %s" % phase
+        )
+        assert fleet_uniform(NEW_V), (
+            "a replica swapped toward a CORRUPT checkpoint: %s"
+            % fleet_versions()
+        )
+        events = journal_events(journal_dir)
+        assert not [e for e in events
+                    if e.get("ev") == "swap_start"
+                    and e.get("to") == CORRUPT_V], (
+            "journal shows a swap attempted toward the torn version"
+        )
+        print("[rollout] CORRUPT checkpoint aborted at staging "
+              "(zero fleet impact): %s" % ctl.last_error)
+
+        # ================= phase 3: poisoned canary -> auto-rollback
+        phase_mark[0] = "poisoned"
+        saver.save(poison(state), version=POISON_V)
+        assert ctl.begin(POISON_V)
+        phase = wait_for(rollout_done, 180, "poisoned rollout terminal")
+        assert phase == ro.ROLLED_BACK, (
+            "poisoned rollout did not roll back: phase=%s verdict=%s"
+            % (phase, ctl.verdict)
+        )
+        assert ctl.verdict == "parity_fail", (
+            "expected greedy-parity to catch the poisoned weights, "
+            "got verdict=%r" % ctl.verdict
+        )
+        vs = wait_for(lambda: fleet_uniform(NEW_V), 60,
+                      "fleet back uniform on version-%d" % NEW_V)
+        assert ctl.rollbacks >= 1
+        print("[rollout] POISONED canary judged parity_fail and "
+              "rolled back; fleet provably uniform on version-%d"
+              % NEW_V)
+
+        # ================= phase 4: controller SIGKILL mid-wave
+        phase_mark[0] = "kill_resume"
+        saver.save(state, version=RESUME_V)
+        assert ctl.begin(RESUME_V)
+        wait_for(
+            lambda: (ctl.phase == ro.WAVE
+                     and len(ctl.swapped) >= 2) or None,
+            180, "canary + first wave swapped",
+        )
+        ctl.abandon()  # journal + fleet exactly as SIGKILL leaves them
+        mixed = fleet_versions()
+        print("[rollout] controller ABANDONED mid-wave; fleet mixed: "
+              "%s" % mixed)
+        assert set(mixed.values()) == {NEW_V, RESUME_V}, (
+            "expected a mixed fleet at the kill point: %s" % mixed
+        )
+        # a fresh controller over the same journal, with a slow-swap
+        # fault on its first swap (the rollout_swap hook on the REAL
+        # swap path) — the rollout must still finish
+        ctl2 = make_controller(
+            injector=FaultInjector(spec="rollout_swap:delay:1:secs=1")
+        )
+        assert ctl2.phase == ro.WAVE, (
+            "journal recovery lost the wave: %s" % ctl2.phase
+        )
+        assert ctl2.rollout_restarts >= 1
+        router.set_rollout(ctl2)
+        ctl2.start()
+        ctl = ctl2
+        phase = wait_for(rollout_done, 180, "resumed rollout terminal")
+        assert phase == ro.COMMITTED, (
+            "resumed rollout did not commit: phase=%s error=%s"
+            % (phase, ctl.last_error)
+        )
+        vs = wait_for(lambda: fleet_uniform(RESUME_V), 60,
+                      "fleet uniform on version-%d" % RESUME_V)
+        print("[rollout] KILLED controller resumed from the journal "
+              "and committed: %s" % vs)
+
+        # ---- journal audit: per-replica history, no double-swap,
+        # settled wave ledger
+        events = journal_events(journal_dir)
+        hist = swap_history(events)
+        assert set(hist) == set(addrs), (
+            "journal swap history covers %s, fleet is %s"
+            % (sorted(hist), sorted(addrs))
+        )
+        for addr, versions in sorted(hist.items()):
+            assert versions.count(RESUME_V) == 1, (
+                "%s reloaded version-%d %d times across the kill "
+                "(double-swap): %s"
+                % (addr, RESUME_V, versions.count(RESUME_V), versions)
+            )
+            assert versions.count(NEW_V) <= 2  # swap + poison rollback
+            # landed order is strictly alternating versions — a
+            # replica never reloads the version it already serves
+            assert all(a != b for a, b in zip(versions, versions[1:])), (
+                "%s journal shows a same-version reload: %s"
+                % (addr, versions)
+            )
+        canary = sorted(addrs)[0]
+        assert hist[canary].count(POISON_V) == 1, (
+            "canary history missing the poisoned swap: %s"
+            % hist[canary]
+        )
+        # raw counts balance BECAUSE resume never re-journals a wave
+        # it recovered: wave 1's begin landed before the kill, its
+        # commit after — one begin, one settle
+        begun = len([e for e in events if e.get("ev") == "wave_begin"])
+        settled = len([e for e in events
+                       if e.get("ev") in ("wave_commit",
+                                          "wave_rollback")])
+        assert begun == settled, (
+            "unsettled wave ledger: %d begun vs %d settled"
+            % (begun, settled)
+        )
+        print("[rollout] journal audit: per-replica history %s; "
+              "%d waves begun, %d settled" % (hist, begun, settled))
+
+        # ---- zero accepted-request loss + steady p99, all phases
+        stop_load.set()
+        loader.join(timeout=10)
+        for t in threads:
+            t.join(timeout=CLIENT_TIMEOUT + 30)
+        hung = [t for t in threads if t.is_alive()]
+        assert not hung, "%d client threads HUNG" % len(hung)
+        codes = list(outcomes.values())
+        counts = {c: codes.count(c) for c in set(codes)}
+        print("[rollout] outcomes over %d requests: %s"
+              % (len(codes), counts))
+        allowed = {"OK", "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+        leaked = set(codes) - allowed
+        assert not leaked, (
+            "accepted requests LOST across rollout transitions: %s"
+            % leaked
+        )
+        assert codes and codes.count("OK") >= int(0.9 * len(codes)), (
+            "too few completions under rollout load: %s" % counts
+        )
+        phase_stats = {}
+        for name in ("healthy", "corrupt", "poisoned", "kill_resume"):
+            rows = [ms for i, (p, ms) in latencies.items()
+                    if p == name and outcomes[i] == "OK"]
+            stats = percentiles(rows, (50, 99))
+            phase_stats[name] = {"requests": len(rows),
+                                 "latency_ms": stats}
+            if rows:
+                assert stats["p99"] <= P99_BOUND_MS, (
+                    "p99 not steady through phase %r: %.0f ms"
+                    % (name, stats["p99"])
+                )
+            print("[rollout] phase %-12s %3d OK requests, p99=%s ms"
+                  % (name, len(rows), stats["p99"]))
+
+        report = {
+            "replicas": NUM_REPLICAS,
+            "rate_rps": RATE_RPS,
+            "requests": len(codes),
+            "outcomes": counts,
+            "phases": phase_stats,
+            "verdicts": {"healthy": "pass", "corrupt": "aborted",
+                         "poisoned": "parity_fail",
+                         "kill_resume": "committed"},
+            "rollout_restarts": ctl.rollout_restarts,
+            "rollbacks_total": ctl.rollbacks,
+            "swap_history": hist,
+            "final_fleet_versions": fleet_versions(),
+            "journal_events": len(events),
+        }
+        out = os.path.join(REPO, "ROLLOUT_REPORT.json")
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1)
+        print("[rollout] report archived -> %s" % out)
+        print("[rollout] rollout drill PASSED: healthy commit with "
+              "zero accepted-request loss and steady p99, torn "
+              "checkpoint rejected at staging, poisoned canary "
+              "parity-failed and auto-rolled back to a provably "
+              "uniform fleet, and a SIGKILLed controller resumed "
+              "from its journal to a single-swap commit")
+        return 0
+    finally:
+        stop_load.set()
+        try:
+            if ctl is not None:
+                ctl.abandon()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        for proc in procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        if router is not None:
+            try:
+                router.stop(grace=2.0)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

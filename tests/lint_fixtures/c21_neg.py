@@ -1,0 +1,39 @@
+"""C21 negative fixture — the rollout lifecycles settled on every
+path: commit_wave on the soaked happy path, rollback_wave on the
+not-converged branch, the burn alert, and the exception path;
+stage_checkpoint settles through activate on success and discard on
+the failed-verification branch — EDL501 must stay silent."""
+
+
+class RolloutDriver(object):
+    def __init__(self, ctl):
+        self._ctl = ctl
+
+    def advance(self, ctl, wave, addrs, reports):
+        converged = ctl.begin_wave(wave, addrs)
+        if not converged or self.alerting(reports):
+            ctl.rollback_wave(wave, "swap failed or SLO burn")
+            return False
+        ctl.commit_wave(wave)
+        return True
+
+    def advance_checked(self, ctl, wave, addrs):
+        ctl.begin_wave(wave, addrs)
+        try:
+            self.soak(ctl)
+        except Exception:
+            ctl.rollback_wave(wave, "soak raised")
+            raise
+        ctl.commit_wave(wave)
+        return True
+
+    def prepare(self, stager, version):
+        if not stager.stage_checkpoint(version):
+            raise RuntimeError(stager.discard())
+        return stager.activate()
+
+    def alerting(self, reports):
+        return bool(reports)
+
+    def soak(self, ctl):
+        return ctl

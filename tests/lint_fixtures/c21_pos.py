@@ -1,0 +1,40 @@
+"""C21 positive fixture — EDL501 leaks of the rollout controller's
+lifecycles (serving/rollout.py discipline, begin_wave -> commit_wave |
+rollback_wave and stage_checkpoint -> activate | discard):
+
+1. a wave opened and then abandoned by a not-converged early return —
+   the fleet sits on a mixed version with the journal claiming the
+   wave is still in flight;
+2. a wave whose SLO-burn exception path never turns the fleet around —
+   the alert raises past the rollback;
+3. a staged checkpoint whose failed-verification branch never discards
+   the verdict — a verification error nobody reads.
+"""
+
+
+class RolloutDriver(object):
+    def __init__(self, ctl):
+        self._ctl = ctl
+
+    def advance(self, ctl, wave, addrs):
+        converged = ctl.begin_wave(wave, addrs)
+        if not converged:
+            return None  # leak: neither committed nor rolled back
+        ctl.commit_wave(wave)
+        return wave
+
+    def advance_checked(self, ctl, wave, addrs, reports):
+        ctl.begin_wave(wave, addrs)
+        if self.alerting(reports):
+            raise RuntimeError("SLO burn")  # leak: no rollback_wave
+        ctl.commit_wave(wave)
+        return wave
+
+    def prepare(self, stager, version):
+        staged = stager.stage_checkpoint(version)
+        if not staged:
+            return None  # leak: the verification error is never read
+        return stager.activate()
+
+    def alerting(self, reports):
+        return bool(reports)

@@ -1,0 +1,144 @@
+"""CheckpointWatcher failure isolation (serving/hot_reload.py).
+
+The hardened reload path's contract, gRPC-free: a torn or corrupt
+checkpoint must NEVER displace the serving params — the watcher retries
+with backoff, then latches `reload_failed` / `last_error` (the
+ServerStatus advertisement the router and the rollout controller read)
+while the old version keeps serving; a later GOOD version clears the
+latch. `load_version` is the rollout handshake: any-direction explicit
+loads, idempotent at the serving version, ReloadError on exhaustion.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.checkpoint import CheckpointSaver
+from elasticdl_tpu.checkpoint.saver import verify_checkpoint
+from elasticdl_tpu.common.fault_injection import FaultInjector
+from elasticdl_tpu.serving.hot_reload import CheckpointWatcher, ReloadError
+
+
+def save(ckpt_dir, version, scale=1.0):
+    CheckpointSaver(str(ckpt_dir), checkpoint_steps=1,
+                    num_shards=2).save(
+        {"w": np.arange(8, dtype=np.float32) * scale}, version=version
+    )
+
+
+def truncate_shard(ckpt_dir, version):
+    path = os.path.join(str(ckpt_dir), "version-%d" % version,
+                        "variables-0-of-2.ckpt")
+    with open(path, "r+b") as f:
+        f.truncate(10)
+    return path
+
+
+def make_watcher(ckpt_dir, sleeps=None, **kwargs):
+    kwargs.setdefault("poll_secs", 0.0)
+    kwargs.setdefault(
+        "sleep", sleeps.append if sleeps is not None else lambda s: None
+    )
+    return CheckpointWatcher(
+        str(ckpt_dir), {"w": np.zeros(8, dtype=np.float32)}, **kwargs
+    )
+
+
+def test_poll_loads_newer_version(tmp_path):
+    save(tmp_path, 3)
+    w = make_watcher(tmp_path)
+    state, version = w.poll(force=True)
+    assert version == w.version == 3
+    np.testing.assert_array_equal(
+        np.asarray(state["w"]), np.arange(8, dtype=np.float32)
+    )
+    assert not w.reload_failed
+    assert w.poll(force=True) is None  # nothing newer
+
+
+def test_truncated_checkpoint_latches_and_keeps_old_params(tmp_path):
+    save(tmp_path, 3)
+    sleeps = []
+    w = make_watcher(tmp_path, sleeps=sleeps)
+    w.poll(force=True)
+    save(tmp_path, 5, scale=2.0)
+    truncate_shard(tmp_path, 5)
+    assert w.poll(force=True) is None
+    # exhausted the retry ladder with exponential backoff...
+    assert sleeps == [w.backoff_secs, w.backoff_secs * 2]
+    # ...latched the failure for ServerStatus, old params serving
+    assert w.reload_failed
+    assert "CheckpointCorruptError" in w.last_error
+    assert w.version == 3
+    # the failed version is remembered: the next poll does not re-chew
+    # the same torn bytes (no further sleeps)
+    assert w.poll(force=True) is None
+    assert sleeps == [w.backoff_secs, w.backoff_secs * 2]
+
+
+def test_good_version_clears_the_failure_latch(tmp_path):
+    save(tmp_path, 3)
+    w = make_watcher(tmp_path)
+    w.poll(force=True)
+    save(tmp_path, 5)
+    truncate_shard(tmp_path, 5)
+    w.poll(force=True)
+    assert w.reload_failed and w.version == 3
+    save(tmp_path, 7, scale=3.0)
+    state, version = w.poll(force=True)
+    assert version == 7
+    assert not w.reload_failed
+    assert w.last_error == ""
+
+
+def test_load_version_rolls_back_and_is_idempotent(tmp_path):
+    save(tmp_path, 3)
+    save(tmp_path, 5, scale=2.0)
+    w = make_watcher(tmp_path)
+    w.poll(force=True)
+    assert w.version == 5
+    # poll never goes backwards; the explicit handshake does
+    state, version = w.load_version(3)
+    assert version == w.version == 3
+    assert w.load_version(3) is None  # already serving: no-op
+
+
+def test_load_version_failure_raises_reload_error(tmp_path):
+    save(tmp_path, 3)
+    w = make_watcher(tmp_path)
+    w.poll(force=True)
+    save(tmp_path, 5)
+    truncate_shard(tmp_path, 5)
+    with pytest.raises(ReloadError):
+        w.load_version(5)
+    assert w.reload_failed and w.version == 3
+
+
+def test_injected_checkpoint_read_fault_is_survived(tmp_path):
+    save(tmp_path, 3)
+    w = make_watcher(
+        tmp_path,
+        injector=FaultInjector(spec="checkpoint_read:error:2"),
+    )
+    # two injected read faults burn two attempts; the third succeeds
+    state, version = w.poll(force=True)
+    assert version == 3
+    assert not w.reload_failed
+
+
+def test_poll_disabled_leaves_explicit_reloads_only(tmp_path):
+    # --reload_poll_secs 0: a rollout-managed replica must not
+    # self-upgrade (or self-revert a rollback) behind the controller
+    save(tmp_path, 3)
+    w = make_watcher(tmp_path, poll_secs=0)
+    assert w.poll() is None
+    state, version = w.load_version(3)
+    assert version == w.version == 3
+
+
+def test_saver_writes_verifiable_digests(tmp_path):
+    save(tmp_path, 3)
+    manifest = verify_checkpoint(str(tmp_path), 3)
+    assert manifest["num_shards"] == manifest["verified_digests"] == 2
+    assert manifest["version"] == 3 and manifest["bytes"] > 0

@@ -342,6 +342,29 @@ def test_c19_negative_settled_handoffs_are_clean():
     assert lint_file("c19_neg.py") == []
 
 
+def test_c21_positive_flags_rollout_lifecycle_leaks():
+    """The rollout controller's pairs (serving/rollout.py): a wave
+    abandoned by a not-converged early return, a burn alert that
+    raises past the rollback, and a staged checkpoint whose failed
+    verification is never discarded."""
+    findings = lint_file("c21_pos.py")
+    assert rule_ids(findings) == ["EDL501"] * 3, findings
+    assert {f.detail for f in findings} == {
+        "ctl.begin_wave", "stager.stage_checkpoint",
+    }
+    assert {f.scope for f in findings} == {
+        "RolloutDriver.advance", "RolloutDriver.advance_checked",
+        "RolloutDriver.prepare",
+    }
+
+
+def test_c21_negative_settled_rollouts_are_clean():
+    """commit_wave on the soaked path, rollback_wave on the failure
+    branches and the exception path, activate/discard closing both
+    staging outcomes — every lifecycle settles, EDL501 stays silent."""
+    assert lint_file("c21_neg.py") == []
+
+
 # ------------------- C14: EDL105 recompile hazard (value-origin v3)
 
 
@@ -557,7 +580,8 @@ FAMILY_FIXTURES = {
     "EDL202": (("c9_pos.py",), "c9_neg.py"),
     "EDL401": (("c5_pos.py",), "c5_neg.py"),
     "EDL501": (("c8_pos.py", "c11_pos.py", "c12_pos.py",
-                "c13_pos.py", "c18_pos.py", "c19_pos.py"), "c8_neg.py"),
+                "c13_pos.py", "c18_pos.py", "c19_pos.py",
+                "c21_pos.py"), "c8_neg.py"),
     "EDL601": (("c17_pos.py",), "c17_neg.py"),
     # EDL301 is repo-level; its trigger/clean pair is the tampered/
     # pristine pb2 in the proto tests below

@@ -1,0 +1,485 @@
+"""Rollout controller (serving/rollout.py): canary judgment and the
+journaled wave state machine, gRPC-free.
+
+The fleet here is fakes — replicas are (address, model_version) records,
+swap_fn mutates them, generate_fn derives tokens from the version a
+replica currently serves — so every test isolates exactly one claim:
+
+* the burn-verdict matrix (pass / fast-burn fail / slow-burn-only pass)
+  and the parity matrix;
+* judgment wiring: parity mismatch rolls the canary back, a fast burn
+  rolls it back, and sustained silence (the judge path erroring) is
+  itself a verdict — no promotion past judge_timeout_secs;
+* an SLO alert during a progressive wave pauses the rollout and rolls
+  every swapped replica back in REVERSE swap order;
+* journal replay: a controller abandoned (SIGKILL stand-in) mid-canary,
+  mid-wave, or mid-rollback resumes from the journal and finishes with
+  every replica swapped exactly once — the no-double-swap invariant.
+
+The real-RPC, real-subprocess version of the same claims is the rollout
+drill (scripts/run_rollout_drill.py).
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.checkpoint import CheckpointSaver
+from elasticdl_tpu.serving import rollout
+from elasticdl_tpu.serving.rollout import (
+    CheckpointStager,
+    RolloutConfig,
+    RolloutController,
+    burn_verdict,
+    parity_verdict,
+    parse_parity_prompts,
+    wave_alerting,
+)
+
+OLD, NEW = 1, 2
+
+
+class FakeReplica(object):
+    def __init__(self, address, model_version=OLD):
+        self.address = address
+        self.model_version = model_version
+        self.reload_failed = False
+
+
+class FakeRouter(object):
+    def __init__(self, addrs):
+        self.fleet = {a: FakeReplica(a) for a in addrs}
+        self.reports = []
+        self._held = set()
+
+    def replicas(self):
+        return list(self.fleet.values())
+
+    def slo_reports(self):
+        return list(self.reports)
+
+    def hold_replica(self, address):
+        self._held.add(address)
+
+    def release_replica(self, address):
+        self._held.discard(address)
+
+    def held_replicas(self):
+        return set(self._held)
+
+
+class FakeClock(object):
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_swap(router, calls, fail_addrs=()):
+    def swap(addr, version):
+        calls.append((addr, version))
+        if addr in fail_addrs:
+            return False, router.fleet[addr].model_version, "injected"
+        router.fleet[addr].model_version = version
+        return True, version, ""
+
+    return swap
+
+
+def make_generate(router, poisoned=False, broken=False):
+    """Greedy generation as a pure function of (prompt, served
+    version): the healthy new version reproduces the old version's
+    tokens (same lineage), the poisoned one drifts."""
+
+    def generate(addr, prompt, max_tokens):
+        v = router.fleet[addr].model_version
+        if broken and v != OLD:
+            # only the post-swap judge path is down; the baseline
+            # (recorded while the canary still serves OLD) works
+            raise RuntimeError("judge path down")
+        if poisoned and v != OLD:
+            return [999] * len(prompt)
+        return [t + 1 for t in prompt]
+
+    return generate
+
+
+def make_checkpoint(tmp_path, versions=(NEW,)):
+    saver = CheckpointSaver(str(tmp_path), checkpoint_steps=1,
+                            num_shards=2)
+    for v in versions:
+        saver.save({"w": np.arange(8, dtype=np.float32) * v}, version=v)
+    return str(tmp_path)
+
+
+def make_controller(tmp_path, addrs=("a:1", "b:1", "c:1"),
+                    journal=False, poisoned=False, broken=False,
+                    fail_addrs=(), **cfg_kwargs):
+    router = FakeRouter(addrs)
+    clock = FakeClock()
+    calls = []
+    cfg = RolloutConfig(
+        checkpoint_dir=make_checkpoint(tmp_path / "ckpt"),
+        journal_dir=str(tmp_path / "journal") if journal else "",
+        soak_secs=3.0, judge_timeout_secs=20.0,
+        parity_prompts=((1, 2, 3), (4, 5)),
+        **cfg_kwargs,
+    )
+    ctl = RolloutController(
+        router, cfg, clock=clock,
+        swap_fn=make_swap(router, calls, fail_addrs=fail_addrs),
+        generate_fn=make_generate(router, poisoned=poisoned,
+                                  broken=broken),
+    )
+    return ctl, router, clock, calls
+
+
+def drive(ctl, clock, max_ticks=100, dt=1.0):
+    for _ in range(max_ticks):
+        ctl.decide_once()
+        if ctl.phase in rollout.TERMINAL:
+            return ctl.phase
+        clock.advance(dt)
+    raise AssertionError("no terminal phase, stuck at %s" % ctl.phase)
+
+
+def fleet_versions(router):
+    return {a: r.model_version for a, r in router.fleet.items()}
+
+
+# ----------------------------------------------- judgment matrices
+
+
+def report(fast=0.0, slow=0.0, fast_samples=10, alerting=False):
+    return {"name": "ttft_p99", "fast_burn": fast, "slow_burn": slow,
+            "fast_samples": fast_samples, "slow_samples": 10,
+            "alerting": alerting}
+
+
+def test_burn_verdict_clean_passes():
+    failed, _ = burn_verdict([report(fast=0.4, slow=0.2)])
+    assert not failed
+
+
+def test_burn_verdict_fast_burn_fails():
+    failed, reason = burn_verdict([report(fast=2.5, slow=0.2)])
+    assert failed
+    assert "ttft_p99" in reason
+
+
+def test_burn_verdict_slow_burn_only_passes():
+    # the slow window averages over history the canary never touched:
+    # a rollout that follows a rough patch must still be judgeable
+    failed, _ = burn_verdict([report(fast=0.3, slow=4.0)])
+    assert not failed
+
+
+def test_burn_verdict_unsampled_fast_window_is_silent():
+    failed, _ = burn_verdict([report(fast=9.0, fast_samples=0)])
+    assert not failed
+
+
+def test_wave_alerting_requires_both_windows():
+    assert wave_alerting([report(fast=2.0, slow=0.1)]) == []
+    assert wave_alerting(
+        [report(fast=2.0, slow=2.0, alerting=True)]
+    ) == ["ttft_p99"]
+
+
+def test_parity_verdict_exact_match_passes():
+    failed, matched, total = parity_verdict([[1, 2], [3]], [[1, 2], [3]])
+    assert (failed, matched, total) == (False, 2, 2)
+
+
+def test_parity_verdict_drift_fails():
+    failed, matched, total = parity_verdict([[1, 2], [3]], [[1, 2], [9]])
+    assert failed and (matched, total) == (1, 2)
+
+
+def test_parity_verdict_min_match_knob():
+    failed, _, _ = parity_verdict([[1], [2]], [[1], [9]], min_match=0.5)
+    assert not failed
+
+
+def test_parse_parity_prompts_grammar():
+    assert parse_parity_prompts("1,2,3; 4,5 ;") == ((1, 2, 3), (4, 5))
+    assert parse_parity_prompts("") == ()
+
+
+# ----------------------------------------------- checkpoint staging
+
+
+def test_stager_pair_and_corrupt_checkpoint(tmp_path):
+    ckpt = make_checkpoint(tmp_path, versions=(NEW,))
+    stager = CheckpointStager(ckpt)
+    assert stager.stage_checkpoint(NEW)
+    manifest = stager.activate()
+    assert manifest["version"] == NEW
+    assert manifest["verified_digests"] == manifest["num_shards"] == 2
+    # a staged version that does not exist discards with the error
+    assert not stager.stage_checkpoint(99)
+    assert isinstance(stager.discard(), Exception)
+    with pytest.raises(RuntimeError):
+        stager.activate()
+
+
+def test_corrupt_checkpoint_aborts_before_any_swap(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path)
+    shard = (tmp_path / "ckpt" / ("version-%d" % NEW)
+             / "variables-0-of-2.ckpt")
+    shard.write_bytes(shard.read_bytes()[:-7])  # torn write
+    assert ctl.begin(NEW)
+    assert drive(ctl, clock) == rollout.ABORTED
+    assert calls == []  # zero fleet impact: no replica ever swapped
+    assert fleet_versions(router) == {a: OLD for a in router.fleet}
+    assert "verification" in ctl.last_error
+
+
+# ----------------------------------------------- happy path
+
+
+def test_healthy_rollout_commits_canary_first(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path)
+    assert ctl.begin(NEW)
+    assert drive(ctl, clock) == rollout.COMMITTED
+    assert fleet_versions(router) == {a: NEW for a in router.fleet}
+    # canary (lowest address) swaps first, then waves in plan order,
+    # and nothing swaps twice
+    assert calls == [("a:1", NEW), ("b:1", NEW), ("c:1", NEW)]
+    assert ctl.verdict == "pass"
+    assert ctl.swapped == ["a:1", "b:1", "c:1"]
+    block = ctl.status_block()
+    assert block.phase == "committed"
+    assert block.swapped == block.fleet == 3
+    assert block.waves_total == 3  # canary + two waves of 1
+
+
+def test_already_serving_replica_is_not_reswapped(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path)
+    router.fleet["b:1"].model_version = NEW  # converged out of band
+    assert ctl.begin(NEW)
+    assert drive(ctl, clock) == rollout.COMMITTED
+    assert ("b:1", NEW) not in calls  # recognized, not repeated
+    assert ctl.swapped == ["a:1", "b:1", "c:1"]
+
+
+def test_begin_guards(tmp_path):
+    ctl, router, clock, _ = make_controller(tmp_path)
+    router.fleet.clear()
+    assert not ctl.begin(NEW)
+    assert "no replicas" in ctl.last_error
+    router.fleet["a:1"] = FakeReplica("a:1")
+    assert ctl.begin(NEW)
+    assert not ctl.begin(NEW)  # already in flight
+    assert "in flight" in ctl.last_error
+
+
+# ----------------------------------------------- failed judgment
+
+
+def test_parity_mismatch_rolls_canary_back(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path, poisoned=True)
+    assert ctl.begin(NEW)
+    assert drive(ctl, clock) == rollout.ROLLED_BACK
+    assert ctl.verdict == "parity_fail"
+    # the canary swapped up, drifted on the pinned prompts, and came
+    # back down; the rest of the fleet never left the old version
+    assert calls == [("a:1", NEW), ("a:1", OLD)]
+    assert fleet_versions(router) == {a: OLD for a in router.fleet}
+    assert ctl.rollbacks == 1
+
+
+def test_fast_burn_during_judging_rolls_back(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path)
+    router.reports = [report(fast=3.0, slow=0.1)]
+    assert ctl.begin(NEW)
+    assert drive(ctl, clock) == rollout.ROLLED_BACK
+    assert ctl.verdict == "burn_fail"
+    assert fleet_versions(router) == {a: OLD for a in router.fleet}
+
+
+def test_judge_timeout_is_a_verdict(tmp_path):
+    # the judge path itself erroring yields NO evidence tick after
+    # tick; sustained silence must not promote — the timeout converts
+    # it into a rollback
+    ctl, router, clock, calls = make_controller(tmp_path, broken=True)
+    assert ctl.begin(NEW)
+    # staging records the baseline BEFORE the judge path breaks
+    ctl.decide_once()
+    assert ctl.phase == rollout.CANARY
+    assert drive(ctl, clock) == rollout.ROLLED_BACK
+    assert ctl.verdict == "timeout"
+    assert fleet_versions(router) == {a: OLD for a in router.fleet}
+
+
+def test_judge_timeout_baseline_break_aborts(tmp_path):
+    # broken from the start: the baseline itself cannot be recorded,
+    # so staging aborts with the fleet untouched
+    ctl, router, clock, calls = make_controller(tmp_path)
+
+    def down(addr, prompt, max_tokens):
+        raise RuntimeError("generation down")
+
+    ctl._generate_fn = down
+    assert ctl.begin(NEW)
+    assert drive(ctl, clock) == rollout.ABORTED
+    assert calls == []
+
+
+def test_wave_alert_pauses_and_reverse_rolls(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path)
+    assert ctl.begin(NEW)
+    # let the canary pass judgment cleanly, then trip the pager the
+    # moment a progressive wave is soaking
+    for _ in range(100):
+        ctl.decide_once()
+        if ctl.phase in rollout.TERMINAL:
+            break
+        if ctl.phase == rollout.WAVE and len(ctl.swapped) == 2:
+            router.reports = [report(fast=2.0, slow=2.0, alerting=True)]
+        clock.advance(1.0)
+    assert ctl.phase == rollout.ROLLED_BACK
+    # rollback is REVERSE swap order: the wave member first, the
+    # canary (longest on the new version) last
+    assert calls == [("a:1", NEW), ("b:1", NEW),
+                     ("b:1", OLD), ("a:1", OLD)]
+    assert fleet_versions(router) == {a: OLD for a in router.fleet}
+    assert "SLO burn alert" in ctl.last_error
+    assert ctl.rollbacks == 2
+
+
+def test_canary_swap_failure_aborts_without_rollback(tmp_path):
+    ctl, router, clock, calls = make_controller(
+        tmp_path, fail_addrs=("a:1",)
+    )
+    assert ctl.begin(NEW)
+    assert drive(ctl, clock) == rollout.ABORTED
+    # nothing ever swapped, so there is nothing to roll back
+    assert fleet_versions(router) == {a: OLD for a in router.fleet}
+    assert ctl.swapped == []
+
+
+# ----------------------------------------------- journal replay
+
+
+def resume(tmp_path, ctl, router, **kwargs):
+    """A fresh controller over the same journal — the post-SIGKILL
+    process. abandon() (not stop()) first: nothing journals on the
+    way down, exactly like a kill."""
+    ctl.abandon()
+    calls = []
+    cfg = RolloutConfig(
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        journal_dir=str(tmp_path / "journal"),
+        soak_secs=3.0, judge_timeout_secs=20.0,
+        parity_prompts=((1, 2, 3), (4, 5)),
+    )
+    clock = FakeClock()
+    ctl2 = RolloutController(
+        router, cfg, clock=clock,
+        swap_fn=make_swap(router, calls),
+        generate_fn=make_generate(router, **kwargs),
+    )
+    return ctl2, clock, calls
+
+
+def test_resume_mid_canary_does_not_double_swap(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path, journal=True)
+    assert ctl.begin(NEW)
+    ctl.decide_once()  # staging
+    ctl.decide_once()  # canary swap lands, then the controller dies
+    assert ctl.phase == rollout.JUDGING
+    ctl2, clock2, calls2 = resume(tmp_path, ctl, router)
+    assert ctl2.phase == rollout.JUDGING
+    assert ctl2.rollout_restarts == 1
+    assert drive(ctl2, clock2) == rollout.COMMITTED
+    # the canary's swap happened in the FIRST life only
+    assert calls == [("a:1", NEW)]
+    assert calls2 == [("b:1", NEW), ("c:1", NEW)]
+    assert fleet_versions(router) == {a: NEW for a in router.fleet}
+
+
+def test_resume_mid_wave_finishes_single_swap(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path, journal=True)
+    assert ctl.begin(NEW)
+    for _ in range(100):
+        ctl.decide_once()
+        if ctl.phase == rollout.WAVE and len(ctl.swapped) == 2:
+            break
+        clock.advance(1.0)
+    ctl2, clock2, calls2 = resume(tmp_path, ctl, router)
+    assert drive(ctl2, clock2) == rollout.COMMITTED
+    both = calls + calls2
+    # every replica reloaded exactly once across both lives
+    assert sorted(both) == [("a:1", NEW), ("b:1", NEW), ("c:1", NEW)]
+    assert fleet_versions(router) == {a: NEW for a in router.fleet}
+
+
+def test_resume_mid_wave_recognizes_landed_swap(tmp_path):
+    """The kill window between journaling swap_start and swap_done:
+    the reload LANDED on the replica but the journal never heard. The
+    resumed controller must reconcile against the replica's advertised
+    version instead of reloading it a second time."""
+    ctl, router, clock, calls = make_controller(tmp_path, journal=True)
+    assert ctl.begin(NEW)
+    for _ in range(100):
+        ctl.decide_once()
+        if ctl.phase == rollout.WAVE and len(ctl.swapped) == 2:
+            break
+        clock.advance(1.0)
+    # simulate the torn swap: b's reload landed, journal says otherwise
+    router.fleet["c:1"].model_version = NEW
+    ctl2, clock2, calls2 = resume(tmp_path, ctl, router)
+    assert drive(ctl2, clock2) == rollout.COMMITTED
+    assert calls2 == []  # recognized via the heartbeat, not re-issued
+    assert ctl2.swapped == ["a:1", "b:1", "c:1"]
+
+
+def test_resume_mid_rollback_finishes_rollback(tmp_path):
+    ctl, router, clock, calls = make_controller(
+        tmp_path, journal=True, poisoned=True
+    )
+    assert ctl.begin(NEW)
+    for _ in range(100):
+        ctl.decide_once()
+        if ctl.phase == rollout.ROLLING_BACK:
+            break
+        clock.advance(1.0)
+    # judged parity_fail, rollback journaled but not yet executed —
+    # the canary still serves the poisoned version at the kill
+    assert fleet_versions(router)["a:1"] == NEW
+    ctl2, clock2, calls2 = resume(tmp_path, ctl, router, poisoned=True)
+    assert ctl2.phase == rollout.ROLLING_BACK
+    assert drive(ctl2, clock2) == rollout.ROLLED_BACK
+    assert calls2 == [("a:1", OLD)]
+    assert fleet_versions(router) == {a: OLD for a in router.fleet}
+    assert ctl2.verdict == "parity_fail"
+    assert ctl2.rollbacks == 1
+
+
+def test_resume_terminal_rollout_stays_terminal(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path, journal=True)
+    assert ctl.begin(NEW)
+    assert drive(ctl, clock) == rollout.COMMITTED
+    ctl2, clock2, calls2 = resume(tmp_path, ctl, router)
+    assert ctl2.phase == rollout.COMMITTED
+    # a restart re-passing the same --rollout over a committed journal
+    ctl2.request(NEW)
+    ctl2.decide_once()
+    assert ctl2.phase == rollout.COMMITTED
+    assert calls2 == []
+
+
+def test_deferred_request_waits_for_fleet(tmp_path):
+    ctl, router, clock, calls = make_controller(tmp_path)
+    fleet = dict(router.fleet)
+    router.fleet.clear()
+    ctl.request(NEW)
+    ctl.decide_once()
+    assert ctl.phase == rollout.IDLE  # nothing registered yet
+    router.fleet.update(fleet)
+    assert drive(ctl, clock) == rollout.COMMITTED
+    assert fleet_versions(router) == {a: NEW for a in router.fleet}

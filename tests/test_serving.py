@@ -437,9 +437,11 @@ def test_serving_service_descriptor():
     names = [m.name for m in svc.methods]
     assert names == ["generate", "generate_stream", "server_status",
                      "export_chain", "transfer_chain",
-                     "abort_transfer"]
+                     "abort_transfer", "reload_checkpoint"]
     assert svc.methods_by_name["generate_stream"].server_streaming
     assert not svc.methods_by_name["generate"].server_streaming
+    # the rollout swap handshake is unary
+    assert not svc.methods_by_name["reload_checkpoint"].server_streaming
     # the disagg transfer RPCs are all unary
     assert not svc.methods_by_name["transfer_chain"].server_streaming
     # the hand-rolled binding table mirrors the descriptor
