@@ -43,6 +43,7 @@ from elasticdl_tpu.analysis import (  # noqa: F401,E402
     deadline_rules,
     donate_rules,
     jit_rules,
+    journal_rules,
     lock_rules,
     lockgraph_rules,
     proto_rules,

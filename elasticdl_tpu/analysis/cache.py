@@ -1,0 +1,143 @@
+"""On-disk per-file result cache for the lint runner.
+
+The full-tree sweep re-parses and re-analyzes every module on every
+run, but between two local runs almost nothing changed — so the
+runner memoizes each file's (pragma-filtered) findings keyed by the
+file's CONTENT hash, under a context key that folds in the rule-set
+version (a hash of every ``analysis/*.py`` source) and the selected
+rule ids. Any engine or rule edit, or a different ``--select``,
+silently invalidates the whole cache; a file edit invalidates that
+file. Repo-level checks (proto drift, the lock graph, the WAL
+controller registry) are never cached — they are cross-file by
+nature and cheap.
+
+Soundness: ``check_module(tree, lines, path)`` is a pure function of
+(file content, relative path, rule set, full-run flag) — content and
+path are the entry key, rule set and full-run are in the context —
+so a hit replays byte-identical findings (test_lint.py pins SARIF
+parity between a cold and a warm run). The cache file lives at the
+repo root (``.edl-lint-cache.json``, git-ignored) and is written
+atomically; a corrupt or stale-context file is discarded wholesale,
+never trusted partially.
+"""
+
+import hashlib
+import json
+import os
+import tempfile
+
+from elasticdl_tpu.analysis.core import Finding
+
+CACHE_BASENAME = ".edl-lint-cache.json"
+
+_FORMAT = 1
+
+
+def ruleset_version():
+    """Hash of every analysis-package source file: any edit to a
+    rule, the engine, or this module invalidates every cached
+    result."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for fn in sorted(os.listdir(here)):
+        if not fn.endswith(".py"):
+            continue
+        h.update(fn.encode("utf-8"))
+        with open(os.path.join(here, fn), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def cache_context(rule_ids):
+    """The context key: rule-set version x selected checkers. The
+    full-run flag (which gates EDL000 pragma judgment) is a pure
+    function of the id set, so folding the ids in covers it."""
+    h = hashlib.sha256()
+    h.update(ruleset_version().encode("utf-8"))
+    h.update(",".join(sorted(rule_ids)).encode("utf-8"))
+    return h.hexdigest()
+
+
+def file_sha(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+class ResultCache(object):
+    def __init__(self, path, context):
+        self.path = path
+        self.context = context
+        self.files = {}
+        self._dirty = False
+        self._load()
+
+    def _load(self):
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        if (not isinstance(data, dict)
+                or data.get("format") != _FORMAT
+                or data.get("context") != self.context):
+            # engine/rule-set changed: the whole cache is void, and
+            # keeping old-context entries around would only let a
+            # future bug resurrect them
+            self._dirty = True
+            return
+        files = data.get("files")
+        if isinstance(files, dict):
+            self.files = files
+
+    def get(self, rel, sha):
+        """(findings, errors) memoized for this content, else None."""
+        entry = self.files.get(rel)
+        if not isinstance(entry, dict) or entry.get("sha") != sha:
+            return None
+        try:
+            findings = [
+                Finding(rule, path, line, scope, detail, message)
+                for rule, path, line, scope, detail, message
+                in entry["findings"]
+            ]
+            errors = [str(e) for e in entry["errors"]]
+        except (KeyError, TypeError, ValueError):
+            return None
+        return findings, errors
+
+    def put(self, rel, sha, findings, errors):
+        self.files[rel] = {
+            "sha": sha,
+            "findings": [
+                [f.rule, f.path, f.line, f.scope, f.detail, f.message]
+                for f in findings
+            ],
+            "errors": list(errors),
+        }
+        self._dirty = True
+
+    def save(self):
+        """Atomic write (tmp + rename): a parallel run or a crash
+        mid-write can never leave a torn cache — the same discipline
+        the journals this linter now checks live by."""
+        if not self._dirty:
+            return
+        payload = {
+            "format": _FORMAT,
+            "context": self.context,
+            "files": self.files,
+        }
+        d = os.path.dirname(self.path) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".edl-lint-cache.")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

@@ -326,7 +326,7 @@ def _check_one_file(args):
 
 
 def run_rules(paths, rules=None, root=None, excludes=DEFAULT_EXCLUDES,
-              jobs=1):
+              jobs=1, cache=None):
     """Run `rules` over every Python file under `paths` plus each
     rule's repo-level check. Returns (findings, errors): findings are
     pragma-filtered but NOT baseline-filtered (the caller owns the
@@ -335,31 +335,52 @@ def run_rules(paths, rules=None, root=None, excludes=DEFAULT_EXCLUDES,
     `jobs` > 1 fans the per-file module passes out over a process
     pool (findings and errors merge deterministically: results are
     re-sorted, so parallel output is byte-identical to serial);
-    repo-level checks always run in this process."""
+    repo-level checks always run in this process.
+
+    `cache` (a `cache.ResultCache`) memoizes per-file results by
+    content hash: hits skip the file entirely, misses are stored, and
+    the cache is saved before returning. Repo-level checks are never
+    cached."""
     rules = rules if rules is not None else all_rules()
     rule_ids = frozenset(r.id for r in rules)
     full_run = rule_ids == frozenset(r.id for r in all_rules())
-    work = []
+    findings, errors = [], []
+    work, shas = [], []
     for path in iter_python_files(paths, excludes=excludes):
         rel = os.path.relpath(path, root) if root else path
-        work.append((path, rel.replace(os.sep, "/"), rule_ids,
-                     full_run))
+        rel = rel.replace(os.sep, "/")
+        sha = None
+        if cache is not None:
+            from elasticdl_tpu.analysis.cache import file_sha
 
-    findings, errors = [], []
+            try:
+                sha = file_sha(path)
+            except OSError:
+                sha = None
+            if sha is not None:
+                hit = cache.get(rel, sha)
+                if hit is not None:
+                    findings.extend(hit[0])
+                    errors.extend(hit[1])
+                    continue
+        work.append((path, rel, rule_ids, full_run))
+        shas.append(sha)
+
     if jobs > 1 and len(work) > 1:
         import multiprocessing
 
         with multiprocessing.Pool(min(jobs, len(work))) as pool:
             results = pool.map(_check_one_file, work,
                                chunksize=max(1, len(work) // (4 * jobs)))
-        for fs, es in results:
-            findings.extend(fs)
-            errors.extend(es)
     else:
-        for item in work:
-            fs, es = _check_one_file(item)
-            findings.extend(fs)
-            errors.extend(es)
+        results = [_check_one_file(item) for item in work]
+    for item, sha, (fs, es) in zip(work, shas, results):
+        findings.extend(fs)
+        errors.extend(es)
+        if cache is not None and sha is not None:
+            cache.put(item[1], sha, fs, es)
+    if cache is not None:
+        cache.save()
 
     if root:
         for rule in rules:

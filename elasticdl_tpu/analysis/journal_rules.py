@@ -1,0 +1,901 @@
+"""C22 — journal-protocol verification (EDL701 write/replay closure,
+EDL702 payload-schema drift, EDL703 transition legality, EDL704
+crash-point closure).
+
+A module that declares ``PROTOCOL = JournalProtocol(...)`` (see
+`typestate.py`) opts its write-ahead journal into four checks, all
+derived from the SAME declaration the controller executes at runtime.
+The checker re-reads the declaration from the AST — it never imports
+the module — so it works on fixture files and in the minimal CI lint
+environment where the serving dependency chain is absent.
+
+* EDL701 — write/replay closure. Every event kind passed to the
+  declared emit surface (``self._journal({...})``,
+  ``registry.record({...})``) must have a branch in the paired replay
+  function, and every replay branch must name a declared, emitted
+  kind: a forgotten branch strands a fleet after a controller crash;
+  a dead branch is recovery code nothing can ever reach. Kinds
+  declared ``informational`` (forensic beacons like the router's
+  ``lease``) are exempt from the replay side. On the modules listed
+  in `protocol_specs.WAL_CONTROLLERS` a MISSING declaration convicts
+  too — new journal consumers are born gated.
+* EDL702 — payload-schema drift. The keys DEFINITELY present in the
+  event dict at each emit site (dict-literal keys plus unconditional
+  ``ev["k"] = ...`` stores, resolved with a MUST dataflow over the
+  CFG, so a key added under ``if why:`` stays non-definite) must
+  cover both the keys the replay branch reads unconditionally
+  (``ev["k"]``; ``.get``/``in`` reads are tolerant by construction)
+  and the spec's declared ``requires``. Conviction names the missing
+  key.
+* EDL703 — transition legality. A typestate pass over each method's
+  CFG tracks the machine state — seeded by ``self.<attr> = LITERAL``
+  assignments (the way EDL004 infers lock bindings) and advanced by
+  emit sites and recognized setter calls — and flags an emit the
+  declared machine forbids from the current state: ``commit`` while
+  still ``staging``. Unknown state convicts nothing (unresolvable =
+  silent, like every engine layer).
+* EDL704 — crash-point closure. After any state-changing emit that
+  can reach ANOTHER emit on a CFG path, the machine must sit in a
+  state with a declared resume action (``recoverable``) or a
+  terminal state: the window between two journal writes is exactly
+  where a SIGKILL strands the on-disk prefix, and "the prefix
+  replays to a state recovery knows how to resume" is the invariant
+  rollout.py used to document by hand.
+
+Precision over recall throughout: an emit whose payload or kind the
+dataflow cannot resolve contributes nothing to 702-704 (and marks
+the machine state unknown rather than guessing); only a resolved,
+definitely-illegal fact convicts.
+"""
+
+import ast
+import os
+
+from elasticdl_tpu.analysis import protocol_specs
+from elasticdl_tpu.analysis.cfg import build_cfg, walk_shallow
+from elasticdl_tpu.analysis.core import Finding, Rule, register
+from elasticdl_tpu.analysis.dataflow import forward
+from elasticdl_tpu.analysis.typestate import (
+    ProtocolError,
+    find_protocol_decl,
+    machine_from_ast,
+    module_constant_env,
+)
+
+_NO = object()       # unresolvable constant
+_UNKNOWN = "\x00?"   # typestate lattice top: any state
+
+
+def _const(node, env):
+    """The compile-time value of `node` (Constant, or a module-level
+    constant Name), else the _NO sentinel."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id, _NO)
+    return _NO
+
+
+def _call_name(call):
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _self_attr(node):
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _sub_key(sub):
+    sl = sub.slice
+    if sl.__class__.__name__ == "Index":  # pre-3.9 AST compat
+        sl = sl.value
+    return sl
+
+
+def _functions(tree):
+    """[(scope, fndef, class-name-or-None)] for module-level functions
+    and methods of module-level classes (the only scopes a journal
+    protocol lives in)."""
+    out = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((node.name, node, None))
+        elif isinstance(node, ast.ClassDef):
+            for s in node.body:
+                if isinstance(s, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    out.append(
+                        ("%s.%s" % (node.name, s.name), s, node.name)
+                    )
+    return out
+
+
+# -------------------------------------------------------- emit sites
+
+
+class _Emit(object):
+    """One resolved (or unresolved: kind None) emit call site."""
+
+    __slots__ = ("kind", "keys", "open_keys", "values", "line",
+                 "scope")
+
+    def __init__(self, kind, keys, open_keys, values, line, scope):
+        self.kind = kind
+        self.keys = keys
+        self.open_keys = open_keys
+        self.values = values  # key -> resolved constant (or _NO)
+        self.line = line
+        self.scope = scope
+
+
+def _parse_dict(d, env):
+    """(definite keys, resolved values, has-star) for a dict literal
+    with all-constant keys; None when a key is unresolvable."""
+    keys, values, open_keys = set(), {}, False
+    for k, v in zip(d.keys, d.values):
+        if k is None:          # ** expansion: unknown extra keys
+            open_keys = True
+            continue
+        kv = _const(k, env)
+        if not isinstance(kv, str):
+            return None
+        keys.add(kv)
+        values[kv] = _const(v, env)
+    return frozenset(keys), values, open_keys
+
+
+def _payload_flow(cfg, env, kind_key):
+    """MUST dataflow: at each node, which local names definitely hold
+    an event dict, with which kind and which definitely-present keys.
+    State: frozenset of (var, kind, keys, open). A key added on only
+    one branch of an ``if`` does not survive the intersection join —
+    exactly the tolerant-``.get``-on-replay contract."""
+
+    def effects(node, st):
+        if node.kind != "stmt":
+            return st
+        s = node.payload
+        if isinstance(s, ast.Assign) and len(s.targets) == 1:
+            t = s.targets[0]
+            if isinstance(t, ast.Name):
+                st = frozenset(e for e in st if e[0] != t.id)
+                if isinstance(s.value, ast.Dict):
+                    parsed = _parse_dict(s.value, env)
+                    if parsed is not None:
+                        keys, values, open_keys = parsed
+                        kind = values.get(kind_key, _NO)
+                        if isinstance(kind, str):
+                            st = st | {(t.id, kind, keys, open_keys)}
+                return st
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)):
+                var = t.value.id
+                cur = [e for e in st if e[0] == var]
+                if cur:
+                    e = cur[0]
+                    key = _const(_sub_key(t), env)
+                    st = st - {e}
+                    if isinstance(key, str):
+                        st = st | {(var, e[1], e[2] | {key}, e[3])}
+                return st
+            return st
+        if isinstance(s, (ast.AugAssign, ast.Delete)):
+            names = {n.id for n in ast.walk(s)
+                     if isinstance(n, ast.Name)}
+            return frozenset(e for e in st if e[0] not in names)
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            fn = s.value.func
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)):
+                var = fn.value.id
+                cur = [e for e in st if e[0] == var]
+                if cur:
+                    e = cur[0]
+                    if fn.attr == "setdefault" and s.value.args:
+                        key = _const(s.value.args[0], env)
+                        if isinstance(key, str):
+                            return (st - {e}) | {
+                                (var, e[1], e[2] | {key}, e[3])
+                            }
+                        return st - {e}
+                    if fn.attr == "update":
+                        # adds unknown keys; definite set is intact
+                        return (st - {e}) | {(var, e[1], e[2], True)}
+                    if fn.attr in ("pop", "popitem", "clear"):
+                        return st - {e}
+        return st
+
+    def join(a, b):
+        am = {e[0]: e for e in a}
+        out = set()
+        for e in b:
+            o = am.get(e[0])
+            if o is not None and o[1] == e[1]:
+                out.add((e[0], e[1], o[2] & e[2], o[3] or e[3]))
+        return frozenset(out)
+
+    return forward(cfg, effects, entry_state=frozenset(), join=join)
+
+
+def _collect_emits(scope, cfg, env, spec):
+    """Every `spec.emit` call in the CFG, resolved where possible.
+    Returns (emits, by_call_id) — the id-map lets the typestate pass
+    reuse resolution when it re-encounters the same Call node."""
+    states = _payload_flow(cfg, env, spec.kind_key)
+    emits, by_id = [], {}
+    for node in cfg.nodes:
+        for root in node.scan_roots():
+            for n in walk_shallow(root):
+                if not isinstance(n, ast.Call):
+                    continue
+                if _call_name(n) != spec.emit or not n.args:
+                    continue
+                if id(n) in by_id:  # finally-copies share AST nodes
+                    continue
+                arg = n.args[0]
+                emit = None
+                if isinstance(arg, ast.Dict):
+                    parsed = _parse_dict(arg, env)
+                    if parsed is not None:
+                        keys, values, open_keys = parsed
+                        kind = values.get(spec.kind_key, _NO)
+                        if isinstance(kind, str):
+                            emit = _Emit(kind, keys, open_keys,
+                                         values, n.lineno, scope)
+                elif isinstance(arg, ast.Name):
+                    match = [
+                        e for e in states.get(node, frozenset())
+                        if e[0] == arg.id
+                    ]
+                    if match:
+                        _, kind, keys, open_keys = match[0]
+                        emit = _Emit(kind, keys, open_keys, {},
+                                     n.lineno, scope)
+                if emit is None:
+                    emit = _Emit(None, frozenset(), True, {},
+                                 n.lineno, scope)
+                emits.append(emit)
+                by_id[id(n)] = emit
+    return emits, by_id
+
+
+# ------------------------------------------------------- replay side
+
+
+class _Replay(object):
+    __slots__ = ("found", "scope", "line", "branches", "required",
+                 "optional", "g_required", "g_optional")
+
+    def __init__(self):
+        self.found = False
+        self.scope = ""
+        self.line = 0
+        self.branches = {}   # kind -> first branch line
+        self.required = {}   # kind -> set(keys read unconditionally)
+        self.optional = {}   # kind -> set(keys read tolerantly)
+        self.g_required = set()  # reads outside any kind branch
+        self.g_optional = set()
+
+    def _record(self, key, kinds, required):
+        if kinds is None:
+            (self.g_required if required else self.g_optional).add(key)
+            return
+        for k in kinds:
+            bucket = self.required if required else self.optional
+            bucket.setdefault(k, set()).add(key)
+
+
+def _kind_expr_ev(expr, kind_key):
+    """The event-var name when `expr` spells ``ev.get(kind_key)`` or
+    ``ev[kind_key]``, else None."""
+    if (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "get"
+            and isinstance(expr.func.value, ast.Name)
+            and expr.args
+            and isinstance(expr.args[0], ast.Constant)
+            and expr.args[0].value == kind_key):
+        return expr.func.value.id
+    if (isinstance(expr, ast.Subscript)
+            and isinstance(expr.value, ast.Name)):
+        key = _sub_key(expr)
+        if isinstance(key, ast.Constant) and key.value == kind_key:
+            return expr.value.id
+    return None
+
+
+def _find_ev_binding(fn, kind_key):
+    """(event var, kind var) of the replay dispatch: either a
+    ``kind = ev.get("ev")`` binding or a direct ``ev["ev"] == ...``
+    comparison; (None, None) when the shape is unrecognized."""
+    for n in walk_shallow(fn):
+        if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)):
+            ev = _kind_expr_ev(n.value, kind_key)
+            if ev is not None:
+                return ev, n.targets[0].id
+    for n in walk_shallow(fn):
+        if isinstance(n, ast.Compare):
+            ev = _kind_expr_ev(n.left, kind_key)
+            if ev is not None:
+                return ev, None
+    return None, None
+
+
+def _test_kinds(test, evvar, kindvar, kind_key, env):
+    """The kind literals a dispatch test selects (``kind == "x"``,
+    ``kind in ("a", "b")``, possibly inside an ``and``), else None."""
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        for v in test.values:
+            kinds = _test_kinds(v, evvar, kindvar, kind_key, env)
+            if kinds is not None:
+                return kinds
+        return None
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+        return None
+    left = test.left
+    is_kind = (
+        (kindvar is not None and isinstance(left, ast.Name)
+         and left.id == kindvar)
+        or _kind_expr_ev(left, kind_key) == evvar
+    )
+    if not is_kind:
+        return None
+    comp = test.comparators[0]
+    if isinstance(test.ops[0], ast.Eq):
+        v = _const(comp, env)
+        return [v] if isinstance(v, str) else None
+    if (isinstance(test.ops[0], ast.In)
+            and isinstance(comp, (ast.Tuple, ast.List, ast.Set))):
+        kinds = [_const(e, env) for e in comp.elts]
+        if kinds and all(isinstance(k, str) for k in kinds):
+            return kinds
+    return None
+
+
+def _guard_keys(test, evvar, env):
+    """Keys whose PRESENCE the test establishes on its true branch
+    (``"why" in ev``, ``ev.get("ok")``): subscript reads under such a
+    guard are tolerant, not required."""
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        out = set()
+        for v in test.values:
+            out |= _guard_keys(v, evvar, env)
+        return out
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.In)
+            and isinstance(test.comparators[0], ast.Name)
+            and test.comparators[0].id == evvar):
+        key = _const(test.left, env)
+        return {key} if isinstance(key, str) else set()
+    if (isinstance(test, ast.Call)
+            and isinstance(test.func, ast.Attribute)
+            and test.func.attr == "get"
+            and isinstance(test.func.value, ast.Name)
+            and test.func.value.id == evvar
+            and test.args):
+        key = _const(test.args[0], env)
+        return {key} if isinstance(key, str) else set()
+    return set()
+
+
+def _scan_reads(node, evvar, kinds, guarded, info, env):
+    """Record every read of the event var inside `node` (an expression
+    or simple statement) against the kind context."""
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Subscript)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == evvar
+                and isinstance(getattr(n, "ctx", None), ast.Load)):
+            key = _const(_sub_key(n), env)
+            if isinstance(key, str):
+                info._record(key, kinds, required=key not in guarded)
+        elif (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("get", "setdefault")
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == evvar
+                and n.args):
+            key = _const(n.args[0], env)
+            if isinstance(key, str):
+                info._record(key, kinds, required=False)
+        elif (isinstance(n, ast.Compare) and len(n.ops) == 1
+                and isinstance(n.ops[0], (ast.In, ast.NotIn))
+                and isinstance(n.comparators[0], ast.Name)
+                and n.comparators[0].id == evvar):
+            key = _const(n.left, env)
+            if isinstance(key, str):
+                info._record(key, kinds, required=False)
+
+
+def _scan_replay_block(stmts, evvar, kindvar, kind_key, env, kinds,
+                       guarded, info):
+    for s in stmts:
+        if isinstance(s, ast.If):
+            branch = _test_kinds(s.test, evvar, kindvar, kind_key,
+                                 env)
+            _scan_reads(s.test, evvar, kinds, guarded, info, env)
+            if branch is not None:
+                for k in branch:
+                    info.branches.setdefault(k, s.lineno)
+                _scan_replay_block(s.body, evvar, kindvar, kind_key,
+                                   env, branch, guarded, info)
+                _scan_replay_block(s.orelse, evvar, kindvar,
+                                   kind_key, env, kinds, guarded,
+                                   info)
+            else:
+                g = guarded | _guard_keys(s.test, evvar, env)
+                _scan_replay_block(s.body, evvar, kindvar, kind_key,
+                                   env, kinds, g, info)
+                _scan_replay_block(s.orelse, evvar, kindvar,
+                                   kind_key, env, kinds, guarded,
+                                   info)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            _scan_reads(s.iter, evvar, kinds, guarded, info, env)
+            _scan_replay_block(s.body, evvar, kindvar, kind_key, env,
+                               kinds, guarded, info)
+            _scan_replay_block(s.orelse, evvar, kindvar, kind_key,
+                               env, kinds, guarded, info)
+        elif isinstance(s, ast.While):
+            _scan_reads(s.test, evvar, kinds, guarded, info, env)
+            _scan_replay_block(s.body, evvar, kindvar, kind_key, env,
+                               kinds, guarded, info)
+            _scan_replay_block(s.orelse, evvar, kindvar, kind_key,
+                               env, kinds, guarded, info)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                _scan_reads(item.context_expr, evvar, kinds, guarded,
+                            info, env)
+            _scan_replay_block(s.body, evvar, kindvar, kind_key, env,
+                               kinds, guarded, info)
+        elif isinstance(s, ast.Try) or type(s).__name__ == "TryStar":
+            for block in (s.body, s.orelse, s.finalbody):
+                _scan_replay_block(block, evvar, kindvar, kind_key,
+                                   env, kinds, guarded, info)
+            for h in s.handlers:
+                _scan_replay_block(h.body, evvar, kindvar, kind_key,
+                                   env, kinds, guarded, info)
+        else:
+            _scan_reads(s, evvar, kinds, guarded, info, env)
+
+
+def _analyze_replay(fn, scope, kind_key, env):
+    info = _Replay()
+    info.found = True
+    info.scope = scope
+    info.line = fn.lineno
+    evvar, kindvar = _find_ev_binding(fn, kind_key)
+    if evvar is None:
+        # unrecognized dispatch shape: report nothing about branches
+        # (precision over recall), but remember we saw the function
+        return info
+    _scan_replay_block(fn.body, evvar, kindvar, kind_key, env, None,
+                       frozenset(), info)
+    return info
+
+
+# ----------------------------------------------------- typestate pass
+
+
+class _ClassCtx(object):
+    """Per-class context for the EDL703/704 machine walk."""
+
+    __slots__ = ("spec", "env", "emit_info", "setters", "touching",
+                 "state_attrs")
+
+    def __init__(self, spec, env):
+        self.spec = spec
+        self.env = env
+        self.emit_info = {}   # id(Call) -> _Emit
+        self.setters = {}     # method -> (kind, param idx, param name)
+        self.touching = set()  # methods that may move the machine
+        self.state_attrs = set()
+
+
+def _detect_setters(methods, spec, env):
+    """Methods that journal a ``to_key`` event whose target state is
+    one of their own parameters — rollout's ``_set_phase(phase, why)``
+    shape. The payload dict may be passed to the emit call inline or
+    built into a local first (``ev = {...}; self._journal(ev)``), so
+    the scan looks at every dict literal in a method that emits at
+    all. A call site passing a literal state is then a resolvable
+    pseudo-emit."""
+    setters = {}
+    for name, fn in methods.items():
+        params = [a.arg for a in fn.args.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        if not params:
+            continue
+        if not any(isinstance(n, ast.Call)
+                   and _call_name(n) == spec.emit
+                   for n in walk_shallow(fn)):
+            continue
+        for n in walk_shallow(fn):
+            if not isinstance(n, ast.Dict):
+                continue
+            kind, to_param = None, None
+            for k, v in zip(n.keys, n.values):
+                if k is None:
+                    continue
+                kv = _const(k, env)
+                if kv == spec.kind_key:
+                    c = _const(v, env)
+                    kind = c if isinstance(c, str) else None
+            ev = spec.events.get(kind) if kind else None
+            if ev is None or ev.to_key is None:
+                continue
+            for k, v in zip(n.keys, n.values):
+                if (k is not None and _const(k, env) == ev.to_key
+                        and isinstance(v, ast.Name)
+                        and v.id in params):
+                    to_param = v.id
+            if to_param is not None:
+                setters[name] = (kind, params.index(to_param),
+                                 to_param)
+    return setters
+
+
+def _build_class_ctx(spec, env, members):
+    """`members`: [(scope, fndef, cfg, emits_by_call_id)]."""
+    ctx = _ClassCtx(spec, env)
+    methods = {fn.name: fn for _s, fn, _c, _b in members}
+    for _s, _f, _c, by_id in members:
+        ctx.emit_info.update(by_id)
+    ctx.setters = _detect_setters(methods, spec, env)
+    # state attrs: assigned a state literal anywhere in the class, or
+    # assigned the to_key parameter inside a setter
+    for name, fn in methods.items():
+        setter = ctx.setters.get(name)
+        for n in walk_shallow(fn):
+            if not isinstance(n, ast.Assign):
+                continue
+            for t in n.targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                v = _const(n.value, env)
+                if v is not _NO and v in spec.states:
+                    ctx.state_attrs.add(attr)
+                elif (setter is not None
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == setter[2]):
+                    ctx.state_attrs.add(attr)
+    # touching fixpoint: a method that emits, assigns a state attr,
+    # or calls a touching method can move the machine
+    calls = {}
+    for name, fn in methods.items():
+        touches = False
+        callees = set()
+        for n in walk_shallow(fn):
+            if isinstance(n, ast.Call):
+                if _call_name(n) == spec.emit:
+                    touches = True
+                attr = _self_attr(n.func)
+                if attr is not None:
+                    callees.add(attr)
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if _self_attr(t) in ctx.state_attrs:
+                        touches = True
+        calls[name] = callees
+        if touches:
+            ctx.touching.add(name)
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls.items():
+            if name not in ctx.touching and callees & ctx.touching:
+                ctx.touching.add(name)
+                changed = True
+    return ctx
+
+
+def _machine_effects(node, st, ctx, sink=None):
+    """Typestate transfer for one CFG node. With `sink` (the
+    post-fixpoint reporting pass) also records convictions and
+    emit-site post-states: sink = (convictions, emit_records,
+    emit_nodes)."""
+    spec = ctx.spec
+    for root in node.scan_roots():
+        for n in walk_shallow(root):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    attr = _self_attr(t)
+                    if attr in ctx.state_attrs:
+                        v = _const(n.value, ctx.env)
+                        st = v if v in spec.states else _UNKNOWN
+            elif isinstance(n, ast.Call):
+                name = _call_name(n)
+                if name == spec.emit and n.args:
+                    st = _emit_effect(node, n, st, ctx, sink)
+                    continue
+                attr = _self_attr(n.func)
+                if attr is None:
+                    continue
+                if attr in ctx.setters:
+                    st = _setter_effect(node, n, attr, st, ctx, sink)
+                elif attr in ctx.touching:
+                    st = _UNKNOWN
+    return st
+
+
+def _emit_effect(node, call, st, ctx, sink):
+    spec = ctx.spec
+    e = ctx.emit_info.get(id(call))
+    if e is None or e.kind is None:
+        return _UNKNOWN
+    ev = spec.events.get(e.kind)
+    if ev is None:
+        return _UNKNOWN  # EDL701 owns the conviction
+    if sink is not None:
+        sink[2].add(node.idx)
+    if ev.informational or ev.entity_key is not None:
+        return st
+    payload = {k: v for k, v in e.values.items() if v is not _NO}
+    cur = None if st == _UNKNOWN else st
+    if sink is not None and cur is not None:
+        if not spec.legal(cur, e.kind, payload):
+            sink[0].append(Finding(
+                "EDL703", None, e.line, e.scope,
+                "%s@%s" % (e.kind, cur),
+                "event %r journaled while the %r machine is in "
+                "state %r, which the declared protocol forbids "
+                "(legal from: %s)" % (
+                    e.kind, spec.name, cur,
+                    "any" if spec.events[e.kind].frm == "*" else
+                    ", ".join(spec.events[e.kind].frm),
+                ),
+            ))
+    nxt = spec.apply(cur, e.kind, payload)
+    out = _UNKNOWN if nxt is None else nxt
+    if sink is not None:
+        sink[1].append((node.idx, e.kind, out, e.line, e.scope))
+    return out
+
+
+def _setter_effect(node, call, attr, st, ctx, sink):
+    spec = ctx.spec
+    kind, pidx, pname = ctx.setters[attr]
+    ev = spec.events[kind]
+    target = _NO
+    if pidx < len(call.args):
+        target = _const(call.args[pidx], ctx.env)
+    else:
+        for kw in call.keywords:
+            if kw.arg == pname:
+                target = _const(kw.value, ctx.env)
+    if sink is not None:
+        sink[2].add(node.idx)
+    if target is _NO or target not in spec.states:
+        return _UNKNOWN
+    cur = None if st == _UNKNOWN else st
+    if sink is not None and cur is not None:
+        if not spec.legal(cur, kind, {ev.to_key: target}):
+            sink[0].append(Finding(
+                "EDL703", None, call.lineno,
+                "", "%s:%s@%s" % (kind, target, cur),
+                "transition to %r (via %r) while the %r machine is "
+                "in state %r, which the declared transitions "
+                "forbid" % (target, attr, spec.name, cur),
+            ))
+    if sink is not None:
+        sink[1].append((node.idx, kind, target, call.lineno, ""))
+    return target
+
+
+def _typestate_findings(spec, env, members, path):
+    """EDL703 + EDL704 findings for one class's methods."""
+    ctx = _build_class_ctx(spec, env, members)
+    out = []
+    ok_states = (set(spec.recoverable) | set(spec.terminal)
+                 | {_UNKNOWN})
+    for scope, fn, cfg, _by_id in members:
+        in_states = forward(
+            cfg,
+            lambda n, s: _machine_effects(n, s, ctx),
+            entry_state=_UNKNOWN,
+            join=lambda a, b: a if a == b else _UNKNOWN,
+        )
+        convictions, records, emit_nodes = [], [], set()
+        sink = (convictions, records, emit_nodes)
+        for node in cfg.nodes:
+            st = in_states.get(node)
+            if st is None:
+                continue  # unreachable
+            _machine_effects(node, st, ctx, sink=sink)
+        for f in convictions:
+            f.path = path
+            if not f.scope:
+                f.scope = scope
+            out.append(f)
+        for idx, kind, s_after, line, escope in records:
+            if s_after in ok_states:
+                continue
+            # can a LATER journal write happen while the machine sits
+            # in this non-recoverable state?
+            seen, stack = set(), list(cfg.nodes[idx].out)
+            reaches = False
+            while stack and not reaches:
+                n = stack.pop()
+                if n.idx in seen:
+                    continue
+                seen.add(n.idx)
+                if n.idx in emit_nodes:
+                    reaches = True
+                    break
+                stack.extend(n.out)
+            if reaches:
+                out.append(Finding(
+                    "EDL704", path, line, escope or scope,
+                    "%s@%s" % (kind, s_after),
+                    "a crash after this %r emit strands the journal "
+                    "in state %r, which declares no resume action "
+                    "(not in `recoverable`), yet another journal "
+                    "write is reachable — the window between the "
+                    "two writes is an unrecoverable crash "
+                    "point" % (kind, s_after),
+                ))
+    return out
+
+
+# ------------------------------------------------------------ checker
+
+
+@register
+class JournalProtocolRule(Rule):
+    """C22 — journal-protocol verification: write/replay closure
+    (EDL701), payload-schema drift (EDL702), transition legality
+    (EDL703), crash-point closure (EDL704)."""
+
+    id = "EDL701"
+    name = "journal-protocol"
+
+    def check_module(self, tree, lines, path):
+        decl = find_protocol_decl(tree)
+        if decl is None:
+            return
+        env = module_constant_env(tree)
+        try:
+            spec = machine_from_ast(decl.value, env)
+        except ProtocolError as e:
+            yield Finding(
+                "EDL701", path, decl.lineno, "<module>",
+                "malformed-protocol",
+                "PROTOCOL declaration is not a valid pure-literal "
+                "JournalProtocol: %s" % e,
+            )
+            return
+
+        funcs = _functions(tree)
+        members = []  # (scope, fn, cls, cfg, emits, by_id)
+        for scope, fn, cls in funcs:
+            cfg = build_cfg(fn)
+            emits, by_id = _collect_emits(scope, cfg, env, spec)
+            members.append((scope, fn, cls, cfg, emits, by_id))
+
+        replay = _Replay()
+        for scope, fn, cls, _cfg, _e, _b in members:
+            if fn.name == spec.replay:
+                replay = _analyze_replay(fn, scope, spec.kind_key,
+                                         env)
+                break
+
+        all_emits = [e for _s, _f, _c, _g, es, _b in members
+                     for e in es]
+        resolved = [e for e in all_emits if e.kind is not None]
+        unresolved = len(all_emits) - len(resolved)
+        first = {}
+        for e in resolved:
+            first.setdefault(e.kind, e)
+
+        # ---- EDL701: write/replay closure
+        if not replay.found:
+            yield Finding(
+                "EDL701", path, decl.lineno, "<module>",
+                "missing-replay:%s" % spec.replay,
+                "the declared replay function %r does not exist in "
+                "this module — every journaled event is "
+                "unrecoverable" % spec.replay,
+            )
+        for kind in sorted(first):
+            e = first[kind]
+            ev = spec.events.get(kind)
+            if ev is None:
+                yield Finding(
+                    "EDL701", path, e.line, e.scope,
+                    "undeclared-kind:%s" % kind,
+                    "event kind %r is journaled but absent from the "
+                    "declared protocol alphabet — declare it (with "
+                    "its transition and payload contract) or drop "
+                    "the emit" % kind,
+                )
+            elif (replay.found and not ev.informational
+                    and kind not in replay.branches):
+                yield Finding(
+                    "EDL701", path, e.line, e.scope,
+                    "no-replay:%s" % kind,
+                    "event kind %r is journaled here but %r has no "
+                    "branch for it: after a crash the event replays "
+                    "as a no-op and recovery diverges from the "
+                    "pre-crash state" % (kind, spec.replay),
+                )
+        for kind in sorted(replay.branches):
+            line = replay.branches[kind]
+            if kind not in spec.events:
+                yield Finding(
+                    "EDL701", path, line, replay.scope,
+                    "dead-replay:%s" % kind,
+                    "replay branch for kind %r, which the declared "
+                    "protocol does not know — dead recovery code "
+                    "(or an undeclared event)" % kind,
+                )
+            elif resolved and not unresolved and kind not in first:
+                yield Finding(
+                    "EDL701", path, line, replay.scope,
+                    "never-emitted:%s" % kind,
+                    "replay branch for kind %r, which no emit site "
+                    "in this module produces — dead recovery "
+                    "code" % kind,
+                )
+
+        # ---- EDL702: payload-schema drift
+        for e in resolved:
+            ev = spec.events.get(e.kind)
+            if ev is None or e.open_keys:
+                continue
+            needed = set(replay.required.get(e.kind, ()))
+            needed |= set(ev.requires)
+            if ev.entity_key:
+                needed.add(ev.entity_key)
+            missing = needed - set(e.keys) - {spec.kind_key}
+            for key in sorted(missing):
+                yield Finding(
+                    "EDL702", path, e.line, e.scope,
+                    "%s.%s" % (e.kind, key),
+                    "emit site for %r does not definitely write key "
+                    "%r, which replay (or the declared contract) "
+                    "requires — a key added only on some branches "
+                    "must be declared `optional` and read via "
+                    ".get()" % (e.kind, key),
+                )
+
+        # ---- EDL703/EDL704: typestate + crash-point closure
+        by_class = {}
+        for scope, fn, cls, cfg, _e, by_id in members:
+            by_class.setdefault(cls, []).append(
+                (scope, fn, cfg, by_id)
+            )
+        for cls in sorted(by_class, key=lambda c: c or ""):
+            for f in _typestate_findings(spec, env, by_class[cls],
+                                         path):
+                yield f
+
+    def check_repo(self, root):
+        out = []
+        for rel in protocol_specs.WAL_CONTROLLERS:
+            full = os.path.join(root, *rel.split("/"))
+            if not os.path.exists(full):
+                continue
+            try:
+                with open(full) as f:
+                    tree = ast.parse(f.read(), filename=full)
+            except (OSError, SyntaxError, UnicodeDecodeError):
+                continue
+            if find_protocol_decl(tree) is None:
+                out.append(Finding(
+                    "EDL701", rel, 1, "<module>", "missing-protocol",
+                    "this module is a registered WAL controller "
+                    "(analysis/protocol_specs.py) but declares no "
+                    "PROTOCOL = JournalProtocol(...) — its journal "
+                    "is unchecked",
+                ))
+        return out

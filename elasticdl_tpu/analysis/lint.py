@@ -19,6 +19,12 @@ Options:
   --jobs N           fan per-file analysis over N processes (0 = one
                      per CPU); repo-level rules stay in-process and
                      output is byte-identical to serial
+  --no-cache         skip the on-disk per-file result cache
+                     (.edl-lint-cache.json at the repo root, keyed by
+                     file content hash x rule-set version; warm runs
+                     are byte-identical to cold ones, so the only
+                     reason to disable it is benchmarking or a
+                     corrupted cache file)
   --changed-only     lint only files changed vs the git merge base
                      (plus untracked files) — the pre-commit mode.
                      Stale-baseline enforcement is skipped: a subset
@@ -64,6 +70,7 @@ RULE_FAMILIES = {
     "EDL401": ("EDL401",),
     "EDL501": ("EDL501",),
     "EDL601": ("EDL601",),
+    "EDL701": ("EDL701", "EDL702", "EDL703", "EDL704"),
 }
 
 DEFAULT_PATHS = ("elasticdl_tpu", "scripts", "tests")
@@ -182,6 +189,8 @@ def main(argv=None):
     parser.add_argument("--jobs", type=int, default=1,
                         help="processes for per-file analysis "
                              "(0 = cpu count)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="skip the per-file result cache")
     parser.add_argument("--changed-only", action="store_true")
     parser.add_argument("--base", default=None,
                         help="merge-base ref for --changed-only")
@@ -233,10 +242,23 @@ def main(argv=None):
                       "linted paths")
                 return 0
 
+    cache = None
+    if not args.no_cache:
+        from elasticdl_tpu.analysis.cache import (
+            CACHE_BASENAME,
+            ResultCache,
+            cache_context,
+        )
+
+        cache = ResultCache(
+            os.path.join(root, CACHE_BASENAME),
+            cache_context(r.id for r in rules),
+        )
+
     jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
     t0 = time.monotonic()
     findings, errors = run_rules(paths, rules=rules, root=root,
-                                 jobs=jobs)
+                                 jobs=jobs, cache=cache)
     elapsed = time.monotonic() - t0
     for err in errors:
         print("edl-lint: ERROR %s" % err, file=sys.stderr)

@@ -1,0 +1,44 @@
+"""Registry of WAL-backed controllers and their protocol loaders.
+
+Each listed module is REQUIRED to carry a module-level
+``PROTOCOL = JournalProtocol(...)`` declaration (see `typestate.py`);
+a missing declaration is an EDL701 conviction in its own right — the
+write/replay closure, payload-drift, typestate, and crash-point rules
+can only gate journals that declare their machine, so the gate on the
+declaration itself is what makes new journal consumers born-checked.
+
+`load_protocol` re-reads a declaration from source without importing
+the module: the lint rules and spec-derived test generators run in
+environments (the CI lint job, fixture files) where importing a
+serving controller — and its jax dependency chain — is not an option.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.typestate import (
+    ProtocolError,
+    find_protocol_decl,
+    machine_from_ast,
+    module_constant_env,
+)
+
+#: repo-relative paths of every shipped WAL-backed controller; a new
+#: journal consumer is added here in the SAME PR that introduces it
+WAL_CONTROLLERS = (
+    "elasticdl_tpu/master/task_dispatcher.py",
+    "elasticdl_tpu/serving/autoscaler.py",
+    "elasticdl_tpu/serving/rollout.py",
+    "elasticdl_tpu/serving/router_cell.py",
+)
+
+
+def load_protocol(path):
+    """The declared JournalProtocol of the module at `path`, parsed
+    from source (never imported). Raises ProtocolError when the file
+    has no declaration or the declaration is malformed."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    decl = find_protocol_decl(tree)
+    if decl is None:
+        raise ProtocolError("%s declares no PROTOCOL" % path)
+    return machine_from_ast(decl.value, module_constant_env(tree))

@@ -35,6 +35,11 @@ def _rule_meta(rules, families):
                 "id": fid,
                 "name": rule.name or fid,
                 "shortDescription": {"text": title},
+                # the catalogue row in the design doc; code-scanning
+                # renders it as the alert's "learn more" link
+                "helpUri": (
+                    "docs/designs/static_analysis.md#%s" % fid.lower()
+                ),
             }
     return [metas[k] for k in sorted(metas)]
 

@@ -1,0 +1,381 @@
+"""Declared journal-protocol state machines for WAL-backed controllers.
+
+Every controller that write-aheads events through a `JobStateStore`
+declares its protocol as a module-level pure-literal call:
+
+    PROTOCOL = JournalProtocol(
+        name="rollout",
+        kind_key="ev",            # payload key carrying the event kind
+        emit="_journal",          # the writer method (call surface)
+        replay="_apply_event",    # the paired replay function
+        states=(IDLE, STAGING, ...),
+        initial=IDLE,
+        events={
+            "begin": {"from": TERMINAL, "to": STAGING,
+                      "requires": ("target", "old", "plan")},
+            "phase": {"from": "*", "to_key": "to"},
+            "swap_start": {"from": (CANARY, WAVE, ROLLING_BACK),
+                           "informational": True},
+            ...
+        },
+        transitions={STAGING: (CANARY, ABORTED), ...},
+        recoverable={STAGING: "re-stage the checkpoint", ...},
+    )
+
+The declaration is the SINGLE SOURCE OF TRUTH, consumed three ways:
+
+* at runtime by the controller itself and by the spec-derived
+  crash-point replay batteries (`analysis/protocol_testgen.py`);
+* at lint time by `journal_rules` (EDL701-EDL704), which re-reads the
+  SAME declaration from the module's AST (`machine_from_ast`) so the
+  checker needs no imports — it works on fixture files and in the
+  minimal CI lint environment alike;
+* by reviewers, as the one place a controller's crash contract is
+  written down.
+
+Event entry vocabulary (all optional except membership itself):
+
+* ``"from"`` — tuple of states the event may be emitted in, or ``"*"``
+  (any state; the default). Idempotent-replay protocols declare
+  liberal from-sets on purpose.
+* ``"to"`` — the machine state after the event (omit/None = no state
+  change).
+* ``"to_key"`` — for generic transition events ("phase"): the payload
+  key that CARRIES the target state; legality of the hop is judged
+  against ``transitions`` (declared adjacency between states).
+* ``"requires"`` — payload keys every emit site must write (the
+  replay side reads them unconditionally; EDL702's contract).
+* ``"optional"`` — payload keys an emit MAY write (the replay side
+  must read them tolerantly, via ``.get``).
+* ``"informational"`` — forensic-only: no replay branch required and
+  no state effect (the router cell's ``lease`` beacon, rollout's
+  ``swap_start``). EDL701 exempts these from write/replay closure.
+* ``"entity_key"`` — for per-entity lifecycles (a seat, a replica
+  address, a task id): the payload key naming the entity this event
+  transitions. Events without it act on the GLOBAL machine state.
+
+``recoverable`` maps each state in which a crash may legally strand
+the journal to its declared resume action (a one-line description of
+how recovery proceeds from there). EDL704 convicts an emit that can
+be followed by another emit while the machine sits in a state absent
+from this map. ``terminal`` states need no resume action by
+construction but may still be listed.
+
+Pure stdlib on purpose: imported by serving/master controllers AND by
+the analyzer, in environments without jax.
+"""
+
+ANY = "*"
+
+
+class ProtocolError(ValueError):
+    """A malformed declaration or an illegal event sequence."""
+
+
+class EventSpec(object):
+    def __init__(self, kind, frm=ANY, to=None, to_key=None,
+                 requires=(), optional=(), informational=False,
+                 entity_key=None):
+        self.kind = kind
+        self.frm = ANY if frm == ANY else tuple(frm)
+        self.to = to
+        self.to_key = to_key
+        self.requires = tuple(requires)
+        self.optional = tuple(optional)
+        self.informational = bool(informational)
+        self.entity_key = entity_key
+        if to is not None and to_key is not None:
+            raise ProtocolError(
+                "event %r declares both 'to' and 'to_key'" % kind
+            )
+        if informational and (to is not None or to_key is not None):
+            raise ProtocolError(
+                "informational event %r cannot change state" % kind
+            )
+
+
+_EVENT_FIELDS = frozenset((
+    "from", "to", "to_key", "requires", "optional", "informational",
+    "entity_key",
+))
+
+
+class JournalProtocol(object):
+    """A declared WAL protocol: states, event alphabet, legal
+    transitions, recoverable states, and the emit/replay pairing."""
+
+    def __init__(self, name, states, initial, events,
+                 recoverable=None, transitions=None, kind_key="ev",
+                 emit="_journal", replay="_apply_event", terminal=()):
+        self.name = name
+        self.states = tuple(states)
+        self.initial = initial
+        self.kind_key = kind_key
+        self.emit = emit
+        self.replay = replay
+        self.terminal = tuple(terminal)
+        self.transitions = {
+            s: tuple(ts) for s, ts in (transitions or {}).items()
+        }
+        self.recoverable = dict(recoverable or {})
+        self.events = {}
+        for kind, entry in events.items():
+            extra = set(entry) - _EVENT_FIELDS
+            if extra:
+                raise ProtocolError(
+                    "event %r has unknown field(s) %s"
+                    % (kind, ", ".join(sorted(extra)))
+                )
+            self.events[kind] = EventSpec(
+                kind,
+                frm=entry.get("from", ANY),
+                to=entry.get("to"),
+                to_key=entry.get("to_key"),
+                requires=entry.get("requires", ()),
+                optional=entry.get("optional", ()),
+                informational=entry.get("informational", False),
+                entity_key=entry.get("entity_key"),
+            )
+        self._validate()
+
+    def _validate(self):
+        known = set(self.states)
+        if self.initial not in known:
+            raise ProtocolError(
+                "initial state %r not in states" % (self.initial,)
+            )
+        for s in self.terminal:
+            if s not in known:
+                raise ProtocolError(
+                    "terminal state %r not in states" % (s,)
+                )
+        for s, targets in self.transitions.items():
+            for t in (s,) + tuple(targets):
+                if t not in known:
+                    raise ProtocolError(
+                        "transition state %r not in states" % (t,)
+                    )
+        for s in self.recoverable:
+            if s not in known:
+                raise ProtocolError(
+                    "recoverable state %r not in states" % (s,)
+                )
+        for spec in self.events.values():
+            if spec.frm != ANY:
+                for s in spec.frm:
+                    if s not in known:
+                        raise ProtocolError(
+                            "event %r 'from' state %r not in states"
+                            % (spec.kind, s)
+                        )
+            if spec.to is not None and spec.to not in known:
+                raise ProtocolError(
+                    "event %r 'to' state %r not in states"
+                    % (spec.kind, spec.to)
+                )
+
+    # ------------------------------------------------------ machine ops
+
+    @property
+    def alphabet(self):
+        return frozenset(self.events)
+
+    def replayed_kinds(self):
+        """Kinds that MUST have a replay branch (non-informational)."""
+        return frozenset(
+            k for k, s in self.events.items() if not s.informational
+        )
+
+    def legal(self, state, kind, payload=None):
+        """May `kind` be emitted while the (global or entity) machine
+        sits in `state`? `state` may be None (unknown) — then any
+        emit is legal (precision over recall, like every engine
+        layer)."""
+        spec = self.events.get(kind)
+        if spec is None:
+            return False
+        if state is None:
+            return True
+        if spec.frm != ANY and state not in spec.frm:
+            return False
+        if spec.to_key is not None and payload is not None:
+            target = payload.get(spec.to_key)
+            if target is not None:
+                allowed = self.transitions.get(state)
+                if allowed is not None and target not in allowed:
+                    return False
+        return True
+
+    def apply(self, state, kind, payload=None):
+        """The machine state after emitting `kind` from `state`.
+        Returns None (unknown) when the target cannot be determined
+        statically; raises ProtocolError on an undeclared kind."""
+        spec = self.events.get(kind)
+        if spec is None:
+            raise ProtocolError(
+                "undeclared event kind %r in protocol %r"
+                % (kind, self.name)
+            )
+        if spec.informational:
+            return state
+        if spec.to is not None:
+            return spec.to
+        if spec.to_key is not None:
+            target = (payload or {}).get(spec.to_key)
+            if target in self.states:
+                return target
+            return None
+        return state
+
+    def simulate(self, events, strict=True):
+        """Fold a journal (list of event dicts) through the machine.
+
+        Returns ``(global_state, entity_states)``: the global machine
+        state plus a dict entity-id -> state for per-entity events
+        (entities start at `initial`... for entity protocols the
+        declared `initial` doubles as the per-entity start state).
+        With strict=True an illegal emission raises ProtocolError —
+        the dynamic twin of EDL703."""
+        state = self.initial
+        entities = {}
+        for i, ev in enumerate(events):
+            kind = ev.get(self.kind_key)
+            spec = self.events.get(kind)
+            if spec is None:
+                if strict:
+                    raise ProtocolError(
+                        "event %d: undeclared kind %r" % (i, kind)
+                    )
+                continue
+            if spec.entity_key is not None:
+                eid = ev.get(spec.entity_key)
+                cur = entities.get(eid, self.initial)
+                if strict and not self.legal(cur, kind, ev):
+                    raise ProtocolError(
+                        "event %d: %r illegal for entity %r in "
+                        "state %r" % (i, kind, eid, cur)
+                    )
+                nxt = self.apply(cur, kind, ev)
+                if not spec.informational:
+                    entities[eid] = nxt if nxt is not None else cur
+            else:
+                if strict and not self.legal(state, kind, ev):
+                    raise ProtocolError(
+                        "event %d: %r illegal in state %r"
+                        % (i, kind, state)
+                    )
+                nxt = self.apply(state, kind, ev)
+                if nxt is not None:
+                    state = nxt
+        return state, entities
+
+    def assert_recoverable_prefixes(self, events):
+        """Every prefix of `events` must leave the machine in a state
+        with a declared resume action — the dynamic twin of EDL704.
+        Terminal states count as trivially recoverable."""
+        state = self.initial
+        ok = set(self.recoverable) | set(self.terminal)
+        ok.add(self.initial)
+        for i, ev in enumerate(events):
+            kind = ev.get(self.kind_key)
+            spec = self.events.get(kind)
+            if spec is None or spec.entity_key is not None:
+                continue
+            nxt = self.apply(state, kind, ev)
+            if nxt is not None:
+                state = nxt
+            if state not in ok:
+                raise ProtocolError(
+                    "after event %d (%r) the machine is in %r, which "
+                    "declares no resume action" % (i, kind, state)
+                )
+
+
+# ----------------------------------------------- AST-side extraction
+#
+# journal_rules re-reads the SAME declaration from the module AST so
+# the checker needs no imports: fixture files are parsed, never
+# imported, and the CI lint job runs without the serving deps. The
+# declaration must therefore be a PURE LITERAL call — constants,
+# module-level string/tuple constants, tuples/lists/dicts/bools.
+
+import ast  # noqa: E402  (grouped with the extraction half on purpose)
+
+
+def module_constant_env(tree):
+    """Resolve module-level literal assignments (``CANARY = "canary"``,
+    ``TERMINAL = (IDLE, COMMITTED)``) into a name -> value map, in
+    statement order so later constants may reference earlier ones."""
+    env = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            try:
+                env[node.targets[0].id] = _literal(node.value, env)
+            except ProtocolError:
+                pass
+    return env
+
+
+def _literal(node, env):
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise ProtocolError("unresolvable name %r" % node.id)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(_literal(e, env) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        out = {}
+        for k, v in zip(node.keys, node.values):
+            if k is None:
+                raise ProtocolError("dict ** expansion not literal")
+            out[_literal(k, env)] = _literal(v, env)
+        return out
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add)):
+        left = _literal(node.left, env)
+        right = _literal(node.right, env)
+        if isinstance(left, tuple) and isinstance(right, tuple):
+            return left + right
+        raise ProtocolError("non-tuple concatenation")
+    raise ProtocolError(
+        "non-literal %s in PROTOCOL declaration"
+        % type(node).__name__
+    )
+
+
+def find_protocol_decl(tree):
+    """The module-level ``PROTOCOL = JournalProtocol(...)`` assignment
+    node, or None."""
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "PROTOCOL"
+                and isinstance(node.value, ast.Call)):
+            func = node.value.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name == "JournalProtocol":
+                return node
+    return None
+
+
+def machine_from_ast(call_node, env):
+    """Rebuild the JournalProtocol from its declaration Call node.
+    Raises ProtocolError when the declaration is not a pure literal
+    or fails the machine's own validation."""
+    if call_node.args:
+        raise ProtocolError(
+            "PROTOCOL must use keyword arguments only"
+        )
+    kwargs = {}
+    for kw in call_node.keywords:
+        if kw.arg is None:
+            raise ProtocolError("** expansion is not literal")
+        kwargs[kw.arg] = _literal(kw.value, env)
+    try:
+        return JournalProtocol(**kwargs)
+    except TypeError as e:
+        raise ProtocolError(str(e))

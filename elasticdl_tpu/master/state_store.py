@@ -57,6 +57,7 @@ class JobStateStore(object):
         self._appends_since_snapshot = 0
         self.journal_appends = 0
         self.compactions = 0
+        self.torn_lines = 0
         if self._had_state:
             self._bump_restarts()
 
@@ -67,26 +68,35 @@ class JobStateStore(object):
 
     def load(self):
         """(snapshot dict or None, [journal events]). Tolerates a torn
-        final journal line — the one write a SIGKILL can interrupt."""
+        final journal line — the one write a SIGKILL can interrupt —
+        whether it is a JSON prefix, non-UTF-8 block garbage, or
+        missing its newline entirely; every dropped tail bumps the
+        ``torn_lines`` counter. Corruption anywhere EARLIER in the
+        journal still raises: that is data loss, not a crash artifact."""
         snapshot = None
         if os.path.exists(self._snapshot_path):
             with open(self._snapshot_path) as f:
                 snapshot = json.load(f)
         events = []
         if os.path.exists(self._journal_path):
-            with open(self._journal_path) as f:
+            self._trim_torn_tail()
+            # binary read: a torn tail of raw block garbage must not
+            # blow up the WHOLE read with UnicodeDecodeError before
+            # per-line tolerance gets a chance
+            with open(self._journal_path, "rb") as f:
                 lines = f.readlines()
-            for i, line in enumerate(lines):
-                line = line.strip()
-                if not line:
+            for i, raw in enumerate(lines):
+                raw = raw.strip()
+                if not raw:
                     continue
                 try:
-                    events.append(json.loads(line))
-                except ValueError:
+                    events.append(json.loads(raw.decode("utf-8")))
+                except ValueError:  # includes UnicodeDecodeError
                     if i == len(lines) - 1:
+                        self.torn_lines += 1
                         logger.warning(
                             "Dropping torn final journal line (%d bytes)",
-                            len(line),
+                            len(raw),
                         )
                     else:
                         raise
@@ -94,8 +104,33 @@ class JobStateStore(object):
 
     # ------------------------------------------------------------ writing
 
+    def _trim_torn_tail(self):
+        """Physically drop a newline-less journal tail. Without the
+        trim, the next append would concatenate onto the torn line,
+        promoting recoverable TAIL garbage into a corrupt mid-file
+        line that load() rightly refuses to skip."""
+        try:
+            size = os.path.getsize(self._journal_path)
+        except OSError:
+            return
+        if size == 0:
+            return
+        with open(self._journal_path, "rb+") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) == b"\n":
+                return
+            f.seek(0)
+            keep = f.read().rfind(b"\n") + 1  # 0: no newline at all
+            f.truncate(keep)
+        self.torn_lines += 1
+        logger.warning(
+            "Trimmed torn journal tail (%d bytes) before append",
+            size - keep,
+        )
+
     def _open_journal(self):
         if self._journal is None:
+            self._trim_torn_tail()
             self._journal = open(self._journal_path, "a")
         return self._journal
 

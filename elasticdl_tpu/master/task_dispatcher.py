@@ -38,6 +38,49 @@ from elasticdl_tpu.common.constants import (
     TaskExecCounterKey,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.analysis.typestate import JournalProtocol
+
+#: Declared journal protocol: the single source of truth edl-lint
+#: (EDL701-EDL704) verifies restore() and every _journal() site
+#: against, and the machine the spec-derived crash-point replay
+#: battery walks (tests/test_protocol_batteries.py). Task lifecycle is
+#: per-id (``entity_key``): ids are dispatched once (the counter only
+#: grows), a failure requeues the RANGE under a future fresh id, and
+#: ``done_recovered`` reconciles an id dispatched before a crash — its
+#: ``dispatch`` may live in an earlier journal incarnation, hence the
+#: liberal from-set.
+PROTOCOL = JournalProtocol(
+    name="task_dispatcher",
+    kind_key="ev",
+    emit="_journal",
+    replay="restore",
+    states=("idle", "doing", "done"),
+    initial="idle",
+    terminal=("done",),
+    events={
+        "create": {"requires": ("task_type", "tasks"),
+                   "optional": ("epoch",)},
+        "dispatch": {"entity_key": "id", "from": ("idle",),
+                     "to": "doing", "requires": ("task",),
+                     "optional": ("worker",)},
+        "done": {"entity_key": "id", "from": ("doing",),
+                 "to": "done", "requires": ("task",)},
+        "done_recovered": {"entity_key": "id", "from": "*",
+                           "to": "done", "requires": ("task",)},
+        "fail": {"entity_key": "id", "from": ("doing",),
+                 "to": "idle", "requires": ("task",)},
+        "stop": {},
+        "version": {"requires": ("v",)},
+        "deferred_add": {},
+        "deferred_invoked": {},
+    },
+    recoverable={
+        "idle": "restore() rebuilds todo from snapshot + journal",
+        "doing": "restore() requeues in-flight ranges and parks the "
+                 "old ids in _recovered_doing for reconciliation",
+        "done": "nothing to resume",
+    },
+)
 
 
 class TaskType(object):
@@ -560,13 +603,23 @@ class TaskDispatcher(object):
         for ev in events:
             kind = ev.get("ev")
             if kind == "create":
-                if ev["task_type"] == TaskType.TRAINING:
-                    epoch = ev.get("epoch", epoch)
-                    todo.extend(ev["tasks"])
-                elif ev["task_type"] == TaskType.EVALUATION:
-                    eval_todo.extend(ev["tasks"])
+                # idempotent under snapshot/journal overlap (a crash
+                # between write_snapshot and the journal truncate
+                # replays the full journal against a snapshot that
+                # already incorporates it): a task whose range is
+                # still queued or in flight is not re-added — later
+                # dispatch/done/fail events re-consume the rest
+                if ev["task_type"] == TaskType.EVALUATION:
+                    queue = eval_todo
                 else:
-                    todo.extend(ev["tasks"])
+                    if ev["task_type"] == TaskType.TRAINING:
+                        epoch = ev.get("epoch", epoch)
+                    queue = todo
+                present = {_key(p) for p in queue}
+                present |= {_key(p) for _w, p in doing.values()}
+                queue.extend(
+                    p for p in ev["tasks"] if _key(p) not in present
+                )
             elif kind == "dispatch":
                 p = ev["task"]
                 queue = (

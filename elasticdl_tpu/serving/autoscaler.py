@@ -81,6 +81,7 @@ import sys
 import threading
 import time
 
+from elasticdl_tpu.analysis.typestate import JournalProtocol
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.master.state_store import JobStateStore
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -88,6 +89,49 @@ from elasticdl_tpu.proto import elasticdl_pb2 as pb
 STARTING = "starting"
 LIVE = "live"
 DRAINING = "draining"
+
+#: journal protocol declaration, verified by edl-lint EDL701-704
+#: (write/replay closure, payload-schema drift, transition legality,
+#: crash-point recoverability) and walked by the spec-derived
+#: crash-replay battery in tests. The machine is PER SEAT (entity_key)
+#: except for `target`, which is global fleet intent; `absent` and
+#: `allocated` name the windows where the journal knows a seat id but
+#: no process exists yet.
+PROTOCOL = JournalProtocol(
+    name="autoscaler",
+    kind_key="ev",
+    emit="_journal",
+    replay="_apply_event",
+    states=("absent", "allocated", STARTING, LIVE, DRAINING),
+    initial="absent",
+    events={
+        "target": {"requires": ("n",), "optional": ("why",)},
+        "spawn": {"entity_key": "seat", "from": ("absent",),
+                  "to": "allocated"},
+        "launched": {"entity_key": "seat", "from": ("allocated",),
+                     "to": STARTING, "requires": ("pid",),
+                     "optional": ("log",)},
+        "adopt": {"entity_key": "seat", "from": (STARTING,),
+                  "to": LIVE, "requires": ("pid", "address")},
+        "begin_drain": {"entity_key": "seat",
+                        "from": (STARTING, LIVE), "to": DRAINING,
+                        "optional": ("why",)},
+        # `retire` is from-any: supervisor stop retires every seat
+        # regardless of phase, not just draining ones
+        "retire": {"entity_key": "seat", "from": "*", "to": "absent",
+                   "optional": ("rc", "why")},
+        "reap": {"entity_key": "seat", "from": "*", "to": "absent",
+                 "requires": ("why", "cause")},
+    },
+    recoverable={
+        "absent": "nothing to resume",
+        "allocated": "spawn either reached `launched` or the deficit "
+                     "path respawns the capacity",
+        STARTING: "re-attach the pid and poll readiness from the log",
+        LIVE: "re-adopt and re-register with the router",
+        DRAINING: "the exit retires it; drain timeout kills stragglers",
+    },
+)
 
 
 class AutoscalerConfig(object):
@@ -469,10 +513,20 @@ class ReplicaSupervisor(object):
                 seats[sid]["state"] = DRAINING
         elif kind in ("retire", "reap"):
             if kind == "reap":
-                why = str(ev.get("why", ""))
-                if why.startswith("exited"):
+                # the explicit `cause` key wins; the why-prefix match
+                # only decodes journals written before it existed
+                cause = ev.get("cause")
+                if cause is None:
+                    why = str(ev.get("why", ""))
+                    if why.startswith("exited"):
+                        cause = "replacement"
+                    elif why == "dead at recovery":
+                        cause = "recovery"
+                    else:
+                        cause = "spawn_failure"
+                if cause == "replacement":
                     bump("replacements")  # unplanned live death
-                elif why != "dead at recovery":
+                elif cause == "spawn_failure":
                     bump("spawn_failures")
             seats.pop(sid, None)
 
@@ -510,7 +564,8 @@ class ReplicaSupervisor(object):
                 # it from ROTATION, but the registry entry and its
                 # channel must not leak); respawn via the deficit path
                 self._journal({"ev": "reap", "seat": sid,
-                               "why": "dead at recovery"})
+                               "why": "dead at recovery",
+                               "cause": "recovery"})
                 if info.get("address"):
                     self._router.remove_replica(info["address"])
                 continue
@@ -741,7 +796,8 @@ class ReplicaSupervisor(object):
             seat.handle.kill()
 
     def _spawn_failed(self, seat, now, why):
-        self._journal({"ev": "reap", "seat": seat.seat_id, "why": why})
+        self._journal({"ev": "reap", "seat": seat.seat_id, "why": why,
+                       "cause": "spawn_failure"})
         del self._seats[seat.seat_id]
         self.spawn_failures += 1
         self._consec_failures += 1
@@ -770,7 +826,8 @@ class ReplicaSupervisor(object):
     def _reap_live(self, seat, now, why):
         """Unplanned loss of a LIVE replica: reap it; the deficit path
         respawns the capacity (bounded by the same backoff/circuit)."""
-        self._journal({"ev": "reap", "seat": seat.seat_id, "why": why})
+        self._journal({"ev": "reap", "seat": seat.seat_id, "why": why,
+                       "cause": "replacement"})
         if seat.address:
             self._router.remove_replica(seat.address)
         del self._seats[seat.seat_id]
@@ -963,7 +1020,8 @@ class ReplicaSupervisor(object):
             handle = self._launcher.spawn(seat_id)
         except Exception as e:  # noqa: BLE001 - spawn-fail drills
             self._journal({"ev": "reap", "seat": seat_id,
-                           "why": "spawn raised: %r" % e})
+                           "why": "spawn raised: %r" % e,
+                           "cause": "spawn_failure"})
             self.spawn_failures += 1
             self._consec_failures += 1
             if self._consec_failures >= self.config.max_restarts:

@@ -53,6 +53,7 @@ drill's stand-in for controller SIGKILL.
 import threading
 import time
 
+from elasticdl_tpu.analysis.typestate import JournalProtocol
 from elasticdl_tpu.checkpoint.saver import verify_checkpoint
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.master.state_store import JobStateStore
@@ -69,6 +70,63 @@ COMMITTED = "committed"
 ROLLED_BACK = "rolled_back"
 ABORTED = "aborted"
 TERMINAL = (IDLE, COMMITTED, ROLLED_BACK, ABORTED)
+
+#: Declared journal protocol: the single source of truth edl-lint
+#: (EDL701-EDL704) verifies _apply_event() and every _journal() site
+#: against, and the machine the spec-derived crash-point replay
+#: battery walks (tests/test_protocol_batteries.py). ``swap_start`` is
+#: informational by design: recovery re-derives swap truth from the
+#: replicas' own advertised model_version at the next tick (see
+#: _recover), so the event exists for forensics, not replay. Every
+#: state is recoverable — the decide loop resumes from any journaled
+#: phase — which is exactly the crash-point-closure property EDL704
+#: holds future edits to.
+PROTOCOL = JournalProtocol(
+    name="rollout",
+    kind_key="ev",
+    emit="_journal",
+    replay="_apply_event",
+    states=(IDLE, STAGING, CANARY, JUDGING, WAVE, ROLLING_BACK,
+            COMMITTED, ROLLED_BACK, ABORTED),
+    initial=IDLE,
+    terminal=TERMINAL,
+    events={
+        "begin": {"from": TERMINAL, "to": STAGING,
+                  "requires": ("target", "old", "plan"),
+                  "optional": ("dir",)},
+        "phase": {"to_key": "to", "optional": ("why",)},
+        "staged": {"from": (STAGING,),
+                   "optional": ("baseline", "manifest")},
+        "swap_start": {"informational": True,
+                       "requires": ("addr", "to")},
+        "swap_done": {"requires": ("addr", "to", "ok"),
+                      "optional": ("note", "why")},
+        "judge": {"from": (JUDGING,), "requires": ("verdict",)},
+        "wave_begin": {"from": (WAVE,),
+                       "requires": ("wave", "addrs")},
+        "wave_commit": {"from": (WAVE,), "requires": ("wave",)},
+        "wave_rollback": {"from": (WAVE,), "requires": ("wave",)},
+        "commit": {"from": (WAVE,), "to": COMMITTED},
+    },
+    transitions={
+        STAGING: (CANARY, ABORTED),
+        CANARY: (JUDGING, ROLLING_BACK, ABORTED),
+        JUDGING: (WAVE, ROLLING_BACK, ABORTED),
+        WAVE: (ROLLING_BACK, ABORTED),
+        ROLLING_BACK: (ROLLED_BACK,),
+    },
+    recoverable={
+        IDLE: "nothing in flight",
+        STAGING: "re-stage the checkpoint (staging is idempotent)",
+        CANARY: "re-swap the canary; advertised versions dedupe",
+        JUDGING: "judgment restarts; the soak clock re-arms",
+        WAVE: "wave membership is journaled; resume the open wave",
+        ROLLING_BACK: "re-walk swapped[] in reverse; no-ops dedupe",
+        COMMITTED: "terminal",
+        ROLLED_BACK: "terminal",
+        ABORTED: "terminal",
+    },
+)
 
 
 def burn_verdict(reports, fast_burn_fail=1.0):
@@ -328,6 +386,13 @@ class RolloutController(object):
             state["wave_addrs"] = []
         elif kind == "wave_rollback":
             state["wave_addrs"] = []
+        elif kind == "commit":
+            # first-sweep EDL701 fix: a crash between the commit event
+            # and the phase transition used to replay back into WAVE
+            # and re-run the commit path; the event now IS the
+            # transition, so the journal prefix [..., commit] recovers
+            # straight to COMMITTED
+            state["phase"] = COMMITTED
 
     def _recover(self):
         """Rebuild the rollout from the journal: snapshot + event
@@ -648,8 +713,9 @@ class RolloutController(object):
             lo = (wave - 1) * cfg.wave_size
             addrs = rest[lo:lo + cfg.wave_size]
             if not addrs:
-                self._journal({"ev": "commit"})
-                self._set_phase(COMMITTED)
+                ev = {"ev": "commit"}
+                self._journal(ev)
+                self._apply_to_self(ev)
                 logger.info(
                     "rollout committed: fleet of %d on version-%d "
                     "(%d swaps)", len(self.plan), self.target_version,

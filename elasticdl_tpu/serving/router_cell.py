@@ -61,6 +61,7 @@ from elasticdl_tpu.common.retry import (
     is_backpressure_rpc_error,
     is_transient_rpc_error,
 )
+from elasticdl_tpu.analysis.typestate import JournalProtocol
 from elasticdl_tpu.master.state_store import JOURNAL_FILE, JobStateStore
 from elasticdl_tpu.serving.prefix_affinity import (
     HashRing,
@@ -76,6 +77,34 @@ from elasticdl_tpu.serving.router import (
 #: registry lock file inside the journal dir: ONE flock serializes
 #: append/refresh/compact across every cell process
 REGISTRY_LOCK_FILE = ".registry.lock"
+
+#: journal protocol declaration, verified by edl-lint EDL701-704 and
+#: walked by the spec-derived crash-replay battery in tests. The
+#: machine is PER ADDRESS: adopt/retire are deliberately legal from
+#: EITHER state (idempotent re-adopt of a seed, retire of an address a
+#: sibling cell already removed), which is what lets compaction
+#: truncate mid-stream. ``lease`` is a liveness beacon — informational
+#: under replay; every cell re-earns leases through its own heartbeat.
+PROTOCOL = JournalProtocol(
+    name="router_cell",
+    kind_key="op",
+    emit="record",
+    replay="_apply_event",
+    states=("absent", "member"),
+    initial="absent",
+    events={
+        "adopt": {"entity_key": "address",
+                  "from": ("absent", "member"), "to": "member"},
+        "retire": {"entity_key": "address",
+                   "from": ("absent", "member"), "to": "absent"},
+        "lease": {"informational": True, "requires": ("addresses",)},
+    },
+    recoverable={
+        "absent": "nothing to resume",
+        "member": "replay re-adds the replica; the heartbeat decides "
+                  "rotation",
+    },
+)
 
 
 class CellRegistryJournal(object):

@@ -1,0 +1,54 @@
+"""C22 negative fixture — a journal protocol whose emit sites, replay
+branches, and declared alphabet agree exactly: every declared kind is
+emitted with its full payload contract, every replay branch matches a
+declared kind, optional keys are read via .get(). Clean under
+EDL701-EDL704.
+"""
+
+from elasticdl_tpu.analysis.typestate import JournalProtocol
+
+PROTOCOL = JournalProtocol(
+    name="meter",
+    kind_key="ev",
+    emit="_journal",
+    replay="_apply_event",
+    states=("idle",),
+    initial="idle",
+    events={
+        "sample": {"informational": True, "requires": ("value",),
+                   "optional": ("tag",)},
+        "flushed": {"informational": True, "requires": ("count",)},
+        "rotate": {"informational": True},
+    },
+    recoverable={"idle": "nothing in flight"},
+)
+
+
+class Meter(object):
+    def __init__(self):
+        self._samples = []
+        self._flushes = 0
+
+    def _journal(self, ev):
+        pass
+
+    def record(self, value, tag=None):
+        ev = {"ev": "sample", "value": value}
+        if tag is not None:
+            ev["tag"] = tag
+        self._journal(ev)
+
+    def flush(self):
+        self._journal({"ev": "flushed", "count": len(self._samples)})
+
+    def rotate(self):
+        self._journal({"ev": "rotate"})
+
+    def _apply_event(self, ev):
+        kind = ev.get("ev")
+        if kind == "sample":
+            self._samples.append((ev["value"], ev.get("tag")))
+        elif kind == "flushed":
+            self._flushes += ev["count"]
+        elif kind == "rotate":
+            self._samples = []

@@ -1,0 +1,67 @@
+"""C22 positive fixture — EDL701/EDL702 write/replay closure and
+payload-schema drift on a declared journal protocol:
+
+1. an emit of a kind the declared alphabet does not know (EDL701
+   undeclared-kind);
+2. a replay branch for a kind the protocol does not know (EDL701
+   dead-replay) and one for a declared kind no emit site produces
+   (EDL701 never-emitted);
+3. an emit that drops a `requires` key (EDL702) and an emit missing a
+   key the replay reads unconditionally (EDL702, inferred contract).
+
+All events are informational (no state transitions), so the typestate
+half (EDL703/EDL704) stays quiet — this fixture isolates the closure
+and schema checks.
+"""
+
+from elasticdl_tpu.analysis.typestate import JournalProtocol
+
+PROTOCOL = JournalProtocol(
+    name="meter",
+    kind_key="ev",
+    emit="_journal",
+    replay="_apply_event",
+    states=("idle",),
+    initial="idle",
+    events={
+        "sample": {"informational": True, "requires": ("value",),
+                   "optional": ("tag",)},
+        "flushed": {"informational": True},
+        "rotate": {"informational": True},
+    },
+    recoverable={"idle": "nothing in flight"},
+)
+
+
+class Meter(object):
+    def __init__(self):
+        self._samples = []
+        self._flushes = 0
+
+    def _journal(self, ev):
+        pass
+
+    def record(self, value):
+        # drift: the declared contract requires 'value'
+        self._journal({"ev": "sample", "tag": "latency"})
+
+    def flush(self):
+        # drift: replay reads ev["count"] unconditionally
+        self._journal({"ev": "flushed"})
+
+    def purge(self):
+        # closure: 'purge' is not in the declared alphabet
+        self._journal({"ev": "purge"})
+
+    def _apply_event(self, ev):
+        kind = ev.get("ev")
+        if kind == "sample":
+            self._samples.append(ev["value"])
+        elif kind == "flushed":
+            self._flushes += ev["count"]
+        elif kind == "rotate":
+            # closure: declared, replayed, but never emitted
+            self._samples = []
+        elif kind == "compact":
+            # closure: replay branch for an undeclared kind
+            self._samples = self._samples[-10:]

@@ -1,0 +1,53 @@
+"""C23 negative fixture — the same two-transition machine as c23_pos
+with both defects repaired: the intermediate 'baking' state declares a
+resume action (so the start->finish window is a legal crash point) and
+'finish' is journaled exactly once, from 'baking'. Clean under
+EDL701-EDL704.
+"""
+
+from elasticdl_tpu.analysis.typestate import JournalProtocol
+
+IDLE = "idle"
+BAKING = "baking"
+DONE = "done"
+
+PROTOCOL = JournalProtocol(
+    name="oven",
+    kind_key="ev",
+    emit="_journal",
+    replay="_apply_event",
+    states=(IDLE, BAKING, DONE),
+    initial=IDLE,
+    terminal=(DONE,),
+    events={
+        "start": {"from": (IDLE,), "to": BAKING},
+        "finish": {"from": (BAKING,), "to": DONE},
+    },
+    recoverable={
+        IDLE: "nothing in flight",
+        BAKING: "replay re-enters baking; the tick resumes the bake",
+        DONE: "the bake is over",
+    },
+)
+
+
+class Oven(object):
+    def __init__(self):
+        self.phase = IDLE
+
+    def _journal(self, ev):
+        pass
+
+    def run(self):
+        self.phase = IDLE
+        self._journal({"ev": "start"})
+        self.phase = BAKING
+        self._journal({"ev": "finish"})
+        self.phase = DONE
+
+    def _apply_event(self, ev):
+        kind = ev.get("ev")
+        if kind == "start":
+            self.phase = BAKING
+        elif kind == "finish":
+            self.phase = DONE

@@ -1,0 +1,61 @@
+"""C23 positive fixture — EDL703/EDL704 typestate violations on a
+declared journal protocol with real transitions:
+
+1. an emit journaled from a machine state its `from` set forbids
+   (EDL703: 'finish' while already done);
+2. an emit that moves the machine into a state with no declared
+   resume action while another journal write is still reachable —
+   the window between the two appends is an unrecoverable crash
+   point (EDL704: 'start' parks the machine in 'baking', which
+   `recoverable` does not cover).
+
+Emit payloads and replay branches agree with the declaration, so the
+closure half (EDL701/EDL702) stays quiet.
+"""
+
+from elasticdl_tpu.analysis.typestate import JournalProtocol
+
+IDLE = "idle"
+BAKING = "baking"
+DONE = "done"
+
+PROTOCOL = JournalProtocol(
+    name="oven",
+    kind_key="ev",
+    emit="_journal",
+    replay="_apply_event",
+    states=(IDLE, BAKING, DONE),
+    initial=IDLE,
+    terminal=(DONE,),
+    events={
+        "start": {"from": (IDLE,), "to": BAKING},
+        "finish": {"from": (BAKING,), "to": DONE},
+    },
+    recoverable={
+        IDLE: "nothing in flight",
+        DONE: "the bake is over",
+    },
+)
+
+
+class Oven(object):
+    def __init__(self):
+        self.phase = IDLE
+
+    def _journal(self, ev):
+        pass
+
+    def run(self):
+        self.phase = IDLE
+        self._journal({"ev": "start"})   # -> baking: unrecoverable window
+        self.phase = BAKING
+        self._journal({"ev": "finish"})
+        self.phase = DONE
+        self._journal({"ev": "finish"})  # illegal: finish from done
+
+    def _apply_event(self, ev):
+        kind = ev.get("ev")
+        if kind == "start":
+            self.phase = BAKING
+        elif kind == "finish":
+            self.phase = DONE

@@ -365,6 +365,54 @@ def test_c21_negative_settled_rollouts_are_clean():
     assert lint_file("c21_neg.py") == []
 
 
+# -------------- C22/C23: EDL701-EDL704 journal-protocol typestate (v4)
+
+
+def test_c22_positive_write_replay_closure_and_payload_drift():
+    """The closure half of a declared journal protocol: an emit of an
+    undeclared kind, a replay branch for an unknown kind, a replay
+    branch no emit produces (EDL701), plus an emit dropping a
+    `requires` key and one missing a key the replay reads
+    unconditionally (EDL702)."""
+    findings = lint_file("c22_pos.py")
+    assert rule_ids(findings) == [
+        "EDL701", "EDL701", "EDL701", "EDL702", "EDL702",
+    ], findings
+    assert {(f.scope, f.detail) for f in findings} == {
+        ("Meter.purge", "undeclared-kind:purge"),
+        ("Meter._apply_event", "dead-replay:compact"),
+        ("Meter._apply_event", "never-emitted:rotate"),
+        ("Meter.record", "sample.value"),
+        ("Meter.flush", "flushed.count"),
+    }
+
+
+def test_c22_negative_closed_protocol_is_clean():
+    """Alphabet == emit sites == replay branches, payload contracts
+    satisfied, optional keys read via .get(): the whole EDL701-EDL704
+    family stays silent."""
+    assert lint_file("c22_neg.py") == []
+
+
+def test_c23_positive_typestate_and_crash_windows():
+    """The machine half: 'finish' journaled from the terminal state
+    its from-set forbids (EDL703), and 'start' parking the machine in
+    an unrecoverable state while another journal write is still
+    reachable (EDL704)."""
+    findings = lint_file("c23_pos.py")
+    assert rule_ids(findings) == ["EDL703", "EDL704"], findings
+    assert {(f.rule, f.scope, f.detail) for f in findings} == {
+        ("EDL703", "Oven.run", "finish@done"),
+        ("EDL704", "Oven.run", "start@baking"),
+    }
+
+
+def test_c23_negative_recoverable_machine_is_clean():
+    """Same machine with the defects repaired — 'baking' declares a
+    resume action and 'finish' fires exactly once, from 'baking'."""
+    assert lint_file("c23_neg.py") == []
+
+
 # ------------------- C14: EDL105 recompile hazard (value-origin v3)
 
 
@@ -583,6 +631,9 @@ FAMILY_FIXTURES = {
                 "c13_pos.py", "c18_pos.py", "c19_pos.py",
                 "c21_pos.py"), "c8_neg.py"),
     "EDL601": (("c17_pos.py",), "c17_neg.py"),
+    # the closure half fires in c22, the typestate half in c23; both
+    # negatives are pinned clean by their dedicated tests above
+    "EDL701": (("c22_pos.py", "c23_pos.py"), "c22_neg.py"),
     # EDL301 is repo-level; its trigger/clean pair is the tampered/
     # pristine pb2 in the proto tests below
     "EDL301": ((), None),
@@ -684,11 +735,76 @@ def test_shipped_tree_is_clean_within_ci_budget():
     import time
 
     t0 = time.monotonic()
-    assert lint_main([]) == 0
+    assert lint_main(["--no-cache"]) == 0
     elapsed = time.monotonic() - t0
     assert elapsed < 60.0, (
-        "full-repo single-process lint took %.1fs (budget 60s); "
+        "full-repo single-process COLD lint took %.1fs (budget 60s); "
         "profile the newest rules" % elapsed
+    )
+
+
+def test_cache_cold_warm_parity_and_no_cache_bypass(tmp_path):
+    """The incremental-cache contract, all three legs in one scenario:
+    a warm run replays byte-identical SARIF to the cold run; the warm
+    run genuinely READS the cache (a tampered entry with a matching
+    content hash surfaces in the output — proof of hits, not re-
+    analysis); and --no-cache bypasses the tampered cache back to the
+    cold bytes."""
+    srcdir = tmp_path / "pkg"
+    srcdir.mkdir()
+    for name in ("c1_pos.py", "c22_pos.py"):
+        shutil.copy(
+            os.path.join(FIXTURES, name),
+            str(srcdir / name.replace("_pos", "_mod")),
+        )
+    root = str(tmp_path)
+    cache_path = tmp_path / ".edl-lint-cache.json"
+
+    def run(extra, out):
+        rc = lint_main(
+            [str(srcdir), "--root", root,
+             "--format", "sarif", "--output", str(out)] + extra
+        )
+        with open(str(out), "rb") as f:
+            return rc, f.read()
+
+    rc, cold = run([], tmp_path / "cold.sarif")
+    assert rc == 1
+    assert cache_path.exists(), "cold run must write the cache"
+
+    rc, warm = run([], tmp_path / "warm.sarif")
+    assert rc == 1
+    assert warm == cold, "warm run is not byte-identical to cold"
+
+    with open(str(cache_path)) as f:
+        data = json.load(f)
+    entry = next(e for e in data["files"].values() if e["findings"])
+    entry["findings"][0][5] = "TAMPERED-CACHE-SENTINEL"
+    with open(str(cache_path), "w") as f:
+        json.dump(data, f)
+    rc, tampered = run([], tmp_path / "tampered.sarif")
+    assert b"TAMPERED-CACHE-SENTINEL" in tampered, (
+        "warm run re-analyzed instead of reading the cache"
+    )
+
+    rc, bypass = run(["--no-cache"], tmp_path / "bypass.sarif")
+    assert bypass == cold, "--no-cache did not bypass the cache"
+
+
+def test_cache_invalidated_by_file_edit(tmp_path):
+    """Editing a linted file invalidates exactly its entry: the next
+    run re-analyzes it and reports the new findings."""
+    srcdir = tmp_path / "pkg"
+    srcdir.mkdir()
+    target = srcdir / "c1_mod.py"
+    shutil.copy(os.path.join(FIXTURES, "c1_pos.py"), str(target))
+    root = str(tmp_path)
+    args = [str(srcdir), "--root", root, "--select", "EDL001"]
+    assert lint_main(args) == 1
+    with open(str(target), "w") as f:
+        f.write("X = 1\n")
+    assert lint_main(args) == 0, (
+        "stale cache entry survived a content change"
     )
 
 
@@ -996,6 +1112,39 @@ def test_sarif_document_structure(tmp_path):
     rule_ids_meta = [r["id"] for r in run["tool"]["driver"]["rules"]]
     assert rule_ids_meta == sorted(rule_ids_meta)
     assert "EDL004" in rule_ids_meta
+    for meta in run["tool"]["driver"]["rules"]:
+        assert meta["helpUri"] == (
+            "docs/designs/static_analysis.md#%s" % meta["id"].lower()
+        )
+
+
+def test_sarif_carries_protocol_family_descriptors(tmp_path):
+    """The EDL701-EDL704 family ships one reportingDescriptor per
+    emitted id, each with a helpUri anchored to its catalogue row —
+    without the descriptor the uploader drops the alert's rule link."""
+    srcdir = tmp_path / "pkg"
+    srcdir.mkdir()
+    for name in ("c22_pos.py", "c23_pos.py"):
+        shutil.copy(os.path.join(FIXTURES, name), str(srcdir / name))
+    out = tmp_path / "protocol.sarif"
+    rc = lint_main([
+        str(srcdir),
+        "--baseline", str(tmp_path / "absent.json"),
+        "--select", "EDL701", "--format", "sarif",
+        "--output", str(out),
+    ])
+    assert rc == 1
+    with open(str(out)) as f:
+        doc = json.load(f)
+    run = doc["runs"][0]
+    metas = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+    for fid in ("EDL701", "EDL702", "EDL703", "EDL704"):
+        assert metas[fid]["helpUri"] == (
+            "docs/designs/static_analysis.md#%s" % fid.lower()
+        )
+    assert {res["ruleId"] for res in run["results"]} == {
+        "EDL701", "EDL702", "EDL703", "EDL704",
+    }
 
 
 def test_sarif_clean_tree_writes_empty_results(tmp_path):

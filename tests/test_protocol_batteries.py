@@ -1,0 +1,358 @@
+"""Spec-derived crash-point replay batteries (tier-1, no jax).
+
+For each of the four WAL-backed controllers, record a journal from a
+live run of the REAL controller (fake processes/replicas, real emit
+path), then walk every crash point with
+`analysis.protocol_testgen.replay_battery`: truncate after each
+event, rebuild through the controller's real replay surface, compare
+against the declared `JournalProtocol` machine's own simulation of
+the prefix, and require deterministic recovery. The
+snapshot/journal-overlap contract (`write_snapshot` lands before the
+journal truncate) is pinned by `double_replay_idempotent` — journal
+counters that deliberately fold full event history are excluded from
+that comparison and ONLY that comparison.
+
+These are the dynamic twins of the EDL701-EDL704 static checks: the
+lint proves the emit/replay surfaces agree with the declaration; the
+battery proves the declaration agrees with what the controllers
+actually do.
+"""
+
+import json
+import os
+
+from test_autoscaler import build as build_supervisor
+from test_autoscaler import settle
+from test_rollout import NEW, drive, make_controller
+from test_router import FakeClock as CellClock
+from test_router import FakeReplicaStub
+
+from elasticdl_tpu.analysis.protocol_testgen import (
+    double_replay_idempotent,
+    kind_coverage,
+    replay_battery,
+    validate_journal,
+)
+from elasticdl_tpu.master.state_store import JOURNAL_FILE, JobStateStore
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.master import task_dispatcher
+from elasticdl_tpu.serving import autoscaler, rollout, router_cell
+from elasticdl_tpu.serving.router import RouterConfig
+from elasticdl_tpu.serving.router_cell import RouterCell
+
+
+# ------------------------------------------------------ master dispatcher
+
+
+def record_dispatcher_journal(tmp_path):
+    """Drive a real dispatcher over a store that never compacts: the
+    full journal of one small job (create, dispatch, done, a fail and
+    its re-run, a model-version bump)."""
+    store = JobStateStore(str(tmp_path / "disp"), snapshot_every=10**6)
+    disp = TaskDispatcher({"f": (0, 30)}, {}, {}, 10, 1,
+                          state_store=store)
+    tid, _task = disp.get(1)
+    disp.report(tid, True)
+    tid, _task = disp.get(1)
+    disp.report(tid, False)  # requeued with a retry bump
+    disp.record_model_version(3)
+    tid, _task = disp.get(2)
+    disp.report(tid, True)
+    store.close()
+    _snapshot, events = JobStateStore(str(tmp_path / "disp")).load()
+    return events
+
+
+def dispatcher_recover(snapshot, events):
+    disp = TaskDispatcher({"f": (0, 30)}, {}, {}, 10, 1)
+    disp.restore(snapshot, events)
+    snap = disp.snapshot()
+    snap["todo"] = sorted(snap["todo"])
+    snap["eval_todo"] = sorted(snap["eval_todo"])
+    snap["recovered_doing"] = sorted(snap["recovered_doing"])
+    snap["retry"] = sorted(snap["retry"])
+    return snap
+
+
+def test_dispatcher_crash_point_battery(tmp_path):
+    spec = task_dispatcher.PROTOCOL
+    events = record_dispatcher_journal(tmp_path)
+    # the recorded job exercises the whole alphabet except the
+    # recovery-only and callback-bookkeeping kinds
+    assert kind_coverage(spec, events) == [
+        "deferred_add", "deferred_invoked", "done_recovered", "stop",
+    ]
+
+    def check(k, sim, snap):
+        recovered_ids = {tid for tid, _w, _key in
+                         snap["recovered_doing"]}
+        for tid, state in sim[1].items():
+            if state == "doing":
+                # in flight at the crash: requeued + parked for
+                # late-report reconciliation
+                assert tid in recovered_ids, (k, tid)
+            elif state == "done":
+                assert tid not in recovered_ids, (k, tid)
+
+    points = replay_battery(spec, events, dispatcher_recover,
+                            check=check)
+    assert points == len(events) + 1
+
+
+def test_dispatcher_snapshot_overlap_replay(tmp_path):
+    # retry counts fold journal history and may inflate by one in the
+    # overlap window (bounded: the journal truncates at the next
+    # compaction); everything stateful must agree exactly
+    events = record_dispatcher_journal(tmp_path)
+    double_replay_idempotent(
+        task_dispatcher.PROTOCOL, events, dispatcher_recover,
+        snapshot_of=lambda snap: json.loads(json.dumps(snap)),
+        fingerprint=lambda snap: {k: v for k, v in snap.items()
+                                  if k != "retry"},
+    )
+
+
+# -------------------------------------------------- autoscaler supervisor
+
+
+def record_supervisor_journal(tmp_path):
+    """A real supervisor lifecycle: spawn to min, an unplanned live
+    death (reap + replacement), a shrink (drain + retire), then a
+    supervisor stop retiring the survivors."""
+    sup, router, _launcher, _clock = build_supervisor(
+        journal_dir=str(tmp_path / "scale"), min_replicas=2,
+        snapshot_every=10**6,
+    )
+    settle(sup, router, ticks=4)
+    victim = sup._seats[min(sup._seats)].handle
+    victim.rc = 1  # crash of a live replica
+    settle(sup, router, ticks=4)  # reap + respawn + re-adopt
+    sup.target = 1
+    settle(sup, router, ticks=4)  # drain one seat, retire on exit
+    sup.stop()
+    _snapshot, events = JobStateStore(str(tmp_path / "scale")).load()
+    return events
+
+
+def supervisor_recover(snapshot, events):
+    state = snapshot or {"target": 0, "next_seat": 0, "seats": {},
+                         "counters": {}}
+    for ev in events:
+        autoscaler.ReplicaSupervisor._apply_event(state, ev)
+    return state
+
+
+def test_supervisor_crash_point_battery(tmp_path):
+    spec = autoscaler.PROTOCOL
+    events = record_supervisor_journal(tmp_path)
+    assert kind_coverage(spec, events) == []  # full alphabet
+
+    def check(k, sim, state):
+        for sid, entity_state in sim[1].items():
+            if entity_state in (autoscaler.STARTING, autoscaler.LIVE,
+                                autoscaler.DRAINING):
+                assert state["seats"][str(sid)]["state"] == \
+                    entity_state, (k, sid)
+            else:  # absent / allocated: no process on the roster yet
+                assert str(sid) not in state["seats"], (k, sid)
+
+    points = replay_battery(spec, events, supervisor_recover,
+                            check=check)
+    assert points == len(events) + 1
+
+
+def test_supervisor_snapshot_overlap_replay(tmp_path):
+    events = record_supervisor_journal(tmp_path)
+    double_replay_idempotent(
+        autoscaler.PROTOCOL, events, supervisor_recover,
+        snapshot_of=lambda state: json.loads(json.dumps(state)),
+        fingerprint=lambda state: {k: v for k, v in state.items()
+                                   if k != "counters"},
+    )
+
+
+# ----------------------------------------------------- rollout controller
+
+
+def record_rollout_journal(tmp_path, wave_alert=False):
+    """A real rollout run over a store that never compacts: the
+    healthy path commits, the wave_alert path trips the pager during
+    a progressive wave and reverse-rolls."""
+    ctl, router, clock, _calls = make_controller(
+        tmp_path, journal=True, snapshot_every=10**6,
+    )
+    assert ctl.begin(NEW)
+    if not wave_alert:
+        assert drive(ctl, clock) == rollout.COMMITTED
+    else:
+        from test_rollout import report
+
+        for _ in range(100):
+            ctl.decide_once()
+            if ctl.phase in rollout.TERMINAL:
+                break
+            if (ctl.phase == rollout.WAVE
+                    and len(ctl.swapped) == 2):
+                router.reports = [report(fast=2.0, slow=2.0,
+                                         alerting=True)]
+            clock.advance(1.0)
+        assert ctl.phase == rollout.ROLLED_BACK
+    _snapshot, events = JobStateStore(
+        str(tmp_path / "journal")).load()
+    return events
+
+
+def rollout_recover(snapshot, events):
+    state = dict(snapshot) if snapshot else {}
+    for ev in events:
+        rollout.RolloutController._apply_event(state, ev)
+    return state
+
+
+#: an operator-driven wave abort: the one declared kind the recorded
+#: runs above cannot reach (wave_rollback is the explicit
+#: rollback_wave() API); strict-validated against the machine before
+#: the battery replays it
+WAVE_ROLLBACK_JOURNAL = [
+    {"ev": "begin", "target": 2, "old": 1, "plan": ["a:1", "b:1"],
+     "dir": "/ckpt"},
+    {"ev": "staged", "baseline": []},
+    {"ev": "phase", "to": rollout.CANARY},
+    {"ev": "swap_done", "addr": "a:1", "to": 2, "ok": True},
+    {"ev": "phase", "to": rollout.JUDGING},
+    {"ev": "judge", "verdict": "pass"},
+    {"ev": "phase", "to": rollout.WAVE},
+    {"ev": "wave_begin", "wave": 1, "addrs": ["b:1"]},
+    {"ev": "swap_done", "addr": "b:1", "to": 2, "ok": True},
+    {"ev": "wave_rollback", "wave": 1},
+    {"ev": "phase", "to": rollout.ROLLING_BACK, "why": "operator"},
+    {"ev": "swap_done", "addr": "b:1", "to": 1, "ok": True,
+     "why": "rollback"},
+    {"ev": "swap_done", "addr": "a:1", "to": 1, "ok": True,
+     "why": "rollback"},
+    {"ev": "phase", "to": rollout.ROLLED_BACK},
+]
+
+
+def rollout_check(k, sim, state):
+    assert state.get("phase", rollout.IDLE) == sim[0], (
+        k, state.get("phase"), sim[0],
+    )
+
+
+def test_rollout_crash_point_battery_commit_path(tmp_path):
+    spec = rollout.PROTOCOL
+    events = record_rollout_journal(tmp_path)
+    replay_battery(spec, events, rollout_recover, check=rollout_check)
+
+
+def test_rollout_crash_point_battery_alert_rollback_path(tmp_path):
+    spec = rollout.PROTOCOL
+    events = record_rollout_journal(tmp_path, wave_alert=True)
+    replay_battery(spec, events, rollout_recover, check=rollout_check)
+
+
+def test_rollout_crash_point_battery_wave_rollback_path(tmp_path):
+    spec = rollout.PROTOCOL
+    events = [dict(ev) for ev in WAVE_ROLLBACK_JOURNAL]
+    replay_battery(spec, events, rollout_recover, check=rollout_check)
+
+
+def test_rollout_journals_cover_the_alphabet(tmp_path):
+    spec = rollout.PROTOCOL
+    covered = set()
+    for events in (
+        record_rollout_journal(tmp_path / "commit"),
+        record_rollout_journal(tmp_path / "alert", wave_alert=True),
+        WAVE_ROLLBACK_JOURNAL,
+    ):
+        covered |= {ev["ev"] for ev in events}
+    assert spec.replayed_kinds() <= covered
+
+
+def test_rollout_snapshot_overlap_replay(tmp_path):
+    events = record_rollout_journal(tmp_path)
+    double_replay_idempotent(
+        rollout.PROTOCOL, events, rollout_recover,
+        snapshot_of=lambda state: json.loads(json.dumps(state)),
+        fingerprint=lambda state: {k: v for k, v in state.items()
+                                   if k != "counters"},
+    )
+
+
+# --------------------------------------------------- router cell registry
+
+
+def record_cell_journal(tmp_path):
+    """A real cell's registry life: seed adopts at construction, a
+    runtime adopt, a retire, and the periodic lease beacon."""
+    from test_router_cells import make_cell
+
+    cell, _stubs, _clock = make_cell(
+        tmp_path / "cells", seeds=("a:1", "b:1"),
+    )
+    cell.add_replica("c:1")
+    cell.remove_replica("b:1")
+    for _ in range(cell.LEASE_JOURNAL_EVERY):
+        cell.poll_once()  # the 8th tick records the lease beacon
+    path = os.path.join(str(tmp_path / "cells"), JOURNAL_FILE)
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def make_bare_cell(seeds):
+    stubs = {}
+
+    def factory(addr):
+        if addr not in stubs:
+            stubs[addr] = FakeReplicaStub(token=7)
+        return stubs[addr]
+
+    return RouterCell(
+        list(seeds), config=RouterConfig(cell_id=0, cells=2),
+        journal_dir=None, stub_factory=factory, clock=CellClock(),
+        sleep=lambda s: None,
+    )
+
+
+def cell_recover(snapshot, events):
+    cell = make_bare_cell(snapshot["replicas"] if snapshot else ())
+    for ev in events:
+        cell._apply_event(ev)
+    return sorted(r.address for r in cell.replicas())
+
+
+def test_cell_crash_point_battery(tmp_path):
+    spec = router_cell.PROTOCOL
+    events = record_cell_journal(tmp_path)
+    assert kind_coverage(spec, events) == []  # full alphabet
+
+    def check(k, sim, addresses):
+        members = sorted(a for a, st in sim[1].items()
+                         if st == "member")
+        assert addresses == members, (k, addresses, members)
+
+    points = replay_battery(spec, events, cell_recover, check=check)
+    assert points == len(events) + 1
+
+
+def test_cell_snapshot_overlap_replay(tmp_path):
+    events = record_cell_journal(tmp_path)
+    double_replay_idempotent(
+        router_cell.PROTOCOL, events, cell_recover,
+        snapshot_of=lambda addresses: {"replicas": list(addresses)},
+    )
+
+
+def test_cell_retire_of_absent_address_is_legal(tmp_path):
+    # the idempotence the from-sets declare on purpose: a sibling
+    # already removed it; replaying both retires is a no-op
+    spec = router_cell.PROTOCOL
+    events = [
+        {"op": "adopt", "address": "a:1", "cell": 0},
+        {"op": "retire", "address": "a:1", "cell": 0},
+        {"op": "retire", "address": "a:1", "cell": 1},
+        {"op": "adopt", "address": "a:1", "cell": 1},
+    ]
+    validate_journal(spec, events)
+    assert cell_recover(None, events) == ["a:1"]

@@ -60,6 +60,51 @@ def test_torn_middle_line_raises(tmp_path):
         store.load()
 
 
+def test_torn_tail_binary_garbage_is_dropped_and_counted(tmp_path):
+    # a power-loss torn block write can leave raw non-UTF-8 bytes, not
+    # just a JSON prefix; load must survive it at the BYTES level
+    store = JobStateStore(str(tmp_path / "s"))
+    store.append({"ev": "a"})
+    store.close()
+    path = os.path.join(str(tmp_path / "s"), "journal.jsonl")
+    with open(path, "ab") as f:
+        f.write(b'{"ev": "b"}\n\xff\xfe\x00garbage')
+    reopened = JobStateStore(str(tmp_path / "s"))
+    _, events = reopened.load()
+    assert [e["ev"] for e in events] == ["a", "b"]
+    assert reopened.torn_lines == 1
+
+
+def test_torn_tail_with_newline_is_dropped_and_counted(tmp_path):
+    store = JobStateStore(str(tmp_path / "s"))
+    store.append({"ev": "a"})
+    store.close()
+    path = os.path.join(str(tmp_path / "s"), "journal.jsonl")
+    with open(path, "ab") as f:
+        f.write(b"\xc3(not json\n")  # invalid UTF-8, newline landed
+    reopened = JobStateStore(str(tmp_path / "s"))
+    _, events = reopened.load()
+    assert [e["ev"] for e in events] == ["a"]
+    assert reopened.torn_lines == 1
+
+
+def test_append_after_torn_tail_trims_instead_of_concatenating(tmp_path):
+    # without the trim, the next append would glue onto the torn line
+    # and turn recoverable tail garbage into fatal MID-file corruption
+    store = JobStateStore(str(tmp_path / "s"))
+    store.append({"ev": "a"})
+    store.close()
+    path = os.path.join(str(tmp_path / "s"), "journal.jsonl")
+    with open(path, "ab") as f:
+        f.write(b'{"ev": "tor')
+    reopened = JobStateStore(str(tmp_path / "s"))
+    reopened.append({"ev": "b"})
+    reopened.close()
+    assert reopened.torn_lines == 1
+    _, events = JobStateStore(str(tmp_path / "s")).load()
+    assert [e["ev"] for e in events] == ["a", "b"]
+
+
 def test_snapshot_compacts_journal(tmp_path):
     store = JobStateStore(str(tmp_path / "s"), snapshot_every=1000)
     for i in range(5):
